@@ -1,0 +1,26 @@
+"""pydcop_tpu — a TPU-native (JAX/XLA) framework for Distributed Constraint
+Optimization Problems.
+
+A from-scratch re-design of the capabilities of pyDCOP (reference:
+Orange-OpenSource/pyDcop fork, see /root/reference) built TPU-first:
+
+* the problem model (domains, variables, constraints, agents) compiles into
+  **padded tensor graphs** (`pydcop_tpu.ops.compile`),
+* every synchronous-round algorithm (MaxSum, DSA, MGM, MGM-2, DBA, GDBA, ...)
+  is a **jitted step function** run under ``lax.scan`` instead of an actor
+  system exchanging messages over queues,
+* inference on trees (DPOP) is expressed as level-scheduled batched
+  ``join``/``projection`` tensor contractions,
+* scale-out uses ``jax.sharding`` meshes + ``shard_map`` with XLA collectives
+  instead of per-agent threads/HTTP (reference:
+  pydcop/infrastructure/communication.py).
+
+The public API mirrors the reference's layering (see SURVEY.md):
+model (`pydcop_tpu.dcop`), computation graphs (`pydcop_tpu.graph`),
+algorithms (`pydcop_tpu.algorithms`), distribution (`pydcop_tpu.distribution`),
+runtime (`pydcop_tpu.runtime`), CLI (`pydcop_tpu.cli`).
+"""
+
+from pydcop_tpu.version import __version__
+
+__all__ = ["__version__"]

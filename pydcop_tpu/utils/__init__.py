@@ -1,0 +1,4 @@
+from pydcop_tpu.utils.serialization import SimpleRepr, simple_repr, from_repr
+from pydcop_tpu.utils.expressions import ExpressionFunction
+
+__all__ = ["SimpleRepr", "simple_repr", "from_repr", "ExpressionFunction"]

@@ -1,0 +1,74 @@
+"""Small graph helpers used by computation-graph builders and generators.
+
+Equivalent capability to the reference's pydcop/utils/graphs.py, implemented
+on plain adjacency dicts (networkx is only used by the problem generators).
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, Hashable, Iterable, List, Set, Tuple
+
+
+def as_adjacency(edges: Iterable[Tuple[Hashable, Hashable]]) -> Dict:
+    adj: Dict[Hashable, Set] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set()).add(a)
+    return adj
+
+
+def connected_components(adj: Dict[Hashable, Set]) -> List[Set]:
+    seen: Set = set()
+    comps: List[Set] = []
+    for start in adj:
+        if start in seen:
+            continue
+        comp = {start}
+        q = deque([start])
+        while q:
+            n = q.popleft()
+            for m in adj.get(n, ()):
+                if m not in comp:
+                    comp.add(m)
+                    q.append(m)
+        seen |= comp
+        comps.append(comp)
+    return comps
+
+
+def is_connected(adj: Dict[Hashable, Set]) -> bool:
+    if not adj:
+        return True
+    return len(connected_components(adj)) == 1
+
+
+def has_cycle(adj: Dict[Hashable, Set]) -> bool:
+    """True if the undirected graph contains at least one cycle."""
+    seen: Set = set()
+    for start in adj:
+        if start in seen:
+            continue
+        stack = [(start, None)]
+        local: Set = set()
+        while stack:
+            n, parent = stack.pop()
+            if n in local:
+                return True
+            local.add(n)
+            for m in adj.get(n, ()):
+                if m != parent:
+                    stack.append((m, n))
+        seen |= local
+    return False
+
+
+def bfs_order(adj: Dict[Hashable, Set], root: Hashable) -> List[Hashable]:
+    order, seen, q = [], {root}, deque([root])
+    while q:
+        n = q.popleft()
+        order.append(n)
+        for m in sorted(adj.get(n, ()), key=str):
+            if m not in seen:
+                seen.add(m)
+                q.append(m)
+    return order

@@ -1,0 +1,78 @@
+"""Unit tests for the safe expression evaluator."""
+import pytest
+
+from pydcop_tpu.utils.expressions import (
+    ExpressionFunction,
+    ExpressionFunctionError,
+)
+from pydcop_tpu.utils.serialization import from_repr, simple_repr
+
+
+def test_simple_expression():
+    f = ExpressionFunction("a + b * 2")
+    assert f.variable_names == {"a", "b"}
+    assert f(a=1, b=3) == 7
+
+
+def test_conditional():
+    f = ExpressionFunction("1 if v1 == v2 else 0")
+    assert f(v1="R", v2="R") == 1
+    assert f(v1="R", v2="G") == 0
+
+
+def test_math_helpers():
+    f = ExpressionFunction("abs(x) + round(y)")
+    assert f(x=-2, y=1.4) == 3
+    g = ExpressionFunction("sqrt(x)")
+    assert g(x=9) == 3
+
+
+def test_partial():
+    f = ExpressionFunction("a + b + c")
+    g = f.partial(b=10)
+    assert g.variable_names == {"a", "c"}
+    assert g(a=1, c=2) == 13
+
+
+def test_partial_unknown_var():
+    with pytest.raises(ExpressionFunctionError):
+        ExpressionFunction("a + b").partial(z=1)
+
+
+def test_missing_variable():
+    with pytest.raises(ExpressionFunctionError):
+        ExpressionFunction("a + b")(a=1)
+
+
+def test_multiline_return():
+    f = ExpressionFunction(
+        """
+total = a + b
+return total * 2
+"""
+    )
+    assert f(a=1, b=2) == 6
+
+
+@pytest.mark.parametrize(
+    "expr",
+    [
+        "__import__('os').system('true')",
+        "open('/etc/passwd')",
+        "(lambda: 1)()",
+        "x.__class__",
+    ],
+)
+def test_unsafe_rejected(expr):
+    with pytest.raises(Exception):
+        f = ExpressionFunction(expr)
+        f(x=1)
+
+
+def test_serialization():
+    f = ExpressionFunction("a + b")
+    f2 = from_repr(simple_repr(f))
+    assert f2(a=1, b=2) == 3
+    g = f.partial(b=5)
+    g2 = from_repr(simple_repr(g))
+    assert g2(a=1) == 6

@@ -1,0 +1,408 @@
+"""Unit tests for the problem model layer (dcop/objects, relations, dcop)."""
+import os
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop import (
+    DCOP,
+    AgentDef,
+    AsNAryFunctionRelation,
+    BinaryVariable,
+    Domain,
+    ExternalVariable,
+    NAryFunctionRelation,
+    NAryMatrixRelation,
+    UnaryFunctionRelation,
+    Variable,
+    VariableNoisyCostFunc,
+    VariableWithCostDict,
+    VariableWithCostFunc,
+    assignment_cost,
+    constraint_from_str,
+    create_agents,
+    create_variables,
+    dcop_yaml,
+    find_arg_optimal,
+    find_optimum,
+    join,
+    load_dcop,
+    load_dcop_from_file,
+    projection,
+)
+from pydcop_tpu.utils import ExpressionFunction, from_repr, simple_repr
+
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+
+
+@pytest.fixture
+def d3():
+    return Domain("d3", "test", [0, 1, 2])
+
+
+class TestDomain:
+    def test_basic(self, d3):
+        assert len(d3) == 3
+        assert d3.index(1) == 1
+        assert d3[2] == 2
+        assert 0 in d3 and 5 not in d3
+        assert list(d3) == [0, 1, 2]
+
+    def test_to_domain_value(self):
+        d = Domain("c", "color", ["R", "G"])
+        assert d.to_domain_value("G") == "G"
+        di = Domain("n", "int", [1, 2, 3])
+        assert di.to_domain_value("2") == 2
+
+    def test_serialization(self, d3):
+        r = simple_repr(d3)
+        assert from_repr(r) == d3
+
+
+class TestVariables:
+    def test_variable(self, d3):
+        v = Variable("v1", d3, initial_value=1)
+        assert v.initial_value == 1
+        assert v.cost_for_val(2) == 0
+        assert not v.has_cost
+
+    def test_bad_initial_value(self, d3):
+        with pytest.raises(ValueError):
+            Variable("v1", d3, initial_value=7)
+
+    def test_cost_dict(self, d3):
+        v = VariableWithCostDict("v1", d3, {0: 1.5, 2: -1.0})
+        assert v.cost_for_val(0) == 1.5
+        assert v.cost_for_val(1) == 0
+        np.testing.assert_allclose(v.cost_vector(), [1.5, 0, -1.0])
+
+    def test_cost_func(self, d3):
+        v = VariableWithCostFunc("v1", d3, ExpressionFunction("v1 * 2"))
+        assert v.cost_for_val(2) == 4
+        assert v.has_cost
+
+    def test_cost_func_wrong_var(self, d3):
+        with pytest.raises(ValueError):
+            VariableWithCostFunc("v1", d3, ExpressionFunction("other * 2"))
+
+    def test_noisy_cost_deterministic(self, d3):
+        v1 = VariableNoisyCostFunc("v1", d3, ExpressionFunction("v1 * 2"),
+                                   noise_level=0.1)
+        v2 = VariableNoisyCostFunc("v1", d3, ExpressionFunction("v1 * 2"),
+                                   noise_level=0.1)
+        assert v1.cost_for_val(1) == v2.cost_for_val(1)
+        assert 2 <= v1.cost_for_val(1) <= 2.1
+
+    def test_binary(self):
+        b = BinaryVariable("b1")
+        assert list(b.domain) == [0, 1]
+
+    def test_external(self, d3):
+        seen = []
+        ev = ExternalVariable("e1", d3, 0)
+        ev.subscribe(seen.append)
+        ev.value = 2
+        assert ev.value == 2 and seen == [2]
+        with pytest.raises(ValueError):
+            ev.value = 9
+
+    def test_create_variables(self, d3):
+        vs = create_variables("x_", ["a", "b"], d3)
+        assert set(vs) == {"x_a", "x_b"}
+        vs2 = create_variables("m", (["1", "2"], ["a"]), d3)
+        assert vs2[("1", "a")].name == "m1_a"
+
+
+class TestAgentDef:
+    def test_costs_routes(self):
+        a = AgentDef("a1", capacity=50, default_hosting_cost=2,
+                     hosting_costs={"c1": 7}, default_route=3,
+                     routes={"a2": 1})
+        assert a.hosting_cost("c1") == 7
+        assert a.hosting_cost("cX") == 2
+        assert a.route("a1") == 0
+        assert a.route("a2") == 1
+        assert a.route("a9") == 3
+
+    def test_extra_attrs(self):
+        a = AgentDef("a1", preference="high")
+        assert a.preference == "high"
+        with pytest.raises(AttributeError):
+            _ = a.nope
+
+    def test_create_agents(self):
+        agts = create_agents("a", range(3), capacity=10)
+        assert set(agts) == {"a0", "a1", "a2"}
+        assert agts["a1"].capacity == 10
+
+    def test_serialization(self):
+        a = AgentDef("a1", capacity=11, hosting_costs={"c": 3}, routes={"a2": 5})
+        a2 = from_repr(simple_repr(a))
+        assert a2 == a
+
+
+class TestRelations:
+    def test_matrix_relation(self, d3):
+        x, y = Variable("x", d3), Variable("y", d3)
+        m = np.arange(9).reshape(3, 3)
+        r = NAryMatrixRelation([x, y], m, "r")
+        assert r(x=1, y=2) == 5
+        assert r.get_value_for_assignment({"x": 2, "y": 0}) == 6
+        assert r.get_value_for_assignment([2, 0]) == 6
+        assert r.arity == 2 and r.shape == (3, 3)
+
+    def test_slice(self, d3):
+        x, y = Variable("x", d3), Variable("y", d3)
+        r = NAryMatrixRelation([x, y], np.arange(9).reshape(3, 3), "r")
+        s = r.slice({"x": 1})
+        assert s.arity == 1
+        assert s(y=0) == 3
+
+    def test_set_value(self, d3):
+        x = Variable("x", d3)
+        r = NAryMatrixRelation([x], name="r")
+        r2 = r.set_value_for_assignment({"x": 1}, 5)
+        assert r(x=1) == 0 and r2(x=1) == 5
+
+    def test_function_relation(self, d3):
+        x, y = Variable("x", d3), Variable("y", d3)
+        r = NAryFunctionRelation(lambda a, b: a * 10 + b, [x, y], "r")
+        assert r(2, 1) == 21
+        t = r.to_tensor()
+        assert t.shape == (3, 3) and t[2, 1] == 21
+
+    def test_decorator(self, d3):
+        x, y = Variable("x", d3), Variable("y", d3)
+
+        @AsNAryFunctionRelation(x, y)
+        def my_rel(x, y):
+            return x + y
+
+        assert my_rel.name == "my_rel"
+        assert my_rel(1, 2) == 3
+
+    def test_unary(self, d3):
+        x = Variable("x", d3)
+        r = UnaryFunctionRelation("r", x, lambda v: v * 3)
+        assert r(2) == 6
+        vals, opt = find_arg_optimal(x, r, "min")
+        assert vals == [0] and opt == 0
+
+    def test_constraint_from_str(self, d3):
+        x, y = Variable("x", d3), Variable("y", d3)
+        c = constraint_from_str("c", "1 if x == y else 0", [x, y])
+        assert c(1, 1) == 1 and c(0, 1) == 0
+        assert set(c.scope_names) == {"x", "y"}
+
+    def test_find_optimum(self, d3):
+        x, y = Variable("x", d3), Variable("y", d3)
+        r = NAryMatrixRelation([x, y], np.arange(9).reshape(3, 3) - 4, "r")
+        assert find_optimum(r, "min") == -4
+        assert find_optimum(r, "max") == 4
+
+    def test_join(self, d3):
+        x, y, z = Variable("x", d3), Variable("y", d3), Variable("z", d3)
+        r1 = NAryMatrixRelation([x, y], np.arange(9).reshape(3, 3), "r1")
+        r2 = NAryMatrixRelation([y, z], 10 * np.arange(9).reshape(3, 3), "r2")
+        j = join(r1, r2)
+        assert [v.name for v in j.dimensions] == ["x", "y", "z"]
+        for xa in range(3):
+            for ya in range(3):
+                for za in range(3):
+                    assert j(x=xa, y=ya, z=za) == r1(xa, ya) + r2(ya, za)
+
+    def test_join_same_dims(self, d3):
+        x, y = Variable("x", d3), Variable("y", d3)
+        r1 = NAryMatrixRelation([x, y], np.ones((3, 3)), "r1")
+        r2 = NAryMatrixRelation([y, x], np.arange(9).reshape(3, 3), "r2")
+        j = join(r1, r2)
+        assert j.arity == 2
+        assert j(x=0, y=2) == 1 + r2(2, 0)
+
+    def test_projection(self, d3):
+        x, y = Variable("x", d3), Variable("y", d3)
+        r = NAryMatrixRelation([x, y], [[5, 1, 7], [2, 8, 0], [9, 9, 9]], "r")
+        p = projection(r, y, "min")
+        assert p(x=0) == 1 and p(x=1) == 0 and p(x=2) == 9
+        pm = projection(r, x, "max")
+        assert pm(y=0) == 9
+
+    def test_assignment_cost(self, d3):
+        x, y = Variable("x", d3), Variable("y", d3)
+        c1 = constraint_from_str("c1", "x + y", [x, y])
+        assert assignment_cost({"x": 1, "y": 2}, [c1]) == 3
+
+    def test_matrix_serialization(self, d3):
+        x, y = Variable("x", d3), Variable("y", d3)
+        r = NAryMatrixRelation([x, y], np.arange(9).reshape(3, 3), "r")
+        r2 = from_repr(simple_repr(r))
+        assert r2 == r
+
+
+class TestDCOP:
+    def test_container(self, d3):
+        dcop = DCOP("t")
+        x, y = Variable("x", d3), Variable("y", d3)
+        dcop.add_constraint(constraint_from_str("c", "x + y", [x, y]))
+        assert set(dcop.variables) == {"x", "y"}
+        assert dcop.domains["d3"] == d3
+        assert len(dcop.constraints_for_variable("x")) == 1
+
+    def test_solution_cost_with_violation(self, d3):
+        dcop = DCOP("t")
+        x, y = Variable("x", d3), Variable("y", d3)
+        dcop.add_constraint(
+            constraint_from_str("c", "10000 if x == y else x + y", [x, y])
+        )
+        assert dcop.solution_cost({"x": 1, "y": 1}, 10000) == (1, 0)
+        assert dcop.solution_cost({"x": 1, "y": 2}, 10000) == (0, 3)
+
+    def test_variable_costs_in_solution_cost(self, d3):
+        dcop = DCOP("t")
+        x = VariableWithCostDict("x", d3, {0: 0.5, 1: 0, 2: 0})
+        y = Variable("y", d3)
+        dcop.add_variable(x)
+        dcop.add_constraint(constraint_from_str("c", "x + y", [x, y]))
+        violations, cost = dcop.solution_cost({"x": 0, "y": 1}, 10000)
+        assert violations == 0 and cost == 1.5
+
+    def test_merge(self, d3):
+        a, b = DCOP("a"), DCOP("b")
+        x, y = Variable("x", d3), Variable("y", d3)
+        a.add_constraint(constraint_from_str("c1", "x * 2", [x]))
+        b.add_constraint(constraint_from_str("c2", "y * 3", [y]))
+        m = a + b
+        assert set(m.constraints) == {"c1", "c2"}
+        assert set(m.variables) == {"x", "y"}
+
+
+class TestYaml:
+    def test_load_tuto(self):
+        dcop = load_dcop_from_file(
+            os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+        )
+        assert dcop.objective == "min"
+        assert set(dcop.variables) == {"v1", "v2", "v3", "v4"}
+        assert len(dcop.constraints) == 4
+        assert len(dcop.agents) == 5
+        assert dcop.agents["a1"].capacity == 100
+        # known optimum
+        assert dcop.solution_cost(
+            {"v1": "G", "v2": "G", "v3": "G", "v4": "G"}, 10000
+        ) == (0, 12)
+        c = dcop.constraints["c_2_3"]
+        assert c(**{"v2": "G", "v3": "R"}) == 3  # from 'G R | G G' grouping
+
+    def test_load_intention(self):
+        dcop = load_dcop_from_file(
+            os.path.join(INSTANCES, "coloring_intention.yaml")
+        )
+        assert dcop.variables["v1"].has_cost
+        assert dcop.variables["v1"].cost_for_val("R") == pytest.approx(-0.1)
+        assert dcop.dist_hints is not None
+        assert dcop.dist_hints.must_host("a1") == ["v1"]
+        violations, cost = dcop.solution_cost(
+            {"v1": "R", "v2": "G", "v3": "R"}, 10000
+        )
+        assert violations == 0
+        assert cost == pytest.approx(-0.1 - 0.1 + 0.1)
+
+    def test_load_range_domain(self):
+        dcop = load_dcop(
+            """
+name: r
+domains:
+  ten:
+    values: [0 .. 9]
+variables:
+  v1: {domain: ten}
+constraints:
+  c1: {type: intention, function: v1 * 2}
+agents: [a1]
+"""
+        )
+        assert len(dcop.domains["ten"]) == 10
+        assert dcop.constraints["c1"](5) == 10
+
+    def test_load_agents_routes_hosting(self):
+        dcop = load_dcop(
+            """
+name: r
+domains: {d: {values: [0, 1]}}
+variables: {v1: {domain: d}}
+constraints: {c1: {type: intention, function: v1}}
+agents:
+  a1: {capacity: 10}
+  a2: {capacity: 20}
+routes:
+  default: 5
+  a1: {a2: 2}
+hosting_costs:
+  default: 7
+  a1:
+    default: 3
+    computations: {v1: 1}
+"""
+        )
+        a1, a2 = dcop.agents["a1"], dcop.agents["a2"]
+        assert a1.route("a2") == 2 and a2.route("a1") == 2
+        assert a1.route("aX") == 5
+        assert a1.hosting_cost("v1") == 1
+        assert a1.hosting_cost("other") == 3
+        assert a2.hosting_cost("v1") == 7
+
+    def test_roundtrip(self):
+        dcop = load_dcop_from_file(
+            os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+        )
+        dumped = dcop_yaml(dcop)
+        dcop2 = load_dcop(dumped)
+        assert set(dcop2.variables) == set(dcop.variables)
+        assert set(dcop2.constraints) == set(dcop.constraints)
+        for a in ("G", "R"):
+            asst = {v: a for v in dcop.variables}
+            assert dcop2.solution_cost(asst, 10000) == dcop.solution_cost(
+                asst, 10000
+            )
+
+    def test_external_variables(self):
+        dcop = load_dcop(
+            """
+name: r
+domains: {d: {values: [0, 1]}}
+variables: {v1: {domain: d}}
+external_variables:
+  e1: {domain: d, initial_value: 1}
+constraints:
+  c1: {type: intention, function: v1 + e1}
+agents: [a1]
+"""
+        )
+        assert dcop.external_variables["e1"].value == 1
+        # external variable value is injected into solution_cost
+        assert dcop.solution_cost({"v1": 1}, 10000) == (0, 2)
+
+
+@pytest.mark.skipif(
+    not os.path.isdir("/root/reference/tests/instances"),
+    reason="reference instances not mounted",
+)
+class TestReferenceInstanceParity:
+    """Load every instance file shipped with the reference (format parity)."""
+
+    def test_load_all_reference_instances(self):
+        import glob
+
+        files = glob.glob("/root/reference/tests/instances/*.y*ml")
+        assert files
+        for fn in files:
+            dcop = load_dcop_from_file(fn)
+            assert dcop.variables or dcop.external_variables, fn
+
+    def test_reference_tuto_optimum(self):
+        dcop = load_dcop_from_file(
+            "/root/reference/tests/instances/graph_coloring_tuto.yaml"
+        )
+        assert dcop.solution_cost(
+            {"v1": "G", "v2": "G", "v3": "G", "v4": "G"}, 10000
+        ) == (0, 12)
