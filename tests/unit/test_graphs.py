@@ -1,0 +1,125 @@
+"""Unit tests for the four computation-graph models."""
+import pytest
+
+from pydcop_tpu.dcop import DCOP, Domain, Variable, constraint_from_str
+from pydcop_tpu.graph import load_graph_module
+from pydcop_tpu.graph import factor_graph, constraints_hypergraph
+from pydcop_tpu.graph import pseudotree, ordered_graph
+
+
+@pytest.fixture
+def coloring_dcop():
+    """Triangle + one pendant variable."""
+    d = Domain("colors", "color", ["R", "G", "B"])
+    dcop = DCOP("coloring")
+    vs = {n: Variable(n, d) for n in ("v1", "v2", "v3", "v4")}
+    for a, b in [("v1", "v2"), ("v2", "v3"), ("v1", "v3"), ("v3", "v4")]:
+        dcop.add_constraint(
+            constraint_from_str(
+                f"c_{a}_{b}", f"1 if {a} == {b} else 0", vs.values()
+            )
+        )
+    return dcop
+
+
+def test_load_graph_module():
+    m = load_graph_module("factor_graph")
+    assert m.GRAPH_TYPE == "factor_graph"
+    with pytest.raises(ValueError):
+        load_graph_module("nope")
+
+
+class TestFactorGraph:
+    def test_build(self, coloring_dcop):
+        fg = factor_graph.build_computation_graph(coloring_dcop)
+        assert len(fg.var_nodes) == 4
+        assert len(fg.factor_nodes) == 4
+        assert fg.node_count() == 8
+        v3 = fg.computation("v3")
+        assert set(v3.neighbors) == {"c_v2_v3", "c_v1_v3", "c_v3_v4"}
+        f = fg.computation("c_v1_v2")
+        assert set(f.neighbors) == {"v1", "v2"}
+
+    def test_density(self, coloring_dcop):
+        fg = factor_graph.build_computation_graph(coloring_dcop)
+        assert 0 < fg.density() < 1
+
+
+class TestConstraintsHypergraph:
+    def test_build(self, coloring_dcop):
+        g = constraints_hypergraph.build_computation_graph(coloring_dcop)
+        assert g.node_count() == 4
+        v3 = g.computation("v3")
+        assert set(v3.neighbors) == {"v1", "v2", "v4"}
+        assert len(v3.constraints) == 3
+        v4 = g.computation("v4")
+        assert set(v4.neighbors) == {"v3"}
+
+
+class TestPseudoTree:
+    def test_build(self, coloring_dcop):
+        pt = pseudotree.build_computation_graph(coloring_dcop)
+        assert len(pt.roots) == 1
+        root = pt.computation(pt.root)
+        assert root.parent is None
+        # every non-root has exactly one parent, depths are consistent
+        for n in pt.nodes:
+            if n.name != pt.root:
+                assert n.parent is not None
+                assert pt.depth(n.name) == pt.depth(n.parent) + 1
+
+    def test_back_edges(self, coloring_dcop):
+        pt = pseudotree.build_computation_graph(coloring_dcop)
+        # triangle v1-v2-v3 forces exactly one pseudo edge
+        pseudo = [
+            (n.name, pp) for n in pt.nodes for pp in n.pseudo_parents
+        ]
+        assert len(pseudo) == 1
+        node, pp = pseudo[0]
+        # the pseudo parent must be an ancestor of the node
+        anc = pt.computation(node).parent
+        ancestors = set()
+        while anc is not None:
+            ancestors.add(anc)
+            anc = pt.computation(anc).parent
+        assert pp in ancestors
+        # symmetric pseudo_children
+        assert node in pt.computation(pp).pseudo_children
+
+    def test_constraints_on_lowest_node(self, coloring_dcop):
+        pt = pseudotree.build_computation_graph(coloring_dcop)
+        all_attached = [c.name for n in pt.nodes for c in n.constraints]
+        assert sorted(all_attached) == sorted(coloring_dcop.constraints)
+        for n in pt.nodes:
+            for c in n.constraints:
+                # node must be the deepest variable of the constraint
+                depths = [pt.depth(v.name) for v in c.dimensions]
+                assert pt.depth(n.name) == max(depths)
+
+    def test_forest_on_disconnected(self):
+        d = Domain("d", "d", [0, 1])
+        dcop = DCOP("two_comps")
+        vs = {n: Variable(n, d) for n in ("a1", "a2", "b1", "b2")}
+        dcop.add_constraint(
+            constraint_from_str("ca", "1 if a1 == a2 else 0", vs.values()))
+        dcop.add_constraint(
+            constraint_from_str("cb", "1 if b1 == b2 else 0", vs.values()))
+        pt = pseudotree.build_computation_graph(dcop)
+        assert len(pt.roots) == 2
+
+    def test_levels(self, coloring_dcop):
+        pt = pseudotree.build_computation_graph(coloring_dcop)
+        levels = pt.nodes_by_depth()
+        assert sum(len(l) for l in levels) == 4
+        assert [n.name for n in levels[0]] == [pt.root]
+
+
+class TestOrderedGraph:
+    def test_build(self, coloring_dcop):
+        og = ordered_graph.build_computation_graph(coloring_dcop)
+        assert og.order == ["v1", "v2", "v3", "v4"]
+        n1 = og.computation("v1")
+        assert n1.previous_node is None and n1.next_node == "v2"
+        n4 = og.computation("v4")
+        assert n4.next_node is None and n4.previous_node == "v3"
+        assert len(og.computation("v3").constraints) == 3
