@@ -1,0 +1,31 @@
+"""Tensorization + XLA kernel ops — the layer with no reference twin.
+
+This package turns a DCOP + computation-graph model into **padded device
+arrays** (`pydcop_tpu.ops.compile`) and provides the jitted update kernels
+that algorithms compose into synchronous rounds:
+
+* segment reductions over graph neighborhoods (`segments`),
+* factor-graph belief-propagation updates (used by maxsum*),
+* local-search cost tables / gain exchange (used by dsa/mgm/...),
+* batched join/projection contractions (used by dpop).
+
+Everything downstream of `compile_*` is pure JAX: static shapes, no python
+control flow inside jit, masks instead of ragged data.
+"""
+from pydcop_tpu.ops.compile import (
+    FactorBucket,
+    FactorGraphTensors,
+    ConstraintGraphTensors,
+    compile_factor_graph,
+    compile_constraint_graph,
+    PAD_COST,
+)
+
+__all__ = [
+    "FactorBucket",
+    "FactorGraphTensors",
+    "ConstraintGraphTensors",
+    "compile_factor_graph",
+    "compile_constraint_graph",
+    "PAD_COST",
+]

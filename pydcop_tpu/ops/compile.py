@@ -1,0 +1,316 @@
+"""DCOP → padded tensor graph compilation.
+
+This is the bridge between the python problem model (pydcop_tpu.dcop) and the
+XLA kernels.  It has no reference twin: the reference evaluates constraints
+lazily per assignment inside each agent's message handler; here every
+constraint is materialized **once** into a dense cost tensor over
+domain-index space, padded to uniform shapes and bucketed by arity, so a
+whole round of the algorithm is a handful of batched array ops.
+
+Layout conventions (used by all kernels):
+
+* ``D``: max domain size over all variables; every per-value axis is padded
+  to D.  ``domain_mask[v, d] == 1`` iff d is a valid value of variable v.
+* Unary (variable) costs: ``unary_costs[V, D]``, PAD_COST at invalid slots so
+  a masked argmin can never select padding.
+* Constraints are grouped into **arity buckets**; bucket ``a`` stacks its
+  cost tensors as ``[F_a, D, ..., D]`` (a value axes).  Invalid combinations
+  (padded values) hold PAD_COST.
+* An **edge** is a (factor, position) pair.  Edges are laid out bucket by
+  bucket, factor-major: global edge id = bucket.edge_offset + f * a + p.
+  ``edge_var[e]`` is the variable index of that edge; message arrays are
+  ``[E, D]``.
+* ``objective='max'`` problems are compiled by negating all costs: kernels
+  always minimize; report final costs via DCOP.solution_cost on host.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Variable
+from pydcop_tpu.dcop.relations import Constraint
+
+# Large-but-finite padding cost: min-reductions never pick padded entries,
+# and sums of a few pads stay finite in float32 (reference uses a 100000
+# sentinel for serializable infinity, pydcop/algorithms/maxsum.py:96 — on
+# device we can afford a much larger sentinel).
+PAD_COST = 1e30
+
+
+@dataclass
+class FactorBucket:
+    """All factors (constraints) of one arity, stacked."""
+
+    arity: int
+    tensors: jnp.ndarray  # [F, D, ..., D] (arity value axes)
+    var_idx: np.ndarray  # [F, arity] int32 — variable index per position
+    factor_ids: np.ndarray  # [F] global factor index
+    edge_offset: int  # start of this bucket's edges in global edge arrays
+
+    @property
+    def n_factors(self) -> int:
+        return int(self.var_idx.shape[0])
+
+    @property
+    def n_edges(self) -> int:
+        return self.n_factors * self.arity
+
+
+@dataclass
+class GraphTensorsBase:
+    var_names: List[str]
+    domain_values: List[Tuple]  # per-variable valid values (host side)
+    domain_sizes: np.ndarray  # [V] int32
+    domain_mask: jnp.ndarray  # [V, D] float32 (1 valid / 0 pad)
+    unary_costs: jnp.ndarray  # [V, D] float32, PAD_COST at invalid slots
+    buckets: List[FactorBucket]
+    edge_var: jnp.ndarray  # [E] int32
+    factor_names: List[str]
+    sign: float  # +1 for min problems, -1 for max (costs pre-multiplied)
+    initial_values: np.ndarray  # [V] int32 domain indices
+
+    @property
+    def n_vars(self) -> int:
+        return len(self.var_names)
+
+    @property
+    def n_factors(self) -> int:
+        return len(self.factor_names)
+
+    @property
+    def n_edges(self) -> int:
+        return int(self.edge_var.shape[0])
+
+    @property
+    def max_domain_size(self) -> int:
+        return int(self.domain_mask.shape[1])
+
+    def var_index(self, name: str) -> int:
+        return self.var_names.index(name)
+
+    def assignment_from_indices(self, x: np.ndarray) -> Dict[str, object]:
+        """Map device value indices [V] back to python domain values."""
+        return {
+            n: self.domain_values[i][int(x[i])]
+            for i, n in enumerate(self.var_names)
+        }
+
+    def indices_from_assignment(self, assignment: Dict[str, object]) -> np.ndarray:
+        x = np.array(self.initial_values, copy=True)
+        for name, val in assignment.items():
+            i = self.var_index(name)
+            x[i] = self.domain_values[i].index(val)
+        return x
+
+
+@dataclass
+class FactorGraphTensors(GraphTensorsBase):
+    """Compiled factor graph (bipartite var/factor view) — maxsum family."""
+
+
+@dataclass
+class ConstraintGraphTensors(GraphTensorsBase):
+    """Compiled constraints hypergraph — local-search family.
+
+    Adds the var↔var adjacency used for gain exchange (MGM & friends):
+    ``neighbor_src/neighbor_dst`` list every directed neighbor pair.
+    """
+
+    neighbor_src: jnp.ndarray = field(default=None)  # [M] int32
+    neighbor_dst: jnp.ndarray = field(default=None)  # [M] int32
+
+
+def _variables_in_order(dcop: DCOP) -> List[Variable]:
+    return [dcop.variables[n] for n in sorted(dcop.variables)]
+
+
+def _compile_common(
+    variables: Sequence[Variable],
+    constraints: Sequence[Constraint],
+    objective: str,
+):
+    sign = 1.0 if objective == "min" else -1.0
+    var_names = [v.name for v in variables]
+    var_pos = {n: i for i, n in enumerate(var_names)}
+    domain_values = [tuple(v.domain.values) for v in variables]
+    domain_sizes = np.array([len(d) for d in domain_values], dtype=np.int32)
+    D = int(domain_sizes.max()) if len(domain_sizes) else 1
+
+    V = len(variables)
+    mask = np.zeros((V, D), dtype=np.float32)
+    unary = np.full((V, D), PAD_COST, dtype=np.float32)
+    init = np.zeros(V, dtype=np.int32)
+    for i, v in enumerate(variables):
+        n = domain_sizes[i]
+        mask[i, :n] = 1.0
+        unary[i, :n] = sign * v.cost_vector()
+        if v.initial_value is not None:
+            init[i] = v.domain.index(v.initial_value)
+
+    # bucket constraints by arity (stable order: by arity, then input order)
+    factor_names = [c.name for c in constraints]
+    by_arity: Dict[int, List[int]] = {}
+    for gi, c in enumerate(constraints):
+        by_arity.setdefault(c.arity, []).append(gi)
+
+    buckets: List[FactorBucket] = []
+    edge_var_parts: List[np.ndarray] = []
+    offset = 0
+    for arity in sorted(by_arity):
+        idxs = by_arity[arity]
+        F = len(idxs)
+        tensors = np.full((F,) + (D,) * arity, PAD_COST, dtype=np.float32)
+        var_idx = np.zeros((F, arity), dtype=np.int32)
+        for k, gi in enumerate(idxs):
+            c = constraints[gi]
+            t = sign * c.to_tensor()
+            tensors[(k,) + tuple(slice(0, s) for s in t.shape)] = t
+            var_idx[k] = [var_pos[v.name] for v in c.dimensions]
+        buckets.append(
+            FactorBucket(
+                arity=arity,
+                tensors=jnp.asarray(tensors),
+                var_idx=var_idx,
+                factor_ids=np.array(idxs, dtype=np.int32),
+                edge_offset=offset,
+            )
+        )
+        edge_var_parts.append(var_idx.reshape(-1))
+        offset += F * arity
+
+    edge_var = (
+        np.concatenate(edge_var_parts)
+        if edge_var_parts
+        else np.zeros(0, dtype=np.int32)
+    )
+    return (
+        var_names,
+        domain_values,
+        domain_sizes,
+        jnp.asarray(mask),
+        jnp.asarray(unary),
+        buckets,
+        jnp.asarray(edge_var, dtype=jnp.int32),
+        factor_names,
+        sign,
+        init,
+    )
+
+
+def compile_factor_graph(
+    dcop: DCOP,
+    variables: Optional[Sequence[Variable]] = None,
+    constraints: Optional[Sequence[Constraint]] = None,
+) -> FactorGraphTensors:
+    """Compile a DCOP for factor-graph algorithms (maxsum family)."""
+    variables = list(variables) if variables is not None else _variables_in_order(dcop)
+    constraints = (
+        list(constraints)
+        if constraints is not None
+        else [dcop.constraints[n] for n in sorted(dcop.constraints)]
+    )
+    return FactorGraphTensors(
+        *_compile_common(variables, constraints, dcop.objective)
+    )
+
+
+def compile_constraint_graph(
+    dcop: DCOP,
+    variables: Optional[Sequence[Variable]] = None,
+    constraints: Optional[Sequence[Constraint]] = None,
+) -> ConstraintGraphTensors:
+    """Compile a DCOP for local-search algorithms on the constraints
+    hypergraph."""
+    variables = list(variables) if variables is not None else _variables_in_order(dcop)
+    constraints = (
+        list(constraints)
+        if constraints is not None
+        else [dcop.constraints[n] for n in sorted(dcop.constraints)]
+    )
+    common = _compile_common(variables, constraints, dcop.objective)
+    var_pos = {n: i for i, n in enumerate(common[0])}
+
+    # var-var adjacency: directed pairs for every two vars sharing a
+    # constraint (deduplicated)
+    pairs = set()
+    for c in constraints:
+        names = [v.name for v in c.dimensions]
+        for a in names:
+            for b in names:
+                if a != b:
+                    pairs.add((var_pos[a], var_pos[b]))
+    if pairs:
+        src, dst = zip(*sorted(pairs))
+    else:
+        src, dst = (), ()
+    return ConstraintGraphTensors(
+        *common,
+        neighbor_src=jnp.asarray(np.array(src, dtype=np.int32)),
+        neighbor_dst=jnp.asarray(np.array(dst, dtype=np.int32)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Shared device-side evaluation helpers
+# ---------------------------------------------------------------------------
+
+
+def bucket_factor_values(bucket: FactorBucket, x: jnp.ndarray) -> jnp.ndarray:
+    """Cost of each factor in the bucket under assignment x ([V] value
+    indices) → [F]."""
+    vals = x[bucket.var_idx]  # [F, a]
+    idx = tuple(vals[:, p] for p in range(bucket.arity))
+    return bucket.tensors[(jnp.arange(bucket.n_factors),) + idx]
+
+
+def total_cost(tensors: GraphTensorsBase, x: jnp.ndarray) -> jnp.ndarray:
+    """Total (sign-adjusted) cost of assignment x on device: all factor
+    costs + unary costs.  Matches DCOP.solution_cost up to the sign
+    convention and hard-constraint accounting."""
+    cost = jnp.zeros((), dtype=jnp.float32)
+    for b in tensors.buckets:
+        cost = cost + jnp.sum(bucket_factor_values(b, x))
+    V = tensors.n_vars
+    unary = tensors.unary_costs[jnp.arange(V), x] * (
+        tensors.domain_mask[jnp.arange(V), x]
+    )
+    return cost + jnp.sum(unary)
+
+
+def local_cost_tables(
+    tensors: GraphTensorsBase, x: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-variable cost table of candidate values given neighbors' current
+    values: out[v, d] = Σ_{factors containing v} cost(factor | v=d, others=x)
+    + unary[v, d].
+
+    The workhorse of the local-search family: one gather + indexed lookup +
+    segment-sum per arity bucket.  out is [V, D] with PAD_COST on invalid
+    slots.
+    """
+    from pydcop_tpu.ops.segments import segment_sum
+
+    V, D = tensors.n_vars, tensors.max_domain_size
+    out = jnp.where(tensors.domain_mask > 0, tensors.unary_costs, PAD_COST)
+    for b in tensors.buckets:
+        F, a = b.n_factors, b.arity
+        if F == 0:
+            continue
+        vals = x[b.var_idx]  # [F, a]
+        fidx = jnp.arange(F)[:, None]  # [F, 1] broadcast over D
+        for p in range(a):
+            # index: axis q!=p fixed at current value, axis p swept over D
+            idx = tuple(
+                jnp.arange(D)[None, :] if q == p else vals[:, q][:, None]
+                for q in range(a)
+            )
+            rows = b.tensors[(fidx,) + idx]  # [F, D]
+            out = out + segment_sum(rows, b.var_idx[:, p], V)
+    # clamp padding back (segment sums may have added pad costs on valid
+    # rows only through real factors, but invalid slots can accumulate)
+    return jnp.where(tensors.domain_mask > 0, out, PAD_COST)

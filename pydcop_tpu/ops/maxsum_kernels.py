@@ -1,0 +1,125 @@
+"""Factor-graph belief-propagation (max-sum) kernels.
+
+The math of the reference's MaxSum computations
+(pydcop/algorithms/maxsum.py: factor_costs_for_var :345 — min over all
+assignments of the factor's other variables — and costs_for_factor :556 —
+sum of other factors' marginals, normalized), re-expressed as batched tensor
+ops:
+
+* factor→var: for each scope position p, broadcast-add every other
+  position's incoming message onto the factor cost tensor and min-reduce all
+  axes except p.  One fused XLA reduction per position per arity bucket,
+  replacing the reference's python loop over the full cross product.
+* var→factor: beliefs = unary + segment-sum of incoming messages over the
+  edge list; outgoing = beliefs − own incoming (so each factor is excluded
+  from its own sum), normalized by the masked mean (the reference's
+  average-normalization, maxsum.py:602).
+
+All arrays follow the layout of pydcop_tpu.ops.compile.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from pydcop_tpu.ops.compile import FactorBucket, FactorGraphTensors
+from pydcop_tpu.ops.segments import masked_argmin, masked_mean, segment_sum
+
+
+def _broadcast_to_axis(msg: jnp.ndarray, axis: int, arity: int) -> jnp.ndarray:
+    """Reshape [F, D] messages to broadcast along value-axis `axis` of a
+    [F, D, ..., D] factor tensor."""
+    F, D = msg.shape
+    shape = [F] + [1] * arity
+    shape[1 + axis] = D
+    return msg.reshape(shape)
+
+
+def factor_to_var_messages(
+    bucket: FactorBucket, q_bucket: jnp.ndarray
+) -> jnp.ndarray:
+    """Compute factor→variable messages for one arity bucket.
+
+    q_bucket: [F, a, D] incoming var→factor messages.
+    Returns [F, a, D]: r[f, p, d] = min over assignments of the other
+    variables of (cost + sum of their incoming messages).
+    """
+    a = bucket.arity
+    outs = []
+    for p in range(a):
+        s = bucket.tensors
+        for q in range(a):
+            if q != p:
+                s = s + _broadcast_to_axis(q_bucket[:, q, :], q, a)
+        # min over all value axes except p (axes are 1..a, p is 1+p)
+        reduce_axes = tuple(1 + q for q in range(a) if q != p)
+        outs.append(jnp.min(s, axis=reduce_axes) if reduce_axes else s)
+    return jnp.stack(outs, axis=1)
+
+
+def all_factor_messages(
+    tensors: FactorGraphTensors, q_flat: jnp.ndarray
+) -> jnp.ndarray:
+    """factor→var messages for every bucket, as a flat [E, D] edge array."""
+    parts: List[jnp.ndarray] = []
+    for b in tensors.buckets:
+        F, a = b.n_factors, b.arity
+        q_bucket = q_flat[b.edge_offset : b.edge_offset + F * a].reshape(
+            F, a, -1
+        )
+        parts.append(factor_to_var_messages(b, q_bucket).reshape(F * a, -1))
+    if not parts:
+        return jnp.zeros_like(q_flat)
+    return jnp.concatenate(parts, axis=0)
+
+
+def var_beliefs_and_messages(
+    tensors: FactorGraphTensors, r_flat: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Variable beliefs [V, D] and outgoing var→factor messages [E, D].
+
+    beliefs[v] = unary[v] + Σ_{incoming edges} r;
+    q[e] = beliefs[var(e)] − r[e], normalized to zero masked mean.
+    """
+    V = tensors.n_vars
+    beliefs = tensors.unary_costs + segment_sum(r_flat, tensors.edge_var, V)
+    vmask = tensors.domain_mask[tensors.edge_var]  # [E, D]
+    q = beliefs[tensors.edge_var] - r_flat
+    q = (q - masked_mean(q, vmask)) * vmask
+    return beliefs, q
+
+
+def select_values(tensors: FactorGraphTensors, beliefs: jnp.ndarray
+                  ) -> jnp.ndarray:
+    """Current value choice per variable: masked argmin of beliefs."""
+    return masked_argmin(beliefs, tensors.domain_mask)
+
+
+def maxsum_cycle(
+    tensors: FactorGraphTensors,
+    q_flat: jnp.ndarray,
+    r_flat: jnp.ndarray,
+    damping: float = 0.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One synchronous MaxSum cycle.
+
+    Returns (q', r', beliefs, values).  Equivalent to every factor and
+    variable computation firing once (the reference's
+    SynchronousComputationMixin round, computations.py:633).
+    """
+    vmask = tensors.domain_mask[tensors.edge_var]
+    r_new = all_factor_messages(tensors, q_flat) * vmask
+    if damping:
+        r_new = damping * r_flat + (1.0 - damping) * r_new
+    beliefs, q_new = var_beliefs_and_messages(tensors, r_new)
+    values = select_values(tensors, beliefs)
+    return q_new, r_new, beliefs, values
+
+
+def init_messages(tensors: FactorGraphTensors) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Zero-initialized message arrays (the reference starts by sending
+    empty/zero costs, maxsum.py on_start)."""
+    E, D = tensors.n_edges, tensors.max_domain_size
+    z = jnp.zeros((E, D), dtype=jnp.float32)
+    return z, z

@@ -1,0 +1,45 @@
+"""Segment reductions over graph neighborhoods.
+
+The TPU-native replacement for per-agent message queues: a "round of
+messages" is one segment reduction over a static edge list
+(reference twin: the per-computation inboxes pumped by
+pydcop/infrastructure/agents.py:784 — here a single fused XLA op).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def segment_sum(data, segment_ids, num_segments: int):
+    return jax.ops.segment_sum(
+        data, segment_ids, num_segments=num_segments,
+        indices_are_sorted=False,
+    )
+
+
+def segment_min(data, segment_ids, num_segments: int):
+    return jax.ops.segment_min(
+        data, segment_ids, num_segments=num_segments,
+        indices_are_sorted=False,
+    )
+
+
+def segment_max(data, segment_ids, num_segments: int):
+    return jax.ops.segment_max(
+        data, segment_ids, num_segments=num_segments,
+        indices_are_sorted=False,
+    )
+
+
+def masked_mean(x, mask, axis=-1, keepdims=True):
+    """Mean of x over entries where mask==1 (mask is 0/1 float)."""
+    s = jnp.sum(x * mask, axis=axis, keepdims=keepdims)
+    n = jnp.maximum(jnp.sum(mask, axis=axis, keepdims=keepdims), 1.0)
+    return s / n
+
+
+def masked_argmin(x, mask, axis=-1):
+    """Argmin over valid entries (mask 1 = valid)."""
+    big = jnp.asarray(jnp.finfo(x.dtype).max, dtype=x.dtype)
+    return jnp.argmin(jnp.where(mask > 0, x, big), axis=axis)

@@ -1,0 +1,191 @@
+"""Unit tests for the tensorization compiler and kernel ops."""
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop import (
+    DCOP,
+    Domain,
+    NAryMatrixRelation,
+    Variable,
+    VariableWithCostDict,
+    constraint_from_str,
+)
+from pydcop_tpu.ops import (
+    PAD_COST,
+    compile_constraint_graph,
+    compile_factor_graph,
+)
+from pydcop_tpu.ops.compile import local_cost_tables, total_cost
+from pydcop_tpu.ops.maxsum_kernels import (
+    factor_to_var_messages,
+    init_messages,
+    maxsum_cycle,
+)
+
+
+@pytest.fixture
+def mixed_dcop():
+    """Heterogeneous domains + mixed arity, to exercise padding/bucketing."""
+    d2 = Domain("d2", "d", [0, 1])
+    d3 = Domain("d3", "d", [0, 1, 2])
+    x, y, z = Variable("x", d2), Variable("y", d3), Variable("z", d3)
+    dcop = DCOP("mixed")
+    dcop.add_variable(VariableWithCostDict("w", d2, {0: 1.0, 1: 2.0}))
+    w = dcop.variables["w"]
+    dcop.add_constraint(constraint_from_str("c_xy", "x * y", [x, y]))
+    dcop.add_constraint(
+        constraint_from_str("c_xyz", "x + y + z", [x, y, z])
+    )
+    dcop.add_constraint(constraint_from_str("c_w", "w * 5", [w]))
+    return dcop
+
+
+class TestCompile:
+    def test_shapes(self, mixed_dcop):
+        t = compile_factor_graph(mixed_dcop)
+        assert t.n_vars == 4
+        assert t.max_domain_size == 3
+        assert t.n_factors == 3
+        # arities 1, 2, 3 → three buckets, edges = 1 + 2 + 3
+        assert [b.arity for b in t.buckets] == [1, 2, 3]
+        assert t.n_edges == 6
+
+    def test_padding(self, mixed_dcop):
+        t = compile_factor_graph(mixed_dcop)
+        # w has domain size 2 → mask [1,1,0]
+        wi = t.var_index("w")
+        np.testing.assert_array_equal(np.asarray(t.domain_mask)[wi], [1, 1, 0])
+        assert np.asarray(t.unary_costs)[wi, 2] == PAD_COST
+        np.testing.assert_allclose(np.asarray(t.unary_costs)[wi, :2], [1, 2])
+
+    def test_factor_tensor_content(self, mixed_dcop):
+        t = compile_factor_graph(mixed_dcop)
+        b2 = next(b for b in t.buckets if b.arity == 2)
+        tens = np.asarray(b2.tensors)[0]
+        # c_xy = x * y with x in d2, y in d3
+        for xv in range(2):
+            for yv in range(3):
+                assert tens[xv, yv] == xv * yv
+        assert tens[2, 0] == PAD_COST  # padded x value
+
+    def test_total_cost(self, mixed_dcop):
+        t = compile_factor_graph(mixed_dcop)
+        x = t.indices_from_assignment({"x": 1, "y": 2, "z": 1, "w": 1})
+        got = float(total_cost(t, np.asarray(x)))
+        # c_xy=2, c_xyz=4, c_w=5, unary w=2
+        assert got == pytest.approx(13.0)
+
+    def test_assignment_roundtrip(self, mixed_dcop):
+        t = compile_factor_graph(mixed_dcop)
+        asst = {"x": 1, "y": 2, "z": 0, "w": 0}
+        x = t.indices_from_assignment(asst)
+        assert t.assignment_from_indices(x) == asst
+
+    def test_max_objective_sign(self):
+        d = Domain("d", "d", [0, 1])
+        v = Variable("v", d)
+        dcop = DCOP("m", objective="max")
+        dcop.add_constraint(constraint_from_str("c", "v * 3", [v]))
+        t = compile_factor_graph(dcop)
+        b = t.buckets[0]
+        np.testing.assert_allclose(np.asarray(b.tensors)[0], [0, -3])
+
+
+class TestLocalCostTables:
+    def test_binary_chain(self):
+        d = Domain("d", "d", [0, 1, 2])
+        vs = [Variable(f"v{i}", d) for i in range(3)]
+        dcop = DCOP("chain")
+        dcop.add_constraint(
+            constraint_from_str("c01", "10 if v0 == v1 else 0", vs))
+        dcop.add_constraint(
+            constraint_from_str("c12", "10 if v1 == v2 else 0", vs))
+        t = compile_constraint_graph(dcop)
+        x = t.indices_from_assignment({"v0": 0, "v1": 1, "v2": 0})
+        tables = np.asarray(local_cost_tables(t, np.asarray(x)))
+        i1 = t.var_index("v1")
+        # v1: conflicts with v0=0 and v2=0 → value 0 costs 20, 1 and 2 cost 0
+        np.testing.assert_allclose(tables[i1], [20, 0, 0])
+        i0 = t.var_index("v0")
+        # v0 vs v1=1: value 1 costs 10
+        np.testing.assert_allclose(tables[i0], [0, 10, 0])
+
+    def test_nary(self):
+        d = Domain("d", "d", [0, 1])
+        vs = [Variable(f"v{i}", d) for i in range(3)]
+        dcop = DCOP("t")
+        dcop.add_constraint(
+            constraint_from_str("c", "v0 * v1 * v2", vs))
+        t = compile_constraint_graph(dcop)
+        x = np.array([1, 1, 0], dtype=np.int32)
+        tables = np.asarray(local_cost_tables(t, x))
+        # for v2 (idx of 'v2'), cost at value 1 = 1*1*1 = 1
+        i2 = t.var_index("v2")
+        np.testing.assert_allclose(tables[i2], [0, 1])
+
+    def test_neighbors(self):
+        d = Domain("d", "d", [0, 1])
+        vs = [Variable(f"v{i}", d) for i in range(3)]
+        dcop = DCOP("t")
+        dcop.add_constraint(constraint_from_str("c", "v0 + v1 + v2", vs))
+        t = compile_constraint_graph(dcop)
+        assert t.neighbor_src.shape == (6,)  # 3 vars, all pairs directed
+
+
+class TestMaxSumKernels:
+    def test_factor_messages_binary(self):
+        """Hand-checked factor→var messages on a single binary factor."""
+        d = Domain("d", "d", [0, 1])
+        x, y = Variable("x", d), Variable("y", d)
+        dcop = DCOP("t")
+        dcop.add_constraint(
+            NAryMatrixRelation([x, y], [[0.0, 3.0], [5.0, 1.0]], "c"))
+        t = compile_factor_graph(dcop)
+        b = t.buckets[0]
+        q = np.zeros((1, 2, 2), dtype=np.float32)
+        r = np.asarray(factor_to_var_messages(b, q))
+        # message to x (pos 0): min over y → [min(0,3), min(5,1)] = [0, 1]
+        np.testing.assert_allclose(r[0, 0], [0, 1])
+        # message to y (pos 1): min over x → [0, 1]
+        np.testing.assert_allclose(r[0, 1], [0, 1])
+        # with a nonzero message from y: q_y = [10, 0]
+        q[0, 1] = [10.0, 0.0]
+        r = np.asarray(factor_to_var_messages(b, q))
+        # to x: min_y(c(x,y)+q_y(y)) = [min(10,3), min(15,1)] = [3, 1]
+        np.testing.assert_allclose(r[0, 0], [3, 1])
+        # to y unchanged by its own message
+        np.testing.assert_allclose(r[0, 1], [0, 1])
+
+    def test_cycle_converges_on_tree(self):
+        """On an acyclic factor graph max-sum is exact: check the argmin."""
+        d = Domain("d", "d", [0, 1, 2])
+        vs = [Variable(f"v{i}", d) for i in range(3)]
+        dcop = DCOP("chain")
+        dcop.add_constraint(
+            constraint_from_str("c01", "(v0 - v1)**2 + v0", vs))
+        dcop.add_constraint(
+            constraint_from_str("c12", "(v1 - v2)**2 + 2*v2", vs))
+        t = compile_factor_graph(dcop)
+        q, r = init_messages(t)
+        for _ in range(6):
+            q, r, beliefs, values = maxsum_cycle(t, q, r)
+        got = t.assignment_from_indices(np.asarray(values))
+        # brute force optimum
+        best, best_cost = None, float("inf")
+        for a0 in range(3):
+            for a1 in range(3):
+                for a2 in range(3):
+                    c = (a0 - a1) ** 2 + a0 + (a1 - a2) ** 2 + 2 * a2
+                    if c < best_cost:
+                        best, best_cost = {"v0": a0, "v1": a1, "v2": a2}, c
+        assert got == best
+
+    def test_cycle_heterogeneous_domains(self, mixed_dcop):
+        t = compile_factor_graph(mixed_dcop)
+        q, r = init_messages(t)
+        for _ in range(5):
+            q, r, beliefs, values = maxsum_cycle(t, q, r, damping=0.3)
+        vals = np.asarray(values)
+        # never select a padded value
+        for i in range(t.n_vars):
+            assert vals[i] < len(t.domain_values[i])
