@@ -1,0 +1,187 @@
+"""Shared solver harness: synchronous rounds under ``lax.scan``.
+
+This replaces the reference's actor runtime for the solve path: where the
+reference runs one thread per agent pumping message queues
+(pydcop/infrastructure/agents.py:784) with a cycle barrier mixin
+(computations.py:633), here a *cycle* is one call of a pure jitted function
+over the whole tensor graph, and a run is ``lax.scan`` over cycles, executed
+in chunks so the host can check convergence/timeouts between chunks.
+
+Per-cycle metrics (values, cost) are emitted as scan outputs, giving the
+same observability as the reference's cycle metrics without host round
+trips.
+"""
+from __future__ import annotations
+
+import dataclasses
+from time import perf_counter
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pydcop_tpu.algorithms import DEFAULT_INFINITY, AlgorithmDef
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.ops.compile import GraphTensorsBase, total_cost
+
+
+@dataclasses.dataclass
+class SolveResult:
+    """Result + metrics of a solve, matching the reference's global_metrics
+    schema (pydcop/infrastructure/orchestrator.py:1179)."""
+
+    status: str
+    assignment: Dict[str, Any]
+    cost: Optional[float]
+    violation: Optional[int]
+    cycle: int
+    msg_count: int
+    msg_size: float
+    time: float
+    history: Optional[List[Dict[str, Any]]] = None
+
+    def metrics(self) -> Dict[str, Any]:
+        return {
+            "status": self.status,
+            "assignment": self.assignment,
+            "cost": self.cost,
+            "violation": self.violation,
+            "cycle": self.cycle,
+            "msg_count": self.msg_count,
+            "msg_size": self.msg_size,
+            "time": self.time,
+        }
+
+
+class SynchronousTensorSolver:
+    """Base class for batched synchronous-round solvers.
+
+    Subclasses implement :meth:`initial_state`, :meth:`cycle` (a pure
+    function of (state, PRNG key) suitable for tracing) and
+    :meth:`values_of`.
+    """
+
+    #: messages exchanged per cycle, for metric parity with the reference's
+    #: per-edge message counting (0 = subclass sets it from tensors)
+    msgs_per_cycle: int = 0
+    #: floats per message (metric parity for msg_size)
+    msg_size_per_msg: float = 0.0
+
+    def __init__(
+        self,
+        dcop: DCOP,
+        tensors: GraphTensorsBase,
+        algo_def: AlgorithmDef,
+        seed: int = 0,
+    ):
+        self.dcop = dcop
+        self.tensors = tensors
+        self.algo_def = algo_def
+        self.params = algo_def.params
+        self.seed = seed
+        self.infinity = DEFAULT_INFINITY
+        self._compiled_chunks: Dict[int, Any] = {}
+
+    # -- to implement -------------------------------------------------------
+
+    def initial_state(self) -> Any:
+        raise NotImplementedError
+
+    def cycle(self, state: Any, key: jax.Array) -> Any:
+        raise NotImplementedError
+
+    def values_of(self, state: Any) -> jnp.ndarray:
+        """Current value indices [V] for a state."""
+        raise NotImplementedError
+
+    # -- harness ------------------------------------------------------------
+
+    def _chunk_runner(self, n: int):
+        if n not in self._compiled_chunks:
+
+            def body(st, k):
+                st2 = self.cycle(st, k)
+                vals = self.values_of(st2)
+                return st2, (vals, total_cost(self.tensors, vals))
+
+            @jax.jit
+            def run_chunk(state, keys):
+                return jax.lax.scan(body, state, keys)
+
+            self._compiled_chunks[n] = run_chunk
+        return self._compiled_chunks[n]
+
+    def run(
+        self,
+        cycles: Optional[int] = None,
+        timeout: Optional[float] = None,
+        max_cycles: int = 2000,
+        chunk: int = 8,
+        stable_chunks: int = 2,
+        collect_cycles: bool = False,
+    ) -> SolveResult:
+        """Run the solver.
+
+        * ``cycles`` set → run exactly that many cycles (the reference's
+          ``stop_cycle``).
+        * otherwise → run until the assignment is stable for
+          ``stable_chunks`` consecutive chunks, or ``max_cycles``/timeout.
+        """
+        t0 = perf_counter()
+        target = cycles if cycles else None
+        limit = target if target is not None else max_cycles
+
+        state = self.initial_state()
+        key = jax.random.PRNGKey(self.seed)
+        done = 0
+        history: List[Dict[str, Any]] = []
+        prev_vals: Optional[np.ndarray] = None
+        stable = 0
+        status = "FINISHED"
+
+        while done < limit:
+            n = min(chunk, limit - done)
+            key, sub = jax.random.split(key)
+            keys = jax.random.split(sub, n)
+            runner = self._chunk_runner(n)
+            state, (vals, costs) = runner(state, keys)
+            done += n
+            if collect_cycles:
+                vals_np = np.asarray(vals)
+                costs_np = np.asarray(costs) * self.tensors.sign
+                for i in range(n):
+                    history.append(
+                        {
+                            "cycle": done - n + i + 1,
+                            "cost": float(costs_np[i]),
+                            "time": perf_counter() - t0,
+                        }
+                    )
+            if target is None:
+                last = np.asarray(self.values_of(state))
+                if prev_vals is not None and np.array_equal(last, prev_vals):
+                    stable += 1
+                    if stable >= stable_chunks:
+                        break
+                else:
+                    stable = 0
+                prev_vals = last
+            if timeout is not None and perf_counter() - t0 > timeout:
+                status = "TIMEOUT"
+                break
+
+        final_vals = np.asarray(self.values_of(state))
+        assignment = self.tensors.assignment_from_indices(final_vals)
+        violation, cost = self.dcop.solution_cost(assignment, self.infinity)
+        return SolveResult(
+            status=status,
+            assignment=assignment,
+            cost=cost,
+            violation=violation,
+            cycle=done,
+            msg_count=self.msgs_per_cycle * done,
+            msg_size=self.msgs_per_cycle * done * self.msg_size_per_msg,
+            time=perf_counter() - t0,
+            history=history if collect_cycles else None,
+        )

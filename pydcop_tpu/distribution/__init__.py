@@ -1,0 +1,44 @@
+"""Distribution (computation→agent placement) strategies.
+
+Equivalent capability to the reference's pydcop/distribution/ package; every
+module exposes ``distribute(computation_graph, agentsdef, hints,
+computation_memory, communication_load) -> Distribution`` and most expose
+``distribution_cost(...)``.
+"""
+from __future__ import annotations
+
+import importlib
+import pkgutil
+
+from pydcop_tpu.distribution.objects import (
+    Distribution,
+    DistributionHints,
+    ImpossibleDistributionException,
+)
+
+
+def list_available_distributions():
+    import pydcop_tpu.distribution as pkg
+
+    exclude = {"objects", "yamlformat"}
+    return sorted(
+        m.name
+        for m in pkgutil.iter_modules(pkg.__path__)
+        if not m.ispkg and m.name not in exclude
+    )
+
+
+def load_distribution_module(name: str):
+    try:
+        return importlib.import_module(f"pydcop_tpu.distribution.{name}")
+    except ImportError as e:
+        raise ImportError(f"Could not find distribution module {name!r}: {e}")
+
+
+__all__ = [
+    "Distribution",
+    "DistributionHints",
+    "ImpossibleDistributionException",
+    "list_available_distributions",
+    "load_distribution_module",
+]

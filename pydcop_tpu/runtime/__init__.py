@@ -1,0 +1,11 @@
+"""Runtime: the high-level solve API, virtual orchestrator, metrics.
+
+The TPU-native replacement for the reference's pydcop/infrastructure/
+package: instead of threads + message queues + an orchestrator agent, the
+runtime compiles the problem to tensors, runs jitted round kernels, and
+reproduces the orchestration surface (deploy/run/pause/stop, scenario
+events, metrics collection) as host-side control flow.
+"""
+from pydcop_tpu.runtime.run import solve, solve_result
+
+__all__ = ["solve", "solve_result"]

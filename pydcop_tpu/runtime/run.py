@@ -1,0 +1,92 @@
+"""One-call solve API.
+
+Equivalent capability to the reference's pydcop/infrastructure/run.py
+(solve :52, run_local_thread_dcop :145, run_local_process_dcop :225) —
+without the thread/process agent plumbing: build graph → (optionally)
+distribute → compile to tensors → run jitted rounds.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+from pydcop_tpu.algorithms import AlgorithmDef, load_algorithm_module
+from pydcop_tpu.algorithms.base import SolveResult
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.graph import load_graph_module
+
+
+def _build_algo_def(
+    dcop: DCOP,
+    algo: Union[str, AlgorithmDef],
+    algo_params: Optional[Dict[str, Any]],
+) -> AlgorithmDef:
+    if isinstance(algo, AlgorithmDef):
+        return algo
+    return AlgorithmDef.build_with_default_params(
+        algo, algo_params or {}, mode=dcop.objective
+    )
+
+
+def solve_result(
+    dcop: DCOP,
+    algo: Union[str, AlgorithmDef],
+    distribution: Optional[str] = None,
+    graph: Optional[str] = None,
+    timeout: Optional[float] = None,
+    cycles: Optional[int] = None,
+    algo_params: Optional[Dict[str, Any]] = None,
+    seed: int = 0,
+    collect_cycles: bool = False,
+) -> SolveResult:
+    """Solve a DCOP and return the full result + metrics.
+
+    The reference twin is infrastructure/run.py:solve (used by all api
+    tests); ``distribution`` is accepted for parity and validated, though a
+    single-host tensor solve does not need a placement to run.
+    """
+    algo_def = _build_algo_def(dcop, algo, algo_params)
+    algo_module = load_algorithm_module(algo_def.algo)
+
+    graph_type = graph or algo_module.GRAPH_TYPE
+    graph_module = load_graph_module(graph_type)
+    cg = graph_module.build_computation_graph(dcop)
+
+    if distribution is not None and dcop.agents:
+        from pydcop_tpu.distribution import load_distribution_module
+
+        dist_module = load_distribution_module(distribution)
+        dist_hints = getattr(dcop, "dist_hints", None)
+        dist_module.distribute(
+            cg,
+            dcop.agents.values(),
+            hints=dist_hints,
+            computation_memory=algo_module.computation_memory,
+            communication_load=algo_module.communication_load,
+        )
+
+    solver = algo_module.build_solver(dcop, cg, algo_def, seed=seed)
+    stop_cycle = (
+        cycles
+        if cycles is not None
+        else (algo_def.params.get("stop_cycle") or None)
+    )
+    return solver.run(
+        cycles=stop_cycle, timeout=timeout, collect_cycles=collect_cycles
+    )
+
+
+def solve(
+    dcop: DCOP,
+    algo: Union[str, AlgorithmDef],
+    distribution: Optional[str] = None,
+    graph: Optional[str] = None,
+    timeout: Optional[float] = None,
+    cycles: Optional[int] = None,
+    algo_params: Optional[Dict[str, Any]] = None,
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """Solve a DCOP and return the assignment (reference-parity signature:
+    infrastructure/run.py:52 returns ``metrics['assignment']``)."""
+    return solve_result(
+        dcop, algo, distribution, graph, timeout, cycles, algo_params, seed
+    ).assignment

@@ -1,0 +1,95 @@
+"""End-to-end library-API solve tests (reference twin: tests/api/).
+
+Mirrors the reference's strategy (tests/api/test_api_solve.py:37-90): solve
+small known instances and assert the assignment / cost.
+"""
+import os
+
+import pytest
+
+from pydcop_tpu.dcop import load_dcop_from_file
+from pydcop_tpu.runtime import solve, solve_result
+
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+
+
+@pytest.fixture
+def tuto_dcop():
+    return load_dcop_from_file(
+        os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+    )
+
+
+@pytest.fixture
+def csp_dcop():
+    return load_dcop_from_file(os.path.join(INSTANCES, "coloring_csp.yaml"))
+
+
+class TestMaxsum:
+    def test_tuto_optimum(self, tuto_dcop):
+        assignment = solve(tuto_dcop, "maxsum", timeout=10)
+        assert assignment == {"v1": "G", "v2": "G", "v3": "G", "v4": "G"}
+
+    def test_result_metrics(self, tuto_dcop):
+        res = solve_result(tuto_dcop, "maxsum", timeout=10)
+        assert res.status == "FINISHED"
+        assert res.cost == 12
+        assert res.violation == 0
+        assert res.cycle > 0
+        assert res.msg_count > 0
+        m = res.metrics()
+        assert set(m) == {
+            "status", "assignment", "cost", "violation", "cycle",
+            "msg_count", "msg_size", "time",
+        }
+
+    def test_csp(self, csp_dcop):
+        res = solve_result(csp_dcop, "maxsum", timeout=10)
+        # 3-coloring of a triangle: all different
+        vals = list(res.assignment.values())
+        assert len(set(vals)) == 3
+        assert res.cost == 0
+
+    def test_stop_cycle(self, tuto_dcop):
+        res = solve_result(tuto_dcop, "maxsum", cycles=5)
+        assert res.cycle == 5
+
+    def test_algo_params(self, tuto_dcop):
+        assignment = solve(
+            tuto_dcop, "maxsum", algo_params={"damping": 0.0}, timeout=10
+        )
+        assert assignment == {"v1": "G", "v2": "G", "v3": "G", "v4": "G"}
+
+    def test_collect_cycles(self, tuto_dcop):
+        res = solve_result(tuto_dcop, "maxsum", cycles=6, collect_cycles=True)
+        assert len(res.history) == 6
+        assert {"cycle", "cost", "time"} <= set(res.history[0])
+
+    def test_with_distribution(self, tuto_dcop):
+        # oneagent needs >= as many agents as computations (8 comps, 5 agts)
+        from pydcop_tpu.distribution import ImpossibleDistributionException
+
+        with pytest.raises(ImpossibleDistributionException):
+            solve(tuto_dcop, "maxsum", distribution="oneagent", cycles=2)
+
+
+class TestMaxMode:
+    def test_maximize(self):
+        from pydcop_tpu.dcop import load_dcop
+
+        dcop = load_dcop(
+            """
+name: maxtest
+objective: max
+domains: {d: {values: [0, 1, 2]}}
+variables:
+  v1: {domain: d}
+  v2: {domain: d}
+constraints:
+  c1: {type: intention, function: v1 + v2 if v1 != v2 else 0}
+agents: [a1, a2]
+"""
+        )
+        res = solve_result(dcop, "maxsum", timeout=10)
+        assert res.cost == 3  # v1,v2 = {1,2} in some order
+        assert sorted(res.assignment.values()) == [1, 2]
