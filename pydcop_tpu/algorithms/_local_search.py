@@ -1,0 +1,134 @@
+"""Shared machinery for the local-search algorithm family
+(dsa / adsa / dsatuto / mgm / mgm2 / dba / gdba / mixeddsa).
+
+All of these run on the constraints hypergraph and share the same per-cycle
+primitive: the **local cost table** — for every variable, the cost of each
+candidate value given its neighbors' current values
+(pydcop_tpu.ops.compile.local_cost_tables).  On top of that they differ only
+in the *move rule* (stochastic / best-gain-in-neighborhood / coordinated
+pairs / weighted breakout).
+
+The reference implements each as an actor exchanging value/gain messages
+(e.g. pydcop/algorithms/mgm.py:213 — value msgs then gain msgs per cycle);
+here a cycle is a handful of batched gathers + segment reductions.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pydcop_tpu.algorithms import AlgorithmDef
+from pydcop_tpu.algorithms.base import SynchronousTensorSolver
+from pydcop_tpu.ops.compile import (
+    ConstraintGraphTensors,
+    PAD_COST,
+    local_cost_tables,
+)
+from pydcop_tpu.ops.segments import masked_argmin, segment_max, segment_min
+
+#: costs at or above this threshold are treated as hard-constraint
+#: violations ("conflicts") by breakout/mixed algorithms — same sentinel the
+#: reference uses as serializable infinity (maxsum.py:96, dba.py:265)
+HARD_THRESHOLD = 10000.0
+
+
+def random_valid_values(
+    tensors: ConstraintGraphTensors, key: jax.Array
+) -> jnp.ndarray:
+    """Random initial value index per variable (uniform over its valid
+    values); variables with an explicit initial_value keep it."""
+    V, D = tensors.domain_mask.shape
+    u = jax.random.uniform(key, (V, D))
+    # masked argmax of random scores = uniform choice among valid values
+    pick = jnp.argmax(jnp.where(tensors.domain_mask > 0, u, -1.0), axis=1)
+    has_init = jnp.asarray(tensors.has_initial)
+    init = jnp.asarray(tensors.initial_values)
+    return jnp.where(has_init, init, pick).astype(jnp.int32)
+
+
+def gains_and_best(
+    tensors: ConstraintGraphTensors,
+    x: jnp.ndarray,
+    tables: Optional[jnp.ndarray] = None,
+    prefer_change: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(current_cost [V], best_value [V], gain [V], tables [V, D]).
+
+    gain = current local cost − best achievable local cost (≥ 0).
+    With ``prefer_change`` the argmin tie-breaks *away* from the current
+    value (used by DSA variants that move laterally on equal cost).
+    """
+    if tables is None:
+        tables = local_cost_tables(tensors, x)
+    V = tensors.n_vars
+    cur = tables[jnp.arange(V), x]
+    pick_from = tables
+    if prefer_change:
+        eps = jnp.zeros_like(tables).at[jnp.arange(V), x].set(1e-6)
+        pick_from = tables + eps
+    best_val = masked_argmin(pick_from, tensors.domain_mask)
+    best_cost = tables[jnp.arange(V), best_val]
+    gain = cur - best_cost
+    return cur, best_val, jnp.maximum(gain, 0.0), tables
+
+
+def neighborhood_winner(
+    tensors: ConstraintGraphTensors, gain: jnp.ndarray
+) -> jnp.ndarray:
+    """MGM-style arbitration: True where a variable's gain is the strict
+    maximum of its neighborhood, with lexical (index-order) tie-break.
+
+    Two segment reductions over the directed neighbor pairs replace the
+    reference's gain-message exchange round (mgm.py:384).
+    """
+    V = tensors.n_vars
+    src, dst = tensors.neighbor_src, tensors.neighbor_dst
+    if src.shape[0] == 0:
+        return gain > 0
+    neigh_max = segment_max(gain[src], dst, V)
+    neigh_max = jnp.maximum(neigh_max, 0.0)  # isolated vars / -inf guard
+    # lowest index among neighbors achieving the max (for lexic tie-break)
+    at_max = gain[src] >= neigh_max[dst] - 1e-9
+    idx_at_max = segment_min(jnp.where(at_max, src, V), dst, V)
+    me = jnp.arange(V)
+    return (gain > 0) & (
+        (gain > neigh_max + 1e-9)
+        | ((jnp.abs(gain - neigh_max) <= 1e-9) & (me < idx_at_max))
+    )
+
+
+def conflicted(
+    tensors: ConstraintGraphTensors,
+    x: jnp.ndarray,
+    tables: jnp.ndarray,
+    threshold: float = HARD_THRESHOLD,
+) -> jnp.ndarray:
+    """True for variables whose current local cost crosses the hard
+    threshold (involved in ≥1 violated hard constraint)."""
+    V = tensors.n_vars
+    cur = tables[jnp.arange(V), x]
+    return cur >= threshold
+
+
+class LocalSearchSolver(SynchronousTensorSolver):
+    """Base for local-search solvers: state = (x, aux...); random init."""
+
+    def __init__(self, dcop, tensors: ConstraintGraphTensors, algo_def:
+                 AlgorithmDef, seed: int = 0):
+        super().__init__(dcop, tensors, algo_def, seed)
+        # one value message to each neighbor per cycle (reference parity:
+        # mgm/dsa broadcast their value each cycle)
+        self.msgs_per_cycle = int(tensors.neighbor_src.shape[0])
+        self.msg_size_per_msg = 1.0
+
+    def initial_values(self, key) -> jnp.ndarray:
+        return random_valid_values(self.tensors, key)
+
+    def initial_state(self):
+        x = self.initial_values(jax.random.PRNGKey(self.seed + 17))
+        return (x,)
+
+    def values_of(self, state):
+        return state[0]

@@ -1,0 +1,88 @@
+"""A-DSA — asynchronous DSA.
+
+Equivalent capability to the reference's pydcop/algorithms/adsa.py
+(ADsaComputation :126): in the reference each variable re-evaluates on a
+wall-clock ``period`` timer, asynchronously.
+
+TPU-native emulation (documented semantic deviation, as planned in
+SURVEY.md §7.10): asynchrony is modeled by a random **activation mask** per
+round — each variable wakes with probability ``activation``, so at any round
+only a random subset re-evaluates, reproducing the interleaving behavior of
+timer-driven agents without threads.  The ``period`` parameter is kept for
+CLI parity and maps onto the reported wall-clock metrics only.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pydcop_tpu.algorithms import AlgoParameterDef, AlgorithmDef
+from pydcop_tpu.algorithms._local_search import (
+    HARD_THRESHOLD,
+    LocalSearchSolver,
+    conflicted,
+    gains_and_best,
+)
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.ops.compile import compile_constraint_graph
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+algo_params = [
+    AlgoParameterDef("period", "float", None, 0.5),
+    AlgoParameterDef("probability", "float", None, 0.7),
+    AlgoParameterDef("variant", "str", ["A", "B", "C"], "B"),
+    AlgoParameterDef("activation", "float", None, 0.5),
+    AlgoParameterDef("stop_cycle", "int", None, 0),
+]
+
+
+class ADsaSolver(LocalSearchSolver):
+    def __init__(self, dcop, tensors, algo_def, seed=0):
+        super().__init__(dcop, tensors, algo_def, seed)
+        self.probability = float(self.params.get("probability", 0.7))
+        self.variant = self.params.get("variant", "B")
+        self.activation = float(self.params.get("activation", 0.5))
+
+    def cycle(self, state, key):
+        (x,) = state
+        k_wake, k_move = jax.random.split(key)
+        awake = (
+            jax.random.uniform(k_wake, (self.tensors.n_vars,))
+            < self.activation
+        )
+        prefer_change = self.variant in ("B", "C")
+        cur, best_val, gain, tables = gains_and_best(
+            self.tensors, x, prefer_change=prefer_change
+        )
+        activate = (
+            jax.random.uniform(k_move, (self.tensors.n_vars,))
+            < self.probability
+        )
+        improving = gain > 1e-9
+        lateral = (gain <= 1e-9) & (best_val != x)
+        if self.variant == "A":
+            want = improving
+        elif self.variant == "B":
+            in_conflict = conflicted(self.tensors, x, tables, HARD_THRESHOLD)
+            want = improving | (lateral & in_conflict)
+        else:
+            want = improving | lateral
+        move = want & activate & awake
+        return (jnp.where(move, best_val, x).astype(jnp.int32),)
+
+
+def build_solver(dcop: DCOP, computation_graph=None, algo_def=None, seed=0):
+    algo_def = algo_def or AlgorithmDef.build_with_default_params(
+        "adsa", parameters_definitions=algo_params
+    )
+    tensors = compile_constraint_graph(dcop)
+    return ADsaSolver(dcop, tensors, algo_def, seed)
+
+
+def computation_memory(node) -> float:
+    return float(len(node.neighbors))
+
+
+def communication_load(node, target: str = None) -> float:
+    return 1.0

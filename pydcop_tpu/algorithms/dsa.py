@@ -1,0 +1,84 @@
+"""DSA — Distributed Stochastic Algorithm (synchronous variants A/B/C).
+
+Equivalent capability to the reference's pydcop/algorithms/dsa.py
+(DsaComputation :213, params :130-134): each cycle every variable computes
+its best local move given neighbors' values and applies it stochastically:
+
+* A: move only on strict improvement, with probability p;
+* B: additionally move laterally (equal cost) when in conflict, w.p. p;
+* C: additionally move laterally even without conflict, w.p. p.
+
+"Conflict" = the current local cost crosses the hard-constraint threshold
+(the reference checks violated hard constraints; soft-only problems never
+trigger the lateral-move rule — documented approximation).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pydcop_tpu.algorithms import AlgoParameterDef, AlgorithmDef
+from pydcop_tpu.algorithms._local_search import (
+    HARD_THRESHOLD,
+    LocalSearchSolver,
+    conflicted,
+    gains_and_best,
+)
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.ops.compile import compile_constraint_graph
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+algo_params = [
+    AlgoParameterDef("probability", "float", None, 0.7),
+    AlgoParameterDef("variant", "str", ["A", "B", "C"], "B"),
+    AlgoParameterDef("stop_cycle", "int", None, 0),
+]
+
+
+class DsaSolver(LocalSearchSolver):
+    """State = (x,)."""
+
+    def __init__(self, dcop, tensors, algo_def, seed=0):
+        super().__init__(dcop, tensors, algo_def, seed)
+        self.probability = float(self.params.get("probability", 0.7))
+        self.variant = self.params.get("variant", "B")
+
+    def cycle(self, state, key):
+        (x,) = state
+        prefer_change = self.variant in ("B", "C")
+        cur, best_val, gain, tables = gains_and_best(
+            self.tensors, x, prefer_change=prefer_change
+        )
+        activate = (
+            jax.random.uniform(key, (self.tensors.n_vars,)) < self.probability
+        )
+        improving = gain > 1e-9
+        lateral = (gain <= 1e-9) & (best_val != x)
+        if self.variant == "A":
+            want = improving
+        elif self.variant == "B":
+            in_conflict = conflicted(self.tensors, x, tables, HARD_THRESHOLD)
+            want = improving | (lateral & in_conflict)
+        else:  # C
+            want = improving | lateral
+        move = want & activate
+        return (jnp.where(move, best_val, x).astype(jnp.int32),)
+
+
+def build_solver(dcop: DCOP, computation_graph=None, algo_def=None, seed=0):
+    algo_def = algo_def or AlgorithmDef.build_with_default_params(
+        "dsa", parameters_definitions=algo_params
+    )
+    tensors = compile_constraint_graph(dcop)
+    return DsaSolver(dcop, tensors, algo_def, seed)
+
+
+def computation_memory(node) -> float:
+    """One value per neighbor (reference: dsa.py computation_memory)."""
+    return float(len(node.neighbors))
+
+
+def communication_load(node, target: str = None) -> float:
+    """DSA sends single values."""
+    return 1.0

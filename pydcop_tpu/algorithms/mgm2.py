@@ -1,0 +1,233 @@
+"""MGM-2 — 2-coordinated Maximum Gain Message.
+
+Equivalent capability to the reference's pydcop/algorithms/mgm2.py
+(Mgm2Computation :398, Value/Offer/Response/Gain/Go messages :146-365,
+params :138-142): on top of MGM's best-gain arbitration, variables can pair
+up and make *coordinated two-variable moves*, escaping local minima a single
+move cannot.
+
+Protocol per cycle (reference's 5 message rounds → batched array ops):
+
+1. value round — implicit (x is global state);
+2. offer round — each variable is an *offerer* with probability
+   ``threshold``; offerers pick one random incident binary constraint whose
+   other end is a non-offerer and compute the joint cost table of the pair;
+3. response round — each receiver accepts its best positive-joint-gain
+   offer (segment-max over offered edges, lowest edge id on ties);
+4. gain round — committed pairs advertise the joint gain, everyone else
+   their unilateral MGM gain;
+5. go round — a pair moves iff BOTH ends win their neighborhoods (partners
+   share a tie-break id so they do not block each other); unpaired winners
+   do the MGM move.
+
+Deviations from the reference (documented): parallel constraints between
+the same pair are not merged when excluding the shared constraint from the
+joint table; the ``favor`` parameter is accepted but only ``unilateral``
+ordering is implemented.  Only binary constraints participate in pairing
+(the reference's offers are pairwise by construction).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pydcop_tpu.algorithms import AlgoParameterDef, AlgorithmDef
+from pydcop_tpu.algorithms._local_search import (
+    LocalSearchSolver,
+    gains_and_best,
+)
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.ops.compile import PAD_COST, compile_constraint_graph, \
+    local_cost_tables
+from pydcop_tpu.ops.segments import masked_argmin, segment_max, segment_min
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+algo_params = [
+    AlgoParameterDef("threshold", "float", None, 0.5),
+    AlgoParameterDef(
+        "favor", "str", ["unilateral", "no", "coordinated"], "unilateral"
+    ),
+    AlgoParameterDef("stop_cycle", "int", None, 0),
+]
+
+
+class Mgm2Solver(LocalSearchSolver):
+    """State = (x,)."""
+
+    def __init__(self, dcop, tensors, algo_def, seed=0):
+        super().__init__(dcop, tensors, algo_def, seed)
+        self.threshold = float(self.params.get("threshold", 0.5))
+        # 5 rounds per cycle, one message per neighbor pair each
+        self.msgs_per_cycle = 5 * int(tensors.neighbor_src.shape[0])
+        self._build_pair_structures()
+
+    def _build_pair_structures(self):
+        """Static pair-edge arrays from the arity-2 bucket."""
+        t = self.tensors
+        b2 = next((b for b in t.buckets if b.arity == 2), None)
+        if b2 is None or b2.n_factors == 0:
+            self.n_pairs = 0
+            return
+        self.n_pairs = b2.n_factors
+        self.pair_bucket = b2
+        self.pe_i = jnp.asarray(b2.var_idx[:, 0])
+        self.pe_j = jnp.asarray(b2.var_idx[:, 1])
+        # incidence: var → padded list of (edge, side)
+        V = t.n_vars
+        inc = [[] for _ in range(V)]
+        for e in range(self.n_pairs):
+            inc[b2.var_idx[e, 0]].append((e, 0))
+            inc[b2.var_idx[e, 1]].append((e, 1))
+        maxdeg = max((len(l) for l in inc), default=0)
+        self.pair_deg = jnp.asarray(
+            np.array([len(l) for l in inc], dtype=np.int32)
+        )
+        inc_e = np.full((V, max(maxdeg, 1)), self.n_pairs, dtype=np.int32)
+        inc_s = np.zeros((V, max(maxdeg, 1)), dtype=np.int32)
+        for v, l in enumerate(inc):
+            for k, (e, s) in enumerate(l):
+                inc_e[v, k] = e
+                inc_s[v, k] = s
+        self.inc_e = jnp.asarray(inc_e)
+        self.inc_s = jnp.asarray(inc_s)
+
+    def cycle(self, state, key):
+        (x,) = state
+        t = self.tensors
+        V, D = t.n_vars, t.max_domain_size
+        me = jnp.arange(V)
+        tables = local_cost_tables(t, x)
+        cur, best_val, own_gain, _ = gains_and_best(t, x, tables=tables)
+
+        if self.n_pairs == 0:
+            from pydcop_tpu.algorithms._local_search import \
+                neighborhood_winner
+
+            move = neighborhood_winner(t, own_gain)
+            return (jnp.where(move, best_val, x).astype(jnp.int32),)
+
+        P = self.n_pairs
+        k_off, k_pick = jax.random.split(key)
+        offerer = jax.random.uniform(k_off, (V,)) < self.threshold
+
+        # --- offer round: each offerer picks one random incident pair edge
+        pick = jnp.floor(
+            jax.random.uniform(k_pick, (V,))
+            * jnp.maximum(self.pair_deg, 1)
+        ).astype(jnp.int32)
+        chosen_e = self.inc_e[me, jnp.minimum(pick, self.inc_e.shape[1] - 1)]
+        chosen_s = self.inc_s[me, jnp.minimum(pick, self.inc_e.shape[1] - 1)]
+        valid_offer = offerer & (self.pair_deg > 0)
+        # scatter: which edges were selected from side 0 / side 1
+        tgt0 = jnp.where(valid_offer & (chosen_s == 0), chosen_e, P)
+        tgt1 = jnp.where(valid_offer & (chosen_s == 1), chosen_e, P)
+        sel0 = jnp.zeros(P, dtype=bool).at[tgt0].set(True, mode="drop")
+        sel1 = jnp.zeros(P, dtype=bool).at[tgt1].set(True, mode="drop")
+        offered0 = sel0 & ~offerer[self.pe_j]  # i offers, j receives
+        offered1 = sel1 & ~offerer[self.pe_i]  # j offers, i receives
+        offered = offered0 | offered1
+        receiver = jnp.where(offered0, self.pe_j, self.pe_i)
+
+        # --- joint gain per pair edge
+        M = self.pair_bucket.tensors  # [P, D, D]
+        xi, xj = x[self.pe_i], x[self.pe_j]
+        ep = jnp.arange(P)
+        m_row = M[ep[:, None], jnp.arange(D)[None, :], xj[:, None]]  # [P, D]
+        m_col = M[ep[:, None], xi[:, None], jnp.arange(D)[None, :]]  # [P, D]
+        ti_excl = tables[self.pe_i] - m_row  # [P, D]
+        tj_excl = tables[self.pe_j] - m_col  # [P, D]
+        joint = ti_excl[:, :, None] + tj_excl[:, None, :] + M  # [P, D, D]
+        pair_mask = (
+            t.domain_mask[self.pe_i][:, :, None]
+            * t.domain_mask[self.pe_j][:, None, :]
+        )
+        joint = jnp.where(pair_mask > 0, joint, PAD_COST)
+        cur_joint = cur[self.pe_i] + cur[self.pe_j] - M[ep, xi, xj]
+        flat = joint.reshape(P, D * D)
+        best_flat = jnp.argmin(flat, axis=1)
+        best_joint = flat[ep, best_flat]
+        jg = jnp.maximum(cur_joint - best_joint, 0.0)
+        di_star = (best_flat // D).astype(jnp.int32)
+        dj_star = (best_flat % D).astype(jnp.int32)
+
+        # --- response round: receiver accepts its best positive offer
+        seg_rec = jnp.where(offered & (jg > 1e-9), receiver, V)
+        rec_max = segment_max(jnp.where(offered, jg, -1.0), seg_rec, V + 1)[
+            :V
+        ]
+        at_best = offered & (jg > 1e-9) & (jg >= rec_max[receiver] - 1e-9)
+        first_e = segment_min(jnp.where(at_best, ep, P), seg_rec, V + 1)[:V]
+        accepted = at_best & (ep == first_e[receiver])
+
+        # --- committed vars, pair targets, pair gains
+        committed = jnp.zeros(V, dtype=bool)
+        committed = committed.at[jnp.where(accepted, self.pe_i, V)].set(
+            True, mode="drop"
+        )
+        committed = committed.at[jnp.where(accepted, self.pe_j, V)].set(
+            True, mode="drop"
+        )
+        pair_target = jnp.array(x)
+        pair_target = pair_target.at[
+            jnp.where(accepted, self.pe_i, V)
+        ].set(di_star, mode="drop")
+        pair_target = pair_target.at[
+            jnp.where(accepted, self.pe_j, V)
+        ].set(dj_star, mode="drop")
+        pair_gain = jnp.zeros(V)
+        pair_gain = pair_gain.at[jnp.where(accepted, self.pe_i, V)].set(
+            jg, mode="drop"
+        )
+        pair_gain = pair_gain.at[jnp.where(accepted, self.pe_j, V)].set(
+            jg, mode="drop"
+        )
+        partner = jnp.array(me)
+        partner = partner.at[jnp.where(accepted, self.pe_i, V)].set(
+            self.pe_j, mode="drop"
+        )
+        partner = partner.at[jnp.where(accepted, self.pe_j, V)].set(
+            self.pe_i, mode="drop"
+        )
+
+        # --- gain & go rounds: neighborhood arbitration where partners
+        # share a tie-break id so they don't block each other
+        gain = jnp.where(committed, pair_gain, own_gain)
+        pid = jnp.where(committed, jnp.minimum(me, partner), me)
+        src, dst = t.neighbor_src, t.neighbor_dst
+        neigh_max = jnp.maximum(segment_max(gain[src], dst, V), 0.0)
+        tie_eps = 1e-9
+        at_max = gain[src] >= neigh_max[dst] - tie_eps
+        idx_at_max = segment_min(jnp.where(at_max, pid[src], V), dst, V)
+        winner = (gain > 1e-9) & (
+            (gain > neigh_max + tie_eps)
+            | (
+                (jnp.abs(gain - neigh_max) <= tie_eps)
+                & (pid <= idx_at_max)
+            )
+        )
+        pair_go = committed & winner & winner[partner]
+        x2 = jnp.where(pair_go, pair_target, x)
+        solo_move = ~committed & winner
+        x2 = jnp.where(solo_move, best_val, x2)
+        return (x2.astype(jnp.int32),)
+
+
+def build_solver(dcop: DCOP, computation_graph=None, algo_def=None, seed=0):
+    algo_def = algo_def or AlgorithmDef.build_with_default_params(
+        "mgm2", parameters_definitions=algo_params
+    )
+    tensors = compile_constraint_graph(dcop)
+    return Mgm2Solver(dcop, tensors, algo_def, seed)
+
+
+def computation_memory(node) -> float:
+    return float(len(node.neighbors)) * 2
+
+
+def communication_load(node, target: str = None) -> float:
+    # offers carry a D×D table in the worst case
+    if hasattr(node, "variable"):
+        return float(len(node.variable.domain)) ** 2
+    return 1.0

@@ -1,0 +1,74 @@
+"""Mixed-DSA — DSA over mixed hard/soft constraint problems.
+
+Equivalent capability to the reference's pydcop/algorithms/mixeddsa.py
+(MixedDsaComputation :154, params :119-124): the move probability depends on
+whether the variable currently violates a hard constraint (``proba_hard``)
+or only soft costs are at stake (``proba_soft``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pydcop_tpu.algorithms import AlgoParameterDef, AlgorithmDef
+from pydcop_tpu.algorithms._local_search import (
+    HARD_THRESHOLD,
+    LocalSearchSolver,
+    conflicted,
+    gains_and_best,
+)
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.ops.compile import compile_constraint_graph
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+algo_params = [
+    AlgoParameterDef("proba_hard", "float", None, 0.7),
+    AlgoParameterDef("proba_soft", "float", None, 0.5),
+    AlgoParameterDef("variant", "str", ["A", "B", "C"], "B"),
+    AlgoParameterDef("stop_cycle", "int", None, 0),
+]
+
+
+class MixedDsaSolver(LocalSearchSolver):
+    def __init__(self, dcop, tensors, algo_def, seed=0):
+        super().__init__(dcop, tensors, algo_def, seed)
+        self.proba_hard = float(self.params.get("proba_hard", 0.7))
+        self.proba_soft = float(self.params.get("proba_soft", 0.5))
+        self.variant = self.params.get("variant", "B")
+
+    def cycle(self, state, key):
+        (x,) = state
+        prefer_change = self.variant in ("B", "C")
+        cur, best_val, gain, tables = gains_and_best(
+            self.tensors, x, prefer_change=prefer_change
+        )
+        in_hard_conflict = conflicted(self.tensors, x, tables, HARD_THRESHOLD)
+        proba = jnp.where(in_hard_conflict, self.proba_hard, self.proba_soft)
+        activate = jax.random.uniform(key, (self.tensors.n_vars,)) < proba
+        improving = gain > 1e-9
+        lateral = (gain <= 1e-9) & (best_val != x)
+        if self.variant == "A":
+            want = improving
+        elif self.variant == "B":
+            want = improving | (lateral & in_hard_conflict)
+        else:
+            want = improving | lateral
+        move = want & activate
+        return (jnp.where(move, best_val, x).astype(jnp.int32),)
+
+
+def build_solver(dcop: DCOP, computation_graph=None, algo_def=None, seed=0):
+    algo_def = algo_def or AlgorithmDef.build_with_default_params(
+        "mixeddsa", parameters_definitions=algo_params
+    )
+    tensors = compile_constraint_graph(dcop)
+    return MixedDsaSolver(dcop, tensors, algo_def, seed)
+
+
+def computation_memory(node) -> float:
+    return float(len(node.neighbors))
+
+
+def communication_load(node, target: str = None) -> float:
+    return 1.0

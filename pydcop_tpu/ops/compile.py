@@ -73,6 +73,7 @@ class GraphTensorsBase:
     factor_names: List[str]
     sign: float  # +1 for min problems, -1 for max (costs pre-multiplied)
     initial_values: np.ndarray  # [V] int32 domain indices
+    has_initial: np.ndarray = None  # [V] bool — variable had initial_value
 
     @property
     def n_vars(self) -> int:
@@ -129,6 +130,23 @@ def _variables_in_order(dcop: DCOP) -> List[Variable]:
     return [dcop.variables[n] for n in sorted(dcop.variables)]
 
 
+def _slice_externals(dcop: DCOP, constraints: Sequence[Constraint]
+                     ) -> List[Constraint]:
+    """Fix external (read-only sensor) variables at their current value:
+    they are inputs, not decision variables (reference twin: read-only
+    variables in maxsum_dynamic, pydcop/algorithms/maxsum_dynamic.py:113)."""
+    if not dcop.external_variables:
+        return list(constraints)
+    ext_values = {
+        ev.name: ev.value for ev in dcop.external_variables.values()
+    }
+    return [
+        c.slice(ext_values) if any(
+            n in ext_values for n in c.scope_names) else c
+        for c in constraints
+    ]
+
+
 def _compile_common(
     variables: Sequence[Variable],
     constraints: Sequence[Constraint],
@@ -145,12 +163,14 @@ def _compile_common(
     mask = np.zeros((V, D), dtype=np.float32)
     unary = np.full((V, D), PAD_COST, dtype=np.float32)
     init = np.zeros(V, dtype=np.int32)
+    has_init = np.zeros(V, dtype=bool)
     for i, v in enumerate(variables):
         n = domain_sizes[i]
         mask[i, :n] = 1.0
         unary[i, :n] = sign * v.cost_vector()
         if v.initial_value is not None:
             init[i] = v.domain.index(v.initial_value)
+            has_init[i] = True
 
     # bucket constraints by arity (stable order: by arity, then input order)
     factor_names = [c.name for c in constraints]
@@ -199,6 +219,7 @@ def _compile_common(
         factor_names,
         sign,
         init,
+        has_init,
     )
 
 
@@ -214,6 +235,9 @@ def compile_factor_graph(
         if constraints is not None
         else [dcop.constraints[n] for n in sorted(dcop.constraints)]
     )
+    constraints = [
+        c for c in _slice_externals(dcop, constraints) if c.arity > 0
+    ]
     return FactorGraphTensors(
         *_compile_common(variables, constraints, dcop.objective)
     )
@@ -232,6 +256,9 @@ def compile_constraint_graph(
         if constraints is not None
         else [dcop.constraints[n] for n in sorted(dcop.constraints)]
     )
+    constraints = [
+        c for c in _slice_externals(dcop, constraints) if c.arity > 0
+    ]
     common = _compile_common(variables, constraints, dcop.objective)
     var_pos = {n: i for i, n in enumerate(common[0])}
 
@@ -283,7 +310,11 @@ def total_cost(tensors: GraphTensorsBase, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def local_cost_tables(
-    tensors: GraphTensorsBase, x: jnp.ndarray
+    tensors: GraphTensorsBase,
+    x: jnp.ndarray,
+    bucket_tensors: Optional[List[jnp.ndarray]] = None,
+    factor_weights: Optional[jnp.ndarray] = None,
+    include_unary: bool = True,
 ) -> jnp.ndarray:
     """Per-variable cost table of candidate values given neighbors' current
     values: out[v, d] = Σ_{factors containing v} cost(factor | v=d, others=x)
@@ -292,24 +323,39 @@ def local_cost_tables(
     The workhorse of the local-search family: one gather + indexed lookup +
     segment-sum per arity bucket.  out is [V, D] with PAD_COST on invalid
     slots.
+
+    ``bucket_tensors`` substitutes per-bucket cost tensors (e.g. GDBA's
+    weighted tensors); ``factor_weights`` ([n_factors]) scales each factor's
+    contribution (e.g. DBA's breakout weights).
     """
     from pydcop_tpu.ops.segments import segment_sum
 
     V, D = tensors.n_vars, tensors.max_domain_size
-    out = jnp.where(tensors.domain_mask > 0, tensors.unary_costs, PAD_COST)
-    for b in tensors.buckets:
+    if include_unary:
+        out = jnp.where(tensors.domain_mask > 0, tensors.unary_costs, PAD_COST)
+    else:
+        out = jnp.zeros((V, D), dtype=jnp.float32)
+    for bi, b in enumerate(tensors.buckets):
         F, a = b.n_factors, b.arity
         if F == 0:
             continue
+        T = b.tensors if bucket_tensors is None else bucket_tensors[bi]
         vals = x[b.var_idx]  # [F, a]
         fidx = jnp.arange(F)[:, None]  # [F, 1] broadcast over D
+        w = (
+            factor_weights[b.factor_ids][:, None]
+            if factor_weights is not None
+            else None
+        )
         for p in range(a):
             # index: axis q!=p fixed at current value, axis p swept over D
             idx = tuple(
                 jnp.arange(D)[None, :] if q == p else vals[:, q][:, None]
                 for q in range(a)
             )
-            rows = b.tensors[(fidx,) + idx]  # [F, D]
+            rows = T[(fidx,) + idx]  # [F, D]
+            if w is not None:
+                rows = rows * w
             out = out + segment_sum(rows, b.var_idx[:, p], V)
     # clamp padding back (segment sums may have added pad costs on valid
     # rows only through real factors, but invalid slots can accumulate)

@@ -1,0 +1,72 @@
+"""Named-tensor join/projection kernels for tree inference (DPOP).
+
+The device-side form of the relational algebra in
+pydcop_tpu.dcop.relations: UTIL tables are dense jnp tensors tagged with an
+ordered list of (variable name, size) dims.  ``join`` aligns on the union of
+dims and adds (broadcast); ``projection`` min/max-reduces one axis — the two
+ops that dominate DPOP's UTIL phase (reference hot loop:
+pydcop/dcop/relations.py:1622-1706, driven from pydcop/algorithms/dpop.py:299).
+
+These run eagerly on the accelerator; the DPOP solver sequences them along
+the pseudo-tree's level schedule.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax.numpy as jnp
+
+Dims = List[Tuple[str, int]]  # ordered (variable name, domain size)
+
+
+def align(t: jnp.ndarray, dims: Dims, out_dims: Dims) -> jnp.ndarray:
+    """Transpose/expand t to broadcast over out_dims (superset of dims)."""
+    pos = {name: i for i, (name, _) in enumerate(dims)}
+    perm = [pos[name] for name, _ in out_dims if name in pos]
+    t = jnp.transpose(t, perm) if perm else t
+    shape = [size if name in pos else 1 for name, size in out_dims]
+    return t.reshape(shape)
+
+
+def join_t(
+    t1: jnp.ndarray, dims1: Dims, t2: jnp.ndarray, dims2: Dims
+) -> Tuple[jnp.ndarray, Dims]:
+    """Sum-combine two util tables over the union of their dims."""
+    names1 = {n for n, _ in dims1}
+    out_dims = list(dims1) + [d for d in dims2 if d[0] not in names1]
+    return align(t1, dims1, out_dims) + align(t2, dims2, out_dims), out_dims
+
+
+def project_t(
+    t: jnp.ndarray, dims: Dims, var_name: str, mode: str = "min"
+) -> Tuple[jnp.ndarray, Dims]:
+    """Optimize one variable out of a util table."""
+    axis = [n for n, _ in dims].index(var_name)
+    out = jnp.min(t, axis=axis) if mode == "min" else jnp.max(t, axis=axis)
+    return out, [d for d in dims if d[0] != var_name]
+
+
+def slice_t(
+    t: jnp.ndarray, dims: Dims, assignment: Dict[str, int]
+) -> Tuple[jnp.ndarray, Dims]:
+    """Fix some dims at given value indices."""
+    idx = tuple(
+        assignment[name] if name in assignment else slice(None)
+        for name, _ in dims
+    )
+    return t[idx], [d for d in dims if d[0] not in assignment]
+
+
+def argopt_value(
+    t: jnp.ndarray, dims: Dims, var_name: str, mode: str = "min"
+) -> int:
+    """Best value index of a 1-D util table over var_name."""
+    assert len(dims) == 1 and dims[0][0] == var_name, dims
+    return int(jnp.argmin(t) if mode == "min" else jnp.argmax(t))
+
+
+def table_size(dims: Dims) -> int:
+    size = 1
+    for _, s in dims:
+        size *= s
+    return size

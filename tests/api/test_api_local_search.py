@@ -1,0 +1,141 @@
+"""End-to-end tests for the local-search family + async/dynamic variants."""
+import os
+
+import pytest
+
+from pydcop_tpu.dcop import load_dcop, load_dcop_from_file
+from pydcop_tpu.runtime import solve_result
+
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+
+
+@pytest.fixture
+def csp_dcop():
+    return load_dcop_from_file(os.path.join(INSTANCES, "coloring_csp.yaml"))
+
+
+@pytest.fixture
+def tuto_dcop():
+    return load_dcop_from_file(
+        os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+    )
+
+
+@pytest.mark.parametrize(
+    "algo", ["dsa", "dsatuto", "mgm", "mgm2", "dba", "gdba", "mixeddsa",
+             "adsa"]
+)
+def test_solves_csp_triangle(csp_dcop, algo):
+    """All local-search algorithms 3-color the triangle (cost 0)."""
+    res = solve_result(csp_dcop, algo, cycles=50, seed=3)
+    assert res.violation == 0
+    assert res.cost == 0
+    assert len(set(res.assignment.values())) == 3
+
+
+@pytest.mark.parametrize("algo", ["dsa", "mgm", "mgm2"])
+def test_tuto_reaches_low_cost(tuto_dcop, algo):
+    res = solve_result(tuto_dcop, algo, cycles=30, seed=1)
+    # optimum is 12; all-R (cost 18) is the worst single-move local optimum
+    assert res.cost <= 18
+    assert res.violation == 0
+
+
+def test_mgm_monotone(tuto_dcop):
+    res = solve_result(
+        tuto_dcop, "mgm", cycles=20, seed=5, collect_cycles=True
+    )
+    costs = [h["cost"] for h in res.history]
+    for a, b in zip(costs, costs[1:]):
+        assert b <= a + 1e-5
+
+
+def test_mgm2_beats_or_matches_mgm_on_average(tuto_dcop):
+    """MGM-2's coordinated moves escape some of MGM's local minima."""
+    mgm_costs, mgm2_costs = [], []
+    for seed in range(4):
+        mgm_costs.append(
+            solve_result(tuto_dcop, "mgm", cycles=25, seed=seed).cost
+        )
+        mgm2_costs.append(
+            solve_result(tuto_dcop, "mgm2", cycles=25, seed=seed).cost
+        )
+    assert sum(mgm2_costs) <= sum(mgm_costs) + 1e-6
+
+
+def test_dsa_variants(csp_dcop):
+    for variant in ("A", "B", "C"):
+        res = solve_result(
+            csp_dcop, "dsa", cycles=60,
+            algo_params={"variant": variant, "probability": 0.7}, seed=2,
+        )
+        assert res.cost == 0, variant
+
+
+def test_gdba_modes(csp_dcop):
+    for modifier in ("A", "M"):
+        for increase_mode in ("E", "R", "C", "T"):
+            res = solve_result(
+                csp_dcop, "gdba", cycles=40,
+                algo_params={
+                    "modifier": modifier, "increase_mode": increase_mode
+                },
+                seed=1,
+            )
+            assert res.cost == 0, (modifier, increase_mode)
+
+
+def test_amaxsum(tuto_dcop):
+    res = solve_result(tuto_dcop, "amaxsum", cycles=40, seed=0)
+    assert res.assignment == {"v1": "G", "v2": "G", "v3": "G", "v4": "G"}
+
+
+def test_maxsum_dynamic_factor_change():
+    from pydcop_tpu.algorithms import AlgorithmDef
+    from pydcop_tpu.algorithms.maxsum_dynamic import build_solver
+    from pydcop_tpu.dcop import constraint_from_str
+
+    dcop = load_dcop(
+        """
+name: dyn
+domains: {d: {values: [0, 1]}}
+variables:
+  v1: {domain: d}
+  v2: {domain: d}
+constraints:
+  c1: {type: intention, function: 10 if v1 != v2 else 0}
+agents: [a1, a2]
+"""
+    )
+    solver = build_solver(dcop)
+    res = solver.run(cycles=10)
+    assert res.assignment["v1"] == res.assignment["v2"]
+    # flip the factor: now equality is penalized
+    new_c = constraint_from_str(
+        "c1", "10 if v1 == v2 else 0", dcop.variables.values()
+    )
+    solver.change_factor_function(new_c)
+    res2 = solver.run(cycles=10)
+    assert res2.assignment["v1"] != res2.assignment["v2"]
+
+
+def test_maxsum_dynamic_external_change():
+    from pydcop_tpu.algorithms.maxsum_dynamic import build_solver
+
+    dcop = load_dcop(
+        """
+name: dyn_ext
+domains: {d: {values: [0, 1]}}
+variables:
+  v1: {domain: d}
+external_variables:
+  e1: {domain: d, initial_value: 0}
+constraints:
+  c1: {type: intention, function: 10 if v1 != e1 else 0}
+agents: [a1]
+"""
+    )
+    solver = build_solver(dcop)
+    assert solver.run(cycles=5).assignment["v1"] == 0
+    solver.on_external_change("e1", 1)
+    assert solver.run(cycles=5).assignment["v1"] == 1

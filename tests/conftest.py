@@ -2,11 +2,17 @@
 
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on a virtual CPU mesh (the standard JAX testing pattern).
-Must run before jax is imported anywhere.
+
+Note: in this environment a sitecustomize imports jax at interpreter start
+with JAX_PLATFORMS=axon (the single tunneled TPU chip), so env-var changes
+here are too late — the platform must be overridden through jax.config.
+Tests must never touch the TPU: it is single-tenant and a concurrent holder
+blocks every other process.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA flags are read at first backend initialization, which has not happened
+# yet at conftest time — set before any jax.devices() call.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,3 +22,7 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
