@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Benchmark: MaxSum message-passing iterations/sec on a 10k-variable random
+graph (the BASELINE.md primary metric).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "iters/s", "vs_baseline": R}
+
+vs_baseline compares against a freshly-measured reference-equivalent
+python implementation of the same factor-update math (the reference's
+factor_costs_for_var enumerates the cross product of neighbor domains in
+python per factor per cycle — pydcop/algorithms/maxsum.py:345-423); its
+per-cycle time is measured on a factor subsample here and extrapolated to
+the full graph.  Runs on the default JAX backend (the TPU under the
+driver).
+"""
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import time
+
+import numpy as np
+
+
+def python_reference_cycle_time(tensors, sample: int = 200) -> float:
+    """Seconds per full message-passing cycle for a python-loop
+    implementation of the factor update (reference-equivalent math)."""
+    b = max(tensors.buckets, key=lambda b: b.n_factors)
+    t_np = np.asarray(b.tensors)
+    n = min(sample, b.n_factors)
+    D = tensors.max_domain_size
+    q = np.zeros((b.arity, D), dtype=np.float32)
+    t0 = time.perf_counter()
+    for f in range(n):
+        cost = t_np[f]
+        for p in range(b.arity):
+            others = [o for o in range(b.arity) if o != p]
+            for d in range(D):
+                best = float("inf")
+                for combo in itertools.product(range(D), repeat=len(others)):
+                    idx = [0] * b.arity
+                    idx[p] = d
+                    for o, c in zip(others, combo):
+                        idx[o] = c
+                    val = cost[tuple(idx)] + sum(
+                        q[o, c] for o, c in zip(others, combo)
+                    )
+                    if val < best:
+                        best = val
+    per_factor = (time.perf_counter() - t0) / n
+    total_factors = sum(bb.n_factors for bb in tensors.buckets)
+    return per_factor * total_factors
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vars", type=int, default=10_000)
+    ap.add_argument("--edges", type=int, default=30_000)
+    ap.add_argument("--colors", type=int, default=3)
+    ap.add_argument("--cycles", type=int, default=50)
+    ap.add_argument("--repeat", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from pydcop_tpu.generators import generate_graph_coloring
+    from pydcop_tpu.ops import compile_factor_graph
+    from pydcop_tpu.ops.maxsum_kernels import init_messages, maxsum_cycle
+
+    dcop = generate_graph_coloring(
+        n_variables=args.vars,
+        n_colors=args.colors,
+        n_edges=args.edges,
+        soft=True,
+        n_agents=1,
+        seed=1,
+    )
+    tensors = compile_factor_graph(dcop)
+
+    @jax.jit
+    def run_n(q, r):
+        def body(carry, _):
+            q, r = carry
+            q2, r2, beliefs, values = maxsum_cycle(tensors, q, r, damping=0.5)
+            return (q2, r2), ()
+
+        (q, r), _ = jax.lax.scan(body, (q, r), None, length=args.cycles)
+        return q, r
+
+    q0, r0 = init_messages(tensors)
+    # warmup / compile
+    q, r = run_n(q0, r0)
+    jax.block_until_ready((q, r))
+
+    times = []
+    for _ in range(args.repeat):
+        t0 = time.perf_counter()
+        q, r = run_n(q0, r0)
+        jax.block_until_ready((q, r))
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    iters_per_sec = args.cycles / best
+
+    ref_cycle_s = python_reference_cycle_time(tensors)
+    ref_iters_per_sec = 1.0 / ref_cycle_s if ref_cycle_s > 0 else 0.0
+    vs_baseline = (
+        iters_per_sec / ref_iters_per_sec if ref_iters_per_sec else 0.0
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"maxsum_iters_per_sec_{args.vars}var_{args.edges}edge"
+                ),
+                "value": round(iters_per_sec, 2),
+                "unit": "iters/s",
+                "vs_baseline": round(vs_baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

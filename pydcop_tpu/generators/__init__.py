@@ -1,0 +1,11 @@
+"""Problem generators (library layer).
+
+Equivalent capability to the reference's pydcop/commands/generators/*
+(graphcoloring :155-310, ising :158-334, agents, scenario, ...), exposed as
+functions returning DCOP objects so both the CLI (`pydcop_tpu generate`) and
+benchmarks can use them.
+"""
+from pydcop_tpu.generators.graphcoloring import generate_graph_coloring
+from pydcop_tpu.generators.ising import generate_ising
+
+__all__ = ["generate_graph_coloring", "generate_ising"]

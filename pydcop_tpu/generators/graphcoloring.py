@@ -1,0 +1,106 @@
+"""Random graph-coloring DCOP generator.
+
+Equivalent capability to the reference's
+pydcop/commands/generators/graphcoloring.py (:155-310): random (Erdős–Rényi
+/ preferential-attachment / grid) graphs, soft or hard coloring constraints,
+optional extensional cost tables.
+"""
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+import numpy as np
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+
+def generate_graph_coloring(
+    n_variables: int,
+    n_colors: int = 3,
+    density: float = 0.2,
+    graph_type: str = "random",  # random | scalefree | grid
+    soft: bool = True,
+    noise_level: float = 0.02,
+    n_agents: Optional[int] = None,
+    capacity: float = 100,
+    seed: int = 0,
+    p_edge: Optional[float] = None,
+    n_edges: Optional[int] = None,
+) -> DCOP:
+    """Build a random coloring DCOP.
+
+    soft=True → extensional random-cost tables penalizing equal colors
+    (weighted coloring); soft=False → hard CSP (equal colors cost 10000).
+    """
+    rng = random.Random(seed)
+    np_rng = np.random.default_rng(seed)
+    dcop = DCOP(f"graph_coloring_{n_variables}", "min")
+    domain = Domain("colors", "color", list(range(n_colors)))
+    variables = [Variable(f"v{i:05d}", domain) for i in range(n_variables)]
+    for v in variables:
+        dcop.add_variable(v)
+
+    edges = set()
+    if graph_type == "grid":
+        side = int(np.sqrt(n_variables))
+        for r in range(side):
+            for c in range(side):
+                i = r * side + c
+                if c + 1 < side:
+                    edges.add((i, i + 1))
+                if r + 1 < side:
+                    edges.add((i, i + side))
+    elif graph_type == "scalefree":
+        # preferential attachment, m=2
+        m = 2
+        targets = list(range(min(m, n_variables)))
+        repeated: list = list(targets)
+        for i in range(m, n_variables):
+            chosen = set()
+            while len(chosen) < min(m, len(set(repeated))):
+                chosen.add(rng.choice(repeated))
+            for t in chosen:
+                edges.add((min(i, t), max(i, t)))
+                repeated.extend([i, t])
+    else:  # random (Erdős–Rényi by density / explicit edge count)
+        if n_edges is not None:
+            while len(edges) < n_edges:
+                i, j = rng.randrange(n_variables), rng.randrange(n_variables)
+                if i != j:
+                    edges.add((min(i, j), max(i, j)))
+        else:
+            p = p_edge if p_edge is not None else density
+            # sample the expected number of edges directly (fast for
+            # large sparse graphs)
+            target = int(p * n_variables * (n_variables - 1) / 2)
+            while len(edges) < target:
+                i, j = rng.randrange(n_variables), rng.randrange(n_variables)
+                if i != j:
+                    edges.add((min(i, j), max(i, j)))
+
+    for k, (i, j) in enumerate(sorted(edges)):
+        if soft:
+            m = np_rng.uniform(0, 1, size=(n_colors, n_colors)).astype(
+                np.float32
+            )
+            m = m + np.eye(n_colors, dtype=np.float32) * 10
+        else:
+            m = np.where(
+                np.eye(n_colors, dtype=bool), 10000.0, 0.0
+            ).astype(np.float32)
+        if noise_level:
+            m = m + np_rng.uniform(0, noise_level, m.shape).astype(np.float32)
+        dcop.add_constraint(
+            NAryMatrixRelation(
+                [variables[i], variables[j]], m, f"c{k:06d}"
+            )
+        )
+
+    n_agents = n_agents if n_agents is not None else n_variables
+    dcop.add_agents(
+        [AgentDef(f"a{i:05d}", capacity=capacity) for i in range(n_agents)]
+    )
+    return dcop

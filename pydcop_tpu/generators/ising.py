@@ -1,0 +1,66 @@
+"""Ising-model factor-graph generator.
+
+Equivalent capability to the reference's pydcop/commands/generators/ising.py
+(:158-334): a grid of binary spins with random pairwise couplings and unary
+fields — the standard MaxSum benchmark topology.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, VariableWithCostDict
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+
+def generate_ising(
+    rows: int,
+    cols: int,
+    bin_range: float = 1.6,
+    un_range: float = 0.05,
+    seed: int = 0,
+    capacity: float = 100,
+) -> DCOP:
+    """rows×cols toroidal Ising grid: spin variables with random unary
+    fields in [-un_range, un_range] and couplings in [-bin_range,
+    bin_range] (cost k·si·sj with si, sj ∈ {-1, 1})."""
+    rng = np.random.default_rng(seed)
+    dcop = DCOP(f"ising_{rows}x{cols}", "min")
+    domain = Domain("spin", "spin", [-1, 1])
+
+    variables = {}
+    for r in range(rows):
+        for c in range(cols):
+            name = f"s_{r}_{c}"
+            u = float(rng.uniform(-un_range, un_range))
+            variables[(r, c)] = VariableWithCostDict(
+                name, domain, {-1: -u, 1: u}
+            )
+            dcop.add_variable(variables[(r, c)])
+
+    k = 0
+    for r in range(rows):
+        for c in range(cols):
+            for dr, dc in ((0, 1), (1, 0)):
+                r2, c2 = (r + dr) % rows, (c + dc) % cols
+                if (r2, c2) == (r, c):
+                    continue
+                coupling = float(rng.uniform(-bin_range, bin_range))
+                # cost(si, sj) = k * si * sj
+                m = np.array(
+                    [[coupling, -coupling], [-coupling, coupling]],
+                    dtype=np.float32,
+                )
+                dcop.add_constraint(
+                    NAryMatrixRelation(
+                        [variables[(r, c)], variables[(r2, c2)]],
+                        m,
+                        f"c{k:06d}",
+                    )
+                )
+                k += 1
+
+    dcop.add_agents(
+        [AgentDef(f"a{i}", capacity=capacity) for i in range(rows * cols)]
+    )
+    return dcop

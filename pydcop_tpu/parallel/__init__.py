@@ -1,0 +1,21 @@
+"""Scale-out: device meshes, sharded kernels, graph partitioning.
+
+The TPU-native replacement for the reference's multi-process/multi-machine
+runtime (pydcop/infrastructure/communication.py HTTP + discovery): the
+computation graph is partitioned into edge shards laid out over a
+``jax.sharding.Mesh``; neighborhood aggregations become ``psum`` collectives
+riding ICI/DCN instead of HTTP messages (SURVEY.md §2.8 mapping).
+"""
+from pydcop_tpu.parallel.mesh import (
+    ShardedMaxSum,
+    build_mesh,
+    shard_factor_graph,
+)
+from pydcop_tpu.parallel.partition import partition_factors
+
+__all__ = [
+    "ShardedMaxSum",
+    "build_mesh",
+    "shard_factor_graph",
+    "partition_factors",
+]
