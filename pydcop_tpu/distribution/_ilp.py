@@ -1,0 +1,147 @@
+"""Shared optimal-ILP placement core.
+
+The reference's ILP distribution modules (ilp_fgdp.py, ilp_compref.py,
+oilp_cgdp.py, ...) all solve variations of one model with pulp/GLPK
+(pydcop/distribution/ilp_fgdp.py:34-38):
+
+    min   w_comm · Σ_edges route(a1,a2)·load·y[c1,c2,a1,a2]
+        + w_host · Σ hosting(a,c)·x[c,a]
+    s.t.  Σ_a x[c,a] = 1                      (every computation placed)
+          Σ_c mem(c)·x[c,a] ≤ capacity(a)     (agent capacity)
+          y ≥ x1 + x2 − 1                     (standard linearization)
+          must_host hints pin x[c,a] = 1
+
+pulp is not available in this environment; the same model is solved with
+scipy.optimize.milp (HiGHS), which is baked in.  The quadratic
+communication term is linearized with one y variable per (edge, agent
+pair), only materialized when communication costs are part of the
+objective.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from pydcop_tpu.distribution._costs import RATIO_HOST_COMM, edge_loads
+from pydcop_tpu.distribution.objects import (
+    Distribution,
+    ImpossibleDistributionException,
+)
+
+
+def ilp_placement(
+    computation_graph,
+    agentsdef: Iterable,
+    hints=None,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+    use_hosting: bool = True,
+    use_comm: bool = True,
+    use_routes: bool = True,
+    w_comm: float = RATIO_HOST_COMM,
+    w_host: float = 1 - RATIO_HOST_COMM,
+) -> Distribution:
+    from scipy.optimize import LinearConstraint, milp
+    from scipy.sparse import lil_matrix
+
+    agents = list(agentsdef)
+    comps = [n.name for n in computation_graph.nodes]
+    nA, nC = len(agents), len(comps)
+    if nC == 0:
+        return Distribution({a.name: [] for a in agents})
+    a_idx = {a.name: i for i, a in enumerate(agents)}
+    c_idx = {c: i for i, c in enumerate(comps)}
+
+    def xvar(c: int, a: int) -> int:
+        return c * nA + a
+
+    n_x = nC * nA
+    edges: List[Tuple[str, str, float]] = (
+        edge_loads(computation_graph, communication_load)
+        if (use_comm and communication_load is not None)
+        else []
+    )
+    # y vars: one per (edge, a1, a2) pair with nonzero cost
+    y_entries: List[Tuple[int, int, int, int, float]] = []
+    for e, (cu, cv, load) in enumerate(edges):
+        for i1, ag1 in enumerate(agents):
+            for i2, ag2 in enumerate(agents):
+                route = ag1.route(agents[i2].name) if use_routes else (
+                    0.0 if i1 == i2 else 1.0
+                )
+                cost = w_comm * route * load
+                y_entries.append((e, i1, i2, len(y_entries), cost))
+    n_y = len(y_entries)
+    n_vars = n_x + n_y
+
+    cost = np.zeros(n_vars)
+    if use_hosting:
+        for c, cname in enumerate(comps):
+            for a, agent in enumerate(agents):
+                cost[xvar(c, a)] = w_host * agent.hosting_cost(cname)
+    for (e, i1, i2, yi, ycost) in y_entries:
+        cost[n_x + yi] = ycost
+
+    constraints = []
+    # each computation exactly on one agent
+    A_eq = lil_matrix((nC, n_vars))
+    for c in range(nC):
+        for a in range(nA):
+            A_eq[c, xvar(c, a)] = 1
+    constraints.append(LinearConstraint(A_eq.tocsr(), 1, 1))
+
+    # capacity
+    if computation_memory is not None:
+        A_cap = lil_matrix((nA, n_vars))
+        caps = np.zeros(nA)
+        for a, agent in enumerate(agents):
+            caps[a] = agent.capacity if agent.capacity is not None else np.inf
+            for c, cname in enumerate(comps):
+                A_cap[a, xvar(c, a)] = computation_memory(
+                    computation_graph.computation(cname)
+                )
+        constraints.append(LinearConstraint(A_cap.tocsr(), -np.inf, caps))
+
+    # linearization y >= x1 + x2 - 1  ⇔  x1 + x2 - y <= 1
+    if n_y:
+        A_lin = lil_matrix((n_y, n_vars))
+        for (e, i1, i2, yi, _) in y_entries:
+            cu, cv, _load = edges[e]
+            A_lin[yi, xvar(c_idx[cu], i1)] = 1
+            A_lin[yi, xvar(c_idx[cv], i2)] = 1
+            A_lin[yi, n_x + yi] = -1
+        constraints.append(LinearConstraint(A_lin.tocsr(), -np.inf, 1))
+
+    # must_host hints pin placements
+    lb = np.zeros(n_vars)
+    ub = np.ones(n_vars)
+    if hints is not None and hasattr(hints, "must_host_map"):
+        for a_name, hosted in hints.must_host_map.items():
+            if a_name not in a_idx:
+                continue
+            for cname in hosted:
+                if cname in c_idx:
+                    lb[xvar(c_idx[cname], a_idx[a_name])] = 1
+
+    from scipy.optimize import Bounds
+
+    integrality = np.ones(n_vars)
+    res = milp(
+        c=cost,
+        constraints=constraints,
+        integrality=integrality,
+        bounds=Bounds(lb, ub),
+    )
+    if not res.success:
+        raise ImpossibleDistributionException(
+            f"ILP placement infeasible: {res.message}"
+        )
+    x = np.round(res.x[:n_x]).astype(int)
+    mapping: Dict[str, List[str]] = {a.name: [] for a in agents}
+    for c, cname in enumerate(comps):
+        for a in range(nA):
+            if x[xvar(c, a)]:
+                mapping[agents[a].name].append(cname)
+                break
+    return Distribution(mapping)

@@ -1,0 +1,83 @@
+"""gh_cgdp: greedy heuristic placement for constraint-graph DCOPs.
+
+Equivalent capability to the reference's pydcop/distribution/gh_cgdp.py
+(:30-38): computations sorted by decreasing footprint; each goes to the
+agent minimizing (hosting cost + communication cost to already-placed
+neighbors) under capacity.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional
+
+from pydcop_tpu.distribution._costs import (
+    RATIO_HOST_COMM,
+    distribution_cost as _dist_cost,
+)
+from pydcop_tpu.distribution.objects import (
+    Distribution,
+    ImpossibleDistributionException,
+)
+
+
+def distribute(
+    computation_graph,
+    agentsdef: Iterable,
+    hints=None,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+) -> Distribution:
+    agents = list(agentsdef)
+    mem = computation_memory or (lambda n: 0.0)
+    load = communication_load or (lambda n, t: 0.0)
+    remaining = {a.name: (a.capacity if a.capacity is not None else
+                          float("inf")) for a in agents}
+    mapping: Dict[str, List[str]] = {a.name: [] for a in agents}
+    hosted_by: Dict[str, str] = {}
+    nodes = {n.name: n for n in computation_graph.nodes}
+
+    if hints is not None and hasattr(hints, "must_host_map"):
+        for a_name, comps in hints.must_host_map.items():
+            for c in comps:
+                if c in nodes and a_name in mapping:
+                    mapping[a_name].append(c)
+                    hosted_by[c] = a_name
+                    remaining[a_name] -= mem(nodes[c])
+
+    todo = [c for c in nodes if c not in hosted_by]
+    for c in sorted(todo, key=lambda c: (-mem(nodes[c]), c)):
+        node = nodes[c]
+        footprint = mem(node)
+        best_agent, best_cost = None, float("inf")
+        for a in agents:
+            if remaining[a.name] < footprint:
+                continue
+            comm = sum(
+                a.route(hosted_by[nb]) * load(node, nb)
+                for nb in node.neighbors
+                if nb in hosted_by
+            )
+            cost = (1 - RATIO_HOST_COMM) * a.hosting_cost(c) + \
+                RATIO_HOST_COMM * comm
+            if cost < best_cost:
+                best_agent, best_cost = a, cost
+        if best_agent is None:
+            raise ImpossibleDistributionException(
+                f"No agent has capacity for {c}"
+            )
+        mapping[best_agent.name].append(c)
+        hosted_by[c] = best_agent.name
+        remaining[best_agent.name] -= footprint
+    return Distribution(mapping)
+
+
+def distribution_cost(
+    distribution: Distribution,
+    computation_graph,
+    agentsdef: Iterable,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+) -> float:
+    return _dist_cost(
+        distribution, computation_graph, agentsdef, computation_memory,
+        communication_load,
+    )[0]

@@ -1,0 +1,73 @@
+"""gh_secp_cgdp: SECP-specific greedy placement (constraint graph).
+
+Equivalent capability to the reference's
+pydcop/distribution/gh_secp_cgdp.py (:30-40): in Smart Environment
+Configuration Problems each device agent should host "its" computations
+(light variable on its lamp, etc.) — the problem encodes this through
+hosting costs, so the greedy strongly prefers the cheapest-hosting agent
+and only then considers communication.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional
+
+from pydcop_tpu.distribution._costs import distribution_cost as _dist_cost
+from pydcop_tpu.distribution.objects import (
+    Distribution,
+    ImpossibleDistributionException,
+)
+
+
+def distribute(
+    computation_graph,
+    agentsdef: Iterable,
+    hints=None,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+) -> Distribution:
+    agents = list(agentsdef)
+    mem = computation_memory or (lambda n: 0.0)
+    load = communication_load or (lambda n, t: 1.0)
+    remaining = {a.name: (a.capacity if a.capacity is not None else
+                          float("inf")) for a in agents}
+    mapping: Dict[str, List[str]] = {a.name: [] for a in agents}
+    hosted_by: Dict[str, str] = {}
+    nodes = {n.name: n for n in computation_graph.nodes}
+
+    for c in sorted(nodes):
+        node = nodes[c]
+        footprint = mem(node)
+        best_agent, best_key = None, None
+        for a in agents:
+            if remaining[a.name] < footprint:
+                continue
+            comm = sum(
+                a.route(hosted_by[nb]) * load(node, nb)
+                for nb in node.neighbors
+                if nb in hosted_by
+            )
+            # hosting cost dominates (lexicographic), then communication
+            key = (a.hosting_cost(c), comm, len(mapping[a.name]), a.name)
+            if best_key is None or key < best_key:
+                best_agent, best_key = a, key
+        if best_agent is None:
+            raise ImpossibleDistributionException(
+                f"No agent has capacity for {c}"
+            )
+        mapping[best_agent.name].append(c)
+        hosted_by[c] = best_agent.name
+        remaining[best_agent.name] -= footprint
+    return Distribution(mapping)
+
+
+def distribution_cost(
+    distribution: Distribution,
+    computation_graph,
+    agentsdef: Iterable,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+) -> float:
+    return _dist_cost(
+        distribution, computation_graph, agentsdef, computation_memory,
+        communication_load,
+    )[0]

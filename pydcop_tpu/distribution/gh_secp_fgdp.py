@@ -1,0 +1,11 @@
+"""gh_secp_fgdp: SECP-specific greedy placement on the factor graph.
+
+Equivalent capability to the reference's
+pydcop/distribution/gh_secp_fgdp.py — same hosting-cost-first greedy as
+gh_secp_cgdp, applied to factor-graph nodes (factors follow the variables
+they constrain).
+"""
+from pydcop_tpu.distribution.gh_secp_cgdp import (  # noqa: F401
+    distribute,
+    distribution_cost,
+)

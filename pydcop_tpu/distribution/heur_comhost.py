@@ -1,0 +1,82 @@
+"""heur_comhost: communication/hosting greedy heuristic (AAMAS-18).
+
+Equivalent capability to the reference's
+pydcop/distribution/heur_comhost.py: computations ordered by their total
+communication weight (heaviest talkers first); each placed on the agent
+minimizing weighted hosting + communication to already-placed neighbors.
+Differs from gh_cgdp in the ordering criterion.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional
+
+from pydcop_tpu.distribution._costs import (
+    RATIO_HOST_COMM,
+    distribution_cost as _dist_cost,
+)
+from pydcop_tpu.distribution.objects import (
+    Distribution,
+    ImpossibleDistributionException,
+)
+
+
+def distribute(
+    computation_graph,
+    agentsdef: Iterable,
+    hints=None,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+) -> Distribution:
+    agents = list(agentsdef)
+    mem = computation_memory or (lambda n: 0.0)
+    load = communication_load or (lambda n, t: 1.0)
+    remaining = {a.name: (a.capacity if a.capacity is not None else
+                          float("inf")) for a in agents}
+    mapping: Dict[str, List[str]] = {a.name: [] for a in agents}
+    hosted_by: Dict[str, str] = {}
+    nodes = {n.name: n for n in computation_graph.nodes}
+
+    def comm_weight(c: str) -> float:
+        node = nodes[c]
+        return sum(load(node, nb) for nb in node.neighbors)
+
+    for c in sorted(nodes, key=lambda c: (-comm_weight(c), c)):
+        node = nodes[c]
+        footprint = mem(node)
+        best_agent, best_cost = None, float("inf")
+        for a in agents:
+            if remaining[a.name] < footprint:
+                continue
+            comm = sum(
+                a.route(hosted_by[nb]) * load(node, nb)
+                for nb in node.neighbors
+                if nb in hosted_by
+            )
+            cost = (1 - RATIO_HOST_COMM) * a.hosting_cost(c) + \
+                RATIO_HOST_COMM * comm
+            if cost < best_cost or (
+                cost == best_cost and best_agent is not None
+                and len(mapping[a.name]) < len(mapping[best_agent.name])
+            ):
+                best_agent, best_cost = a, cost
+        if best_agent is None:
+            raise ImpossibleDistributionException(
+                f"No agent has capacity for {c}"
+            )
+        mapping[best_agent.name].append(c)
+        hosted_by[c] = best_agent.name
+        remaining[best_agent.name] -= footprint
+    return Distribution(mapping)
+
+
+def distribution_cost(
+    distribution: Distribution,
+    computation_graph,
+    agentsdef: Iterable,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+) -> float:
+    return _dist_cost(
+        distribution, computation_graph, agentsdef, computation_memory,
+        communication_load,
+    )[0]

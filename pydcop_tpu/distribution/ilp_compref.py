@@ -1,0 +1,44 @@
+"""ilp_compref: optimal ILP placement minimizing weighted communication +
+hosting costs on the constraint graph (AAMAS-18).
+
+Equivalent capability to the reference's pydcop/distribution/ilp_compref.py
+(header :30-40): RATIO_HOST_COMM-weighted objective, uniform routes.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+from pydcop_tpu.distribution._costs import (
+    RATIO_HOST_COMM,
+    distribution_cost as _dist_cost,
+)
+from pydcop_tpu.distribution._ilp import ilp_placement
+from pydcop_tpu.distribution.objects import Distribution
+
+
+def distribute(
+    computation_graph,
+    agentsdef: Iterable,
+    hints=None,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+) -> Distribution:
+    return ilp_placement(
+        computation_graph, agentsdef, hints, computation_memory,
+        communication_load,
+        use_hosting=True, use_comm=True, use_routes=False,
+        w_comm=RATIO_HOST_COMM, w_host=1 - RATIO_HOST_COMM,
+    )
+
+
+def distribution_cost(
+    distribution: Distribution,
+    computation_graph,
+    agentsdef: Iterable,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+) -> float:
+    return _dist_cost(
+        distribution, computation_graph, agentsdef, computation_memory,
+        communication_load,
+    )[0]

@@ -1,0 +1,10 @@
+"""ilp_compref_fg: the AAMAS-18 weighted ILP on the factor graph.
+
+Equivalent capability to the reference's
+pydcop/distribution/ilp_compref_fg.py — identical model to ilp_compref,
+applied to factor-graph computation nodes (variables AND factors placed).
+"""
+from pydcop_tpu.distribution.ilp_compref import (  # noqa: F401
+    distribute,
+    distribution_cost,
+)

@@ -1,0 +1,41 @@
+"""ilp_fgdp: optimal ILP placement for factor graphs (IJCAI-16 model).
+
+Equivalent capability to the reference's pydcop/distribution/ilp_fgdp.py
+(:34-38; pulp/GLPK there, scipy HiGHS here): minimize inter-agent
+communication with agent capacities; hosting costs ignored, routes uniform.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+from pydcop_tpu.distribution._costs import distribution_cost as _dist_cost
+from pydcop_tpu.distribution._ilp import ilp_placement
+from pydcop_tpu.distribution.objects import Distribution
+
+
+def distribute(
+    computation_graph,
+    agentsdef: Iterable,
+    hints=None,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+) -> Distribution:
+    return ilp_placement(
+        computation_graph, agentsdef, hints, computation_memory,
+        communication_load,
+        use_hosting=False, use_comm=True, use_routes=False,
+        w_comm=1.0, w_host=0.0,
+    )
+
+
+def distribution_cost(
+    distribution: Distribution,
+    computation_graph,
+    agentsdef: Iterable,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+) -> float:
+    return _dist_cost(
+        distribution, computation_graph, agentsdef, computation_memory,
+        communication_load,
+    )[1]  # communication term only

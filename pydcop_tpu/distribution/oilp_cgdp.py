@@ -1,0 +1,44 @@
+"""oilp_cgdp: optimal ILP placement including inter-agent route costs.
+
+Equivalent capability to the reference's pydcop/distribution/oilp_cgdp.py
+(:30-38): the full model — hosting costs + route-weighted communication
+under capacities.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+from pydcop_tpu.distribution._costs import (
+    RATIO_HOST_COMM,
+    distribution_cost as _dist_cost,
+)
+from pydcop_tpu.distribution._ilp import ilp_placement
+from pydcop_tpu.distribution.objects import Distribution
+
+
+def distribute(
+    computation_graph,
+    agentsdef: Iterable,
+    hints=None,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+) -> Distribution:
+    return ilp_placement(
+        computation_graph, agentsdef, hints, computation_memory,
+        communication_load,
+        use_hosting=True, use_comm=True, use_routes=True,
+        w_comm=RATIO_HOST_COMM, w_host=1 - RATIO_HOST_COMM,
+    )
+
+
+def distribution_cost(
+    distribution: Distribution,
+    computation_graph,
+    agentsdef: Iterable,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+) -> float:
+    return _dist_cost(
+        distribution, computation_graph, agentsdef, computation_memory,
+        communication_load,
+    )[0]

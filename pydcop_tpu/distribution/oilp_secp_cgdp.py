@@ -1,0 +1,6 @@
+"""oilp_secp_cgdp: optimal ILP for SECP placements (constraint graph, with
+routes) — reference: pydcop/distribution/oilp_secp_cgdp.py."""
+from pydcop_tpu.distribution.oilp_cgdp import (  # noqa: F401
+    distribute,
+    distribution_cost,
+)

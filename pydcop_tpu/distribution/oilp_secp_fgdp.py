@@ -1,0 +1,6 @@
+"""oilp_secp_fgdp: optimal ILP for SECP placements (factor graph, with
+routes) — reference: pydcop/distribution/oilp_secp_fgdp.py."""
+from pydcop_tpu.distribution.oilp_cgdp import (  # noqa: F401
+    distribute,
+    distribution_cost,
+)

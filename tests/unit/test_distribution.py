@@ -1,0 +1,152 @@
+"""Unit tests for the distribution (placement) layer."""
+import os
+
+import pytest
+
+from pydcop_tpu.dcop import AgentDef, load_dcop_from_file
+from pydcop_tpu.dcop.yamldcop import DistributionHints
+from pydcop_tpu.distribution import (
+    ImpossibleDistributionException,
+    list_available_distributions,
+    load_distribution_module,
+)
+from pydcop_tpu.graph import constraints_hypergraph, factor_graph
+
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+
+GREEDY = ["oneagent", "adhoc", "gh_cgdp", "heur_comhost", "gh_secp_cgdp",
+          "gh_secp_fgdp"]
+ILP = ["ilp_fgdp", "ilp_compref", "ilp_compref_fg", "oilp_cgdp",
+       "oilp_secp_cgdp", "oilp_secp_fgdp"]
+
+
+@pytest.fixture
+def tuto():
+    dcop = load_dcop_from_file(
+        os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+    )
+    cg = constraints_hypergraph.build_computation_graph(dcop)
+    return dcop, cg
+
+
+def _mem(node):
+    return 1.0
+
+
+def _load(node, target=None):
+    return 1.0
+
+
+def test_registry():
+    mods = list_available_distributions()
+    for m in GREEDY + ILP + ["yamlformat"]:
+        if m == "yamlformat":
+            assert m not in mods  # excluded (not a strategy)
+        else:
+            assert m in mods, m
+
+
+@pytest.mark.parametrize("name", GREEDY + ILP)
+def test_distribute_all_hosted(tuto, name):
+    dcop, cg = tuto
+    mod = load_distribution_module(name)
+    dist = mod.distribute(
+        cg, dcop.agents.values(), hints=None,
+        computation_memory=_mem, communication_load=_load,
+    )
+    hosted = sorted(dist.computations)
+    assert hosted == sorted(n.name for n in cg.nodes)
+    # capacity respected (all capacities are 100 here)
+    for a in dist.agents:
+        assert len(dist.computations_hosted(a)) <= 100
+
+
+def test_oneagent_needs_enough_agents(tuto):
+    dcop, cg = tuto
+    mod = load_distribution_module("oneagent")
+    few = [AgentDef("only_one")]
+    with pytest.raises(ImpossibleDistributionException):
+        mod.distribute(cg, few)
+
+
+@pytest.mark.parametrize("name", ["adhoc", "gh_cgdp", "ilp_compref"])
+def test_must_host_hints(tuto, name):
+    dcop, cg = tuto
+    mod = load_distribution_module(name)
+    hints = DistributionHints(must_host={"a1": ["v1"], "a2": ["v2"]})
+    dist = mod.distribute(
+        cg, dcop.agents.values(), hints=hints,
+        computation_memory=_mem, communication_load=_load,
+    )
+    assert "v1" in dist.computations_hosted("a1")
+    assert "v2" in dist.computations_hosted("a2")
+
+
+def test_capacity_limits():
+    from pydcop_tpu.dcop import DCOP, Domain, Variable, constraint_from_str
+
+    d = Domain("d", "d", [0, 1])
+    dcop = DCOP("t")
+    vs = [Variable(f"v{i}", d) for i in range(4)]
+    for i in range(3):
+        dcop.add_constraint(
+            constraint_from_str(f"c{i}", f"v{i} + v{i+1}", vs))
+    cg = constraints_hypergraph.build_computation_graph(dcop)
+    # capacity 2 per agent, 4 computations of size 1 → >= 2 agents needed
+    agents = [AgentDef("a1", capacity=2), AgentDef("a2", capacity=2)]
+    for name in ("adhoc", "gh_cgdp", "ilp_compref"):
+        mod = load_distribution_module(name)
+        dist = mod.distribute(
+            cg, agents, computation_memory=_mem, communication_load=_load
+        )
+        for a in dist.agents:
+            assert len(dist.computations_hosted(a)) <= 2
+
+    # impossible: capacity 1 on one agent only
+    tiny = [AgentDef("a1", capacity=1)]
+    for name in ("adhoc", "gh_cgdp"):
+        mod = load_distribution_module(name)
+        with pytest.raises(ImpossibleDistributionException):
+            mod.distribute(cg, tiny, computation_memory=_mem)
+
+
+def test_ilp_optimal_communication(tuto):
+    """The ILP must achieve communication cost <= any greedy placement."""
+    dcop, cg = tuto
+    from pydcop_tpu.distribution._costs import distribution_cost
+
+    ilp = load_distribution_module("ilp_fgdp").distribute(
+        cg, dcop.agents.values(), computation_memory=_mem,
+        communication_load=_load,
+    )
+    greedy = load_distribution_module("adhoc").distribute(
+        cg, dcop.agents.values(), computation_memory=_mem,
+        communication_load=_load,
+    )
+    _, ilp_comm, _ = distribution_cost(
+        ilp, cg, dcop.agents.values(), _mem, _load)
+    _, greedy_comm, _ = distribution_cost(
+        greedy, cg, dcop.agents.values(), _mem, _load)
+    assert ilp_comm <= greedy_comm + 1e-6
+
+
+def test_factor_graph_distribution(tuto):
+    dcop, _ = tuto
+    fg = factor_graph.build_computation_graph(dcop)
+    dist = load_distribution_module("ilp_compref_fg").distribute(
+        fg, dcop.agents.values(), computation_memory=_mem,
+        communication_load=_load,
+    )
+    assert sorted(dist.computations) == sorted(n.name for n in fg.nodes)
+
+
+def test_yamlformat_roundtrip(tuto):
+    dcop, cg = tuto
+    from pydcop_tpu.distribution import yamlformat
+
+    dist = load_distribution_module("adhoc").distribute(
+        cg, dcop.agents.values(), computation_memory=_mem,
+    )
+    dumped = yamlformat.yaml_dist(dist)
+    dist2 = yamlformat.load_dist(dumped)
+    assert dist2 == dist
