@@ -120,6 +120,7 @@ class SynchronousTensorSolver:
         chunk: int = 8,
         stable_chunks: int = 2,
         collect_cycles: bool = False,
+        resume: bool = False,
     ) -> SolveResult:
         """Run the solver.
 
@@ -127,12 +128,18 @@ class SynchronousTensorSolver:
           ``stop_cycle``).
         * otherwise → run until the assignment is stable for
           ``stable_chunks`` consecutive chunks, or ``max_cycles``/timeout.
+        * ``resume=True`` continues from the previous run's state (warm
+          restart — used by the orchestrator across scenario events).
         """
         t0 = perf_counter()
         target = cycles if cycles else None
         limit = target if target is not None else max_cycles
 
-        state = self.initial_state()
+        state = (
+            self._last_state
+            if resume and getattr(self, "_last_state", None) is not None
+            else self.initial_state()
+        )
         key = jax.random.PRNGKey(self.seed)
         done = 0
         history: List[Dict[str, Any]] = []
@@ -171,6 +178,7 @@ class SynchronousTensorSolver:
                 status = "TIMEOUT"
                 break
 
+        self._last_state = state
         final_vals = np.asarray(self.values_of(state))
         assignment = self.tensors.assignment_from_indices(final_vals)
         violation, cost = self.dcop.solution_cost(assignment, self.infinity)

@@ -1,0 +1,106 @@
+"""`pydcop_tpu` CLI entry point.
+
+Equivalent capability to the reference's pydcop/dcop_cli.py (:62-207):
+global options (-v verbosity, --timeout with a forced-exit slack timer,
+--output, --version, --log) and the subcommand tree (solve, run,
+orchestrator, agent, distribute, graph, generate, batch, replica_dist,
+consolidate).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+
+#: extra seconds after --timeout before the process force-exits
+#: (reference: dcop_cli.py TIMEOUT_SLACK = 40)
+TIMEOUT_SLACK = 40
+
+
+def make_parser() -> argparse.ArgumentParser:
+    from pydcop_tpu.version import __version__
+
+    parser = argparse.ArgumentParser(
+        prog="pydcop_tpu",
+        description="TPU-native DCOP solving (pyDCOP capability set)",
+    )
+    parser.add_argument("-v", "--verbosity", type=int, default=0,
+                        choices=[0, 1, 2, 3])
+    parser.add_argument("--version", action="version",
+                        version=f"pydcop_tpu {__version__}")
+    parser.add_argument("-t", "--timeout", type=float, default=None,
+                        help="global timeout in seconds")
+    parser.add_argument("--strict_timeout", type=float, default=None,
+                        help="hard wall-clock limit (forced exit)")
+    parser.add_argument("-o", "--output", default=None,
+                        help="result output file")
+    parser.add_argument("--log", default=None,
+                        help="logging fileConfig (accepted for "
+                             "compatibility)")
+
+    subparsers = parser.add_subparsers(dest="command", required=True)
+    from pydcop_tpu.commands import (
+        agent,
+        batch,
+        consolidate,
+        distribute,
+        generate,
+        graph,
+        orchestrator,
+        replica_dist,
+        run,
+        solve,
+    )
+
+    for module in (solve, run, orchestrator, agent, distribute, graph,
+                   generate, batch, replica_dist, consolidate):
+        module.set_parser(subparsers)
+    return parser
+
+
+def _setup_logging(verbosity: int, log_conf) -> None:
+    if log_conf:
+        from logging import config as logging_config
+
+        logging_config.fileConfig(log_conf)
+        return
+    levels = {0: logging.ERROR, 1: logging.WARNING, 2: logging.INFO,
+              3: logging.DEBUG}
+    logging.basicConfig(
+        level=levels.get(verbosity, logging.ERROR),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+
+def main(argv=None) -> int:
+    parser = make_parser()
+    args = parser.parse_args(argv)
+    _setup_logging(args.verbosity, args.log)
+
+    # forced-exit watchdog: even if a solver wedges, the CLI returns
+    # (reference: dcop_cli.py:162-207)
+    hard_limit = args.strict_timeout or (
+        args.timeout + TIMEOUT_SLACK if args.timeout else None
+    )
+    if hard_limit:
+        def force_exit():
+            print('{"status": "STOPPED", "reason": "forced timeout"}',
+                  file=sys.stderr)
+            os._exit(42)
+
+        watchdog = threading.Timer(hard_limit, force_exit)
+        watchdog.daemon = True
+        watchdog.start()
+
+    try:
+        return args.func(args) or 0
+    except KeyboardInterrupt:
+        print('{"status": "STOPPED"}', file=sys.stderr)
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())

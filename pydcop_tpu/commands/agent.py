@@ -1,0 +1,76 @@
+"""`pydcop_tpu agent` — control-plane agent client.
+
+Equivalent capability to the reference's pydcop/commands/agent.py (:32-46):
+in the reference, agent processes host computations and exchange algorithm
+messages over HTTP.  In the TPU framework computations execute as batched
+device kernels on the orchestrator; agent processes participate in the
+control plane only: they register with the orchestrator, wait for the
+solve, and print the final metrics.  (--restart is accepted for CLI
+compatibility.)
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+from pydcop_tpu.commands._utils import output_metrics
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "agent", help="agent client for a standalone orchestrator"
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument("-n", "--names", nargs="+", required=True)
+    parser.add_argument("--address", default="127.0.0.1",
+                        help="accepted for compatibility")
+    parser.add_argument("-p", "--port", type=int, default=9001,
+                        help="accepted for compatibility")
+    parser.add_argument("--orchestrator", default="127.0.0.1:9000",
+                        help="orchestrator address host:port")
+    parser.add_argument("--restart", action="store_true")
+    return parser
+
+
+def _request(url: str, payload=None):
+    if payload is not None:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+    else:
+        req = url
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def run_cmd(args):
+    base = f"http://{args.orchestrator}"
+    deadline = time.time() + (args.timeout or 60)
+    # register every agent name
+    registered = False
+    while time.time() < deadline and not registered:
+        try:
+            for name in args.names:
+                _request(f"{base}/register", {"agent": name})
+            registered = True
+        except OSError:
+            time.sleep(0.5)
+    if not registered:
+        output_metrics({"status": "ERROR",
+                        "error": "orchestrator unreachable"}, args.output)
+        return 1
+    # wait for the solve to finish, then print the metrics
+    while time.time() < deadline:
+        try:
+            status = _request(f"{base}/status")["status"]
+            if status in ("FINISHED", "TIMEOUT", "STOPPED", "ERROR"):
+                metrics = _request(f"{base}/metrics")
+                output_metrics(metrics, args.output)
+                return 0
+        except OSError:
+            pass
+        time.sleep(0.5)
+    output_metrics({"status": "TIMEOUT"}, args.output)
+    return 1

@@ -1,0 +1,120 @@
+"""`pydcop_tpu batch` — YAML-driven benchmark sweeps.
+
+Equivalent capability to the reference's pydcop/commands/batch.py
+(:117-357): problem *sets* (file lists + iterations) × *batches* (a command
+template + cross-product of option values), each run as a subprocess of
+this CLI; simple resume (skip runs whose output file already exists).
+
+Batch definition format:
+
+```yaml
+sets:
+  set1:
+    path: ["instances/*.yaml"]     # glob(s)
+    iterations: 2                   # repeat each file (seed varies)
+batches:
+  maxsum_sweep:
+    command: solve                  # CLI command
+    command_options:
+      algo: [maxsum, dsa]           # cross-product of lists
+      algo_params: ["damping:0.5"]
+    global_options:
+      timeout: 5
+```
+"""
+from __future__ import annotations
+
+import glob
+import itertools
+import os
+import subprocess
+import sys
+from typing import Any, Dict, List
+
+import yaml
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser("batch", help="run benchmark sweeps")
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument("batch_file", help="batch definition YAML")
+    parser.add_argument("--simulate", action="store_true",
+                        help="print commands without running")
+    parser.add_argument("--output_dir", default="batch_output")
+    return parser
+
+
+def _option_combinations(options: Dict[str, Any]):
+    keys = list(options)
+    value_lists = [
+        v if isinstance(v, list) else [v] for v in (options[k] for k in keys)
+    ]
+    for combo in itertools.product(*value_lists):
+        yield dict(zip(keys, combo))
+
+
+def _opt_to_cli(name: str, value) -> List[str]:
+    if isinstance(value, bool):
+        return [f"--{name}"] if value else []
+    return [f"--{name}", str(value)]
+
+
+def run_cmd(args):
+    with open(args.batch_file, encoding="utf-8") as f:
+        definition = yaml.safe_load(f)
+
+    sets = definition.get("sets", {"default": {"path": []}})
+    batches = definition.get("batches", {})
+    os.makedirs(args.output_dir, exist_ok=True)
+
+    n_run, n_skipped = 0, 0
+    for set_name, set_def in sets.items():
+        paths = set_def.get("path", [])
+        if isinstance(paths, str):
+            paths = [paths]
+        files: List[str] = []
+        for p in paths:
+            files.extend(sorted(glob.glob(p)))
+        iterations = int(set_def.get("iterations", 1))
+        for batch_name, batch_def in batches.items():
+            command = batch_def.get("command", "solve")
+            for combo in _option_combinations(
+                batch_def.get("command_options", {})
+            ):
+                for it in range(iterations):
+                    for fn in files or [None]:
+                        out_name = "_".join(
+                            str(x)
+                            for x in [
+                                set_name, batch_name,
+                                os.path.basename(fn) if fn else "nofile",
+                                *(f"{k}{v}" for k, v in combo.items()),
+                                f"it{it}",
+                            ]
+                        ).replace("/", "-").replace(":", "") + ".json"
+                        out_path = os.path.join(args.output_dir, out_name)
+                        if os.path.exists(out_path):
+                            n_skipped += 1
+                            continue
+                        cmd = [sys.executable, "-m", "pydcop_tpu",
+                               "--output", out_path]
+                        for k, v in (
+                            batch_def.get("global_options") or {}
+                        ).items():
+                            cmd.extend(_opt_to_cli(k, v))
+                        cmd.append(command)
+                        for k, v in combo.items():
+                            cmd.extend(_opt_to_cli(k, v))
+                        if command == "solve":
+                            cmd.extend(_opt_to_cli("seed", it))
+                        if fn:
+                            cmd.append(fn)
+                        if args.simulate:
+                            print(" ".join(cmd))
+                            continue
+                        subprocess.run(cmd, check=False,
+                                       capture_output=True)
+                        n_run += 1
+    print(f"batch: ran {n_run}, skipped {n_skipped} "
+          f"(outputs in {args.output_dir})")
+    return 0

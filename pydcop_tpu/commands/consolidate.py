@@ -1,0 +1,60 @@
+"""`pydcop_tpu consolidate` — fold result JSON files into one CSV.
+
+Equivalent capability to the reference's pydcop/commands/consolidate.py:
+collect per-run JSON outputs (e.g. from `batch`) and emit a CSV with one
+row per run.
+"""
+from __future__ import annotations
+
+import csv
+import glob
+import json
+import os
+import sys
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "consolidate", help="fold result JSONs into a CSV"
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument("files", nargs="+",
+                        help="JSON result files or globs")
+    parser.add_argument("--csv_file", default=None,
+                        help="output CSV (default: stdout)")
+    return parser
+
+
+def run_cmd(args):
+    files = []
+    for pattern in args.files:
+        files.extend(sorted(glob.glob(pattern)))
+    rows = []
+    for fn in files:
+        try:
+            with open(fn, encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        row = {"file": os.path.basename(fn)}
+        for k, v in data.items():
+            if isinstance(v, (str, int, float, bool)) or v is None:
+                row[k] = v
+            elif k == "assignment" and isinstance(v, dict):
+                row[k] = ";".join(f"{a}={b}" for a, b in sorted(v.items()))
+        rows.append(row)
+    if not rows:
+        print("consolidate: no readable results", file=sys.stderr)
+        return 1
+    columns = ["file"] + sorted({k for r in rows for k in r} - {"file"})
+    out = open(args.csv_file, "w", newline="", encoding="utf-8") \
+        if args.csv_file else sys.stdout
+    try:
+        w = csv.DictWriter(out, fieldnames=columns)
+        w.writeheader()
+        for r in rows:
+            w.writerow(r)
+    finally:
+        if args.csv_file:
+            out.close()
+    return 0

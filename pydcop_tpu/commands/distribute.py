@@ -1,0 +1,62 @@
+"""`pydcop_tpu distribute` — compute and save a distribution.
+
+Equivalent capability to the reference's pydcop/commands/distribute.py:
+build the computation graph, run a placement strategy, output the
+distribution + its cost as JSON/YAML.
+"""
+from __future__ import annotations
+
+from pydcop_tpu.commands._utils import output_metrics
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser("distribute",
+                                   help="compute a distribution")
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument("dcop_files", nargs="+")
+    parser.add_argument("-d", "--distribution", required=True,
+                        help="distribution strategy name")
+    parser.add_argument(
+        "-g", "--graph", default=None,
+        help="graph model (default: from --algo)",
+    )
+    parser.add_argument("-a", "--algo", default=None,
+                        help="algorithm (for cost callbacks + graph model)")
+    return parser
+
+
+def run_cmd(args):
+    from pydcop_tpu.dcop import load_dcop_from_file
+    from pydcop_tpu.distribution import load_distribution_module
+    from pydcop_tpu.graph import load_graph_module
+
+    dcop = load_dcop_from_file(args.dcop_files)
+
+    algo_module = None
+    if args.algo:
+        from pydcop_tpu.algorithms import load_algorithm_module
+
+        algo_module = load_algorithm_module(args.algo)
+    graph_type = args.graph or (
+        algo_module.GRAPH_TYPE if algo_module else "constraints_hypergraph"
+    )
+    cg = load_graph_module(graph_type).build_computation_graph(dcop)
+
+    dist_module = load_distribution_module(args.distribution)
+    mem = algo_module.computation_memory if algo_module else None
+    load = algo_module.communication_load if algo_module else None
+    dist = dist_module.distribute(
+        cg, dcop.agents.values(), hints=dcop.dist_hints,
+        computation_memory=mem, communication_load=load,
+    )
+    result = {"distribution": dist.mapping(), "status": "OK"}
+    if hasattr(dist_module, "distribution_cost"):
+        try:
+            result["cost"] = dist_module.distribution_cost(
+                dist, cg, dcop.agents.values(),
+                computation_memory=mem, communication_load=load,
+            )
+        except Exception:
+            result["cost"] = None
+    output_metrics(result, args.output)
+    return 0

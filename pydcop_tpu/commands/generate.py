@@ -1,0 +1,220 @@
+"""`pydcop_tpu generate` — problem generators.
+
+Equivalent capability to the reference's pydcop/commands/generate.py +
+generators/* (`pydcop generate {graphcoloring, ising, secp,
+meetingscheduling, iot, smallworld, agents, scenario}`).  Output is the
+problem YAML on stdout or --output.
+"""
+from __future__ import annotations
+
+import sys
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser("generate", help="generate problems")
+    gen_sub = parser.add_subparsers(dest="generator", required=True)
+
+    p = gen_sub.add_parser("graphcoloring")
+    p.set_defaults(func=_graphcoloring)
+    p.add_argument("--variables_count", "-V", type=int, required=True)
+    p.add_argument("--colors_count", "-C", type=int, default=3)
+    p.add_argument("--graph", choices=["random", "scalefree", "grid"],
+                   default="random")
+    p.add_argument("--p_edge", type=float, default=None)
+    p.add_argument("--edges_count", type=int, default=None)
+    p.add_argument("--soft", action="store_true")
+    p.add_argument("--noise", type=float, default=0.02)
+    p.add_argument("--agents_count", type=int, default=None)
+    p.add_argument("--capacity", type=float, default=100)
+    p.add_argument("--seed", type=int, default=0)
+
+    p = gen_sub.add_parser("ising")
+    p.set_defaults(func=_ising)
+    p.add_argument("--row_count", type=int, required=True)
+    p.add_argument("--col_count", type=int, default=None)
+    p.add_argument("--bin_range", type=float, default=1.6)
+    p.add_argument("--un_range", type=float, default=0.05)
+    p.add_argument("--seed", type=int, default=0)
+
+    p = gen_sub.add_parser("secp")
+    p.set_defaults(func=_secp)
+    p.add_argument("--lights", type=int, default=9)
+    p.add_argument("--models", type=int, default=3)
+    p.add_argument("--rules", type=int, default=2)
+    p.add_argument("--max_model_size", type=int, default=4)
+    p.add_argument("--light_levels", type=int, default=5)
+    p.add_argument("--seed", type=int, default=0)
+
+    p = gen_sub.add_parser("meetingscheduling")
+    p.set_defaults(func=_meetings)
+    p.add_argument("--agents_count", type=int, default=4)
+    p.add_argument("--meetings_count", type=int, default=3)
+    p.add_argument("--slots_count", type=int, default=8)
+    p.add_argument("--participants_count", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+
+    p = gen_sub.add_parser("iot")
+    p.set_defaults(func=_iot)
+    p.add_argument("--num_device", "-n", type=int, default=10)
+    p.add_argument("--domain_size", type=int, default=3)
+    p.add_argument("--seed", type=int, default=0)
+
+    p = gen_sub.add_parser("smallworld")
+    p.set_defaults(func=_smallworld)
+    p.add_argument("--variables_count", "-V", type=int, default=20)
+    p.add_argument("--k_neighbors", type=int, default=4)
+    p.add_argument("--rewire_p", type=float, default=0.1)
+    p.add_argument("--colors_count", type=int, default=3)
+    p.add_argument("--seed", type=int, default=0)
+
+    p = gen_sub.add_parser("agents")
+    p.set_defaults(func=_agents)
+    p.add_argument("--count", type=int, required=True)
+    p.add_argument("--capacity", type=float, default=100)
+    p.add_argument("--hosting_default", type=float, default=0)
+    p.add_argument("--routes_default", type=float, default=1)
+    p.add_argument("--seed", type=int, default=0)
+
+    p = gen_sub.add_parser("scenario")
+    p.set_defaults(func=_scenario)
+    p.add_argument("--evts_count", type=int, default=3)
+    p.add_argument("--actions_count", type=int, default=1)
+    p.add_argument("--delay", type=float, default=10)
+    p.add_argument("--dcop_files", nargs="*", default=None,
+                   help="take agent names from this DCOP")
+    p.add_argument("--agents_count", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
+
+    return parser
+
+
+def _write(args, text: str):
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+def _graphcoloring(args):
+    from pydcop_tpu.dcop import dcop_yaml
+    from pydcop_tpu.generators import generate_graph_coloring
+
+    dcop = generate_graph_coloring(
+        n_variables=args.variables_count,
+        n_colors=args.colors_count,
+        graph_type=args.graph,
+        p_edge=args.p_edge,
+        n_edges=args.edges_count,
+        soft=args.soft,
+        noise_level=args.noise,
+        n_agents=args.agents_count,
+        capacity=args.capacity,
+        seed=args.seed,
+    )
+    return _write(args, dcop_yaml(dcop))
+
+
+def _ising(args):
+    from pydcop_tpu.dcop import dcop_yaml
+    from pydcop_tpu.generators import generate_ising
+
+    dcop = generate_ising(
+        rows=args.row_count,
+        cols=args.col_count or args.row_count,
+        bin_range=args.bin_range,
+        un_range=args.un_range,
+        seed=args.seed,
+    )
+    return _write(args, dcop_yaml(dcop))
+
+
+def _secp(args):
+    from pydcop_tpu.dcop import dcop_yaml
+    from pydcop_tpu.generators import generate_secp
+
+    dcop = generate_secp(
+        n_lights=args.lights,
+        n_models=args.models,
+        n_rules=args.rules,
+        max_model_size=args.max_model_size,
+        light_levels=args.light_levels,
+        seed=args.seed,
+    )
+    return _write(args, dcop_yaml(dcop))
+
+
+def _meetings(args):
+    from pydcop_tpu.dcop import dcop_yaml
+    from pydcop_tpu.generators import generate_meeting_scheduling
+
+    dcop = generate_meeting_scheduling(
+        n_agents=args.agents_count,
+        n_meetings=args.meetings_count,
+        n_slots=args.slots_count,
+        participants_per_meeting=args.participants_count,
+        seed=args.seed,
+    )
+    return _write(args, dcop_yaml(dcop))
+
+
+def _iot(args):
+    from pydcop_tpu.dcop import dcop_yaml
+    from pydcop_tpu.generators import generate_iot
+
+    dcop = generate_iot(
+        n_devices=args.num_device, n_states=args.domain_size, seed=args.seed
+    )
+    return _write(args, dcop_yaml(dcop))
+
+
+def _smallworld(args):
+    from pydcop_tpu.dcop import dcop_yaml
+    from pydcop_tpu.generators import generate_smallworld
+
+    dcop = generate_smallworld(
+        n_variables=args.variables_count,
+        k_neighbors=args.k_neighbors,
+        rewire_p=args.rewire_p,
+        n_colors=args.colors_count,
+        seed=args.seed,
+    )
+    return _write(args, dcop_yaml(dcop))
+
+
+def _agents(args):
+    from pydcop_tpu.dcop import yaml_agents
+    from pydcop_tpu.generators import generate_agents
+
+    agents = generate_agents(
+        args.count,
+        capacity=args.capacity,
+        hosting_default=args.hosting_default,
+        routes_default=args.routes_default,
+        seed=args.seed,
+    )
+    return _write(args, yaml_agents(agents))
+
+
+def _scenario(args):
+    from pydcop_tpu.dcop import yaml_scenario
+    from pydcop_tpu.generators import generate_scenario
+
+    if args.dcop_files:
+        from pydcop_tpu.dcop import load_dcop_from_file
+
+        dcop = load_dcop_from_file(args.dcop_files)
+        agent_names = list(dcop.agents)
+    elif args.agents_count:
+        agent_names = [f"a{i:04d}" for i in range(args.agents_count)]
+    else:
+        raise SystemExit("scenario: need --dcop_files or --agents_count")
+    scenario = generate_scenario(
+        agent_names,
+        n_events=args.evts_count,
+        removals_per_event=args.actions_count,
+        delay=args.delay,
+        seed=args.seed,
+    )
+    return _write(args, yaml_scenario(scenario))

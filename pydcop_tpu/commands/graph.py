@@ -1,0 +1,46 @@
+"""`pydcop_tpu graph` — computation-graph metrics.
+
+Equivalent capability to the reference's pydcop/commands/graph.py: node and
+edge counts, density, per-node degree stats for a DCOP under a given graph
+model.
+"""
+from __future__ import annotations
+
+from pydcop_tpu.commands._utils import output_metrics
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser("graph", help="computation graph metrics")
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument("dcop_files", nargs="+")
+    parser.add_argument(
+        "-g", "--graph",
+        choices=["factor_graph", "constraints_hypergraph", "pseudotree",
+                 "ordered_graph"],
+        required=True,
+    )
+    parser.add_argument("--display", action="store_true",
+                        help="accepted for compatibility (no GUI backend)")
+    return parser
+
+
+def run_cmd(args):
+    from pydcop_tpu.dcop import load_dcop_from_file
+    from pydcop_tpu.graph import load_graph_module
+
+    dcop = load_dcop_from_file(args.dcop_files)
+    module = load_graph_module(args.graph)
+    cg = module.build_computation_graph(dcop)
+    degrees = [len(n.neighbors) for n in cg.nodes]
+    metrics = {
+        "graph": args.graph,
+        "nodes_count": cg.node_count(),
+        "edges_count": cg.link_count(),
+        "density": cg.density(),
+        "max_degree": max(degrees, default=0),
+        "min_degree": min(degrees, default=0),
+        "avg_degree": (sum(degrees) / len(degrees)) if degrees else 0,
+        "status": "OK",
+    }
+    output_metrics(metrics, args.output)
+    return 0

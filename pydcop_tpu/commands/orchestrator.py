@@ -1,0 +1,117 @@
+"""`pydcop_tpu orchestrator` — standalone orchestrator with an HTTP control
+plane.
+
+Equivalent capability to the reference's pydcop/commands/orchestrator.py
+(:618 LoC HTTP orchestrator server): in the TPU framework all computations
+execute on the orchestrator's device(s) — agents connect only as
+*control-plane participants* (register, observe results).  This command
+solves the DCOP and serves status/results over HTTP so `pydcop_tpu agent`
+processes (or anything else) can poll them.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from pydcop_tpu.commands._utils import output_metrics, parse_algo_params
+
+_STATE = {"status": "INITIAL", "metrics": {}, "agents": []}
+_LOCK = threading.Lock()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _json(self, payload, code=200):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        with _LOCK:
+            if self.path == "/status":
+                self._json({"status": _STATE["status"],
+                            "agents": _STATE["agents"]})
+            elif self.path == "/metrics":
+                self._json(_STATE["metrics"])
+            else:
+                self._json({"error": "unknown endpoint"}, 404)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        data = json.loads(self.rfile.read(length) or b"{}")
+        with _LOCK:
+            if self.path == "/register":
+                name = data.get("agent")
+                if name and name not in _STATE["agents"]:
+                    _STATE["agents"].append(name)
+                self._json({"registered": name})
+            else:
+                self._json({"error": "unknown endpoint"}, 404)
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "orchestrator", help="standalone orchestrator (HTTP control plane)"
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument("dcop_files", nargs="+")
+    parser.add_argument("-a", "--algo", required=True)
+    parser.add_argument("-p", "--algo_params", action="append")
+    parser.add_argument("-d", "--distribution", default="oneagent")
+    parser.add_argument("--port", type=int, default=9000)
+    parser.add_argument("--address", default="127.0.0.1")
+    parser.add_argument("--expected_agents", type=int, default=0,
+                        help="wait for this many registrations before "
+                             "solving")
+    parser.add_argument("--seed", type=int, default=0)
+    return parser
+
+
+def run_cmd(args):
+    import time
+
+    from pydcop_tpu.dcop import load_dcop_from_file
+    from pydcop_tpu.runtime.orchestrator import VirtualOrchestrator
+
+    dcop = load_dcop_from_file(args.dcop_files)
+    server = ThreadingHTTPServer((args.address, args.port), _Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+
+    with _LOCK:
+        _STATE["status"] = "WAITING_AGENTS" if args.expected_agents \
+            else "RUNNING"
+    deadline = time.time() + (args.timeout or 30)
+    while args.expected_agents and time.time() < deadline:
+        with _LOCK:
+            if len(_STATE["agents"]) >= args.expected_agents:
+                break
+        time.sleep(0.1)
+
+    from pydcop_tpu.algorithms import AlgorithmDef
+
+    algo_def = AlgorithmDef.build_with_default_params(
+        args.algo, parse_algo_params(args.algo_params),
+        mode=dcop.objective,
+    )
+    orch = VirtualOrchestrator(
+        dcop, algo_def, distribution=args.distribution, seed=args.seed,
+    )
+    with _LOCK:
+        _STATE["status"] = "RUNNING"
+    res = orch.run(timeout=args.timeout)
+    metrics = orch.end_metrics()
+    with _LOCK:
+        _STATE["status"] = res.status
+        _STATE["metrics"] = metrics
+    output_metrics(metrics, args.output)
+    # keep serving briefly so agents can fetch the result
+    time.sleep(1.0)
+    server.shutdown()
+    return 0

@@ -1,0 +1,51 @@
+"""`pydcop_tpu replica_dist` — compute a replica placement offline.
+
+Equivalent capability to the reference's pydcop/commands/replica_dist.py:
+given a DCOP, an algorithm and a distribution, place k replicas of every
+computation and print the mapping.
+"""
+from __future__ import annotations
+
+from pydcop_tpu.commands._utils import output_metrics
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "replica_dist", help="compute replica placement"
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument("dcop_files", nargs="+")
+    parser.add_argument("-a", "--algo", required=True)
+    parser.add_argument("-d", "--distribution", default="oneagent")
+    parser.add_argument("-k", "--ktarget", type=int, required=True)
+    return parser
+
+
+def run_cmd(args):
+    from pydcop_tpu.algorithms import load_algorithm_module
+    from pydcop_tpu.dcop import load_dcop_from_file
+    from pydcop_tpu.distribution import load_distribution_module
+    from pydcop_tpu.graph import load_graph_module
+    from pydcop_tpu.replication import place_replicas
+
+    dcop = load_dcop_from_file(args.dcop_files)
+    algo_module = load_algorithm_module(args.algo)
+    cg = load_graph_module(algo_module.GRAPH_TYPE).build_computation_graph(
+        dcop
+    )
+    dist = load_distribution_module(args.distribution).distribute(
+        cg, dcop.agents.values(), hints=dcop.dist_hints,
+        computation_memory=algo_module.computation_memory,
+        communication_load=algo_module.communication_load,
+    )
+    replicas = place_replicas(
+        [n.name for n in cg.nodes], dist, dcop.agents.values(),
+        args.ktarget,
+        computation_memory=lambda c: algo_module.computation_memory(
+            cg.computation(c)
+        ),
+    )
+    output_metrics(
+        {"replica_dist": replicas.mapping(), "status": "OK"}, args.output
+    )
+    return 0

@@ -1,0 +1,102 @@
+"""`pydcop_tpu solve` — single-machine solve of a static DCOP.
+
+Equivalent capability to the reference's pydcop/commands/solve.py
+(run_cmd :442-560, options doc :123-177): load YAML → build graph →
+distribute → run → print the metrics JSON.  The reference's --mode
+thread/process selects the actor runtime; here both modes run the tensor
+path (one process IS the whole agent population), the flag is accepted for
+CLI compatibility.
+"""
+from __future__ import annotations
+
+import sys
+
+from pydcop_tpu.commands._utils import (
+    add_csvline,
+    output_metrics,
+    parse_algo_params,
+)
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "solve", help="solve a static DCOP"
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument("dcop_files", nargs="+", help="DCOP YAML file(s)")
+    parser.add_argument("-a", "--algo", required=True,
+                        help="algorithm name")
+    parser.add_argument(
+        "-p", "--algo_params", action="append",
+        help="algorithm parameter as name:value, repeatable",
+    )
+    parser.add_argument(
+        "-d", "--distribution", default=None,
+        help="distribution strategy (or a distribution YAML file); the "
+        "tensor runtime does not need a placement to solve, so it is only "
+        "computed/validated when requested (the reference defaults to "
+        "oneagent, which requires one agent per computation)",
+    )
+    parser.add_argument("-m", "--mode", choices=["thread", "process"],
+                        default="thread", help="accepted for compatibility")
+    parser.add_argument("-c", "--collect_on",
+                        choices=["value_change", "cycle_change", "period"],
+                        default="value_change")
+    parser.add_argument("--period", type=float, default=None)
+    parser.add_argument("--run_metrics", default=None,
+                        help="CSV file for run metrics")
+    parser.add_argument("--end_metrics", default=None,
+                        help="CSV file for end metrics")
+    parser.add_argument("--delay", type=float, default=None,
+                        help="accepted for compatibility")
+    parser.add_argument("--uiport", type=int, default=None,
+                        help="accepted for compatibility")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--cycles", type=int, default=None,
+                        help="run exactly this many cycles")
+    return parser
+
+
+def run_cmd(args):
+    from pydcop_tpu.dcop import load_dcop_from_file
+    from pydcop_tpu.runtime import solve_result
+
+    dcop = load_dcop_from_file(args.dcop_files)
+    algo_params = parse_algo_params(args.algo_params)
+
+    distribution = args.distribution
+    if distribution and (distribution.endswith(".yaml") or
+                         distribution.endswith(".yml")):
+        # a pre-computed distribution file: load to validate, then run
+        from pydcop_tpu.distribution.yamlformat import load_dist_from_file
+
+        load_dist_from_file(distribution)
+        distribution = None
+
+    try:
+        res = solve_result(
+            dcop,
+            args.algo,
+            distribution=distribution,
+            timeout=args.timeout,
+            cycles=args.cycles,
+            algo_params=algo_params,
+            seed=args.seed,
+            collect_cycles=args.run_metrics is not None
+            or args.collect_on == "cycle_change",
+        )
+    except Exception as e:
+        output_metrics({"status": "ERROR", "error": str(e)}, args.output)
+        return 1
+
+    metrics = res.metrics()
+    if args.run_metrics and res.history:
+        for h in res.history:
+            add_csvline(
+                args.run_metrics, args.collect_on,
+                {**metrics, **h, "status": "RUNNING"},
+            )
+    if args.end_metrics:
+        add_csvline(args.end_metrics, args.collect_on, metrics)
+    output_metrics(metrics, args.output)
+    return 0 if res.status in ("FINISHED", "TIMEOUT") else 1

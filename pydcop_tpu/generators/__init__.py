@@ -7,5 +7,20 @@ benchmarks can use them.
 """
 from pydcop_tpu.generators.graphcoloring import generate_graph_coloring
 from pydcop_tpu.generators.ising import generate_ising
+from pydcop_tpu.generators.secp import generate_secp
+from pydcop_tpu.generators.meetingscheduling import generate_meeting_scheduling
+from pydcop_tpu.generators.smallworld import generate_smallworld
+from pydcop_tpu.generators.iot import generate_iot
+from pydcop_tpu.generators.agents_gen import generate_agents
+from pydcop_tpu.generators.scenario_gen import generate_scenario
 
-__all__ = ["generate_graph_coloring", "generate_ising"]
+__all__ = [
+    "generate_graph_coloring",
+    "generate_ising",
+    "generate_secp",
+    "generate_meeting_scheduling",
+    "generate_smallworld",
+    "generate_iot",
+    "generate_agents",
+    "generate_scenario",
+]

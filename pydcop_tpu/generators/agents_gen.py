@@ -1,0 +1,42 @@
+"""Agents generator: agent definitions with capacities, hosting costs and
+routes.
+
+Equivalent capability to the reference's `pydcop generate agents`
+(pydcop/commands/generators — agents with hosting/route costs).
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional
+
+from pydcop_tpu.dcop.objects import AgentDef
+
+
+def generate_agents(
+    n_agents: int,
+    capacity: float = 100,
+    hosting_default: float = 0,
+    routes_default: float = 1,
+    route_range: Optional[tuple] = None,
+    seed: int = 0,
+    name_prefix: str = "a",
+) -> List[AgentDef]:
+    rng = random.Random(seed)
+    names = [f"{name_prefix}{i:04d}" for i in range(n_agents)]
+    agents = []
+    for i, name in enumerate(names):
+        routes: Dict[str, float] = {}
+        if route_range is not None:
+            lo, hi = route_range
+            for other in names[i + 1:]:
+                routes[other] = rng.randint(int(lo), int(hi))
+        agents.append(
+            AgentDef(
+                name,
+                capacity=capacity,
+                default_hosting_cost=hosting_default,
+                default_route=routes_default,
+                routes=routes,
+            )
+        )
+    return agents

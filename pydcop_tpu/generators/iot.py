@@ -1,0 +1,58 @@
+"""IoT problem generator.
+
+Equivalent capability to the reference's
+pydcop/commands/generators/iot.py: a scale-free network of devices, each
+with a variable and coordination constraints, plus per-device agents with
+hosting costs favoring their own computation and route costs.
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+
+def generate_iot(
+    n_devices: int = 10,
+    n_states: int = 3,
+    seed: int = 0,
+) -> DCOP:
+    rng = random.Random(seed)
+    np_rng = np.random.default_rng(seed)
+    dcop = DCOP(f"iot_{n_devices}", "min")
+    domain = Domain("states", "state", list(range(n_states)))
+    variables = [Variable(f"d{i:03d}", domain) for i in range(n_devices)]
+    for v in variables:
+        dcop.add_variable(v)
+
+    # preferential attachment network (devices join near popular hubs)
+    edges = set()
+    repeated = [0, 1]
+    edges.add((0, 1))
+    for i in range(2, n_devices):
+        t = rng.choice(repeated)
+        edges.add((min(i, t), max(i, t)))
+        repeated.extend([i, t])
+
+    for k, (i, j) in enumerate(sorted(edges)):
+        m = np_rng.uniform(0, 2, (n_states, n_states)).astype(np.float32)
+        dcop.add_constraint(
+            NAryMatrixRelation([variables[i], variables[j]], m, f"c{k:04d}")
+        )
+
+    agents = []
+    for i in range(n_devices):
+        hosting = {f"d{j:03d}": (0 if j == i else 5)
+                   for j in range(n_devices)}
+        routes = {f"a{j:03d}": rng.randint(1, 5) for j in range(n_devices)
+                  if j != i}
+        agents.append(
+            AgentDef(f"a{i:03d}", capacity=10, default_hosting_cost=5,
+                     hosting_costs=hosting, routes=routes)
+        )
+    dcop.add_agents(agents)
+    return dcop

@@ -1,0 +1,79 @@
+"""Meeting-scheduling generator (PEAV model).
+
+Equivalent capability to the reference's
+pydcop/commands/generators/meetingscheduling.py: each participant holds one
+variable per meeting they attend (Private Events As Variables); equality
+constraints align the copies of a meeting across participants; hard
+constraints forbid one participant attending two meetings at the same slot;
+per-participant preferences give soft costs.
+"""
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, VariableWithCostDict
+from pydcop_tpu.dcop.relations import NAryFunctionRelation
+
+
+def generate_meeting_scheduling(
+    n_agents: int = 4,
+    n_meetings: int = 3,
+    n_slots: int = 8,
+    participants_per_meeting: int = 2,
+    seed: int = 0,
+) -> DCOP:
+    rng = random.Random(seed)
+    dcop = DCOP(f"meetings_{n_meetings}m_{n_agents}a", "min")
+    slots = Domain("slots", "time_slot", list(range(n_slots)))
+
+    # who attends what
+    attendance = {
+        m: rng.sample(range(n_agents), min(participants_per_meeting,
+                                           n_agents))
+        for m in range(n_meetings)
+    }
+
+    # PEAV: one variable per (participant, meeting)
+    peav = {}
+    for m, members in attendance.items():
+        for agt in members:
+            prefs = {
+                s: round(rng.uniform(0, 1), 2) for s in range(n_slots)
+            }
+            v = VariableWithCostDict(f"m{m}_a{agt}", slots, prefs)
+            peav[(m, agt)] = v
+            dcop.add_variable(v)
+
+    # equality constraints between copies of the same meeting
+    for m, members in attendance.items():
+        for i in range(len(members) - 1):
+            v1, v2 = peav[(m, members[i])], peav[(m, members[i + 1])]
+            dcop.add_constraint(
+                NAryFunctionRelation(
+                    lambda a, b: 0 if a == b else 10000,
+                    [v1, v2],
+                    f"eq_m{m}_{members[i]}_{members[i+1]}",
+                )
+            )
+
+    # no-overlap: same participant cannot attend two meetings at one slot
+    for agt in range(n_agents):
+        my_meetings = [m for m, mem in attendance.items() if agt in mem]
+        for i in range(len(my_meetings)):
+            for j in range(i + 1, len(my_meetings)):
+                v1 = peav[(my_meetings[i], agt)]
+                v2 = peav[(my_meetings[j], agt)]
+                dcop.add_constraint(
+                    NAryFunctionRelation(
+                        lambda a, b: 10000 if a == b else 0,
+                        [v1, v2],
+                        f"noov_a{agt}_m{my_meetings[i]}_m{my_meetings[j]}",
+                    )
+                )
+
+    dcop.add_agents(
+        [AgentDef(f"a{i}", capacity=100) for i in range(n_agents)]
+    )
+    return dcop

@@ -1,0 +1,42 @@
+"""Scenario generator: random agent-failure event streams for dynamic DCOPs.
+
+Equivalent capability to the reference's
+pydcop/commands/generators/scenario.py (:132-176): k events, each removing
+some random live agents, separated by delays.
+"""
+from __future__ import annotations
+
+import random
+from typing import Iterable, List
+
+from pydcop_tpu.dcop.scenario import DcopEvent, EventAction, Scenario
+
+
+def generate_scenario(
+    agent_names: Iterable[str],
+    n_events: int = 3,
+    removals_per_event: int = 1,
+    delay: float = 10,
+    seed: int = 0,
+    protected: Iterable[str] = (),
+) -> Scenario:
+    rng = random.Random(seed)
+    alive: List[str] = [a for a in agent_names if a not in set(protected)]
+    events: List[DcopEvent] = []
+    for e in range(n_events):
+        events.append(DcopEvent(f"delay_{e}", delay=delay))
+        k = min(removals_per_event, max(0, len(alive) - 1))
+        if k == 0:
+            break
+        removed = rng.sample(alive, k)
+        for a in removed:
+            alive.remove(a)
+        events.append(
+            DcopEvent(
+                f"e{e}",
+                actions=[
+                    EventAction("remove_agent", agent=a) for a in removed
+                ],
+            )
+        )
+    return Scenario(events)

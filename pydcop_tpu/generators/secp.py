@@ -1,0 +1,73 @@
+"""SECP generator — Smart Environment Configuration Problems (smart
+lighting).
+
+Equivalent capability to the reference's pydcop/commands/generators/secp*
+(`pydcop generate secp`): lights with per-level energy costs, physical
+models computing scene illuminance from subsets of lights, and target rules
+penalizing deviation from desired illuminance.
+"""
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, VariableWithCostFunc
+from pydcop_tpu.dcop.relations import NAryFunctionRelation
+from pydcop_tpu.utils.expressions import ExpressionFunction
+
+
+def generate_secp(
+    n_lights: int = 9,
+    n_models: int = 3,
+    n_rules: int = 2,
+    light_levels: int = 5,
+    max_model_size: int = 4,
+    seed: int = 0,
+    n_agents: Optional[int] = None,
+) -> DCOP:
+    rng = random.Random(seed)
+    dcop = DCOP(f"secp_{n_lights}l_{n_models}m", "min")
+    domain = Domain("light_levels", "luminosity", list(range(light_levels)))
+
+    lights = []
+    for i in range(n_lights):
+        name = f"l{i}"
+        # energy cost proportional to level, per-light efficiency
+        eff = round(rng.uniform(0.5, 1.5), 2)
+        v = VariableWithCostFunc(
+            name, domain, ExpressionFunction(f"{eff} * {name}")
+        )
+        lights.append(v)
+        dcop.add_variable(v)
+
+    # physical models: illuminance of a scene = mean of its lights
+    model_scopes = []
+    for m in range(n_models):
+        size = rng.randint(2, min(max_model_size, n_lights))
+        scope = rng.sample(lights, size)
+        model_scopes.append(scope)
+
+    # target rules: |mean(scope) - target| over a model's scope
+    for r in range(n_rules):
+        scope = model_scopes[r % n_models]
+        target = rng.randint(0, light_levels - 1)
+        names = [v.name for v in scope]
+
+        def rule_fn(*values, _target=target, _n=len(names)):
+            return abs(sum(values) / _n - _target) * 10
+
+        dcop.add_constraint(
+            NAryFunctionRelation(rule_fn, scope, f"rule_{r}")
+        )
+
+    n_agents = n_agents if n_agents is not None else n_lights
+    agents = []
+    for i in range(n_agents):
+        hosting = {f"l{j}": 0 if j == i else 10 for j in range(n_lights)}
+        agents.append(
+            AgentDef(f"a{i}", capacity=100,
+                     default_hosting_cost=10, hosting_costs=hosting)
+        )
+    dcop.add_agents(agents)
+    return dcop

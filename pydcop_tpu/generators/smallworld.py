@@ -1,0 +1,59 @@
+"""Small-world coloring generator (Watts–Strogatz topology).
+
+Equivalent capability to the reference's
+pydcop/commands/generators/smallworld.py: a ring lattice with random
+rewiring, soft coloring costs.
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+
+def generate_smallworld(
+    n_variables: int = 20,
+    k_neighbors: int = 4,
+    rewire_p: float = 0.1,
+    n_colors: int = 3,
+    seed: int = 0,
+) -> DCOP:
+    rng = random.Random(seed)
+    np_rng = np.random.default_rng(seed)
+    dcop = DCOP(f"smallworld_{n_variables}", "min")
+    domain = Domain("colors", "color", list(range(n_colors)))
+    variables = [Variable(f"v{i:04d}", domain) for i in range(n_variables)]
+    for v in variables:
+        dcop.add_variable(v)
+
+    # Watts–Strogatz: ring of k nearest neighbors, then rewire
+    edges = set()
+    for i in range(n_variables):
+        for d in range(1, k_neighbors // 2 + 1):
+            j = (i + d) % n_variables
+            edges.add((min(i, j), max(i, j)))
+    rewired = set()
+    for (i, j) in sorted(edges):
+        if rng.random() < rewire_p:
+            new_j = rng.randrange(n_variables)
+            if new_j != i and (min(i, new_j), max(i, new_j)) not in edges:
+                rewired.add((min(i, new_j), max(i, new_j)))
+            else:
+                rewired.add((i, j))
+        else:
+            rewired.add((i, j))
+
+    for k, (i, j) in enumerate(sorted(rewired)):
+        m = np_rng.uniform(0, 1, (n_colors, n_colors)).astype(np.float32)
+        m += np.eye(n_colors, dtype=np.float32) * 5
+        dcop.add_constraint(
+            NAryMatrixRelation([variables[i], variables[j]], m, f"c{k:05d}")
+        )
+    dcop.add_agents(
+        [AgentDef(f"a{i:04d}", capacity=100) for i in range(n_variables)]
+    )
+    return dcop

@@ -1,0 +1,117 @@
+"""Replica placement for k-resiliency.
+
+Equivalent capability to the reference's
+pydcop/replication/dist_ucs_hostingcosts.py (:52-74,
+build_replication_computation): place k replicas of every active
+computation on distinct other agents, minimizing route-distance + hosting
+cost, under agent capacities.
+
+The reference runs a distributed uniform-cost search among agents; the
+placement objective is identical here but solved centrally: shortest route
+distances via Dijkstra over the agents' route graph (the UCS cost), then
+per-computation greedy assignment of the k cheapest feasible agents.
+Determinism: ties break on agent name.
+"""
+from __future__ import annotations
+
+import heapq
+from typing import Callable, Dict, Iterable, List, Optional
+
+from pydcop_tpu.dcop.objects import AgentDef
+from pydcop_tpu.distribution.objects import Distribution
+
+
+class ReplicaDistribution:
+    """computation → list of replica-holder agents."""
+
+    def __init__(self, mapping: Dict[str, List[str]]):
+        self._mapping = {c: list(agents) for c, agents in mapping.items()}
+
+    def replicas(self, computation: str) -> List[str]:
+        return list(self._mapping.get(computation, []))
+
+    def mapping(self) -> Dict[str, List[str]]:
+        return {c: list(a) for c, a in self._mapping.items()}
+
+    def agents_holding(self, agent: str) -> List[str]:
+        return [c for c, agents in self._mapping.items() if agent in agents]
+
+    def __repr__(self):
+        return f"ReplicaDistribution({self._mapping})"
+
+
+def route_distances(agents: List[AgentDef]) -> Dict[str, Dict[str, float]]:
+    """All-pairs shortest route costs (Dijkstra per agent) — the UCS metric
+    of the reference (replication/path_utils.py cheapest_path_to)."""
+    names = [a.name for a in agents]
+    by_name = {a.name: a for a in agents}
+    dist: Dict[str, Dict[str, float]] = {}
+    for src in names:
+        d = {src: 0.0}
+        heap = [(0.0, src)]
+        while heap:
+            cost, cur = heapq.heappop(heap)
+            if cost > d.get(cur, float("inf")):
+                continue
+            for other in names:
+                if other == cur:
+                    continue
+                step = by_name[cur].route(other)
+                nd = cost + step
+                if nd < d.get(other, float("inf")):
+                    d[other] = nd
+                    heapq.heappush(heap, (nd, other))
+        dist[src] = d
+    return dist
+
+
+def place_replicas(
+    computations: Iterable[str],
+    distribution: Distribution,
+    agents: Iterable[AgentDef],
+    k: int,
+    computation_memory: Optional[Callable[[str], float]] = None,
+    hosting_weight: float = 1.0,
+    route_weight: float = 1.0,
+) -> ReplicaDistribution:
+    """Place k replicas of each computation on distinct agents ≠ its host,
+    minimizing route(host→candidate) + hosting cost, respecting remaining
+    capacities."""
+    agents = list(agents)
+    by_name = {a.name: a for a in agents}
+    dists = route_distances(agents)
+    mem = computation_memory or (lambda c: 0.0)
+
+    remaining = {}
+    for a in agents:
+        used = sum(
+            mem(c) for c in distribution.computations_hosted(a.name)
+        ) if distribution else 0.0
+        cap = a.capacity if a.capacity is not None else float("inf")
+        remaining[a.name] = cap - used
+
+    mapping: Dict[str, List[str]] = {}
+    for comp in sorted(computations):
+        try:
+            host = distribution.agent_for(comp)
+        except KeyError:
+            host = None
+        candidates = []
+        for a in agents:
+            if a.name == host:
+                continue
+            route = dists.get(host, {}).get(a.name, a.route(host or a.name)) \
+                if host else 0.0
+            cost = route_weight * route + \
+                hosting_weight * a.hosting_cost(comp)
+            candidates.append((cost, a.name))
+        candidates.sort()
+        chosen: List[str] = []
+        for cost, name in candidates:
+            if len(chosen) >= k:
+                break
+            if remaining[name] >= mem(comp):
+                chosen.append(name)
+                remaining[name] -= mem(comp)
+        mapping[comp] = chosen
+    return ReplicaDistribution(mapping)

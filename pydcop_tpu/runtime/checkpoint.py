@@ -1,0 +1,58 @@
+"""Checkpoint / resume of solver state.
+
+The reference has no checkpointing (resilience is replication-based,
+SURVEY.md §5); for a dense tensor solver a checkpoint is just the state
+pytree, so we add it: save/restore the solver's device state + metadata to
+a single .npz file.  Used by the orchestrator for resilience and by
+long-running batch solves.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def save_checkpoint(path: str, solver, extra: Optional[Dict] = None) -> None:
+    """Persist a solver's last run state (host-transferred) + metadata."""
+    state = getattr(solver, "_last_state", None)
+    if state is None:
+        raise ValueError("Solver has no state yet — run() it first")
+    leaves, treedef = jax.tree.flatten(state)
+    meta = {
+        "algo": solver.algo_def.algo,
+        "params": solver.algo_def.params,
+        "seed": solver.seed,
+        "n_leaves": len(leaves),
+        "extra": extra or {},
+    }
+    arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
+    np.savez(path, __meta__=json.dumps(meta), **arrays)
+
+
+def load_checkpoint(path: str, solver) -> Dict[str, Any]:
+    """Restore a solver's state; returns the checkpoint metadata.
+
+    The solver must have been built for the same problem (leaf shapes are
+    validated against a freshly initialized state).
+    """
+    with np.load(path, allow_pickle=False) as data:
+        meta = json.loads(str(data["__meta__"]))
+        leaves = [data[f"leaf_{i}"] for i in range(meta["n_leaves"])]
+    ref_state = solver.initial_state()
+    ref_leaves, treedef = jax.tree.flatten(ref_state)
+    if len(ref_leaves) != len(leaves):
+        raise ValueError(
+            f"Checkpoint has {len(leaves)} state leaves, solver expects "
+            f"{len(ref_leaves)}"
+        )
+    for got, want in zip(leaves, ref_leaves):
+        if np.shape(got) != np.shape(want):
+            raise ValueError(
+                f"Checkpoint leaf shape {np.shape(got)} != solver "
+                f"{np.shape(want)} — different problem?"
+            )
+    solver._last_state = jax.tree.unflatten(treedef, leaves)
+    return meta

@@ -1,0 +1,43 @@
+"""In-process event bus.
+
+Equivalent capability to the reference's pydcop/infrastructure/Events.py
+(:41-96): topic-based pub/sub with ``*`` wildcard suffix matching, disabled
+by default; topics follow the reference's naming
+(``computations.value.<name>``, ``computations.cycle.<name>``,
+``agents.add_computation.<agent>``, ...).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Tuple
+
+
+class EventDispatcher:
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self._subs: List[Tuple[str, Callable]] = []
+
+    def subscribe(self, topic: str, callback: Callable) -> None:
+        self._subs.append((topic, callback))
+
+    def unsubscribe(self, callback: Callable) -> None:
+        self._subs = [(t, cb) for t, cb in self._subs if cb != callback]
+
+    def send(self, topic: str, evt) -> None:
+        if not self.enabled:
+            return
+        for pattern, cb in list(self._subs):
+            if self._match(pattern, topic):
+                cb(topic, evt)
+
+    @staticmethod
+    def _match(pattern: str, topic: str) -> bool:
+        if pattern == topic or pattern == "*":
+            return True
+        if pattern.endswith("*"):
+            return topic.startswith(pattern[:-1])
+        return False
+
+
+#: process-global bus, disabled unless observability is turned on
+#: (reference: Events.py event_bus :103)
+event_bus = EventDispatcher()

@@ -1,0 +1,266 @@
+"""Virtual orchestrator: the host-side control plane.
+
+Equivalent capability to the reference's
+pydcop/infrastructure/orchestrator.py (Orchestrator :62, AgentsMgt :531,
+deploy :203, start_replication :223, run(scenario) :245, scenario pump
+:336, agent-removal repair handshake :943-1125) — with the actor plumbing
+removed: deploy/run/pause/stop are host control flow over one tensor
+solver, scenario events mutate the placement metadata, and the repair
+handshake becomes build-repair-DCOP → solve-with-MGM-kernel → update
+Distribution.
+
+The solver state lives on device across events (warm restart), matching
+the reference's behavior where computations keep their state when re-hosted
+from replicas.
+"""
+from __future__ import annotations
+
+from time import perf_counter
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from pydcop_tpu.algorithms import AlgorithmDef, load_algorithm_module
+from pydcop_tpu.algorithms.base import SolveResult
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.scenario import Scenario
+from pydcop_tpu.distribution import load_distribution_module
+from pydcop_tpu.distribution.objects import Distribution
+from pydcop_tpu.graph import load_graph_module
+from pydcop_tpu.replication import ReplicaDistribution, place_replicas
+from pydcop_tpu.reparation import build_repair_dcop, solve_repair_dcop
+from pydcop_tpu.runtime.events import event_bus
+
+
+class VirtualOrchestrator:
+    def __init__(
+        self,
+        dcop: DCOP,
+        algo: Union[str, AlgorithmDef],
+        distribution: Union[str, Distribution] = "oneagent",
+        graph: Optional[str] = None,
+        collect_on: str = "value_change",
+        period: Optional[float] = None,
+        collector: Optional[Callable[[float, Dict], None]] = None,
+        seed: int = 0,
+    ):
+        self.dcop = dcop
+        self.algo_def = (
+            algo
+            if isinstance(algo, AlgorithmDef)
+            else AlgorithmDef.build_with_default_params(
+                algo, mode=dcop.objective
+            )
+        )
+        self.algo_module = load_algorithm_module(self.algo_def.algo)
+        graph_type = graph or self.algo_module.GRAPH_TYPE
+        self.graph_module = load_graph_module(graph_type)
+        self.cg = self.graph_module.build_computation_graph(dcop)
+
+        if isinstance(distribution, Distribution):
+            self.distribution = distribution
+        else:
+            dist_module = load_distribution_module(distribution)
+            self.distribution = dist_module.distribute(
+                self.cg,
+                dcop.agents.values(),
+                hints=getattr(dcop, "dist_hints", None),
+                computation_memory=self.algo_module.computation_memory,
+                communication_load=self.algo_module.communication_load,
+            )
+
+        self.solver = self.algo_module.build_solver(
+            dcop, self.cg, self.algo_def, seed=seed
+        )
+        self.replicas: Optional[ReplicaDistribution] = None
+        self.seed = seed
+        self.status = "INITIAL"
+        self.collect_on = collect_on
+        self.period = period
+        self.collector = collector
+        self.run_metrics_log: List[Dict] = []
+        self.events_log: List[Dict] = []
+        self._last_result: Optional[SolveResult] = None
+        self._cycles_done = 0
+        self.start_time: Optional[float] = None
+
+    # -- lifecycle (reference: deploy/run/pause/stop broadcasts) ------------
+
+    def deploy_computations(self) -> None:
+        missing = [
+            n.name for n in self.cg.nodes
+            if not self.distribution.has_computation(n.name)
+        ]
+        if missing:
+            raise ValueError(
+                f"Distribution does not host computations: {missing}"
+            )
+        self.status = "DEPLOYED"
+        for a in self.distribution.agents:
+            for c in self.distribution.computations_hosted(a):
+                event_bus.send(f"agents.add_computation.{a}", c)
+
+    def start_replication(self, k: int) -> ReplicaDistribution:
+        """Place k replicas of every computation (reference:
+        orchestrator.py:223 → distributed UCS)."""
+        self.replicas = place_replicas(
+            [n.name for n in self.cg.nodes],
+            self.distribution,
+            self.dcop.agents.values(),
+            k,
+            computation_memory=lambda c: self.algo_module.computation_memory(
+                self.cg.computation(c)
+            ),
+        )
+        self.status = "REPLICATING" if self.status == "INITIAL" \
+            else self.status
+        return self.replicas
+
+    # -- solving ------------------------------------------------------------
+
+    def _run_phase(
+        self, cycles: Optional[int], timeout: Optional[float], resume: bool
+    ) -> SolveResult:
+        res = self.solver.run(
+            cycles=cycles,
+            timeout=timeout,
+            collect_cycles=self.collect_on == "cycle_change"
+            or self.collector is not None,
+            resume=resume,
+        )
+        self._cycles_done += res.cycle
+        self._last_result = res
+        if self.collector is not None and res.history:
+            for h in res.history:
+                m = {**res.metrics(), **h, "status": "RUNNING"}
+                self.collector(h["time"], m)
+                self.run_metrics_log.append(m)
+        event_bus.send("computations.cycle.*", self._cycles_done)
+        return res
+
+    def run(
+        self,
+        scenario: Optional[Scenario] = None,
+        timeout: Optional[float] = None,
+        cycles: Optional[int] = None,
+    ) -> SolveResult:
+        """Run to completion; with a scenario, interleave solving phases
+        with the event stream (reference: orchestrator.py:245,336)."""
+        self.start_time = perf_counter()
+        if self.status == "INITIAL":
+            self.deploy_computations()
+        self.status = "RUNNING"
+
+        if scenario is None or not len(scenario):
+            res = self._run_phase(cycles, timeout, resume=False)
+            self.status = res.status
+            return self._finalize(res)
+
+        resume = False
+        res: Optional[SolveResult] = None
+        phase_cycles = cycles or 20
+        for event in scenario:
+            if timeout is not None and \
+                    perf_counter() - self.start_time > timeout:
+                break
+            if event.is_delay:
+                # a delay = let the system run; wall-clock delays map to a
+                # bounded solving phase (device rounds are much faster than
+                # the reference's actor cycles)
+                res = self._run_phase(
+                    phase_cycles, timeout=event.delay, resume=resume
+                )
+                resume = True
+            else:
+                for action in event.actions:
+                    self._apply_action(action)
+                self.events_log.append(
+                    {"id": event.id,
+                     "actions": [a.type for a in event.actions]}
+                )
+        # final phase to (re)converge after the last event
+        res = self._run_phase(phase_cycles, timeout=None, resume=resume)
+        self.status = res.status
+        return self._finalize(res)
+
+    def _finalize(self, res: SolveResult) -> SolveResult:
+        res.cycle = self._cycles_done
+        res.time = perf_counter() - self.start_time
+        return res
+
+    # -- scenario actions ---------------------------------------------------
+
+    def _apply_action(self, action) -> None:
+        if action.type == "remove_agent":
+            self._agents_removal([action.parameters["agent"]])
+        elif action.type == "add_agent":
+            # new agents become available hosts (computations stay put until
+            # a repair needs them)
+            from pydcop_tpu.dcop.objects import AgentDef
+
+            name = action.parameters["agent"]
+            if name not in self.dcop.agents:
+                self.dcop.agents[name] = AgentDef(name)
+            self.distribution.host_on_agent(name, [])
+        elif action.type == "set_external":
+            ev = self.dcop.external_variables[
+                action.parameters["variable"]
+            ]
+            ev.value = action.parameters["value"]
+            if hasattr(self.solver, "on_external_change"):
+                self.solver.on_external_change(ev.name, ev.value)
+        else:
+            raise ValueError(f"Unknown scenario action {action.type!r}")
+
+    def _agents_removal(self, removed: List[str]) -> None:
+        """Orphaned computations are re-hosted on their replicas via a
+        repair DCOP solved with MGM (reference: orchestrator.py:943-1125 +
+        agents.py:1044-1355)."""
+        orphans: List[str] = []
+        for a in removed:
+            orphans.extend(self.distribution.remove_agent(a))
+            self.dcop.agents.pop(a, None)
+            event_bus.send(f"agents.rem_agent.{a}", a)
+        if not orphans:
+            return
+        surviving = {a.name: a for a in self.dcop.agents.values()}
+        candidates: Dict[str, List[str]] = {}
+        for c in orphans:
+            if self.replicas is not None:
+                cand = [
+                    a for a in self.replicas.replicas(c) if a in surviving
+                ]
+            else:
+                cand = []
+            # fall back to every surviving agent when no replica survives
+            candidates[c] = cand or sorted(surviving)
+        neighbors = {
+            c: list(self.cg.computation(c).neighbors) for c in orphans
+        }
+        repair, vars_by_comp = build_repair_dcop(
+            orphans,
+            candidates,
+            surviving,
+            self.distribution,
+            computation_memory=lambda c: self.algo_module.computation_memory(
+                self.cg.computation(c)
+            ),
+            communication_load=lambda c, t: self.algo_module.
+            communication_load(self.cg.computation(c), t),
+            neighbors=neighbors,
+        )
+        placement = solve_repair_dcop(repair, vars_by_comp, seed=self.seed)
+        for comp, agent in placement.items():
+            self.distribution.host_on_agent(agent, [comp])
+        self.events_log.append({"repaired": placement})
+
+    # -- metrics ------------------------------------------------------------
+
+    def end_metrics(self) -> Dict[str, Any]:
+        if self._last_result is None:
+            return {"status": self.status}
+        m = self._last_result.metrics()
+        m["status"] = self.status
+        m["distribution"] = self.distribution.mapping()
+        if self.replicas is not None:
+            m["replicas"] = self.replicas.mapping()
+        m["events"] = self.events_log
+        return m

@@ -1,0 +1,231 @@
+"""CLI subprocess tests (reference twin: tests/dcop_cli/ — spawn the real
+CLI against YAML instances and assert on the JSON output)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+TUTO = os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+CSP = os.path.join(INSTANCES, "coloring_csp.yaml")
+
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "PYTHONPATH": REPO,  # drop the axon sitecustomize, add the repo
+}
+
+
+def run_cli(*args, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu", *args],
+        capture_output=True, text=True, timeout=timeout, env=ENV, cwd=REPO,
+    )
+
+
+def json_out(proc):
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout)
+
+
+class TestSolve:
+    def test_solve_maxsum(self):
+        out = json_out(
+            run_cli("--timeout", "20", "solve", "--algo", "maxsum", TUTO)
+        )
+        assert out["assignment"] == {
+            "v1": "G", "v2": "G", "v3": "G", "v4": "G"
+        }
+        assert out["cost"] == 12
+        assert out["status"] == "FINISHED"
+
+    def test_solve_dpop(self):
+        out = json_out(run_cli("solve", "--algo", "dpop", TUTO))
+        assert out["cost"] == 12
+
+    def test_solve_with_params(self):
+        out = json_out(
+            run_cli(
+                "--timeout", "20", "solve", "--algo", "dsa",
+                "--algo_params", "variant:C",
+                "--algo_params", "probability:0.8",
+                "--cycles", "40", CSP,
+            )
+        )
+        assert out["cost"] == 0
+
+    def test_solve_unknown_algo_fails(self):
+        proc = run_cli("solve", "--algo", "nope", TUTO)
+        assert proc.returncode != 0
+
+    def test_output_file(self, tmp_path):
+        out_file = str(tmp_path / "res.json")
+        run_cli("--output", out_file, "solve", "--algo", "dpop", TUTO)
+        with open(out_file) as f:
+            assert json.load(f)["cost"] == 12
+
+
+class TestGraphDistribute:
+    def test_graph_metrics(self):
+        out = json_out(
+            run_cli("graph", "--graph", "factor_graph", TUTO)
+        )
+        assert out["nodes_count"] == 8
+        assert out["edges_count"] == 8
+
+    def test_distribute(self):
+        out = json_out(
+            run_cli("distribute", "--distribution", "adhoc",
+                    "--algo", "maxsum", TUTO)
+        )
+        hosted = [c for comps in out["distribution"].values()
+                  for c in comps]
+        assert len(hosted) == 8
+
+
+class TestGenerate:
+    def test_generate_graphcoloring(self, tmp_path):
+        out_file = str(tmp_path / "gen.yaml")
+        proc = run_cli(
+            "--output", out_file, "generate", "graphcoloring",
+            "--variables_count", "6", "--colors_count", "3",
+            "--edges_count", "8", "--soft",
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        from pydcop_tpu.dcop import load_dcop_from_file
+
+        dcop = load_dcop_from_file(out_file)
+        assert len(dcop.variables) == 6
+        assert len(dcop.constraints) == 8
+
+    def test_generate_ising(self, tmp_path):
+        out_file = str(tmp_path / "ising.yaml")
+        run_cli("--output", out_file, "generate", "ising",
+                "--row_count", "3")
+        from pydcop_tpu.dcop import load_dcop_from_file
+
+        dcop = load_dcop_from_file(out_file)
+        assert len(dcop.variables) == 9
+        assert len(dcop.constraints) == 18  # toroidal 2 per cell
+
+    @pytest.mark.parametrize(
+        "gen_args",
+        [
+            ("secp", "--lights", "4", "--models", "2", "--rules", "1"),
+            ("meetingscheduling", "--agents_count", "3",
+             "--meetings_count", "2"),
+            ("iot", "-n", "5"),
+            ("smallworld", "-V", "8"),
+        ],
+    )
+    def test_generate_others(self, tmp_path, gen_args):
+        out_file = str(tmp_path / "gen.yaml")
+        proc = run_cli("--output", out_file, "generate", *gen_args)
+        assert proc.returncode == 0, proc.stderr[-800:]
+        from pydcop_tpu.dcop import load_dcop_from_file
+
+        dcop = load_dcop_from_file(out_file)
+        assert dcop.variables
+
+    def test_generate_agents_and_scenario(self, tmp_path):
+        agents_file = str(tmp_path / "agents.yaml")
+        proc = run_cli("--output", agents_file, "generate", "agents",
+                       "--count", "5")
+        assert proc.returncode == 0, proc.stderr[-800:]
+        scen_file = str(tmp_path / "scenario.yaml")
+        proc = run_cli(
+            "--output", scen_file, "generate", "scenario",
+            "--agents_count", "5", "--evts_count", "2",
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        from pydcop_tpu.dcop import load_scenario_from_file
+
+        scenario = load_scenario_from_file(scen_file)
+        assert len(scenario) >= 2
+
+
+class TestEndToEndGenerateSolve:
+    def test_generate_then_solve(self, tmp_path):
+        gen_file = str(tmp_path / "p.yaml")
+        run_cli(
+            "--output", gen_file, "generate", "graphcoloring",
+            "--variables_count", "8", "--edges_count", "10", "--soft",
+        )
+        out = json_out(
+            run_cli("--timeout", "30", "solve", "--algo", "mgm",
+                    "--cycles", "15", gen_file)
+        )
+        assert out["status"] == "FINISHED"
+        assert len(out["assignment"]) == 8
+
+
+class TestRunScenario:
+    def test_dynamic_run_with_repair(self, tmp_path):
+        scen = tmp_path / "scen.yaml"
+        scen.write_text(
+            """
+events:
+  - id: d1
+    delay: 1
+  - id: e1
+    actions:
+      - type: remove_agent
+        agent: a2
+"""
+        )
+        out = json_out(
+            run_cli(
+                "--timeout", "40", "run", "--algo", "maxsum",
+                "--distribution", "adhoc", "--scenario", str(scen),
+                "--ktarget", "2", TUTO,
+            )
+        )
+        assert out["status"] in ("FINISHED", "TIMEOUT")
+        # a2 must be gone from the distribution; all computations re-hosted
+        assert "a2" not in out["distribution"]
+        hosted = [c for comps in out["distribution"].values()
+                  for c in comps]
+        assert sorted(hosted) == sorted(
+            ["v1", "v2", "v3", "v4", "c_1_2", "c_1_3", "c_2_3", "c_2_4"]
+        )
+
+
+class TestBatchConsolidate:
+    def test_batch_and_consolidate(self, tmp_path):
+        batch_def = tmp_path / "batch.yaml"
+        batch_def.write_text(
+            f"""
+sets:
+  s1:
+    path: ["{TUTO}"]
+    iterations: 1
+batches:
+  sweep:
+    command: solve
+    command_options:
+      algo: [dpop, syncbb]
+    global_options:
+      timeout: 20
+"""
+        )
+        out_dir = str(tmp_path / "out")
+        proc = run_cli("batch", str(batch_def), "--output_dir", out_dir,
+                       timeout=240)
+        assert proc.returncode == 0, proc.stderr[-800:]
+        import glob
+
+        results = glob.glob(os.path.join(out_dir, "*.json"))
+        assert len(results) == 2
+        csv_file = str(tmp_path / "all.csv")
+        proc = run_cli(
+            "consolidate", os.path.join(out_dir, "*.json"),
+            "--csv_file", csv_file,
+        )
+        assert proc.returncode == 0
+        with open(csv_file) as f:
+            lines = f.read().strip().splitlines()
+        assert len(lines) == 3  # header + 2 rows
+        assert "cost" in lines[0]

@@ -1,0 +1,198 @@
+"""Unit tests for runtime: orchestrator, replication, repair, checkpoint,
+events."""
+import os
+
+import pytest
+
+from pydcop_tpu.dcop import (
+    AgentDef,
+    DcopEvent,
+    EventAction,
+    Scenario,
+    load_dcop_from_file,
+)
+from pydcop_tpu.distribution.objects import Distribution
+from pydcop_tpu.replication import place_replicas, route_distances
+from pydcop_tpu.reparation import build_repair_dcop, solve_repair_dcop
+from pydcop_tpu.runtime.events import EventDispatcher
+from pydcop_tpu.runtime.orchestrator import VirtualOrchestrator
+
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+
+
+@pytest.fixture
+def tuto():
+    return load_dcop_from_file(
+        os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+    )
+
+
+class TestEvents:
+    def test_pubsub_wildcards(self):
+        bus = EventDispatcher(enabled=True)
+        got = []
+        bus.subscribe("computations.value.*", lambda t, e: got.append(e))
+        bus.send("computations.value.v1", 42)
+        bus.send("computations.cycle.v1", 1)
+        assert got == [42]
+
+    def test_disabled_by_default(self):
+        bus = EventDispatcher()
+        got = []
+        bus.subscribe("*", lambda t, e: got.append(e))
+        bus.send("x", 1)
+        assert got == []
+
+
+class TestReplication:
+    def test_route_distances_triangle_inequality(self):
+        agents = [
+            AgentDef("a1", routes={"a2": 1, "a3": 10}),
+            AgentDef("a2", routes={"a1": 1, "a3": 1}),
+            AgentDef("a3", routes={"a1": 10, "a2": 1}),
+        ]
+        d = route_distances(agents)
+        # a1→a3 direct costs 10, via a2 costs 2
+        assert d["a1"]["a3"] == 2
+
+    def test_place_replicas(self):
+        agents = [AgentDef(f"a{i}", capacity=10) for i in range(4)]
+        dist = Distribution({"a0": ["c1"], "a1": ["c2"], "a2": [], "a3": []})
+        reps = place_replicas(
+            ["c1", "c2"], dist, agents, k=2,
+            computation_memory=lambda c: 1.0,
+        )
+        for c in ("c1", "c2"):
+            r = reps.replicas(c)
+            assert len(r) == 2
+            assert dist.agent_for(c) not in r
+            assert len(set(r)) == 2
+
+    def test_replicas_respect_capacity(self):
+        agents = [AgentDef("a0", capacity=10), AgentDef("a1", capacity=1)]
+        dist = Distribution({"a0": ["c1", "c2", "c3"], "a1": []})
+        reps = place_replicas(
+            ["c1", "c2", "c3"], dist, agents, k=1,
+            computation_memory=lambda c: 1.0,
+        )
+        # a1 can hold only one replica
+        held = sum(1 for c in ("c1", "c2", "c3")
+                   if "a1" in reps.replicas(c))
+        assert held == 1
+
+
+class TestRepair:
+    def test_repair_dcop_and_solve(self):
+        agents = {
+            "a1": AgentDef("a1", capacity=10),
+            "a2": AgentDef("a2", capacity=10),
+        }
+        dist = Distribution({"a1": ["k1"], "a2": ["k2"]})
+        repair, vars_by_comp = build_repair_dcop(
+            orphaned=["o1", "o2"],
+            candidates={"o1": ["a1", "a2"], "o2": ["a1", "a2"]},
+            agents=agents,
+            distribution=dist,
+            computation_memory=lambda c: 1.0,
+        )
+        # 4 binary variables, 2 hosted constraints + 2 capacity constraints
+        assert len(repair.variables) == 4
+        placement = solve_repair_dcop(repair, vars_by_comp, seed=1)
+        assert set(placement) == {"o1", "o2"}
+        assert all(a in ("a1", "a2") for a in placement.values())
+
+    def test_repair_respects_capacity(self):
+        agents = {
+            "a1": AgentDef("a1", capacity=1),
+            "a2": AgentDef("a2", capacity=10),
+        }
+        dist = Distribution({"a1": ["k1"], "a2": []})  # a1 already full
+        repair, vars_by_comp = build_repair_dcop(
+            orphaned=["o1"],
+            candidates={"o1": ["a1", "a2"]},
+            agents=agents,
+            distribution=dist,
+            computation_memory=lambda c: 1.0,
+        )
+        placement = solve_repair_dcop(repair, vars_by_comp, seed=0)
+        assert placement["o1"] == "a2"
+
+
+class TestOrchestrator:
+    def test_static_run(self, tuto):
+        orch = VirtualOrchestrator(tuto, "maxsum", distribution="adhoc")
+        orch.deploy_computations()
+        res = orch.run(timeout=20)
+        assert res.status == "FINISHED"
+        assert res.cost == 12
+        m = orch.end_metrics()
+        assert m["distribution"]
+
+    def test_scenario_remove_agent(self, tuto):
+        orch = VirtualOrchestrator(tuto, "maxsum", distribution="adhoc")
+        orch.deploy_computations()
+        orch.start_replication(2)
+        scenario = Scenario(
+            [
+                DcopEvent("d1", delay=0.5),
+                DcopEvent(
+                    "e1",
+                    actions=[EventAction("remove_agent", agent="a1")],
+                ),
+            ]
+        )
+        res = orch.run(scenario, timeout=30)
+        assert "a1" not in orch.distribution.agents
+        # every computation is still hosted somewhere
+        hosted = sorted(orch.distribution.computations)
+        assert hosted == sorted(n.name for n in orch.cg.nodes)
+        assert res.cost == 12  # solution quality survives the repair
+
+    def test_invalid_distribution_rejected(self, tuto):
+        orch = VirtualOrchestrator(tuto, "maxsum", distribution="adhoc")
+        orch.distribution.remove_computation("v1")
+        with pytest.raises(ValueError):
+            orch.deploy_computations()
+
+
+class TestCheckpoint:
+    def test_save_load_roundtrip(self, tuto, tmp_path):
+        import numpy as np
+
+        from pydcop_tpu.algorithms import AlgorithmDef
+        from pydcop_tpu.algorithms.maxsum import build_solver
+        from pydcop_tpu.runtime.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        solver = build_solver(tuto)
+        res1 = solver.run(cycles=6)
+        path = str(tmp_path / "ck.npz")
+        save_checkpoint(path, solver, extra={"note": "test"})
+
+        solver2 = build_solver(tuto)
+        meta = load_checkpoint(path, solver2)
+        assert meta["algo"] == "maxsum"
+        assert meta["extra"]["note"] == "test"
+        # resuming from the checkpoint reproduces the same next state
+        res_a = solver.run(cycles=4, resume=True)
+        res_b = solver2.run(cycles=4, resume=True)
+        assert res_a.assignment == res_b.assignment
+
+    def test_shape_mismatch_rejected(self, tuto, tmp_path):
+        from pydcop_tpu.algorithms.maxsum import build_solver
+        from pydcop_tpu.generators import generate_graph_coloring
+        from pydcop_tpu.runtime.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        solver = build_solver(tuto)
+        solver.run(cycles=2)
+        path = str(tmp_path / "ck.npz")
+        save_checkpoint(path, solver)
+        other = generate_graph_coloring(6, 3, n_edges=5, seed=0)
+        solver_other = build_solver(other)
+        with pytest.raises(ValueError):
+            load_checkpoint(path, solver_other)
