@@ -1,0 +1,24 @@
+# Test/check targets (reference twin: pyDcop Makefile:1-21)
+
+.PHONY: test unit api cli doctest all-tests bench
+
+test: all-tests
+
+unit:
+	python -m pytest tests/unit -q
+
+api:
+	python -m pytest tests/api -q
+
+cli:
+	python -m pytest tests/cli -q
+
+doctest:
+	python -m pytest --doctest-modules pydcop_tpu -q
+
+all-tests:
+	python -m pytest tests/ -q
+	python -m pytest --doctest-modules pydcop_tpu -q
+
+bench:
+	python bench.py

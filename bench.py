@@ -60,24 +60,47 @@ def main():
     ap.add_argument("--colors", type=int, default=3)
     ap.add_argument("--cycles", type=int, default=50)
     ap.add_argument("--repeat", type=int, default=3)
+    ap.add_argument(
+        "--stretch", action="store_true",
+        help="100k-var / 300k-edge instance via the direct array compiler",
+    )
     args = ap.parse_args()
+    if args.stretch:
+        args.vars, args.edges = 100_000, 300_000
 
     import jax
     import jax.numpy as jnp
 
-    from pydcop_tpu.generators import generate_graph_coloring
     from pydcop_tpu.ops import compile_factor_graph
     from pydcop_tpu.ops.maxsum_kernels import init_messages, maxsum_cycle
 
-    dcop = generate_graph_coloring(
-        n_variables=args.vars,
-        n_colors=args.colors,
-        n_edges=args.edges,
-        soft=True,
-        n_agents=1,
-        seed=1,
-    )
-    tensors = compile_factor_graph(dcop)
+    if args.stretch:
+        from pydcop_tpu.ops.compile import compile_binary_from_arrays
+
+        rng = np.random.default_rng(1)
+        edge_i = rng.integers(0, args.vars, args.edges)
+        edge_j = (edge_i + 1 + rng.integers(
+            0, args.vars - 1, args.edges)) % args.vars
+        mats = rng.uniform(0, 1, (args.edges, args.colors, args.colors))
+        mats += np.eye(args.colors) * 10  # coloring penalty
+        tensors = compile_binary_from_arrays(
+            edge_i, edge_j, mats.astype(np.float32), args.vars,
+            unary=rng.uniform(0, 0.01, (args.vars, args.colors)).astype(
+                np.float32
+            ),
+        )
+    else:
+        from pydcop_tpu.generators import generate_graph_coloring
+
+        dcop = generate_graph_coloring(
+            n_variables=args.vars,
+            n_colors=args.colors,
+            n_edges=args.edges,
+            soft=True,
+            n_agents=1,
+            seed=1,
+        )
+        tensors = compile_factor_graph(dcop)
 
     @jax.jit
     def run_n(q, r):

@@ -1,0 +1,68 @@
+"""Per-cycle computation tracing.
+
+Equivalent capability to the reference's pydcop/infrastructure/stats.py
+(:50-105): CSV step-tracing with operation counters, including the
+non-concurrent operation count (`nc_op_count`, the literature's logical-time
+metric).  For tensor solvers op counts come from kernel shapes: one cycle's
+`op_count` is the total number of cost-table entries touched, and
+`nc_op_count` is the critical-path share (one variable's worth), since all
+per-variable updates of a cycle are concurrent on device.
+"""
+from __future__ import annotations
+
+import csv
+from typing import List, Optional
+
+#: matches the reference's column set (stats.py:50-66)
+COLUMNS = ["timestamp", "computation", "cycle", "op_count", "nc_op_count",
+           "msg_count", "cost"]
+
+
+def cycle_op_counts(tensors) -> tuple:
+    """(op_count, nc_op_count) per cycle from compiled kernel shapes."""
+    ops = 0
+    max_per_factor = 0
+    for b in tensors.buckets:
+        entries = b.n_factors
+        for _ in range(b.arity):
+            entries *= tensors.max_domain_size
+        ops += entries * b.arity  # each position's reduction reads the table
+        per_factor = 1
+        for _ in range(b.arity):
+            per_factor *= tensors.max_domain_size
+        max_per_factor = max(max_per_factor, per_factor * b.arity)
+    return ops, max_per_factor
+
+
+class StatsLogger:
+    """Accumulate per-cycle rows and dump them as CSV (reference:
+    trace_computation, stats.py:81)."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.rows: List[dict] = []
+
+    def trace_cycle(self, computation: str, cycle: int, tensors,
+                    cost: Optional[float] = None, msg_count: int = 0,
+                    timestamp: float = 0.0) -> None:
+        if not self.enabled:
+            return
+        op_count, nc_op_count = cycle_op_counts(tensors)
+        self.rows.append(
+            {
+                "timestamp": timestamp,
+                "computation": computation,
+                "cycle": cycle,
+                "op_count": op_count,
+                "nc_op_count": nc_op_count,
+                "msg_count": msg_count,
+                "cost": cost,
+            }
+        )
+
+    def dump(self, path: str) -> None:
+        with open(path, "w", newline="", encoding="utf-8") as f:
+            w = csv.DictWriter(f, fieldnames=COLUMNS)
+            w.writeheader()
+            for row in self.rows:
+                w.writerow(row)

@@ -1,0 +1,100 @@
+"""Live observation server.
+
+Equivalent capability to the reference's pydcop/infrastructure/ui.py
+(UiServer :43-120): the reference pushes event-bus topics to GUI clients
+over websockets (websocket-server dependency).  That library is not in this
+image, so the same capability is served with stdlib HTTP:
+
+* ``GET /state``  — current status, cycle, cost, assignment (JSON);
+* ``GET /events`` — Server-Sent Events stream of event-bus topics
+  (consumable from any browser/EventSource, no extra deps).
+"""
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from pydcop_tpu.runtime.events import event_bus
+
+
+class UiServer:
+    def __init__(self, port: int = 10001, address: str = "127.0.0.1"):
+        self.port = port
+        self.address = address
+        self._state = {"status": "INITIAL"}
+        self._lock = threading.Lock()
+        self._subscribers: list[queue.Queue] = []
+        self._server: Optional[ThreadingHTTPServer] = None
+        event_bus.subscribe("*", self._on_event)
+
+    # -- event plumbing -----------------------------------------------------
+
+    def _on_event(self, topic: str, evt) -> None:
+        payload = json.dumps({"topic": topic, "event": repr(evt)})
+        with self._lock:
+            for q in list(self._subscribers):
+                try:
+                    q.put_nowait(payload)
+                except queue.Full:
+                    pass
+
+    def update_state(self, **kwargs) -> None:
+        with self._lock:
+            self._state.update(kwargs)
+
+    # -- server -------------------------------------------------------------
+
+    def start(self) -> None:
+        ui = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/state":
+                    with ui._lock:
+                        body = json.dumps(ui._state).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/events":
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.end_headers()
+                    q: queue.Queue = queue.Queue(maxsize=1000)
+                    with ui._lock:
+                        ui._subscribers.append(q)
+                    try:
+                        while True:
+                            payload = q.get(timeout=30)
+                            self.wfile.write(
+                                f"data: {payload}\n\n".encode()
+                            )
+                            self.wfile.flush()
+                    except (queue.Empty, OSError):
+                        pass
+                    finally:
+                        with ui._lock:
+                            if q in ui._subscribers:
+                                ui._subscribers.remove(q)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+        self._server = ThreadingHTTPServer((self.address, self.port),
+                                           Handler)
+        thread = threading.Thread(target=self._server.serve_forever,
+                                  daemon=True)
+        thread.start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
