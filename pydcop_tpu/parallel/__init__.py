@@ -7,6 +7,7 @@ computation graph is partitioned into edge shards laid out over a
 riding ICI/DCN instead of HTTP messages (SURVEY.md §2.8 mapping).
 """
 from pydcop_tpu.parallel.mesh import (
+    ShardedLocalSearch,
     ShardedMaxSum,
     build_mesh,
     shard_factor_graph,
@@ -14,6 +15,7 @@ from pydcop_tpu.parallel.mesh import (
 from pydcop_tpu.parallel.partition import partition_factors
 
 __all__ = [
+    "ShardedLocalSearch",
     "ShardedMaxSum",
     "build_mesh",
     "shard_factor_graph",

@@ -252,3 +252,112 @@ def st_factors(sb: ShardedBucket) -> int:
 
 def pairs(flat):
     return [tuple(flat[i : i + 2]) for i in range(0, len(flat), 2)]
+
+
+class ShardedLocalSearch:
+    """Local-search family over a device mesh (DSA / MGM move rules).
+
+    Constraints are sharded (same layout as ShardedMaxSum); the per-variable
+    local cost tables are computed as per-shard partial sums combined with
+    one psum per cycle, then the (cheap, replicated) move rule runs
+    identically on every device.  Equivalent distribution story to the
+    reference's agents-on-machines (SURVEY.md §2.8), with the value
+    broadcast replaced by the psum.
+    """
+
+    def __init__(self, tensors, mesh: Optional[Mesh] = None,
+                 rule: str = "mgm", probability: float = 0.7):
+        from pydcop_tpu.ops.compile import ConstraintGraphTensors
+
+        assert isinstance(tensors, ConstraintGraphTensors), (
+            "ShardedLocalSearch needs constraint-graph tensors"
+        )
+        self.base = tensors
+        self.mesh = mesh or build_mesh()
+        self.n_shards = self.mesh.devices.size
+        self.st = shard_factor_graph(tensors, self.n_shards)
+        self.rule = rule
+        self.probability = probability
+        self._run_n = None
+
+    def _tables_block(self, x, *bucket_blocks):
+        """Per-shard partial local-cost tables [V+1, D] (inside shard_map)."""
+        st = self.st
+        V, D = st.n_vars, st.max_domain_size
+        partial = jnp.zeros((V + 1, D), dtype=jnp.float32)
+        for sb, (t_blk, vi_blk) in zip(st.buckets, bucket_blocks):
+            Fs, a = sb.factors_per_shard, sb.arity
+            x_ext = jnp.concatenate([x, jnp.zeros(1, dtype=x.dtype)])
+            vals = x_ext[vi_blk]  # [Fs, a]
+            fidx = jnp.arange(Fs)[:, None]
+            for p in range(a):
+                idx = tuple(
+                    jnp.arange(D)[None, :] if q == p else vals[:, q][:, None]
+                    for q in range(a)
+                )
+                rows = t_blk[(fidx,) + idx]  # [Fs, D]
+                partial = partial + segment_sum(rows, vi_blk[:, p], V + 1)
+        return partial
+
+    def _build(self):
+        from pydcop_tpu.algorithms._local_search import (
+            gains_and_best,
+            neighborhood_winner,
+        )
+        from pydcop_tpu.ops.compile import PAD_COST
+
+        st = self.st
+        base = self.base
+        bucket_args = []
+        in_specs = [P(), P()]  # x, key replicated
+        for sb in st.buckets:
+            bucket_args.extend([sb.tensors, sb.var_idx])
+            in_specs.extend([P(AXIS), P(AXIS)])
+
+        def cycle_fn(x, key, *buckets):
+            partial = self._tables_block(x, *pairs(buckets))
+            total = jax.lax.psum(partial, AXIS)
+            tables = jnp.where(
+                base.domain_mask > 0,
+                base.unary_costs + total[: st.n_vars],
+                PAD_COST,
+            )
+            cur, best_val, gain, _ = gains_and_best(
+                base, x, tables=tables,
+                prefer_change=(self.rule == "dsa"),
+            )
+            if self.rule == "mgm":
+                move = neighborhood_winner(base, gain)
+            else:  # dsa-B style
+                activate = (
+                    jax.random.uniform(key, (st.n_vars,)) < self.probability
+                )
+                move = (gain > 1e-9) & activate
+            return jnp.where(move, best_val, x).astype(jnp.int32)
+
+        sharded = jax.shard_map(
+            cycle_fn,
+            mesh=self.mesh,
+            in_specs=tuple(in_specs),
+            out_specs=P(),
+            check_vma=False,
+        )
+
+        def run_n(x, keys):
+            def body(x, k):
+                return sharded(x, k, *bucket_args), ()
+
+            x, _ = jax.lax.scan(body, x, keys)
+            return x
+
+        self._run_n = jax.jit(run_n)
+
+    def run(self, cycles: int = 20, seed: int = 0):
+        """Returns the final value indices [V]."""
+        if self._run_n is None:
+            self._build()
+        from pydcop_tpu.algorithms._local_search import random_valid_values
+
+        x0 = random_valid_values(self.base, jax.random.PRNGKey(seed + 17))
+        keys = jax.random.split(jax.random.PRNGKey(seed), cycles)
+        return np.asarray(self._run_n(x0, keys))

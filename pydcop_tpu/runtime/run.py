@@ -90,3 +90,34 @@ def solve(
     return solve_result(
         dcop, algo, distribution, graph, timeout, cycles, algo_params, seed
     ).assignment
+
+
+def run_local_thread_dcop(
+    dcop: DCOP,
+    algo: Union[str, AlgorithmDef],
+    distribution: Union[str, Any] = "adhoc",
+    graph: Optional[str] = None,
+    collector=None,
+    collect_moment: str = "value_change",
+    period: Optional[float] = None,
+    replication: Optional[str] = None,
+    seed: int = 0,
+):
+    """Reference-parity constructor (infrastructure/run.py:145): returns a
+    deployed orchestrator.  In the tensor runtime "thread mode" and
+    "process mode" are the same engine — one process IS the whole agent
+    population — so both names build a VirtualOrchestrator."""
+    from pydcop_tpu.runtime.orchestrator import VirtualOrchestrator
+
+    orch = VirtualOrchestrator(
+        dcop, algo, distribution=distribution, graph=graph,
+        collect_on=collect_moment, period=period, collector=collector,
+        seed=seed,
+    )
+    orch.deploy_computations()
+    return orch
+
+
+#: reference-parity alias (infrastructure/run.py:225) — see
+#: run_local_thread_dcop
+run_local_process_dcop = run_local_thread_dcop

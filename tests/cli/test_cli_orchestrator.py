@@ -1,0 +1,46 @@
+"""Orchestrator + agent CLI commands: multi-process control plane."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+TUTO = os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "PYTHONPATH": REPO,
+}
+
+
+def test_orchestrator_and_agent():
+    port = 19371
+    orch = subprocess.Popen(
+        [sys.executable, "-m", "pydcop_tpu", "--timeout", "30",
+         "orchestrator", "--algo", "dpop", "--port", str(port),
+         "--expected_agents", "2", TUTO],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=ENV, cwd=REPO,
+    )
+    try:
+        time.sleep(1.0)
+        agent = subprocess.run(
+            [sys.executable, "-m", "pydcop_tpu", "--timeout", "40",
+             "agent", "--names", "a1", "a2",
+             "--orchestrator", f"127.0.0.1:{port}"],
+            capture_output=True, text=True, timeout=60, env=ENV, cwd=REPO,
+        )
+        assert agent.returncode == 0, agent.stderr[-800:]
+        agent_metrics = json.loads(agent.stdout)
+        assert agent_metrics["cost"] == 12
+        out, err = orch.communicate(timeout=60)
+        assert orch.returncode == 0, err[-800:]
+        orch_metrics = json.loads(out)
+        assert orch_metrics["cost"] == 12
+        assert orch_metrics["status"] == "FINISHED"
+    finally:
+        if orch.poll() is None:
+            orch.kill()

@@ -66,6 +66,54 @@ def test_sharded_on_subset_mesh(tuto_tensors):
     assert got == {"v1": "G", "v2": "G", "v3": "G", "v4": "G"}
 
 
+class TestShardedLocalSearch:
+    def test_sharded_mgm_matches_unsharded(self):
+        """Sharded MGM ≡ single-device MGM from the same start (MGM is
+        deterministic given x0)."""
+        import jax
+        import jax.numpy as jnp
+
+        from pydcop_tpu.algorithms._local_search import (
+            gains_and_best,
+            neighborhood_winner,
+            random_valid_values,
+        )
+        from pydcop_tpu.dcop import load_dcop_from_file
+        from pydcop_tpu.ops import compile_constraint_graph
+        from pydcop_tpu.parallel import ShardedLocalSearch
+
+        dcop = load_dcop_from_file(
+            os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+        )
+        tensors = compile_constraint_graph(dcop)
+        seed = 3
+        # unsharded rollout
+        x = random_valid_values(tensors, jax.random.PRNGKey(seed + 17))
+        for _ in range(10):
+            cur, best_val, gain, _ = gains_and_best(tensors, x)
+            move = neighborhood_winner(tensors, gain)
+            x = jnp.where(move, best_val, x).astype(jnp.int32)
+        expected = np.asarray(x)
+
+        sharded = ShardedLocalSearch(tensors, build_mesh(4), rule="mgm")
+        got = sharded.run(cycles=10, seed=seed)
+        np.testing.assert_array_equal(got, expected)
+
+    def test_sharded_dsa_solves_csp(self):
+        from pydcop_tpu.dcop import load_dcop_from_file
+        from pydcop_tpu.ops import compile_constraint_graph
+        from pydcop_tpu.parallel import ShardedLocalSearch
+
+        dcop = load_dcop_from_file(
+            os.path.join(INSTANCES, "coloring_csp.yaml")
+        )
+        tensors = compile_constraint_graph(dcop)
+        sharded = ShardedLocalSearch(tensors, build_mesh(2), rule="dsa")
+        values = sharded.run(cycles=60, seed=1)
+        assignment = tensors.assignment_from_indices(values)
+        assert dcop.solution_cost(assignment, 10000) == (0, 0)
+
+
 def test_partition_locality():
     rng = np.random.default_rng(0)
     var_idx = rng.integers(0, 100, size=(200, 2)).astype(np.int32)
