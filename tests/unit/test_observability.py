@@ -1,0 +1,93 @@
+"""Tests for the UI server, stats tracing, and metric collection."""
+import json
+import os
+import urllib.request
+
+import pytest
+
+from pydcop_tpu.dcop import load_dcop_from_file
+from pydcop_tpu.runtime.events import event_bus
+from pydcop_tpu.runtime.stats import StatsLogger, cycle_op_counts
+from pydcop_tpu.runtime.ui import UiServer
+
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+
+
+@pytest.fixture
+def tuto():
+    return load_dcop_from_file(
+        os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+    )
+
+
+class TestUiServer:
+    def test_state_endpoint(self):
+        ui = UiServer(port=19455)
+        ui.start()
+        try:
+            ui.update_state(status="RUNNING", cycle=3)
+            with urllib.request.urlopen(
+                "http://127.0.0.1:19455/state", timeout=5
+            ) as resp:
+                state = json.loads(resp.read())
+            assert state["status"] == "RUNNING"
+            assert state["cycle"] == 3
+        finally:
+            ui.stop()
+            event_bus.unsubscribe(ui._on_event)
+
+    def test_unknown_endpoint_404(self):
+        ui = UiServer(port=19456)
+        ui.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    "http://127.0.0.1:19456/nope", timeout=5
+                )
+        finally:
+            ui.stop()
+            event_bus.unsubscribe(ui._on_event)
+
+
+class TestStats:
+    def test_op_counts(self, tuto):
+        from pydcop_tpu.ops import compile_factor_graph
+
+        tensors = compile_factor_graph(tuto)
+        ops, nc_ops = cycle_op_counts(tensors)
+        # 4 binary factors with D=2: 4 * 2*2 * 2 positions = 32 table reads
+        assert ops == 32
+        assert nc_ops == 8  # one factor's worth (critical path)
+
+    def test_trace_and_dump(self, tuto, tmp_path):
+        from pydcop_tpu.ops import compile_factor_graph
+
+        tensors = compile_factor_graph(tuto)
+        logger = StatsLogger()
+        for c in range(3):
+            logger.trace_cycle("maxsum", c, tensors, cost=10.0 - c,
+                              msg_count=16)
+        path = str(tmp_path / "stats.csv")
+        logger.dump(path)
+        lines = open(path).read().strip().splitlines()
+        assert len(lines) == 4
+        assert lines[0].startswith("timestamp,computation,cycle,op_count")
+
+
+class TestRunLocalApi:
+    def test_run_local_thread_dcop_with_collector(self, tuto):
+        """Reference-parity integration path: build orchestrator via
+        run_local_thread_dcop, collect run metrics, read end metrics."""
+        from pydcop_tpu.runtime import run_local_thread_dcop
+
+        collected = []
+        orch = run_local_thread_dcop(
+            tuto, "maxsum", distribution="adhoc",
+            collector=lambda t, m: collected.append((t, m)),
+            collect_moment="cycle_change",
+        )
+        res = orch.run(timeout=20)
+        assert res.cost == 12
+        assert collected, "collector must receive per-cycle metrics"
+        t, m = collected[-1]
+        assert "cost" in m and "cycle" in m
