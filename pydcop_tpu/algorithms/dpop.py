@@ -43,6 +43,11 @@ algo_params = []  # reference: no parameters (dpop.py:45)
 class DpopSolver:
     """Two tree sweeps; not round-based, so it implements run() directly."""
 
+    #: refuse UTIL tables beyond this many entries: DPOP is exponential in
+    #: the pseudo-tree's induced width, and a clear error beats an
+    #: out-of-memory hang on high-width graphs (use local search there)
+    max_table_entries: int = 100_000_000
+
     def __init__(self, dcop: DCOP, tree: Optional[ComputationPseudoTree] =
                  None, algo_def: Optional[AlgorithmDef] = None, seed: int = 0):
         self.dcop = dcop
@@ -60,12 +65,15 @@ class DpopSolver:
         ext = {
             ev.name: ev.value for ev in self.dcop.external_variables.values()
         }
-        t = jnp.asarray(v.cost_vector(), dtype=jnp.float32)
+        # tables start on host; join_t migrates them to the device once they
+        # cross DEVICE_THRESHOLD entries (hybrid dispatch — eager device
+        # round-trips dominate for the many tiny tables of sparse problems)
+        t = np.asarray(v.cost_vector(), dtype=np.float32)
         for c in node.constraints:
             if any(n in ext for n in c.scope_names):
                 c = c.slice(ext)
             c_dims = [(d.name, len(d.domain)) for d in c.dimensions]
-            c_t = jnp.asarray(c.to_tensor(), dtype=jnp.float32)
+            c_t = np.asarray(c.to_tensor(), dtype=np.float32)
             # include neighbor variable costs once: only the deepest node
             # holds the constraint, variable costs are added per-variable
             t, dims = join_t(t, dims, c_t, c_dims)
@@ -87,6 +95,17 @@ class DpopSolver:
                 t, dims = self._node_constraint_table(node)
                 for child in node.children:
                     ct, cdims = util_from.pop(child)
+                    have = {n for n, _ in dims}
+                    out_dims = dims + [d for d in cdims if d[0] not in have]
+                    est = table_size(out_dims)
+                    if est > self.max_table_entries:
+                        raise MemoryError(
+                            f"DPOP UTIL table at {node.name} would need "
+                            f"{est:.2e} entries (separator too wide — "
+                            f"induced width of this graph is too high for "
+                            f"exact inference; use a local-search or B&B "
+                            f"algorithm)"
+                        )
                     t, dims = join_t(t, dims, ct, cdims)
                 joined[node.name] = (t, dims)
                 if node.parent is not None:

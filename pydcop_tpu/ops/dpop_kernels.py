@@ -15,40 +15,61 @@ from __future__ import annotations
 from typing import Dict, List, Sequence, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 Dims = List[Tuple[str, int]]  # ordered (variable name, domain size)
 
+#: tables with at least this many entries migrate to the accelerator; below
+#: it, eager per-op dispatch overhead exceeds the math and numpy on host
+#: wins.  Join/project code is array-namespace-generic so the hybrid is one
+#: conversion at the threshold.
+DEVICE_THRESHOLD = 1 << 14
 
-def align(t: jnp.ndarray, dims: Dims, out_dims: Dims) -> jnp.ndarray:
+
+def _xp(t):
+    return np if isinstance(t, np.ndarray) else jnp
+
+
+def maybe_to_device(t):
+    """Move a host table to the device once it crosses the size threshold."""
+    if isinstance(t, np.ndarray) and t.size >= DEVICE_THRESHOLD:
+        return jnp.asarray(t)
+    return t
+
+
+def align(t, dims: Dims, out_dims: Dims):
     """Transpose/expand t to broadcast over out_dims (superset of dims)."""
+    xp = _xp(t)
     pos = {name: i for i, (name, _) in enumerate(dims)}
     perm = [pos[name] for name, _ in out_dims if name in pos]
-    t = jnp.transpose(t, perm) if perm else t
+    t = xp.transpose(t, perm) if perm else t
     shape = [size if name in pos else 1 for name, size in out_dims]
     return t.reshape(shape)
 
 
-def join_t(
-    t1: jnp.ndarray, dims1: Dims, t2: jnp.ndarray, dims2: Dims
-) -> Tuple[jnp.ndarray, Dims]:
+def join_t(t1, dims1: Dims, t2, dims2: Dims) -> Tuple[object, Dims]:
     """Sum-combine two util tables over the union of their dims."""
     names1 = {n for n, _ in dims1}
     out_dims = list(dims1) + [d for d in dims2 if d[0] not in names1]
+    if table_size(out_dims) >= DEVICE_THRESHOLD:
+        t1, t2 = jnp.asarray(t1), jnp.asarray(t2)
+    elif isinstance(t1, np.ndarray) != isinstance(t2, np.ndarray):
+        # mixed host/device operands: device wins
+        t1, t2 = jnp.asarray(t1), jnp.asarray(t2)
     return align(t1, dims1, out_dims) + align(t2, dims2, out_dims), out_dims
 
 
-def project_t(
-    t: jnp.ndarray, dims: Dims, var_name: str, mode: str = "min"
-) -> Tuple[jnp.ndarray, Dims]:
+def project_t(t, dims: Dims, var_name: str, mode: str = "min"
+              ) -> Tuple[object, Dims]:
     """Optimize one variable out of a util table."""
+    xp = _xp(t)
     axis = [n for n, _ in dims].index(var_name)
-    out = jnp.min(t, axis=axis) if mode == "min" else jnp.max(t, axis=axis)
+    out = xp.min(t, axis=axis) if mode == "min" else xp.max(t, axis=axis)
     return out, [d for d in dims if d[0] != var_name]
 
 
-def slice_t(
-    t: jnp.ndarray, dims: Dims, assignment: Dict[str, int]
-) -> Tuple[jnp.ndarray, Dims]:
+def slice_t(t, dims: Dims, assignment: Dict[str, int]
+            ) -> Tuple[object, Dims]:
     """Fix some dims at given value indices."""
     idx = tuple(
         assignment[name] if name in assignment else slice(None)
@@ -57,12 +78,11 @@ def slice_t(
     return t[idx], [d for d in dims if d[0] not in assignment]
 
 
-def argopt_value(
-    t: jnp.ndarray, dims: Dims, var_name: str, mode: str = "min"
-) -> int:
+def argopt_value(t, dims: Dims, var_name: str, mode: str = "min") -> int:
     """Best value index of a 1-D util table over var_name."""
     assert len(dims) == 1 and dims[0][0] == var_name, dims
-    return int(jnp.argmin(t) if mode == "min" else jnp.argmax(t))
+    xp = _xp(t)
+    return int(xp.argmin(t) if mode == "min" else xp.argmax(t))
 
 
 def table_size(dims: Dims) -> int:
