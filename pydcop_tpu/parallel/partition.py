@@ -15,17 +15,25 @@ import numpy as np
 
 
 def partition_factors(
-    var_idx_per_bucket: List[np.ndarray], n_vars: int, n_shards: int
+    var_idx_per_bucket: List[np.ndarray],
+    n_vars: int,
+    n_shards: int,
+    use_native: bool = True,
 ) -> List[np.ndarray]:
-    """Greedy locality partition: factors are assigned shard-by-shard
-    following a variable-major order, so factors sharing variables tend to
-    land on the same shard.  Returns, per bucket, the factor→shard
-    assignment.
+    """Locality partition of factors onto shards.
 
-    (A spectral/METIS-quality partitioner can slot in here later; the
-    interface is stable.)
+    Preferred path: the native C++ BFS-region-growing vertex partitioner
+    (pydcop_tpu.native) partitions the variable graph, factors follow their
+    first variable, and shard loads are rebalanced to the ceil-average.
+    Fallback: a variable-major greedy ordering (pure python).
+    Returns, per bucket, the factor→shard assignment.
     """
-    # order factors by their lowest variable index (cheap locality proxy)
+    if use_native and n_shards > 1:
+        native = _native_partition(var_idx_per_bucket, n_vars, n_shards)
+        if native is not None:
+            return native
+    # fallback: order factors by their lowest variable index (cheap
+    # locality proxy)
     out = []
     for var_idx in var_idx_per_bucket:
         F = var_idx.shape[0]
@@ -38,6 +46,53 @@ def partition_factors(
         for rank, f in enumerate(order):
             assign[f] = min(rank // per_shard, n_shards - 1)
         out.append(assign)
+    return out
+
+
+def _native_partition(
+    var_idx_per_bucket: List[np.ndarray], n_vars: int, n_shards: int
+) -> List[np.ndarray]:
+    """Factor assignment via the C++ vertex partitioner, or None."""
+    from pydcop_tpu import native
+
+    # variable graph: consecutive scope pairs cover each factor's clique
+    # connectivity at O(arity) edges
+    eu, ev = [], []
+    for var_idx in var_idx_per_bucket:
+        for p in range(var_idx.shape[1] - 1):
+            eu.append(var_idx[:, p])
+            ev.append(var_idx[:, p + 1])
+    if not eu:
+        return None
+    edge_u = np.concatenate(eu)
+    edge_v = np.concatenate(ev)
+    vpart = native.partition_vertices(edge_u, edge_v, n_vars, n_shards)
+    if vpart is None:
+        return None
+
+    out = []
+    total_f = sum(v.shape[0] for v in var_idx_per_bucket)
+    cap = -(-total_f // n_shards)  # global ceil target per shard
+    loads = np.zeros(n_shards, dtype=np.int64)
+    for var_idx in var_idx_per_bucket:
+        F = var_idx.shape[0]
+        if F == 0:
+            out.append(np.zeros(0, dtype=np.int32))
+            continue
+        assign = vpart[var_idx[:, 0]].astype(np.int32)
+        out.append(assign)
+        np.add.at(loads, assign, 1)
+    # rebalance: move factors from overloaded shards to the lightest
+    for bi, var_idx in enumerate(var_idx_per_bucket):
+        assign = out[bi]
+        for f in range(assign.shape[0]):
+            s = assign[f]
+            if loads[s] > cap:
+                tgt = int(np.argmin(loads))
+                if loads[tgt] < cap:
+                    assign[f] = tgt
+                    loads[s] -= 1
+                    loads[tgt] += 1
     return out
 
 
