@@ -53,6 +53,33 @@ def python_reference_cycle_time(tensors, sample: int = 200) -> float:
     return per_factor * total_factors
 
 
+def _arm_watchdog(seconds: float) -> None:
+    """Guarantee the one-JSON-line contract even if device init wedges
+    (the tunneled TPU is single-tenant; a stale claim can block forever)."""
+    import os
+    import threading
+
+    def fire():
+        print(
+            json.dumps(
+                {
+                    "metric": "maxsum_iters_per_sec",
+                    "value": 0.0,
+                    "unit": "iters/s",
+                    "vs_baseline": 0.0,
+                    "error": f"watchdog: no result within {seconds}s "
+                    "(device init or run wedged)",
+                }
+            ),
+            flush=True,
+        )
+        os._exit(3)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--vars", type=int, default=10_000)
@@ -64,9 +91,12 @@ def main():
         "--stretch", action="store_true",
         help="100k-var / 300k-edge instance via the direct array compiler",
     )
+    ap.add_argument("--watchdog", type=float, default=900.0)
     args = ap.parse_args()
     if args.stretch:
         args.vars, args.edges = 100_000, 300_000
+    if args.watchdog:
+        _arm_watchdog(args.watchdog)
 
     import jax
     import jax.numpy as jnp

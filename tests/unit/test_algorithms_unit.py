@@ -1,0 +1,167 @@
+"""Algorithm-level unit tests: drive solver cycles directly with crafted
+states (reference twin: tests/unit/test_algorithms_*.py drive handlers with
+mocks, e.g. test_algorithms_dpop.py:80-148)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms import AlgorithmDef
+from pydcop_tpu.dcop import DCOP, Domain, NAryMatrixRelation, Variable
+from pydcop_tpu.ops.compile import compile_constraint_graph
+
+
+def chain_dcop():
+    """v0 - v1 - v2 chain, equality penalized by 10."""
+    d = Domain("d", "d", [0, 1, 2])
+    vs = [Variable(f"v{i}", d) for i in range(3)]
+    dcop = DCOP("chain")
+    for i in range(2):
+        m = np.where(np.eye(3, dtype=bool), 10.0, 0.0)
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[i], vs[i + 1]], m, f"c{i}")
+        )
+    return dcop
+
+
+def pair_trap_dcop():
+    """Two variables where only a coordinated flip escapes the minimum:
+    cost(0,0)=5, cost(1,1)=0, cost(0,1)=cost(1,0)=20."""
+    d = Domain("d", "d", [0, 1])
+    x, y = Variable("x", d), Variable("y", d)
+    dcop = DCOP("trap")
+    dcop.add_constraint(
+        NAryMatrixRelation([x, y], [[5.0, 20.0], [20.0, 0.0]], "c")
+    )
+    return dcop
+
+
+class TestMgmCycle:
+    def test_only_max_gain_moves(self):
+        from pydcop_tpu.algorithms.mgm import build_solver
+
+        dcop = chain_dcop()
+        solver = build_solver(dcop)
+        # all equal (0,0,0): v1 gains 20 by moving, v0/v2 gain 10
+        x = jnp.array([0, 0, 0], dtype=jnp.int32)
+        (x2,) = solver.cycle((x,), jax.random.PRNGKey(0))
+        x2 = np.asarray(x2)
+        assert x2[1] != 0  # the max-gain variable moved
+        assert x2[0] == 0 and x2[2] == 0  # neighbors of the winner held
+
+    def test_stable_at_optimum(self):
+        from pydcop_tpu.algorithms.mgm import build_solver
+
+        dcop = chain_dcop()
+        solver = build_solver(dcop)
+        x = jnp.array([0, 1, 0], dtype=jnp.int32)  # cost 0: no move
+        (x2,) = solver.cycle((x,), jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(x2), [0, 1, 0])
+
+    def test_lexic_tie_break(self):
+        """Equal gains: the lower-index variable wins."""
+        from pydcop_tpu.algorithms.mgm import build_solver
+
+        d = Domain("d", "d", [0, 1])
+        a, b = Variable("a", d), Variable("b", d)
+        dcop = DCOP("tie")
+        dcop.add_constraint(
+            NAryMatrixRelation([a, b], [[10.0, 0.0], [0.0, 10.0]], "c")
+        )
+        solver = build_solver(dcop)
+        x = jnp.array([0, 0], dtype=jnp.int32)  # both could gain 10
+        (x2,) = solver.cycle((x,), jax.random.PRNGKey(0))
+        x2 = np.asarray(x2)
+        assert x2[0] == 1 and x2[1] == 0
+
+
+class TestDsaCycle:
+    def test_variant_a_never_moves_laterally(self):
+        from pydcop_tpu.algorithms.dsa import build_solver
+
+        d = Domain("d", "d", [0, 1])
+        a, b = Variable("a", d), Variable("b", d)
+        dcop = DCOP("flat")
+        # all assignments cost the same: no strict improvement exists
+        dcop.add_constraint(
+            NAryMatrixRelation([a, b], [[1.0, 1.0], [1.0, 1.0]], "c")
+        )
+        algo = AlgorithmDef(
+            "dsa", {"probability": 1.0, "variant": "A", "stop_cycle": 0}
+        )
+        solver = build_solver(dcop, algo_def=algo)
+        x = jnp.array([0, 0], dtype=jnp.int32)
+        for i in range(5):
+            (x,) = solver.cycle((x,), jax.random.PRNGKey(i))
+        np.testing.assert_array_equal(np.asarray(x), [0, 0])
+
+    def test_probability_zero_freezes(self):
+        from pydcop_tpu.algorithms.dsa import build_solver
+
+        dcop = chain_dcop()
+        algo = AlgorithmDef(
+            "dsa", {"probability": 0.0, "variant": "B", "stop_cycle": 0}
+        )
+        solver = build_solver(dcop, algo_def=algo)
+        x = jnp.array([0, 0, 0], dtype=jnp.int32)
+        (x2,) = solver.cycle((x,), jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(x2), [0, 0, 0])
+
+
+class TestMgm2Pairs:
+    def test_coordinated_escape(self):
+        """From (0,0), no unilateral move helps (cost 5 → 20), but the pair
+        flip to (1,1) reaches 0 — only MGM-2 can take it."""
+        from pydcop_tpu.algorithms.mgm import build_solver as build_mgm
+        from pydcop_tpu.algorithms.mgm2 import build_solver as build_mgm2
+
+        dcop = pair_trap_dcop()
+        x0 = jnp.array([0, 0], dtype=jnp.int32)
+
+        mgm = build_mgm(dcop)
+        (x_mgm,) = mgm.cycle((x0,), jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(x_mgm), [0, 0])  # stuck
+
+        mgm2 = build_mgm2(dcop, algo_def=AlgorithmDef(
+            "mgm2", {"threshold": 0.5, "favor": "unilateral",
+                     "stop_cycle": 0}))
+        # over a few cycles some offer coin flip pairs them up
+        x = x0
+        for i in range(10):
+            (x,) = mgm2.cycle((x,), jax.random.PRNGKey(i))
+        np.testing.assert_array_equal(np.asarray(x), [1, 1])
+
+
+class TestDbaWeights:
+    def test_weights_increase_at_quasi_local_minimum(self):
+        from pydcop_tpu.algorithms.dba import build_solver
+
+        d = Domain("d", "d", [0, 1])
+        a, b = Variable("a", d), Variable("b", d)
+        dcop = DCOP("stuck")
+        # every assignment violates: weights must grow
+        dcop.add_constraint(
+            NAryMatrixRelation([a, b], [[1.0, 1.0], [1.0, 1.0]], "c")
+        )
+        solver = build_solver(dcop)
+        x = jnp.array([0, 0], dtype=jnp.int32)
+        w = jnp.ones(1, dtype=jnp.float32)
+        x2, w2 = solver.cycle((x, w), jax.random.PRNGKey(0))
+        assert float(w2[0]) == 2.0
+
+
+class TestAMaxsumActivation:
+    def test_zero_activation_freezes_messages(self):
+        from pydcop_tpu.algorithms.amaxsum import build_solver
+
+        dcop = chain_dcop()
+        algo = AlgorithmDef(
+            "amaxsum",
+            {"stop_cycle": 0, "damping": 0.0, "stability": 0.1,
+             "noise": 0.0, "activation": 0.0},
+        )
+        solver = build_solver(dcop, algo_def=algo)
+        q, r, v = solver.initial_state()
+        q2, r2, _ = solver.cycle((q, r, v), jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(q2), np.asarray(q))
+        np.testing.assert_array_equal(np.asarray(r2), np.asarray(r))
