@@ -53,9 +53,10 @@ def python_reference_cycle_time(tensors, sample: int = 200) -> float:
     return per_factor * total_factors
 
 
-def _arm_watchdog(seconds: float) -> None:
+def _arm_watchdog(seconds: float, metric: str):
     """Guarantee the one-JSON-line contract even if device init wedges
-    (the tunneled TPU is single-tenant; a stale claim can block forever)."""
+    (the tunneled TPU is single-tenant; a stale claim can block forever).
+    Returns the Timer so the success path can cancel it."""
     import os
     import threading
 
@@ -63,7 +64,7 @@ def _arm_watchdog(seconds: float) -> None:
         print(
             json.dumps(
                 {
-                    "metric": "maxsum_iters_per_sec",
+                    "metric": metric,
                     "value": 0.0,
                     "unit": "iters/s",
                     "vs_baseline": 0.0,
@@ -78,6 +79,7 @@ def _arm_watchdog(seconds: float) -> None:
     t = threading.Timer(seconds, fire)
     t.daemon = True
     t.start()
+    return t
 
 
 def main():
@@ -95,8 +97,10 @@ def main():
     args = ap.parse_args()
     if args.stretch:
         args.vars, args.edges = 100_000, 300_000
+    metric = f"maxsum_iters_per_sec_{args.vars}var_{args.edges}edge"
+    watchdog = None
     if args.watchdog:
-        _arm_watchdog(args.watchdog)
+        watchdog = _arm_watchdog(args.watchdog, metric)
 
     import jax
     import jax.numpy as jnp
@@ -162,17 +166,18 @@ def main():
         iters_per_sec / ref_iters_per_sec if ref_iters_per_sec else 0.0
     )
 
+    if watchdog is not None:
+        watchdog.cancel()
     print(
         json.dumps(
             {
-                "metric": (
-                    f"maxsum_iters_per_sec_{args.vars}var_{args.edges}edge"
-                ),
+                "metric": metric,
                 "value": round(iters_per_sec, 2),
                 "unit": "iters/s",
                 "vs_baseline": round(vs_baseline, 2),
             }
-        )
+        ),
+        flush=True,
     )
 
 

@@ -4,11 +4,9 @@ mocks, e.g. test_algorithms_dpop.py:80-148)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from pydcop_tpu.algorithms import AlgorithmDef
 from pydcop_tpu.dcop import DCOP, Domain, NAryMatrixRelation, Variable
-from pydcop_tpu.ops.compile import compile_constraint_graph
 
 
 def chain_dcop():
