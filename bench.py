@@ -107,6 +107,9 @@ def main():
 
     from pydcop_tpu.ops import compile_factor_graph
     from pydcop_tpu.ops.maxsum_kernels import init_messages, maxsum_cycle
+    from pydcop_tpu.ops.pallas_maxsum import (
+        pack_for_pallas, packed_cycle, packed_init_state,
+    )
 
     if args.stretch:
         from pydcop_tpu.ops.compile import compile_binary_from_arrays
@@ -136,17 +139,28 @@ def main():
         )
         tensors = compile_factor_graph(dcop)
 
+    # engine: lane-packed pallas kernel on TPU (binary graphs), else generic
+    packed = None
+    if jax.default_backend() == "tpu":
+        packed = pack_for_pallas(tensors)
+
     @jax.jit
     def run_n(q, r):
         def body(carry, _):
             q, r = carry
-            q2, r2, beliefs, values = maxsum_cycle(tensors, q, r, damping=0.5)
+            if packed is not None:
+                q2, r2, _, _ = packed_cycle(packed, q, r, damping=0.5)
+            else:
+                q2, r2, _, _ = maxsum_cycle(tensors, q, r, damping=0.5)
             return (q2, r2), ()
 
         (q, r), _ = jax.lax.scan(body, (q, r), None, length=args.cycles)
         return q, r
 
-    q0, r0 = init_messages(tensors)
+    q0, r0 = (
+        packed_init_state(packed) if packed is not None
+        else init_messages(tensors)
+    )
     # warmup / compile
     q, r = run_n(q0, r0)
     jax.block_until_ready((q, r))

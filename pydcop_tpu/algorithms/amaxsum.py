@@ -35,7 +35,10 @@ algo_params = [
 
 class AMaxSumSolver(MaxSumSolver):
     def __init__(self, dcop, tensors, algo_def, seed=0):
-        super().__init__(dcop, tensors, algo_def, seed)
+        # use_packed=False: this cycle() runs the generic [E, D] kernel with
+        # a per-edge activation mask, which the lane-packed layout does not
+        # carry
+        super().__init__(dcop, tensors, algo_def, seed, use_packed=False)
         self.activation = float(self.params.get("activation", 0.7))
 
     def cycle(self, state, key):

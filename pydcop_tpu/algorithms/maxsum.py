@@ -44,10 +44,19 @@ algo_params = [
 
 
 class MaxSumSolver(SynchronousTensorSolver):
-    """State = (q var→factor msgs [E,D], r factor→var msgs [E,D],
-    values [V])."""
+    """State = (q var→factor msgs, r factor→var msgs, values [V]).
 
-    def __init__(self, dcop, tensors, algo_def, seed=0):
+    Two interchangeable engines:
+
+    * generic (any arity/domain): [E, D] message arrays, batched
+      broadcast-min per arity bucket (ops/maxsum_kernels);
+    * lane-packed pallas (all-binary graphs on TPU): [D, N] messages with
+      edges on the lane axis and the var↔factor exchange as a Clos-routed
+      in-VMEM permutation (ops/pallas_maxsum) — ~2x faster per cycle on
+      the 10k-var benchmark.
+    """
+
+    def __init__(self, dcop, tensors, algo_def, seed=0, use_packed=None):
         super().__init__(dcop, tensors, algo_def, seed)
         self.damping = float(self.params.get("damping", 0.5))
         # Symmetry breaking: without per-value cost differences BP beliefs
@@ -74,17 +83,38 @@ class MaxSumSolver(SynchronousTensorSolver):
         self.msgs_per_cycle = 2 * tensors.n_edges
         self.msg_size_per_msg = float(tensors.max_domain_size)
 
+        # engine selection: lane-packed pallas on TPU for binary graphs
+        self.packed = None
+        if use_packed is None:
+            use_packed = jax.default_backend() == "tpu"
+        if use_packed:
+            from pydcop_tpu.ops.pallas_maxsum import pack_for_pallas
+
+            self.packed = pack_for_pallas(self.tensors)
+
     def initial_state(self):
-        q, r = init_messages(self.tensors)
+        if self.packed is not None:
+            from pydcop_tpu.ops.pallas_maxsum import packed_init_state
+
+            q, r = packed_init_state(self.packed)
+        else:
+            q, r = init_messages(self.tensors)
         values = masked_argmin(self.tensors.unary_costs,
                                self.tensors.domain_mask)
         return q, r, values
 
     def cycle(self, state, key):
         q, r, _ = state
-        q2, r2, beliefs, values = maxsum_cycle(
-            self.tensors, q, r, damping=self.damping
-        )
+        if self.packed is not None:
+            from pydcop_tpu.ops.pallas_maxsum import packed_cycle
+
+            q2, r2, beliefs, values = packed_cycle(
+                self.packed, q, r, damping=self.damping
+            )
+        else:
+            q2, r2, beliefs, values = maxsum_cycle(
+                self.tensors, q, r, damping=self.damping
+            )
         return q2, r2, values
 
     def values_of(self, state):

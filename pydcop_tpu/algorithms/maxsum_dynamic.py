@@ -38,6 +38,13 @@ class DynamicMaxSumSolver(MaxSumSolver):
     """MaxSum whose factor tensors can be swapped between (chunks of)
     cycles."""
 
+    def __init__(self, dcop, tensors, algo_def, seed=0):
+        # use_packed=False: _swap_tensor mutates bucket tensors in place,
+        # which the packed engine's pre-baked cost_rows would not see.
+        # (The swap keeps the graph structure, so a future optimization can
+        # rewrite pg.cost_rows in place instead of re-routing.)
+        super().__init__(dcop, tensors, algo_def, seed, use_packed=False)
+
     def change_factor_function(self, new_constraint: Constraint):
         """Replace the cost function of an existing factor (same name, same
         scope) — reference: DynamicFactorComputation.change_factor_function."""

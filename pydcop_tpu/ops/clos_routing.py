@@ -1,0 +1,207 @@
+"""Clos-network routing: compile an arbitrary static permutation into
+TPU-friendly stages.
+
+Motivation.  Every graph-structured exchange in this framework (MaxSum's
+var↔factor message routing, local-search neighbor gathers, shard halo
+exchange) reduces to ONE static permutation of the lane axis of a
+``[rows, N]`` array per cycle.  XLA lowers such a gather to scalarized
+loads (~200-400us for N≈64k on v5e) — the dominant cost of a solver cycle.
+Mosaic/Pallas, however, supports three fast vector primitives:
+
+* within-vreg lane gather: ``take_along_axis(x[R,128], idx[R,128], axis=1)``
+* [128, 128] tile transposes
+* per-lane k-way select between a few sublane planes
+
+By the Slepian-Duguid rearrangeability theorem, ANY permutation of an
+``R x C`` matrix factors into (within-rows) ∘ (within-columns) ∘
+(within-rows).  The within-columns middle stage is itself decomposed the
+same way after a tile transpose.  Concretely, for N = A·B·L laid out as
+(a, b, l) with l the lane axis (L = lanes = 128, B = tile width = 128,
+A = small leftover factor):
+
+    pi = R2 ∘ T⁻¹ ∘ G2 ∘ S ∘ G1 ∘ T ∘ R1
+
+      R1, R2 : lane gathers on rows (a, b)          [within-vreg ✓]
+      T, T⁻¹ : transpose of the (b, l) axes          [tile transpose ✓]
+      G1, G2 : lane gathers on rows (a, l) (over b)  [within-vreg ✓]
+      S      : per-lane A-way select across a        [vector selects ✓]
+
+The stage index arrays are computed here on the host, once per graph, by
+edge-coloring regular bipartite multigraphs (Hall's theorem): color =
+intermediate lane.  Coloring is by recursive Euler splitting, which needs
+the degree to be a power of two — L and B are 128 and A is padded
+implicitly by the caller choosing N = A·B·L ≥ n with dummy fixed points.
+
+This module is pure numpy (no jax): the kernels live in
+pydcop_tpu.ops.pallas_permute.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+def _euler_split(src: np.ndarray, dst: np.ndarray, n_left: int,
+                 n_right: int) -> np.ndarray:
+    """Split a bipartite multigraph with all-even degrees into two halves
+    (returned as a 0/1 array per edge) such that every vertex has exactly
+    half its edges in each half.  Hierholzer walk, alternating colors."""
+    E = len(src)
+    half = np.empty(E, dtype=np.int8)
+    # adjacency: per vertex, list of incident edge ids (as stacks)
+    left_adj = [[] for _ in range(n_left)]
+    right_adj = [[] for _ in range(n_right)]
+    for e in range(E):
+        left_adj[src[e]].append(e)
+        right_adj[dst[e]].append(e)
+    used = np.zeros(E, dtype=bool)
+    for e0 in range(E):
+        if used[e0]:
+            continue
+        # walk a circuit starting from e0's left vertex, alternating sides
+        e, color, on_left = e0, 0, True
+        while True:
+            used[e] = True
+            half[e] = color
+            color ^= 1
+            # move across the edge, pick next unused edge at the far vertex
+            vert_adj = right_adj[dst[e]] if on_left else left_adj[src[e]]
+            nxt = None
+            while vert_adj:
+                cand = vert_adj.pop()
+                if not used[cand]:
+                    nxt = cand
+                    break
+            if nxt is None:
+                break  # circuit closed (all degrees even ⇒ back at start)
+            e = nxt
+            on_left = not on_left
+    return half
+
+
+def edge_color(src: np.ndarray, dst: np.ndarray, n_left: int, n_right: int,
+               degree: int) -> np.ndarray:
+    """Proper edge coloring of a `degree`-regular bipartite multigraph with
+    exactly `degree` colors (degree must be a power of two)."""
+    if degree & (degree - 1):
+        raise ValueError(f"degree {degree} is not a power of two")
+    E = len(src)
+    colors = np.zeros(E, dtype=np.int32)
+    # iterative recursive splitting: queue of (edge_ids, color_base, deg)
+    stack = [(np.arange(E), 0, degree)]
+    while stack:
+        ids, base, deg = stack.pop()
+        if deg == 1:
+            colors[ids] = base
+            continue
+        half = _euler_split(src[ids], dst[ids], n_left, n_right)
+        stack.append((ids[half == 0], base, deg // 2))
+        stack.append((ids[half == 1], base + deg // 2, deg // 2))
+    return colors
+
+
+@dataclass
+class PermutationPlan:
+    """Stage index arrays realizing out[:, t] = in[:, perm[t]].
+
+    Layout: N = A*B*L, position (a, b, l), flat = (a*B + b)*L + l.
+    All index arrays are per-row relative (values < row length).
+    """
+
+    A: int
+    B: int
+    L: int
+    idx_r1: np.ndarray  # [A*B, L]   lane gather, original layout
+    idx_g1: np.ndarray  # [A*L, B]   lane gather, transposed layout
+    sel_s: np.ndarray   # [A, L, B]  source plane a for output plane a'
+    idx_g2: np.ndarray  # [A*L, B]   lane gather, transposed layout
+    idx_r2: np.ndarray  # [A*B, L]   lane gather, original layout
+
+    @property
+    def n(self) -> int:
+        return self.A * self.B * self.L
+
+    # -- numpy reference implementation (for tests and as documentation of
+    #    the kernel's stage semantics) ---------------------------------------
+
+    def apply_numpy(self, x: np.ndarray) -> np.ndarray:
+        """x: [S, N] → permuted [S, N] (reference semantics of the pallas
+        kernel in pydcop_tpu.ops.pallas_permute)."""
+        A, B, L = self.A, self.B, self.L
+        S = x.shape[0]
+        v = x.reshape(S, A * B, L)
+        v = np.take_along_axis(v, self.idx_r1[None], axis=2)  # R1
+        v = v.reshape(S, A, B, L).transpose(0, 1, 3, 2)  # T: [S, A, L, B]
+        v = v.reshape(S, A * L, B)
+        v = np.take_along_axis(v, self.idx_g1[None], axis=2)  # G1
+        v = v.reshape(S, A, L, B)
+        out = np.empty_like(v)
+        for a_out in range(A):  # S: per-lane select across planes
+            sel = self.sel_s[a_out]  # [L, B]
+            got = np.take_along_axis(
+                v, sel[None, None, :, :], axis=1
+            )[:, 0]
+            out[:, a_out] = got
+        v = out.reshape(S, A * L, B)
+        v = np.take_along_axis(v, self.idx_g2[None], axis=2)  # G2
+        v = v.reshape(S, A, L, B).transpose(0, 1, 3, 2)  # T⁻¹: [S, A, B, L]
+        v = v.reshape(S, A * B, L)
+        v = np.take_along_axis(v, self.idx_r2[None], axis=2)  # R2
+        return v.reshape(S, self.n)
+
+
+def plan_permutation(perm: np.ndarray, A: int, B: int = 128,
+                     L: int = 128) -> PermutationPlan:
+    """Compile ``out[t] = in[perm[t]]`` (perm a permutation of A*B*L) into
+    the 7-stage Clos plan."""
+    N = A * B * L
+    perm = np.asarray(perm, dtype=np.int64)
+    if perm.shape != (N,):
+        raise ValueError(f"perm must have shape ({N},), got {perm.shape}")
+    R = A * B
+
+    # element k := the element whose SOURCE flat position is perm[t_k]; we
+    # index elements by their destination t for convenience.
+    t = np.arange(N)
+    s = perm  # source flat position of the element destined for t
+    s_row, s_lane = s // L, s % L
+    t_row, t_lane = t // L, t % L
+
+    # ---- top level: rows = (a,b) [R rows of L lanes] -----------------------
+    # color = intermediate lane m; every source row and dest row sees each
+    # color exactly once (L-regular bipartite multigraph).
+    m = edge_color(s_row, t_row, R, R, L)
+
+    # R1: within source rows, move each element from s_lane to lane m
+    idx_r1 = np.empty((R, L), dtype=np.int32)
+    idx_r1[s_row, m] = s_lane
+    # M: per-lane m, row s_row → t_row : a permutation of R per lane
+    # R2: within dest rows, from lane m to t_lane
+    idx_r2 = np.empty((R, L), dtype=np.int32)
+    idx_r2[t_row, t_lane] = m
+
+    # ---- middle: per-lane permutation of rows, rows=(a,b) ------------------
+    # in transposed layout (b on lanes): positions (a, b) at fixed lane m.
+    # 3-stage again: within-(a)-rows over b  ∘  across-a select  ∘  within.
+    # Edge-color per lane: left = source a, right = dest a', degree B.
+    idx_g1 = np.empty((A, L, B), dtype=np.int32)
+    idx_g2 = np.empty((A, L, B), dtype=np.int32)
+    sel_s = np.empty((A, L, B), dtype=np.int32)
+    s_a, s_b = s_row // B, s_row % B
+    t_a, t_b = t_row // B, t_row % B
+    for lane in range(L):
+        k = np.flatnonzero(m == lane)  # elements using this lane: R of them
+        c = edge_color(s_a[k], t_a[k], A, A, B)  # intermediate b position
+        idx_g1[s_a[k], lane, c] = s_b[k]
+        sel_s[t_a[k], lane, c] = s_a[k]
+        idx_g2[t_a[k], lane, t_b[k]] = c
+
+    return PermutationPlan(
+        A=A, B=B, L=L,
+        idx_r1=idx_r1,
+        idx_g1=idx_g1.reshape(A * L, B),
+        sel_s=sel_s,
+        idx_g2=idx_g2.reshape(A * L, B),
+        idx_r2=idx_r2,
+    )
