@@ -113,15 +113,36 @@ def conflicted(
 
 
 class LocalSearchSolver(SynchronousTensorSolver):
-    """Base for local-search solvers: state = (x, aux...); random init."""
+    """Base for local-search solvers: state = (x, aux...); random init.
+
+    On TPU with an all-binary graph, plain (unweighted) local cost tables
+    are computed by the lane-packed pallas kernel
+    (ops.pallas_maxsum.packed_local_tables) via :meth:`local_tables`;
+    weighted variants (dba/gdba) keep the generic path.
+    """
 
     def __init__(self, dcop, tensors: ConstraintGraphTensors, algo_def:
-                 AlgorithmDef, seed: int = 0):
+                 AlgorithmDef, seed: int = 0, use_packed=None):
         super().__init__(dcop, tensors, algo_def, seed)
         # one value message to each neighbor per cycle (reference parity:
         # mgm/dsa broadcast their value each cycle)
         self.msgs_per_cycle = int(tensors.neighbor_src.shape[0])
         self.msg_size_per_msg = 1.0
+        self.packed = None
+        if use_packed is None:
+            use_packed = jax.default_backend() == "tpu"
+        if use_packed:
+            from pydcop_tpu.ops.pallas_maxsum import pack_for_pallas
+
+            self.packed = pack_for_pallas(tensors)
+
+    def local_tables(self, x: jnp.ndarray) -> jnp.ndarray:
+        """[V, D] local cost tables under the current assignment x."""
+        if self.packed is not None:
+            from pydcop_tpu.ops.pallas_maxsum import packed_local_tables
+
+            return packed_local_tables(self.packed, x)
+        return local_cost_tables(self.tensors, x)
 
     def initial_values(self, key) -> jnp.ndarray:
         return random_valid_values(self.tensors, key)

@@ -53,7 +53,8 @@ class ADsaSolver(LocalSearchSolver):
         )
         prefer_change = self.variant in ("B", "C")
         cur, best_val, gain, tables = gains_and_best(
-            self.tensors, x, prefer_change=prefer_change
+            self.tensors, x, tables=self.local_tables(x),
+            prefer_change=prefer_change,
         )
         activate = (
             jax.random.uniform(k_move, (self.tensors.n_vars,))

@@ -59,7 +59,9 @@ class DbaSolver(LocalSearchSolver):
     """State = (x, weights [n_factors])."""
 
     def __init__(self, dcop, tensors, algo_def, seed=0):
-        super().__init__(dcop, tensors, algo_def, seed)
+        # use_packed=False: breakout weights need the generic weighted
+        # local_cost_tables path
+        super().__init__(dcop, tensors, algo_def, seed, use_packed=False)
         self.indicators = _violation_tensors(tensors)
         # ok + improve message per neighbor pair per cycle
         self.msgs_per_cycle = 2 * int(tensors.neighbor_src.shape[0])

@@ -48,7 +48,8 @@ class DsaSolver(LocalSearchSolver):
         (x,) = state
         prefer_change = self.variant in ("B", "C")
         cur, best_val, gain, tables = gains_and_best(
-            self.tensors, x, prefer_change=prefer_change
+            self.tensors, x, tables=self.local_tables(x),
+            prefer_change=prefer_change,
         )
         activate = (
             jax.random.uniform(key, (self.tensors.n_vars,)) < self.probability

@@ -54,7 +54,9 @@ class GdbaSolver(LocalSearchSolver):
     """State = (x, [W_b per bucket])."""
 
     def __init__(self, dcop, tensors, algo_def, seed=0):
-        super().__init__(dcop, tensors, algo_def, seed)
+        # use_packed=False: breakout weights need the generic weighted
+        # local_cost_tables path
+        super().__init__(dcop, tensors, algo_def, seed, use_packed=False)
         self.modifier = self.params.get("modifier", "A")
         self.violation = self.params.get("violation", "NZ")
         self.increase_mode = self.params.get("increase_mode", "E")

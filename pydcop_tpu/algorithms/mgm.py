@@ -41,7 +41,9 @@ class MgmSolver(LocalSearchSolver):
 
     def cycle(self, state, key):
         (x,) = state
-        cur, best_val, gain, tables = gains_and_best(self.tensors, x)
+        cur, best_val, gain, tables = gains_and_best(
+            self.tensors, x, tables=self.local_tables(x)
+        )
         move = neighborhood_winner(self.tensors, gain)
         return (jnp.where(move, best_val, x).astype(jnp.int32),)
 

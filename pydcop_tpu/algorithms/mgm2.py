@@ -38,8 +38,7 @@ from pydcop_tpu.algorithms._local_search import (
     gains_and_best,
 )
 from pydcop_tpu.dcop.dcop import DCOP
-from pydcop_tpu.ops.compile import PAD_COST, compile_constraint_graph, \
-    local_cost_tables
+from pydcop_tpu.ops.compile import PAD_COST, compile_constraint_graph
 from pydcop_tpu.ops.segments import masked_argmin, segment_max, segment_min
 
 GRAPH_TYPE = "constraints_hypergraph"
@@ -98,7 +97,7 @@ class Mgm2Solver(LocalSearchSolver):
         t = self.tensors
         V, D = t.n_vars, t.max_domain_size
         me = jnp.arange(V)
-        tables = local_cost_tables(t, x)
+        tables = self.local_tables(x)
         cur, best_val, own_gain, _ = gains_and_best(t, x, tables=tables)
 
         if self.n_pairs == 0:

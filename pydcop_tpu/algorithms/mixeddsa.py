@@ -41,7 +41,8 @@ class MixedDsaSolver(LocalSearchSolver):
         (x,) = state
         prefer_change = self.variant in ("B", "C")
         cur, best_val, gain, tables = gains_and_best(
-            self.tensors, x, prefer_change=prefer_change
+            self.tensors, x, tables=self.local_tables(x),
+            prefer_change=prefer_change,
         )
         in_hard_conflict = conflicted(self.tensors, x, tables, HARD_THRESHOLD)
         proba = jnp.where(in_hard_conflict, self.proba_hard, self.proba_soft)

@@ -79,18 +79,21 @@ class PackedMaxSumGraph:
     N: int  # padded edge slots (= plan.n)
     plan: PermutationPlan  # mate routing
     buckets: Tuple[Tuple[int, int, int, int], ...]  # (cls, nvp, voff, soff)
-    cost_rows: jnp.ndarray  # [D*D, N]; row i*D+j = cost(d_tgt=i, d_oth=j)
+    # cost tables, OTHER-value-major: row j*D+i = cost(d_oth=j, d_tgt=i),
+    # so kernels slice cost[j*D:(j+1)*D] as the contiguous d_oth=j slab
+    cost_rows: jnp.ndarray  # [D*D, N]
     unary_p: jnp.ndarray  # [D, Vp]
     mask_p: jnp.ndarray  # [D, Vp] 1=valid value (0 on dummy vars)
     vmask: jnp.ndarray  # [D, N] mask_p spread to slots (0 on dummy slots)
     inv_dcount: jnp.ndarray  # [1, N] 1/|valid values| per slot (0 dummy)
     var_order: jnp.ndarray  # [n_vars] padded column of each original var
 
-    @property
-    def vmem_bytes(self) -> int:
-        return 4 * (
-            self.cost_rows.size + 4 * self.D * self.N + 3 * self.D * self.Vp
-        )
+
+def _vmem_estimate(D: int, N: int, Vp: int) -> int:
+    """Rough VMEM working-set bound of the cycle kernel: cost tables, q/r
+    in+out, ~2 permute-stage temporaries, belief-side arrays, and the 5
+    Clos plan index arrays (~5N int32)."""
+    return 4 * (D * D * N + 6 * D * N + 3 * D * Vp + 5 * N)
 
 
 def pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
@@ -288,3 +291,77 @@ def packed_values(pg: PackedMaxSumGraph, beliefs: jnp.ndarray) -> jnp.ndarray:
     big = jnp.where(pg.mask_p > 0, beliefs, PAD_COST)
     pvalues = jnp.argmin(big, axis=0).astype(jnp.int32)
     return pvalues[pg.var_order]
+
+
+def packed_local_tables(pg: PackedMaxSumGraph, x: jnp.ndarray,
+                        interpret: bool = False) -> jnp.ndarray:
+    """Local cost tables for the local-search family, lane-packed.
+
+    Same result as ops.compile.local_cost_tables on the source tensors
+    (out[v, d] = unary[v, d] + Σ_{factors containing v} cost(v=d | others
+    at x), PAD_COST at invalid slots), computed in one pallas kernel:
+    expand current values to slots, Clos-route each slot its factor's
+    other-endpoint value, select the matching cost row per slot, and
+    bucket-sum slots per variable — no XLA gather/segment ops.
+
+    x: [V] int32 value indices (original variable order) → [V, D] float32.
+    """
+    D, N, Vp = pg.D, pg.N, pg.Vp
+    # current value per padded column, as f32 broadcast over all D rows —
+    # keeps every in-kernel op on the same [D, *] shapes as _cycle_body
+    # (Mosaic rejects some 1-sublane-row layouts)
+    x_p = jnp.zeros((D, Vp), jnp.float32).at[:, pg.var_order].set(
+        x.astype(jnp.float32)[None, :]
+    )
+
+    def kern(xp_ref, cost_ref, unary_ref, c_r1, c_g1, c_ss, c_g2, c_r2,
+             t_out):
+        xp = xp_ref[:]
+        cost = cost_ref[:]
+        # expand values to slots (aligned repeats, as in _cycle_body)
+        parts = []
+        for cls, nvp, voff, soff in pg.buckets:
+            parts.extend([xp[:, voff: voff + nvp]] * cls)
+        xs = jnp.concatenate(parts, axis=1) if parts else xp
+        if xs.shape[1] < N:
+            xs = jnp.concatenate(
+                [xs, jnp.zeros((D, N - xs.shape[1]), xs.dtype)], axis=1
+            )
+        xo = _permute_in_kernel(
+            xs, pg.plan, D, (c_r1[:], c_g1[:], c_ss[:], c_g2[:], c_r2[:])
+        )
+        # per-slot cost row for the other endpoint's current value
+        contrib = cost[0: D, :]
+        for j in range(1, D):
+            contrib = jnp.where(
+                xo == float(j), cost[j * D: (j + 1) * D, :], contrib
+            )
+        # bucket-sum slots per variable (as in _cycle_body's beliefs)
+        bparts = []
+        voff_expect = 0
+        for cls, nvp, voff, soff in pg.buckets:
+            while voff_expect < voff:
+                bparts.append(jnp.zeros((D, _LANES), dtype=contrib.dtype))
+                voff_expect += _LANES
+            acc = contrib[:, soff: soff + nvp]
+            for k in range(1, cls):
+                acc = acc + contrib[:, soff + k * nvp: soff + (k + 1) * nvp]
+            bparts.append(acc)
+            voff_expect += nvp
+        while voff_expect < Vp:
+            bparts.append(jnp.zeros((D, _LANES), dtype=contrib.dtype))
+            voff_expect += _LANES
+        t_out[:] = unary_ref[:] + (
+            bparts[0] if len(bparts) == 1 else jnp.concatenate(bparts, axis=1)
+        )
+
+    tables_p = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((D, Vp), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 8,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x_p, pg.cost_rows, pg.unary_p, *_plan_consts(pg.plan))
+    tables = tables_p[:, pg.var_order].T  # [V, D] original order
+    mask = pg.mask_p[:, pg.var_order].T
+    return jnp.where(mask > 0, tables, PAD_COST)

@@ -101,6 +101,21 @@ class TestPackedEngine:
         assert np.allclose(np.asarray(bel), belp_orig, atol=1e-4)
         assert np.array_equal(np.asarray(vals), np.asarray(valsp))
 
+    def test_local_tables_match_generic(self):
+        from pydcop_tpu.ops.compile import local_cost_tables
+        from pydcop_tpu.ops.pallas_maxsum import packed_local_tables
+
+        t = _random_binary_instance(V=50, F=120, D=3, seed=5)
+        pg = pack_for_pallas(t)
+        rng = np.random.default_rng(2)
+        x = np.asarray(rng.integers(0, 3, 50), dtype=np.int32)
+        import jax.numpy as jnp
+
+        ref = np.asarray(local_cost_tables(t, jnp.asarray(x)))
+        got = np.asarray(packed_local_tables(pg, jnp.asarray(x),
+                                             interpret=True))
+        assert np.allclose(ref, got, atol=1e-4)
+
     def test_packed_values_respects_domain_mask(self):
         # variables with smaller domains must never select padded values
         rng = np.random.default_rng(1)
