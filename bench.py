@@ -93,6 +93,10 @@ def main():
         "--stretch", action="store_true",
         help="100k-var / 300k-edge instance via the direct array compiler",
     )
+    ap.add_argument(
+        "--engine", choices=["auto", "generic", "packed"], default="auto",
+        help="force an engine (auto = packed on TPU when applicable)",
+    )
     ap.add_argument("--watchdog", type=float, default=900.0)
     args = ap.parse_args()
     if args.stretch:
@@ -108,7 +112,7 @@ def main():
     from pydcop_tpu.ops import compile_factor_graph
     from pydcop_tpu.ops.maxsum_kernels import init_messages, maxsum_cycle
     from pydcop_tpu.ops.pallas_maxsum import (
-        pack_for_pallas, packed_cycle, packed_init_state,
+        packed_cycle, packed_init_state, try_pack_for_pallas,
     )
 
     if args.stretch:
@@ -141,8 +145,19 @@ def main():
 
     # engine: lane-packed pallas kernel on TPU (binary graphs), else generic
     packed = None
-    if jax.default_backend() == "tpu":
-        packed = pack_for_pallas(tensors)
+    if args.engine == "packed":
+        packed = try_pack_for_pallas(tensors)
+        if packed is None:
+            if watchdog is not None:
+                watchdog.cancel()
+            print(json.dumps({
+                "metric": metric, "value": 0.0, "unit": "iters/s",
+                "vs_baseline": 0.0,
+                "error": "--engine packed: graph not packable",
+            }), flush=True)
+            raise SystemExit(1)
+    elif args.engine == "auto" and jax.default_backend() == "tpu":
+        packed = try_pack_for_pallas(tensors)
 
     @jax.jit
     def run_n(q, r):

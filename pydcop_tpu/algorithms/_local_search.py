@@ -132,9 +132,9 @@ class LocalSearchSolver(SynchronousTensorSolver):
         if use_packed is None:
             use_packed = jax.default_backend() == "tpu"
         if use_packed:
-            from pydcop_tpu.ops.pallas_maxsum import pack_for_pallas
+            from pydcop_tpu.ops.pallas_maxsum import try_pack_for_pallas
 
-            self.packed = pack_for_pallas(tensors)
+            self.packed = try_pack_for_pallas(tensors)
 
     def local_tables(self, x: jnp.ndarray) -> jnp.ndarray:
         """[V, D] local cost tables under the current assignment x."""

@@ -88,9 +88,9 @@ class MaxSumSolver(SynchronousTensorSolver):
         if use_packed is None:
             use_packed = jax.default_backend() == "tpu"
         if use_packed:
-            from pydcop_tpu.ops.pallas_maxsum import pack_for_pallas
+            from pydcop_tpu.ops.pallas_maxsum import try_pack_for_pallas
 
-            self.packed = pack_for_pallas(self.tensors)
+            self.packed = try_pack_for_pallas(self.tensors)
 
     def initial_state(self):
         if self.packed is not None:

@@ -61,7 +61,11 @@ def run_cmd(args):
     from pydcop_tpu.dcop import load_dcop_from_file
     from pydcop_tpu.runtime import solve_result
 
-    dcop = load_dcop_from_file(args.dcop_files)
+    try:
+        dcop = load_dcop_from_file(args.dcop_files)
+    except Exception as e:
+        output_metrics({"status": "ERROR", "error": str(e)}, args.output)
+        return 1
     algo_params = parse_algo_params(args.algo_params)
 
     distribution = args.distribution

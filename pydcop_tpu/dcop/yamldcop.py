@@ -89,6 +89,10 @@ def load_dcop(dcop_str: str) -> DCOP:
     loaded = yaml.safe_load(dcop_str)
     if not loaded:
         raise DcopInvalidFormatError("Empty DCOP definition")
+    if not isinstance(loaded, dict) or not loaded.get("variables"):
+        raise DcopInvalidFormatError(
+            "Invalid DCOP definition: no 'variables' section"
+        )
     dcop = DCOP(
         name=loaded.get("name", "dcop"),
         objective=loaded.get("objective", "min"),

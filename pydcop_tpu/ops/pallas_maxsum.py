@@ -52,6 +52,13 @@ _VMEM_BUDGET = 13 * 2**20  # leave headroom under ~16MB
 
 
 _MAX_BUCKETS = 24
+# _cycle_body / packed_local_tables unroll a python loop of `cls` slice-adds
+# per degree bucket; a scale-free hub with degree in the thousands would blow
+# trace/compile time and kernel size, so above this slot class we fall back
+# to the generic engine (same spirit as the A>8 guard).  Known limitation:
+# one hub knocks the whole graph off the packed engine — splitting hub slots
+# across multiple padded columns would keep the rest packed (future work).
+_MAX_SLOT_CLASS = 96
 
 
 def _degree_classes(deg: np.ndarray) -> np.ndarray:
@@ -88,12 +95,38 @@ class PackedMaxSumGraph:
     inv_dcount: jnp.ndarray  # [1, N] 1/|valid values| per slot (0 dummy)
     var_order: jnp.ndarray  # [n_vars] padded column of each original var
 
+    @property
+    def vmem_bytes(self) -> int:
+        return _vmem_estimate(self.D, self.N, self.Vp)
+
 
 def _vmem_estimate(D: int, N: int, Vp: int) -> int:
     """Rough VMEM working-set bound of the cycle kernel: cost tables, q/r
-    in+out, ~2 permute-stage temporaries, belief-side arrays, and the 5
-    Clos plan index arrays (~5N int32)."""
-    return 4 * (D * D * N + 6 * D * N + 3 * D * Vp + 5 * N)
+    in+out, ~2 permute-stage temporaries, belief-side arrays, the 5 Clos
+    plan index arrays (~5N int32), plus the A-way select stage of the
+    permutation which materializes up to A candidate [D, TILE] planes
+    (A*_TILE == N, so that term is one extra D*N)."""
+    return 4 * (D * D * N + 7 * D * N + 3 * D * Vp + 5 * N)
+
+
+def try_pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
+    """Fail-safe engine selection: any packing bug degrades to the generic
+    engine (with a logged warning) instead of taking the solve down.  Solvers
+    must use this, never :func:`pack_for_pallas` directly — a broken packed
+    engine on TPU would otherwise crash every solve on the target hardware."""
+    try:
+        return pack_for_pallas(t)
+    except Exception:  # noqa: BLE001 — deliberate blanket fallback
+        import logging
+
+        # ERROR, not WARNING: the CLI default log level is ERROR, and a
+        # silent drop to the generic engine is a large perf cliff the user
+        # must be able to see without benchmarking
+        logging.getLogger(__name__).error(
+            "pack_for_pallas failed; falling back to the generic engine",
+            exc_info=True,
+        )
+        return None
 
 
 def pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
@@ -111,6 +144,8 @@ def pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
 
     # group variables by slot class (≈ exact degree, quantized when many)
     cls_of = _degree_classes(deg)
+    if cls_of.max(initial=0) > _MAX_SLOT_CLASS:
+        return None  # hub degree would unroll too far; generic engine
     buckets: List[Tuple[int, int, int, int]] = []
     var_pcol = np.empty(V, dtype=np.int64)  # original var -> padded column
     order_parts: List[np.ndarray] = []
@@ -189,6 +224,18 @@ def pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
     return pg
 
 
+def _resolve_interpret(interpret: Optional[bool]) -> bool:
+    """Default to interpret mode when the actual devices are not TPUs, so
+    solvers whose engine selection chose the packed path (e.g. in tests that
+    monkeypatch the backend) still execute correctly on CPU."""
+    if interpret is not None:
+        return interpret
+    try:
+        return jax.devices()[0].platform != "tpu"
+    except Exception:  # pragma: no cover - device init failure
+        return True
+
+
 def packed_init_state(pg: PackedMaxSumGraph
                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     z = jnp.zeros((pg.D, pg.N), dtype=jnp.float32)
@@ -253,10 +300,11 @@ def packed_cycle(
     q: jnp.ndarray,
     r: jnp.ndarray,
     damping: float = 0.0,
-    interpret: bool = False,
+    interpret: Optional[bool] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One fused MaxSum cycle.  Returns (q', r', beliefs [D,Vp], values [V])
     with values in ORIGINAL variable order."""
+    interpret = _resolve_interpret(interpret)
     D, N, Vp = pg.D, pg.N, pg.Vp
 
     def kern(q_ref, r_ref, cost_ref, unary_ref, vmask_ref,
@@ -294,7 +342,7 @@ def packed_values(pg: PackedMaxSumGraph, beliefs: jnp.ndarray) -> jnp.ndarray:
 
 
 def packed_local_tables(pg: PackedMaxSumGraph, x: jnp.ndarray,
-                        interpret: bool = False) -> jnp.ndarray:
+                        interpret: Optional[bool] = None) -> jnp.ndarray:
     """Local cost tables for the local-search family, lane-packed.
 
     Same result as ops.compile.local_cost_tables on the source tensors
@@ -306,6 +354,7 @@ def packed_local_tables(pg: PackedMaxSumGraph, x: jnp.ndarray,
 
     x: [V] int32 value indices (original variable order) → [V, D] float32.
     """
+    interpret = _resolve_interpret(interpret)
     D, N, Vp = pg.D, pg.N, pg.Vp
     # current value per padded column, as f32 broadcast over all D rows —
     # keeps every in-kernel op on the same [D, *] shapes as _cycle_body

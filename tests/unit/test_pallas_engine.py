@@ -17,6 +17,7 @@ from pydcop_tpu.ops.pallas_maxsum import (
     packed_cycle,
     packed_init_state,
     packed_values,
+    try_pack_for_pallas,
 )
 
 
@@ -116,6 +117,24 @@ class TestPackedEngine:
                                              interpret=True))
         assert np.allclose(ref, got, atol=1e-4)
 
+    def test_vmem_bytes_property(self):
+        t = _random_binary_instance()
+        pg = pack_for_pallas(t)
+        assert isinstance(pg.vmem_bytes, int) and pg.vmem_bytes > 0
+
+    def test_pack_rejects_huge_hub_degree(self):
+        # a star graph: center variable degree far above _MAX_SLOT_CLASS
+        # would unroll thousands of slice-adds per bucket — must fall back
+        from pydcop_tpu.ops.pallas_maxsum import _MAX_SLOT_CLASS
+
+        rng = np.random.default_rng(7)
+        F = _MAX_SLOT_CLASS + 50
+        ei = np.zeros(F, dtype=np.int64)
+        ej = np.arange(1, F + 1)
+        mats = rng.uniform(0, 1, (F, 3, 3)).astype(np.float32)
+        t = compile_binary_from_arrays(ei, ej, mats, F + 1)
+        assert pack_for_pallas(t) is None
+
     def test_packed_values_respects_domain_mask(self):
         # variables with smaller domains must never select padded values
         rng = np.random.default_rng(1)
@@ -139,3 +158,73 @@ class TestPackedEngine:
         vals = np.asarray(valsp)
         assert (vals[::2] < 2).all()
         assert (vals < D).all()
+
+
+class TestEngineSelection:
+    """The round-1 regression class: the TPU branch of engine selection was
+    never executed in CI and shipped broken.  These tests drive the exact
+    branch solvers take on TPU hardware (backend monkeypatched to "tpu";
+    the packed kernels auto-run in interpret mode off-TPU)."""
+
+    def _coloring_dcop(self):
+        from pydcop_tpu.generators import generate_graph_coloring
+
+        return generate_graph_coloring(
+            n_variables=25, n_colors=3, n_edges=60, soft=True,
+            n_agents=1, seed=3,
+        )
+
+    def test_maxsum_tpu_branch_solves(self, monkeypatch):
+        import jax
+
+        from pydcop_tpu.algorithms.maxsum import build_solver
+
+        dcop = self._coloring_dcop()
+        generic = build_solver(dcop)
+        assert generic.packed is None  # CPU backend → generic engine
+        ref = generic.run(cycles=10)
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        solver = build_solver(dcop)
+        assert solver.packed is not None  # TPU branch picked the engine
+        got = solver.run(cycles=10)
+        assert got.status == "FINISHED"
+        # engines sum beliefs in different fp orders, so near-tied argmins
+        # may flip; cost equivalence is the robust invariant
+        assert got.cost == pytest.approx(ref.cost, rel=1e-3)
+
+    def test_local_search_tpu_branch_solves(self, monkeypatch):
+        import jax
+
+        from pydcop_tpu.algorithms.mgm import build_solver
+
+        dcop = self._coloring_dcop()
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        solver = build_solver(dcop)
+        got = solver.run(cycles=8)
+        assert got.status == "FINISHED"
+        assert got.cost is not None
+
+    def test_packing_error_falls_back_to_generic(self, monkeypatch):
+        import pydcop_tpu.ops.pallas_maxsum as pm
+        from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+        from pydcop_tpu.algorithms import AlgorithmDef
+        from pydcop_tpu.algorithms.maxsum import algo_params
+        from pydcop_tpu.ops.compile import compile_factor_graph
+
+        def boom(t):
+            raise AttributeError("simulated packing regression")
+
+        monkeypatch.setattr(pm, "pack_for_pallas", boom)
+        assert try_pack_for_pallas(None) is None
+
+        dcop = self._coloring_dcop()
+        algo = AlgorithmDef.build_with_default_params(
+            "maxsum", parameters_definitions=algo_params
+        )
+        solver = MaxSumSolver(
+            dcop, compile_factor_graph(dcop), algo, use_packed=True
+        )
+        assert solver.packed is None  # degraded, not crashed
+        res = solver.run(cycles=5)
+        assert res.status == "FINISHED"
