@@ -1,31 +1,50 @@
 #!/usr/bin/env python
-"""Benchmark: MaxSum message-passing iterations/sec on a 10k-variable random
-graph (the BASELINE.md primary metric).
+"""Benchmark driver.  Prints ONE JSON line:
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "iters/s", "vs_baseline": R}
+  {"metric": ..., "value": N, "unit": "iters/s", "vs_baseline": R,
+   "extra": {...secondary metrics...}}
 
-vs_baseline compares against a freshly-measured reference-equivalent
-python implementation of the same factor-update math (the reference's
-factor_costs_for_var enumerates the cross product of neighbor domains in
-python per factor per cycle — pydcop/algorithms/maxsum.py:345-423); its
-per-cycle time is measured on a factor subsample here and extrapolated to
-the full graph.  Runs on the default JAX backend (the TPU under the
-driver).
+Primary metric (BASELINE.md): MaxSum message-passing iterations/sec on a
+10k-variable / 30k-edge random graph-coloring instance, on the default
+JAX backend (the TPU under the driver).
+
+Secondary metrics in "extra" (all BASELINE.md / VERDICT round-2 asks):
+  * dpop_tables_per_sec_10000var — DPOP UTIL+VALUE batched sweep on a
+    10k-node random tree, D=10 (second primary in BASELINE.md).
+  * mgm_cycles_per_sec_10000var / dsa_cycles_per_sec_10000var — local
+    search family on the same 10k coloring instance.
+  * sharded_maxsum_iters_per_sec_8dev — ShardedMaxSum on a virtual
+    8-device CPU mesh (subprocess), regression canary for the mesh path.
+  * stretch_* — North star: MaxSum convergence on 100k-var/300k-edge
+    coloring; wall-clock to a stable assignment (target < 10 s).
+
+vs_baseline for the primary compares against a freshly-measured
+reference-equivalent python implementation of the same factor-update
+math (pydcop/algorithms/maxsum.py:345-423 enumerates the neighbor-domain
+cross product in python), measured on a factor subsample here and
+extrapolated.  See BENCHREF.md for the honest end-to-end reference CLI
+baseline (VERDICT item 10).
 """
 from __future__ import annotations
 
 import argparse
 import itertools
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 
+# --------------------------------------------------------------------------
+# reference-equivalent python baselines (measured, not hardcoded)
+# --------------------------------------------------------------------------
+
 def python_reference_cycle_time(tensors, sample: int = 200) -> float:
-    """Seconds per full message-passing cycle for a python-loop
-    implementation of the factor update (reference-equivalent math)."""
+    """Seconds per full MaxSum cycle for a python-loop implementation of
+    the factor update (reference-equivalent math)."""
     b = max(tensors.buckets, key=lambda b: b.n_factors)
     t_np = np.asarray(b.tensors)
     n = min(sample, b.n_factors)
@@ -53,25 +72,45 @@ def python_reference_cycle_time(tensors, sample: int = 200) -> float:
     return per_factor * total_factors
 
 
+def python_reference_dpop_time(D: int, n_nodes: int, n_children: int = 1,
+                               sample: int = 200) -> float:
+    """Seconds for a python-loop UTIL join+project over n_nodes tree
+    nodes (reference-equivalent math: relations.py:1622-1706 enumerates
+    every assignment of the joined dims)."""
+    rng = np.random.default_rng(0)
+    cost = rng.uniform(0, 10, (D, D))
+    unary = rng.uniform(0, 1, D)
+    child_msgs = [rng.uniform(0, 10, D) for _ in range(n_children)]
+    t0 = time.perf_counter()
+    for _ in range(sample):
+        table = [[0.0] * D for _ in range(D)]
+        for own in range(D):
+            for par in range(D):
+                v = unary[own] + cost[own][par]
+                for m in child_msgs:
+                    v += m[own]
+                table[own][par] = v
+        msg = [min(table[own][par] for own in range(D)) for par in range(D)]
+        del msg
+    per_node = (time.perf_counter() - t0) / sample
+    return per_node * n_nodes
+
+
+# --------------------------------------------------------------------------
+# watchdog: guarantee the one-JSON-line contract even if the device wedges
+# --------------------------------------------------------------------------
+
 def _arm_watchdog(seconds: float, metric: str):
-    """Guarantee the one-JSON-line contract even if device init wedges
-    (the tunneled TPU is single-tenant; a stale claim can block forever).
-    Returns the Timer so the success path can cancel it."""
-    import os
     import threading
 
     def fire():
         print(
-            json.dumps(
-                {
-                    "metric": metric,
-                    "value": 0.0,
-                    "unit": "iters/s",
-                    "vs_baseline": 0.0,
-                    "error": f"watchdog: no result within {seconds}s "
-                    "(device init or run wedged)",
-                }
-            ),
+            json.dumps({
+                "metric": metric, "value": 0.0, "unit": "iters/s",
+                "vs_baseline": 0.0,
+                "error": f"watchdog: no result within {seconds}s "
+                "(device init or run wedged)",
+            }),
             flush=True,
         )
         os._exit(3)
@@ -82,80 +121,54 @@ def _arm_watchdog(seconds: float, metric: str):
     return t
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--vars", type=int, default=10_000)
-    ap.add_argument("--edges", type=int, default=30_000)
-    ap.add_argument("--colors", type=int, default=3)
-    ap.add_argument("--cycles", type=int, default=50)
-    ap.add_argument("--repeat", type=int, default=3)
-    ap.add_argument(
-        "--stretch", action="store_true",
-        help="100k-var / 300k-edge instance via the direct array compiler",
-    )
-    ap.add_argument(
-        "--engine", choices=["auto", "generic", "packed"], default="auto",
-        help="force an engine (auto = packed on TPU when applicable)",
-    )
-    ap.add_argument("--watchdog", type=float, default=900.0)
-    args = ap.parse_args()
-    if args.stretch:
-        args.vars, args.edges = 100_000, 300_000
-    metric = f"maxsum_iters_per_sec_{args.vars}var_{args.edges}edge"
-    watchdog = None
-    if args.watchdog:
-        watchdog = _arm_watchdog(args.watchdog, metric)
+# --------------------------------------------------------------------------
+# benchmark parts
+# --------------------------------------------------------------------------
 
+class BenchAbort(RuntimeError):
+    """Raised when a requested bench configuration cannot run; main()
+    turns it into the contractual one-JSON-line error output."""
+
+
+def build_stretch_tensors(args):
+    """The 100k-var / 300k-edge coloring instance (single source for the
+    --stretch compat mode and the convergence bench — same rng(1) data)."""
+    from pydcop_tpu.ops.compile import compile_binary_from_arrays
+
+    V, E, C = args.stretch_vars, args.stretch_edges, args.colors
+    rng = np.random.default_rng(1)
+    edge_i = rng.integers(0, V, E)
+    edge_j = (edge_i + 1 + rng.integers(0, V - 1, E)) % V
+    mats = rng.uniform(0, 1, (E, C, C)).astype(np.float32)
+    mats += np.eye(C, dtype=np.float32) * 10  # coloring penalty
+    return compile_binary_from_arrays(
+        edge_i, edge_j, mats, V,
+        unary=rng.uniform(0, 0.01, (V, C)).astype(np.float32),
+    )
+
+
+def bench_maxsum(args):
+    """Primary metric + the tensors for the local-search benches."""
     import jax
-    import jax.numpy as jnp
 
     from pydcop_tpu.ops import compile_factor_graph
     from pydcop_tpu.ops.maxsum_kernels import init_messages, maxsum_cycle
     from pydcop_tpu.ops.pallas_maxsum import (
         packed_cycle, packed_init_state, try_pack_for_pallas,
     )
+    from pydcop_tpu.generators import generate_graph_coloring
 
-    if args.stretch:
-        from pydcop_tpu.ops.compile import compile_binary_from_arrays
+    dcop = generate_graph_coloring(
+        n_variables=args.vars, n_colors=args.colors, n_edges=args.edges,
+        soft=True, n_agents=1, seed=1,
+    )
+    tensors = compile_factor_graph(dcop)
 
-        rng = np.random.default_rng(1)
-        edge_i = rng.integers(0, args.vars, args.edges)
-        edge_j = (edge_i + 1 + rng.integers(
-            0, args.vars - 1, args.edges)) % args.vars
-        mats = rng.uniform(0, 1, (args.edges, args.colors, args.colors))
-        mats += np.eye(args.colors) * 10  # coloring penalty
-        tensors = compile_binary_from_arrays(
-            edge_i, edge_j, mats.astype(np.float32), args.vars,
-            unary=rng.uniform(0, 0.01, (args.vars, args.colors)).astype(
-                np.float32
-            ),
-        )
-    else:
-        from pydcop_tpu.generators import generate_graph_coloring
-
-        dcop = generate_graph_coloring(
-            n_variables=args.vars,
-            n_colors=args.colors,
-            n_edges=args.edges,
-            soft=True,
-            n_agents=1,
-            seed=1,
-        )
-        tensors = compile_factor_graph(dcop)
-
-    # engine: lane-packed pallas kernel on TPU (binary graphs), else generic
     packed = None
     if args.engine == "packed":
         packed = try_pack_for_pallas(tensors)
         if packed is None:
-            if watchdog is not None:
-                watchdog.cancel()
-            print(json.dumps({
-                "metric": metric, "value": 0.0, "unit": "iters/s",
-                "vs_baseline": 0.0,
-                "error": "--engine packed: graph not packable",
-            }), flush=True)
-            raise SystemExit(1)
+            raise BenchAbort("--engine packed: graph not packable")
     elif args.engine == "auto" and jax.default_backend() == "tpu":
         packed = try_pack_for_pallas(tensors)
 
@@ -176,38 +189,384 @@ def main():
         packed_init_state(packed) if packed is not None
         else init_messages(tensors)
     )
-    # warmup / compile
-    q, r = run_n(q0, r0)
+    q, r = run_n(q0, r0)  # warmup / compile
     jax.block_until_ready((q, r))
-
     times = []
     for _ in range(args.repeat):
         t0 = time.perf_counter()
         q, r = run_n(q0, r0)
         jax.block_until_ready((q, r))
         times.append(time.perf_counter() - t0)
-    best = min(times)
-    iters_per_sec = args.cycles / best
+    iters_per_sec = args.cycles / min(times)
 
     ref_cycle_s = python_reference_cycle_time(tensors)
-    ref_iters_per_sec = 1.0 / ref_cycle_s if ref_cycle_s > 0 else 0.0
-    vs_baseline = (
-        iters_per_sec / ref_iters_per_sec if ref_iters_per_sec else 0.0
-    )
+    vs = iters_per_sec * ref_cycle_s if ref_cycle_s > 0 else 0.0
+    return iters_per_sec, vs, dcop, tensors
 
-    if watchdog is not None:
-        watchdog.cancel()
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(iters_per_sec, 2),
-                "unit": "iters/s",
-                "vs_baseline": round(vs_baseline, 2),
-            }
-        ),
-        flush=True,
+
+def bench_dpop(args):
+    """DPOP UTIL+VALUE cost-tables/sec on a 10k-node random tree, D=10
+    (BASELINE.md second primary metric), batched sweep engine."""
+    import jax
+
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+    from pydcop_tpu.graph import pseudotree
+    from pydcop_tpu.ops.dpop_sweep import compile_sweep, make_sweep_fn
+
+    N, D = args.dpop_vars, args.dpop_domain
+    rng = np.random.default_rng(2)
+    dcop = DCOP("dpop_bench", objective="min")
+    dom = Domain("d", "vals", list(range(D)))
+    vs = [Variable(f"v{i}", dom) for i in range(N)]
+    for v in vs:
+        dcop.add_variable(v)
+    parents = [int(rng.integers(0, i)) for i in range(1, N)]
+    mats = rng.uniform(0, 10, (N - 1, D, D)).astype(np.float32)
+    for i, p in enumerate(parents):
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[p], vs[i + 1]], mats[i], name=f"c{i}")
+        )
+    dcop.add_agents([AgentDef("a0")])
+
+    tree = pseudotree.build_computation_graph(dcop)
+    plan = compile_sweep(tree, dcop, "min")
+    if plan is None:
+        raise RuntimeError("dpop bench instance not sweepable")
+    fn, dev_args = make_sweep_fn(plan)
+    out = fn(*dev_args)  # warmup / compile
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(args.repeat):
+        t0 = time.perf_counter()
+        out = fn(*dev_args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    tables_per_sec = plan.n_nodes / min(times)
+
+    mean_children = (N - 1) / max(1, len(set(parents)))
+    ref_s = python_reference_dpop_time(D, N, n_children=round(mean_children))
+    vs = tables_per_sec * (ref_s / N) if ref_s > 0 else 0.0
+    return tables_per_sec, vs, plan
+
+
+def bench_local_search(dcop, algo: str, cycles: int = 50):
+    """MGM / DSA cycles per second on the 10k coloring instance."""
+    from pydcop_tpu.algorithms import AlgorithmDef, load_algorithm_module
+
+    mod = load_algorithm_module(algo)
+    algo_def = AlgorithmDef.build_with_default_params(algo)
+    solver = mod.build_solver(dcop, algo_def=algo_def)
+    solver.run(cycles=cycles, chunk=cycles)  # warmup incl. compile
+    res = solver.run(cycles=cycles, chunk=cycles)
+    return cycles / res.time
+
+
+def bench_convergence_stretch(args):
+    """North star: wall-clock to MaxSum convergence on the 100k-var /
+    300k-edge coloring instance.
+
+    Three convergence criteria, checked in-device per chunk:
+      * ``assignment`` — strict: no variable changed its value;
+      * ``messages`` — the reference's own test (approx_match within
+        STABILITY_COEFF=0.1 for SAME_COUNT=4 cycles,
+        pydcop/algorithms/maxsum.py:98-100,620): every r-message stable;
+      * ``cost`` — anytime plateau: best cost not improved by >0.1%
+        for 5 consecutive chunks.
+    On frustrated random instances plain BP oscillates (strict stability
+    never fires — measured); the plateau criterion captures what the
+    anytime solver delivers, the message criterion is reference parity.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pydcop_tpu.ops.compile import total_cost
+    from pydcop_tpu.ops.maxsum_kernels import init_messages, maxsum_cycle
+
+    V, E = args.stretch_vars, args.stretch_edges
+    tensors = build_stretch_tensors(args)
+
+    chunk = 10
+    damping = 0.9  # measured best for convergence on the 100k instance
+    STABILITY_COEFF = 0.1  # reference maxsum.py:98
+
+    @jax.jit
+    def run_chunk(q, r, prev_vals, msg_stable_in):
+        def body(carry, _):
+            q, r, msg_stable = carry
+            q2, r2, _, _ = maxsum_cycle(tensors, q, r, damping=damping)
+            # reference approx_match: relative diff within 10%
+            rel = jnp.abs(r2 - r) / (jnp.abs(r) + 1e-6)
+            all_stable = jnp.all(rel <= STABILITY_COEFF)
+            msg_stable = jnp.where(all_stable, msg_stable + 1, 0)
+            return (q2, r2, msg_stable), ()
+
+        (q, r, msg_stable), _ = jax.lax.scan(
+            body, (q, r, msg_stable_in), None, length=chunk
+        )
+        _, _, beliefs, values = maxsum_cycle(tensors, q, r, damping=damping)
+        changed = jnp.sum(values != prev_vals)
+        return q, r, values, changed, msg_stable, total_cost(
+            tensors, values)
+
+    q, r = init_messages(tensors)
+    zero_vals = jnp.zeros(V, dtype=jnp.int32)
+    zero_stab = jnp.zeros((), dtype=jnp.int32)
+    out = run_chunk(q, r, zero_vals, zero_stab)  # warmup / compile
+    jax.block_until_ready(out)
+
+    q, r = init_messages(tensors)
+    t0 = time.perf_counter()
+    prev_vals = zero_vals
+    msg_stable = zero_stab
+    converged = None
+    cycles_run = 0
+    best_cost = float("inf")
+    plateau = 0
+    final_cost = None
+    for _ in range(args.stretch_max_cycles // chunk):
+        q, r, prev_vals, changed, msg_stable, cost = run_chunk(
+            q, r, prev_vals, msg_stable
+        )
+        cycles_run += chunk
+        changed = int(changed)
+        final_cost = float(cost)
+        if changed == 0:
+            converged = "assignment"
+            break
+        if int(msg_stable) >= 4:  # reference SAME_COUNT, maxsum.py:100
+            converged = "messages"
+            break
+        if final_cost >= best_cost * (1 - 1e-3):
+            plateau += 1
+            if plateau >= 5:
+                converged = "cost_plateau"
+                break
+        else:
+            plateau = 0
+        best_cost = min(best_cost, final_cost)
+    wall = time.perf_counter() - t0
+    return {
+        "stretch_vars": V,
+        "stretch_edges": E,
+        "stretch_wall_s": round(wall, 3),
+        "stretch_converged": converged is not None,
+        "stretch_criterion": converged,
+        "stretch_cycles": cycles_run,
+        "stretch_final_cost": round(final_cost, 1),
+    }
+
+
+def bench_sharded_subprocess(args):
+    """ShardedMaxSum on a virtual 8-device CPU mesh, in a subprocess so
+    the forced-CPU platform doesn't poison this process's TPU backend."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--only", "sharded-inner",
+         "--vars", str(args.sharded_vars), "--edges",
+         str(args.sharded_vars * 3), "--watchdog", "0"],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
     )
+    lines = out.stdout.strip().splitlines()
+    if not lines:
+        raise RuntimeError(
+            f"sharded subprocess produced no output (rc={out.returncode}): "
+            + out.stderr.strip()[-400:]
+        )
+    return json.loads(lines[-1])
+
+
+def bench_sharded_inner(args):
+    """Runs inside the CPU-mesh subprocess."""
+    # sitecustomize clobbers JAX_PLATFORMS; jax.config (pre-backend-init)
+    # is the only override that sticks (same pattern as __graft_entry__)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from pydcop_tpu.generators import generate_graph_coloring
+    from pydcop_tpu.ops import compile_factor_graph
+    from pydcop_tpu.parallel.mesh import ShardedMaxSum, build_mesh
+
+    dcop = generate_graph_coloring(
+        n_variables=args.vars, n_colors=args.colors, n_edges=args.edges,
+        soft=True, n_agents=1, seed=1,
+    )
+    tensors = compile_factor_graph(dcop)
+    sharded = ShardedMaxSum(tensors, build_mesh(8), damping=0.5)
+    cycles = 20
+    sharded.run(cycles=cycles)  # warmup / compile
+    t0 = time.perf_counter()
+    sharded.run(cycles=cycles)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": f"sharded_maxsum_iters_per_sec_8dev_{args.vars}var",
+        "value": round(cycles / dt, 2), "unit": "iters/s",
+        "n_devices": len(jax.devices()),
+    }), flush=True)
+
+
+# --------------------------------------------------------------------------
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vars", type=int, default=10_000)
+    ap.add_argument("--edges", type=int, default=30_000)
+    ap.add_argument("--colors", type=int, default=3)
+    ap.add_argument("--cycles", type=int, default=50)
+    ap.add_argument("--repeat", type=int, default=3)
+    ap.add_argument("--dpop-vars", type=int, default=10_000)
+    ap.add_argument("--dpop-domain", type=int, default=10)
+    ap.add_argument("--stretch-vars", type=int, default=100_000)
+    ap.add_argument("--stretch-edges", type=int, default=300_000)
+    ap.add_argument("--stretch-max-cycles", type=int, default=400)
+    ap.add_argument("--sharded-vars", type=int, default=2_000)
+    ap.add_argument(
+        "--stretch", action="store_true",
+        help="compat: run ONLY the 100k stretch instance as primary",
+    )
+    ap.add_argument(
+        "--engine", choices=["auto", "generic", "packed"], default="auto",
+        help="force a maxsum engine (auto = packed on TPU when applicable)",
+    )
+    ap.add_argument(
+        "--only",
+        choices=["all", "maxsum", "dpop", "convergence", "local",
+                 "sharded", "sharded-inner"],
+        default="all",
+    )
+    ap.add_argument("--watchdog", type=float, default=900.0)
+    args = ap.parse_args()
+
+    if args.only == "sharded-inner":
+        bench_sharded_inner(args)
+        return
+
+    if args.stretch:
+        # the watchdog (and output) must name the instance actually run
+        metric = (f"maxsum_iters_per_sec_{args.stretch_vars}var_"
+                  f"{args.stretch_edges}edge")
+    else:
+        metric = f"maxsum_iters_per_sec_{args.vars}var_{args.edges}edge"
+    watchdog = _arm_watchdog(args.watchdog, metric) if args.watchdog else None
+
+    if args.stretch:
+        # compat mode: the 100k instance timed as plain iters/s
+        import jax
+        from pydcop_tpu.ops.maxsum_kernels import init_messages, maxsum_cycle
+
+        tensors = build_stretch_tensors(args)
+
+        @jax.jit
+        def run_n(q, r):
+            def body(carry, _):
+                q, r = carry
+                q2, r2, _, _ = maxsum_cycle(tensors, q, r, damping=0.5)
+                return (q2, r2), ()
+            (q, r), _ = jax.lax.scan(body, (q, r), None, length=args.cycles)
+            return q, r
+
+        q0, r0 = init_messages(tensors)
+        q, r = run_n(q0, r0)
+        jax.block_until_ready((q, r))
+        times = []
+        for _ in range(args.repeat):
+            t0 = time.perf_counter()
+            q, r = run_n(q0, r0)
+            jax.block_until_ready((q, r))
+            times.append(time.perf_counter() - t0)
+        val = args.cycles / min(times)
+        ref = python_reference_cycle_time(tensors)
+        if watchdog:
+            watchdog.cancel()
+        print(json.dumps({
+            "metric": metric,
+            "value": round(val, 2), "unit": "iters/s",
+            "vs_baseline": round(val * ref, 2) if ref > 0 else 0.0,
+        }), flush=True)
+        return
+
+    extra = {}
+    value = vs = 0.0
+    dcop = None
+
+    if args.only in ("all", "maxsum"):
+        try:
+            value, vs, dcop, _tensors = bench_maxsum(args)
+        except BenchAbort as e:
+            if watchdog:
+                watchdog.cancel()
+            print(json.dumps({
+                "metric": metric, "value": 0.0, "unit": "iters/s",
+                "vs_baseline": 0.0, "error": str(e),
+            }), flush=True)
+            raise SystemExit(1)
+
+    if args.only in ("all", "dpop"):
+        try:
+            tps, dvs, _plan = bench_dpop(args)
+            extra["dpop_tables_per_sec_%dvar" % args.dpop_vars] = round(tps, 1)
+            extra["dpop_vs_python_reference"] = round(dvs, 1)
+        except Exception as e:  # never lose the primary metric
+            extra["dpop_error"] = repr(e)
+
+    if args.only in ("all", "local"):
+        try:
+            if dcop is None:
+                from pydcop_tpu.generators import generate_graph_coloring
+                dcop = generate_graph_coloring(
+                    n_variables=args.vars, n_colors=args.colors,
+                    n_edges=args.edges, soft=True, n_agents=1, seed=1,
+                )
+            extra["mgm_cycles_per_sec_%dvar" % args.vars] = round(
+                bench_local_search(dcop, "mgm"), 1)
+            extra["dsa_cycles_per_sec_%dvar" % args.vars] = round(
+                bench_local_search(dcop, "dsa"), 1)
+        except Exception as e:
+            extra["local_error"] = repr(e)
+
+    if args.only in ("all", "convergence"):
+        try:
+            extra.update(bench_convergence_stretch(args))
+        except Exception as e:
+            extra["stretch_error"] = repr(e)
+
+    if args.only in ("all", "sharded"):
+        try:
+            sh = bench_sharded_subprocess(args)
+            extra[sh["metric"]] = sh["value"]
+        except Exception as e:
+            extra["sharded_error"] = repr(e)
+
+    if args.only in ("dpop", "local", "convergence", "sharded") and not value:
+        # single-part run: promote the part's headline measurement (not
+        # config constants like stretch_vars) to the primary slot
+        headline = ("_per_sec", "_wall_s", "_cycles_per")
+        k = next(
+            (k for k in extra if any(h in k for h in headline)),
+            next((k for k in extra if not k.endswith("_error")), None),
+        )
+        out = {"metric": k or "error", "value": extra.get(k, 0.0),
+               "unit": "", "vs_baseline": 0.0, "extra": extra}
+        if watchdog:
+            watchdog.cancel()
+        print(json.dumps(out), flush=True)
+        return
+
+    if watchdog:
+        watchdog.cancel()
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": "iters/s",
+        "vs_baseline": round(vs, 2),
+        "extra": extra,
+    }), flush=True)
 
 
 if __name__ == "__main__":

@@ -12,8 +12,12 @@ Protocol per cycle (reference's 5 message rounds → batched array ops):
 2. offer round — each variable is an *offerer* with probability
    ``threshold``; offerers pick one random incident binary constraint whose
    other end is a non-offerer and compute the joint cost table of the pair;
-3. response round — each receiver accepts its best positive-joint-gain
-   offer (segment-max over offered edges, lowest edge id on ties);
+3. response round — each receiver takes its best positive-joint-gain
+   offer (segment-max over offered edges, lowest edge id on ties) and
+   commits iff that joint gain beats its own unilateral gain — or ties
+   it, as arbitrated by ``favor``: ``coordinated`` commits on ties,
+   ``no`` flips a coin, ``unilateral`` (default) stays solo (reference
+   mgm2.py:812-821);
 4. gain round — committed pairs advertise the joint gain, everyone else
    their unilateral MGM gain;
 5. go round — a pair moves iff BOTH ends win their neighborhoods (partners
@@ -22,9 +26,8 @@ Protocol per cycle (reference's 5 message rounds → batched array ops):
 
 Deviations from the reference (documented): parallel constraints between
 the same pair are not merged when excluding the shared constraint from the
-joint table; the ``favor`` parameter is accepted but only ``unilateral``
-ordering is implemented.  Only binary constraints participate in pairing
-(the reference's offers are pairwise by construction).
+joint table.  Only binary constraints participate in pairing (the
+reference's offers are pairwise by construction).
 """
 from __future__ import annotations
 
@@ -58,6 +61,12 @@ class Mgm2Solver(LocalSearchSolver):
     def __init__(self, dcop, tensors, algo_def, seed=0):
         super().__init__(dcop, tensors, algo_def, seed)
         self.threshold = float(self.params.get("threshold", 0.5))
+        self.favor = str(self.params.get("favor", "unilateral"))
+        if self.favor not in ("unilateral", "no", "coordinated"):
+            raise ValueError(
+                f"mgm2: unsupported favor mode {self.favor!r} "
+                "(use unilateral, no or coordinated)"
+            )
         # 5 rounds per cycle, one message per neighbor pair each
         self.msgs_per_cycle = 5 * int(tensors.neighbor_src.shape[0])
         self._build_pair_structures()
@@ -108,7 +117,7 @@ class Mgm2Solver(LocalSearchSolver):
             return (jnp.where(move, best_val, x).astype(jnp.int32),)
 
         P = self.n_pairs
-        k_off, k_pick = jax.random.split(key)
+        k_off, k_pick, k_favor = jax.random.split(key, 3)
         offerer = jax.random.uniform(k_off, (V,)) < self.threshold
 
         # --- offer round: each offerer picks one random incident pair edge
@@ -151,14 +160,28 @@ class Mgm2Solver(LocalSearchSolver):
         di_star = (best_flat // D).astype(jnp.int32)
         dj_star = (best_flat % D).astype(jnp.int32)
 
-        # --- response round: receiver accepts its best positive offer
+        # --- response round: receiver takes its best positive offer and
+        # commits iff the joint gain beats its own unilateral gain (ties
+        # arbitrated by favor — reference mgm2.py:812-821)
         seg_rec = jnp.where(offered & (jg > 1e-9), receiver, V)
         rec_max = segment_max(jnp.where(offered, jg, -1.0), seg_rec, V + 1)[
             :V
         ]
         at_best = offered & (jg > 1e-9) & (jg >= rec_max[receiver] - 1e-9)
         first_e = segment_min(jnp.where(at_best, ep, P), seg_rec, V + 1)[:V]
-        accepted = at_best & (ep == first_e[receiver])
+        tie_eps = 1e-9
+        beats = rec_max > own_gain + tie_eps
+        ties = jnp.abs(rec_max - own_gain) <= tie_eps
+        if self.favor == "coordinated":
+            commits = beats | ties
+        elif self.favor == "no":
+            coin = jax.random.uniform(k_favor, (V,)) > 0.5
+            commits = beats | (ties & coin)
+        else:  # unilateral
+            commits = beats
+        accepted = (
+            at_best & (ep == first_e[receiver]) & commits[receiver]
+        )
 
         # --- committed vars, pair targets, pair gains
         committed = jnp.zeros(V, dtype=bool)
@@ -196,7 +219,6 @@ class Mgm2Solver(LocalSearchSolver):
         pid = jnp.where(committed, jnp.minimum(me, partner), me)
         src, dst = t.neighbor_src, t.neighbor_dst
         neigh_max = jnp.maximum(segment_max(gain[src], dst, V), 0.0)
-        tie_eps = 1e-9
         at_max = gain[src] >= neigh_max[dst] - tie_eps
         idx_at_max = segment_min(jnp.where(at_max, pid[src], V), dst, V)
         winner = (gain > 1e-9) & (

@@ -32,10 +32,11 @@ def set_parser(subparsers):
     )
     parser.add_argument(
         "-d", "--distribution", default=None,
-        help="distribution strategy (or a distribution YAML file); the "
-        "tensor runtime does not need a placement to solve, so it is only "
-        "computed/validated when requested (the reference defaults to "
-        "oneagent, which requires one agent per computation)",
+        help="distribution strategy name (computed and validated; the "
+        "tensor runtime does not need a placement to solve), or a "
+        "distribution YAML file — which DRIVES the solve: factors are "
+        "sharded onto the device mesh by host agent (maxsum family only; "
+        "other algorithms reject an explicit placement loudly)",
     )
     parser.add_argument("-m", "--mode", choices=["thread", "process"],
                         default="thread", help="accepted for compatibility")
@@ -71,11 +72,20 @@ def run_cmd(args):
     distribution = args.distribution
     if distribution and (distribution.endswith(".yaml") or
                          distribution.endswith(".yml")):
-        # a pre-computed distribution file: load to validate, then run
+        # a pre-computed distribution file DRIVES the solve: factors are
+        # sharded onto devices by host agent (reference parity:
+        # pydcop/commands/solve.py:483-507 runs under the placement)
         from pydcop_tpu.distribution.yamlformat import load_dist_from_file
 
-        load_dist_from_file(distribution)
-        distribution = None
+        try:
+            distribution = load_dist_from_file(distribution)
+        except Exception as e:
+            output_metrics(
+                {"status": "ERROR",
+                 "error": f"cannot load distribution: {e}"},
+                args.output,
+            )
+            return 1
 
     try:
         res = solve_result(

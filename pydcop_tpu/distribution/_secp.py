@@ -1,0 +1,225 @@
+"""Shared SECP (Smart Environment Configuration Problem) placement core.
+
+SECP placements differ from the generic models in three ways (reference:
+pydcop/distribution/oilp_secp_cgdp.py:72-116, oilp_secp_fgdp.py:71-130):
+
+1. **Actuator pre-assignment** — a variable with ``hosting_cost == 0`` on
+   some agent represents that agent's own actuator (lamp, blind...) and
+   is pinned there before any optimization; on factor graphs its cost
+   factor ``c_<var>`` is co-hosted with it.
+2. **Communication-only objective** — the ILP maximizes co-location of
+   linked computations (equivalently, minimizes cross-agent link load);
+   hosting and route costs are NOT part of the objective.
+3. **Liveness** — every agent that received nothing in pre-assignment
+   must host at least one computation.
+
+The reference solves this with pulp/GLPK; pulp is absent here so the same
+model runs on scipy.optimize.milp (HiGHS), like distribution/_ilp.py.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from pydcop_tpu.distribution._costs import edge_loads
+from pydcop_tpu.distribution.objects import (
+    Distribution,
+    ImpossibleDistributionException,
+)
+
+
+def split_actuators(
+    computation_graph,
+    agents: List,
+    computation_memory: Callable,
+    pair_cost_factors: bool,
+) -> Tuple[Dict[str, List[str]], List[str], Dict[str, float]]:
+    """Pin actuator variables (hosting_cost == 0) on their agents.
+
+    Returns (mapping, comps_to_host, remaining_capacity).  With
+    ``pair_cost_factors`` (factor-graph mode), a factor named ``c_<var>``
+    is co-hosted with its actuator variable (reference
+    oilp_secp_fgdp.py:97-110).
+    """
+    mapping: Dict[str, List[str]] = {a.name: [] for a in agents}
+    capa = {
+        a.name: (a.capacity if a.capacity is not None else float("inf"))
+        for a in agents
+    }
+    comps = [n.name for n in computation_graph.nodes]
+    names = set(comps)
+    mem = computation_memory or (lambda n: 0.0)
+
+    for comp in list(comps):
+        if comp not in names:
+            continue
+        for agent in agents:
+            if agent.hosting_cost(comp) == 0:
+                mapping[agent.name].append(comp)
+                names.discard(comp)
+                capa[agent.name] -= mem(
+                    computation_graph.computation(comp)
+                )
+                if pair_cost_factors and f"c_{comp}" in names:
+                    factor = f"c_{comp}"
+                    mapping[agent.name].append(factor)
+                    names.discard(factor)
+                    capa[agent.name] -= mem(
+                        computation_graph.computation(factor)
+                    )
+                if capa[agent.name] < 0:
+                    raise ImpossibleDistributionException(
+                        f"Not enough capacity on {agent.name} to host "
+                        f"actuator {comp}"
+                    )
+                break
+    comps_to_host = [c for c in comps if c in names]
+    return mapping, comps_to_host, capa
+
+
+def secp_ilp(
+    computation_graph,
+    agents: List,
+    pre_mapping: Dict[str, List[str]],
+    comps_to_host: List[str],
+    capa: Dict[str, float],
+    computation_memory: Callable,
+    communication_load: Callable,
+) -> Distribution:
+    """Communication-only optimal ILP over the free computations.
+
+    min Σ -load(i,j)·alpha[(i,j),k]   (maximize co-located link load)
+    s.t. each free comp hosted exactly once; every empty agent hosts ≥ 1;
+    capacity; alpha ≤ x_i, alpha ≤ x_j (linearization — the objective
+    pulls alpha up, so the ≥ side is implied at the optimum).
+    """
+    from scipy.optimize import Bounds, LinearConstraint, milp
+    from scipy.sparse import lil_matrix
+
+    agent_names = [a.name for a in agents]
+    nA = len(agents)
+    free = list(comps_to_host)
+    nC = len(free)
+    if nC == 0:
+        return Distribution(pre_mapping)
+    c_idx = {c: i for i, c in enumerate(free)}
+    hosted_on = {
+        c: a_name for a_name, cs in pre_mapping.items() for c in cs
+    }
+    mem = computation_memory or (lambda n: 0.0)
+    load_fn = communication_load or (lambda n, t: 1.0)
+
+    def xvar(c: int, k: int) -> int:
+        return c * nA + k
+
+    n_x = nC * nA
+    cost = np.zeros(n_x, dtype=float)
+
+    # links where both ends free -> alpha vars; one end pinned -> direct
+    # bonus on x[free, pinned_agent]; both pinned -> constant (dropped)
+    alpha_links: List[Tuple[int, int, float]] = []
+    for c1, c2, load in edge_loads(computation_graph, load_fn):
+        f1, f2 = c1 in c_idx, c2 in c_idx
+        if f1 and f2:
+            alpha_links.append((c_idx[c1], c_idx[c2], float(load)))
+        elif f1 and c2 in hosted_on:
+            k = agent_names.index(hosted_on[c2])
+            cost[xvar(c_idx[c1], k)] -= float(load)
+        elif f2 and c1 in hosted_on:
+            k = agent_names.index(hosted_on[c1])
+            cost[xvar(c_idx[c2], k)] -= float(load)
+
+    n_alpha = len(alpha_links) * nA
+    n_vars = n_x + n_alpha
+    cost = np.concatenate([cost, np.zeros(n_alpha)])
+    for li, (i, j, load) in enumerate(alpha_links):
+        for k in range(nA):
+            cost[n_x + li * nA + k] = -load
+
+    constraints = []
+    # each free computation hosted exactly once
+    A_eq = lil_matrix((nC, n_vars))
+    for c in range(nC):
+        for k in range(nA):
+            A_eq[c, xvar(c, k)] = 1
+    constraints.append(LinearConstraint(A_eq.tocsr(), 1, 1))
+
+    # every empty agent hosts at least one computation
+    empty = [k for k, a in enumerate(agents) if not pre_mapping[a.name]]
+    if empty:
+        A_live = lil_matrix((len(empty), n_vars))
+        for r, k in enumerate(empty):
+            for c in range(nC):
+                A_live[r, xvar(c, k)] = 1
+        constraints.append(
+            LinearConstraint(A_live.tocsr(), 1, np.inf)
+        )
+
+    # capacity (remaining after pre-assignment)
+    caps = np.array([capa[a.name] for a in agents])
+    if np.any(np.isfinite(caps)):
+        A_cap = lil_matrix((nA, n_vars))
+        for k in range(nA):
+            for c, cname in enumerate(free):
+                A_cap[k, xvar(c, k)] = mem(
+                    computation_graph.computation(cname)
+                )
+        constraints.append(
+            LinearConstraint(
+                A_cap.tocsr(), -np.inf,
+                np.where(np.isfinite(caps), caps, 1e18),
+            )
+        )
+
+    # alpha_{ij}^k <= x_i^k ; alpha_{ij}^k <= x_j^k
+    if n_alpha:
+        A_lin = lil_matrix((2 * n_alpha, n_vars))
+        for li, (i, j, _l) in enumerate(alpha_links):
+            for k in range(nA):
+                a_col = n_x + li * nA + k
+                r = 2 * (li * nA + k)
+                A_lin[r, a_col] = 1
+                A_lin[r, xvar(i, k)] = -1
+                A_lin[r + 1, a_col] = 1
+                A_lin[r + 1, xvar(j, k)] = -1
+        constraints.append(
+            LinearConstraint(A_lin.tocsr(), -np.inf, 0)
+        )
+
+    res = milp(
+        c=cost,
+        constraints=constraints,
+        integrality=np.ones(n_vars),
+        bounds=Bounds(np.zeros(n_vars), np.ones(n_vars)),
+    )
+    if not res.success:
+        raise ImpossibleDistributionException(
+            f"SECP ILP infeasible: {res.message}"
+        )
+    x = np.round(res.x[:n_x]).astype(int)
+    mapping = {a: list(cs) for a, cs in pre_mapping.items()}
+    for c, cname in enumerate(free):
+        for k in range(nA):
+            if x[xvar(c, k)]:
+                mapping[agent_names[k]].append(cname)
+                break
+    return Distribution(mapping)
+
+
+def secp_comm_cost(
+    distribution: Distribution,
+    computation_graph,
+    agentsdef: Iterable,
+    computation_memory: Callable = None,
+    communication_load: Callable = None,
+) -> float:
+    """Communication-only placement cost: sum of link loads whose ends
+    live on different agents (reference oilp_secp_*.py distribution_cost
+    returns (comm, comm, 0))."""
+    load_fn = communication_load or (lambda n, t: 1.0)
+    comm = 0.0
+    for c1, c2, load in edge_loads(computation_graph, load_fn):
+        if distribution.agent_for(c1) != distribution.agent_for(c2):
+            comm += load
+    return comm

@@ -1,11 +1,133 @@
-"""gh_secp_fgdp: SECP-specific greedy placement on the factor graph.
+"""gh_secp_fgdp: SECP greedy heuristic on the factor graph.
 
 Equivalent capability to the reference's
-pydcop/distribution/gh_secp_fgdp.py — same hosting-cost-first greedy as
-gh_secp_cgdp, applied to factor-graph nodes (factors follow the variables
-they constrain).
+pydcop/distribution/gh_secp_fgdp.py (:30-196): computations are placed in
+three SECP-specific passes —
+
+1. each actuator variable (hosting_cost == 0 on some agent) and its cost
+   factor ``c_<var>`` go on that device agent;
+2. each physical model, i.e. the pair (model variable ``m``, model factor
+   ``c_m``), goes — as a unit — on the candidate agent with enough
+   capacity already hosting the most of the factor's neighbors (ties:
+   highest remaining capacity);
+3. remaining factors are rules, placed one by one with the same
+   candidate rule.
+
+Unlike gh_secp_cgdp, hosting costs only matter for the actuator pass;
+model/rule placement is purely co-location driven.
 """
-from pydcop_tpu.distribution.gh_secp_cgdp import (  # noqa: F401
-    distribute,
-    distribution_cost,
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from pydcop_tpu.distribution._costs import distribution_cost as _dist_cost
+from pydcop_tpu.distribution._secp import split_actuators
+from pydcop_tpu.distribution.objects import (
+    Distribution,
+    ImpossibleDistributionException,
 )
+from pydcop_tpu.graph.factor_graph import (
+    FactorComputationNode,
+    VariableComputationNode,
+)
+
+
+def find_candidates(
+    capa: Dict[str, float],
+    comp: str,
+    footprint: float,
+    mapping: Dict[str, List[str]],
+    neighbors: Iterable[str],
+) -> List[Tuple[int, float, str]]:
+    """Agents with enough capacity, best first: most already-hosted
+    neighbors of ``comp``, then highest remaining capacity (reference
+    gh_secp_cgdp.find_candidates)."""
+    nb = set(neighbors)
+    out = []
+    for a_name, cs in mapping.items():
+        if capa[a_name] < footprint:
+            continue
+        hosted_nb = sum(1 for c in cs if c in nb)
+        out.append((-hosted_nb, -capa[a_name], a_name))
+    if not out:
+        raise ImpossibleDistributionException(
+            f"No agent has capacity {footprint} left for {comp}"
+        )
+    return sorted(out)
+
+
+def distribute(
+    computation_graph,
+    agentsdef: Iterable,
+    hints=None,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+) -> Distribution:
+    if computation_memory is None:
+        raise ImpossibleDistributionException(
+            "gh_secp_fgdp distribution requires a computation_memory "
+            "function"
+        )
+    agents = list(agentsdef)
+    mem = computation_memory
+
+    # pass 1: actuator variables + their cost factors on device agents
+    mapping, free, capa = split_actuators(
+        computation_graph, agents, mem, pair_cost_factors=True,
+    )
+
+    free_set = set(free)
+    var_comps = [
+        n.name for n in computation_graph.nodes
+        if isinstance(n, VariableComputationNode) and n.name in free_set
+    ]
+    fac_comps = [
+        n.name for n in computation_graph.nodes
+        if isinstance(n, FactorComputationNode) and n.name in free_set
+    ]
+
+    # pass 2: physical models — the (m, c_m) pair placed as a unit
+    models = []
+    for model_var in var_comps:
+        if f"c_{model_var}" in fac_comps:
+            models.append((model_var, f"c_{model_var}"))
+            fac_comps.remove(f"c_{model_var}")
+    model_vars_placed = {v for v, _ in models}
+    for model_var, model_fac in models:
+        footprint = mem(computation_graph.computation(model_var)) + mem(
+            computation_graph.computation(model_fac)
+        )
+        neighbors = computation_graph.computation(model_fac).neighbors
+        selected = find_candidates(
+            capa, model_fac, footprint, mapping, neighbors
+        )[0][2]
+        mapping[selected].extend([model_var, model_fac])
+        capa[selected] -= footprint
+
+    # model variables without a matching factor fall through to pass 3
+    orphan_vars = [v for v in var_comps if v not in model_vars_placed]
+
+    # pass 3: rule factors (and orphan variables), co-location greedy
+    for comp in fac_comps + orphan_vars:
+        footprint = mem(computation_graph.computation(comp))
+        neighbors = computation_graph.computation(comp).neighbors
+        selected = find_candidates(
+            capa, comp, footprint, mapping, neighbors
+        )[0][2]
+        mapping[selected].append(comp)
+        capa[selected] -= footprint
+
+    return Distribution({a: list(cs) for a, cs in mapping.items()})
+
+
+def distribution_cost(
+    distribution: Distribution,
+    computation_graph,
+    agentsdef: Iterable,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+) -> float:
+    return _dist_cost(
+        distribution, computation_graph, agentsdef, computation_memory,
+        communication_load,
+    )[0]

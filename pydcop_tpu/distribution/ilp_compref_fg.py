@@ -1,8 +1,14 @@
 """ilp_compref_fg: the AAMAS-18 weighted ILP on the factor graph.
 
 Equivalent capability to the reference's
-pydcop/distribution/ilp_compref_fg.py — identical model to ilp_compref,
-applied to factor-graph computation nodes (variables AND factors placed).
+pydcop/distribution/ilp_compref_fg.py.  In the reference this file is
+byte-identical to ilp_compref.py except one blank line (verified with
+``diff``: the two 298-line files differ only at ilp_compref.py:147) — the
+factor-graph variant is the SAME model applied to factor-graph
+computation nodes (variables AND factors placed); the model itself is
+graph-agnostic.  Re-exporting ilp_compref here therefore IS full parity,
+not a placeholder: ``distribute`` receives the factor-graph computation
+graph from the caller and places both node kinds.
 """
 from pydcop_tpu.distribution.ilp_compref import (  # noqa: F401
     distribute,

@@ -1,6 +1,62 @@
-"""oilp_secp_cgdp: optimal ILP for SECP placements (constraint graph, with
-routes) — reference: pydcop/distribution/oilp_secp_cgdp.py."""
-from pydcop_tpu.distribution.oilp_cgdp import (  # noqa: F401
-    distribute,
-    distribution_cost,
+"""oilp_secp_cgdp: optimal communication-only ILP for SECP placements on
+the constraint graph.
+
+Equivalent capability to the reference's
+pydcop/distribution/oilp_secp_cgdp.py (:72-116): actuator variables
+(hosting_cost == 0) are pinned to their device agents first, then an ILP
+places the remaining (physical-model) variables, maximizing co-location
+of constraint-graph neighbors under capacity, with every empty agent
+hosting at least one computation.  Unlike the generic oilp_cgdp, the
+objective has NO hosting or route terms.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+from pydcop_tpu.distribution._secp import (
+    secp_comm_cost,
+    secp_ilp,
+    split_actuators,
 )
+from pydcop_tpu.distribution.objects import (
+    Distribution,
+    ImpossibleDistributionException,
+)
+
+
+def distribute(
+    computation_graph,
+    agentsdef: Iterable,
+    hints=None,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+) -> Distribution:
+    if computation_memory is None or communication_load is None:
+        raise ImpossibleDistributionException(
+            "oilp_secp_cgdp distribution requires computation_memory "
+            "and communication_load functions"
+        )
+    agents = list(agentsdef)
+    # constraint-graph mode: only variable computations exist, so no
+    # cost-factor pairing
+    pre, free, capa = split_actuators(
+        computation_graph, agents, computation_memory,
+        pair_cost_factors=False,
+    )
+    return secp_ilp(
+        computation_graph, agents, pre, free, capa,
+        computation_memory, communication_load,
+    )
+
+
+def distribution_cost(
+    distribution: Distribution,
+    computation_graph,
+    agentsdef: Iterable,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+) -> float:
+    return secp_comm_cost(
+        distribution, computation_graph, agentsdef, computation_memory,
+        communication_load,
+    )

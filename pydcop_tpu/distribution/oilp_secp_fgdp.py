@@ -1,6 +1,60 @@
-"""oilp_secp_fgdp: optimal ILP for SECP placements (factor graph, with
-routes) — reference: pydcop/distribution/oilp_secp_fgdp.py."""
-from pydcop_tpu.distribution.oilp_cgdp import (  # noqa: F401
-    distribute,
-    distribution_cost,
+"""oilp_secp_fgdp: optimal communication-only ILP for SECP placements on
+the factor graph.
+
+Equivalent capability to the reference's
+pydcop/distribution/oilp_secp_fgdp.py (:71-130, fg_secp_ilp :173):
+actuator variables (hosting_cost == 0) are pinned on their device agents
+together with their cost factors ``c_<var>``, then an ILP places the
+remaining variables AND factors, maximizing co-location over factor-graph
+links under capacity, with every empty agent hosting at least one
+computation.  Objective is communication only (no hosting/route terms).
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+from pydcop_tpu.distribution._secp import (
+    secp_comm_cost,
+    secp_ilp,
+    split_actuators,
 )
+from pydcop_tpu.distribution.objects import (
+    Distribution,
+    ImpossibleDistributionException,
+)
+
+
+def distribute(
+    computation_graph,
+    agentsdef: Iterable,
+    hints=None,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+) -> Distribution:
+    if computation_memory is None or communication_load is None:
+        raise ImpossibleDistributionException(
+            "oilp_secp_fgdp distribution requires computation_memory "
+            "and communication_load functions"
+        )
+    agents = list(agentsdef)
+    pre, free, capa = split_actuators(
+        computation_graph, agents, computation_memory,
+        pair_cost_factors=True,
+    )
+    return secp_ilp(
+        computation_graph, agents, pre, free, capa,
+        computation_memory, communication_load,
+    )
+
+
+def distribution_cost(
+    distribution: Distribution,
+    computation_graph,
+    agentsdef: Iterable,
+    computation_memory: Optional[Callable] = None,
+    communication_load: Optional[Callable] = None,
+) -> float:
+    return secp_comm_cost(
+        distribution, computation_graph, agentsdef, computation_memory,
+        communication_load,
+    )

@@ -74,14 +74,21 @@ class ShardedFactorGraph:
 
 
 def shard_factor_graph(
-    tensors: FactorGraphTensors, n_shards: int
+    tensors: FactorGraphTensors, n_shards: int,
+    assigns: Optional[List[np.ndarray]] = None,
 ) -> ShardedFactorGraph:
     """Partition factors over shards; pad each bucket to a uniform per-shard
-    factor count with zero-cost dummy factors wired to a phantom variable."""
+    factor count with zero-cost dummy factors wired to a phantom variable.
+
+    ``assigns`` (per-bucket factor→shard arrays) overrides the built-in
+    locality partitioner — this is how an explicit placement (a
+    distribution YAML, reference pydcop/commands/solve.py:483-507) drives
+    device sharding."""
     V = tensors.n_vars
-    assigns = partition_factors(
-        [b.var_idx for b in tensors.buckets], V, n_shards
-    )
+    if assigns is None:
+        assigns = partition_factors(
+            [b.var_idx for b in tensors.buckets], V, n_shards
+        )
     sharded_buckets: List[ShardedBucket] = []
     edge_var_shards: List[List[np.ndarray]] = [[] for _ in range(n_shards)]
     for b, assign in zip(tensors.buckets, assigns):
@@ -138,10 +145,11 @@ class ShardedMaxSum:
         tensors: FactorGraphTensors,
         mesh: Optional[Mesh] = None,
         damping: float = 0.5,
+        assigns: Optional[List[np.ndarray]] = None,
     ):
         self.mesh = mesh or build_mesh()
         self.n_shards = self.mesh.devices.size
-        self.st = shard_factor_graph(tensors, self.n_shards)
+        self.st = shard_factor_graph(tensors, self.n_shards, assigns)
         self.damping = damping
         self._run_n = None
 
@@ -237,11 +245,14 @@ class ShardedMaxSum:
         z = jax.device_put(jnp.zeros((E, D), dtype=jnp.float32), sharding)
         return z, z
 
-    def run(self, cycles: int = 20):
-        """Run `cycles` sharded cycles; returns (values [V], q, r)."""
+    def run(self, cycles: int = 20, q=None, r=None):
+        """Run `cycles` sharded cycles; returns (values [V], q, r).
+        Pass the previous call's (q, r) to continue instead of
+        restarting from zero messages."""
         if self._run_n is None:
             self._build()
-        q, r = self.init_messages()
+        if q is None or r is None:
+            q, r = self.init_messages()
         q, r, values = self._run_n(q, r, cycles)
         return np.asarray(values), q, r
 

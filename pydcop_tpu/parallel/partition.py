@@ -113,3 +113,43 @@ def partition_stats(
         "cut_fraction": cut / len(var_shards),
         "replicated_vars": cut,
     }
+
+
+def assigns_from_distribution(
+    distribution, tensors, n_shards: int
+) -> List[np.ndarray]:
+    """Factor→shard assignments driven by an explicit placement.
+
+    The reference runs computations on the agents a distribution names
+    (pydcop/commands/solve.py:483-507); the TPU equivalent is device
+    placement: agents are folded (sorted, round-robin) onto the mesh's
+    ``n_shards`` devices and every factor computation lands on its host
+    agent's shard.  Raises if the placement does not cover the graph.
+    """
+    from pydcop_tpu.distribution.objects import (
+        ImpossibleDistributionException,
+    )
+
+    agents = sorted(distribution.agents)
+    if not agents:
+        raise ImpossibleDistributionException(
+            "distribution names no agents"
+        )
+    shard_of_agent = {a: i % n_shards for i, a in enumerate(agents)}
+    host = {
+        c: a
+        for a in agents
+        for c in distribution.computations_hosted(a)
+    }
+    out = []
+    for b in tensors.buckets:
+        assign = np.zeros(b.n_factors, dtype=np.int32)
+        for f in range(b.n_factors):
+            name = tensors.factor_names[int(b.factor_ids[f])]
+            if name not in host:
+                raise ImpossibleDistributionException(
+                    f"distribution does not place computation {name!r}"
+                )
+            assign[f] = shard_of_agent[host[name]]
+        out.append(assign)
+    return out

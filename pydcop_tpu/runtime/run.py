@@ -30,7 +30,7 @@ def _build_algo_def(
 def solve_result(
     dcop: DCOP,
     algo: Union[str, AlgorithmDef],
-    distribution: Optional[str] = None,
+    distribution: Optional[Union[str, Any]] = None,
     graph: Optional[str] = None,
     timeout: Optional[float] = None,
     cycles: Optional[int] = None,
@@ -41,11 +41,24 @@ def solve_result(
     """Solve a DCOP and return the full result + metrics.
 
     The reference twin is infrastructure/run.py:solve (used by all api
-    tests); ``distribution`` is accepted for parity and validated, though a
-    single-host tensor solve does not need a placement to run.
+    tests).  ``distribution`` as a strategy NAME is computed and validated
+    (a single-host tensor solve does not need a placement to run); as a
+    ``Distribution`` OBJECT (e.g. loaded from a distribution YAML) it
+    actually drives execution — factors are sharded onto the device mesh
+    by their host agents (reference parity: pydcop/commands/solve.py
+    :483-507 runs under the given placement).
     """
+    from pydcop_tpu.distribution.objects import Distribution
+
     algo_def = _build_algo_def(dcop, algo, algo_params)
     algo_module = load_algorithm_module(algo_def.algo)
+
+    if isinstance(distribution, Distribution):
+        # placement-driven path compiles straight from the dcop; don't
+        # build the computation graph it would never read
+        return _solve_under_placement(
+            dcop, algo_def, distribution, cycles, timeout
+        )
 
     graph_type = graph or algo_module.GRAPH_TYPE
     graph_module = load_graph_module(graph_type)
@@ -72,6 +85,85 @@ def solve_result(
     )
     return solver.run(
         cycles=stop_cycle, timeout=timeout, collect_cycles=collect_cycles
+    )
+
+
+def _solve_under_placement(
+    dcop: DCOP,
+    algo_def: AlgorithmDef,
+    distribution,
+    cycles: Optional[int],
+    timeout: Optional[float],
+) -> SolveResult:
+    """Run a solve whose device sharding is driven by an explicit
+    placement (Distribution object).  Supported for the factor-graph BP
+    family; the complete host-driven algorithms have no device placement
+    to drive, so asking for one fails loudly instead of being ignored."""
+    from time import perf_counter
+
+    import jax
+    import numpy as np
+
+    from pydcop_tpu.ops.compile import compile_factor_graph
+    from pydcop_tpu.parallel.mesh import ShardedMaxSum, build_mesh
+    from pydcop_tpu.parallel.partition import assigns_from_distribution
+
+    if algo_def.algo not in ("maxsum", "amaxsum"):
+        raise ValueError(
+            f"an explicit distribution can only drive device sharding "
+            f"for the factor-graph BP family (maxsum/amaxsum), not "
+            f"{algo_def.algo!r}; rerun without -d or with a strategy name"
+        )
+    t0 = perf_counter()
+    tensors = compile_factor_graph(dcop)
+    n_devices = len(jax.devices())
+    mesh = build_mesh(n_devices)
+    assigns = assigns_from_distribution(distribution, tensors, n_devices)
+    if n_devices == 1:
+        import logging
+
+        logging.getLogger("pydcop_tpu.run").warning(
+            "placement-driven solve on a single device: all %d agents "
+            "fold onto one shard", len(distribution.agents),
+        )
+    damping = algo_def.params.get("damping")
+    damping = 0.5 if damping is None else float(damping)  # 0 is valid
+    sharded = ShardedMaxSum(tensors, mesh, damping=damping,
+                            assigns=assigns)
+    n_cycles = cycles or 30
+    status = "FINISHED"
+    if timeout is None:
+        values, _q, _r = sharded.run(cycles=n_cycles)
+    else:
+        # chunked so the timeout is honored between device dispatches
+        chunk = max(1, min(10, n_cycles))
+        done = 0
+        q = r = None
+        values = None
+        while done < n_cycles:
+            n = min(chunk, n_cycles - done)
+            values, q, r = sharded.run(cycles=n, q=q, r=r)
+            done += n
+            if perf_counter() - t0 > timeout:
+                status = "TIMEOUT"
+                break
+        n_cycles = done
+    from pydcop_tpu.algorithms import DEFAULT_INFINITY
+
+    assignment = tensors.assignment_from_indices(np.asarray(values))
+    violation, cost = dcop.solution_cost(assignment, DEFAULT_INFINITY)
+    edges = int(tensors.edge_var.shape[0])
+    return SolveResult(
+        status=status,
+        assignment=assignment,
+        cost=cost,
+        violation=violation,
+        cycle=n_cycles,
+        msg_count=2 * edges * n_cycles,
+        msg_size=float(
+            2 * edges * n_cycles * tensors.max_domain_size
+        ),
+        time=perf_counter() - t0,
     )
 
 

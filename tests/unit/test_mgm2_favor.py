@@ -1,0 +1,130 @@
+"""MGM-2 favor semantics (reference pydcop/algorithms/mgm2.py:812-821).
+
+A receiver commits to a pair move iff the best offered joint gain BEATS
+its own unilateral gain — or ties it, arbitrated by favor:
+``coordinated`` commits on ties, ``no`` flips a coin, ``unilateral``
+stays solo.
+
+Trap instance: two binary variables, one constraint
+``M = [[10, 5], [5, 5]]``, state (0, 0).  Every improving move — a
+alone, b alone, or the pair — has gain exactly 5, so the joint offer
+TIES the receiver's own gain: coordinated executes the pair move to
+(0, 1) (argmin tie-break), unilateral arbitration moves only the lower
+id to (1, 0).
+"""
+import jax.numpy as jnp
+import jax.random
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms import AlgorithmDef
+from pydcop_tpu.algorithms.mgm2 import Mgm2Solver, algo_params
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.ops.compile import compile_constraint_graph
+
+
+def trap_dcop():
+    dcop = DCOP("trap", objective="min")
+    d = Domain("d", "vals", [0, 1])
+    a, b = Variable("a", d), Variable("b", d)
+    dcop.add_variable(a)
+    dcop.add_variable(b)
+    m = np.array([[10.0, 5.0], [5.0, 5.0]])
+    dcop.add_constraint(NAryMatrixRelation([a, b], m, name="c"))
+    dcop.add_agents([AgentDef("ag")])
+    return dcop
+
+
+def make_solver(favor):
+    dcop = trap_dcop()
+    algo = AlgorithmDef.build_with_default_params(
+        "mgm2", {"favor": favor}, parameters_definitions=algo_params
+    )
+    return Mgm2Solver(dcop, compile_constraint_graph(dcop), algo)
+
+
+def run_one_cycle(solver, key):
+    (x2,) = solver.cycle((jnp.array([0, 0], dtype=jnp.int32),), key)
+    return tuple(int(v) for v in np.asarray(x2))
+
+
+def test_favor_modes_differ_on_tie():
+    coord = make_solver("coordinated")
+    unil = make_solver("unilateral")
+    outcomes = set()
+    for k in range(40):
+        key = jax.random.PRNGKey(k)
+        rc = run_one_cycle(coord, key)
+        ru = run_one_cycle(unil, key)
+        outcomes.add((rc, ru))
+        # unilateral must NEVER take the tied pair move
+        assert ru != (0, 1), f"unilateral committed a tied pair (key {k})"
+    # for keys where exactly one variable offered, coordinated commits
+    # the pair while unilateral moves solo
+    assert ((0, 1), (1, 0)) in outcomes, outcomes
+
+
+def test_favor_no_is_between():
+    nof = make_solver("no")
+    results = {
+        run_one_cycle(nof, jax.random.PRNGKey(k)) for k in range(60)
+    }
+    # the coin sometimes commits the tied pair, sometimes not
+    assert (0, 1) in results
+    assert (1, 0) in results
+
+
+def test_unilateral_commits_when_joint_strictly_better():
+    # pair move strictly beats both solo moves -> all favors commit
+    dcop = DCOP("trap2", objective="min")
+    d = Domain("d", "vals", [0, 1])
+    a, b = Variable("a", d), Variable("b", d)
+    dcop.add_variable(a)
+    dcop.add_variable(b)
+    # solo moves gain 0, joint move gains 10: the canonical MGM-2 trap
+    m = np.array([[10.0, 10.0], [10.0, 0.0]])
+    dcop.add_constraint(NAryMatrixRelation([a, b], m, name="c"))
+    dcop.add_agents([AgentDef("ag")])
+    algo = AlgorithmDef.build_with_default_params(
+        "mgm2", {"favor": "unilateral"}, parameters_definitions=algo_params
+    )
+    solver = Mgm2Solver(dcop, compile_constraint_graph(dcop), algo)
+    moved = set()
+    for k in range(40):
+        moved.add(run_one_cycle(solver, jax.random.PRNGKey(k)))
+    assert (1, 1) in moved  # escapes the trap via the pair move
+    assert (1, 0) not in moved and (0, 1) not in moved  # never solo
+
+
+def test_invalid_favor_raises():
+    from pydcop_tpu.algorithms import AlgoParameterException
+
+    # central param validation catches it first...
+    with pytest.raises(AlgoParameterException, match="favor"):
+        make_solver("sideways")
+    # ...and the solver itself refuses if validation is bypassed
+    dcop = trap_dcop()
+    algo = AlgorithmDef("mgm2", {"favor": "sideways", "threshold": 0.5})
+    with pytest.raises(ValueError, match="favor"):
+        Mgm2Solver(dcop, compile_constraint_graph(dcop), algo)
+
+
+def test_full_solve_all_favors():
+    from pydcop_tpu.generators import generate_graph_coloring
+    from pydcop_tpu.runtime import solve_result
+
+    dcop = generate_graph_coloring(
+        n_variables=12, n_colors=3, n_edges=20, soft=True, n_agents=1,
+        seed=4,
+    )
+    costs = {}
+    for favor in ("unilateral", "no", "coordinated"):
+        res = solve_result(
+            dcop, "mgm2", cycles=25, algo_params={"favor": favor}
+        )
+        assert res.status == "FINISHED"
+        costs[favor] = res.cost
+    # all modes must produce sane solutions on a real instance
+    assert all(c < 1000 for c in costs.values()), costs

@@ -1,0 +1,109 @@
+"""Placement-driven execution: a Distribution object must actually drive
+device sharding (VERDICT item 7 — reference parity with
+pydcop/commands/solve.py:483-507 running under a given placement)."""
+import numpy as np
+import pytest
+
+from pydcop_tpu.distribution.objects import (
+    Distribution,
+    ImpossibleDistributionException,
+)
+from pydcop_tpu.generators import generate_graph_coloring
+from pydcop_tpu.ops.compile import compile_factor_graph
+from pydcop_tpu.parallel.partition import assigns_from_distribution
+from pydcop_tpu.runtime import solve_result
+
+
+@pytest.fixture(scope="module")
+def coloring():
+    return generate_graph_coloring(
+        n_variables=12, n_colors=3, n_edges=20, soft=True, n_agents=4,
+        seed=7,
+    )
+
+
+def full_distribution(dcop, n_agents=4):
+    """Round-robin placement of all computations (vars + constraints)."""
+    comps = sorted(dcop.variables) + sorted(dcop.constraints)
+    agents = sorted(dcop.agents)[:n_agents]
+    mapping = {a: [] for a in agents}
+    for i, c in enumerate(comps):
+        mapping[agents[i % len(agents)]].append(c)
+    return Distribution(mapping)
+
+
+def test_assigns_follow_hosts(coloring):
+    tensors = compile_factor_graph(coloring)
+    dist = full_distribution(coloring)
+    assigns = assigns_from_distribution(dist, tensors, 4)
+    agents = sorted(dist.agents)
+    for b, assign in zip(tensors.buckets, assigns):
+        for f in range(b.n_factors):
+            name = tensors.factor_names[int(b.factor_ids[f])]
+            host = dist.agent_for(name)
+            assert assign[f] == agents.index(host) % 4
+
+
+def test_missing_computation_fails_loudly(coloring):
+    tensors = compile_factor_graph(coloring)
+    incomplete = Distribution({"a0": ["v0"]})
+    with pytest.raises(ImpossibleDistributionException, match="place"):
+        assigns_from_distribution(incomplete, tensors, 4)
+
+
+def test_placement_driven_solve_matches_unsharded(coloring):
+    dist = full_distribution(coloring)
+    res = solve_result(coloring, "maxsum", distribution=dist, cycles=25)
+    assert res.status == "FINISHED"
+    ref = solve_result(coloring, "maxsum", cycles=25)
+    # sharded-by-placement BP must land on a solution of similar quality
+    assert res.cost <= ref.cost * 1.5 + 2.0
+    assert sorted(res.assignment) == sorted(coloring.variables)
+
+
+def test_placement_rejected_for_host_driven_algos(coloring):
+    dist = full_distribution(coloring)
+    with pytest.raises(ValueError, match="maxsum"):
+        solve_result(coloring, "dpop", distribution=dist)
+
+
+def test_cli_solve_with_distribution_file(tmp_path, coloring):
+    """End-to-end: solve -d file.yaml runs under the placement."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from pydcop_tpu.dcop import dcop_yaml
+    from pydcop_tpu.distribution.yamlformat import yaml_dist
+
+    repo = os.path.join(os.path.dirname(__file__), "..", "..")
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo,  # drop axon sitecustomize so cpu sticks
+    }
+    dcop_f = tmp_path / "prob.yaml"
+    dcop_f.write_text(dcop_yaml(coloring))
+    dist_f = tmp_path / "dist.yaml"
+    dist_f.write_text(yaml_dist(full_distribution(coloring)))
+    out = subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu", "--timeout", "60", "solve",
+         "--algo", "maxsum", "--cycles", "10", "-d", str(dist_f),
+         str(dcop_f)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+    )
+    data = json.loads(out.stdout)
+    assert data["status"] in ("FINISHED", "TIMEOUT"), out.stderr[-500:]
+    assert set(data["assignment"]) == set(coloring.variables)
+
+    # a placement file missing computations must fail loudly
+    bad_f = tmp_path / "bad_dist.yaml"
+    bad_f.write_text("distribution:\n  a0: [v0]\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu", "--timeout", "60", "solve",
+         "--algo", "maxsum", "-d", str(bad_f), str(dcop_f)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+    )
+    assert out.returncode != 0
+    assert "ERROR" in out.stdout
