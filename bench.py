@@ -456,22 +456,46 @@ def main():
     watchdog = _arm_watchdog(args.watchdog, metric) if args.watchdog else None
 
     if args.stretch:
-        # compat mode: the 100k instance timed as plain iters/s
+        # compat mode: the 100k instance timed as plain iters/s, with the
+        # same engine selection as the primary bench (--engine honored)
         import jax
         from pydcop_tpu.ops.maxsum_kernels import init_messages, maxsum_cycle
+        from pydcop_tpu.ops.pallas_maxsum import (
+            packed_cycle, packed_init_state, try_pack_for_pallas,
+        )
 
         tensors = build_stretch_tensors(args)
+        packed = None
+        if args.engine == "packed":
+            packed = try_pack_for_pallas(tensors)
+            if packed is None:
+                if watchdog:
+                    watchdog.cancel()
+                print(json.dumps({
+                    "metric": metric, "value": 0.0, "unit": "iters/s",
+                    "vs_baseline": 0.0,
+                    "error": "--engine packed: graph not packable",
+                }), flush=True)
+                raise SystemExit(1)
+        elif args.engine == "auto" and jax.default_backend() == "tpu":
+            packed = try_pack_for_pallas(tensors)
 
         @jax.jit
         def run_n(q, r):
             def body(carry, _):
                 q, r = carry
-                q2, r2, _, _ = maxsum_cycle(tensors, q, r, damping=0.5)
+                if packed is not None:
+                    q2, r2, _, _ = packed_cycle(packed, q, r, damping=0.5)
+                else:
+                    q2, r2, _, _ = maxsum_cycle(tensors, q, r, damping=0.5)
                 return (q2, r2), ()
             (q, r), _ = jax.lax.scan(body, (q, r), None, length=args.cycles)
             return q, r
 
-        q0, r0 = init_messages(tensors)
+        q0, r0 = (
+            packed_init_state(packed) if packed is not None
+            else init_messages(tensors)
+        )
         q, r = run_n(q0, r0)
         jax.block_until_ready((q, r))
         times = []

@@ -87,7 +87,24 @@ class DynamicMaxSumSolver(MaxSumSolver):
                     f"{sliced.name!r} has arity {sliced.arity}, bucket "
                     f"expects {b.arity}"
                 )
+            # align the new tensor's axes to the bucket slot's variable
+            # order (the new constraint may list the same scope in a
+            # different order, e.g. constraint_from_str sorts by name)
+            slot_names = [
+                self.tensors.var_names[int(v)] for v in b.var_idx[k]
+            ]
+            new_names = [d.name for d in sliced.dimensions]
+            if set(slot_names) != set(new_names):
+                raise ValueError(
+                    f"Dynamic factor change must keep the scope: factor "
+                    f"{sliced.name!r} covers {new_names}, bucket slot "
+                    f"expects {slot_names}"
+                )
             t = self.tensors.sign * sliced.to_tensor()
+            if new_names != slot_names:
+                t = np.transpose(
+                    t, [new_names.index(n) for n in slot_names]
+                )
             D = self.tensors.max_domain_size
             padded = np.full((D,) * b.arity, PAD_COST, dtype=np.float32)
             padded[tuple(slice(0, s) for s in t.shape)] = t

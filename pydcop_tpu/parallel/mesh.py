@@ -206,11 +206,20 @@ class ShardedMaxSum:
         # local (per-shard) edge_var view is static: same for every shard?
         # NO — each shard has its own edge_var slice; pass it as a sharded
         # operand instead.
+        # operands are device_put with explicit shardings: required under
+        # multi-process meshes (each process materializes only its
+        # addressable shards from the replicated host copy), free on a
+        # single process
+        shard0 = NamedSharding(self.mesh, P(AXIS))
         bucket_args = []
         in_specs = [P(AXIS), P(AXIS), P(AXIS)]  # q, r, edge_var
         for sb in st.buckets:
-            bucket_args.extend([sb.tensors, sb.var_idx])
+            bucket_args.extend([
+                jax.device_put(sb.tensors, shard0),
+                jax.device_put(sb.var_idx, shard0),
+            ])
             in_specs.extend([P(AXIS), P(AXIS)])
+        self._edge_var_arg = jax.device_put(st.edge_var, shard0)
 
         def cycle_fn(q, r, edge_var, *buckets):
             # inside shard_map: blocks carry the per-shard slices
@@ -225,10 +234,14 @@ class ShardedMaxSum:
             check_vma=False,
         )
 
-        def run_n(q, r, n_cycles):
+        self._bucket_args = bucket_args
+
+        # global arrays must be jit ARGUMENTS, not closure constants —
+        # multi-process meshes reject closing over non-addressable shards
+        def run_n(q, r, n_cycles, edge_var, *buckets):
             def body(carry, _):
                 q, r = carry
-                q2, r2, values = sharded(q, r, st.edge_var, *bucket_args)
+                q2, r2, values = sharded(q, r, edge_var, *buckets)
                 return (q2, r2), values
 
             (q, r), values_hist = jax.lax.scan(
@@ -253,7 +266,9 @@ class ShardedMaxSum:
             self._build()
         if q is None or r is None:
             q, r = self.init_messages()
-        q, r, values = self._run_n(q, r, cycles)
+        q, r, values = self._run_n(
+            q, r, cycles, self._edge_var_arg, *self._bucket_args
+        )
         return np.asarray(values), q, r
 
 
@@ -319,11 +334,19 @@ class ShardedLocalSearch:
 
         st = self.st
         base = self.base
+        # sharded operands must be explicit jit arguments with committed
+        # shardings (multi-process meshes reject closure constants
+        # spanning non-addressable devices) — same rule as ShardedMaxSum
+        shard0 = NamedSharding(self.mesh, P(AXIS))
         bucket_args = []
         in_specs = [P(), P()]  # x, key replicated
         for sb in st.buckets:
-            bucket_args.extend([sb.tensors, sb.var_idx])
+            bucket_args.extend([
+                jax.device_put(sb.tensors, shard0),
+                jax.device_put(sb.var_idx, shard0),
+            ])
             in_specs.extend([P(AXIS), P(AXIS)])
+        self._bucket_args = bucket_args
 
         def cycle_fn(x, key, *buckets):
             partial = self._tables_block(x, *pairs(buckets))
@@ -354,9 +377,9 @@ class ShardedLocalSearch:
             check_vma=False,
         )
 
-        def run_n(x, keys):
+        def run_n(x, keys, *buckets):
             def body(x, k):
-                return sharded(x, k, *bucket_args), ()
+                return sharded(x, k, *buckets), ()
 
             x, _ = jax.lax.scan(body, x, keys)
             return x
@@ -371,4 +394,4 @@ class ShardedLocalSearch:
 
         x0 = random_valid_values(self.base, jax.random.PRNGKey(seed + 17))
         keys = jax.random.split(jax.random.PRNGKey(seed), cycles)
-        return np.asarray(self._run_n(x0, keys))
+        return np.asarray(self._run_n(x0, keys, *self._bucket_args))

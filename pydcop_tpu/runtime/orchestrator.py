@@ -207,6 +207,30 @@ class VirtualOrchestrator:
             ev.value = action.parameters["value"]
             if hasattr(self.solver, "on_external_change"):
                 self.solver.on_external_change(ev.name, ev.value)
+        elif action.type == "change_factor":
+            # factor hot-swap mid-scenario (∅→+ over the reference's
+            # add/remove_agent events; pairs with maxsum_dynamic's
+            # change_factor_function, ref maxsum_dynamic.py:188)
+            from pydcop_tpu.dcop.relations import constraint_from_str
+
+            if not hasattr(self.solver, "change_factor_function"):
+                raise ValueError(
+                    f"algorithm {self.algo_def.algo!r} cannot hot-swap "
+                    "factors; use maxsum_dynamic for change_factor "
+                    "scenarios"
+                )
+            name = action.parameters["constraint"]
+            if name not in self.dcop.constraints:
+                raise ValueError(
+                    f"change_factor: unknown constraint {name!r}"
+                )
+            expr = action.parameters["expression"]
+            old = self.dcop.constraints[name]
+            scope = list(old.dimensions) + [
+                ev for ev in self.dcop.external_variables.values()
+            ]
+            new_c = constraint_from_str(name, expr, scope)
+            self.solver.change_factor_function(new_c)
         else:
             raise ValueError(f"Unknown scenario action {action.type!r}")
 

@@ -57,7 +57,7 @@ def solve_result(
         # placement-driven path compiles straight from the dcop; don't
         # build the computation graph it would never read
         return _solve_under_placement(
-            dcop, algo_def, distribution, cycles, timeout
+            dcop, algo_def, distribution, cycles, timeout, collect_cycles
         )
 
     graph_type = graph or algo_module.GRAPH_TYPE
@@ -94,6 +94,7 @@ def _solve_under_placement(
     distribution,
     cycles: Optional[int],
     timeout: Optional[float],
+    collect_cycles: bool = False,
 ) -> SolveResult:
     """Run a solve whose device sharding is driven by an explicit
     placement (Distribution object).  Supported for the factor-graph BP
@@ -132,11 +133,15 @@ def _solve_under_placement(
                             assigns=assigns)
     n_cycles = cycles or 30
     status = "FINISHED"
-    if timeout is None:
+    history = []
+    if timeout is None and not collect_cycles:
         values, _q, _r = sharded.run(cycles=n_cycles)
     else:
-        # chunked so the timeout is honored between device dispatches
-        chunk = max(1, min(10, n_cycles))
+        # chunked so the timeout is honored (and per-cycle metrics are
+        # collected) between device dispatches
+        from pydcop_tpu.ops.compile import total_cost
+
+        chunk = 1 if collect_cycles else max(1, min(10, n_cycles))
         done = 0
         q = r = None
         values = None
@@ -144,7 +149,17 @@ def _solve_under_placement(
             n = min(chunk, n_cycles - done)
             values, q, r = sharded.run(cycles=n, q=q, r=r)
             done += n
-            if perf_counter() - t0 > timeout:
+            if collect_cycles:
+                import jax.numpy as jnp
+
+                history.append({
+                    "cycle": done,
+                    "cost": float(total_cost(
+                        tensors, jnp.asarray(values)
+                    )) * tensors.sign,
+                    "time": perf_counter() - t0,
+                })
+            if timeout is not None and perf_counter() - t0 > timeout:
                 status = "TIMEOUT"
                 break
         n_cycles = done
@@ -164,6 +179,7 @@ def _solve_under_placement(
             2 * edges * n_cycles * tensors.max_domain_size
         ),
         time=perf_counter() - t0,
+        history=history or None,
     )
 
 

@@ -1,0 +1,69 @@
+"""Run the ACTUAL /root/reference pyDCOP on an instance and print its
+result as one JSON line — the parity oracle for
+tests/parity/test_reference_parity.py.
+
+Usage: python ref_runner.py <instance.yaml> <algo> <timeout_s>
+
+Python-3.12 shims only (collections ABC aliases + a no-op
+websocket_server module injected into sys.modules); no reference file
+is modified or copied.
+"""
+import json
+import sys
+import types
+
+# --- py3.12 compat for the 3.7-era reference
+import collections
+import collections.abc
+for _n in ("Iterable", "Mapping", "Sequence", "Callable", "Hashable",
+           "MutableMapping", "Set", "MutableSet", "MutableSequence"):
+    if not hasattr(collections, _n):
+        setattr(collections, _n, getattr(collections.abc, _n))
+
+# --- websocket-server is not in the image; the UI is unused here
+_ws = types.ModuleType("websocket_server")
+_wsi = types.ModuleType("websocket_server.websocket_server")
+
+
+class _WS:  # noqa: D401 - minimal surface pydcop.infrastructure.ui needs
+    def __init__(self, *a, **k): pass
+
+    def __getattr__(self, name):
+        return lambda *a, **k: None
+
+
+_wsi.WebsocketServer = _WS
+_ws.WebsocketServer = _WS
+_ws.websocket_server = _wsi
+sys.modules["websocket_server"] = _ws
+sys.modules["websocket_server.websocket_server"] = _wsi
+
+sys.path.insert(0, "/root/reference")
+
+import logging
+logging.disable(logging.CRITICAL)
+
+
+def main():
+    instance, algo, timeout = sys.argv[1], sys.argv[2], float(sys.argv[3])
+    from pydcop.dcop.yamldcop import load_dcop_from_file
+    from pydcop.algorithms import AlgorithmDef, load_algorithm_module
+    from pydcop.infrastructure.run import solve
+
+    dcop = load_dcop_from_file([instance])
+    mod = load_algorithm_module(algo)
+    algo_def = AlgorithmDef.build_with_default_param(
+        algo, {}, parameters_definitions=mod.algo_params,
+        mode=dcop.objective,
+    )
+    assignment = solve(dcop, algo_def, "adhoc", timeout=timeout)
+    violation, cost = (None, None)
+    if assignment:
+        # reference solution_cost returns (hard_violations, soft_cost)
+        violation, cost = dcop.solution_cost(assignment, 10000)
+    print(json.dumps({"assignment": assignment, "cost": cost,
+                      "violation": violation}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

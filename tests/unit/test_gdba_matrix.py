@@ -1,0 +1,174 @@
+"""GDBA full option matrix (reference pydcop/algorithms/gdba.py:177-182):
+modifier {A, M} × violation {NZ, NM, MX} × increase_mode {E, R, C, T}.
+
+Semantics pinned by driving single cycles on crafted states:
+
+* weights bump ONLY at a quasi-local-minimum AND only for constraints
+  the violation criterion marks as violated;
+* the bumped entry set depends on increase_mode (E ⊆ R,C ⊆ T; for
+  binary constraints R == C: "reachable by deviating one variable" and
+  "keeping one variable's value" coincide at arity 2);
+* modifier A adds the weight to the base cost, M multiplies it.
+"""
+import itertools
+
+import jax.numpy as jnp
+import jax.random
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms import AlgorithmDef
+from pydcop_tpu.algorithms.gdba import GdbaSolver, algo_params
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.ops.compile import compile_constraint_graph
+
+MODIFIERS = ["A", "M"]
+VIOLATIONS = ["NZ", "NM", "MX"]
+INCREASES = ["E", "R", "C", "T"]
+
+
+def trap_dcop(m=None):
+    """Two binary vars, one constraint with a strict local minimum at
+    (0,0): cost 1, both unilateral moves cost 2 — quasi-local-min with
+    nonzero, non-minimal... wait, (0,0) IS the minimum here."""
+    dcop = DCOP("trap", objective="min")
+    d = Domain("d", "vals", [0, 1])
+    a, b = Variable("a", d), Variable("b", d)
+    dcop.add_variable(a)
+    dcop.add_variable(b)
+    m = np.array([[1.0, 2.0], [2.0, 3.0]]) if m is None else np.asarray(m)
+    dcop.add_constraint(NAryMatrixRelation([a, b], m, name="c"))
+    dcop.add_agents([AgentDef("ag")])
+    return dcop
+
+
+def make_solver(dcop, **params):
+    algo = AlgorithmDef.build_with_default_params(
+        "gdba", params, parameters_definitions=algo_params
+    )
+    return GdbaSolver(dcop, compile_constraint_graph(dcop), algo)
+
+
+def one_cycle(solver, x):
+    state = (jnp.asarray(x, dtype=jnp.int32), solver.initial_state()[1])
+    (x2, ws2) = solver.cycle(state, jax.random.PRNGKey(0))
+    return np.asarray(x2), [np.asarray(w) for w in ws2]
+
+
+@pytest.mark.parametrize(
+    "modifier,violation,increase",
+    list(itertools.product(MODIFIERS, VIOLATIONS, INCREASES)),
+)
+def test_full_matrix_solves_coloring(modifier, violation, increase):
+    from pydcop_tpu.generators import generate_graph_coloring
+    from pydcop_tpu.runtime import solve_result
+
+    dcop = generate_graph_coloring(
+        n_variables=10, n_colors=3, n_edges=16, soft=True, n_agents=1,
+        seed=5,
+    )
+    res = solve_result(
+        dcop, "gdba", cycles=20,
+        algo_params={"modifier": modifier, "violation": violation,
+                     "increase_mode": increase},
+    )
+    assert res.status == "FINISHED"
+    assert sorted(res.assignment) == sorted(dcop.variables)
+    assert res.cost < 500
+
+
+def test_weights_bump_only_at_quasi_local_min():
+    # (0,0) is a strict local min (gain 0 both vars) with cost 1 > 0:
+    # NZ bumps; a state with positive gain must NOT bump
+    dcop = trap_dcop()
+    solver = make_solver(dcop, violation="NZ", increase_mode="E")
+    _, ws = one_cycle(solver, [0, 0])
+    assert ws[0][0, 0, 0] == 1.0  # bumped current entry
+    assert ws[0].sum() == 1.0
+    # state (1, 1) costs 3; moving b to 0 gains 1 -> not stuck, no bump
+    _, ws = one_cycle(solver, [1, 1])
+    assert ws[0].sum() == 0.0
+
+
+def test_violation_modes_differ():
+    # at the (0,0) local min: cost 1 = fmin -> NM says NOT violated,
+    # NZ says violated (1 > 0), MX says not violated (1 < fmax=3)
+    dcop = trap_dcop()
+    for violation, expect_bump in (("NZ", True), ("NM", False),
+                                   ("MX", False)):
+        solver = make_solver(dcop, violation=violation, increase_mode="E")
+        _, ws = one_cycle(solver, [0, 0])
+        assert (ws[0].sum() > 0) == expect_bump, violation
+
+
+def test_violation_mx_fires_on_maximal_entry():
+    # constraint where the stuck state IS the maximal entry:
+    # M = [[5, 6], [6, 7]] has its min at (0,0)=5... need stuck at max.
+    # Use M = [[7, 8], [8, 8]]: at (1,1) cost 8 = fmax, moves cost 8 ->
+    # no gain -> stuck, MX violated.
+    dcop = trap_dcop(m=[[7.0, 8.0], [8.0, 8.0]])
+    solver = make_solver(dcop, violation="MX", increase_mode="E")
+    _, ws = one_cycle(solver, [1, 1])
+    assert ws[0][0, 1, 1] == 1.0
+    # NM also fires (8 > fmin=7); NZ also fires (8 > 0)
+    for violation in ("NM", "NZ"):
+        s2 = make_solver(dcop, violation=violation, increase_mode="E")
+        _, ws2 = one_cycle(s2, [1, 1])
+        assert ws2[0][0, 1, 1] == 1.0
+
+
+def test_increase_mode_entry_sets():
+    dcop = trap_dcop()
+    masks = {}
+    for mode in INCREASES:
+        solver = make_solver(dcop, violation="NZ", increase_mode=mode)
+        _, ws = one_cycle(solver, [0, 0])
+        masks[mode] = ws[0][0] > 0  # [D, D] bump mask of the constraint
+    # E: exactly the current entry
+    assert masks["E"].sum() == 1 and masks["E"][0, 0]
+    # R and C (binary): current row + column through (0,0) -> 3 entries
+    for mode in ("R", "C"):
+        assert masks[mode].sum() == 3
+        assert masks[mode][0, 0] and masks[mode][0, 1] and masks[mode][1, 0]
+        assert not masks[mode][1, 1]
+    # T: whole tensor
+    assert masks["T"].all()
+    # nesting: E <= R == C <= T
+    assert (masks["E"] <= masks["R"]).all()
+    assert (masks["R"] <= masks["T"]).all()
+
+
+def test_modifier_a_vs_m_effective_costs():
+    dcop = trap_dcop()
+    for modifier, expected in (("A", 1.0 + 1.0), ("M", 1.0 * 2.0)):
+        solver = make_solver(dcop, modifier=modifier, violation="NZ",
+                             increase_mode="E")
+        x = jnp.asarray([0, 0], dtype=jnp.int32)
+        state = (x, solver.initial_state()[1])
+        state = solver.cycle(state, jax.random.PRNGKey(0))
+        # after one bump the effective cost of entry (0,0) must be
+        # base+1 (A, W0=0) or base*2 (M, W0=1 bumped to 2)
+        eff = solver._effective(state[1])[0]
+        assert float(eff[0, 0, 0]) == pytest.approx(expected), modifier
+
+
+def test_breakout_escapes_local_minimum():
+    """The defining GDBA behavior: weight bumps eventually push the
+    search out of a local minimum a pure hill-climber cannot leave."""
+    # (0,0) local min cost 1; global optimum (1,1) cost 0 requires
+    # passing through cost-2 states -> plain MGM-style moves never take
+    # it, breakout re-weights (0,0) until a move opens
+    dcop = trap_dcop(m=[[1.0, 2.0], [2.0, 0.0]])
+    solver = make_solver(dcop, violation="NZ", increase_mode="E")
+    x = jnp.asarray([0, 0], dtype=jnp.int32)
+    state = (x, solver.initial_state()[1])
+    key = jax.random.PRNGKey(3)
+    seen = []
+    for _ in range(8):
+        key, sub = jax.random.split(key)
+        state = solver.cycle(state, sub)
+        seen.append(tuple(int(v) for v in np.asarray(state[0])))
+    assert (1, 1) in seen, seen
+    assert seen[-1] == (1, 1)  # and it stays at the optimum

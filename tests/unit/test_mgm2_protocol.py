@@ -1,0 +1,119 @@
+"""MGM-2 protocol edge cases (VERDICT item 6): offer collisions at one
+receiver, and a committed pair blocked by a stronger neighbor in the
+gain/go rounds (partners must BOTH win their neighborhoods — reference
+pydcop/algorithms/mgm2.py go handling).
+"""
+import jax.numpy as jnp
+import jax.random
+import numpy as np
+
+from pydcop_tpu.algorithms import AlgorithmDef
+from pydcop_tpu.algorithms.mgm2 import Mgm2Solver, algo_params
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.ops.compile import compile_constraint_graph
+
+
+def build(dcop, **params):
+    algo = AlgorithmDef.build_with_default_params(
+        "mgm2", params, parameters_definitions=algo_params
+    )
+    return Mgm2Solver(dcop, compile_constraint_graph(dcop), algo)
+
+
+def chain_dcop():
+    """a - b - c with joint gains 6 (a,b) and 2 (b,c); every unilateral
+    gain is 0.  Only coordinated moves can improve."""
+    dcop = DCOP("chain", objective="min")
+    d = Domain("d", "vals", [0, 1])
+    a, b, c = (Variable(n, d) for n in "abc")
+    for v in (a, b, c):
+        dcop.add_variable(v)
+    m0 = np.array([[10.0, 10.0], [10.0, 4.0]])
+    m1 = np.array([[8.0, 8.0], [8.0, 6.0]])
+    dcop.add_constraint(NAryMatrixRelation([a, b], m0, name="c0"))
+    dcop.add_constraint(NAryMatrixRelation([b, c], m1, name="c1"))
+    dcop.add_agents([AgentDef("ag")])
+    return dcop
+
+
+def run_cycle(solver, x, key):
+    (x2,) = solver.cycle((jnp.asarray(x, dtype=jnp.int32),), key)
+    return tuple(int(v) for v in np.asarray(x2))
+
+
+def test_offer_collision_receiver_takes_best():
+    """When both a and c offer to b, b must accept the (a,b) pair
+    (joint gain 6 beats 2); the (b,c) pair only ever wins when a did
+    not offer."""
+    solver = build(chain_dcop())
+    outcomes = set()
+    for k in range(60):
+        outcomes.add(run_cycle(solver, [0, 0, 0], jax.random.PRNGKey(k)))
+    # possible cycle-1 outcomes: pair (a,b) moved, pair (b,c) moved, or
+    # no valid offer happened this cycle
+    assert outcomes <= {(1, 1, 0), (0, 1, 1), (0, 0, 0)}, outcomes
+    assert (1, 1, 0) in outcomes  # the best pair does move
+    # a unilateral move alone is never an improvement here
+    assert (1, 0, 0) not in outcomes and (0, 1, 0) not in outcomes
+
+
+def test_chain_converges_to_coordinated_optimum():
+    solver = build(chain_dcop())
+    x = jnp.asarray([0, 0, 0], dtype=jnp.int32)
+    key = jax.random.PRNGKey(1)
+    state = (x,)
+    for _ in range(12):
+        key, sub = jax.random.split(key)
+        state = solver.cycle(state, sub)
+    final = tuple(int(v) for v in np.asarray(state[0]))
+    # the pair move reaches (1,1,0) cost 12, then c follows unilaterally:
+    # global optimum (1,1,1), cost M0[1,1] + M1[1,1] = 4 + 6 = 10
+    assert final == (1, 1, 1)
+    _, cost = solver.dcop.solution_cost(
+        {"a": 1, "b": 1, "c": 1}, 10000)
+    assert cost == 10
+
+
+def test_pair_blocked_by_stronger_neighbor():
+    """A committed pair whose member loses its neighborhood to a bigger
+    unilateral gain must NOT move; the big gain moves instead."""
+    dcop = DCOP("blocked", objective="min")
+    d = Domain("d", "vals", [0, 1])
+    a, b, dd = (Variable(n, d) for n in ("a", "b", "d"))
+    for v in (a, b, dd):
+        dcop.add_variable(v)
+    # pair (a,b): joint gain 6, unilateral 0 (same trap as chain_dcop)
+    m0 = np.array([[10.0, 10.0], [10.0, 4.0]])
+    # d: unilateral gain 100 by moving to 1; neighbor of b
+    m2 = np.array([[100.0, 0.0], [100.0, 0.0]])  # cost(b, d)
+    dcop.add_constraint(NAryMatrixRelation([a, b], m0, name="c0"))
+    dcop.add_constraint(NAryMatrixRelation([b, dd], m2, name="c1"))
+    dcop.add_agents([AgentDef("ag")])
+    solver = build(dcop)
+    for k in range(40):
+        out = run_cycle(solver, [0, 0, 0], jax.random.PRNGKey(k))
+        # d always wins its neighborhood (gain 100) and moves; b loses
+        # (6 < 100), so the pair never goes this cycle; a alone must not
+        # move either (its only gain is the blocked pair move)
+        assert out[2] == 1, (k, out)
+        assert out[0] == 0 and out[1] == 0, (k, out)
+
+
+def test_threshold_zero_means_pure_mgm():
+    """threshold=0: nobody offers, MGM-2 degenerates to MGM — in the
+    all-coordination trap nothing can move."""
+    solver = build(chain_dcop(), threshold=0.0)
+    for k in range(10):
+        assert run_cycle(solver, [0, 0, 0], jax.random.PRNGKey(k)) == \
+            (0, 0, 0)
+
+
+def test_threshold_one_means_everyone_offers():
+    """threshold=1: every variable is an offerer, so no one receives —
+    offers need a non-offerer other end — and again nothing moves."""
+    solver = build(chain_dcop(), threshold=1.0)
+    for k in range(10):
+        assert run_cycle(solver, [0, 0, 0], jax.random.PRNGKey(k)) == \
+            (0, 0, 0)

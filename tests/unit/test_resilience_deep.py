@@ -1,0 +1,177 @@
+"""Deeper resilience coverage: sequential failures, replica survival
+under k-replication, repair placement quality, and metrics continuity
+(reference flow: pydcop/infrastructure/orchestrator.py:943-1125 +
+agents.py:1044-1355).
+"""
+import os
+
+import pytest
+
+from pydcop_tpu.dcop import (
+    AgentDef,
+    DcopEvent,
+    EventAction,
+    Scenario,
+    load_dcop_from_file,
+)
+from pydcop_tpu.distribution.objects import Distribution
+from pydcop_tpu.replication import place_replicas, route_distances
+from pydcop_tpu.runtime.orchestrator import VirtualOrchestrator
+
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+
+
+@pytest.fixture
+def tuto():
+    return load_dcop_from_file(
+        os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+    )
+
+
+def removal_scenario(*agents, delay=0.3):
+    events = [DcopEvent("d0", delay=delay)]
+    for i, a in enumerate(agents):
+        events.append(DcopEvent(
+            f"e{i}", actions=[EventAction("remove_agent", agent=a)]
+        ))
+        events.append(DcopEvent(f"d{i + 1}", delay=delay))
+    return Scenario(events)
+
+
+class TestSequentialFailures:
+    def test_two_sequential_removals_still_hosted(self, tuto):
+        orch = VirtualOrchestrator(tuto, "maxsum", distribution="adhoc")
+        orch.deploy_computations()
+        orch.start_replication(2)
+        res = orch.run(removal_scenario("a1", "a2"), timeout=60)
+        assert res.status == "FINISHED"
+        assert "a1" not in orch.distribution.agents
+        assert "a2" not in orch.distribution.agents
+        hosted = sorted(orch.distribution.computations)
+        assert hosted == sorted(n.name for n in orch.cg.nodes)
+        assert res.cost == 12  # quality survives two repairs
+
+    def test_events_logged_per_removal(self, tuto):
+        orch = VirtualOrchestrator(tuto, "maxsum", distribution="adhoc")
+        orch.deploy_computations()
+        orch.start_replication(2)
+        orch.run(removal_scenario("a1", "a2"), timeout=60)
+        action_logs = [
+            e["actions"] for e in orch.events_log if "actions" in e
+        ]
+        assert action_logs.count(["remove_agent"]) == 2
+        # each removal triggers a repair placement entry
+        repairs = [e for e in orch.events_log if "repaired" in e]
+        assert len(repairs) == 2
+
+    def test_add_agent_then_remove_other(self, tuto):
+        orch = VirtualOrchestrator(tuto, "maxsum", distribution="adhoc")
+        orch.deploy_computations()
+        orch.start_replication(2)
+        scenario = Scenario([
+            DcopEvent("d0", delay=0.3),
+            DcopEvent("e0", actions=[
+                EventAction("add_agent", agent="a_new")
+            ]),
+            DcopEvent("e1", actions=[
+                EventAction("remove_agent", agent="a1")
+            ]),
+            DcopEvent("d1", delay=0.3),
+        ])
+        res = orch.run(scenario, timeout=60)
+        assert res.status == "FINISHED"
+        assert "a_new" in orch.distribution.agents
+        hosted = sorted(orch.distribution.computations)
+        assert hosted == sorted(n.name for n in orch.cg.nodes)
+
+
+class TestReplicaSurvival:
+    def agents(self, n, capacity=10):
+        return [
+            AgentDef(f"a{i}", capacity=capacity,
+                     routes={f"a{j}": 1 for j in range(n) if j != i})
+            for i in range(n)
+        ]
+
+    def test_k2_replicas_survive_single_failure(self):
+        agents = self.agents(5)
+        comps = ["c0", "c1", "c2"]
+        dist = Distribution({
+            "a0": ["c0"], "a1": ["c1"], "a2": ["c2"], "a3": [], "a4": [],
+        })
+        placement = place_replicas(
+            comps, dist, agents, k=2, computation_memory=lambda c: 1.0
+        )
+        for comp in comps:
+            hosts = placement.replicas(comp)
+            assert len(hosts) == 2
+            owner = dist.agent_for(comp)
+            assert owner not in hosts  # replicas live off the owner
+            # single agent failure leaves at least one replica
+            for failed in agents:
+                survivors = [h for h in hosts if h != failed.name]
+                assert survivors or failed.name not in hosts
+
+    def test_replicas_prefer_cheap_routes(self):
+        # a1 is 1 hop from a0; a2 is 100 — k=1 replica of a0's comp
+        # must land on a1
+        agents = [
+            AgentDef("a0", capacity=10, routes={"a1": 1, "a2": 100}),
+            AgentDef("a1", capacity=10, routes={"a0": 1, "a2": 100}),
+            AgentDef("a2", capacity=10, routes={"a0": 100, "a1": 100}),
+        ]
+        dist = Distribution({"a0": ["c0"], "a1": [], "a2": []})
+        placement = place_replicas(
+            ["c0"], dist, agents, k=1, computation_memory=lambda c: 1.0
+        )
+        assert placement.replicas("c0") == ["a1"]
+
+    def test_replica_count_capped_by_agents(self):
+        agents = self.agents(3)
+        dist = Distribution({"a0": ["c0"], "a1": [], "a2": []})
+        placement = place_replicas(
+            ["c0"], dist, agents, k=5, computation_memory=lambda c: 1.0
+        )
+        # only 2 other agents exist: k is effectively min(k, |A|-1)
+        assert len(placement.replicas("c0")) == 2
+
+
+class TestRouteDistances:
+    def test_disconnected_agents_unreachable(self):
+        # routes are direction-of-sender: every agent must declare the
+        # partition (default inf) for a3 to be truly unreachable
+        inf = float("inf")
+        agents = [
+            AgentDef("a1", routes={"a2": 1}, default_route=inf),
+            AgentDef("a2", routes={"a1": 1}, default_route=inf),
+            AgentDef("a3", routes={}, default_route=inf),
+        ]
+        d = route_distances(agents)
+        assert d["a1"]["a2"] == 1
+        assert d["a1"].get("a3", inf) == inf
+
+    def test_default_route_used_when_no_explicit(self):
+        agents = [AgentDef("a1", default_route=3),
+                  AgentDef("a2", default_route=3)]
+        d = route_distances(agents)
+        assert d["a1"]["a2"] == 3
+
+
+class TestRepairQuality:
+    def test_repair_prefers_low_comm_hosts(self, tuto):
+        """After removing an agent, its computation should land on a
+        surviving replica host (not vanish, not duplicate)."""
+        orch = VirtualOrchestrator(tuto, "maxsum", distribution="adhoc")
+        orch.deploy_computations()
+        orch.start_replication(2)
+        lost = orch.distribution.computations_hosted("a1")
+        res = orch.run(removal_scenario("a1"), timeout=60)
+        assert res.status == "FINISHED"
+        for comp in lost:
+            new_host = orch.distribution.agent_for(comp)
+            assert new_host != "a1"
+        # no computation is hosted twice
+        all_comps = []
+        for a in orch.distribution.agents:
+            all_comps.extend(orch.distribution.computations_hosted(a))
+        assert len(all_comps) == len(set(all_comps))
