@@ -1,12 +1,17 @@
-"""`pydcop_tpu agent` — control-plane agent client.
+"""`pydcop_tpu agent` — agent process client.
 
 Equivalent capability to the reference's pydcop/commands/agent.py (:32-46):
 in the reference, agent processes host computations and exchange algorithm
-messages over HTTP.  In the TPU framework computations execute as batched
-device kernels on the orchestrator; agent processes participate in the
-control plane only: they register with the orchestrator, wait for the
-solve, and print the final metrics.  (--restart is accepted for CLI
-compatibility.)
+messages over HTTP.  Two modes here:
+
+* default (control plane): computations execute as batched device kernels
+  on the orchestrator; the agent registers, waits for the solve, prints
+  the final metrics.  (--restart accepted for CLI compatibility.)
+* ``--multihost``: the agent process IS a compute participant — one rank
+  of the global device mesh (parallel/multihost.py).  All ranks load the
+  same DCOP (SPMD), shard the factor graph over the global mesh, and
+  exchange messages through the mesh collectives instead of HTTP — the
+  true TPU-native equivalent of reference agents hosting computations.
 """
 from __future__ import annotations
 
@@ -22,7 +27,8 @@ def set_parser(subparsers):
         "agent", help="agent client for a standalone orchestrator"
     )
     parser.set_defaults(func=run_cmd)
-    parser.add_argument("-n", "--names", nargs="+", required=True)
+    parser.add_argument("-n", "--names", nargs="+", default=None,
+                        help="agent names (control-plane mode)")
     parser.add_argument("--address", default="127.0.0.1",
                         help="accepted for compatibility")
     parser.add_argument("-p", "--port", type=int, default=9001,
@@ -30,7 +36,72 @@ def set_parser(subparsers):
     parser.add_argument("--orchestrator", default="127.0.0.1:9000",
                         help="orchestrator address host:port")
     parser.add_argument("--restart", action="store_true")
+    # --multihost: this agent is one rank of a global device mesh
+    parser.add_argument("--multihost", action="store_true",
+                        help="be a compute rank of a multi-process mesh "
+                        "instead of a control-plane client")
+    parser.add_argument("--coordinator", default="127.0.0.1:29517")
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
+    parser.add_argument("--local-devices", type=int, default=None,
+                        help="force N virtual CPU devices (testing)")
+    parser.add_argument("--platform", default=None,
+                        help="cpu for testing; default autodetect")
+    parser.add_argument("--dcop", default=None,
+                        help="DCOP YAML (must be identical on all ranks)")
+    parser.add_argument("--algo", default="maxsum")
+    parser.add_argument("--cycles", type=int, default=30)
     return parser
+
+
+def run_multihost(args):
+    if args.num_processes is None or args.process_id is None:
+        output_metrics(
+            {"status": "ERROR",
+             "error": "--multihost needs --num-processes and "
+             "--process-id"}, args.output)
+        return 1
+    if not args.dcop:
+        output_metrics(
+            {"status": "ERROR", "error": "--multihost needs --dcop"},
+            args.output)
+        return 1
+    if args.algo not in ("maxsum", "amaxsum"):
+        output_metrics(
+            {"status": "ERROR",
+             "error": f"multihost mesh execution supports the maxsum "
+             f"family, not {args.algo!r}"}, args.output)
+        return 1
+    from pydcop_tpu.parallel.multihost import (
+        init_multihost,
+        run_multihost_maxsum,
+    )
+
+    init_multihost(
+        args.coordinator, args.num_processes, args.process_id,
+        local_devices=args.local_devices, platform=args.platform,
+    )
+    from pydcop_tpu.dcop import load_dcop_from_file
+
+    dcop = load_dcop_from_file(args.dcop)
+    t0 = time.time()
+    from pydcop_tpu.algorithms import DEFAULT_INFINITY
+
+    values, n_devices, tensors = run_multihost_maxsum(
+        dcop, cycles=args.cycles)
+    assignment = tensors.assignment_from_indices(values)
+    violation, cost = dcop.solution_cost(assignment, DEFAULT_INFINITY)
+    output_metrics({
+        "status": "FINISHED",
+        "assignment": assignment,
+        "cost": cost,
+        "violation": violation,
+        "cycle": args.cycles,
+        "time": time.time() - t0,
+        "process_id": args.process_id,
+        "n_global_devices": int(n_devices),
+    }, args.output)
+    return 0
 
 
 def _request(url: str, payload=None):
@@ -46,6 +117,13 @@ def _request(url: str, payload=None):
 
 
 def run_cmd(args):
+    if args.multihost:
+        return run_multihost(args)
+    if not args.names:
+        output_metrics(
+            {"status": "ERROR",
+             "error": "control-plane mode needs --names"}, args.output)
+        return 1
     base = f"http://{args.orchestrator}"
     deadline = time.time() + (args.timeout or 60)
     # register every agent name

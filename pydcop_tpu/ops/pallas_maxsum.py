@@ -334,6 +334,70 @@ def packed_cycle(
     return q_new, r_new, beliefs, values
 
 
+def packed_cycles(
+    pg: PackedMaxSumGraph,
+    q: jnp.ndarray,
+    r: jnp.ndarray,
+    n_cycles: int,
+    damping: float = 0.0,
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """``n_cycles`` fused MaxSum cycles in ONE pallas kernel.
+
+    Amortizes per-kernel launch/dispatch cost: cycles are statically
+    UNROLLED inside the kernel (a fori_loop carry would double-buffer
+    (q, r) and blow the ~16MB VMEM scoped-allocation limit at benchmark
+    sizes), so kernel size grows linearly with ``n_cycles`` — keep it
+    small (≤ ~16); measured sweet spot ~5 on the 10k-var bench.  Returns
+    (q', r', beliefs, values) after the last cycle — intermediate
+    beliefs are not materialized, so use :func:`packed_cycle` when
+    per-cycle values are needed.
+    """
+    if not 1 <= n_cycles <= 64:
+        raise ValueError(
+            f"packed_cycles unrolls in-kernel: n_cycles must be in "
+            f"[1, 64], got {n_cycles}"
+        )
+    interpret = _resolve_interpret(interpret)
+    D, N, Vp = pg.D, pg.N, pg.Vp
+
+    def kern(q_ref, r_ref, cost_ref, unary_ref, vmask_ref,
+             invd_ref, c_r1, c_g1, c_ss, c_g2, c_r2, q_out, r_out, b_out):
+        cost = cost_ref[:]
+        unary = unary_ref[:]
+        vmask = vmask_ref[:]
+        invd = invd_ref[:]
+        consts = (c_r1[:], c_g1[:], c_ss[:], c_g2[:], c_r2[:])
+
+        # static unroll: a fori_loop carry would double-buffer (q, r) and
+        # push the kernel over the ~16MB VMEM scoped-allocation limit at
+        # benchmark sizes; unrolled cycles let Mosaic reuse buffers
+        qn, rn = q_ref[:], r_ref[:]
+        bel = None
+        for _ in range(n_cycles):
+            qn, rn, bel = _cycle_body(
+                pg, damping, qn, rn, cost, unary, vmask, invd, consts
+            )
+        q_out[:] = qn
+        r_out[:] = rn
+        b_out[:] = bel
+
+    q_new, r_new, beliefs = pl.pallas_call(
+        kern,
+        out_shape=(
+            jax.ShapeDtypeStruct((D, N), jnp.float32),
+            jax.ShapeDtypeStruct((D, N), jnp.float32),
+            jax.ShapeDtypeStruct((D, Vp), jnp.float32),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 11,
+        out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 3),
+        interpret=interpret,
+    )(q, r, pg.cost_rows, pg.unary_p, pg.vmask, pg.inv_dcount,
+      *_plan_consts(pg.plan))
+    values = packed_values(pg, beliefs)
+    return q_new, r_new, beliefs, values
+
+
 def packed_values(pg: PackedMaxSumGraph, beliefs: jnp.ndarray) -> jnp.ndarray:
     """Masked argmin per padded column, mapped to original variable order."""
     big = jnp.where(pg.mask_p > 0, beliefs, PAD_COST)

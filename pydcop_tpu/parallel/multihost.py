@@ -76,8 +76,8 @@ def global_mesh():
 
 def run_multihost_maxsum(dcop, cycles: int = 15, damping: float = 0.5):
     """Solve `dcop` with MaxSum sharded over the global multi-process
-    mesh.  Returns (values, n_global_devices).  Every process must call
-    this with an identical dcop (SPMD)."""
+    mesh.  Returns (values, n_global_devices, tensors).  Every process
+    must call this with an identical dcop (SPMD)."""
     from pydcop_tpu.ops.compile import compile_factor_graph
     from pydcop_tpu.parallel.mesh import ShardedMaxSum
 
@@ -85,7 +85,7 @@ def run_multihost_maxsum(dcop, cycles: int = 15, damping: float = 0.5):
     mesh = global_mesh()
     sharded = ShardedMaxSum(tensors, mesh, damping=damping)
     values, _q, _r = sharded.run(cycles=cycles)
-    return values, mesh.devices.size
+    return values, mesh.devices.size, tensors
 
 
 def main(argv=None) -> int:
@@ -93,10 +93,12 @@ def main(argv=None) -> int:
     ap.add_argument("--coordinator", default="127.0.0.1:29517")
     ap.add_argument("--num-processes", type=int, required=True)
     ap.add_argument("--process-id", type=int, required=True)
-    ap.add_argument("--local-devices", type=int, default=4)
-    ap.add_argument("--platform", default="cpu",
-                    help="cpu for testing; empty string = autodetect "
-                    "(real TPU hosts)")
+    ap.add_argument("--local-devices", type=int, default=None,
+                    help="force N virtual CPU devices per process "
+                    "(testing); default: discover local chips")
+    ap.add_argument("--platform", default="",
+                    help="default: autodetect (real TPU hosts); pass "
+                    "'cpu' for testing")
     ap.add_argument("--vars", type=int, default=60)
     ap.add_argument("--edges", type=int, default=120)
     ap.add_argument("--cycles", type=int, default=15)
@@ -114,7 +116,8 @@ def main(argv=None) -> int:
         n_variables=args.vars, n_colors=3, n_edges=args.edges,
         soft=True, n_agents=1, seed=args.seed,
     )
-    values, n_devices = run_multihost_maxsum(dcop, cycles=args.cycles)
+    values, n_devices, _tensors = run_multihost_maxsum(
+        dcop, cycles=args.cycles)
     import numpy as np
 
     print(json.dumps({

@@ -5,18 +5,40 @@ Two JAX processes × 4 virtual CPU devices form one global 8-device mesh
 via jax.distributed (Gloo); both run the same sharded MaxSum and must
 agree with each other AND with the single-process 8-device mesh result.
 """
+import contextlib
 import json
 import os
+import socket
 import subprocess
 import sys
 
 import pytest
 
 REPO = os.path.join(os.path.dirname(__file__), "..", "..")
-PORT = 29517
 
 
-def spawn_worker(process_id, num_processes=2):
+def free_port():
+    """OS-assigned free port for the jax.distributed coordinator — fixed
+    ports collide across parallel/reentrant test runs."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@contextlib.contextmanager
+def reaped(procs):
+    """Kill stragglers on any failure: an asserting rank must not leave
+    its peer blocked in jax.distributed.initialize holding the port."""
+    try:
+        yield procs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+
+
+def spawn_worker(process_id, port, num_processes=2):
     env = {
         **os.environ,
         "PYTHONPATH": REPO,  # drop axon sitecustomize so cpu sticks
@@ -24,7 +46,7 @@ def spawn_worker(process_id, num_processes=2):
     }
     return subprocess.Popen(
         [sys.executable, "-m", "pydcop_tpu.parallel.multihost",
-         "--coordinator", f"127.0.0.1:{PORT}",
+         "--coordinator", f"127.0.0.1:{port}",
          "--num-processes", str(num_processes),
          "--process-id", str(process_id),
          "--local-devices", "4", "--platform", "cpu",
@@ -35,12 +57,13 @@ def spawn_worker(process_id, num_processes=2):
 
 
 def test_two_process_mesh_agrees_with_single_process():
-    procs = [spawn_worker(0), spawn_worker(1)]
+    port = free_port()
     outs = []
-    for p in procs:
-        stdout, stderr = p.communicate(timeout=240)
-        assert p.returncode == 0, stderr[-1500:]
-        outs.append(json.loads(stdout.strip().splitlines()[-1]))
+    with reaped([spawn_worker(0, port), spawn_worker(1, port)]) as procs:
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=240)
+            assert p.returncode == 0, stderr[-1500:]
+            outs.append(json.loads(stdout.strip().splitlines()[-1]))
 
     # both processes computed over the GLOBAL 8-device mesh
     assert all(o["n_global_devices"] == 8 for o in outs), outs
@@ -62,3 +85,61 @@ def test_two_process_mesh_agrees_with_single_process():
     sharded = ShardedMaxSum(tensors, build_mesh(8), damping=0.5)
     values, _, _ = sharded.run(cycles=15)
     assert int(np.asarray(values).sum()) == outs[0]["values_checksum"]
+
+
+def test_agent_multihost_cli(tmp_path):
+    """`pydcop_tpu agent --multihost` — agent processes as compute ranks
+    of a global mesh, the TPU-native twin of reference agent processes
+    hosting computations (pydcop/commands/agent.py:32-46)."""
+    from pydcop_tpu.dcop import dcop_yaml
+    from pydcop_tpu.generators import generate_graph_coloring
+
+    dcop = generate_graph_coloring(
+        n_variables=30, n_colors=3, n_edges=60, soft=True, n_agents=1,
+        seed=2,
+    )
+    dcop_f = tmp_path / "prob.yaml"
+    dcop_f.write_text(dcop_yaml(dcop))
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO,
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+    }
+
+    port = free_port()
+
+    def worker(pid):
+        return subprocess.Popen(
+            [sys.executable, "-m", "pydcop_tpu", "--timeout", "240",
+             "agent", "--multihost",
+             "--coordinator", f"127.0.0.1:{port}",
+             "--num-processes", "2", "--process-id", str(pid),
+             "--local-devices", "4", "--platform", "cpu",
+             "--dcop", str(dcop_f), "--algo", "maxsum",
+             "--cycles", "12"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=REPO,
+        )
+
+    outs = []
+    with reaped([worker(0), worker(1)]) as procs:
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=240)
+            assert p.returncode == 0, stderr[-1500:]
+            # Gloo may chat on stdout before the metrics JSON
+            payload = stdout[stdout.find("{"):]
+            outs.append(json.JSONDecoder().raw_decode(payload)[0])
+    assert all(o["status"] == "FINISHED" for o in outs)
+    assert all(o["n_global_devices"] == 8 for o in outs)
+    assert outs[0]["assignment"] == outs[1]["assignment"]
+    assert outs[0]["cost"] == outs[1]["cost"]
+
+
+def test_agent_multihost_rejects_missing_args():
+    env = {**os.environ, "PYTHONPATH": REPO}
+    out = subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu", "agent", "--multihost"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+    assert out.returncode != 0
+    assert "num-processes" in out.stdout or "num-processes" in out.stderr

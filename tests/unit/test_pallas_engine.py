@@ -228,3 +228,36 @@ class TestEngineSelection:
         assert solver.packed is None  # degraded, not crashed
         res = solver.run(cycles=5)
         assert res.status == "FINISHED"
+
+
+class TestFusedCycles:
+    def test_fused_matches_per_cycle(self):
+        from pydcop_tpu.ops.pallas_maxsum import packed_cycles
+
+        t = _random_binary_instance()
+        pg = pack_for_pallas(t)
+        q1, r1 = packed_init_state(pg)
+        for _ in range(6):
+            q1, r1, bel1, vals1 = packed_cycle(
+                pg, q1, r1, damping=0.5, interpret=True
+            )
+        q2, r2 = packed_init_state(pg)
+        q2, r2, bel2, vals2 = packed_cycles(
+            pg, q2, r2, 6, damping=0.5, interpret=True
+        )
+        assert np.allclose(np.asarray(q1), np.asarray(q2), atol=1e-4)
+        assert np.allclose(np.asarray(r1), np.asarray(r2), atol=1e-4)
+        assert np.array_equal(np.asarray(vals1), np.asarray(vals2))
+
+    def test_fused_single_cycle(self):
+        from pydcop_tpu.ops.pallas_maxsum import packed_cycles
+
+        t = _random_binary_instance()
+        pg = pack_for_pallas(t)
+        q0, r0 = packed_init_state(pg)
+        q1, r1, _, v1 = packed_cycle(pg, q0, r0, damping=0.0,
+                                     interpret=True)
+        q2, r2, _, v2 = packed_cycles(pg, q0, r0, 1, damping=0.0,
+                                      interpret=True)
+        assert np.allclose(np.asarray(q1), np.asarray(q2), atol=1e-5)
+        assert np.array_equal(np.asarray(v1), np.asarray(v2))

@@ -1,0 +1,174 @@
+"""YAML format robustness: extensional value tables, initial values
+honored by solvers, distribution hints, and malformed-input errors
+(reference format: pydcop/dcop/yamldcop.py).
+"""
+import textwrap
+
+import pytest
+
+from pydcop_tpu.dcop import load_dcop
+from pydcop_tpu.dcop.yamldcop import dcop_yaml
+from pydcop_tpu.runtime import solve_result
+
+
+class TestExtensional:
+    YAML = textwrap.dedent("""
+        name: ext
+        objective: min
+        domains:
+          d: {values: [a, b, c]}
+        variables:
+          x: {domain: d}
+          y: {domain: d}
+        constraints:
+          table:
+            type: extensional
+            variables: [x, y]
+            default: 9
+            values:
+              0: a a | b b
+              1: a b
+        agents: [a1, a2, a3]
+    """)
+
+    def test_values_and_default(self):
+        dcop = load_dcop(self.YAML)
+        c = dcop.constraints["table"]
+        assert c(x="a", y="a") == 0
+        assert c(x="b", y="b") == 0
+        assert c(x="a", y="b") == 1
+        assert c(x="c", y="a") == 9  # default
+
+    def test_solvable(self):
+        dcop = load_dcop(self.YAML)
+        res = solve_result(dcop, "dpop")
+        assert res.cost == 0
+        assert res.assignment["x"] == res.assignment["y"]
+
+    def test_roundtrip_preserves_semantics(self):
+        dcop = load_dcop(self.YAML)
+        dcop2 = load_dcop(dcop_yaml(dcop))
+        c1, c2 = dcop.constraints["table"], dcop2.constraints["table"]
+        for x in "abc":
+            for y in "abc":
+                assert c1(x=x, y=y) == c2(x=x, y=y), (x, y)
+
+
+class TestInitialValues:
+    YAML = textwrap.dedent("""
+        name: init
+        objective: min
+        domains:
+          d: {values: [0, 1, 2]}
+        variables:
+          x: {domain: d, initial_value: 2}
+          y: {domain: d, initial_value: 1}
+        constraints:
+          free:
+            type: intention
+            function: "0 * (x + y)"
+        agents: [a1, a2, a3]
+    """)
+
+    def test_parsed(self):
+        dcop = load_dcop(self.YAML)
+        assert dcop.variables["x"].initial_value == 2
+        assert dcop.variables["y"].initial_value == 1
+
+    def test_local_search_starts_from_initial_values(self):
+        """All-zero constraint -> no gain ever -> a local-search solver
+        must keep the declared initial values."""
+        dcop = load_dcop(self.YAML)
+        res = solve_result(dcop, "mgm", cycles=10)
+        assert res.assignment == {"x": 2, "y": 1}
+
+    def test_invalid_initial_value_rejected(self):
+        from pydcop_tpu.dcop.yamldcop import DcopInvalidFormatError
+
+        bad = self.YAML.replace("initial_value: 2", "initial_value: 7")
+        with pytest.raises(DcopInvalidFormatError, match="initial value"):
+            load_dcop(bad)
+
+
+class TestHints:
+    def test_must_host_hints_parsed_and_applied(self):
+        yaml_str = textwrap.dedent("""
+            name: hints
+            objective: min
+            domains:
+              d: {values: [0, 1]}
+            variables:
+              x: {domain: d}
+              y: {domain: d}
+            constraints:
+              c:
+                type: intention
+                function: "x + y"
+            agents: [a1, a2, a3]
+            distribution_hints:
+              must_host:
+                a1: [x]
+                a2: [y]
+        """)
+        dcop = load_dcop(yaml_str)
+        hints = dcop.dist_hints
+        assert hints.must_host("a1") == ["x"]
+        from pydcop_tpu.distribution import load_distribution_module
+        from pydcop_tpu.graph import constraints_hypergraph
+
+        cg = constraints_hypergraph.build_computation_graph(dcop)
+        dist = load_distribution_module("adhoc").distribute(
+            cg, dcop.agents.values(), hints=hints,
+            computation_memory=lambda n: 1.0,
+        )
+        assert "x" in dist.computations_hosted("a1")
+        assert "y" in dist.computations_hosted("a2")
+
+
+class TestMalformed:
+    def test_no_variables_section(self):
+        from pydcop_tpu.dcop.yamldcop import DcopInvalidFormatError
+
+        with pytest.raises(DcopInvalidFormatError, match="variables"):
+            load_dcop("name: empty\ndomains:\n  d: {values: [0]}\n")
+
+    def test_unknown_domain_reference(self):
+        bad = textwrap.dedent("""
+            name: bad
+            domains:
+              d: {values: [0, 1]}
+            variables:
+              x: {domain: nosuch}
+            agents: [a1]
+        """)
+        with pytest.raises(Exception):
+            load_dcop(bad)
+
+    def test_constraint_over_unknown_variable(self):
+        bad = textwrap.dedent("""
+            name: bad
+            domains:
+              d: {values: [0, 1]}
+            variables:
+              x: {domain: d}
+            constraints:
+              c:
+                type: intention
+                function: "x + zz"
+            agents: [a1]
+        """)
+        with pytest.raises(Exception):
+            load_dcop(bad)
+
+    def test_bad_objective_rejected(self):
+        bad = textwrap.dedent("""
+            name: bad
+            objective: fastest
+            domains:
+              d: {values: [0, 1]}
+            variables:
+              x: {domain: d}
+            agents: [a1]
+        """)
+        with pytest.raises(ValueError, match="objective"):
+            load_dcop(bad)
