@@ -66,11 +66,13 @@ def run_multihost(args):
             {"status": "ERROR", "error": "--multihost needs --dcop"},
             args.output)
         return 1
-    if args.algo not in ("maxsum", "amaxsum"):
+    if args.algo != "maxsum":
+        # amaxsum's activation masks are not implemented in the sharded
+        # engine — refusing beats silently running synchronous maxsum
         output_metrics(
             {"status": "ERROR",
-             "error": f"multihost mesh execution supports the maxsum "
-             f"family, not {args.algo!r}"}, args.output)
+             "error": f"multihost mesh execution supports 'maxsum', "
+             f"not {args.algo!r}"}, args.output)
         return 1
     from pydcop_tpu.parallel.multihost import (
         init_multihost,

@@ -304,34 +304,7 @@ def packed_cycle(
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One fused MaxSum cycle.  Returns (q', r', beliefs [D,Vp], values [V])
     with values in ORIGINAL variable order."""
-    interpret = _resolve_interpret(interpret)
-    D, N, Vp = pg.D, pg.N, pg.Vp
-
-    def kern(q_ref, r_ref, cost_ref, unary_ref, vmask_ref,
-             invd_ref, c_r1, c_g1, c_ss, c_g2, c_r2, q_out, r_out, b_out):
-        qn, rn, bel = _cycle_body(
-            pg, damping, q_ref[:], r_ref[:], cost_ref[:], unary_ref[:],
-            vmask_ref[:], invd_ref[:],
-            (c_r1[:], c_g1[:], c_ss[:], c_g2[:], c_r2[:]),
-        )
-        q_out[:] = qn
-        r_out[:] = rn
-        b_out[:] = bel
-
-    q_new, r_new, beliefs = pl.pallas_call(
-        kern,
-        out_shape=(
-            jax.ShapeDtypeStruct((D, N), jnp.float32),
-            jax.ShapeDtypeStruct((D, N), jnp.float32),
-            jax.ShapeDtypeStruct((D, Vp), jnp.float32),
-        ),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 11,
-        out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 3),
-        interpret=interpret,
-    )(q, r, pg.cost_rows, pg.unary_p, pg.vmask, pg.inv_dcount,
-      *_plan_consts(pg.plan))
-    values = packed_values(pg, beliefs)
-    return q_new, r_new, beliefs, values
+    return packed_cycles(pg, q, r, 1, damping=damping, interpret=interpret)
 
 
 def packed_cycles(
