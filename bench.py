@@ -73,25 +73,47 @@ def python_reference_cycle_time(tensors, sample: int = 200) -> float:
 
 
 def python_reference_dpop_time(D: int, n_nodes: int, n_children: int = 1,
-                               sample: int = 200) -> float:
-    """Seconds for a python-loop UTIL join+project over n_nodes tree
-    nodes (reference-equivalent math: relations.py:1622-1706 enumerates
-    every assignment of the joined dims)."""
+                               sample: int = 100) -> float:
+    """Seconds for the reference-equivalent UTIL join+project over
+    n_nodes tree nodes.
+
+    Mirrors the reference's control flow (relations.py:1622-1706): join
+    enumerates EVERY assignment of the joined dims as a dict, reads both
+    operands via per-assignment keyword calls, and writes element-wise;
+    projection then optimizes one variable out per remaining assignment.
+    (The actual reference cannot run here — its join uses
+    ndarray.itemset, removed in NumPy 2.0 — so this faithful
+    re-implementation of its per-assignment loop is the stand-in; see
+    BENCHREF.md for measured end-to-end reference baselines.)
+    """
+    import itertools as it
+
     rng = np.random.default_rng(0)
-    cost = rng.uniform(0, 10, (D, D))
-    unary = rng.uniform(0, 1, D)
-    child_msgs = [rng.uniform(0, 10, D) for _ in range(n_children)]
+    cost = {(o, p): float(v) for (o, p), v in np.ndenumerate(
+        rng.uniform(0, 10, (D, D)))}
+    child_msgs = [
+        {o: float(v) for o, v in enumerate(rng.uniform(0, 10, D))}
+        for _ in range(n_children)
+    ]
     t0 = time.perf_counter()
     for _ in range(sample):
-        table = [[0.0] * D for _ in range(D)]
-        for own in range(D):
-            for par in range(D):
-                v = unary[own] + cost[own][par]
-                for m in child_msgs:
-                    v += m[own]
-                table[own][par] = v
-        msg = [min(table[own][par] for own in range(D)) for par in range(D)]
-        del msg
+        # join: full cross product of the union dims, dict-keyed reads
+        joined = {}
+        for asst in it.product(range(D), range(D)):
+            assignment = {"own": asst[0], "par": asst[1]}
+            v = cost[(assignment["own"], assignment["par"])]
+            for m in child_msgs:
+                v += m[assignment["own"]]
+            joined[asst] = v
+        # projection: min over own per remaining assignment
+        msg = {}
+        for par in range(D):
+            best = float("inf")
+            for own in range(D):
+                val = joined[(own, par)]
+                if val < best:
+                    best = val
+            msg[par] = best
     per_node = (time.perf_counter() - t0) / sample
     return per_node * n_nodes
 
@@ -454,8 +476,7 @@ def main():
     ap.add_argument("--watchdog", type=float, default=900.0)
     args = ap.parse_args()
     if args.cycles is None:
-        args.cycles = 50 if (args.stretch or
-                             args.only == "sharded-inner") else 2000
+        args.cycles = 50 if args.stretch else 2000
 
     if args.only == "sharded-inner":
         bench_sharded_inner(args)

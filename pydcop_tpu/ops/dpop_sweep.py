@@ -342,10 +342,15 @@ def make_throughput_fn(plan: DpopSweepPlan, reps: int):
 
     @jax.jit
     def run_reps(local, align_idx, parent_slot, sep_ids, node_ids):
-        def body(_, eps_r):
+        def body(assign_prev, eps_r):
+            # the previous assignment REALLY feeds the next rep's input
+            # (a tiny uniform offset — cannot flip any min/argmin, but
+            # is not constant-foldable the way `+ 0 * x` is), so no
+            # loop-peeling pass may legally elide repetitions
+            carry_dep = assign_prev[0].astype(jnp.float32) * 1e-12
             assign = _sweep_math(
-                plan, local + eps_r, align_idx, parent_slot, sep_ids,
-                node_ids,
+                plan, local + eps_r + carry_dep, align_idx, parent_slot,
+                sep_ids, node_ids,
             )
             return assign, None
 

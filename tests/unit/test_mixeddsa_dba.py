@@ -1,0 +1,180 @@
+"""Semantics of MixedDSA (hard/soft move probabilities, reference
+pydcop/algorithms/mixeddsa.py:119-154) and DBA (breakout weights,
+pydcop/algorithms/dba.py).
+"""
+import jax.numpy as jnp
+import jax.random
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms import AlgorithmDef
+from pydcop_tpu.algorithms.dba import DbaSolver
+from pydcop_tpu.algorithms.dba import algo_params as dba_params
+from pydcop_tpu.algorithms.mixeddsa import MixedDsaSolver
+from pydcop_tpu.algorithms.mixeddsa import algo_params as mix_params
+from pydcop_tpu.dcop import load_dcop
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.ops.compile import PAD_COST, compile_constraint_graph
+from pydcop_tpu.runtime import solve_result
+
+import textwrap
+
+MIXED_YAML = textwrap.dedent("""
+    name: mixed
+    objective: min
+    domains:
+      d: {values: [0, 1, 2]}
+    variables:
+      a: {domain: d}
+      b: {domain: d}
+      c: {domain: d}
+    constraints:
+      hard_ab:
+        type: intention
+        function: "10000 if a == b else 0"
+      soft_bc:
+        type: intention
+        function: "abs(b - c)"
+    agents: [a1, a2, a3, a4, a5]
+""")
+
+
+def mixed_solver(**params):
+    dcop = load_dcop(MIXED_YAML)
+    algo = AlgorithmDef.build_with_default_params(
+        "mixeddsa", params, parameters_definitions=mix_params
+    )
+    return dcop, MixedDsaSolver(
+        dcop, compile_constraint_graph(dcop), algo
+    )
+
+
+class TestMixedDsa:
+    def test_solves_mixed_problem(self):
+        dcop = load_dcop(MIXED_YAML)
+        res = solve_result(dcop, "mixeddsa", cycles=60, seed=1)
+        assert res.status == "FINISHED"
+        assert res.violation == 0  # the hard constraint is satisfied
+        assert res.assignment["a"] != res.assignment["b"]
+
+    def test_hard_conflict_uses_proba_hard(self):
+        """proba_hard=1, proba_soft=0: variables in hard conflict always
+        move (when improving), others never do."""
+        dcop, solver = mixed_solver(proba_hard=1.0, proba_soft=0.0)
+        # a == b -> hard conflict for a and b; c only has soft costs
+        x0 = jnp.asarray([1, 1, 0], dtype=jnp.int32)
+        moved_hard, moved_soft = 0, 0
+        for k in range(25):
+            (x1,) = solver.cycle((x0,), jax.random.PRNGKey(k))
+            x1 = np.asarray(x1)
+            if x1[0] != 1 or x1[1] != 1:
+                moved_hard += 1
+            if x1[2] != 0:
+                moved_soft += 1
+        assert moved_hard == 25  # always resolves the hard conflict
+        assert moved_soft == 0  # soft-only variable frozen at proba 0
+
+    def test_proba_soft_controls_soft_moves(self):
+        dcop, solver = mixed_solver(proba_hard=0.0, proba_soft=1.0)
+        # no hard conflict: a=0, b=1; c=0 has soft gain (b=1 -> c=1)
+        x0 = jnp.asarray([0, 1, 0], dtype=jnp.int32)
+        (x1,) = solver.cycle((x0,), jax.random.PRNGKey(3))
+        assert np.asarray(x1)[2] == 1  # c follows b
+
+    def test_variants_accepted(self):
+        dcop = load_dcop(MIXED_YAML)
+        for variant in ("A", "B", "C"):
+            res = solve_result(
+                dcop, "mixeddsa", cycles=40,
+                algo_params={"variant": variant}, seed=2,
+            )
+            assert res.status == "FINISHED"
+
+
+def dba_solver(m, **params):
+    dcop = DCOP("dba", objective="min")
+    d = Domain("d", "vals", [0, 1])
+    a, b = Variable("a", d), Variable("b", d)
+    dcop.add_variable(a)
+    dcop.add_variable(b)
+    dcop.add_constraint(
+        NAryMatrixRelation([a, b], np.asarray(m, dtype=float), name="c")
+    )
+    dcop.add_agents([AgentDef("ag")])
+    algo = AlgorithmDef.build_with_default_params(
+        "dba", params, parameters_definitions=dba_params
+    )
+    return DbaSolver(dcop, compile_constraint_graph(dcop), algo)
+
+
+class TestDba:
+    def test_weights_grow_only_when_stuck_and_violated(self):
+        # (0,0) is a strict local min with nonzero cost -> breakout bumps
+        solver = dba_solver([[1.0, 2.0], [2.0, 3.0]])
+        state = solver.initial_state()
+        x = jnp.asarray([0, 0], dtype=jnp.int32)
+        state = (x,) + tuple(state[1:])
+        state2 = solver.cycle(state, jax.random.PRNGKey(0))
+        w_after = [np.asarray(w) for w in state2[1]]
+        assert sum(float(w.sum()) for w in w_after) > sum(
+            float(np.asarray(w).sum()) for w in solver.initial_state()[1]
+        )
+
+    def test_breakout_reweighting_escapes_tie(self):
+        """The canonical breakout move: b is torn between violating c1
+        (at b=0) or c2 (at b=1) with equal weights — a tie, so it is
+        stuck; the violated constraint's weight grows until the balance
+        tips and b moves."""
+        dcop = DCOP("tie", objective="min")
+        d1 = Domain("d1", "v", [0])
+        d2 = Domain("d2", "v", [0, 1])
+        a, b, c = Variable("a", d1), Variable("b", d2), Variable("c", d1)
+        for v in (a, b, c):
+            dcop.add_variable(v)
+        dcop.add_constraint(NAryMatrixRelation(
+            [a, b], np.array([[1.0, 0.0]]), name="c1"))  # violated iff b=0
+        dcop.add_constraint(NAryMatrixRelation(
+            [b, c], np.array([[0.0], [1.0]]), name="c2"))  # viol. iff b=1
+        dcop.add_agents([AgentDef("ag")])
+        algo = AlgorithmDef.build_with_default_params(
+            "dba", {}, parameters_definitions=dba_params
+        )
+        solver = DbaSolver(dcop, compile_constraint_graph(dcop), algo)
+        state = solver.initial_state()
+        state = (jnp.asarray([0, 0, 0], dtype=jnp.int32),) + \
+            tuple(state[1:])
+        key = jax.random.PRNGKey(5)
+        bs = []
+        for _ in range(4):
+            key, sub = jax.random.split(key)
+            state = solver.cycle(state, sub)
+            bs.append(int(np.asarray(state[0])[1]))
+        # cycle 1: tie -> stuck, c1's weight bumps; cycle 2: b moves
+        assert bs[0] == 0 and 1 in bs, bs
+        w = np.asarray(state[1])
+        assert w.max() > 1.0  # a weight actually grew
+
+    def test_csp_solved(self):
+        # classic CSP use: 3-coloring a triangle (dba is a CSP algorithm)
+        yaml_str = textwrap.dedent("""
+            name: tri
+            objective: min
+            domains:
+              colors: {values: [R, G, B]}
+            variables:
+              v1: {domain: colors}
+              v2: {domain: colors}
+              v3: {domain: colors}
+            constraints:
+              c12: {type: intention, function: "10000 if v1 == v2 else 0"}
+              c13: {type: intention, function: "10000 if v1 == v3 else 0"}
+              c23: {type: intention, function: "10000 if v2 == v3 else 0"}
+            agents: [a1, a2, a3, a4, a5, a6]
+        """)
+        dcop = load_dcop(yaml_str)
+        res = solve_result(dcop, "dba", cycles=50, seed=3)
+        assert res.violation == 0
+        vals = set(res.assignment.values())
+        assert len(vals) == 3  # proper coloring
