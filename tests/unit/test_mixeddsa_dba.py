@@ -5,7 +5,6 @@ pydcop/algorithms/dba.py).
 import jax.numpy as jnp
 import jax.random
 import numpy as np
-import pytest
 
 from pydcop_tpu.algorithms import AlgorithmDef
 from pydcop_tpu.algorithms.dba import DbaSolver
@@ -16,7 +15,7 @@ from pydcop_tpu.dcop import load_dcop
 from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
 from pydcop_tpu.dcop.relations import NAryMatrixRelation
-from pydcop_tpu.ops.compile import PAD_COST, compile_constraint_graph
+from pydcop_tpu.ops.compile import compile_constraint_graph
 from pydcop_tpu.runtime import solve_result
 
 import textwrap
@@ -46,9 +45,7 @@ def mixed_solver(**params):
     algo = AlgorithmDef.build_with_default_params(
         "mixeddsa", params, parameters_definitions=mix_params
     )
-    return dcop, MixedDsaSolver(
-        dcop, compile_constraint_graph(dcop), algo
-    )
+    return MixedDsaSolver(dcop, compile_constraint_graph(dcop), algo)
 
 
 class TestMixedDsa:
@@ -62,7 +59,7 @@ class TestMixedDsa:
     def test_hard_conflict_uses_proba_hard(self):
         """proba_hard=1, proba_soft=0: variables in hard conflict always
         move (when improving), others never do."""
-        dcop, solver = mixed_solver(proba_hard=1.0, proba_soft=0.0)
+        solver = mixed_solver(proba_hard=1.0, proba_soft=0.0)
         # a == b -> hard conflict for a and b; c only has soft costs
         x0 = jnp.asarray([1, 1, 0], dtype=jnp.int32)
         moved_hard, moved_soft = 0, 0
@@ -77,7 +74,7 @@ class TestMixedDsa:
         assert moved_soft == 0  # soft-only variable frozen at proba 0
 
     def test_proba_soft_controls_soft_moves(self):
-        dcop, solver = mixed_solver(proba_hard=0.0, proba_soft=1.0)
+        solver = mixed_solver(proba_hard=0.0, proba_soft=1.0)
         # no hard conflict: a=0, b=1; c=0 has soft gain (b=1 -> c=1)
         x0 = jnp.asarray([0, 1, 0], dtype=jnp.int32)
         (x1,) = solver.cycle((x0,), jax.random.PRNGKey(3))
