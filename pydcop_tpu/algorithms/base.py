@@ -81,7 +81,7 @@ class SynchronousTensorSolver:
         self.params = algo_def.params
         self.seed = seed
         self.infinity = DEFAULT_INFINITY
-        self._compiled_chunks: Dict[int, Any] = {}
+        self._compiled_chunks: Dict[Any, Any] = {}
 
     # -- to implement -------------------------------------------------------
 
@@ -97,11 +97,20 @@ class SynchronousTensorSolver:
 
     # -- harness ------------------------------------------------------------
 
-    def _chunk_runner(self, n: int):
-        if n not in self._compiled_chunks:
+    def _chunk_runner(self, n: int, collect: bool = True):
+        """Jitted n-cycle runner.  With ``collect=False`` the per-cycle
+        values/total_cost collection is skipped — for fixed-cycle runs
+        with no metric collection only the final state is read, saving
+        one full cost-table evaluation per cycle.  Returns
+        (state, (vals, costs)) when collecting, (state, None) otherwise.
+        """
+        cache_key = (n, collect)
+        if cache_key not in self._compiled_chunks:
 
             def body(st, k):
                 st2 = self.cycle(st, k)
+                if not collect:
+                    return st2, None
                 vals = self.values_of(st2)
                 return st2, (vals, total_cost(self.tensors, vals))
 
@@ -109,8 +118,8 @@ class SynchronousTensorSolver:
             def run_chunk(state, keys):
                 return jax.lax.scan(body, state, keys)
 
-            self._compiled_chunks[n] = run_chunk
-        return self._compiled_chunks[n]
+            self._compiled_chunks[cache_key] = run_chunk
+        return self._compiled_chunks[cache_key]
 
     def run(
         self,
@@ -147,13 +156,23 @@ class SynchronousTensorSolver:
         stable = 0
         status = "FINISHED"
 
+        # fixed-cycle runs without metric collection only read the final
+        # state: skip the per-cycle values/cost collection entirely
+        collect = target is None or collect_cycles
+
         while done < limit:
             n = min(chunk, limit - done)
             key, sub = jax.random.split(key)
             keys = jax.random.split(sub, n)
-            runner = self._chunk_runner(n)
-            state, (vals, costs) = runner(state, keys)
+            runner = self._chunk_runner(n, collect=collect)
+            state, collected = runner(state, keys)
             done += n
+            if not collect:
+                if timeout is not None and perf_counter() - t0 > timeout:
+                    status = "TIMEOUT"
+                    break
+                continue
+            vals, costs = collected
             if collect_cycles:
                 vals_np = np.asarray(vals)
                 costs_np = np.asarray(costs) * self.tensors.sign
