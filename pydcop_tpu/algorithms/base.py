@@ -144,37 +144,33 @@ class SynchronousTensorSolver:
         target = cycles if cycles else None
         limit = target if target is not None else max_cycles
 
-        state = (
-            self._last_state
-            if resume and getattr(self, "_last_state", None) is not None
-            else self.initial_state()
+        warm = resume and getattr(self, "_last_state", None) is not None
+        state = self._last_state if warm else self.initial_state()
+        # a warm restart continues the PRNG stream — re-seeding would
+        # replay the previous run's random choices for stochastic moves
+        key = (
+            self._last_key
+            if warm and getattr(self, "_last_key", None) is not None
+            else jax.random.PRNGKey(self.seed)
         )
-        key = jax.random.PRNGKey(self.seed)
         done = 0
         history: List[Dict[str, Any]] = []
         prev_vals: Optional[np.ndarray] = None
         stable = 0
         status = "FINISHED"
 
-        # fixed-cycle runs without metric collection only read the final
-        # state: skip the per-cycle values/cost collection entirely
-        collect = target is None or collect_cycles
-
         while done < limit:
             n = min(chunk, limit - done)
             key, sub = jax.random.split(key)
             keys = jax.random.split(sub, n)
-            runner = self._chunk_runner(n, collect=collect)
+            # per-cycle values/cost are only materialized when a metrics
+            # history is requested; the convergence check below reads
+            # the chunk-final state directly
+            runner = self._chunk_runner(n, collect=collect_cycles)
             state, collected = runner(state, keys)
             done += n
-            if not collect:
-                if timeout is not None and perf_counter() - t0 > timeout:
-                    status = "TIMEOUT"
-                    break
-                continue
-            vals, costs = collected
             if collect_cycles:
-                vals_np = np.asarray(vals)
+                vals, costs = collected
                 costs_np = np.asarray(costs) * self.tensors.sign
                 for i in range(n):
                     history.append(
@@ -198,6 +194,7 @@ class SynchronousTensorSolver:
                 break
 
         self._last_state = state
+        self._last_key = key
         final_vals = np.asarray(self.values_of(state))
         assignment = self.tensors.assignment_from_indices(final_vals)
         violation, cost = self.dcop.solution_cost(assignment, self.infinity)

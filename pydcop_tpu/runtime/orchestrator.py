@@ -78,6 +78,8 @@ class VirtualOrchestrator:
         self.collector = collector
         self.run_metrics_log: List[Dict] = []
         self.events_log: List[Dict] = []
+        self._resume_next = False
+        self._pre_pause_status = "INITIAL"
         self._last_result: Optional[SolveResult] = None
         self._cycles_done = 0
         self.start_time: Optional[float] = None
@@ -97,6 +99,32 @@ class VirtualOrchestrator:
         for a in self.distribution.agents:
             for c in self.distribution.computations_hosted(a):
                 event_bus.send(f"agents.add_computation.{a}", c)
+
+    def pause_computations(self) -> None:
+        """Reference parity (PauseMessage broadcast, orchestrator.py
+        :1127): between phases every computation is naturally paused —
+        device state is retained and nothing advances until the next
+        run; this marks the status and blocks further phases until
+        :meth:`resume_computations`."""
+        if self.status == "INITIAL":
+            raise RuntimeError(
+                "nothing to pause: deploy_computations() first"
+            )
+        self._pre_pause_status = self.status
+        self.status = "PAUSED"
+
+    def resume_computations(self) -> None:
+        """Reference parity (ResumeMessage broadcast): continue from the
+        retained solver state — the next run() warm-restarts from
+        exactly where pause left off."""
+        if self.status == "PAUSED":
+            self.status = self._pre_pause_status
+            self._resume_next = True
+
+    def stop_agents(self, timeout: Optional[float] = None) -> None:
+        """Reference parity (StopMessage broadcast, orchestrator.py
+        :290): no agent threads exist to join; marks the run stopped."""
+        self.status = "STOPPED"
 
     def start_replication(self, k: int) -> ReplicaDistribution:
         """Place k replicas of every computation (reference:
@@ -144,17 +172,25 @@ class VirtualOrchestrator:
     ) -> SolveResult:
         """Run to completion; with a scenario, interleave solving phases
         with the event stream (reference: orchestrator.py:245,336)."""
+        if self.status == "PAUSED":
+            raise RuntimeError(
+                "orchestrator is paused; call resume_computations() first"
+            )
+        if self.status == "STOPPED":
+            raise RuntimeError(
+                "orchestrator was stopped; create a new one to run again"
+            )
         self.start_time = perf_counter()
         if self.status == "INITIAL":
             self.deploy_computations()
         self.status = "RUNNING"
+        resume = getattr(self, "_resume_next", False)
+        self._resume_next = False
 
         if scenario is None or not len(scenario):
-            res = self._run_phase(cycles, timeout, resume=False)
+            res = self._run_phase(cycles, timeout, resume=resume)
             self.status = res.status
             return self._finalize(res)
-
-        resume = False
         res: Optional[SolveResult] = None
         phase_cycles = cycles or 20
         for event in scenario:

@@ -1,6 +1,7 @@
 """Unit tests for runtime: orchestrator, replication, repair, checkpoint,
 events."""
 import os
+import numpy as np
 
 import pytest
 
@@ -196,3 +197,71 @@ class TestCheckpoint:
         solver_other = build_solver(other)
         with pytest.raises(ValueError):
             load_checkpoint(path, solver_other)
+
+
+class TestPauseResume:
+    """Reference mgmt verbs pause/resume/stop (orchestrator.py:1127-1159)
+    mapped onto the phase-based runtime: pause blocks further phases,
+    resume allows a warm restart from retained device state."""
+
+    def test_pause_blocks_run(self, tuto):
+        orch = VirtualOrchestrator(tuto, "maxsum", distribution="adhoc")
+        orch.deploy_computations()
+        orch.pause_computations()
+        assert orch.status == "PAUSED"
+        with pytest.raises(RuntimeError, match="paused"):
+            orch.run(cycles=5)
+
+    def test_pause_before_deploy_rejected(self, tuto):
+        orch = VirtualOrchestrator(tuto, "maxsum", distribution="adhoc")
+        with pytest.raises(RuntimeError, match="deploy"):
+            orch.pause_computations()
+
+    def test_run_after_stop_rejected(self, tuto):
+        orch = VirtualOrchestrator(tuto, "maxsum", distribution="adhoc")
+        orch.deploy_computations()
+        orch.run(cycles=3)
+        orch.stop_agents(2)
+        with pytest.raises(RuntimeError, match="stopped"):
+            orch.run(cycles=3)
+
+    def test_resume_continues_prng_stream(self, tuto):
+        """A warm restart must continue the PRNG stream, not replay it:
+        dsa's activation coins in cycles 4-6 must differ from 1-3."""
+        from pydcop_tpu.runtime import solve_result
+
+        orch = VirtualOrchestrator(tuto, "dsa", distribution="adhoc")
+        orch.deploy_computations()
+        orch.run(cycles=3)
+        solver = orch.solver
+        key_after_first = np.asarray(solver._last_key)
+        orch.pause_computations()
+        orch.resume_computations()
+        orch.run(cycles=3)
+        key_after_second = np.asarray(solver._last_key)
+        assert not np.array_equal(key_after_first, key_after_second)
+
+    def test_resume_continues_from_state(self, tuto):
+        # mgm is monotone and deterministically seeded: a COLD restart
+        # replays the same 3-cycle trajectory, a WARM restart continues
+        orch = VirtualOrchestrator(tuto, "mgm", distribution="adhoc")
+        orch.deploy_computations()
+        res1 = orch.run(cycles=3)
+        orch.pause_computations()
+        orch.resume_computations()
+        assert orch.status != "PAUSED"  # restored to its pre-pause state
+        res2 = orch.run(cycles=3)
+        # warm restart: the combined 6 cycles match one straight 6-cycle
+        # run, not a replay of the first 3
+        straight = VirtualOrchestrator(tuto, "mgm", distribution="adhoc")
+        straight.deploy_computations()
+        res6 = straight.run(cycles=6)
+        assert res2.cost == pytest.approx(res6.cost)
+        assert res2.cost <= res1.cost
+
+    def test_stop_agents_marks_stopped(self, tuto):
+        orch = VirtualOrchestrator(tuto, "maxsum", distribution="adhoc")
+        orch.deploy_computations()
+        orch.run(cycles=5)
+        orch.stop_agents(2)
+        assert orch.status == "STOPPED"
