@@ -102,7 +102,7 @@ class SynchronousTensorSolver:
         values/total_cost collection is skipped — for fixed-cycle runs
         with no metric collection only the final state is read, saving
         one full cost-table evaluation per cycle.  Returns
-        (state, (vals, costs)) when collecting, (state, None) otherwise.
+        (state, costs [n]) when collecting, (state, None) otherwise.
         """
         cache_key = (n, collect)
         if cache_key not in self._compiled_chunks:
@@ -112,7 +112,10 @@ class SynchronousTensorSolver:
                 if not collect:
                     return st2, None
                 vals = self.values_of(st2)
-                return st2, (vals, total_cost(self.tensors, vals))
+                # only the cost is consumed host-side (metrics history);
+                # returning per-cycle values too would ship [n, V] ints
+                # nobody reads
+                return st2, total_cost(self.tensors, vals)
 
             @jax.jit
             def run_chunk(state, keys):
@@ -144,6 +147,12 @@ class SynchronousTensorSolver:
         target = cycles if cycles else None
         limit = target if target is not None else max_cycles
 
+        if target is not None and not collect_cycles:
+            # fixed-cycle, no-metrics runs only check the timeout between
+            # chunks: larger chunks amortize per-dispatch cost (~70ms on
+            # a tunneled device) at the price of coarser timeout checks
+            chunk = min(limit, max(chunk, 100))
+
         warm = resume and getattr(self, "_last_state", None) is not None
         state = self._last_state if warm else self.initial_state()
         # a warm restart continues the PRNG stream — re-seeding would
@@ -170,8 +179,7 @@ class SynchronousTensorSolver:
             state, collected = runner(state, keys)
             done += n
             if collect_cycles:
-                vals, costs = collected
-                costs_np = np.asarray(costs) * self.tensors.sign
+                costs_np = np.asarray(collected) * self.tensors.sign
                 for i in range(n):
                     history.append(
                         {

@@ -29,6 +29,11 @@ def save_checkpoint(path: str, solver, extra: Optional[Dict] = None) -> None:
         "extra": extra or {},
     }
     arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
+    # the PRNG key travels with the state: a warm run after restore must
+    # CONTINUE the random stream, not replay it from the seed
+    key = getattr(solver, "_last_key", None)
+    if key is not None:
+        arrays["__prng_key__"] = np.asarray(key)
     np.savez(path, __meta__=json.dumps(meta), **arrays)
 
 
@@ -41,6 +46,7 @@ def load_checkpoint(path: str, solver) -> Dict[str, Any]:
     with np.load(path, allow_pickle=False) as data:
         meta = json.loads(str(data["__meta__"]))
         leaves = [data[f"leaf_{i}"] for i in range(meta["n_leaves"])]
+        key = data["__prng_key__"] if "__prng_key__" in data else None
     ref_state = solver.initial_state()
     ref_leaves, treedef = jax.tree.flatten(ref_state)
     if len(ref_leaves) != len(leaves):
@@ -55,4 +61,8 @@ def load_checkpoint(path: str, solver) -> Dict[str, Any]:
                 f"{np.shape(want)} — different problem?"
             )
     solver._last_state = jax.tree.unflatten(treedef, leaves)
+    if key is not None:
+        import jax.numpy as jnp
+
+        solver._last_key = jnp.asarray(key)
     return meta

@@ -110,6 +110,10 @@ class VirtualOrchestrator:
             raise RuntimeError(
                 "nothing to pause: deploy_computations() first"
             )
+        if self.status == "STOPPED":
+            raise RuntimeError("orchestrator was stopped; cannot pause")
+        if self.status == "PAUSED":
+            return  # idempotent: keep the original pre-pause status
         self._pre_pause_status = self.status
         self.status = "PAUSED"
 

@@ -265,3 +265,46 @@ class TestPauseResume:
         orch.run(cycles=5)
         orch.stop_agents(2)
         assert orch.status == "STOPPED"
+
+
+class TestLifecycleEdgeCases:
+    def test_double_pause_is_idempotent(self, tuto):
+        orch = VirtualOrchestrator(tuto, "maxsum", distribution="adhoc")
+        orch.deploy_computations()
+        orch.pause_computations()
+        orch.pause_computations()  # must not trap the pre-pause status
+        orch.resume_computations()
+        assert orch.status != "PAUSED"
+        res = orch.run(cycles=3)
+        assert res.status == "FINISHED"
+
+    def test_pause_after_stop_rejected(self, tuto):
+        orch = VirtualOrchestrator(tuto, "maxsum", distribution="adhoc")
+        orch.deploy_computations()
+        orch.run(cycles=3)
+        orch.stop_agents(2)
+        with pytest.raises(RuntimeError, match="stopped"):
+            orch.pause_computations()
+
+    def test_checkpoint_persists_prng_key(self, tuto, tmp_path):
+        """A restored stochastic solver must CONTINUE the PRNG stream."""
+        from pydcop_tpu.algorithms.dsa import build_solver
+        from pydcop_tpu.runtime.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        s1 = build_solver(tuto)
+        s1.run(cycles=5)
+        path = str(tmp_path / "ck.npz")
+        save_checkpoint(path, s1)
+        s2 = build_solver(tuto)
+        load_checkpoint(path, s2)
+        assert np.array_equal(
+            np.asarray(s2._last_key), np.asarray(s1._last_key)
+        )
+        # and the continued run differs from a replayed-seed run
+        s2.run(cycles=5, resume=True)
+        assert not np.array_equal(
+            np.asarray(s2._last_key), np.asarray(s1._last_key)
+        )
