@@ -429,7 +429,19 @@ def constraint_from_str(
     name: str, expression: str, all_variables: Iterable[Variable]
 ) -> Constraint:
     """Build a constraint from a python expression string, binding the
-    expression's free names to the given variables (relations.py:1275)."""
+    expression's free names to the given variables (relations.py:1275).
+
+    >>> from pydcop_tpu.dcop.objects import Domain, Variable
+    >>> d = Domain('d', 'vals', [0, 1, 2])
+    >>> x, y = Variable('x', d), Variable('y', d)
+    >>> c = constraint_from_str('c', '10 if x == y else abs(x - y)', [x, y])
+    >>> c(x=1, y=1)
+    10
+    >>> c(x=0, y=2)
+    2
+    >>> sorted(c.scope_names)
+    ['x', 'y']
+    """
     f = ExpressionFunction(expression)
     var_map = {v.name: v for v in all_variables}
     scope = []
@@ -478,7 +490,16 @@ def assignment_cost(
     variables: Iterable[Variable] = (),
 ) -> float:
     """Total cost of an assignment over the given constraints
-    (relations.py:1460)."""
+    (relations.py:1460).
+
+    >>> from pydcop_tpu.dcop.objects import Domain, Variable
+    >>> d = Domain('d', 'vals', [0, 1])
+    >>> x, y = Variable('x', d), Variable('y', d)
+    >>> c1 = constraint_from_str('c1', 'x + y', [x, y])
+    >>> c2 = constraint_from_str('c2', '5 * x', [x])
+    >>> assignment_cost({'x': 1, 'y': 0}, [c1, c2])
+    6.0
+    """
     cost = 0.0
     for c in constraints:
         cost += c.get_value_for_assignment(
@@ -520,7 +541,15 @@ def find_arg_optimal(
     variable: Variable, relation: Constraint, mode: str = "min"
 ) -> Tuple[List[Any], float]:
     """All optimal values of `variable` for a unary relation over it
-    (relations.py:1535).  Returns (list_of_values, optimal_cost)."""
+    (relations.py:1535).  Returns (list_of_values, optimal_cost).
+
+    >>> from pydcop_tpu.dcop.objects import Domain, Variable
+    >>> d = Domain('d', 'vals', ['a', 'b', 'c'])
+    >>> v = Variable('v', d)
+    >>> r = constraint_from_str('r', "{'a': 3, 'b': 1, 'c': 1}[v]", [v])
+    >>> find_arg_optimal(v, r, mode='min')
+    (['b', 'c'], 1.0)
+    """
     if relation.arity != 1 or relation.dimensions[0].name != variable.name:
         raise ValueError(
             f"find_arg_optimal needs a unary relation on {variable.name}, "

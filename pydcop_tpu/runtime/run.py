@@ -194,7 +194,24 @@ def solve(
     seed: int = 0,
 ) -> Dict[str, Any]:
     """Solve a DCOP and return the assignment (reference-parity signature:
-    infrastructure/run.py:52 returns ``metrics['assignment']``)."""
+    infrastructure/run.py:52 returns ``metrics['assignment']``).
+
+    >>> from pydcop_tpu.dcop import load_dcop
+    >>> dcop = load_dcop('''
+    ... name: mini
+    ... objective: min
+    ... domains: {d: {values: [0, 1]}}
+    ... variables:
+    ...   x: {domain: d}
+    ...   y: {domain: d}
+    ... constraints:
+    ...   c: {type: intention, function: "10 if x == y else 0"}
+    ... agents: [a1, a2, a3]
+    ... ''')
+    >>> a = solve(dcop, 'dpop')
+    >>> a['x'] != a['y']
+    True
+    """
     return solve_result(
         dcop, algo, distribution, graph, timeout, cycles, algo_params, seed
     ).assignment
