@@ -85,29 +85,51 @@ class DpopSolver:
 
     def run(self, cycles=None, timeout=None, collect_cycles=False,
             **_kwargs) -> SolveResult:
-        # batched level-synchronous sweep engine first (one lax.scan per
-        # phase over the whole tree); falls back to the per-node hybrid
-        # path when the padded formulation would blow up
+        # three engine tiers: (1) global batched sweep — one lax.scan
+        # per phase, everything padded to the tree-wide max separator
+        # width; (2) per-level sweep — each level padded to ITS OWN
+        # width, one jitted batched step per level (survives a single
+        # wide hub); (3) per-node hybrid loop (anything else)
+        import logging
+
+        from pydcop_tpu.ops.dpop_sweep import (
+            compile_sweep,
+            compile_sweep_perlevel,
+        )
+
+        log = logging.getLogger("pydcop_tpu.dpop")
         try:
-            from pydcop_tpu.ops.dpop_sweep import compile_sweep
             plan = compile_sweep(self.tree, self.dcop, self.mode)
+            perlevel = False
+            if plan is None:
+                plan = compile_sweep_perlevel(
+                    self.tree, self.dcop, self.mode
+                )
+                perlevel = True
         except Exception:  # pragma: no cover - defensive: never take
-            import logging   # down an exact solve over an engine bug
-            logging.getLogger("pydcop_tpu.dpop").exception(
-                "batched sweep compile failed; using per-node path"
+            log.exception(  # down an exact solve over an engine bug
+                "batched sweep COMPILE failed; using per-node path"
             )
             plan = None
         if plan is not None:
-            return self._run_sweep(plan)
+            try:
+                return self._run_sweep(plan, perlevel=perlevel)
+            except Exception:  # pragma: no cover - e.g. device OOM on
+                log.exception(  # an accepted plan
+                    "batched sweep EXECUTION failed; re-solving with "
+                    "the per-node path"
+                )
         return self._run_pernode()
 
-    def _run_sweep(self, plan) -> SolveResult:
-        from pydcop_tpu.ops.dpop_sweep import run_sweep
+    def _run_sweep(self, plan, perlevel: bool = False) -> SolveResult:
+        from pydcop_tpu.ops.dpop_sweep import run_sweep, run_sweep_perlevel
 
         t0 = perf_counter()
-        self.last_engine = "sweep"
+        self.last_engine = "sweep_perlevel" if perlevel else "sweep"
         tree = self.tree
-        assign_idx, _ = run_sweep(plan)
+        assign_idx, _ = (
+            run_sweep_perlevel(plan) if perlevel else run_sweep(plan)
+        )
         assignment = {}
         for gidx, name in enumerate(plan.gid_to_name):
             v = tree.computation(name).variable

@@ -85,6 +85,105 @@ def _canonical_seps(
     return sorted(sep, key=lambda n: (depth[n], n))
 
 
+def _global_ids(levels):
+    """(gid map, gid->name list, per-level slot map) in level order."""
+    gid = {}
+    gid_to_name = []
+    for lv in levels:
+        for n in lv:
+            gid[n.name] = len(gid_to_name)
+            gid_to_name.append(n.name)
+    slot = {n.name: i for lv in levels for i, n in enumerate(lv)}
+    return gid, gid_to_name, slot
+
+
+def _true_sep_sizes(sep, by_name):
+    """Product of true (unpadded) separator domain sizes per node — the
+    UTIL message size reported in metrics (DpopMessage.size parity)."""
+    return {
+        name: int(np.prod(
+            [len(by_name[m].variable.domain) for m in s], dtype=np.int64
+        )) if s else 1
+        for name, s in sep.items()
+    }
+
+
+def _compute_separators(tree, levels):
+    """Bottom-up separator sets: sep(n) = (scope of own constraints ∪
+    children's separators) - {n}; members are ancestors of n."""
+    nodes_flat = [n for lv in levels for n in lv]
+    by_name = {n.name: n for n in nodes_flat}
+    sep: Dict[str, set] = {}
+    for lv in reversed(levels):
+        for node in lv:
+            s = set()
+            for c in node.constraints:
+                s.update(v.name for v in c.dimensions if v.name in by_name)
+            for ch in node.children:
+                s.update(sep[ch])
+            s.discard(node.name)
+            sep[node.name] = s
+    return sep, by_name
+
+
+def _digits_table(S: int, W: int, Dmax: int) -> np.ndarray:
+    """digits[s, k] of table slot s: k=0 own var, k>=1 separator axes."""
+    s_range = np.arange(S, dtype=np.int64)
+    digits = np.empty((S, W + 1), dtype=np.int64)
+    for k in range(W + 1):
+        digits[:, k] = (s_range // (Dmax ** (W - k))) % Dmax
+    return digits
+
+
+def _build_local_table(node, cseps: List[str], W: int, Dmax: int,
+                       sign: float, ext: Dict) -> np.ndarray:
+    """Flat [Dmax**(W+1)] local table: padded unary + own constraints in
+    the canonical [own, sep...] layout."""
+    v = node.variable
+    D = len(v.domain)
+    axis_of = {node.name: 0}
+    for k, sn in enumerate(cseps):
+        axis_of[sn] = k + 1
+    tbl = np.zeros((Dmax,) * (W + 1), dtype=np.float32)
+    unary = np.full(Dmax, sign * BIG, dtype=np.float32)
+    unary[:D] = np.asarray(v.cost_vector(), dtype=np.float32)
+    tbl += unary.reshape((Dmax,) + (1,) * W)
+    for c in node.constraints:
+        if any(n in ext for n in c.scope_names):
+            c = c.slice(ext)
+        c_names = [d.name for d in c.dimensions]
+        ct = np.asarray(c.to_tensor(), dtype=np.float32)
+        if any(sz < Dmax for sz in ct.shape):
+            ct = np.pad(
+                ct, [(0, Dmax - sz) for sz in ct.shape],
+                constant_values=0.0,
+            )
+        tgt = [axis_of[n] for n in c_names]
+        ct = np.transpose(ct, np.argsort(tgt))
+        shape = [1] * (W + 1)
+        for a in sorted(tgt):
+            shape[a] = Dmax
+        tbl += ct.reshape(shape)
+    return tbl.reshape(-1)
+
+
+def _child_align_index(cseps_child: List[str], parent_name: str,
+                       p_cseps: List[str], digits_parent: np.ndarray,
+                       W_child: int, Dmax: int) -> np.ndarray:
+    """For each parent-table slot, the child-message entry feeding it
+    (child message layout: canonical seps with strides
+    Dmax**(W_child-1-k))."""
+    p_axis_of = {parent_name: 0}
+    for k, sn in enumerate(p_cseps):
+        p_axis_of[sn] = k + 1
+    idx = np.zeros(digits_parent.shape[0], dtype=np.int64)
+    for k, sn in enumerate(cseps_child):
+        idx += digits_parent[:, p_axis_of[sn]] * (
+            Dmax ** (W_child - 1 - k)
+        )
+    return idx.astype(np.int32)
+
+
 def compile_sweep(tree, dcop, mode: str = "min") -> Optional[DpopSweepPlan]:
     """Compile a pseudo-tree + DCOP into a batched sweep plan.
 
@@ -99,30 +198,10 @@ def compile_sweep(tree, dcop, mode: str = "min") -> Optional[DpopSweepPlan]:
     nodes_flat = [n for lv in levels for n in lv]
     N = len(nodes_flat)
     depth = {n.name: tree.depth(n.name) for n in nodes_flat}
-    by_name = {n.name: n for n in nodes_flat}
 
     Dmax = max(len(n.variable.domain) for n in nodes_flat)
-
-    # separator sets bottom-up: sep(n) = (scope of own constraints ∪
-    # children's separators) - {n}; all members are ancestors of n.
-    sep: Dict[str, set] = {}
-    for lv in reversed(levels):
-        for node in lv:
-            s = set()
-            for c in node.constraints:
-                s.update(v.name for v in c.dimensions
-                         if v.name in by_name)
-            for ch in node.children:
-                s.update(sep[ch])
-            s.discard(node.name)
-            sep[node.name] = s
-
-    sep_size = {
-        name: int(np.prod(
-            [len(by_name[m].variable.domain) for m in s], dtype=np.int64
-        )) if s else 1
-        for name, s in sep.items()
-    }
+    sep, by_name = _compute_separators(tree, levels)
+    sep_size = _true_sep_sizes(sep, by_name)
     # W >= 1 keeps the message/stride arrays non-degenerate (W would be 0
     # only when every node is an isolated root)
     W = max(max((len(s) for s in sep.values()), default=0), 1)
@@ -134,16 +213,7 @@ def compile_sweep(tree, dcop, mode: str = "min") -> Optional[DpopSweepPlan]:
         return None
 
     # global ids in level order; gid N = padding sentinel
-    gid = {}
-    gid_to_name = []
-    for lv in levels:
-        for n in lv:
-            gid[n.name] = len(gid_to_name)
-            gid_to_name.append(n.name)
-    slot = {}  # name -> slot within its level
-    for lv in levels:
-        for i, n in enumerate(lv):
-            slot[n.name] = i
+    gid, gid_to_name, slot = _global_ids(levels)
 
     ext = {ev.name: ev.value for ev in dcop.external_variables.values()}
 
@@ -156,74 +226,28 @@ def compile_sweep(tree, dcop, mode: str = "min") -> Optional[DpopSweepPlan]:
     node_ids = np.full((L, Bmax), N + 1, dtype=np.int32)
     dom_sizes = np.zeros(N, dtype=np.int32)
 
-    # digit strides: axis k of the message layout (canonical sep order,
-    # k in [0, W)) has stride Dmax**(W-1-k); table axis 0 (own) stride Sm
-    msg_stride = np.array(
-        [Dmax ** (W - 1 - k) for k in range(W)], dtype=np.int64
-    )
-    # per-table-slot digits, computed once: digits[s, k] for k in 0..W
-    # (k=0 own var, k>=1 separator axis k-1)
-    s_range = np.arange(S, dtype=np.int64)
-    digits = np.empty((S, W + 1), dtype=np.int64)
-    for k in range(W + 1):
-        stride = Dmax ** (W - k)
-        digits[:, k] = (s_range // stride) % Dmax
-
+    # per-table-slot digits (k=0 own var, k>=1 separator axis k-1)
+    digits = _digits_table(S, W, Dmax)
     sign = 1.0 if mode == "min" else -1.0
 
     for li, lv in enumerate(levels):
         for bi, node in enumerate(lv):
             name = node.name
-            v = node.variable
-            D = len(v.domain)
             node_ids[li, bi] = gid[name]
-            dom_sizes[gid[name]] = D
+            dom_sizes[gid[name]] = len(node.variable.domain)
             cseps = _canonical_seps(sep[name], depth, W)
             for k, sn in enumerate(cseps):
                 sep_ids[li, bi, k] = gid[sn]
-            axis_of = {name: 0}
-            for k, sn in enumerate(cseps):
-                axis_of[sn] = k + 1
-
-            # ---- local table: unary + own constraints, canonical layout
-            tbl = local[li, bi].reshape((Dmax,) * (W + 1))
-            unary = np.full(Dmax, sign * BIG, dtype=np.float32)
-            unary[:D] = np.asarray(v.cost_vector(), dtype=np.float32)
-            tbl += unary.reshape((Dmax,) + (1,) * W)
-            for c in node.constraints:
-                if any(n in ext for n in c.scope_names):
-                    c = c.slice(ext)
-                c_names = [d.name for d in c.dimensions]
-                ct = np.asarray(c.to_tensor(), dtype=np.float32)
-                # pad each constraint axis to Dmax (pad entries unread:
-                # blocked by the BIG unary of the owning variable)
-                if any(sz < Dmax for sz in ct.shape):
-                    ct = np.pad(
-                        ct,
-                        [(0, Dmax - sz) for sz in ct.shape],
-                        constant_values=0.0,
-                    )
-                tgt = [axis_of[n] for n in c_names]
-                order = np.argsort(tgt)
-                ct = np.transpose(ct, order)
-                shape = [1] * (W + 1)
-                for a in sorted(tgt):
-                    shape[a] = Dmax
-                tbl += ct.reshape(shape)
-
+            local[li, bi] = _build_local_table(
+                node, cseps, W, Dmax, sign, ext
+            )
             # ---- alignment of this node's UTIL message into its parent
             if node.parent is not None:
                 parent_slot[li, bi] = slot[node.parent]
-                p_axis_of = {node.parent: 0}
                 p_cseps = _canonical_seps(sep[node.parent], depth, W)
-                for k, sn in enumerate(p_cseps):
-                    p_axis_of[sn] = k + 1
-                # message axes = this node's canonical separators; value
-                # of each comes from a digit of the parent's table slot
-                idx = np.zeros(S, dtype=np.int64)
-                for k, sn in enumerate(cseps):
-                    idx += digits[:, p_axis_of[sn]] * msg_stride[k]
-                align_idx[li, bi] = idx.astype(np.int32)
+                align_idx[li, bi] = _child_align_index(
+                    cseps, node.parent, p_cseps, digits, W, Dmax
+                )
 
     return DpopSweepPlan(
         L=L, Bmax=Bmax, Dmax=Dmax, W=W, S=S, Sm=Sm, n_nodes=N, mode=mode,
@@ -359,3 +383,191 @@ def make_throughput_fn(plan: DpopSweepPlan, reps: int):
         return assign
 
     return run_reps, _plan_args(plan)
+
+
+# ---------------------------------------------------------------------------
+# Per-level tier: each level padded to ITS OWN max separator width.
+#
+# The global-scan engine pads every table to the tree-wide max width, so a
+# single wide node (e.g. a hub with several pseudo-parents) can blow the
+# padded size for the whole tree and force the per-node fallback.  This
+# middle tier pays the width cost only at the levels that have it: levels
+# run as individually-jitted batched steps (shapes differ per level, so no
+# single scan), still one device dispatch per level instead of per node.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DpopLevelPlan:
+    """One level's static arrays (batch axis = nodes of the level)."""
+
+    B: int           # real nodes at this level
+    W: int           # this level's max separator width
+    S: int           # Dmax ** (W + 1) — table entries per node
+    local: np.ndarray        # [B, S] f32
+    align_idx: np.ndarray    # [B, S_parent] i32 (roots: [B, 1] zeros)
+    parent_slot: np.ndarray  # [B] i32 (parent's slot one level up)
+    sep_ids: np.ndarray      # [B, W] i32 (pad: n_nodes)
+    node_ids: np.ndarray     # [B] i32
+
+
+@dataclass
+class DpopPerLevelPlan:
+    levels: List[DpopLevelPlan]  # top-down (index 0 = roots)
+    Dmax: int
+    n_nodes: int
+    mode: str
+    gid_to_name: List[str]
+    sep_size: Dict[str, int]
+
+    @property
+    def total_entries(self) -> int:
+        return sum(lv.B * lv.S for lv in self.levels)
+
+
+def compile_sweep_perlevel(tree, dcop,
+                           mode: str = "min") -> Optional[DpopPerLevelPlan]:
+    """Compile with per-level width padding.  Returns None when even the
+    per-level form blows the budgets (fallback: per-node path)."""
+    levels = tree.nodes_by_depth()
+    if not levels or not levels[0]:
+        return None
+    nodes_flat = [n for lv in levels for n in lv]
+    N = len(nodes_flat)
+    depth = {n.name: tree.depth(n.name) for n in nodes_flat}
+    Dmax = max(len(n.variable.domain) for n in nodes_flat)
+    sep, by_name = _compute_separators(tree, levels)
+    sep_size = _true_sep_sizes(sep, by_name)
+
+    W_l = [
+        max(max((len(sep[n.name]) for n in lv), default=0), 1)
+        for lv in levels
+    ]
+    S_l = [Dmax ** (w + 1) for w in W_l]
+    if any(s > MAX_TABLE_ENTRIES_PER_NODE for s in S_l):
+        return None
+    # budget covers local tables AND the align_idx / aligned
+    # intermediates, which are [B_child, S_parent]-shaped — in the
+    # wide-hub case those dominate (many narrow children x a huge
+    # parent table)
+    entries = sum(len(lv) * s for lv, s in zip(levels, S_l))
+    entries += sum(
+        len(levels[li]) * S_l[li - 1] for li in range(1, len(levels))
+    )
+    if entries > MAX_PLAN_ENTRIES:
+        return None
+
+    gid, gid_to_name, slot = _global_ids(levels)
+    ext = {ev.name: ev.value for ev in dcop.external_variables.values()}
+    sign = 1.0 if mode == "min" else -1.0
+    digits_l = [_digits_table(s, w, Dmax) for s, w in zip(S_l, W_l)]
+
+    plans: List[DpopLevelPlan] = []
+    for li, lv in enumerate(levels):
+        B, W, S = len(lv), W_l[li], S_l[li]
+        S_parent = S_l[li - 1] if li > 0 else 1
+        local = np.zeros((B, S), dtype=np.float32)
+        align_idx = np.zeros((B, S_parent), dtype=np.int32)
+        parent_slot = np.full(
+            (B,), len(levels[li - 1]) if li > 0 else 0, dtype=np.int32
+        )
+        sep_ids = np.full((B, W), N, dtype=np.int32)
+        node_ids = np.empty((B,), dtype=np.int32)
+        for bi, node in enumerate(lv):
+            cseps = _canonical_seps(sep[node.name], depth, W)
+            node_ids[bi] = gid[node.name]
+            for k, sn in enumerate(cseps):
+                sep_ids[bi, k] = gid[sn]
+            local[bi] = _build_local_table(
+                node, cseps, W, Dmax, sign, ext
+            )
+            if node.parent is not None:
+                parent_slot[bi] = slot[node.parent]
+                p_cseps = _canonical_seps(
+                    sep[node.parent], depth, W_l[li - 1]
+                )
+                align_idx[bi] = _child_align_index(
+                    cseps, node.parent, p_cseps, digits_l[li - 1],
+                    W, Dmax,
+                )
+        plans.append(DpopLevelPlan(
+            B=B, W=W, S=S, local=local,
+            align_idx=align_idx, parent_slot=parent_slot,
+            sep_ids=sep_ids, node_ids=node_ids,
+        ))
+
+    return DpopPerLevelPlan(
+        levels=plans, Dmax=Dmax, n_nodes=N, mode=mode,
+        gid_to_name=gid_to_name, sep_size=sep_size,
+    )
+
+
+def run_sweep_perlevel(plan: DpopPerLevelPlan):
+    """Execute the per-level UTIL+VALUE sweeps: one jitted batched step
+    per level (jit caches by shape).  Returns (assign_idx [N], N)."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    Dmax, N, mode = plan.Dmax, plan.n_nodes, plan.mode
+    levels = plan.levels
+    L = len(levels)
+
+    @partial(jax.jit, static_argnames=("Dmax", "mode"))
+    def util_step(local, aligned_sum, *, Dmax, mode):
+        table = local + aligned_sum
+        B, S = table.shape
+        t = table.reshape(B, Dmax, S // Dmax)
+        msg = jnp.min(t, axis=1) if mode == "min" else jnp.max(t, axis=1)
+        return table, msg
+
+    @partial(jax.jit, static_argnames=("B_parent",))
+    def align_combine(msg, align_idx, parent_slot, *, B_parent):
+        aligned = jnp.take_along_axis(msg, align_idx, axis=1)
+        return jax.ops.segment_sum(
+            aligned, parent_slot, num_segments=B_parent
+        )
+
+    # ---- UTIL: deepest level -> roots
+    tables = [None] * L
+    msg = None
+    for li in range(L - 1, -1, -1):
+        lv = levels[li]
+        if li == L - 1:
+            aligned_sum = jnp.zeros((lv.B, lv.S), dtype=jnp.float32)
+        else:
+            child = levels[li + 1]
+            aligned_sum = align_combine(
+                msg, jnp.asarray(child.align_idx),
+                jnp.asarray(child.parent_slot), B_parent=lv.B,
+            )
+        tables[li], msg = util_step(
+            jnp.asarray(lv.local), aligned_sum, Dmax=Dmax, mode=mode,
+        )
+
+    # ---- VALUE: roots -> deepest level
+    @partial(jax.jit, static_argnames=("Dmax", "mode", "W"))
+    def value_step(assign, table, sep_ids, node_ids, *, Dmax, mode, W):
+        strides = jnp.asarray(
+            np.array([Dmax ** (W - 1 - k) for k in range(W)],
+                     dtype=np.int32)
+        )
+        sep_vals = assign[jnp.clip(sep_ids, 0, N)]
+        sep_pos = jnp.sum(sep_vals * strides[None, :], axis=1)
+        B, S = table.shape
+        t = table.reshape(B, Dmax, S // Dmax)
+        col = jnp.take_along_axis(
+            t, sep_pos[:, None, None], axis=2
+        )[:, :, 0]
+        best = (jnp.argmin(col, axis=1) if mode == "min"
+                else jnp.argmax(col, axis=1)).astype(jnp.int32)
+        return assign.at[node_ids].set(best, mode="promise_in_bounds")
+
+    assign = jnp.zeros((N + 1,), dtype=jnp.int32)
+    for li in range(L):
+        lv = levels[li]
+        assign = value_step(
+            assign, tables[li], jnp.asarray(lv.sep_ids),
+            jnp.asarray(lv.node_ids), Dmax=Dmax, mode=mode, W=lv.W,
+        )
+    return np.asarray(jax.device_get(assign[:N])), N

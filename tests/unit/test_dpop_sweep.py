@@ -169,3 +169,102 @@ def test_run_sweep_direct_assignment_indices():
     }
     _, cost = dcop.solution_cost(assignment, 10000000)
     assert cost == pytest.approx(brute_force_cost(dcop))
+
+
+class TestPerLevelTier:
+    """The per-level engine (each level padded to its own separator
+    width) must agree with the per-node oracle and engage exactly when
+    the global plan refuses but per-level budgets fit."""
+
+    def test_perlevel_matches_pernode(self):
+        from pydcop_tpu.ops.dpop_sweep import (
+            compile_sweep_perlevel,
+            run_sweep_perlevel,
+        )
+
+        for seed in range(4):
+            dcop = random_dcop(15, 6, seed=seed)
+            tree = pseudotree.build_computation_graph(dcop)
+            plan = compile_sweep_perlevel(tree, dcop, dcop.objective)
+            assert plan is not None
+            assign_idx, n = run_sweep_perlevel(plan)
+            assignment = {
+                name: tree.computation(name).variable.domain[
+                    int(assign_idx[g])
+                ]
+                for g, name in enumerate(plan.gid_to_name)
+            }
+            _, cost = dcop.solution_cost(assignment, 10000000)
+            ref = DpopSolver(dcop, tree)._run_pernode()
+            assert cost == pytest.approx(ref.cost), seed
+
+    def test_engages_when_global_refuses(self, monkeypatch):
+        """A single wide hub blows the global-width padding; the
+        per-level tier isolates the cost to the hub's level."""
+        import pydcop_tpu.ops.dpop_sweep as ds
+
+        # mostly width-1 chain with one dense clique near the root:
+        # depresses the global budget without making any level huge
+        rng = np.random.default_rng(6)
+        dcop = DCOP("hub", objective="min")
+        d = Domain("d", "vals", list(range(4)))
+        vs = [Variable(f"v{i:02d}", d) for i in range(24)]
+        for v in vs:
+            dcop.add_variable(v)
+        k = 0
+        # clique over v0..v3 -> separator width ~3 at the clique's level
+        for i in range(4):
+            for j in range(i + 1, 4):
+                m = rng.integers(0, 9, (4, 4)).astype(float)
+                dcop.add_constraint(
+                    NAryMatrixRelation([vs[i], vs[j]], m, name=f"q{k}")
+                )
+                k += 1
+        # long chains hanging off v3
+        for i in range(4, 24):
+            p = vs[i - 1] if i > 4 else vs[3]
+            m = rng.integers(0, 9, (4, 4)).astype(float)
+            dcop.add_constraint(
+                NAryMatrixRelation([p, vs[i]], m, name=f"c{i}")
+            )
+        dcop.add_agents([AgentDef("a0")])
+        tree = pseudotree.build_computation_graph(dcop)
+
+        global_plan = ds.compile_sweep(tree, dcop, "min")
+        assert global_plan is not None
+        # shrink the total-entry budget to just below the global plan's
+        # need: global refuses, per-level (much smaller) fits
+        perlevel_plan = ds.compile_sweep_perlevel(tree, dcop, "min")
+        assert perlevel_plan is not None
+        assert perlevel_plan.total_entries < global_plan.total_entries
+        monkeypatch.setattr(
+            ds, "MAX_PLAN_ENTRIES", global_plan.total_entries - 1
+        )
+        assert ds.compile_sweep(tree, dcop, "min") is None
+        assert ds.compile_sweep_perlevel(tree, dcop, "min") is not None
+
+        solver = DpopSolver(dcop, tree)
+        res = solver.run()
+        assert solver.last_engine == "sweep_perlevel"
+        ref = DpopSolver(dcop, tree)._run_pernode()
+        assert res.cost == pytest.approx(ref.cost)
+
+    def test_perlevel_mixed_domains_and_max_mode(self):
+        from pydcop_tpu.ops.dpop_sweep import (
+            compile_sweep_perlevel,
+            run_sweep_perlevel,
+        )
+
+        dcop = random_dcop(10, 4, seed=9, objective="max")
+        tree = pseudotree.build_computation_graph(dcop)
+        plan = compile_sweep_perlevel(tree, dcop, "max")
+        assert plan is not None
+        assign_idx, _ = run_sweep_perlevel(plan)
+        assignment = {
+            name: tree.computation(name).variable.domain[
+                int(assign_idx[g])
+            ]
+            for g, name in enumerate(plan.gid_to_name)
+        }
+        _, cost = dcop.solution_cost(assignment, 10000000)
+        assert cost == pytest.approx(brute_force_cost(dcop))
