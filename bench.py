@@ -154,6 +154,22 @@ class BenchAbort(RuntimeError):
     turns it into the contractual one-JSON-line error output."""
 
 
+def robust_best(times):
+    """Representative per-call time from repeated measurements.
+
+    The tunneled device occasionally returns from block_until_ready
+    before the work is actually done, yielding a physically impossible
+    near-zero sample (observed once: a 2000-cycle run "finishing" in
+    29us).  min() amplifies such glitches into absurd headline numbers;
+    the median is immune to a single bad sample.  Samples more than 50x
+    faster than the median are discarded as glitches before taking the
+    best of the rest."""
+    ts = sorted(times)
+    med = ts[len(ts) // 2]
+    sane = [t for t in ts if t > med / 50]
+    return min(sane) if sane else med
+
+
 def build_stretch_tensors(args):
     """The 100k-var / 300k-edge coloring instance (single source for the
     --stretch compat mode and the convergence bench — same rng(1) data)."""
@@ -229,7 +245,7 @@ def bench_maxsum(args):
         q, r = run_n(q0, r0)
         jax.block_until_ready((q, r))
         times.append(time.perf_counter() - t0)
-    iters_per_sec = (args.cycles // chunk * chunk) / min(times)
+    iters_per_sec = (args.cycles // chunk * chunk) / robust_best(times)
 
     ref_cycle_s = python_reference_cycle_time(tensors)
     vs = iters_per_sec * ref_cycle_s if ref_cycle_s > 0 else 0.0
@@ -279,7 +295,7 @@ def bench_dpop(args):
         out = fn(*dev_args)
         jax.block_until_ready(out)
         times.append(time.perf_counter() - t0)
-    tables_per_sec = reps * plan.n_nodes / min(times)
+    tables_per_sec = reps * plan.n_nodes / robust_best(times)
 
     mean_children = (N - 1) / max(1, len(set(parents)))
     ref_s = python_reference_dpop_time(D, N, n_children=round(mean_children))
@@ -549,7 +565,7 @@ def main():
             q, r = run_n(q0, r0)
             jax.block_until_ready((q, r))
             times.append(time.perf_counter() - t0)
-        val = args.cycles / min(times)
+        val = args.cycles / robust_best(times)
         ref = python_reference_cycle_time(tensors)
         if watchdog:
             watchdog.cancel()
