@@ -24,12 +24,16 @@ from pydcop_tpu.ops.maxsum_kernels import maxsum_cycle
 
 GRAPH_TYPE = "factor_graph"
 
+#: default per-edge activation probability — the single source of truth
+#: for every amaxsum entry point (solver, placement-driven run, multihost)
+DEFAULT_ACTIVATION = 0.7
+
 algo_params = [
     AlgoParameterDef("stop_cycle", "int", None, 0),
     AlgoParameterDef("damping", "float", None, 0.5),
     AlgoParameterDef("stability", "float", None, 0.1),
     AlgoParameterDef("noise", "float", None, 0.01),
-    AlgoParameterDef("activation", "float", None, 0.7),
+    AlgoParameterDef("activation", "float", None, DEFAULT_ACTIVATION),
 ]
 
 
@@ -39,7 +43,9 @@ class AMaxSumSolver(MaxSumSolver):
         # a per-edge activation mask, which the lane-packed layout does not
         # carry
         super().__init__(dcop, tensors, algo_def, seed, use_packed=False)
-        self.activation = float(self.params.get("activation", 0.7))
+        self.activation = float(
+            self.params.get("activation", DEFAULT_ACTIVATION)
+        )
 
     def cycle(self, state, key):
         q, r, values = state

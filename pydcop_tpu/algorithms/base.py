@@ -129,7 +129,7 @@ class SynchronousTensorSolver:
         cycles: Optional[int] = None,
         timeout: Optional[float] = None,
         max_cycles: int = 2000,
-        chunk: int = 8,
+        chunk: Optional[int] = None,
         stable_chunks: int = 2,
         collect_cycles: bool = False,
         resume: bool = False,
@@ -147,10 +147,21 @@ class SynchronousTensorSolver:
         target = cycles if cycles else None
         limit = target if target is not None else max_cycles
 
-        if target is not None and not collect_cycles:
-            # fixed-cycle, no-metrics runs only check the timeout between
-            # chunks: larger chunks amortize per-dispatch cost (~70ms on
-            # a tunneled device) at the price of coarser timeout checks
+        caller_chunk = chunk is not None
+        if chunk is None:
+            chunk = 8
+        if (
+            target is not None
+            and not collect_cycles
+            and not caller_chunk
+            and timeout is None
+        ):
+            # fixed-cycle, no-metrics, no-deadline runs only check
+            # convergence between chunks: larger chunks amortize
+            # per-dispatch cost (~70ms on a tunneled device).  A
+            # caller-provided chunk or a timeout keeps the finer grain —
+            # the timeout is only honored between chunks, so a raised
+            # floor could overshoot a tight deadline by ~100 cycles.
             chunk = min(limit, max(chunk, 100))
 
         warm = resume and getattr(self, "_last_state", None) is not None

@@ -66,13 +66,13 @@ def run_multihost(args):
             {"status": "ERROR", "error": "--multihost needs --dcop"},
             args.output)
         return 1
-    if args.algo != "maxsum":
-        # amaxsum's activation masks are not implemented in the sharded
-        # engine — refusing beats silently running synchronous maxsum
+    if args.algo not in ("maxsum", "amaxsum"):
         output_metrics(
             {"status": "ERROR",
-             "error": f"multihost mesh execution supports 'maxsum', "
-             f"not {args.algo!r}"}, args.output)
+             "error": f"multihost mesh execution supports the factor-"
+             f"graph BP family (maxsum/amaxsum) and the local-search "
+             f"family via 'pydcop_tpu solve', not {args.algo!r}"},
+            args.output)
         return 1
     from pydcop_tpu.parallel.multihost import (
         init_multihost,
@@ -89,8 +89,15 @@ def run_multihost(args):
     t0 = time.time()
     from pydcop_tpu.algorithms import DEFAULT_INFINITY
 
+    # amaxsum: per-edge activation masks in the sharded engine (same
+    # emulation as AMaxSumSolver, decorrelated per shard)
+    activation = None
+    if args.algo == "amaxsum":
+        from pydcop_tpu.algorithms.amaxsum import DEFAULT_ACTIVATION
+
+        activation = DEFAULT_ACTIVATION
     values, n_devices, tensors = run_multihost_maxsum(
-        dcop, cycles=args.cycles)
+        dcop, cycles=args.cycles, activation=activation)
     assignment = tensors.assignment_from_indices(values)
     violation, cost = dcop.solution_cost(assignment, DEFAULT_INFINITY)
     output_metrics({

@@ -102,6 +102,15 @@ def secp_ilp(
     free = list(comps_to_host)
     nC = len(free)
     if nC == 0:
+        # the reference ILP's 'atleastone' liveness constraints would be
+        # infeasible with an empty agent left and nothing to host — match
+        # that instead of silently returning a dead-agent distribution
+        empty = [a for a, cs in pre_mapping.items() if not cs]
+        if empty:
+            raise ImpossibleDistributionException(
+                f"no free computations but agents {empty} would stay "
+                f"empty — liveness (each agent hosts >= 1) is infeasible"
+            )
         return Distribution(pre_mapping)
     c_idx = {c: i for i, c in enumerate(free)}
     hosted_on = {

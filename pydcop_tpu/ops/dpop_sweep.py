@@ -39,8 +39,11 @@ falls back to the per-node hybrid path (ops/dpop_kernels.py).
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import Dict, List, Optional, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 BIG = 1e9  # +inf stand-in: survives (C+1)-way f32 sums without overflow
@@ -260,7 +263,6 @@ def compile_sweep(tree, dcop, mode: str = "min") -> Optional[DpopSweepPlan]:
 def run_sweep(plan: DpopSweepPlan):
     """Execute the batched UTIL+VALUE sweeps. Returns (assign_idx [N],
     tables_computed).  assign_idx maps gid -> chosen domain index."""
-    import jax
 
     fn, args = make_sweep_fn(plan)
     assign = fn(*args)
@@ -271,8 +273,6 @@ def _sweep_math(plan: DpopSweepPlan, local, align_idx, parent_slot,
                 sep_ids, node_ids):
     """Traced UTIL+VALUE math (pure; shared by make_sweep_fn and
     make_throughput_fn).  Returns assign_idx [n_nodes]."""
-    import jax
-    import jax.numpy as jnp
     from jax import lax
 
     Bmax, Dmax, W = plan.Bmax, plan.Dmax, plan.W
@@ -325,7 +325,6 @@ def _sweep_math(plan: DpopSweepPlan, local, align_idx, parent_slot,
 
 
 def _plan_args(plan: DpopSweepPlan):
-    import jax.numpy as jnp
 
     return (
         jnp.asarray(plan.local), jnp.asarray(plan.align_idx),
@@ -337,7 +336,6 @@ def _plan_args(plan: DpopSweepPlan):
 def make_sweep_fn(plan: DpopSweepPlan):
     """Return (jitted_fn, device_args) running the full UTIL+VALUE sweep
     without host round-trips."""
-    import jax
 
     @jax.jit
     def util_value(local, align_idx, parent_slot, sep_ids, node_ids):
@@ -356,8 +354,6 @@ def make_throughput_fn(plan: DpopSweepPlan, reps: int):
     scalar fed through the scan (a real data dependence — a
     value-preserving ``+ 0 * x`` trick gets constant-folded and the
     whole sweep hoisted out of the loop as loop-invariant)."""
-    import jax
-    import jax.numpy as jnp
     from jax import lax
 
     # a constant offset on every table entry shifts all costs uniformly:
@@ -502,31 +498,53 @@ def compile_sweep_perlevel(tree, dcop,
     )
 
 
+# Per-level step functions live at module level so the jit cache persists
+# across solver runs — defined inside run_sweep_perlevel they would retrace
+# every call (advisor finding, round 2).
+
+
+@partial(jax.jit, static_argnames=("Dmax", "mode"))
+def _perlevel_util_step(local, aligned_sum, *, Dmax, mode):
+    table = local + aligned_sum
+    B, S = table.shape
+    t = table.reshape(B, Dmax, S // Dmax)
+    msg = jnp.min(t, axis=1) if mode == "min" else jnp.max(t, axis=1)
+    return table, msg
+
+
+@partial(jax.jit, static_argnames=("B_parent",))
+def _perlevel_align_combine(msg, align_idx, parent_slot, *, B_parent):
+    aligned = jnp.take_along_axis(msg, align_idx, axis=1)
+    return jax.ops.segment_sum(
+        aligned, parent_slot, num_segments=B_parent
+    )
+
+
+@partial(jax.jit, static_argnames=("Dmax", "mode", "W", "N"))
+def _perlevel_value_step(assign, table, sep_ids, node_ids, *, Dmax, mode,
+                         W, N):
+    strides = jnp.asarray(
+        np.array([Dmax ** (W - 1 - k) for k in range(W)], dtype=np.int32)
+    )
+    sep_vals = assign[jnp.clip(sep_ids, 0, N)]
+    sep_pos = jnp.sum(sep_vals * strides[None, :], axis=1)
+    B, S = table.shape
+    t = table.reshape(B, Dmax, S // Dmax)
+    col = jnp.take_along_axis(
+        t, sep_pos[:, None, None], axis=2
+    )[:, :, 0]
+    best = (jnp.argmin(col, axis=1) if mode == "min"
+            else jnp.argmax(col, axis=1)).astype(jnp.int32)
+    return assign.at[node_ids].set(best, mode="promise_in_bounds")
+
+
 def run_sweep_perlevel(plan: DpopPerLevelPlan):
     """Execute the per-level UTIL+VALUE sweeps: one jitted batched step
-    per level (jit caches by shape).  Returns (assign_idx [N], N)."""
-    import jax
-    import jax.numpy as jnp
-    from functools import partial
-
+    per level (jit caches by shape, shared across runs).  Returns
+    (assign_idx [N], N)."""
     Dmax, N, mode = plan.Dmax, plan.n_nodes, plan.mode
     levels = plan.levels
     L = len(levels)
-
-    @partial(jax.jit, static_argnames=("Dmax", "mode"))
-    def util_step(local, aligned_sum, *, Dmax, mode):
-        table = local + aligned_sum
-        B, S = table.shape
-        t = table.reshape(B, Dmax, S // Dmax)
-        msg = jnp.min(t, axis=1) if mode == "min" else jnp.max(t, axis=1)
-        return table, msg
-
-    @partial(jax.jit, static_argnames=("B_parent",))
-    def align_combine(msg, align_idx, parent_slot, *, B_parent):
-        aligned = jnp.take_along_axis(msg, align_idx, axis=1)
-        return jax.ops.segment_sum(
-            aligned, parent_slot, num_segments=B_parent
-        )
 
     # ---- UTIL: deepest level -> roots
     tables = [None] * L
@@ -537,37 +555,20 @@ def run_sweep_perlevel(plan: DpopPerLevelPlan):
             aligned_sum = jnp.zeros((lv.B, lv.S), dtype=jnp.float32)
         else:
             child = levels[li + 1]
-            aligned_sum = align_combine(
+            aligned_sum = _perlevel_align_combine(
                 msg, jnp.asarray(child.align_idx),
                 jnp.asarray(child.parent_slot), B_parent=lv.B,
             )
-        tables[li], msg = util_step(
+        tables[li], msg = _perlevel_util_step(
             jnp.asarray(lv.local), aligned_sum, Dmax=Dmax, mode=mode,
         )
 
     # ---- VALUE: roots -> deepest level
-    @partial(jax.jit, static_argnames=("Dmax", "mode", "W"))
-    def value_step(assign, table, sep_ids, node_ids, *, Dmax, mode, W):
-        strides = jnp.asarray(
-            np.array([Dmax ** (W - 1 - k) for k in range(W)],
-                     dtype=np.int32)
-        )
-        sep_vals = assign[jnp.clip(sep_ids, 0, N)]
-        sep_pos = jnp.sum(sep_vals * strides[None, :], axis=1)
-        B, S = table.shape
-        t = table.reshape(B, Dmax, S // Dmax)
-        col = jnp.take_along_axis(
-            t, sep_pos[:, None, None], axis=2
-        )[:, :, 0]
-        best = (jnp.argmin(col, axis=1) if mode == "min"
-                else jnp.argmax(col, axis=1)).astype(jnp.int32)
-        return assign.at[node_ids].set(best, mode="promise_in_bounds")
-
     assign = jnp.zeros((N + 1,), dtype=jnp.int32)
     for li in range(L):
         lv = levels[li]
-        assign = value_step(
+        assign = _perlevel_value_step(
             assign, tables[li], jnp.asarray(lv.sep_ids),
-            jnp.asarray(lv.node_ids), Dmax=Dmax, mode=mode, W=lv.W,
+            jnp.asarray(lv.node_ids), Dmax=Dmax, mode=mode, W=lv.W, N=N,
         )
     return np.asarray(jax.device_get(assign[:N])), N

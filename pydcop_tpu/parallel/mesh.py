@@ -138,7 +138,15 @@ def shard_factor_graph(
 
 
 class ShardedMaxSum:
-    """MaxSum over a device mesh: one psum of partial beliefs per cycle."""
+    """MaxSum over a device mesh: one psum of partial beliefs per cycle.
+
+    ``activation`` < 1 runs the **amaxsum** emulation (same semantics as
+    AMaxSumSolver, algorithms/amaxsum.py): each cycle only a random subset
+    of edges commits its freshly computed messages, the rest keep the
+    previous cycle's — the per-edge mask is drawn inside the shard_map
+    from a per-(cycle, shard) folded key, so asynchrony is decorrelated
+    across shards exactly as actor interleavings are across machines.
+    """
 
     def __init__(
         self,
@@ -146,19 +154,25 @@ class ShardedMaxSum:
         mesh: Optional[Mesh] = None,
         damping: float = 0.5,
         assigns: Optional[List[np.ndarray]] = None,
+        activation: Optional[float] = None,
     ):
         self.mesh = mesh or build_mesh()
         self.n_shards = self.mesh.devices.size
         self.st = shard_factor_graph(tensors, self.n_shards, assigns)
         self.damping = damping
+        self.activation = (
+            None if activation is None or activation >= 1.0
+            else float(activation)
+        )
         self._run_n = None
 
     # -- kernel -------------------------------------------------------------
 
-    def _local_cycle(self, q_blk, r_blk, *bucket_blocks):
+    def _local_cycle(self, q_blk, r_blk, key, *bucket_blocks):
         """Per-shard block of one cycle; runs inside shard_map.
 
         q_blk/r_blk: [Es, D] local message blocks.
+        key: per-cycle PRNG key (replicated; folded with the shard index).
         bucket_blocks: per bucket (tensors_blk, var_idx_blk).
         """
         st = self.st
@@ -198,6 +212,16 @@ class ShardedMaxSum:
         q_new = (beliefs_ext[edge_var_blk] - r_new)
         q_new = (q_new - masked_mean(q_new, vmask)) * vmask
         values = masked_argmin(beliefs, self.st.base.domain_mask)
+        if self.activation is not None:
+            # amaxsum emulation: only a random subset of edges commits its
+            # new messages this cycle (AMaxSumSolver.cycle semantics)
+            skey = jax.random.fold_in(key, jax.lax.axis_index(AXIS))
+            active = (
+                jax.random.uniform(skey, (q_blk.shape[0], 1))
+                < self.activation
+            )
+            q_new = jnp.where(active, q_new, q_blk)
+            r_new = jnp.where(active, r_new, r_blk)
         return q_new, r_new, values
 
     def _build(self):
@@ -212,7 +236,8 @@ class ShardedMaxSum:
         # single process
         shard0 = NamedSharding(self.mesh, P(AXIS))
         bucket_args = []
-        in_specs = [P(AXIS), P(AXIS), P(AXIS)]  # q, r, edge_var
+        # q, r, per-cycle key (replicated), edge_var
+        in_specs = [P(AXIS), P(AXIS), P(), P(AXIS)]
         for sb in st.buckets:
             bucket_args.extend([
                 jax.device_put(sb.tensors, shard0),
@@ -221,10 +246,10 @@ class ShardedMaxSum:
             in_specs.extend([P(AXIS), P(AXIS)])
         self._edge_var_arg = jax.device_put(st.edge_var, shard0)
 
-        def cycle_fn(q, r, edge_var, *buckets):
+        def cycle_fn(q, r, key, edge_var, *buckets):
             # inside shard_map: blocks carry the per-shard slices
             self._edge_var_blk = edge_var
-            return self._local_cycle(q, r, *pairs(buckets))
+            return self._local_cycle(q, r, key, *pairs(buckets))
 
         sharded = jax.shard_map(
             cycle_fn,
@@ -238,18 +263,16 @@ class ShardedMaxSum:
 
         # global arrays must be jit ARGUMENTS, not closure constants —
         # multi-process meshes reject closing over non-addressable shards
-        def run_n(q, r, n_cycles, edge_var, *buckets):
-            def body(carry, _):
+        def run_n(q, r, keys, edge_var, *buckets):
+            def body(carry, k):
                 q, r = carry
-                q2, r2, values = sharded(q, r, edge_var, *buckets)
+                q2, r2, values = sharded(q, r, k, edge_var, *buckets)
                 return (q2, r2), values
 
-            (q, r), values_hist = jax.lax.scan(
-                body, (q, r), None, length=n_cycles
-            )
+            (q, r), values_hist = jax.lax.scan(body, (q, r), keys)
             return q, r, values_hist[-1]
 
-        self._run_n = jax.jit(run_n, static_argnums=2)
+        self._run_n = jax.jit(run_n)
 
     def init_messages(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
         st = self.st
@@ -258,7 +281,7 @@ class ShardedMaxSum:
         z = jax.device_put(jnp.zeros((E, D), dtype=jnp.float32), sharding)
         return z, z
 
-    def run(self, cycles: int = 20, q=None, r=None):
+    def run(self, cycles: int = 20, q=None, r=None, seed: int = 0):
         """Run `cycles` sharded cycles; returns (values [V], q, r).
         Pass the previous call's (q, r) to continue instead of
         restarting from zero messages."""
@@ -266,8 +289,16 @@ class ShardedMaxSum:
             self._build()
         if q is None or r is None:
             q, r = self.init_messages()
+            self._epoch = 0
+        # identical on every process (SPMD); the epoch advances the stream
+        # across chunked/resumed runs so activation patterns don't replay
+        epoch = getattr(self, "_epoch", 0)
+        self._epoch = epoch + 1
+        keys = jax.random.split(
+            jax.random.fold_in(jax.random.PRNGKey(seed), epoch), cycles
+        )
         q, r, values = self._run_n(
-            q, r, cycles, self._edge_var_arg, *self._bucket_args
+            q, r, keys, self._edge_var_arg, *self._bucket_args
         )
         return np.asarray(values), q, r
 

@@ -74,16 +74,20 @@ def global_mesh():
     return Mesh(np.array(jax.devices()), (AXIS,))
 
 
-def run_multihost_maxsum(dcop, cycles: int = 15, damping: float = 0.5):
+def run_multihost_maxsum(dcop, cycles: int = 15, damping: float = 0.5,
+                         activation: Optional[float] = None):
     """Solve `dcop` with MaxSum sharded over the global multi-process
     mesh.  Returns (values, n_global_devices, tensors).  Every process
-    must call this with an identical dcop (SPMD)."""
+    must call this with an identical dcop (SPMD).  ``activation`` < 1
+    runs the amaxsum emulation (per-edge activation masks,
+    ShardedMaxSum)."""
     from pydcop_tpu.ops.compile import compile_factor_graph
     from pydcop_tpu.parallel.mesh import ShardedMaxSum
 
     tensors = compile_factor_graph(dcop)
     mesh = global_mesh()
-    sharded = ShardedMaxSum(tensors, mesh, damping=damping)
+    sharded = ShardedMaxSum(tensors, mesh, damping=damping,
+                            activation=activation)
     values, _q, _r = sharded.run(cycles=cycles)
     return values, mesh.devices.size, tensors
 

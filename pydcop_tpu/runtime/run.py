@@ -129,8 +129,17 @@ def _solve_under_placement(
         )
     damping = algo_def.params.get("damping")
     damping = 0.5 if damping is None else float(damping)  # 0 is valid
+    # amaxsum rides the same sharded engine with its per-edge activation
+    # mask (ShardedMaxSum activation — the AMaxSumSolver emulation)
+    activation = None
+    if algo_def.algo == "amaxsum":
+        from pydcop_tpu.algorithms.amaxsum import DEFAULT_ACTIVATION
+
+        activation = float(
+            algo_def.params.get("activation", DEFAULT_ACTIVATION)
+        )
     sharded = ShardedMaxSum(tensors, mesh, damping=damping,
-                            assigns=assigns)
+                            assigns=assigns, activation=activation)
     n_cycles = cycles or 30
     status = "FINISHED"
     history = []

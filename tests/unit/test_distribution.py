@@ -16,8 +16,11 @@ INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
 
 GREEDY = ["oneagent", "adhoc", "gh_cgdp", "heur_comhost", "gh_secp_cgdp",
           "gh_secp_fgdp"]
-ILP = ["ilp_fgdp", "ilp_compref", "ilp_compref_fg", "oilp_cgdp",
-       "oilp_secp_cgdp", "oilp_secp_fgdp"]
+ILP = ["ilp_fgdp", "ilp_compref", "ilp_compref_fg", "oilp_cgdp"]
+# the optimal SECP ILPs degenerate on non-SECP instances (see
+# test_oilp_secp_degenerate_on_non_secp) and are covered on a real SECP
+# instance in test_distribution_secp.py
+ILP_SECP = ["oilp_secp_cgdp", "oilp_secp_fgdp"]
 
 
 @pytest.fixture
@@ -39,7 +42,7 @@ def _load(node, target=None):
 
 def test_registry():
     mods = list_available_distributions()
-    for m in GREEDY + ILP + ["yamlformat"]:
+    for m in GREEDY + ILP + ILP_SECP + ["yamlformat"]:
         if m == "yamlformat":
             assert m not in mods  # excluded (not a strategy)
         else:
@@ -150,3 +153,20 @@ def test_yamlformat_roundtrip(tuto):
     dumped = yamlformat.yaml_dist(dist)
     dist2 = yamlformat.load_dist(dumped)
     assert dist2 == dist
+
+
+@pytest.mark.parametrize("name", ["oilp_secp_cgdp", "oilp_secp_fgdp"])
+def test_oilp_secp_degenerate_on_non_secp(tuto, name):
+    """On a non-SECP instance every computation has hosting_cost 0 on the
+    first agent, so actuator pre-assignment pins everything there and the
+    liveness constraints (every empty agent hosts >= 1, reference
+    oilp_secp_cgdp.py:206-214) become infeasible — the reference raises
+    ImpossibleDistributionException (oilp_secp_cgdp.py:280-281), and so
+    do we (ADVICE r2)."""
+    dcop, cg = tuto
+    mod = load_distribution_module(name)
+    with pytest.raises(ImpossibleDistributionException):
+        mod.distribute(
+            cg, dcop.agents.values(), hints=None,
+            computation_memory=_mem, communication_load=_load,
+        )

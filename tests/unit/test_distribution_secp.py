@@ -132,3 +132,31 @@ def test_secp_ilp_respects_capacity():
     )
     for a in dist.agents:
         assert len(dist.computations_hosted(a)) <= 3
+
+
+def test_secp_ilp_liveness_with_no_free_comps():
+    """ADVICE r2: when nothing is free to host but an agent's pre-mapping
+    is empty, the reference ILP's liveness constraints are infeasible —
+    we must raise, not return a dead-agent distribution."""
+    import pytest as _pytest
+
+    from pydcop_tpu.distribution._secp import secp_ilp
+    from pydcop_tpu.distribution.objects import (
+        ImpossibleDistributionException,
+    )
+
+    class _A:
+        def __init__(self, name):
+            self.name = name
+
+    agents = [_A("a1"), _A("a2")]
+    with _pytest.raises(ImpossibleDistributionException):
+        secp_ilp(
+            computation_graph=None,
+            agents=agents,
+            pre_mapping={"a1": ["c1"], "a2": []},
+            comps_to_host=[],
+            capa={"a1": 10.0, "a2": 10.0},
+            computation_memory=None,
+            communication_load=None,
+        )
