@@ -112,13 +112,53 @@ def conflicted(
     return cur >= threshold
 
 
+def select_fused_runner(solver, n, build_runner, candidates):
+    """Return the first candidate fused-group runner that compiles and
+    executes on this backend, or None.
+
+    Pallas scoped-VMEM limits depend on problem scale AND the loop
+    context XLA places the kernel in, so a static model cannot predict
+    which unroll depth fits — each candidate is trial-run once on dummy
+    state (one dispatch, cached thereafter) and the first success wins.
+    """
+    import logging
+
+    log = logging.getLogger(__name__)
+    last_err = None
+    for group in candidates:
+        runner = build_runner(group)
+        try:
+            state = solver.initial_state()
+            keys = jax.random.split(jax.random.PRNGKey(0), n)
+            out_state, _ = runner(state, keys)
+            jax.block_until_ready(jax.tree_util.tree_leaves(out_state))
+            return runner
+        except Exception as e:  # noqa: BLE001 — compile failure → next tier
+            last_err = e
+            log.info(
+                "fused local-search kernel with %d cycles/launch did not "
+                "compile at this scale (%s); trying a smaller unroll",
+                group, e,
+            )
+    # even the 1-cycle kernel failed: that is a bug or a truly oversized
+    # graph, not a tuning matter — surface it loudly (the generic path is
+    # 25-50x slower, a silent fallback would read as a perf mystery)
+    log.error(
+        "no fused local-search kernel compiled; falling back to the "
+        "generic engine", exc_info=last_err,
+    )
+    return None
+
+
 class LocalSearchSolver(SynchronousTensorSolver):
     """Base for local-search solvers: state = (x, aux...); random init.
 
     On TPU with an all-binary graph, plain (unweighted) local cost tables
     are computed by the lane-packed pallas kernel
     (ops.pallas_maxsum.packed_local_tables) via :meth:`local_tables`;
-    weighted variants (dba/gdba) keep the generic path.
+    MGM/DSA additionally fuse whole multi-cycle chunks into single pallas
+    kernels (ops.pallas_local_search) on the no-metrics path.  Weighted
+    variants (dba/gdba) keep the generic path.
     """
 
     def __init__(self, dcop, tensors: ConstraintGraphTensors, algo_def:
@@ -129,12 +169,44 @@ class LocalSearchSolver(SynchronousTensorSolver):
         self.msgs_per_cycle = int(tensors.neighbor_src.shape[0])
         self.msg_size_per_msg = 1.0
         self.packed = None
+        self._packed_ls = None
+        self._packed_ls_built = False
         if use_packed is None:
             use_packed = jax.default_backend() == "tpu"
         if use_packed:
             from pydcop_tpu.ops.pallas_maxsum import try_pack_for_pallas
 
             self.packed = try_pack_for_pallas(tensors)
+
+    @property
+    def packed_ls(self):
+        """Packed layout for the FUSED cycle kernels, built lazily from
+        :attr:`packed` on first use — only MGM/DSA's fused chunk runners
+        read it, and the extra device arrays (cost slabs, mate indices)
+        would be dead weight for the weighted variants (dba/gdba)."""
+        if not self._packed_ls_built:
+            self._packed_ls_built = True
+            if self.packed is not None:
+                from pydcop_tpu.ops.pallas_local_search import pack_from_pg
+
+                self._packed_ls = pack_from_pg(self.packed)
+        return self._packed_ls
+
+    def _fused_chunk_runner(self, n, collect, build_runner):
+        """Shared fused fast-path plumbing for MGM/DSA: cache by
+        (n, 'fused'), trial-compile descending unroll tiers, fall back
+        to the generic runner when nothing compiles."""
+        if collect or self.packed_ls is None:
+            return super()._chunk_runner(n, collect)
+        cache_key = (n, "fused")
+        if cache_key not in self._compiled_chunks:
+            candidates = [g for g in (5, 4, 3, 2) if n % g == 0] + [1]
+            runner = select_fused_runner(self, n, build_runner, candidates)
+            self._compiled_chunks[cache_key] = (
+                runner if runner is not None
+                else super()._chunk_runner(n, collect)
+            )
+        return self._compiled_chunks[cache_key]
 
     def local_tables(self, x: jnp.ndarray) -> jnp.ndarray:
         """[V, D] local cost tables under the current assignment x."""

@@ -39,8 +39,9 @@ algo_params = [
 class DsaSolver(LocalSearchSolver):
     """State = (x,)."""
 
-    def __init__(self, dcop, tensors, algo_def, seed=0):
-        super().__init__(dcop, tensors, algo_def, seed)
+    def __init__(self, dcop, tensors, algo_def, seed=0, use_packed=None):
+        super().__init__(dcop, tensors, algo_def, seed,
+                         use_packed=use_packed)
         self.probability = float(self.params.get("probability", 0.7))
         self.variant = self.params.get("variant", "B")
 
@@ -65,6 +66,46 @@ class DsaSolver(LocalSearchSolver):
             want = improving | lateral
         move = want & activate
         return (jnp.where(move, best_val, x).astype(jnp.int32),)
+
+    def _chunk_runner(self, n, collect: bool = True):
+        """Fused fast path: groups of cycles as single pallas kernels
+        (ops.pallas_local_search.packed_dsa_cycles) when per-cycle
+        metrics are not collected.  The per-cycle coin flips are drawn
+        from the same keys the generic path would use, so the fused run
+        is bit-identical (tests/unit/test_pallas_local_search.py)."""
+        if collect or self.packed is None:
+            return super()._chunk_runner(n, collect)
+        from pydcop_tpu.ops.pallas_local_search import (
+            pack_x,
+            packed_dsa_cycles,
+            uniforms_for_keys,
+            unpack_x,
+        )
+
+        pls = self.packed_ls
+        prob, variant = self.probability, self.variant
+
+        def build_runner(group):
+            @jax.jit
+            def run_chunk(state, keys):
+                (x,) = state
+                x_row = pack_x(pls, x)
+                uniforms = uniforms_for_keys(pls, keys)
+                u_groups = uniforms.reshape(
+                    n // group, group, uniforms.shape[1]
+                )
+
+                def body(xr, u):
+                    return packed_dsa_cycles(
+                        pls, xr, u, probability=prob, variant=variant
+                    ), None
+
+                x_row, _ = jax.lax.scan(body, x_row, u_groups)
+                return (unpack_x(pls, x_row),), None
+
+            return run_chunk
+
+        return self._fused_chunk_runner(n, collect, build_runner)
 
 
 def build_solver(dcop: DCOP, computation_graph=None, algo_def=None, seed=0):

@@ -34,8 +34,9 @@ algo_params = [
 class MgmSolver(LocalSearchSolver):
     """State = (x,).  One cycle = the reference's value+gain rounds."""
 
-    def __init__(self, dcop, tensors, algo_def, seed=0):
-        super().__init__(dcop, tensors, algo_def, seed)
+    def __init__(self, dcop, tensors, algo_def, seed=0, use_packed=None):
+        super().__init__(dcop, tensors, algo_def, seed,
+                         use_packed=use_packed)
         # 2 rounds (value + gain) of one message per directed neighbor pair
         self.msgs_per_cycle = 2 * int(tensors.neighbor_src.shape[0])
 
@@ -46,6 +47,41 @@ class MgmSolver(LocalSearchSolver):
         )
         move = neighborhood_winner(self.tensors, gain)
         return (jnp.where(move, best_val, x).astype(jnp.int32),)
+
+    def _chunk_runner(self, n, collect: bool = True):
+        """Fused fast path: groups of cycles as single pallas kernels
+        (ops.pallas_local_search.packed_mgm_cycles) when per-cycle
+        metrics are not collected — bit-identical to :meth:`cycle`
+        (tests/unit/test_pallas_local_search.py)."""
+        if collect or self.packed is None:
+            return super()._chunk_runner(n, collect)
+        import jax as _jax
+
+        from pydcop_tpu.ops.pallas_local_search import (
+            pack_x,
+            packed_mgm_cycles,
+            unpack_x,
+        )
+
+        pls = self.packed_ls
+
+        def build_runner(group):
+            @_jax.jit
+            def run_chunk(state, keys):
+                (x,) = state
+                x_row = pack_x(pls, x)
+
+                def body(xr, _):
+                    return packed_mgm_cycles(pls, xr, group), None
+
+                x_row, _ = _jax.lax.scan(
+                    body, x_row, None, length=n // group
+                )
+                return (unpack_x(pls, x_row),), None
+
+            return run_chunk
+
+        return self._fused_chunk_runner(n, collect, build_runner)
 
 
 def build_solver(dcop: DCOP, computation_graph=None, algo_def=None, seed=0):

@@ -1,0 +1,194 @@
+"""Fused packed local-search engine ≡ generic engine (exact cross-checks).
+
+Costs in these instances are integers, so float sums are exact and the
+packed kernels must reproduce the generic path bit-for-bit — including
+argmin tie-breaks and MGM's lexic neighborhood arbitration.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pydcop_tpu.algorithms import AlgorithmDef
+from pydcop_tpu.algorithms._local_search import random_valid_values
+from pydcop_tpu.generators import generate_graph_coloring
+from pydcop_tpu.ops.compile import compile_constraint_graph, total_cost
+from pydcop_tpu.ops.pallas_local_search import (
+    pack_local_search,
+    pack_x,
+    packed_dsa_cycles,
+    packed_mgm_cycles,
+    uniforms_for_keys,
+    unpack_x,
+)
+
+
+def _instance(n_vars=40, n_edges=90, seed=3):
+    dcop = generate_graph_coloring(
+        n_variables=n_vars, n_colors=3, n_edges=n_edges, soft=True,
+        n_agents=1, seed=seed,
+    )
+    return dcop, compile_constraint_graph(dcop)
+
+
+@pytest.fixture(scope="module")
+def packed_instance():
+    dcop, tensors = _instance()
+    pls = pack_local_search(tensors)
+    assert pls is not None
+    return dcop, tensors, pls
+
+
+def test_pack_roundtrip(packed_instance):
+    _, tensors, pls = packed_instance
+    x = random_valid_values(tensors, jax.random.PRNGKey(0))
+    x_row = pack_x(pls, x)
+    np.testing.assert_array_equal(np.asarray(unpack_x(pls, x_row)),
+                                  np.asarray(x))
+
+
+def test_mgm_fused_matches_generic(packed_instance):
+    from pydcop_tpu.algorithms.mgm import MgmSolver
+
+    dcop, tensors, pls = packed_instance
+    algo_def = AlgorithmDef.build_with_default_params("mgm")
+    solver = MgmSolver(dcop, tensors, algo_def, seed=0)
+    assert solver.packed is None  # CPU: generic per-cycle path
+
+    x = random_valid_values(tensors, jax.random.PRNGKey(17))
+    state = (x,)
+    n = 12
+    for i in range(n):
+        state = solver.cycle(state, jax.random.PRNGKey(i))
+    expected = np.asarray(state[0])
+
+    x_row = packed_mgm_cycles(pls, pack_x(pls, x), n)
+    got = np.asarray(unpack_x(pls, x_row))
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_mgm_fused_is_monotone(packed_instance):
+    dcop, tensors, pls = packed_instance
+    x = random_valid_values(tensors, jax.random.PRNGKey(5))
+    x_row = pack_x(pls, x)
+    prev_cost = float(total_cost(tensors, unpack_x(pls, x_row)))
+    for _ in range(6):
+        x_row = packed_mgm_cycles(pls, x_row, 2)
+        cost = float(total_cost(tensors, unpack_x(pls, x_row)))
+        assert cost <= prev_cost + 1e-6  # MGM never increases total cost
+        prev_cost = cost
+
+
+@pytest.mark.parametrize("variant", ["A", "B", "C"])
+def test_dsa_fused_matches_generic(packed_instance, variant):
+    from pydcop_tpu.algorithms.dsa import DsaSolver
+
+    dcop, tensors, pls = packed_instance
+    algo_def = AlgorithmDef.build_with_default_params(
+        "dsa", {"variant": variant, "probability": 0.7}
+    )
+    solver = DsaSolver(dcop, tensors, algo_def, seed=0)
+
+    x = random_valid_values(tensors, jax.random.PRNGKey(23))
+    keys = jax.random.split(jax.random.PRNGKey(99), 10)
+    state = (x,)
+    for k in keys:
+        state = solver.cycle(state, k)
+    expected = np.asarray(state[0])
+
+    uniforms = uniforms_for_keys(pls, keys)
+    x_row = packed_dsa_cycles(
+        pls, pack_x(pls, x), uniforms, probability=0.7, variant=variant
+    )
+    got = np.asarray(unpack_x(pls, x_row))
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_mgm_lexic_tiebreak_smallest_index_wins():
+    """Two variables in conflict with equal gains: only the smaller
+    index may move in one MGM cycle (reference mgm.py lexic break_mode)."""
+    from pydcop_tpu.dcop import DCOP, Domain, Variable, constraint_from_str
+
+    d = Domain("c", "c", ["R", "G"])
+    dcop = DCOP("tie", objective="min")
+    va = Variable("a", d)
+    vb = Variable("b", d)
+    dcop.add_constraint(constraint_from_str(
+        "conf", "10 if a == b else 0", [va, vb]))
+    tensors = compile_constraint_graph(dcop)
+    pls = pack_local_search(tensors)
+    assert pls is not None
+
+    x = jnp.array([0, 0], dtype=jnp.int32)  # both "R": conflict, tied gain
+    x_row = packed_mgm_cycles(pls, pack_x(pls, x), 1)
+    got = np.asarray(unpack_x(pls, x_row))
+    # only variable 0 ("a") moves in the first cycle
+    assert got[0] != 0 and got[1] == 0
+
+
+def test_degree_zero_variable_moves_on_unary_gain():
+    """An isolated variable has no neighbors — MGM must let it move on
+    its own unary gain (generic: empty neighborhood)."""
+    from pydcop_tpu.dcop import DCOP, Domain, Variable, constraint_from_str
+    from pydcop_tpu.dcop.objects import VariableWithCostDict
+
+    d = Domain("c", "c", [0, 1])
+    dcop = DCOP("iso", objective="min")
+    va = Variable("a", d)
+    vb = Variable("b", d)
+    # a-b constrained; z isolated with a unary cost preferring value 1
+    vz = VariableWithCostDict("z", d, {0: 10.0, 1: 0.0})
+    dcop.add_variable(vz)
+    dcop.add_constraint(constraint_from_str(
+        "conf", "5 if a == b else 0", [va, vb]))
+    tensors = compile_constraint_graph(dcop)
+    pls = pack_local_search(tensors)
+    assert pls is not None
+    iz = tensors.var_index("z")
+
+    x = jnp.zeros(3, dtype=jnp.int32)
+    x_row = packed_mgm_cycles(pls, pack_x(pls, x), 1)
+    got = np.asarray(unpack_x(pls, x_row))
+    assert got[iz] == 1  # moved to the cheap value
+
+
+def test_fused_chunks_equal_single_calls(packed_instance):
+    """packed_mgm_cycles(n) ≡ n sequential packed_mgm_cycles(1)."""
+    _, tensors, pls = packed_instance
+    x = random_valid_values(tensors, jax.random.PRNGKey(7))
+    a = pack_x(pls, x)
+    b = a
+    a = packed_mgm_cycles(pls, a, 6)
+    for _ in range(6):
+        b = packed_mgm_cycles(pls, b, 1)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("algo", ["mgm", "dsa"])
+def test_solver_fused_path_matches_generic(algo):
+    """MgmSolver/DsaSolver with the packed engine (fused chunk runner)
+    produce the same run as the generic engine — same seed, same PRNG
+    stream, integer costs."""
+    from pydcop_tpu.algorithms import load_algorithm_module
+
+    dcop, _ = _instance(n_vars=30, n_edges=70, seed=11)
+    mod = load_algorithm_module(algo)
+    algo_def = AlgorithmDef.build_with_default_params(algo)
+
+    tensors_a = compile_constraint_graph(dcop)
+    generic = mod.__dict__[
+        "MgmSolver" if algo == "mgm" else "DsaSolver"
+    ](dcop, tensors_a, algo_def, seed=4)
+    assert generic.packed_ls is None
+    res_g = generic.run(cycles=20, chunk=20)
+
+    tensors_b = compile_constraint_graph(dcop)
+    fused = mod.__dict__[
+        "MgmSolver" if algo == "mgm" else "DsaSolver"
+    ](dcop, tensors_b, algo_def, seed=4, use_packed=True)
+    assert fused.packed_ls is not None
+    res_f = fused.run(cycles=20, chunk=20)
+
+    assert res_f.assignment == res_g.assignment
+    assert res_f.cost == res_g.cost
