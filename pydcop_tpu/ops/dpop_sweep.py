@@ -276,6 +276,22 @@ def run_sweep(plan: DpopSweepPlan):
 _SCAN_UNROLL = 4
 
 
+def mode_ops(plan: DpopSweepPlan):
+    """(reduce_axis, argred, msg_stride) for a plan's min/max mode —
+    shared by the single-device engine and parallel.dpop_mesh so the two
+    cannot drift."""
+    reduce_axis = (
+        (lambda t: jnp.min(t, axis=1)) if plan.mode == "min"
+        else (lambda t: jnp.max(t, axis=1))
+    )
+    argred = jnp.argmin if plan.mode == "min" else jnp.argmax
+    msg_stride = jnp.asarray(np.array(
+        [plan.Dmax ** (plan.W - 1 - k) for k in range(plan.W)],
+        dtype=np.int32,
+    ))
+    return reduce_axis, argred, msg_stride
+
+
 def _sweep_math(plan: DpopSweepPlan, local, align_idx, parent_slot,
                 sep_ids, node_ids):
     """Traced UTIL+VALUE math (pure; shared by make_sweep_fn and
@@ -284,13 +300,7 @@ def _sweep_math(plan: DpopSweepPlan, local, align_idx, parent_slot,
 
     Bmax, Dmax, W = plan.Bmax, plan.Dmax, plan.W
     S, Sm, N = plan.S, plan.Sm, plan.n_nodes
-    mode = plan.mode
-    reduce_axis = (lambda t: jnp.min(t, axis=1)) if mode == "min" else (
-        lambda t: jnp.max(t, axis=1))
-    argred = jnp.argmin if mode == "min" else jnp.argmax
-    msg_stride = jnp.asarray(
-        np.array([Dmax ** (W - 1 - k) for k in range(W)], dtype=np.int32)
-    )
+    reduce_axis, argred, msg_stride = mode_ops(plan)
 
     def util_step(carry, x):
         msg_prev, aidx_prev, pslot_prev = carry

@@ -1,0 +1,168 @@
+"""Sharded DPOP UTIL/VALUE sweep over a device mesh.
+
+DPOP is the algorithm that actually exhausts one chip's memory — UTIL
+tables grow as ``D^(w+1)`` with separator width — so it is the one that
+most needs multi-chip execution (the reference runs it distributed in
+process mode, pydcop/infrastructure/run.py:225-287; SURVEY.md §2.8).
+
+Sharding layout (mirrors ShardedMaxSum's "shard the big axis, combine
+with one collective per step" design):
+
+* every level's node batch rides the mesh axis: each device owns a
+  contiguous block of ``Bp / n_shards`` node rows of EVERY level — the
+  saved UTIL tables ``[L, Bp/n, S]``, the dominant memory term, are
+  genuinely sharded;
+* the one cross-device exchange per UTIL level is a
+  ``psum_scatter``: children compute per-shard partial combines of
+  their messages into the (global) parent-slot space, the collective
+  sums them and hands each device exactly its block of parent rows —
+  messages then stay block-aligned for the next level with no gather;
+* the VALUE sweep walks down with a replicated assignment vector; each
+  device arg-reduces its own table rows and a one-hot ``psum`` merges
+  the per-shard assignments (disjoint by construction).
+
+The same code runs on a real multi-chip mesh or the virtual
+``--xla_force_host_platform_device_count`` CPU mesh (tests and the
+driver's dry run), and matches the single-device engine exactly for
+exactly-representable costs (tests/unit/test_dpop_mesh.py).  With
+general float costs the per-shard partial combine + psum_scatter
+associates f32 additions differently than the single global
+segment_sum, so near-tied argmins may legitimately differ in the last
+ulp.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pydcop_tpu.ops.dpop_sweep import DpopSweepPlan, mode_ops
+from pydcop_tpu.parallel.mesh import AXIS, build_mesh
+
+
+class ShardedDpopSweep:
+    """Run a compiled DpopSweepPlan sharded over a device mesh."""
+
+    def __init__(self, plan: DpopSweepPlan, mesh: Optional[Mesh] = None):
+        self.plan = plan
+        self.mesh = mesh or build_mesh()
+        self.n_shards = int(self.mesh.devices.size)
+        n = self.n_shards
+        Bmax = plan.Bmax
+        self.Bp = Bp = -(-Bmax // n) * n  # pad batch to a multiple of n
+
+        # pad the batch axis; dummy parent slot Bmax is remapped to Bp
+        # (the dropped segment of the per-shard combine)
+        def pad_rows(a, fill):
+            pad = [(0, 0), (0, Bp - Bmax)] + [(0, 0)] * (a.ndim - 2)
+            return np.pad(a, pad, constant_values=fill)
+
+        local = pad_rows(plan.local, 0.0)
+        align_idx = pad_rows(plan.align_idx, 0)
+        parent_slot = pad_rows(plan.parent_slot, Bp)
+        parent_slot = np.where(parent_slot == Bmax, Bp, parent_slot)
+        sep_ids = pad_rows(plan.sep_ids, plan.n_nodes)
+        node_ids = pad_rows(plan.node_ids, plan.n_nodes + 1)
+
+        # the UTIL scan walks bottom-up: flip on host, once
+        self._args_np = (
+            local[::-1].copy(), align_idx[::-1].copy(),
+            parent_slot[::-1].copy(),
+            # VALUE walks top-down over tables produced bottom-up: the
+            # traced fn re-flips the scanned tables, sep/node stay
+            # top-down
+            sep_ids, node_ids,
+        )
+        self._fn = None
+        self._dev_args = None
+
+    def _build(self):
+        plan = self.plan
+        Bp, n = self.Bp, self.n_shards
+        bs = Bp // n
+        Dmax, S, Sm, N = plan.Dmax, plan.S, plan.Sm, plan.n_nodes
+        reduce_axis, argred, msg_stride = mode_ops(plan)
+
+        def sweep(local, align_idx, parent_slot, sep_ids, node_ids):
+            # per-shard blocks: local [L, bs, S], ... (level axis whole)
+            def util_step(carry, x):
+                msg_prev, aidx_prev, pslot_prev = carry
+                local_l, aidx_l, pslot_l = x
+                aligned = jnp.take_along_axis(msg_prev, aidx_prev, axis=1)
+                partial = jax.ops.segment_sum(
+                    aligned, pslot_prev, num_segments=Bp + 1
+                )[:Bp]
+                combined = jax.lax.psum_scatter(
+                    partial, AXIS, scatter_dimension=0, tiled=True
+                )
+                table = local_l + combined
+                msg = reduce_axis(table.reshape(bs, Dmax, Sm))
+                return (msg, aidx_l, pslot_l), table
+
+            init = (
+                jnp.zeros((bs, Sm), dtype=jnp.float32),
+                jnp.zeros((bs, S), dtype=jnp.int32),
+                jnp.full((bs,), Bp, dtype=jnp.int32),
+            )
+            _, tables_rev = jax.lax.scan(
+                util_step, init, (local, align_idx, parent_slot)
+            )
+            tables = tables_rev[::-1]
+
+            def value_step(assign, x):
+                table_l, sep_l, nid_l = x
+                sep_vals = assign[jnp.clip(sep_l, 0, N)]
+                sep_pos = jnp.sum(sep_vals * msg_stride[None, :], axis=1)
+                tbl = table_l.reshape(bs, Dmax, Sm)
+                col = jnp.take_along_axis(
+                    tbl, sep_pos[:, None, None], axis=2
+                )[:, :, 0]
+                best = argred(col, axis=1).astype(jnp.int32)
+                # disjoint per-shard updates merged by one psum (+1
+                # sentinel so chosen index 0 survives the where)
+                delta = jnp.zeros((N + 1,), jnp.int32).at[nid_l].set(
+                    best + 1, mode="drop"
+                )
+                delta = jax.lax.psum(delta, AXIS)
+                return jnp.where(delta > 0, delta - 1, assign), None
+
+            assign0 = jnp.zeros((N + 1,), dtype=jnp.int32)
+            assign, _ = jax.lax.scan(
+                value_step, assign0, (tables, sep_ids, node_ids)
+            )
+            return assign[:N]
+
+        sharded = jax.shard_map(
+            sweep,
+            mesh=self.mesh,
+            in_specs=(
+                P(None, AXIS, None), P(None, AXIS, None), P(None, AXIS),
+                P(None, AXIS, None), P(None, AXIS),
+            ),
+            out_specs=P(),
+            check_vma=False,
+        )
+        self._fn = jax.jit(sharded)
+
+        shard_row = NamedSharding(self.mesh, P(None, AXIS))
+        shard_row3 = NamedSharding(self.mesh, P(None, AXIS, None))
+        a_l, a_ai, a_ps, a_si, a_ni = self._args_np
+        self._dev_args = (
+            jax.device_put(jnp.asarray(a_l), shard_row3),
+            jax.device_put(jnp.asarray(a_ai), shard_row3),
+            jax.device_put(jnp.asarray(a_ps), shard_row),
+            jax.device_put(jnp.asarray(a_si), shard_row3),
+            jax.device_put(jnp.asarray(a_ni), shard_row),
+        )
+        # the padded host copies are dead once on device — the tables
+        # are the memory-bound term, don't hold them twice
+        self._args_np = None
+
+    def run(self) -> np.ndarray:
+        """Full UTIL+VALUE sweep on the mesh → assign_idx [n_nodes]."""
+        if self._fn is None:
+            self._build()
+        return np.asarray(jax.device_get(self._fn(*self._dev_args)))
