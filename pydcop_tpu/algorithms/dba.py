@@ -43,16 +43,17 @@ algo_params = [
 ]
 
 
+def violation_indicator(t: jnp.ndarray) -> jnp.ndarray:
+    """0/1 violation indicator per constraint entry for one stacked cost
+    tensor (padding stays PAD).  Shared with the sharded twin
+    (parallel.mesh.ShardedLocalSearch) so the semantics cannot drift."""
+    return jnp.where(
+        t >= PAD_COST / 2, PAD_COST, (t > 0).astype(jnp.float32)
+    )
+
+
 def _violation_tensors(tensors) -> List[jnp.ndarray]:
-    """0/1 violation indicator per constraint entry (padding stays PAD)."""
-    out = []
-    for b in tensors.buckets:
-        t = b.tensors
-        ind = jnp.where(
-            t >= PAD_COST / 2, PAD_COST, (t > 0).astype(jnp.float32)
-        )
-        out.append(ind)
-    return out
+    return [violation_indicator(b.tensors) for b in tensors.buckets]
 
 
 class DbaSolver(LocalSearchSolver):

@@ -50,6 +50,79 @@ algo_params = [
 ]
 
 
+# -- shared per-tensor building blocks (used by GdbaSolver AND the sharded
+#    twin, parallel.mesh.ShardedLocalSearch — single source of semantics) --
+
+
+def factor_min_max(t: jnp.ndarray, arity: int):
+    """(fmin, fmax) per factor of one stacked cost tensor, ignoring
+    padding (for the NM / MX violation modes)."""
+    valid = t < PAD_COST / 2
+    axes = tuple(range(1, arity + 1))
+    fmin = jnp.min(jnp.where(valid, t, PAD_COST), axis=axes)
+    fmax = jnp.max(jnp.where(valid, t, -PAD_COST), axis=axes)
+    return fmin, fmax
+
+
+def effective_tensor(t: jnp.ndarray, w: jnp.ndarray,
+                     modifier: str) -> jnp.ndarray:
+    """base ∘ weight with the A/M modifier; padding stays huge."""
+    e = t + w if modifier == "A" else t * w
+    return jnp.where(t >= PAD_COST / 2, PAD_COST, e)
+
+
+def violation_mask(base_cur: jnp.ndarray, fmin: jnp.ndarray,
+                   fmax: jnp.ndarray, violation: str) -> jnp.ndarray:
+    """Per-factor violation test under the current assignment
+    (NZ: non-zero, NM: non-minimal, MX: maximal)."""
+    if violation == "NZ":
+        viol = base_cur > 1e-9
+    elif violation == "NM":
+        viol = base_cur > fmin + 1e-9
+    else:  # MX
+        viol = base_cur >= fmax - 1e-9
+    return viol & (base_cur < PAD_COST / 2)
+
+
+def increase_mask(t: jnp.ndarray, vals: jnp.ndarray,
+                  increase_mode: str) -> jnp.ndarray:
+    """Which entries of each factor tensor get their weight bumped
+    (E: current entry, R: one-deviation slices, C: own-value slices,
+    T: whole tensor).  ``vals`` is [F, arity] current value indices."""
+    F, a = vals.shape
+    onehots = [
+        jax.nn.one_hot(vals[:, p], t.shape[1 + p]) for p in range(a)
+    ]
+
+    def _bcast(m, p):
+        shape = [F] + [1] * a
+        shape[1 + p] = t.shape[1 + p]
+        return m.reshape(shape)
+
+    if increase_mode == "E":
+        mask = jnp.ones_like(t)
+        for p in range(a):
+            mask = mask * _bcast(onehots[p], p)
+    elif increase_mode == "R":
+        # entries reachable by deviating ONE variable: for each p, other
+        # axes fixed at current values
+        mask = jnp.zeros_like(t)
+        for p in range(a):
+            m = jnp.ones_like(t)
+            for q in range(a):
+                if q != p:
+                    m = m * _bcast(onehots[q], q)
+            mask = jnp.maximum(mask, m)
+    elif increase_mode == "C":
+        # entries keeping this factor's current values on ONE axis
+        mask = jnp.zeros_like(t)
+        for p in range(a):
+            mask = jnp.maximum(mask, _bcast(onehots[p], p))
+    else:  # T: the whole tensor
+        mask = jnp.ones_like(t)
+    return mask
+
+
 class GdbaSolver(LocalSearchSolver):
     """State = (x, [W_b per bucket])."""
 
@@ -64,14 +137,9 @@ class GdbaSolver(LocalSearchSolver):
         # masked per-factor min/max of base costs, for NM / MX violation
         self._fmin, self._fmax = [], []
         for b in tensors.buckets:
-            valid = b.tensors < PAD_COST / 2
-            axes = tuple(range(1, b.arity + 1))
-            self._fmin.append(
-                jnp.min(jnp.where(valid, b.tensors, PAD_COST), axis=axes)
-            )
-            self._fmax.append(
-                jnp.max(jnp.where(valid, b.tensors, -PAD_COST), axis=axes)
-            )
+            fmin, fmax = factor_min_max(b.tensors, b.arity)
+            self._fmin.append(fmin)
+            self._fmax.append(fmax)
 
     def initial_state(self):
         x = self.initial_values(jax.random.PRNGKey(self.seed + 17))
@@ -83,15 +151,10 @@ class GdbaSolver(LocalSearchSolver):
         return (x, ws)
 
     def _effective(self, ws) -> List[jnp.ndarray]:
-        eff = []
-        for b, w in zip(self.tensors.buckets, ws):
-            if self.modifier == "A":
-                e = b.tensors + w
-            else:
-                e = b.tensors * w
-            # keep padding huge
-            eff.append(jnp.where(b.tensors >= PAD_COST / 2, PAD_COST, e))
-        return eff
+        return [
+            effective_tensor(b.tensors, w, self.modifier)
+            for b, w in zip(self.tensors.buckets, ws)
+        ]
 
     def cycle(self, state, key):
         x, ws = state
@@ -120,52 +183,13 @@ class GdbaSolver(LocalSearchSolver):
             vals = x[b.var_idx]  # [F, a]
             idx = tuple(vals[:, p] for p in range(a))
             base_cur = b.tensors[(jnp.arange(F),) + idx]  # [F]
-            if self.violation == "NZ":
-                viol = base_cur > 1e-9
-            elif self.violation == "NM":
-                viol = base_cur > self._fmin[bi] + 1e-9
-            else:  # MX
-                viol = base_cur >= self._fmax[bi] - 1e-9
-            viol = viol & (base_cur < PAD_COST / 2)
-            qlm_any = jnp.any(stuck[b.var_idx] & (
-                jnp.ones((F, a), dtype=bool)), axis=1)
+            viol = violation_mask(
+                base_cur, self._fmin[bi], self._fmax[bi], self.violation
+            )
+            qlm_any = jnp.any(stuck[b.var_idx], axis=1)
             do_inc = (viol & qlm_any).astype(jnp.float32)  # [F]
-
-            # build the increase mask over tensor entries
-            onehots = [
-                jax.nn.one_hot(vals[:, p], b.tensors.shape[1 + p]) for p in
-                range(a)
-            ]  # list of [F, D]
-
-            def _bcast(m, p):
-                shape = [F] + [1] * a
-                shape[1 + p] = b.tensors.shape[1 + p]
-                return m.reshape(shape)
-
-            if self.increase_mode == "E":
-                mask = jnp.ones_like(b.tensors)
-                for p in range(a):
-                    mask = mask * _bcast(onehots[p], p)
-            elif self.increase_mode == "R":
-                # entries reachable by deviating ONE variable: for each p,
-                # other axes fixed at current values
-                mask = jnp.zeros_like(b.tensors)
-                for p in range(a):
-                    m = jnp.ones_like(b.tensors)
-                    for q in range(a):
-                        if q != p:
-                            m = m * _bcast(onehots[q], q)
-                    mask = jnp.maximum(mask, m)
-            elif self.increase_mode == "C":
-                # entries keeping this factor's current values on ONE axis
-                mask = jnp.zeros_like(b.tensors)
-                for p in range(a):
-                    mask = jnp.maximum(mask, _bcast(onehots[p], p))
-            else:  # T: the whole tensor
-                mask = jnp.ones_like(b.tensors)
-
-            inc = mask * do_inc.reshape([F] + [1] * a)
-            ws2.append(w + inc)
+            mask = increase_mask(b.tensors, vals, self.increase_mode)
+            ws2.append(w + mask * do_inc.reshape([F] + [1] * a))
         return (x2, tuple(ws2))
 
 

@@ -50,6 +50,9 @@ def set_parser(subparsers):
     parser.add_argument("--dcop", default=None,
                         help="DCOP YAML (must be identical on all ranks)")
     parser.add_argument("--algo", default="maxsum")
+    parser.add_argument("--algo_params", action="append", default=None,
+                        help="repeated name:value algorithm parameters "
+                        "(e.g. gdba's modifier/violation/increase_mode)")
     parser.add_argument("--cycles", type=int, default=30)
     return parser
 
@@ -66,16 +69,18 @@ def run_multihost(args):
             {"status": "ERROR", "error": "--multihost needs --dcop"},
             args.output)
         return 1
-    if args.algo not in ("maxsum", "amaxsum"):
+    LS_RULES = ("mgm", "dsa", "dba", "gdba")
+    if args.algo not in ("maxsum", "amaxsum") + LS_RULES:
         output_metrics(
             {"status": "ERROR",
              "error": f"multihost mesh execution supports the factor-"
              f"graph BP family (maxsum/amaxsum) and the local-search "
-             f"family via 'pydcop_tpu solve', not {args.algo!r}"},
+             f"family ({', '.join(LS_RULES)}), not {args.algo!r}"},
             args.output)
         return 1
     from pydcop_tpu.parallel.multihost import (
         init_multihost,
+        run_multihost_local_search,
         run_multihost_maxsum,
     )
 
@@ -89,15 +94,25 @@ def run_multihost(args):
     t0 = time.time()
     from pydcop_tpu.algorithms import DEFAULT_INFINITY
 
-    # amaxsum: per-edge activation masks in the sharded engine (same
-    # emulation as AMaxSumSolver, decorrelated per shard)
-    activation = None
-    if args.algo == "amaxsum":
-        from pydcop_tpu.algorithms.amaxsum import DEFAULT_ACTIVATION
+    from pydcop_tpu.commands._utils import parse_algo_params
 
-        activation = DEFAULT_ACTIVATION
-    values, n_devices, tensors = run_multihost_maxsum(
-        dcop, cycles=args.cycles, activation=activation)
+    algo_params = parse_algo_params(getattr(args, "algo_params", None))
+    if args.algo in LS_RULES:
+        values, n_devices, tensors = run_multihost_local_search(
+            dcop, rule=args.algo, cycles=args.cycles,
+            algo_params=algo_params)
+    else:
+        # amaxsum: per-edge activation masks in the sharded engine (same
+        # emulation as AMaxSumSolver, decorrelated per shard)
+        activation = None
+        if args.algo == "amaxsum":
+            from pydcop_tpu.algorithms.amaxsum import DEFAULT_ACTIVATION
+
+            activation = float(
+                algo_params.get("activation", DEFAULT_ACTIVATION)
+            )
+        values, n_devices, tensors = run_multihost_maxsum(
+            dcop, cycles=args.cycles, activation=activation)
     assignment = tensors.assignment_from_indices(values)
     violation, cost = dcop.solution_cost(assignment, DEFAULT_INFINITY)
     output_metrics({

@@ -312,7 +312,8 @@ def pairs(flat):
 
 
 class ShardedLocalSearch:
-    """Local-search family over a device mesh (DSA / MGM move rules).
+    """Local-search family over a device mesh (MGM / DSA / DBA / GDBA
+    move rules).
 
     Constraints are sharded (same layout as ShardedMaxSum); the per-variable
     local cost tables are computed as per-shard partial sums combined with
@@ -320,41 +321,165 @@ class ShardedLocalSearch:
     identically on every device.  Equivalent distribution story to the
     reference's agents-on-machines (SURVEY.md §2.8), with the value
     broadcast replaced by the psum.
+
+    The breakout rules carry per-constraint weight state: weights live
+    WITH their sharded factor blocks (dba: [Fs] per bucket; gdba: full
+    per-entry tensors), so every weight update is shard-local — the one
+    psum of partial tables per cycle remains the only collective.
     """
 
     def __init__(self, tensors, mesh: Optional[Mesh] = None,
-                 rule: str = "mgm", probability: float = 0.7):
+                 rule: str = "mgm", probability: float = 0.7,
+                 algo_params: Optional[dict] = None):
         from pydcop_tpu.ops.compile import ConstraintGraphTensors
 
         assert isinstance(tensors, ConstraintGraphTensors), (
             "ShardedLocalSearch needs constraint-graph tensors"
         )
+        if rule not in ("mgm", "dsa", "dba", "gdba"):
+            raise ValueError(f"unknown sharded local-search rule {rule!r}")
         self.base = tensors
         self.mesh = mesh or build_mesh()
         self.n_shards = self.mesh.devices.size
         self.st = shard_factor_graph(tensors, self.n_shards)
         self.rule = rule
         self.probability = probability
+        self.params = dict(algo_params or {})
         self._run_n = None
 
-    def _tables_block(self, x, *bucket_blocks):
-        """Per-shard partial local-cost tables [V+1, D] (inside shard_map)."""
+    def _tables_block(self, x, bucket_blocks, tensor_blocks=None,
+                      weight_blocks=None):
+        """Per-shard partial local-cost tables [V+1, D] (inside
+        shard_map).  ``tensor_blocks`` substitutes per-bucket cost
+        tensors (gdba's effective tensors, dba's indicators);
+        ``weight_blocks`` scales each factor's rows (dba weights)."""
         st = self.st
         V, D = st.n_vars, st.max_domain_size
         partial = jnp.zeros((V + 1, D), dtype=jnp.float32)
-        for sb, (t_blk, vi_blk) in zip(st.buckets, bucket_blocks):
+        for bi, (sb, (t_blk, vi_blk)) in enumerate(
+                zip(st.buckets, bucket_blocks)):
             Fs, a = sb.factors_per_shard, sb.arity
+            T = t_blk if tensor_blocks is None else tensor_blocks[bi]
             x_ext = jnp.concatenate([x, jnp.zeros(1, dtype=x.dtype)])
             vals = x_ext[vi_blk]  # [Fs, a]
             fidx = jnp.arange(Fs)[:, None]
+            w = (
+                weight_blocks[bi][:, None]
+                if weight_blocks is not None else None
+            )
             for p in range(a):
                 idx = tuple(
                     jnp.arange(D)[None, :] if q == p else vals[:, q][:, None]
                     for q in range(a)
                 )
-                rows = t_blk[(fidx,) + idx]  # [Fs, D]
+                rows = T[(fidx,) + idx]  # [Fs, D]
+                if w is not None:
+                    rows = rows * w
                 partial = partial + segment_sum(rows, vi_blk[:, p], V + 1)
         return partial
+
+    # -- rule-specific sharded extras ---------------------------------------
+
+    def _static_extras(self):
+        """Per-bucket constant arrays the rule needs, sharded like the
+        factor tensors (dba: violation indicators; gdba: per-factor
+        masked base min/max for the NM/MX violation modes).  Built from
+        the single-device solvers' shared helpers — one source of
+        semantics."""
+        extras = []
+        if self.rule == "dba":
+            from pydcop_tpu.algorithms.dba import violation_indicator
+
+            for sb in self.st.buckets:
+                extras.append(violation_indicator(sb.tensors))
+        elif self.rule == "gdba":
+            from pydcop_tpu.algorithms.gdba import factor_min_max
+
+            for sb in self.st.buckets:
+                extras.extend(factor_min_max(sb.tensors, sb.arity))
+        return extras
+
+    def initial_aux(self):
+        """Initial sharded weight state (empty tuple for mgm/dsa)."""
+        shard0 = NamedSharding(self.mesh, P(AXIS))
+        if self.rule == "dba":
+            return tuple(
+                jax.device_put(
+                    jnp.ones((sb.factors_per_shard * self.n_shards,),
+                             jnp.float32), shard0)
+                for sb in self.st.buckets
+            )
+        if self.rule == "gdba":
+            init = 0.0 if self.params.get("modifier", "A") == "A" else 1.0
+            return tuple(
+                jax.device_put(
+                    jnp.full(sb.tensors.shape, init, jnp.float32), shard0)
+                for sb in self.st.buckets
+            )
+        return ()
+
+    def _quasi_local_minimum(self, gain):
+        """Replicated: stuck-neighborhood indicator per variable
+        (breakout trigger, same math as DbaSolver/GdbaSolver)."""
+        from pydcop_tpu.ops.segments import segment_max
+
+        base = self.base
+        V = base.n_vars
+        src, dst = base.neighbor_src, base.neighbor_dst
+        if src.shape[0] > 0:
+            neigh_max = jnp.maximum(segment_max(gain[src], dst, V), 0.0)
+        else:
+            neigh_max = jnp.zeros(V)
+        return jnp.maximum(gain, neigh_max) <= 1e-9
+
+    def _dba_update(self, x, qlm, aux, bucket_blocks, extras):
+        """Shard-local breakout weight bump (DbaSolver.cycle semantics);
+        qlm additionally requires violations remaining (cur > 0)."""
+        x_ext = jnp.concatenate([x, jnp.zeros(1, dtype=x.dtype)])
+        qlm_ext = jnp.concatenate([qlm, jnp.zeros(1, dtype=bool)])
+        aux2 = []
+        for (t_blk, vi_blk), ind_blk, w in zip(bucket_blocks, extras, aux):
+            Fs = vi_blk.shape[0]
+            vals = x_ext[vi_blk]
+            idx = tuple(vals[:, p] for p in range(vi_blk.shape[1]))
+            viol = ind_blk[(jnp.arange(Fs),) + idx] > 0.5
+            qlm_any = jnp.any(qlm_ext[vi_blk], axis=1)
+            aux2.append(w + (viol & qlm_any).astype(jnp.float32))
+        return tuple(aux2)
+
+    def _gdba_effective(self, aux, bucket_blocks):
+        from pydcop_tpu.algorithms.gdba import effective_tensor
+
+        modifier = self.params.get("modifier", "A")
+        return [
+            effective_tensor(t_blk, w, modifier)
+            for (t_blk, _vi), w in zip(bucket_blocks, aux)
+        ]
+
+    def _gdba_update(self, x, stuck, aux, bucket_blocks, extras):
+        """Shard-local per-entry weight increase (GdbaSolver.cycle
+        semantics via the shared violation_mask/increase_mask helpers)."""
+        from pydcop_tpu.algorithms.gdba import increase_mask, violation_mask
+
+        violation = self.params.get("violation", "NZ")
+        increase_mode = self.params.get("increase_mode", "E")
+        x_ext = jnp.concatenate([x, jnp.zeros(1, dtype=x.dtype)])
+        stuck_ext = jnp.concatenate([stuck, jnp.zeros(1, dtype=bool)])
+        aux2 = []
+        for bi, ((t_blk, vi_blk), w) in enumerate(zip(bucket_blocks, aux)):
+            fmin_blk, fmax_blk = extras[2 * bi], extras[2 * bi + 1]
+            Fs, a = vi_blk.shape
+            vals = x_ext[vi_blk]
+            idx = tuple(vals[:, p] for p in range(a))
+            base_cur = t_blk[(jnp.arange(Fs),) + idx]
+            viol = violation_mask(base_cur, fmin_blk, fmax_blk, violation)
+            qlm_any = jnp.any(stuck_ext[vi_blk], axis=1)
+            do_inc = (viol & qlm_any).astype(jnp.float32)
+            mask = increase_mask(t_blk, vals, increase_mode)
+            aux2.append(w + mask * do_inc.reshape([Fs] + [1] * a))
+        return tuple(aux2)
+
+    # -- assembly -----------------------------------------------------------
 
     def _build(self):
         from pydcop_tpu.algorithms._local_search import (
@@ -370,50 +495,77 @@ class ShardedLocalSearch:
         # spanning non-addressable devices) — same rule as ShardedMaxSum
         shard0 = NamedSharding(self.mesh, P(AXIS))
         bucket_args = []
-        in_specs = [P(), P()]  # x, key replicated
+        in_specs = [P(), P(), P(AXIS)]  # x, key, aux (pytree prefix)
         for sb in st.buckets:
             bucket_args.extend([
                 jax.device_put(sb.tensors, shard0),
                 jax.device_put(sb.var_idx, shard0),
             ])
             in_specs.extend([P(AXIS), P(AXIS)])
+        extras = [jax.device_put(e, shard0) for e in self._static_extras()]
+        in_specs.extend([P(AXIS)] * len(extras))
         self._bucket_args = bucket_args
+        self._extra_args = extras
+        n_buckets = len(st.buckets)
 
-        def cycle_fn(x, key, *buckets):
-            partial = self._tables_block(x, *pairs(buckets))
+        def cycle_fn(x, key, aux, *rest):
+            bucket_blocks = pairs(rest[: 2 * n_buckets])
+            extra_blocks = rest[2 * n_buckets:]
+            tensor_blocks = weight_blocks = None
+            include_unary = True
+            if self.rule == "dba":
+                tensor_blocks, weight_blocks = extra_blocks, aux
+                include_unary = False
+            elif self.rule == "gdba":
+                tensor_blocks = self._gdba_effective(aux, bucket_blocks)
+            partial = self._tables_block(
+                x, bucket_blocks, tensor_blocks, weight_blocks
+            )
             total = jax.lax.psum(partial, AXIS)
+            unary = base.unary_costs if include_unary else 0.0
             tables = jnp.where(
                 base.domain_mask > 0,
-                base.unary_costs + total[: st.n_vars],
+                unary + total[: st.n_vars],
                 PAD_COST,
             )
             cur, best_val, gain, _ = gains_and_best(
                 base, x, tables=tables,
                 prefer_change=(self.rule == "dsa"),
             )
-            if self.rule == "mgm":
-                move = neighborhood_winner(base, gain)
-            else:  # dsa-B style
+            if self.rule == "dsa":
                 activate = (
                     jax.random.uniform(key, (st.n_vars,)) < self.probability
                 )
                 move = (gain > 1e-9) & activate
-            return jnp.where(move, best_val, x).astype(jnp.int32)
+            else:  # mgm-style arbitration (also dba/gdba)
+                move = neighborhood_winner(base, gain)
+            x2 = jnp.where(move, best_val, x).astype(jnp.int32)
+            if self.rule == "dba":
+                qlm = self._quasi_local_minimum(gain) & (cur > 1e-9)
+                aux = self._dba_update(x, qlm, aux, bucket_blocks,
+                                       extra_blocks)
+            elif self.rule == "gdba":
+                stuck = self._quasi_local_minimum(gain)
+                aux = self._gdba_update(x, stuck, aux, bucket_blocks,
+                                        extra_blocks)
+            return x2, aux
 
         sharded = jax.shard_map(
             cycle_fn,
             mesh=self.mesh,
             in_specs=tuple(in_specs),
-            out_specs=P(),
+            out_specs=(P(), P(AXIS)),
             check_vma=False,
         )
 
-        def run_n(x, keys, *buckets):
-            def body(x, k):
-                return sharded(x, k, *buckets), ()
+        def run_n(x, keys, aux, *rest):
+            def body(carry, k):
+                x, aux = carry
+                x2, aux2 = sharded(x, k, aux, *rest)
+                return (x2, aux2), ()
 
-            x, _ = jax.lax.scan(body, x, keys)
-            return x
+            (x, aux), _ = jax.lax.scan(body, (x, aux), keys)
+            return x, aux
 
         self._run_n = jax.jit(run_n)
 
@@ -425,4 +577,8 @@ class ShardedLocalSearch:
 
         x0 = random_valid_values(self.base, jax.random.PRNGKey(seed + 17))
         keys = jax.random.split(jax.random.PRNGKey(seed), cycles)
-        return np.asarray(self._run_n(x0, keys, *self._bucket_args))
+        x, _aux = self._run_n(
+            x0, keys, self.initial_aux(), *self._bucket_args,
+            *self._extra_args,
+        )
+        return np.asarray(x)

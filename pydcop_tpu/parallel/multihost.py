@@ -92,6 +92,30 @@ def run_multihost_maxsum(dcop, cycles: int = 15, damping: float = 0.5,
     return values, mesh.devices.size, tensors
 
 
+def run_multihost_local_search(dcop, rule: str = "mgm", cycles: int = 15,
+                               seed: int = 0,
+                               algo_params: Optional[dict] = None):
+    """Solve `dcop` with a local-search rule (mgm / dsa / dba / gdba)
+    sharded over the global multi-process mesh.  Returns
+    (values, n_global_devices, tensors).  SPMD: identical dcop on every
+    process; the breakout rules' weight state is shard-local, so the one
+    psum of partial cost tables per cycle is the only cross-process
+    traffic."""
+    from pydcop_tpu.ops.compile import compile_constraint_graph
+    from pydcop_tpu.parallel.mesh import ShardedLocalSearch
+
+    tensors = compile_constraint_graph(dcop)
+    mesh = global_mesh()
+    params = dict(algo_params or {})
+    sharded = ShardedLocalSearch(
+        tensors, mesh, rule=rule,
+        probability=float(params.get("probability", 0.7)),
+        algo_params=params,
+    )
+    values = sharded.run(cycles=cycles, seed=seed)
+    return values, mesh.devices.size, tensors
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--coordinator", default="127.0.0.1:29517")
@@ -103,6 +127,9 @@ def main(argv=None) -> int:
     ap.add_argument("--platform", default="",
                     help="default: autodetect (real TPU hosts); pass "
                     "'cpu' for testing")
+    ap.add_argument("--algo", default="maxsum",
+                    choices=["maxsum", "amaxsum", "mgm", "dsa", "dba",
+                             "gdba"])
     ap.add_argument("--vars", type=int, default=60)
     ap.add_argument("--edges", type=int, default=120)
     ap.add_argument("--cycles", type=int, default=15)
@@ -120,8 +147,17 @@ def main(argv=None) -> int:
         n_variables=args.vars, n_colors=3, n_edges=args.edges,
         soft=True, n_agents=1, seed=args.seed,
     )
-    values, n_devices, _tensors = run_multihost_maxsum(
-        dcop, cycles=args.cycles)
+    if args.algo in ("maxsum", "amaxsum"):
+        activation = None
+        if args.algo == "amaxsum":
+            from pydcop_tpu.algorithms.amaxsum import DEFAULT_ACTIVATION
+
+            activation = DEFAULT_ACTIVATION
+        values, n_devices, _tensors = run_multihost_maxsum(
+            dcop, cycles=args.cycles, activation=activation)
+    else:
+        values, n_devices, _tensors = run_multihost_local_search(
+            dcop, rule=args.algo, cycles=args.cycles)
     import numpy as np
 
     print(json.dumps({

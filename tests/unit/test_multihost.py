@@ -143,3 +143,53 @@ def test_agent_multihost_rejects_missing_args():
     )
     assert out.returncode != 0
     assert "num-processes" in out.stdout or "num-processes" in out.stderr
+
+
+def test_two_process_mesh_dba():
+    """The breakout family rides the multi-process mesh too: 2 real
+    processes x 4 virtual devices run sharded DBA (shard-local weight
+    state) and must agree with each other and with the single-process
+    8-device mesh."""
+
+    def worker(pid, port):
+        env = {
+            **os.environ,
+            "PYTHONPATH": REPO,
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        }
+        return subprocess.Popen(
+            [sys.executable, "-m", "pydcop_tpu.parallel.multihost",
+             "--coordinator", f"127.0.0.1:{port}",
+             "--num-processes", "2", "--process-id", str(pid),
+             "--local-devices", "4", "--platform", "cpu",
+             "--algo", "dba",
+             "--vars", "40", "--edges", "80", "--cycles", "10"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=REPO,
+        )
+
+    port = free_port()
+    outs = []
+    with reaped([worker(0, port), worker(1, port)]) as procs:
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=240)
+            assert p.returncode == 0, stderr[-1500:]
+            outs.append(json.loads(stdout.strip().splitlines()[-1]))
+
+    assert all(o["n_global_devices"] == 8 for o in outs), outs
+    assert outs[0]["values_checksum"] == outs[1]["values_checksum"]
+
+    import numpy as np
+
+    from pydcop_tpu.generators import generate_graph_coloring
+    from pydcop_tpu.ops.compile import compile_constraint_graph
+    from pydcop_tpu.parallel.mesh import ShardedLocalSearch, build_mesh
+
+    dcop = generate_graph_coloring(
+        n_variables=40, n_colors=3, n_edges=80, soft=True, n_agents=1,
+        seed=1,
+    )
+    tensors = compile_constraint_graph(dcop)
+    sharded = ShardedLocalSearch(tensors, build_mesh(8), rule="dba")
+    values = sharded.run(cycles=10, seed=0)
+    assert int(np.asarray(values).sum()) == outs[0]["values_checksum"]

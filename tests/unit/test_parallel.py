@@ -172,3 +172,64 @@ class TestShardedAMaxSum:
         np.testing.assert_allclose(np.asarray(q1), np.asarray(qb))
         # ...but chunk 2 continues the stream instead of replaying it
         assert not np.allclose(np.asarray(q1), np.asarray(q2))
+
+
+class TestShardedBreakout:
+    """dba/gdba sharded twins ≡ single-device solvers (deterministic
+    given x0: MGM-style arbitration, integer costs)."""
+
+    def _dcop(self, seed=13):
+        from pydcop_tpu.generators import generate_graph_coloring
+
+        return generate_graph_coloring(
+            n_variables=24, n_colors=3, n_edges=50, soft=True,
+            n_agents=1, seed=seed,
+        )
+
+    def test_sharded_dba_matches_single_device(self):
+        from pydcop_tpu.algorithms import AlgorithmDef
+        from pydcop_tpu.algorithms.dba import DbaSolver
+        from pydcop_tpu.ops.compile import compile_constraint_graph
+        from pydcop_tpu.parallel.mesh import ShardedLocalSearch
+
+        dcop = self._dcop()
+        tensors = compile_constraint_graph(dcop)
+        solver = DbaSolver(
+            dcop, tensors, AlgorithmDef.build_with_default_params("dba"),
+            seed=0,
+        )
+        state = solver.initial_state()
+        for i in range(12):
+            state = solver.cycle(state, jax.random.PRNGKey(i))
+        expected = np.asarray(state[0])
+
+        sharded = ShardedLocalSearch(tensors, build_mesh(4), rule="dba")
+        got = sharded.run(cycles=12, seed=0)
+        np.testing.assert_array_equal(got, expected)
+
+    @pytest.mark.parametrize("params", [
+        {"modifier": "A", "violation": "NZ", "increase_mode": "E"},
+        {"modifier": "M", "violation": "NM", "increase_mode": "R"},
+    ])
+    def test_sharded_gdba_matches_single_device(self, params):
+        from pydcop_tpu.algorithms import AlgorithmDef
+        from pydcop_tpu.algorithms.gdba import GdbaSolver
+        from pydcop_tpu.ops.compile import compile_constraint_graph
+        from pydcop_tpu.parallel.mesh import ShardedLocalSearch
+
+        dcop = self._dcop(seed=29)
+        tensors = compile_constraint_graph(dcop)
+        solver = GdbaSolver(
+            dcop, tensors,
+            AlgorithmDef.build_with_default_params("gdba", params), seed=0,
+        )
+        state = solver.initial_state()
+        for i in range(10):
+            state = solver.cycle(state, jax.random.PRNGKey(i))
+        expected = np.asarray(state[0])
+
+        sharded = ShardedLocalSearch(
+            tensors, build_mesh(4), rule="gdba", algo_params=params,
+        )
+        got = sharded.run(cycles=10, seed=0)
+        np.testing.assert_array_equal(got, expected)
