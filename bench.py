@@ -386,9 +386,10 @@ def bench_convergence_stretch(args):
         def body(carry, _):
             q, r, msg_stable = carry
             q2, r2, _, _ = maxsum_cycle(tensors, q, r, damping=damping)
-            # reference approx_match: relative diff within 10%
-            rel = jnp.abs(r2 - r) / (jnp.abs(r) + 1e-6)
-            all_stable = jnp.all(rel <= STABILITY_COEFF)
+            # reference approx_match (maxsum.py:620-639), shared impl
+            from pydcop_tpu.algorithms.maxsum import messages_stable
+
+            all_stable = jnp.all(messages_stable(r, r2, STABILITY_COEFF))
             msg_stable = jnp.where(all_stable, msg_stable + 1, 0)
             return (q2, r2, msg_stable), ()
 

@@ -95,6 +95,16 @@ class SynchronousTensorSolver:
         """Current value indices [V] for a state."""
         raise NotImplementedError
 
+    def chunk_converged(self, prev_state: Any, state: Any) -> bool:
+        """Did the solver reach a fixed point between two chunk
+        boundaries?  Default: the assignment did not change.  Solvers
+        with richer state may widen this (MaxSumSolver adds the
+        reference's message-stability test)."""
+        return bool(np.array_equal(
+            np.asarray(self.values_of(prev_state)),
+            np.asarray(self.values_of(state)),
+        ))
+
     # -- harness ------------------------------------------------------------
 
     def _chunk_runner(self, n: int, collect: bool = True):
@@ -175,7 +185,7 @@ class SynchronousTensorSolver:
         )
         done = 0
         history: List[Dict[str, Any]] = []
-        prev_vals: Optional[np.ndarray] = None
+        prev_state: Any = None
         stable = 0
         status = "FINISHED"
 
@@ -200,14 +210,15 @@ class SynchronousTensorSolver:
                         }
                     )
             if target is None:
-                last = np.asarray(self.values_of(state))
-                if prev_vals is not None and np.array_equal(last, prev_vals):
+                if prev_state is not None and self.chunk_converged(
+                    prev_state, state
+                ):
                     stable += 1
                     if stable >= stable_chunks:
                         break
                 else:
                     stable = 0
-                prev_vals = last
+                prev_state = state
             if timeout is not None and perf_counter() - t0 > timeout:
                 status = "TIMEOUT"
                 break

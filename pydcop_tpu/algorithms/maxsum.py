@@ -43,6 +43,18 @@ algo_params = [
 ]
 
 
+def messages_stable(r_prev: jnp.ndarray, r_cur: jnp.ndarray,
+                    stability: float) -> jnp.ndarray:
+    """Elementwise reference approx_match (maxsum.py:620-639): equal
+    values match; otherwise the symmetric relative difference
+    ``2|a-b| / |a+b|`` must be below the coefficient (written as a
+    multiplication so a zero denominator needs no special-casing —
+    ``a+b == 0`` with ``a != b`` correctly fails)."""
+    delta = jnp.abs(r_cur - r_prev)
+    denom = jnp.abs(r_cur + r_prev)
+    return (delta == 0) | (2 * delta < stability * denom)
+
+
 class MaxSumSolver(SynchronousTensorSolver):
     """State = (q var→factor msgs, r factor→var msgs, values [V]).
 
@@ -59,6 +71,10 @@ class MaxSumSolver(SynchronousTensorSolver):
     def __init__(self, dcop, tensors, algo_def, seed=0, use_packed=None):
         super().__init__(dcop, tensors, algo_def, seed)
         self.damping = float(self.params.get("damping", 0.5))
+        # message-stability convergence coefficient (the reference's
+        # approx_match STABILITY_COEFF, maxsum.py:98): messages within
+        # this relative change between chunk boundaries count as stable
+        self.stability = float(self.params.get("stability", 0.1))
         # Symmetry breaking: without per-value cost differences BP beliefs
         # stay perfectly symmetric and every variable argmins to the same
         # index.  The reference injects VariableNoisyCostFunc noise into
@@ -119,6 +135,19 @@ class MaxSumSolver(SynchronousTensorSolver):
 
     def values_of(self, state):
         return state[2]
+
+    def chunk_converged(self, prev_state, state):
+        """Assignment unchanged OR all factor→variable messages stable
+        within the ``stability`` coefficient — the reference's own
+        convergence test (approx_match: symmetric relative difference
+        ``2|a-b|/|a+b| < coeff``, equal values always match,
+        maxsum.py:98-100,620-639), applied at chunk boundaries (several
+        cycles apart, i.e. at least as strict per check)."""
+        if super().chunk_converged(prev_state, state):
+            return True
+        return bool(jnp.all(
+            messages_stable(prev_state[1], state[1], self.stability)
+        ))
 
     def _chunk_runner(self, n, collect: bool = True):
         """Packed-engine fast path: when per-cycle metrics are not

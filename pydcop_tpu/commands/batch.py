@@ -3,7 +3,19 @@
 Equivalent capability to the reference's pydcop/commands/batch.py
 (:117-357): problem *sets* (file lists + iterations) × *batches* (a command
 template + cross-product of option values), each run as a subprocess of
-this CLI; simple resume (skip runs whose output file already exists).
+this CLI.
+
+Resume protocol (reference parity, batch.py:56-142): every job that ran
+without error is registered as a ``JID:`` line in a
+``progress_<batch_file>`` state file inside the output directory; on
+startup, registered jobs are skipped, so an interrupted sweep (crash,
+kill -9, shared-TPU preemption) resumes exactly where it stopped —
+completion is recorded per JOB, not inferred from output files, so a
+truncated output from a killed run is re-run rather than trusted.  When
+the whole batch completes, the file is renamed
+``done_<batch_file>_<date>`` (delete the progress file to re-run from
+scratch).  The total job count (sets × files × iterations ×
+combinations) is estimated up front (reference batch.py:159-169).
 
 Batch definition format:
 
@@ -24,9 +36,11 @@ batches:
 """
 from __future__ import annotations
 
+import datetime
 import glob
 import itertools
 import os
+import shutil
 import subprocess
 import sys
 from typing import Any, Dict, List
@@ -59,15 +73,11 @@ def _opt_to_cli(name: str, value) -> List[str]:
     return [f"--{name}", str(value)]
 
 
-def run_cmd(args):
-    with open(args.batch_file, encoding="utf-8") as f:
-        definition = yaml.safe_load(f)
-
+def _iter_jobs(definition, output_dir):
+    """Yield (jid, out_path, cmd) for every job of the sweep, in a
+    deterministic order (jid doubles as the output file stem)."""
     sets = definition.get("sets", {"default": {"path": []}})
     batches = definition.get("batches", {})
-    os.makedirs(args.output_dir, exist_ok=True)
-
-    n_run, n_skipped = 0, 0
     for set_name, set_def in sets.items():
         paths = set_def.get("path", [])
         if isinstance(paths, str):
@@ -83,7 +93,7 @@ def run_cmd(args):
             ):
                 for it in range(iterations):
                     for fn in files or [None]:
-                        out_name = "_".join(
+                        jid = "_".join(
                             str(x)
                             for x in [
                                 set_name, batch_name,
@@ -91,11 +101,8 @@ def run_cmd(args):
                                 *(f"{k}{v}" for k, v in combo.items()),
                                 f"it{it}",
                             ]
-                        ).replace("/", "-").replace(":", "") + ".json"
-                        out_path = os.path.join(args.output_dir, out_name)
-                        if os.path.exists(out_path):
-                            n_skipped += 1
-                            continue
+                        ).replace("/", "-").replace(":", "")
+                        out_path = os.path.join(output_dir, jid + ".json")
                         cmd = [sys.executable, "-m", "pydcop_tpu",
                                "--output", out_path]
                         for k, v in (
@@ -109,12 +116,72 @@ def run_cmd(args):
                             cmd.extend(_opt_to_cli("seed", it))
                         if fn:
                             cmd.append(fn)
-                        if args.simulate:
-                            print(" ".join(cmd))
-                            continue
-                        subprocess.run(cmd, check=False,
-                                       capture_output=True)
-                        n_run += 1
-    print(f"batch: ran {n_run}, skipped {n_skipped} "
+                        yield jid, out_path, cmd
+
+
+def estimate_jobs(definition) -> int:
+    """Upfront job count: sets × files × iterations × combinations
+    (reference batch.py:159-169)."""
+    return sum(1 for _ in _iter_jobs(definition, ""))
+
+
+def _load_progress(progress_path: str) -> set:
+    if not os.path.exists(progress_path):
+        return set()
+    with open(progress_path, encoding="utf-8") as f:
+        return {
+            line[5:].strip() for line in f if line.startswith("JID: ")
+        }
+
+
+def run_cmd(args):
+    with open(args.batch_file, encoding="utf-8") as f:
+        definition = yaml.safe_load(f)
+
+    os.makedirs(args.output_dir, exist_ok=True)
+
+    batch_stem = os.path.splitext(os.path.basename(args.batch_file))[0]
+    progress_path = os.path.join(args.output_dir, f"progress_{batch_stem}")
+    done_jobs = _load_progress(progress_path)
+
+    total = estimate_jobs(definition)
+    print(f"batch: {total} jobs total, {len(done_jobs)} already done "
+          f"(progress file: {progress_path})")
+
+    n_run = n_skipped = n_failed = 0
+    if not args.simulate and not os.path.exists(progress_path):
+        with open(progress_path, "a", encoding="utf-8") as f:
+            f.write(f"{batch_stem}_{datetime.datetime.now():%Y%m%d_%H%M}\n")
+
+    for jid, out_path, cmd in _iter_jobs(definition, args.output_dir):
+        if jid in done_jobs:
+            n_skipped += 1
+            continue
+        if args.simulate:
+            print(" ".join(cmd))
+            continue
+        res = subprocess.run(cmd, check=False, capture_output=True)
+        if res.returncode == 0:
+            n_run += 1
+            # append + flush per job: a kill -9 at any point loses at
+            # most the in-flight job, never a completed one
+            with open(progress_path, "a", encoding="utf-8") as f:
+                f.write(f"JID: {jid}\n")
+                f.flush()
+                os.fsync(f.fileno())
+        else:
+            n_failed += 1
+            tail = (res.stderr or b"")[-500:].decode(errors="replace")
+            print(f"batch: job {jid} failed (rc={res.returncode}): {tail}",
+                  file=sys.stderr)
+
+    if not args.simulate and n_failed == 0:
+        # everything ran: the progress file becomes a completion record
+        done_path = os.path.join(
+            args.output_dir,
+            f"done_{batch_stem}_{datetime.datetime.now():%Y%m%d_%H%M}",
+        )
+        shutil.move(progress_path, done_path)
+    print(f"batch: ran {n_run}, skipped {n_skipped}, failed {n_failed} "
           f"(outputs in {args.output_dir})")
-    return 0
+    return 0 if n_failed == 0 else 1

@@ -69,6 +69,17 @@ def run_cmd(args):
         return 1
     algo_params = parse_algo_params(args.algo_params)
 
+    if args.mode == "process":
+        # no silent no-op: a reference user benchmarking thread vs
+        # process would otherwise get identical numbers unexplained
+        print(
+            "note: --mode process runs the same single-process tensor "
+            "engine as thread mode (one process IS the whole agent "
+            "population); for true multi-process execution use "
+            "'pydcop_tpu agent --multihost'",
+            file=sys.stderr,
+        )
+
     distribution = args.distribution
     if distribution and (distribution.endswith(".yaml") or
                          distribution.endswith(".yml")):

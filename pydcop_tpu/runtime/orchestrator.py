@@ -83,6 +83,8 @@ class VirtualOrchestrator:
         self._last_result: Optional[SolveResult] = None
         self._cycles_done = 0
         self.start_time: Optional[float] = None
+        #: measured device rate (cycles/s) for scenario delay budgets
+        self._cycle_rate: Optional[float] = None
 
     # -- lifecycle (reference: deploy/run/pause/stop broadcasts) ------------
 
@@ -196,18 +198,21 @@ class VirtualOrchestrator:
             self.status = res.status
             return self._finalize(res)
         res: Optional[SolveResult] = None
-        phase_cycles = cycles or 20
         for event in scenario:
             if timeout is not None and \
                     perf_counter() - self.start_time > timeout:
                 break
             if event.is_delay:
-                # a delay = let the system run; wall-clock delays map to a
-                # bounded solving phase (device rounds are much faster than
-                # the reference's actor cycles)
-                res = self._run_phase(
-                    phase_cycles, timeout=event.delay, resume=resume
-                )
+                # a delay = let the system run for that much wall time.
+                # Scenario delays are written in seconds of solver
+                # activity (reference: the actor system simply keeps
+                # running, orchestrator.py:336); here the device rate is
+                # measured on the first phase and each delay converts to
+                # a cycle budget, so `delay: 2` runs ~2s worth of cycles
+                # instead of an arbitrary fixed count.  event.delay also
+                # bounds the phase as a timeout (safety when the rate
+                # estimate is stale).
+                res = self._delay_phase(event.delay, cycles, resume)
                 resume = True
             else:
                 for action in event.actions:
@@ -216,10 +221,68 @@ class VirtualOrchestrator:
                     {"id": event.id,
                      "actions": [a.type for a in event.actions]}
                 )
-        # final phase to (re)converge after the last event
-        res = self._run_phase(phase_cycles, timeout=None, resume=resume)
+        # final phase to (re)converge after the last event: the explicit
+        # per-phase cycle count unbounded (caller's contract), else the
+        # budget of a 1-second delay
+        if cycles is not None:
+            res = self._run_phase(cycles, timeout=None, resume=resume)
+        else:
+            res = self._delay_phase(1.0, None, resume)
         self.status = res.status
         return self._finalize(res)
+
+    #: cycles of the rate-calibration phase (first delay event) and the
+    #: upper bound on any single delay phase's budget
+    CALIBRATION_CYCLES = 20
+    MAX_PHASE_CYCLES = 200_000
+
+    def _delay_phase(self, delay: float, cycles: Optional[int],
+                     resume: bool) -> SolveResult:
+        """One scenario solving phase worth ``delay`` seconds.
+
+        With an explicit per-phase ``cycles`` the caller's count wins
+        (back-compat / deterministic tests), bounded by the delay.
+        Otherwise the first phase runs CALIBRATION_CYCLES to measure the
+        device rate, then every delay converts to ``delay * rate``
+        cycles; the rate is refreshed from each phase so drift (bigger
+        tables after repair, metric collection) is tracked.
+        """
+        if cycles is not None:
+            return self._run_phase(cycles, timeout=delay, resume=resume)
+        if self._cycle_rate is not None:
+            res = self._run_phase(
+                self._budget(delay), timeout=delay, resume=resume
+            )
+            self._update_rate(res)
+            return res
+        # cold start: the calibration phase's wall time includes jit
+        # compilation, so its rate wildly underestimates the device.
+        # Top up against the REMAINING wall budget of this delay (so one
+        # event never runs ~2x its duration) until it is consumed; the
+        # warm top-up rates replace the compile-skewed first estimate.
+        t0 = perf_counter()
+        res = self._run_phase(
+            self.CALIBRATION_CYCLES, timeout=delay, resume=resume
+        )
+        self._update_rate(res)
+        for _ in range(4):
+            remaining = delay - (perf_counter() - t0)
+            if remaining <= max(0.05 * delay, 1e-3):
+                break
+            res = self._run_phase(
+                self._budget(remaining), timeout=remaining, resume=True
+            )
+            self._update_rate(res)
+        return res
+
+    def _budget(self, delay: float) -> int:
+        return max(1, min(
+            self.MAX_PHASE_CYCLES, int(round(delay * self._cycle_rate))
+        ))
+
+    def _update_rate(self, res: SolveResult) -> None:
+        if res.cycle > 0 and res.time > 0:
+            self._cycle_rate = res.cycle / res.time
 
     def _finalize(self, res: SolveResult) -> SolveResult:
         res.cycle = self._cycles_done

@@ -229,3 +229,92 @@ batches:
             lines = f.read().strip().splitlines()
         assert len(lines) == 3  # header + 2 rows
         assert "cost" in lines[0]
+
+
+class TestBatchResume:
+    def _batch_def(self, tmp_path, n_algos=3):
+        algos = ["dpop", "syncbb", "ncbb"][:n_algos]
+        batch_def = tmp_path / "resume.yaml"
+        batch_def.write_text(
+            f"""
+sets:
+  s1:
+    path: ["{TUTO}"]
+    iterations: 1
+batches:
+  sweep:
+    command: solve
+    command_options:
+      algo: {algos}
+    global_options:
+      timeout: 30
+"""
+        )
+        return batch_def
+
+    def _progress_lines(self, out_dir):
+        path = os.path.join(out_dir, "progress_resume")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return [ln for ln in f.read().splitlines()
+                    if ln.startswith("JID: ")]
+
+    def test_kill_then_resume_runs_each_job_exactly_once(self, tmp_path):
+        """Reference progress-file protocol (batch.py:56-142): kill -9
+        mid-batch, rerun, no job lost or duplicated."""
+        import time
+
+        batch_def = self._batch_def(tmp_path)
+        out_dir = str(tmp_path / "out")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "pydcop_tpu", "batch", str(batch_def),
+             "--output_dir", out_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=ENV, cwd=REPO,
+        )
+        # wait until exactly one job is registered, then kill -9
+        deadline = time.time() + 120
+        try:
+            while time.time() < deadline:
+                lines = self._progress_lines(out_dir)
+                if lines and len(lines) >= 1:
+                    break
+                time.sleep(0.2)
+            else:
+                raise AssertionError("no job registered before deadline")
+        finally:
+            proc.kill()
+            proc.wait(timeout=30)
+
+        done_before = self._progress_lines(out_dir)
+        assert done_before, "progress file must survive the kill"
+
+        # resume: remaining jobs run, registered ones are skipped
+        proc2 = run_cli("batch", str(batch_def), "--output_dir", out_dir,
+                        timeout=240)
+        assert proc2.returncode == 0, proc2.stderr[-800:]
+        assert f"{len(done_before)} already done" in proc2.stdout
+        assert "3 jobs total" in proc2.stdout
+
+        # all three outputs exist, none was re-run (skip count matches)
+        import glob as _glob
+
+        results = _glob.glob(os.path.join(out_dir, "*.json"))
+        assert len(results) == 3
+        assert f"skipped {len(done_before)}" in proc2.stdout
+
+        # completion renames progress_ -> done_<stem>_<date>
+        assert self._progress_lines(out_dir) is None
+        done_files = _glob.glob(os.path.join(out_dir, "done_resume_*"))
+        assert len(done_files) == 1
+
+    def test_simulate_estimates_without_running(self, tmp_path):
+        batch_def = self._batch_def(tmp_path, n_algos=2)
+        out_dir = str(tmp_path / "sim")
+        proc = run_cli("batch", str(batch_def), "--output_dir", out_dir,
+                       "--simulate")
+        assert proc.returncode == 0
+        assert "2 jobs total" in proc.stdout
+        # no progress file is created in simulate mode
+        assert self._progress_lines(out_dir) is None

@@ -308,3 +308,41 @@ class TestLifecycleEdgeCases:
         assert not np.array_equal(
             np.asarray(s2._last_key), np.asarray(s1._last_key)
         )
+
+
+class TestRateDerivedPhases:
+    """Scenario delays are seconds of solver activity: the device rate is
+    calibrated on the first phase and each delay converts to a
+    proportional cycle budget (VERDICT r2 item 7)."""
+
+    def _run(self, tuto, delays):
+        orch = VirtualOrchestrator(tuto, "dsa", distribution="adhoc")
+        orch.deploy_computations()
+        events = [
+            DcopEvent(f"d{i}", delay=d) for i, d in enumerate(delays)
+        ]
+        orch.run(Scenario(events), timeout=60)
+        return orch
+
+    def test_delay_converts_to_proportional_cycles(self, tuto):
+        short = self._run(tuto, [0.4])
+        long = self._run(tuto, [1.2])
+        assert short._cycle_rate is not None
+        # final convergence phases are both ~1s worth; the delay phases
+        # differ 3x, so total cycles must clearly increase with delay
+        ratio = long._cycles_done / max(1, short._cycles_done)
+        assert ratio > 1.3, (
+            short._cycles_done, long._cycles_done, short._cycle_rate,
+        )
+
+    def test_explicit_cycles_still_win(self, tuto):
+        orch = VirtualOrchestrator(tuto, "dsa", distribution="adhoc")
+        orch.deploy_computations()
+        scenario = Scenario([DcopEvent("d1", delay=5.0)])
+        res = orch.run(scenario, cycles=7, timeout=60)
+        # 7 for the delay phase + 7 for the final phase, not 5s worth
+        assert res.cycle == 14
+
+    def test_rate_is_refreshed_across_phases(self, tuto):
+        orch = self._run(tuto, [0.3, 0.3])
+        assert orch._cycle_rate is not None and orch._cycle_rate > 0
