@@ -396,9 +396,13 @@ def bench_convergence_stretch(args):
         (q, r, msg_stable), _ = jax.lax.scan(
             body, (q, r, msg_stable_in), None, length=chunk
         )
-        _, _, beliefs, values = maxsum_cycle(tensors, q, r, damping=damping)
+        _, r_next, beliefs, values = maxsum_cycle(
+            tensors, q, r, damping=damping)
+        from pydcop_tpu.algorithms.maxsum import messages_stable
+
+        unstable = jnp.sum(~messages_stable(r, r_next, STABILITY_COEFF))
         changed = jnp.sum(values != prev_vals)
-        return q, r, values, changed, msg_stable, total_cost(
+        return q, r, values, changed, msg_stable, unstable, total_cost(
             tensors, values)
 
     q, r = init_messages(tensors)
@@ -416,8 +420,9 @@ def bench_convergence_stretch(args):
     best_cost = float("inf")
     plateau = 0
     final_cost = None
+    unstable = None
     for _ in range(args.stretch_max_cycles // chunk):
-        q, r, prev_vals, changed, msg_stable, cost = run_chunk(
+        q, r, prev_vals, changed, msg_stable, unstable, cost = run_chunk(
             q, r, prev_vals, msg_stable
         )
         cycles_run += chunk
@@ -438,15 +443,31 @@ def bench_convergence_stretch(args):
             plateau = 0
         best_cost = min(best_cost, final_cost)
     wall = time.perf_counter() - t0
-    return {
+    out = {
         "stretch_vars": V,
         "stretch_edges": E,
         "stretch_wall_s": round(wall, 3),
         "stretch_converged": converged is not None,
         "stretch_criterion": converged,
         "stretch_cycles": cycles_run,
-        "stretch_final_cost": round(final_cost, 1),
+        "stretch_final_cost": (
+            round(final_cost, 1) if final_cost is not None else None
+        ),
     }
+    if converged != "messages" and unstable is not None:
+        # documented negative result (VERDICT r2 item 10): on this
+        # frustrated random instance a fraction of messages keeps
+        # oscillating under ANY damping (measured: ~74% at 0.5, ~20% at
+        # 0.9, ~4% plateau at 0.98 — the approx_match criterion is
+        # scale-invariant, so damping cannot force it), hence the
+        # reference's own message criterion never fires and the honest
+        # convergence signal is the cost plateau.  See
+        # docs/performance.rst.
+        out["stretch_msg_unstable_frac"] = round(
+            float(unstable) / (tensors.n_edges * tensors.max_domain_size),
+            4,
+        )
+    return out
 
 
 def bench_sharded_subprocess(args):

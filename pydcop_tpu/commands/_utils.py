@@ -60,3 +60,20 @@ def add_csvline(csv_file: str, collect_on: str, metrics: Dict) -> None:
         f.write(
             ",".join(str(metrics.get(c, "")) for c in CSV_COLUMNS) + "\n"
         )
+
+
+def warn_process_mode(mode: str) -> None:
+    """One-line stderr notice when --mode process is requested: both
+    modes run the single-process tensor engine, and a silent no-op would
+    read as identical thread-vs-process benchmark numbers with no
+    explanation."""
+    import sys
+
+    if mode == "process":
+        print(
+            "note: --mode process runs the same single-process tensor "
+            "engine as thread mode (one process IS the whole agent "
+            "population); for true multi-process execution use "
+            "'pydcop_tpu agent --multihost'",
+            file=sys.stderr,
+        )

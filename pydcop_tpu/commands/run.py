@@ -10,6 +10,7 @@ from pydcop_tpu.commands._utils import (
     add_csvline,
     output_metrics,
     parse_algo_params,
+    warn_process_mode,
 )
 
 
@@ -45,6 +46,7 @@ def run_cmd(args):
     dcop = load_dcop_from_file(args.dcop_files)
     scenario = load_scenario_from_file(args.scenario)
     algo_params = parse_algo_params(args.algo_params)
+    warn_process_mode(args.mode)
 
     from pydcop_tpu.algorithms import AlgorithmDef
 

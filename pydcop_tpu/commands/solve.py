@@ -15,6 +15,7 @@ from pydcop_tpu.commands._utils import (
     add_csvline,
     output_metrics,
     parse_algo_params,
+    warn_process_mode,
 )
 
 
@@ -69,16 +70,9 @@ def run_cmd(args):
         return 1
     algo_params = parse_algo_params(args.algo_params)
 
-    if args.mode == "process":
-        # no silent no-op: a reference user benchmarking thread vs
-        # process would otherwise get identical numbers unexplained
-        print(
-            "note: --mode process runs the same single-process tensor "
-            "engine as thread mode (one process IS the whole agent "
-            "population); for true multi-process execution use "
-            "'pydcop_tpu agent --multihost'",
-            file=sys.stderr,
-        )
+    # no silent no-op: a reference user benchmarking thread vs process
+    # would otherwise get identical numbers unexplained
+    warn_process_mode(args.mode)
 
     distribution = args.distribution
     if distribution and (distribution.endswith(".yaml") or

@@ -405,6 +405,8 @@ def load_scenario_from_file(filename: str) -> Scenario:
 
 def load_scenario(scenario_str: str) -> Scenario:
     loaded = yaml.safe_load(scenario_str)
+    if loaded is None:  # empty file = empty scenario, not a crash
+        loaded = {}
     events = []
     for e in loaded.get("events", []):
         if "delay" in e:

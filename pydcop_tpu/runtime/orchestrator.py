@@ -209,11 +209,19 @@ class VirtualOrchestrator:
                 # running, orchestrator.py:336); here the device rate is
                 # measured on the first phase and each delay converts to
                 # a cycle budget, so `delay: 2` runs ~2s worth of cycles
-                # instead of an arbitrary fixed count.  event.delay also
-                # bounds the phase as a timeout (safety when the rate
-                # estimate is stale).
-                res = self._delay_phase(event.delay, cycles, resume)
-                resume = True
+                # instead of an arbitrary fixed count.  The effective
+                # delay also bounds the phase as a timeout (safety when
+                # the rate estimate is stale) and is clamped to the
+                # run-level timeout's remaining budget.
+                eff = event.delay
+                if timeout is not None:
+                    remaining = timeout - (
+                        perf_counter() - self.start_time
+                    )
+                    eff = max(0.0, min(eff, remaining))
+                if eff > 0:
+                    res = self._delay_phase(eff, cycles, resume)
+                    resume = True
             else:
                 for action in event.actions:
                     self._apply_action(action)
@@ -223,11 +231,21 @@ class VirtualOrchestrator:
                 )
         # final phase to (re)converge after the last event: the explicit
         # per-phase cycle count unbounded (caller's contract), else the
-        # budget of a 1-second delay
+        # budget of a 1-second delay clamped to the remaining run timeout
         if cycles is not None:
             res = self._run_phase(cycles, timeout=None, resume=resume)
         else:
-            res = self._delay_phase(1.0, None, resume)
+            final_delay = 1.0
+            if timeout is not None:
+                remaining = timeout - (perf_counter() - self.start_time)
+                final_delay = min(1.0, remaining)
+            if final_delay > 0 or res is None:
+                res = self._delay_phase(
+                    max(final_delay, 0.05), None, resume
+                )
+        if timeout is not None and \
+                perf_counter() - self.start_time > timeout:
+            res.status = "TIMEOUT"
         self.status = res.status
         return self._finalize(res)
 
@@ -248,13 +266,15 @@ class VirtualOrchestrator:
         tables after repair, metric collection) is tracked.
         """
         if cycles is not None:
-            return self._run_phase(cycles, timeout=delay, resume=resume)
+            return self._normalize(
+                self._run_phase(cycles, timeout=delay, resume=resume)
+            )
         if self._cycle_rate is not None:
             res = self._run_phase(
                 self._budget(delay), timeout=delay, resume=resume
             )
             self._update_rate(res)
-            return res
+            return self._normalize(res)
         # cold start: the calibration phase's wall time includes jit
         # compilation, so its rate wildly underestimates the device.
         # Top up against the REMAINING wall budget of this delay (so one
@@ -273,6 +293,15 @@ class VirtualOrchestrator:
                 self._budget(remaining), timeout=remaining, resume=True
             )
             self._update_rate(res)
+        return self._normalize(res)
+
+    @staticmethod
+    def _normalize(res: SolveResult) -> SolveResult:
+        """A delay phase cut by its wall budget behaved exactly as asked
+        ("run for that much time") — that is not a run-level TIMEOUT.
+        run() re-applies TIMEOUT when the RUN deadline is exhausted."""
+        if res.status == "TIMEOUT":
+            res.status = "FINISHED"
         return res
 
     def _budget(self, delay: float) -> int:

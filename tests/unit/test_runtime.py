@@ -325,13 +325,14 @@ class TestRateDerivedPhases:
         return orch
 
     def test_delay_converts_to_proportional_cycles(self, tuto):
-        short = self._run(tuto, [0.4])
-        long = self._run(tuto, [1.2])
+        short = self._run(tuto, [0.2])
+        long = self._run(tuto, [2.0])
         assert short._cycle_rate is not None
         # final convergence phases are both ~1s worth; the delay phases
-        # differ 3x, so total cycles must clearly increase with delay
+        # differ 10x, so total cycles must clearly increase with delay
+        # (loose threshold: machine load skews wall-derived rates)
         ratio = long._cycles_done / max(1, short._cycles_done)
-        assert ratio > 1.3, (
+        assert ratio > 1.2, (
             short._cycles_done, long._cycles_done, short._cycle_rate,
         )
 
