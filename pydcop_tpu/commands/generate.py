@@ -14,19 +14,35 @@ def set_parser(subparsers):
     parser = subparsers.add_parser("generate", help="generate problems")
     gen_sub = parser.add_subparsers(dest="generator", required=True)
 
-    p = gen_sub.add_parser("graphcoloring")
-    p.set_defaults(func=_graphcoloring)
-    p.add_argument("--variables_count", "-V", type=int, required=True)
-    p.add_argument("--colors_count", "-C", type=int, default=3)
-    p.add_argument("--graph", choices=["random", "scalefree", "grid"],
-                   default="random")
-    p.add_argument("--p_edge", type=float, default=None)
-    p.add_argument("--edges_count", type=int, default=None)
-    p.add_argument("--soft", action="store_true")
-    p.add_argument("--noise", type=float, default=0.02)
-    p.add_argument("--agents_count", type=int, default=None)
-    p.add_argument("--capacity", type=float, default=100)
-    p.add_argument("--seed", type=int, default=0)
+    # both spellings exist in the reference (graphcoloring in the docs'
+    # synopsis, graph_coloring in the generators package registration)
+    for alias in ("graphcoloring", "graph_coloring"):
+        p = gen_sub.add_parser(alias)
+        p.set_defaults(func=_graphcoloring)
+        p.add_argument("--variables_count", "-v", "-V", type=int,
+                       required=True)
+        p.add_argument("--colors_count", "-c", "-C", type=int, default=3)
+        p.add_argument("--graph", "-g",
+                       choices=["random", "scalefree", "grid"],
+                       default="random")
+        p.add_argument("--p_edge", "-p", type=float, default=None,
+                       help="edge probability (Erdős–Rényi random graphs)")
+        p.add_argument("--m_edge", "-m", type=int, default=None,
+                       help="edges attached per new variable "
+                       "(scale-free graphs)")
+        p.add_argument("--edges_count", type=int, default=None)
+        p.add_argument("--soft", action="store_true")
+        p.add_argument("--intentional", action="store_true",
+                       help="intentional (expression) constraints — hard "
+                       "coloring only, like the reference")
+        p.add_argument("--allow_subgraph", action="store_true",
+                       help="skip the connected-graph filter")
+        p.add_argument("--noagents", action="store_true",
+                       help="do not generate agents")
+        p.add_argument("--noise", type=float, default=0.02)
+        p.add_argument("--agents_count", type=int, default=None)
+        p.add_argument("--capacity", type=float, default=100)
+        p.add_argument("--seed", type=int, default=0)
 
     p = gen_sub.add_parser("ising")
     p.set_defaults(func=_ising)
@@ -53,10 +69,32 @@ def set_parser(subparsers):
     p.add_argument("--participants_count", type=int, default=2)
     p.add_argument("--seed", type=int, default=0)
 
+    # the reference's resource-based PEAV generator (meetingscheduling.py
+    # :125-192) — emits a DCOP AND its PEAV distribution
+    p = gen_sub.add_parser("meetings")
+    p.set_defaults(func=_meetings_peav)
+    p.add_argument("--slots_count", type=int, required=True)
+    p.add_argument("--events_count", type=int, required=True)
+    p.add_argument("--resources_count", type=int, required=True)
+    p.add_argument("--max_resources_event", type=int, required=True)
+    p.add_argument("--max_length_event", type=int, default=1)
+    p.add_argument("--max_resource_value", type=int, default=10)
+    p.add_argument("--no_agents", action="store_true")
+    p.add_argument("--routes_default", type=int, default=None)
+    p.add_argument("--hosting_default", type=int, default=None)
+    p.add_argument("--capacity", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
+
     p = gen_sub.add_parser("iot")
     p.set_defaults(func=_iot)
-    p.add_argument("--num_device", "-n", type=int, default=10)
-    p.add_argument("--domain_size", type=int, default=3)
+    p.add_argument("--num", "--num_device", "-n", dest="num_device",
+                   type=int, default=10,
+                   help="number of devices/variables")
+    p.add_argument("--domain", "--domain_size", "-d", dest="domain_size",
+                   type=int, default=3,
+                   help="variable domain size: 0..d-1")
+    p.add_argument("--range", "-r", dest="cost_range", type=float,
+                   default=10, help="range of the constraint costs")
     p.add_argument("--seed", type=int, default=0)
 
     p = gen_sub.add_parser("smallworld")
@@ -107,13 +145,63 @@ def _graphcoloring(args):
         graph_type=args.graph,
         p_edge=args.p_edge,
         n_edges=args.edges_count,
+        m_edge=args.m_edge,
         soft=args.soft,
+        intentional=args.intentional,
+        allow_subgraph=args.allow_subgraph,
+        no_agents=args.noagents,
         noise_level=args.noise,
         n_agents=args.agents_count,
         capacity=args.capacity,
         seed=args.seed,
     )
     return _write(args, dcop_yaml(dcop))
+
+
+def _meetings_peav(args):
+    import yaml as _yaml
+
+    from pydcop_tpu.dcop import dcop_yaml
+    from pydcop_tpu.generators import generate_meetings_peav
+
+    dcop, mapping = generate_meetings_peav(
+        slots_count=args.slots_count,
+        events_count=args.events_count,
+        resources_count=args.resources_count,
+        max_resources_event=args.max_resources_event,
+        max_length_event=args.max_length_event,
+        max_resource_value=args.max_resource_value,
+        seed=args.seed,
+        no_agents=args.no_agents,
+        hosting_default=args.hosting_default,
+        routes_default=args.routes_default,
+        capacity=args.capacity,
+    )
+    dist_text = None
+    if mapping is not None:
+        dist_text = _yaml.dump({
+            "inputs": {
+                "dist_algo": "peav",
+                "dcop": args.output or "NA",
+                "graph": "constraints_graph",
+                "algo": "NA",
+            },
+            "distribution": mapping,
+            "cost": None,
+        })
+    rc = _write(args, dcop_yaml(dcop))
+    if dist_text is not None:
+        if args.output:
+            import os as _os
+
+            path, ext = _os.path.splitext(args.output)
+            with open(f"{path}_dist{ext}", "w", encoding="utf-8") as f:
+                f.write(dist_text)
+        else:
+            # separate YAML document on stdout, so consumers can split
+            # the DCOP and the distribution with a multi-doc load
+            sys.stdout.write("---\n" + dist_text)
+    return rc
 
 
 def _ising(args):
@@ -164,9 +252,32 @@ def _iot(args):
     from pydcop_tpu.generators import generate_iot
 
     dcop = generate_iot(
-        n_devices=args.num_device, n_states=args.domain_size, seed=args.seed
+        n_devices=args.num_device, n_states=args.domain_size,
+        seed=args.seed, cost_range=args.cost_range,
     )
-    return _write(args, dcop_yaml(dcop))
+    rc = _write(args, dcop_yaml(dcop))
+    if args.output:
+        # the reference iot generator emits the DCOP *and* its initial
+        # ilp_compref distribution (iot.py:30-33, "generates both a dcop
+        # and its initial distribution")
+        import os as _os
+
+        from pydcop_tpu.algorithms import load_algorithm_module
+        from pydcop_tpu.distribution import load_distribution_module
+        from pydcop_tpu.distribution.yamlformat import yaml_dist
+        from pydcop_tpu.graph import constraints_hypergraph
+
+        cg = constraints_hypergraph.build_computation_graph(dcop)
+        algo = load_algorithm_module("dsa")
+        dist = load_distribution_module("ilp_compref").distribute(
+            cg, dcop.agents.values(),
+            computation_memory=algo.computation_memory,
+            communication_load=algo.communication_load,
+        )
+        path, ext = _os.path.splitext(args.output)
+        with open(f"{path}_dist{ext}", "w", encoding="utf-8") as f:
+            f.write(yaml_dist(dist))
+    return rc
 
 
 def _smallworld(args):

@@ -8,7 +8,10 @@ benchmarks can use them.
 from pydcop_tpu.generators.graphcoloring import generate_graph_coloring
 from pydcop_tpu.generators.ising import generate_ising
 from pydcop_tpu.generators.secp import generate_secp
-from pydcop_tpu.generators.meetingscheduling import generate_meeting_scheduling
+from pydcop_tpu.generators.meetingscheduling import (
+    generate_meeting_scheduling,
+    generate_meetings_peav,
+)
 from pydcop_tpu.generators.smallworld import generate_smallworld
 from pydcop_tpu.generators.iot import generate_iot
 from pydcop_tpu.generators.agents_gen import generate_agents
@@ -19,6 +22,7 @@ __all__ = [
     "generate_ising",
     "generate_secp",
     "generate_meeting_scheduling",
+    "generate_meetings_peav",
     "generate_smallworld",
     "generate_iot",
     "generate_agents",

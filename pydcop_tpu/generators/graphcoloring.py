@@ -14,35 +14,32 @@ import numpy as np
 
 from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
-from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.dcop.relations import (
+    NAryMatrixRelation,
+    constraint_from_str,
+)
 
 
-def generate_graph_coloring(
-    n_variables: int,
-    n_colors: int = 3,
-    density: float = 0.2,
-    graph_type: str = "random",  # random | scalefree | grid
-    soft: bool = True,
-    noise_level: float = 0.02,
-    n_agents: Optional[int] = None,
-    capacity: float = 100,
-    seed: int = 0,
-    p_edge: Optional[float] = None,
-    n_edges: Optional[int] = None,
-) -> DCOP:
-    """Build a random coloring DCOP.
+def _is_connected(n: int, edges) -> bool:
+    """Union-find connectivity test over (i, j) pairs."""
+    parent = list(range(n))
 
-    soft=True → extensional random-cost tables penalizing equal colors
-    (weighted coloring); soft=False → hard CSP (equal colors cost 10000).
-    """
-    rng = random.Random(seed)
-    np_rng = np.random.default_rng(seed)
-    dcop = DCOP(f"graph_coloring_{n_variables}", "min")
-    domain = Domain("colors", "color", list(range(n_colors)))
-    variables = [Variable(f"v{i:05d}", domain) for i in range(n_variables)]
-    for v in variables:
-        dcop.add_variable(v)
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
 
+    for i, j in edges:
+        ri, rj = find(i), find(j)
+        if ri != rj:
+            parent[ri] = rj
+    roots = {find(i) for i in range(n)}
+    return len(roots) <= 1
+
+
+def _sample_edges(rng, n_variables, graph_type, p_edge, n_edges, density,
+                  m_edge):
     edges = set()
     if graph_type == "grid":
         side = int(np.sqrt(n_variables))
@@ -54,8 +51,9 @@ def generate_graph_coloring(
                 if r + 1 < side:
                     edges.add((i, i + side))
     elif graph_type == "scalefree":
-        # preferential attachment, m=2
-        m = 2
+        # preferential attachment (Barabási–Albert); m_edge = edges per
+        # new variable (reference graphcoloring.py -m/--m_edge)
+        m = m_edge if m_edge is not None else 2
         targets = list(range(min(m, n_variables)))
         repeated: list = list(targets)
         for i in range(m, n_variables):
@@ -80,8 +78,87 @@ def generate_graph_coloring(
                 i, j = rng.randrange(n_variables), rng.randrange(n_variables)
                 if i != j:
                     edges.add((min(i, j), max(i, j)))
+    return edges
+
+
+def generate_graph_coloring(
+    n_variables: int,
+    n_colors: int = 3,
+    density: float = 0.2,
+    graph_type: str = "random",  # random | scalefree | grid
+    soft: bool = True,
+    noise_level: float = 0.02,
+    n_agents: Optional[int] = None,
+    capacity: float = 100,
+    seed: int = 0,
+    p_edge: Optional[float] = None,
+    n_edges: Optional[int] = None,
+    m_edge: Optional[int] = None,
+    intentional: bool = False,
+    allow_subgraph: bool = True,
+    no_agents: bool = False,
+) -> DCOP:
+    """Build a random coloring DCOP.
+
+    soft=True → extensional random-cost tables penalizing equal colors
+    (weighted coloring); soft=False → hard CSP (equal colors cost 10000),
+    optionally in ``intentional`` (expression) form like the reference's
+    --intentional flag (graphcoloring.py:200-206 — intentional is only
+    defined for the non-weighted problem).  ``allow_subgraph=False``
+    resamples random graphs until connected (reference --allow_subgraph
+    is the inverse opt-out).
+    """
+    if intentional and soft:
+        raise ValueError(
+            "intentional constraints are only available for hard "
+            "(non-soft) graph coloring, like the reference"
+        )
+    if graph_type == "grid":
+        side = int(np.sqrt(n_variables))
+        if side * side != n_variables:
+            raise ValueError(
+                f"grid graphs need a square variables_count "
+                f"(got {n_variables}); see the reference's "
+                f"--variables_count doc"
+            )
+    rng = random.Random(seed)
+    np_rng = np.random.default_rng(seed)
+    dcop = DCOP(f"graph_coloring_{n_variables}", "min")
+    domain = Domain("colors", "color", list(range(n_colors)))
+    variables = [Variable(f"v{i:05d}", domain) for i in range(n_variables)]
+    for v in variables:
+        dcop.add_variable(v)
+
+    edges = _sample_edges(
+        rng, n_variables, graph_type, p_edge, n_edges, density, m_edge
+    )
+    if not allow_subgraph and n_variables > 1:
+        # grid sampling is deterministic (a square grid is connected);
+        # only the random families are worth resampling
+        attempts = 1 if graph_type == "grid" else 50
+        for _ in range(attempts):
+            if _is_connected(n_variables, edges):
+                break
+            edges = _sample_edges(
+                rng, n_variables, graph_type, p_edge, n_edges, density,
+                m_edge,
+            )
+        else:
+            raise ValueError(
+                "could not sample a connected graph in "
+                f"{attempts} attempts; raise the edge density or pass "
+                "allow_subgraph=True (--allow_subgraph)"
+            )
 
     for k, (i, j) in enumerate(sorted(edges)):
+        if intentional:
+            vi, vj = variables[i], variables[j]
+            dcop.add_constraint(constraint_from_str(
+                f"c{k:06d}",
+                f"10000 if {vi.name} == {vj.name} else 0",
+                [vi, vj],
+            ))
+            continue
         if soft:
             m = np_rng.uniform(0, 1, size=(n_colors, n_colors)).astype(
                 np.float32
@@ -99,8 +176,10 @@ def generate_graph_coloring(
             )
         )
 
-    n_agents = n_agents if n_agents is not None else n_variables
-    dcop.add_agents(
-        [AgentDef(f"a{i:05d}", capacity=capacity) for i in range(n_agents)]
-    )
+    if not no_agents:
+        n_agents = n_agents if n_agents is not None else n_variables
+        dcop.add_agents(
+            [AgentDef(f"a{i:05d}", capacity=capacity)
+             for i in range(n_agents)]
+        )
     return dcop

@@ -20,7 +20,12 @@ def generate_iot(
     n_devices: int = 10,
     n_states: int = 3,
     seed: int = 0,
+    cost_range: float = 2,
 ) -> DCOP:
+    """``cost_range`` is the reference's -r/--range: constraint costs are
+    drawn uniformly from [0, range) (generate.py:170-172).  The library
+    default stays at the historical 2 so existing seeds reproduce; the
+    CLI passes the reference's default of 10."""
     rng = random.Random(seed)
     np_rng = np.random.default_rng(seed)
     dcop = DCOP(f"iot_{n_devices}", "min")
@@ -39,7 +44,9 @@ def generate_iot(
         repeated.extend([i, t])
 
     for k, (i, j) in enumerate(sorted(edges)):
-        m = np_rng.uniform(0, 2, (n_states, n_states)).astype(np.float32)
+        m = np_rng.uniform(
+            0, cost_range, (n_states, n_states)
+        ).astype(np.float32)
         dcop.add_constraint(
             NAryMatrixRelation([variables[i], variables[j]], m, f"c{k:04d}")
         )

@@ -13,7 +13,12 @@ import random
 from typing import Optional
 
 from pydcop_tpu.dcop.dcop import DCOP
-from pydcop_tpu.dcop.objects import AgentDef, Domain, VariableWithCostDict
+from pydcop_tpu.dcop.objects import (
+    AgentDef,
+    Domain,
+    Variable,
+    VariableWithCostDict,
+)
 from pydcop_tpu.dcop.relations import NAryFunctionRelation
 
 
@@ -77,3 +82,154 @@ def generate_meeting_scheduling(
         [AgentDef(f"a{i}", capacity=100) for i in range(n_agents)]
     )
     return dcop
+
+
+# ---------------------------------------------------------------------------
+# Resource-based PEAV model (the reference's `pydcop generate meetings`,
+# pydcop/commands/generators/meetingscheduling.py:196-630, after
+# Maheswaran et al. 2004): agents are RESOURCES; each (resource, event)
+# pair it may serve is a variable whose value is the event's start slot
+# (0 = not scheduled); intra-resource constraints penalize schedule
+# overlaps and carry the scheduling utility; inter-resource constraints
+# force all resources of an event to agree on its start.  Objective: max.
+# ---------------------------------------------------------------------------
+
+
+def generate_meetings_peav(
+    slots_count: int,
+    events_count: int,
+    resources_count: int,
+    max_resources_event: int,
+    max_length_event: int = 1,
+    max_resource_value: int = 10,
+    seed: int = 0,
+    no_agents: bool = False,
+    hosting_default: Optional[int] = None,
+    routes_default: Optional[int] = None,
+    capacity: Optional[int] = None,
+):
+    """Returns (DCOP, distribution mapping or None).
+
+    The distribution is part of the PEAV model itself (one agent per
+    resource hosting its own event-copy variables), mirroring the
+    reference command which emits both files.
+    """
+    import numpy as np
+
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    rng = random.Random(seed)
+    slots = list(range(1, slots_count + 1))
+
+    # resources: value of staying free per slot
+    free_value = {
+        r: {t: rng.randint(0, max_resource_value) for t in slots}
+        for r in range(resources_count)
+    }
+    # events: length, required resources and each one's value
+    events = {}
+    for e in range(events_count):
+        length = rng.randint(1, max_length_event)
+        req = rng.sample(range(resources_count),
+                         rng.randint(1, max_resources_event))
+        values = {r: rng.randint(1, max_resource_value) for r in req}
+        events[e] = (length, values)
+
+    penalty = max_resource_value * slots_count * resources_count
+
+    def sched_value(r, e, t):
+        """Utility of resource r serving event e starting at slot t:
+        event value over its length minus the foregone free-slot value
+        (0 when unscheduled)."""
+        length, values = events[e]
+        if t == 0:
+            return 0.0
+        return values[r] * length - sum(
+            free_value[r][t + j] for j in range(length)
+        )
+
+    dcop = DCOP("MeetingSceduling", "max")
+    variables = {}
+    by_resource = {r: [] for r in range(resources_count)}
+    for e, (length, values) in events.items():
+        for r in values:
+            name = f"v_{r:02d}_{e:02d}"
+            # start slots: 0 = unscheduled, else 1..slots-length+1
+            dom = Domain(f"d_{name}", "time_slot",
+                         list(range(0, slots_count - length + 2)))
+            v = Variable(name, dom)
+            variables[(r, e)] = v
+            by_resource[r].append(e)
+            dcop.add_variable(v)
+
+    def overlap(e1, t1, e2, t2):
+        l1, l2 = events[e1][0], events[e2][0]
+        if t1 == 0 or t2 == 0:
+            return False
+        return (t1 <= t2 <= t1 + l1 - 1) or (t2 <= t1 <= t2 + l2 - 1)
+
+    # intra-resource constraints (+ unary for single-event resources)
+    for r, evs in by_resource.items():
+        k = len(evs)
+        if k == 1:
+            (e,) = evs
+            v = variables[(r, e)]
+            m = np.array(
+                [sched_value(r, e, t) for t in v.domain.values],
+                dtype=np.float32,
+            )
+            dcop.add_constraint(
+                NAryMatrixRelation([v], m, f"cu_{v.name}"))
+            continue
+        for i in range(k):
+            for j in range(i + 1, k):
+                e1, e2 = evs[i], evs[j]
+                v1, v2 = variables[(r, e1)], variables[(r, e2)]
+                m = np.zeros(
+                    (len(v1.domain), len(v2.domain)), dtype=np.float32
+                )
+                for a, t1 in enumerate(v1.domain.values):
+                    for b, t2 in enumerate(v2.domain.values):
+                        if overlap(e1, t1, e2, t2):
+                            m[a, b] = -penalty
+                        else:
+                            m[a, b] = (
+                                sched_value(r, e1, t1)
+                                + sched_value(r, e2, t2)
+                            ) / (k - 1)
+                dcop.add_constraint(NAryMatrixRelation(
+                    [v1, v2], m, f"ci_{v1.name}_{v2.name}"))
+
+    # inter-resource: all copies of an event must agree on its start
+    for e, (length, values) in events.items():
+        req = sorted(values)
+        for i in range(len(req)):
+            for j in range(i + 1, len(req)):
+                v1 = variables[(req[i], e)]
+                v2 = variables[(req[j], e)]
+                m = np.where(
+                    np.eye(len(v1.domain), len(v2.domain), dtype=bool),
+                    0.0, -float(penalty),
+                ).astype(np.float32)
+                dcop.add_constraint(NAryMatrixRelation(
+                    [v1, v2], m, f"ce_{v1.name}_{v2.name}"))
+
+    mapping = None
+    if not no_agents:
+        mapping = {}
+        for r in range(resources_count):
+            kw = {}
+            kw["hosting_costs"] = {
+                variables[(r, e)].name: 0 for e in by_resource[r]
+            }
+            if hosting_default is not None:
+                kw["default_hosting_cost"] = hosting_default
+            if capacity is not None:
+                kw["capacity"] = capacity
+            if routes_default is not None:
+                kw["default_route"] = routes_default
+            dcop.agents[f"a_{r}"] = AgentDef(f"a_{r}", **kw)
+            mapping[f"a_{r}"] = [
+                variables[(r, e)].name for e in by_resource[r]
+            ]
+    return dcop, mapping

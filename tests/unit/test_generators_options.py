@@ -1,0 +1,147 @@
+"""Generator option parity with the reference command surfaces
+(VERDICT r2 item 8: reference docs' generate command lines run
+unchanged — graphcoloring.py:160-226, meetingscheduling.py:125-192)."""
+import subprocess
+import sys
+import os
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+ENV = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+
+
+def gen(*args, timeout=60):
+    return subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu", "generate", *args],
+        capture_output=True, text=True, timeout=timeout, env=ENV, cwd=REPO,
+    )
+
+
+class TestGraphColoringOptions:
+    def test_intentional_constraints(self):
+        from pydcop_tpu.dcop import load_dcop
+        from pydcop_tpu.runtime.run import solve
+
+        out = gen("graphcoloring", "-v", "6", "-c", "3", "-g", "random",
+                  "-p", "0.5", "--intentional", "--seed", "2")
+        assert out.returncode == 0, out.stderr[-500:]
+        assert "intention" in out.stdout  # expression form in the YAML
+        dcop = load_dcop(out.stdout)
+        a = solve(dcop, "dpop")  # hard CSP: optimal has no conflicts
+        viol, cost = dcop.solution_cost(a, 10000)
+        assert cost < 10000
+
+    def test_intentional_refuses_soft(self):
+        out = gen("graphcoloring", "-v", "6", "--soft", "--intentional")
+        assert out.returncode != 0
+
+    def test_connected_by_default_subgraphs_on_flag(self):
+        from pydcop_tpu.generators import generate_graph_coloring
+        from pydcop_tpu.generators.graphcoloring import _is_connected
+
+        # sparse random graph: disconnected when allowed...
+        dcop = generate_graph_coloring(
+            n_variables=30, n_edges=10, seed=0, allow_subgraph=True)
+        # ...the CLI default (allow_subgraph False) filters to connected
+        dcop2 = generate_graph_coloring(
+            n_variables=12, n_edges=12, seed=0, allow_subgraph=False)
+        names = sorted(dcop2.variables)
+        pos = {n: i for i, n in enumerate(names)}
+        edges = [
+            tuple(pos[v.name] for v in c.dimensions)
+            for c in dcop2.constraints.values()
+        ]
+        assert _is_connected(len(names), edges)
+
+    def test_m_edge_controls_scalefree_density(self):
+        from pydcop_tpu.generators import generate_graph_coloring
+
+        d2 = generate_graph_coloring(
+            n_variables=30, graph_type="scalefree", m_edge=2, seed=1)
+        d4 = generate_graph_coloring(
+            n_variables=30, graph_type="scalefree", m_edge=4, seed=1)
+        assert len(d4.constraints) > len(d2.constraints)
+
+    def test_noagents_and_aliases(self):
+        out = gen("graph_coloring", "-v", "9", "-c", "3", "-g", "grid",
+                  "--noagents")
+        assert out.returncode == 0, out.stderr[-500:]
+        assert "agents: {}" in out.stdout
+
+
+class TestMeetingsPeav:
+    def test_reference_docs_command_line(self, tmp_path):
+        """The exact example from the reference docs (module docstring
+        meetingscheduling.py:96-104) runs unchanged and emits both the
+        DCOP and its PEAV distribution."""
+        out_file = tmp_path / "meetings.yaml"
+        proc = subprocess.run(
+            [sys.executable, "-m", "pydcop_tpu",
+             "--output", str(out_file), "generate", "meetings",
+             "--slots_count", "5", "--events_count", "6",
+             "--resources_count", "3", "--max_resources_event", "2",
+             "--max_length_event", "2"],
+            capture_output=True, text=True, timeout=60, env=ENV, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stderr[-500:]
+        dist_file = tmp_path / "meetings_dist.yaml"
+        assert out_file.exists() and dist_file.exists()
+
+        import yaml
+
+        from pydcop_tpu.dcop import load_dcop_from_file
+
+        dcop = load_dcop_from_file(str(out_file))
+        assert dcop.objective == "max"
+        # one agent per resource, hosting its own event-copy variables
+        dist = yaml.safe_load(dist_file.read_text())
+        assert dist["inputs"]["dist_algo"] == "peav"
+        hosted = [v for vs in dist["distribution"].values() for v in vs]
+        assert sorted(hosted) == sorted(dcop.variables)
+
+    def test_peav_solves(self):
+        from pydcop_tpu.generators import generate_meetings_peav
+        from pydcop_tpu.runtime.run import solve
+
+        dcop, mapping = generate_meetings_peav(
+            slots_count=4, events_count=3, resources_count=3,
+            max_resources_event=2, seed=3,
+        )
+        assert mapping is not None
+        a = solve(dcop, "dpop")
+        # every scheduled copy of an event agrees on its start slot
+        starts = {}
+        for name, val in a.items():
+            e = name.rsplit("_", 1)[-1]
+            starts.setdefault(e, set()).add(val)
+        assert all(len(s) == 1 for s in starts.values())
+
+    def test_no_agents(self):
+        from pydcop_tpu.generators import generate_meetings_peav
+
+        dcop, mapping = generate_meetings_peav(
+            slots_count=4, events_count=2, resources_count=2,
+            max_resources_event=2, seed=1, no_agents=True,
+        )
+        assert mapping is None and not dcop.agents
+
+
+class TestIotOptions:
+    def test_reference_flags_and_dist_output(self, tmp_path):
+        out_file = tmp_path / "iot.yaml"
+        proc = subprocess.run(
+            [sys.executable, "-m", "pydcop_tpu",
+             "--output", str(out_file), "generate", "iot",
+             "-d", "4", "-n", "8", "-r", "10"],
+            capture_output=True, text=True, timeout=120, env=ENV, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stderr[-500:]
+        assert out_file.exists()
+        assert (tmp_path / "iot_dist.yaml").exists()
+
+        from pydcop_tpu.dcop import load_dcop_from_file
+
+        dcop = load_dcop_from_file(str(out_file))
+        assert len(dcop.variables) == 8
+        assert all(len(v.domain) == 4 for v in dcop.variables.values())
