@@ -33,6 +33,9 @@ def set_parser(subparsers):
     parser.add_argument("--end_metrics", default=None)
     parser.add_argument("--replication_method", default="dist_ucs_hostingcosts",
                         help="accepted for compatibility (one method)")
+    parser.add_argument("--uiport", type=int, default=None,
+                        help="serve the GUI websocket protocol + HTTP "
+                        "/state on this port (ws on port+1)")
     parser.add_argument("--ktarget", type=int, default=3,
                         help="replication level k")
     parser.add_argument("--seed", type=int, default=0)
@@ -64,11 +67,23 @@ def run_cmd(args):
     orch.deploy_computations()
     if args.ktarget:
         orch.start_replication(args.ktarget)
+    ui = None
+    if args.uiport:
+        from pydcop_tpu.runtime.events import event_bus
+        from pydcop_tpu.runtime.ui import UiServer
+
+        event_bus.enabled = True
+        ui = UiServer(port=args.uiport, orchestrator=orch)
+        ui.start()
     try:
         orch.run(scenario, timeout=args.timeout)
     except Exception as e:
         output_metrics({"status": "ERROR", "error": str(e)}, args.output)
         return 1
+    finally:
+        if ui is not None:
+            ui.update_state(**orch.end_metrics())
+            ui.stop()
     metrics = orch.end_metrics()
     if args.run_metrics:
         for t, m in collected:

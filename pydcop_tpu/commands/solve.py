@@ -52,7 +52,8 @@ def set_parser(subparsers):
     parser.add_argument("--delay", type=float, default=None,
                         help="accepted for compatibility")
     parser.add_argument("--uiport", type=int, default=None,
-                        help="accepted for compatibility")
+                        help="serve the GUI websocket protocol + HTTP "
+                        "/state on this port (ws on port+1)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--cycles", type=int, default=None,
                         help="run exactly this many cycles")
@@ -92,6 +93,14 @@ def run_cmd(args):
             )
             return 1
 
+    ui = None
+    if args.uiport:
+        from pydcop_tpu.runtime.events import event_bus
+        from pydcop_tpu.runtime.ui import UiServer
+
+        event_bus.enabled = True
+        ui = UiServer(port=args.uiport)
+        ui.start()
     try:
         res = solve_result(
             dcop,
@@ -107,6 +116,11 @@ def run_cmd(args):
     except Exception as e:
         output_metrics({"status": "ERROR", "error": str(e)}, args.output)
         return 1
+    finally:
+        if ui is not None:
+            if "res" in locals():
+                ui.update_state(**res.metrics())
+            ui.stop()
 
     metrics = res.metrics()
     if args.run_metrics and res.history:

@@ -1,13 +1,25 @@
 """Live observation server.
 
 Equivalent capability to the reference's pydcop/infrastructure/ui.py
-(UiServer :43-120): the reference pushes event-bus topics to GUI clients
-over websockets (websocket-server dependency).  That library is not in this
-image, so the same capability is served with stdlib HTTP:
+(UiServer :43-120), speaking the SAME websocket protocol to GUI clients
+— via the stdlib RFC 6455 implementation in runtime/ws.py (the
+reference's websocket-server dependency is not needed):
+
+* client commands (JSON ``{"cmd": ...}``): ``test``, ``agent``,
+  ``computations`` — answered with ``{"cmd": ..., ...}`` payloads in
+  the reference's shapes (ui.py:118-195);
+* pushed events (JSON ``{"evt": ...}``): ``cycle``, ``value``,
+  ``add_comp``, ``rem_comp`` from the event bus, and an
+  application-level ``{"cmd": "close"}`` on shutdown (ui.py:89-91).
+
+An HTTP fallback runs alongside on ``port``:
 
 * ``GET /state``  — current status, cycle, cost, assignment (JSON);
 * ``GET /events`` — Server-Sent Events stream of event-bus topics
   (consumable from any browser/EventSource, no extra deps).
+
+The websocket endpoint listens on ``ws_port`` (default ``port + 1``,
+matching the reference's one-ws-port-per-agent layout).
 """
 from __future__ import annotations
 
@@ -21,14 +33,22 @@ from pydcop_tpu.runtime.events import event_bus
 
 
 class UiServer:
-    def __init__(self, port: int = 10001, address: str = "127.0.0.1"):
+    def __init__(self, port: int = 10001, address: str = "127.0.0.1",
+                 ws_port: Optional[int] = None, orchestrator=None):
         self.port = port
+        self.ws_port = ws_port if ws_port is not None else port + 1
         self.address = address
+        self.orchestrator = orchestrator
         self._state = {"status": "INITIAL"}
         self._lock = threading.Lock()
         self._subscribers: list[queue.Queue] = []
         self._server: Optional[ThreadingHTTPServer] = None
+        self._ws = None
         event_bus.subscribe("*", self._on_event)
+        event_bus.subscribe("computations.cycle.*", self._cb_cycle)
+        event_bus.subscribe("computations.value.*", self._cb_value)
+        event_bus.subscribe("agents.add_computation.*", self._cb_add_comp)
+        event_bus.subscribe("agents.rem_computation.*", self._cb_rem_comp)
 
     # -- event plumbing -----------------------------------------------------
 
@@ -44,6 +64,100 @@ class UiServer:
     def update_state(self, **kwargs) -> None:
         with self._lock:
             self._state.update(kwargs)
+
+    # -- websocket protocol (reference ui.py command/event shapes) ----------
+
+    def _ws_message(self, client, text: str) -> None:
+        try:
+            msg = json.loads(text)
+        except ValueError:
+            return
+        cmd = msg.get("cmd") if isinstance(msg, dict) else None
+        if cmd == "test":
+            self._ws.send_all(json.dumps({"cmd": "test", "data": "foo"}))
+        elif cmd == "agent":
+            self._ws.send(client, json.dumps(
+                {"cmd": "agent", "agent": self._agent_data()}))
+        elif cmd == "computations":
+            self._ws.send(client, json.dumps(
+                {"cmd": "computations",
+                 "computations": self._computations()}))
+
+    def _agent_data(self) -> dict:
+        """The reference's agent payload (ui.py:135-147), with the
+        virtual orchestrator standing in for the per-agent view."""
+        with self._lock:
+            state = dict(self._state)
+        return {
+            "name": "orchestrator",
+            "extra": {},
+            "computations": self._computations(),
+            "replicas": self._replicas(),
+            "address": f"{self.address}:{self.port}",
+            "is_orchestrator": True,
+            "status": state.get("status"),
+        }
+
+    def _computations(self) -> list:
+        """The reference's computation payloads (ui.py:155-194)."""
+        orch = self.orchestrator
+        if orch is None:
+            return []
+        with self._lock:
+            assignment = dict(self._state.get("assignment") or {})
+        # mid-run values: the last completed phase's assignment (the
+        # end metrics only land in _state after the run)
+        last = getattr(orch, "_last_result", None)
+        if not assignment and last is not None:
+            assignment = dict(last.assignment or {})
+        algo = {"name": orch.algo_def.algo,
+                "params": dict(orch.algo_def.params)}
+        out = []
+        for node in orch.cg.nodes:
+            # variable-vs-factor from the node class, not from the
+            # assignment (which is empty before the first phase ends)
+            is_var = hasattr(node, "variable")
+            out.append({
+                "id": node.name,
+                "name": node.name,
+                "type": "variable" if is_var else "factor",
+                "value": assignment.get(node.name),
+                "neighbors": list(node.neighbors),
+                "algo": algo,
+                "msg_count": 0,
+                "msg_size": 0,
+                "cycles": self._state.get("cycle", 0),
+                "footprint": orch.algo_module.computation_memory(node),
+            })
+        return out
+
+    def _replicas(self) -> list:
+        orch = self.orchestrator
+        if orch is None or orch.replicas is None:
+            return []
+        return sorted(orch.replicas.mapping())
+
+    def _cb_cycle(self, topic: str, evt) -> None:
+        if self._ws is not None:
+            self._ws.send_all(json.dumps(
+                {"evt": "cycle", "computation": topic.rsplit(".", 1)[-1],
+                 "cycles": evt}))
+
+    def _cb_value(self, topic: str, evt) -> None:
+        if self._ws is not None:
+            self._ws.send_all(json.dumps(
+                {"evt": "value", "computation": topic.rsplit(".", 1)[-1],
+                 "value": evt}))
+
+    def _cb_add_comp(self, topic: str, evt) -> None:
+        if self._ws is not None:
+            self._ws.send_all(json.dumps(
+                {"evt": "add_comp", "computation": evt}))
+
+    def _cb_rem_comp(self, topic: str, evt) -> None:
+        if self._ws is not None:
+            self._ws.send_all(json.dumps(
+                {"evt": "rem_comp", "computation": evt}))
 
     # -- server -------------------------------------------------------------
 
@@ -94,7 +208,23 @@ class UiServer:
                                   daemon=True)
         thread.start()
 
+        from pydcop_tpu.runtime.ws import WebSocketServer
+
+        self._ws = WebSocketServer(
+            self.ws_port, host=self.address, on_message=self._ws_message
+        )
+        self._ws.start()
+
     def stop(self) -> None:
+        for cb in (self._on_event, self._cb_cycle, self._cb_value,
+                   self._cb_add_comp, self._cb_rem_comp):
+            event_bus.unsubscribe(cb)
         if self._server is not None:
             self._server.shutdown()
             self._server = None
+        if self._ws is not None:
+            # application-level close first (reference ui.py:89-91: the
+            # ws close alone does not reach the GUI client)
+            self._ws.send_all(json.dumps({"cmd": "close"}))
+            self._ws.stop()
+            self._ws = None

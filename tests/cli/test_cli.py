@@ -318,3 +318,40 @@ batches:
         assert "2 jobs total" in proc.stdout
         # no progress file is created in simulate mode
         assert self._progress_lines(out_dir) is None
+
+
+class TestUiPort:
+    def test_solve_uiport_serves_state_and_ws(self, tmp_path):
+        """--uiport (previously accepted-for-compat) serves the HTTP
+        /state endpoint and the reference's websocket protocol while
+        solving."""
+        import socket
+        import time
+        import urllib.request
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "pydcop_tpu", "--timeout", "60",
+             "solve", "--algo", "dsa", "--cycles", "2000",
+             "--uiport", str(port), TUTO],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=ENV, cwd=REPO,
+        )
+        try:
+            state = None
+            deadline = time.time() + 50
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/state", timeout=2
+                    ) as resp:
+                        state = json.loads(resp.read())
+                    break
+                except OSError:
+                    time.sleep(0.3)
+            assert state is not None, "UI server never came up"
+        finally:
+            proc.kill()
+            proc.wait(timeout=30)

@@ -20,14 +20,23 @@ def tuto():
     )
 
 
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 class TestUiServer:
     def test_state_endpoint(self):
-        ui = UiServer(port=19455)
+        port = _free_port()
+        ui = UiServer(port=port, ws_port=_free_port())
         ui.start()
         try:
             ui.update_state(status="RUNNING", cycle=3)
             with urllib.request.urlopen(
-                "http://127.0.0.1:19455/state", timeout=5
+                f"http://127.0.0.1:{port}/state", timeout=5
             ) as resp:
                 state = json.loads(resp.read())
             assert state["status"] == "RUNNING"
@@ -37,12 +46,13 @@ class TestUiServer:
             event_bus.unsubscribe(ui._on_event)
 
     def test_unknown_endpoint_404(self):
-        ui = UiServer(port=19456)
+        port = _free_port()
+        ui = UiServer(port=port, ws_port=_free_port())
         ui.start()
         try:
             with pytest.raises(urllib.error.HTTPError):
                 urllib.request.urlopen(
-                    "http://127.0.0.1:19456/nope", timeout=5
+                    f"http://127.0.0.1:{port}/nope", timeout=5
                 )
         finally:
             ui.stop()

@@ -1,0 +1,228 @@
+"""UiServer websocket protocol ≡ reference ui.py command/event shapes."""
+import base64
+import hashlib
+import json
+import os
+import socket
+import time
+
+import pytest
+
+from pydcop_tpu.dcop import load_dcop_from_file
+from pydcop_tpu.runtime.events import event_bus
+from pydcop_tpu.runtime.orchestrator import VirtualOrchestrator
+from pydcop_tpu.runtime.ui import UiServer
+from pydcop_tpu.runtime.ws import OP_TEXT, encode_frame, read_frame
+
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class WsClient:
+    """Stdlib test client: handshake + masked text frames."""
+
+    def __init__(self, port):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+        key = base64.b64encode(os.urandom(16)).decode()
+        self.sock.sendall(
+            f"GET / HTTP/1.1\r\nHost: 127.0.0.1:{port}\r\n"
+            f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\n"
+            f"Sec-WebSocket-Version: 13\r\n\r\n".encode()
+        )
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            resp += self.sock.recv(4096)
+        assert b"101" in resp.split(b"\r\n", 1)[0]
+        expect = base64.b64encode(hashlib.sha1(
+            (key + "258EAFA5-E914-47DA-95CA-C5AB0DC85B11").encode()
+        ).digest())
+        assert expect in resp
+
+    def send_json(self, obj):
+        self.sock.sendall(
+            encode_frame(json.dumps(obj).encode(), OP_TEXT, mask=True)
+        )
+
+    def recv_json(self, timeout=5):
+        self.sock.settimeout(timeout)
+        opcode, payload = read_frame(self.sock)
+        assert opcode == OP_TEXT, opcode
+        return json.loads(payload.decode())
+
+    def close(self):
+        self.sock.close()
+
+
+@pytest.fixture
+def served_orchestrator():
+    dcop = load_dcop_from_file(
+        os.path.join(INSTANCES, "graph_coloring_tuto.yaml"))
+    orch = VirtualOrchestrator(dcop, "maxsum", distribution="adhoc")
+    orch.deploy_computations()
+    ui = UiServer(port=free_port(), ws_port=free_port(),
+                  orchestrator=orch)
+    ui.start()
+    time.sleep(0.1)
+    yield orch, ui
+    ui.stop()
+
+
+def test_cmd_test_agent_computations(served_orchestrator):
+    orch, ui = served_orchestrator
+    orch.run(cycles=5)
+    ui.update_state(**orch.end_metrics())
+    c = WsClient(ui.ws_port)
+    try:
+        # cmd: test → broadcast {"cmd": "test", "data": "foo"}
+        c.send_json({"cmd": "test"})
+        assert c.recv_json() == {"cmd": "test", "data": "foo"}
+
+        # cmd: agent → the reference's agent payload shape
+        c.send_json({"cmd": "agent"})
+        msg = c.recv_json()
+        assert msg["cmd"] == "agent"
+        agent = msg["agent"]
+        assert agent["is_orchestrator"] is True
+        for key in ("name", "computations", "replicas", "address"):
+            assert key in agent
+
+        # cmd: computations → one payload per graph node, reference keys
+        c.send_json({"cmd": "computations"})
+        msg = c.recv_json()
+        comps = {m["name"]: m for m in msg["computations"]}
+        assert set(comps) == {n.name for n in orch.cg.nodes}
+        v1 = comps["v1"]
+        for key in ("id", "type", "value", "neighbors", "algo",
+                    "msg_count", "msg_size", "cycles", "footprint"):
+            assert key in v1
+        assert v1["type"] == "variable"
+        assert v1["value"] == "G"  # tuto optimum
+        assert v1["algo"]["name"] == "maxsum"
+        assert comps["c_1_2"]["type"] == "factor"
+    finally:
+        c.close()
+
+
+def _wait_clients(ui, n, deadline=5.0):
+    """The client's handshake completes before the server registers it
+    in its client list — wait for registration before broadcasting."""
+    t0 = time.time()
+    while ui._ws.n_clients < n:
+        if time.time() - t0 > deadline:
+            raise AssertionError("ws client not registered in time")
+        time.sleep(0.01)
+
+
+def test_events_are_pushed(served_orchestrator):
+    orch, ui = served_orchestrator
+    c = WsClient(ui.ws_port)
+    try:
+        _wait_clients(ui, 1)
+        was_enabled = event_bus.enabled
+        event_bus.enabled = True
+        try:
+            event_bus.send("computations.value.v1", "R")
+        finally:
+            event_bus.enabled = was_enabled
+        msg = c.recv_json()
+        assert msg == {"evt": "value", "computation": "v1", "value": "R"}
+    finally:
+        c.close()
+
+
+def test_close_message_on_stop(served_orchestrator):
+    _, ui = served_orchestrator
+    c = WsClient(ui.ws_port)
+    _wait_clients(ui, 1)
+    ui.stop()
+    msg = c.recv_json()
+    assert msg == {"cmd": "close"}
+    c.close()
+
+
+def test_ping_pong(served_orchestrator):
+    from pydcop_tpu.runtime.ws import OP_PING, OP_PONG
+
+    _, ui = served_orchestrator
+    c = WsClient(ui.ws_port)
+    try:
+        c.sock.sendall(encode_frame(b"hb", OP_PING, mask=True))
+        opcode, payload = read_frame(c.sock)
+        assert opcode == OP_PONG and payload == b"hb"
+    finally:
+        c.close()
+
+
+def test_bad_messages_do_not_kill_connection(served_orchestrator):
+    """Non-object JSON and garbage must not disconnect the client
+    (one malformed GUI message would otherwise drop the session)."""
+    _, ui = served_orchestrator
+    c = WsClient(ui.ws_port)
+    try:
+        _wait_clients(ui, 1)
+        for bad in ('[1]', '"hello"', "not json"):
+            c.sock.sendall(encode_frame(bad.encode(), OP_TEXT, mask=True))
+        c.send_json({"cmd": "test"})
+        assert c.recv_json() == {"cmd": "test", "data": "foo"}
+    finally:
+        c.close()
+
+
+def test_pipelined_first_frame_not_lost(served_orchestrator):
+    """A frame sent back-to-back with the HTTP upgrade (TCP coalescing)
+    must still be processed (handshake leftover buffering)."""
+    import base64 as b64
+
+    _, ui = served_orchestrator
+    sock = socket.create_connection(("127.0.0.1", ui.ws_port), timeout=5)
+    key = b64.b64encode(os.urandom(16)).decode()
+    frame = encode_frame(json.dumps({"cmd": "test"}).encode(),
+                         OP_TEXT, mask=True)
+    sock.sendall(
+        f"GET / HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+        f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+        f"Sec-WebSocket-Version: 13\r\n\r\n".encode() + frame
+    )
+    resp = b""
+    while b"\r\n\r\n" not in resp:
+        resp += sock.recv(4096)
+    leftover = resp.split(b"\r\n\r\n", 1)[1]
+
+    class _Rdr:
+        def __init__(self):
+            self.buf = leftover
+
+        def recv(self, n):
+            if self.buf:
+                out, self.buf = self.buf[:n], self.buf[n:]
+                return out
+            return sock.recv(n)
+
+    sock.settimeout(5)
+    opcode, payload = read_frame(_Rdr())
+    assert opcode == OP_TEXT
+    assert json.loads(payload) == {"cmd": "test", "data": "foo"}
+    sock.close()
+
+
+def test_oversized_frame_is_refused(served_orchestrator):
+    """A client-claimed multi-GB payload closes the connection instead
+    of allocating unbounded memory."""
+    import struct
+
+    _, ui = served_orchestrator
+    c = WsClient(ui.ws_port)
+    _wait_clients(ui, 1)
+    # header claiming 2^40 bytes, masked
+    c.sock.sendall(bytes([0x81, 0x80 | 127]) + struct.pack(">Q", 1 << 40))
+    t0 = time.time()
+    while ui._ws.n_clients > 0 and time.time() - t0 < 5:
+        time.sleep(0.05)
+    assert ui._ws.n_clients == 0
+    c.close()
