@@ -679,10 +679,25 @@ def main():
             extra["local_error"] = repr(e)
 
     if args.only in ("all", "convergence"):
-        try:
-            extra.update(bench_convergence_stretch(args))
-        except Exception as e:
-            extra["stretch_error"] = repr(e)
+        # the tunneled remote-compile service occasionally drops a
+        # response mid-read; one retry keeps such a transient from
+        # costing the recorded stretch number.  Deterministic failures
+        # (OOM, shape errors) are not retried — rerunning a multi-minute
+        # bench to hit the same error would just double time-to-failure.
+        for attempt in (1, 2):
+            try:
+                extra.update(bench_convergence_stretch(args))
+                extra.pop("stretch_error", None)
+                break
+            except Exception as e:
+                extra["stretch_error"] = repr(e)
+                transient = any(
+                    marker in repr(e)
+                    for marker in ("remote_compile", "read body",
+                                   "Connection", "Socket closed")
+                )
+                if not transient:
+                    break
 
     if args.only in ("all", "sharded"):
         try:
