@@ -150,6 +150,55 @@ def select_fused_runner(solver, n, build_runner, candidates):
     return None
 
 
+def build_stochastic_fused_runner(solver, n, kernel_kwargs,
+                                  split_keys=False):
+    """run_chunk factory shared by the DSA-family fused fast paths
+    (dsa / dsatuto / mixeddsa / adsa): pack the assignment, pre-draw the
+    per-cycle uniforms from the generic path's exact PRNG stream, scan
+    fused multi-cycle pallas kernels, unpack.  ``split_keys`` draws the
+    (wake, move) pair adsa's cycle splits from each key."""
+    from pydcop_tpu.ops.pallas_local_search import (
+        pack_x,
+        packed_dsa_cycles,
+        uniforms_for_keys,
+        uniforms_for_split_keys,
+        unpack_x,
+    )
+
+    pls = solver.packed_ls
+
+    def build_runner(group):
+        @jax.jit
+        def run_chunk(state, keys):
+            (x,) = state
+            x_row = pack_x(pls, x)
+            if split_keys:
+                wake_u, move_u = uniforms_for_split_keys(pls, keys)
+                shape = (n // group, group, move_u.shape[1])
+                xs = (wake_u.reshape(shape), move_u.reshape(shape))
+
+                def body(xr, us):
+                    w, m = us
+                    return packed_dsa_cycles(
+                        pls, xr, m, awake_uniforms=w, **kernel_kwargs
+                    ), None
+            else:
+                u = uniforms_for_keys(pls, keys)
+                xs = u.reshape(n // group, group, u.shape[1])
+
+                def body(xr, u_):
+                    return packed_dsa_cycles(
+                        pls, xr, u_, **kernel_kwargs
+                    ), None
+
+            x_row, _ = jax.lax.scan(body, x_row, xs)
+            return (unpack_x(pls, x_row),), None
+
+        return run_chunk
+
+    return build_runner
+
+
 class LocalSearchSolver(SynchronousTensorSolver):
     """Base for local-search solvers: state = (x, aux...); random init.
 

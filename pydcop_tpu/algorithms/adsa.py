@@ -38,8 +38,9 @@ algo_params = [
 
 
 class ADsaSolver(LocalSearchSolver):
-    def __init__(self, dcop, tensors, algo_def, seed=0):
-        super().__init__(dcop, tensors, algo_def, seed)
+    def __init__(self, dcop, tensors, algo_def, seed=0, use_packed=None):
+        super().__init__(dcop, tensors, algo_def, seed,
+                         use_packed=use_packed)
         self.probability = float(self.params.get("probability", 0.7))
         self.variant = self.params.get("variant", "B")
         self.activation = float(self.params.get("activation", 0.5))
@@ -71,6 +72,24 @@ class ADsaSolver(LocalSearchSolver):
             want = improving | lateral
         move = want & activate & awake
         return (jnp.where(move, best_val, x).astype(jnp.int32),)
+
+    def _chunk_runner(self, n, collect: bool = True):
+        """Fused fast path (ops.pallas_local_search.packed_dsa_cycles
+        with the adsa wake mask), consuming the generic path's exact
+        split-key PRNG stream — bit-identical to :meth:`cycle`."""
+        if collect or self.packed is None:
+            return super()._chunk_runner(n, collect)
+        from pydcop_tpu.algorithms._local_search import (
+            build_stochastic_fused_runner,
+        )
+
+        build_runner = build_stochastic_fused_runner(
+            self, n,
+            dict(probability=self.probability, variant=self.variant,
+                 activation=self.activation),
+            split_keys=True,
+        )
+        return self._fused_chunk_runner(n, collect, build_runner)
 
 
 def build_solver(dcop: DCOP, computation_graph=None, algo_def=None, seed=0):

@@ -75,36 +75,14 @@ class DsaSolver(LocalSearchSolver):
         is bit-identical (tests/unit/test_pallas_local_search.py)."""
         if collect or self.packed is None:
             return super()._chunk_runner(n, collect)
-        from pydcop_tpu.ops.pallas_local_search import (
-            pack_x,
-            packed_dsa_cycles,
-            uniforms_for_keys,
-            unpack_x,
+        from pydcop_tpu.algorithms._local_search import (
+            build_stochastic_fused_runner,
         )
 
-        pls = self.packed_ls
-        prob, variant = self.probability, self.variant
-
-        def build_runner(group):
-            @jax.jit
-            def run_chunk(state, keys):
-                (x,) = state
-                x_row = pack_x(pls, x)
-                uniforms = uniforms_for_keys(pls, keys)
-                u_groups = uniforms.reshape(
-                    n // group, group, uniforms.shape[1]
-                )
-
-                def body(xr, u):
-                    return packed_dsa_cycles(
-                        pls, xr, u, probability=prob, variant=variant
-                    ), None
-
-                x_row, _ = jax.lax.scan(body, x_row, u_groups)
-                return (unpack_x(pls, x_row),), None
-
-            return run_chunk
-
+        build_runner = build_stochastic_fused_runner(
+            self, n,
+            dict(probability=self.probability, variant=self.variant),
+        )
         return self._fused_chunk_runner(n, collect, build_runner)
 
 

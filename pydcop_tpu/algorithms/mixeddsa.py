@@ -31,8 +31,9 @@ algo_params = [
 
 
 class MixedDsaSolver(LocalSearchSolver):
-    def __init__(self, dcop, tensors, algo_def, seed=0):
-        super().__init__(dcop, tensors, algo_def, seed)
+    def __init__(self, dcop, tensors, algo_def, seed=0, use_packed=None):
+        super().__init__(dcop, tensors, algo_def, seed,
+                         use_packed=use_packed)
         self.proba_hard = float(self.params.get("proba_hard", 0.7))
         self.proba_soft = float(self.params.get("proba_soft", 0.5))
         self.variant = self.params.get("variant", "B")
@@ -57,6 +58,23 @@ class MixedDsaSolver(LocalSearchSolver):
             want = improving | lateral
         move = want & activate
         return (jnp.where(move, best_val, x).astype(jnp.int32),)
+
+    def _chunk_runner(self, n, collect: bool = True):
+        """Fused fast path (ops.pallas_local_search.packed_dsa_cycles
+        with the per-variable hard/soft probability) — bit-identical to
+        :meth:`cycle` (tests/unit/test_pallas_local_search.py)."""
+        if collect or self.packed is None:
+            return super()._chunk_runner(n, collect)
+        from pydcop_tpu.algorithms._local_search import (
+            build_stochastic_fused_runner,
+        )
+
+        build_runner = build_stochastic_fused_runner(
+            self, n,
+            dict(probability=self.proba_soft, variant=self.variant,
+                 probability_hard=self.proba_hard),
+        )
+        return self._fused_chunk_runner(n, collect, build_runner)
 
 
 def build_solver(dcop: DCOP, computation_graph=None, algo_def=None, seed=0):

@@ -327,25 +327,47 @@ def packed_dsa_cycles(
     uniforms: jnp.ndarray,
     probability: float,
     variant: str = "B",
+    probability_hard: Optional[float] = None,
+    awake_uniforms: Optional[jnp.ndarray] = None,
+    activation: Optional[float] = None,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
-    """``n_cycles`` fused DSA cycles (variants A/B/C) in ONE pallas
-    kernel.  ``uniforms`` is [n_cycles, Vp] — one coin per variable per
-    cycle, pre-drawn so the fused path replays the generic path's PRNG
-    stream exactly.  Returns the updated [1, Vp] row."""
+    """``n_cycles`` fused DSA-family cycles (variants A/B/C) in ONE
+    pallas kernel.  ``uniforms`` is [n_cycles, Vp] — one move coin per
+    variable per cycle, pre-drawn so the fused path replays the generic
+    path's PRNG stream exactly.  Returns the updated [1, Vp] row.
+
+    Two optional rule extensions cover the rest of the stochastic
+    family:
+
+    * mixeddsa: ``probability_hard`` — variables in hard conflict
+      (current local cost ≥ the hard threshold) move with this
+      probability instead of ``probability`` (MixedDsaSolver.cycle);
+    * adsa: ``awake_uniforms`` [n_cycles, Vp] + ``activation`` — a
+      variable only acts when its wake coin clears the activation
+      probability (ADsaSolver.cycle's timer emulation).
+    """
     n_cycles = int(uniforms.shape[0])
     if not 1 <= n_cycles <= 64:
         raise ValueError(f"n_cycles must be in [1, 64], got {n_cycles}")
     if variant not in ("A", "B", "C"):
         raise ValueError(f"unknown DSA variant {variant!r}")
+    if (awake_uniforms is None) != (activation is None):
+        raise ValueError(
+            "awake_uniforms and activation must be passed together"
+        )
     interpret = _resolve_interpret(interpret)
     pg = pls.pg
     D, Vp = pg.D, pg.Vp
     prefer_change = variant in ("B", "C")
+    adsa_mode = awake_uniforms is not None
 
-    def kern(x_ref, u_ref, unary_ref, maskp_ref, colm_ref,
-             c_r1, c_g1, c_ss, c_g2, c_r2, *slab_refs_and_out):
-        slab_refs, x_out = slab_refs_and_out[:-1], slab_refs_and_out[-1]
+    def kern(x_ref, u_ref, *rest):
+        if adsa_mode:
+            au_ref, rest = rest[0], rest[1:]
+        (unary_ref, maskp_ref, colm_ref,
+         c_r1, c_g1, c_ss, c_g2, c_r2) = rest[:8]
+        slab_refs, x_out = rest[8:-1], rest[-1]
         slabs = [ref[:] for ref in slab_refs]
         unary = unary_ref[:]
         mask_p = maskp_ref[:]
@@ -358,28 +380,40 @@ def packed_dsa_cycles(
             cur, best_idx, gain = _cur_best_gain(
                 pg, tables, x, prefer_change
             )
+            conflict = cur >= _HARD
             improving = gain > 1e-9
             if variant == "A":
                 want = improving
             else:
                 lateral = (gain <= 1e-9) & (best_idx != x)
                 if variant == "B":
-                    want = improving | (lateral & (cur >= _HARD))
+                    want = improving | (lateral & conflict)
                 else:  # C
                     want = improving | lateral
-            activate = u_ref[c: c + 1, :] < probability
-            x = jnp.where(want & activate & (colm > 0), best_idx, x)
+            u = u_ref[c: c + 1, :]
+            if probability_hard is None:
+                activate = u < probability
+            else:
+                p = jnp.where(conflict, probability_hard, probability)
+                activate = u < p
+            move = want & activate & (colm > 0)
+            if adsa_mode:
+                move = move & (au_ref[c: c + 1, :] < activation)
+            x = jnp.where(move, best_idx, x)
         x_out[:] = x
 
-    n_in = 10 + D
+    operands = [x_row, uniforms]
+    if adsa_mode:
+        operands.append(awake_uniforms)
+    operands.extend([pg.unary_p, pg.mask_p, pls.colmask,
+                     *_plan_consts(pg.plan), *pls.cost_slabs])
     return pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((1, Vp), jnp.float32),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n_in,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(operands),
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=interpret,
-    )(x_row, uniforms, pg.unary_p, pg.mask_p, pls.colmask,
-      *_plan_consts(pg.plan), *pls.cost_slabs)
+    )(*operands)
 
 
 def uniforms_for_keys(
@@ -393,5 +427,21 @@ def uniforms_for_keys(
     def one(k):
         u = jax.random.uniform(k, (V,))
         return jnp.ones((Vp,), jnp.float32).at[pls.pg.var_order].set(u)
+
+    return jax.vmap(one)(keys)
+
+
+def uniforms_for_split_keys(pls: PackedLocalSearch, keys: jnp.ndarray):
+    """(wake [n, Vp], move [n, Vp]) uniforms matching ADsaSolver.cycle's
+    ``k_wake, k_move = jax.random.split(key)`` draws exactly — the fused
+    adsa path consumes the generic path's PRNG stream."""
+    V, Vp = pls.pg.n_vars, pls.pg.Vp
+
+    def one(k):
+        k_wake, k_move = jax.random.split(k)
+        pad = jnp.ones((Vp,), jnp.float32)
+        w = pad.at[pls.pg.var_order].set(jax.random.uniform(k_wake, (V,)))
+        m = pad.at[pls.pg.var_order].set(jax.random.uniform(k_move, (V,)))
+        return w, m
 
     return jax.vmap(one)(keys)

@@ -179,7 +179,7 @@ def test_solver_fused_path_matches_generic(algo):
     tensors_a = compile_constraint_graph(dcop)
     generic = mod.__dict__[
         "MgmSolver" if algo == "mgm" else "DsaSolver"
-    ](dcop, tensors_a, algo_def, seed=4)
+    ](dcop, tensors_a, algo_def, seed=4, use_packed=False)
     assert generic.packed_ls is None
     res_g = generic.run(cycles=20, chunk=20)
 
@@ -187,6 +187,87 @@ def test_solver_fused_path_matches_generic(algo):
     fused = mod.__dict__[
         "MgmSolver" if algo == "mgm" else "DsaSolver"
     ](dcop, tensors_b, algo_def, seed=4, use_packed=True)
+    assert fused.packed_ls is not None
+    res_f = fused.run(cycles=20, chunk=20)
+
+    assert res_f.assignment == res_g.assignment
+    assert res_f.cost == res_g.cost
+
+
+@pytest.mark.parametrize("variant", ["A", "B", "C"])
+def test_mixeddsa_fused_matches_generic(packed_instance, variant):
+    """packed_dsa_cycles with probability_hard ≡ MixedDsaSolver.cycle."""
+    from pydcop_tpu.algorithms.mixeddsa import MixedDsaSolver
+
+    dcop, tensors, pls = packed_instance
+    algo_def = AlgorithmDef.build_with_default_params(
+        "mixeddsa",
+        {"variant": variant, "proba_hard": 0.9, "proba_soft": 0.4},
+    )
+    solver = MixedDsaSolver(dcop, tensors, algo_def, seed=0)
+
+    x = random_valid_values(tensors, jax.random.PRNGKey(31))
+    keys = jax.random.split(jax.random.PRNGKey(77), 10)
+    state = (x,)
+    for k in keys:
+        state = solver.cycle(state, k)
+    expected = np.asarray(state[0])
+
+    uniforms = uniforms_for_keys(pls, keys)
+    x_row = packed_dsa_cycles(
+        pls, pack_x(pls, x), uniforms, probability=0.4, variant=variant,
+        probability_hard=0.9,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(unpack_x(pls, x_row)), expected)
+
+
+@pytest.mark.parametrize("variant", ["A", "B", "C"])
+def test_adsa_fused_matches_generic(packed_instance, variant):
+    """packed_dsa_cycles with the wake mask ≡ ADsaSolver.cycle (same
+    split-key PRNG stream), for every variant."""
+    from pydcop_tpu.algorithms.adsa import ADsaSolver
+    from pydcop_tpu.ops.pallas_local_search import uniforms_for_split_keys
+
+    dcop, tensors, pls = packed_instance
+    algo_def = AlgorithmDef.build_with_default_params(
+        "adsa", {"activation": 0.6, "probability": 0.7,
+                 "variant": variant})
+    solver = ADsaSolver(dcop, tensors, algo_def, seed=0)
+
+    x = random_valid_values(tensors, jax.random.PRNGKey(41))
+    keys = jax.random.split(jax.random.PRNGKey(55), 10)
+    state = (x,)
+    for k in keys:
+        state = solver.cycle(state, k)
+    expected = np.asarray(state[0])
+
+    wake_u, move_u = uniforms_for_split_keys(pls, keys)
+    x_row = packed_dsa_cycles(
+        pls, pack_x(pls, x), move_u, probability=0.7, variant=variant,
+        awake_uniforms=wake_u, activation=0.6,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(unpack_x(pls, x_row)), expected)
+
+
+@pytest.mark.parametrize("algo", ["mixeddsa", "adsa"])
+def test_solver_fused_path_mixed_adsa(algo):
+    """Full-solver equivalence through the fused chunk runners."""
+    from pydcop_tpu.algorithms.adsa import ADsaSolver
+    from pydcop_tpu.algorithms.mixeddsa import MixedDsaSolver
+
+    cls = MixedDsaSolver if algo == "mixeddsa" else ADsaSolver
+    dcop, _ = _instance(n_vars=30, n_edges=70, seed=19)
+    algo_def = AlgorithmDef.build_with_default_params(algo)
+
+    generic = cls(dcop, compile_constraint_graph(dcop), algo_def, seed=4,
+                  use_packed=False)
+    assert generic.packed_ls is None
+    res_g = generic.run(cycles=20, chunk=20)
+
+    fused = cls(dcop, compile_constraint_graph(dcop), algo_def, seed=4,
+                use_packed=True)
     assert fused.packed_ls is not None
     res_f = fused.run(cycles=20, chunk=20)
 
