@@ -133,3 +133,77 @@ def test_dpop_sweep_used_for_all_edge_cases():
     assert solver.last_engine == "sweep"
     _, expected = brute_force(dcop)
     assert res.cost == pytest.approx(expected)
+
+
+@pytest.mark.parametrize("algo", COMPLETE)
+def test_tie_dense_instance_returns_an_optimum(algo):
+    """All-equal cost tables make every assignment optimal — heavy
+    tie-breaking stress; the algorithms may pick any optimum but the
+    COST must match brute force."""
+    mats = {
+        (0, 1): [[3, 3], [3, 3]],
+        (1, 2): [[3, 3], [3, 3]],
+        (0, 2): [[3, 3], [3, 3]],
+    }
+    dcop = binary_dcop(mats)
+    res = solve_result(dcop, algo)
+    _, bf_cost = brute_force(dcop)
+    assert res.cost == bf_cost == 9
+
+
+@pytest.mark.parametrize("algo", COMPLETE)
+def test_star_topology(algo):
+    """A hub with 6 leaves: the pseudo-tree is one level deep and wide
+    (DPOP separator stress), the chain walk is hub-first or hub-last."""
+    rng = np.random.default_rng(3)
+    mats = {
+        (0, j): rng.integers(0, 9, (3, 2)).tolist() for j in range(1, 7)
+    }
+    dcop = binary_dcop(mats, dom_sizes={0: 3})
+    res = solve_result(dcop, algo)
+    _, bf_cost = brute_force(dcop)
+    assert res.cost == bf_cost
+    # the reported assignment must itself achieve the reported cost
+    assert dcop.solution_cost(res.assignment, 10000000)[1] == bf_cost
+
+
+@pytest.mark.parametrize("algo", COMPLETE)
+def test_hard_infeasible_csp_returns_min_violation(algo):
+    """Every assignment violates at least one pseudo-hard constraint
+    (10000 penalty): the exact algorithms must return an assignment with
+    the FEWEST violations.  Metrics semantics (reference
+    global_metrics): entries at/above the infinity threshold count as
+    `violation`, not as cost — so the optimum here is violation=1 with
+    the satisfiable constraint satisfied (cost 0)."""
+    never = [[10000, 10000], [10000, 10000]]
+    diff = [[10000, 0], [0, 10000]]
+    mats = {(0, 1): never, (1, 2): diff}
+    dcop = binary_dcop(mats)
+    res = solve_result(dcop, algo)
+    assert res.violation == 1  # the unsatisfiable constraint only
+    assert res.cost == 0.0     # the diff constraint IS satisfied
+    assert res.assignment["v1"] != res.assignment["v2"]
+
+
+@pytest.mark.parametrize("algo", COMPLETE)
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_random_mixed_domains_vs_bruteforce(algo, seed):
+    """Randomized graphs with ragged domain sizes (2-4), cross-checked
+    against brute force — the padding paths of all three engines."""
+    rng = np.random.default_rng(seed)
+    n = 7
+    sizes = {i: int(rng.integers(2, 5)) for i in range(n)}
+    mats = {}
+    for i in range(1, n):
+        p = int(rng.integers(0, i))
+        mats[(p, i)] = rng.integers(0, 10, (sizes[p], sizes[i])).tolist()
+    # a couple of extra (non-tree) edges
+    for _ in range(2):
+        i, j = sorted(rng.choice(n, 2, replace=False).tolist())
+        if (i, j) not in mats:
+            mats[(i, j)] = rng.integers(
+                0, 10, (sizes[i], sizes[j])).tolist()
+    dcop = binary_dcop(mats, dom_sizes=sizes)
+    res = solve_result(dcop, algo)
+    _, bf_cost = brute_force(dcop)
+    assert res.cost == bf_cost
