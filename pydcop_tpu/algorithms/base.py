@@ -159,7 +159,13 @@ class SynchronousTensorSolver:
 
         caller_chunk = chunk is not None
         if chunk is None:
-            chunk = 8
+            # prime default: chunk_converged compares states one chunk
+            # apart, so an oscillation whose period divides the chunk
+            # size would look like a fixed point — with a prime chunk
+            # only period-7 (and true fixed points) can alias, and two
+            # stable chunks in a row (stable_chunks=2, 14 cycles) rules
+            # out period 7 too unless the period is exactly 7 AND 14
+            chunk = 7
         if (
             target is not None
             and not collect_cycles

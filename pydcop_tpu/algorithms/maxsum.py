@@ -141,8 +141,13 @@ class MaxSumSolver(SynchronousTensorSolver):
         within the ``stability`` coefficient — the reference's own
         convergence test (approx_match: symmetric relative difference
         ``2|a-b|/|a+b| < coeff``, equal values always match,
-        maxsum.py:98-100,620-639), applied at chunk boundaries (several
-        cycles apart, i.e. at least as strict per check)."""
+        maxsum.py:98-100,620-639), applied at chunk boundaries.  Note
+        this compares states several cycles apart rather than the
+        reference's consecutive cycles: stricter against drift, but a
+        message stream oscillating with a period that divides the chunk
+        size would alias to "stable" — the harness uses a prime chunk
+        (base.py) so only period-equal-to-chunk oscillations can alias,
+        and two consecutive stable chunks are required."""
         if super().chunk_converged(prev_state, state):
             return True
         return bool(jnp.all(

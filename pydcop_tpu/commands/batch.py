@@ -17,6 +17,15 @@ the whole batch completes, the file is renamed
 scratch).  The total job count (sets × files × iterations ×
 combinations) is estimated up front (reference batch.py:159-169).
 
+When NO progress file exists — outputs produced before the progress
+protocol, or a sweep already completed and renamed to ``done_*`` —
+existing output files are trusted as completed and skipped, so
+re-invoking an old or finished sweep does not silently re-run and
+overwrite everything; pass ``--force`` to re-run those jobs anyway.
+While a progress file exists it is authoritative: an output file
+without a ``JID:`` entry is an in-flight job that was killed, and is
+re-run rather than trusted.
+
 Batch definition format:
 
 ```yaml
@@ -55,6 +64,11 @@ def set_parser(subparsers):
     parser.add_argument("--simulate", action="store_true",
                         help="print commands without running")
     parser.add_argument("--output_dir", default="batch_output")
+    parser.add_argument(
+        "--force", action="store_true",
+        help="re-run jobs whose output file exists but has no progress "
+        "entry (by default such outputs are trusted when no progress "
+        "file exists)")
     return parser
 
 
@@ -143,6 +157,12 @@ def run_cmd(args):
     batch_stem = os.path.splitext(os.path.basename(args.batch_file))[0]
     progress_path = os.path.join(args.output_dir, f"progress_{batch_stem}")
     done_jobs = _load_progress(progress_path)
+    # no progress file → pre-protocol outputs or a completed (renamed to
+    # done_*) sweep: trust existing output files unless --force
+    trust_outputs = (
+        not os.path.exists(progress_path) and not getattr(
+            args, "force", False)
+    )
 
     total = estimate_jobs(definition)
     print(f"batch: {total} jobs total, {len(done_jobs)} already done "
@@ -154,7 +174,7 @@ def run_cmd(args):
             f.write(f"{batch_stem}_{datetime.datetime.now():%Y%m%d_%H%M}\n")
 
     for jid, out_path, cmd in _iter_jobs(definition, args.output_dir):
-        if jid in done_jobs:
+        if jid in done_jobs or (trust_outputs and os.path.exists(out_path)):
             n_skipped += 1
             continue
         if args.simulate:

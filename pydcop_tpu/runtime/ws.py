@@ -190,7 +190,11 @@ class WebSocketServer:
                 if opcode is None or opcode == OP_CLOSE:
                     break
                 if opcode == OP_PING:
-                    with self._write_locks[conn]:
+                    with self._lock:
+                        wlock = self._write_locks.get(conn)
+                    if wlock is None:  # a failed send() dropped the client
+                        break
+                    with wlock:
                         conn.sendall(encode_frame(payload, OP_PONG))
                 elif opcode == OP_TEXT and self.on_message is not None:
                     try:
