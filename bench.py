@@ -353,6 +353,101 @@ def bench_local_search(dcop, algo: str, cycles: int = 2000, repeat: int = 3):
     return cycles / robust_best(times)
 
 
+def build_scalefree_dcop(args):
+    """Barabási–Albert coloring instance with the top hub boosted past
+    degree 500 (the BA tail at 10k vars tops out ~300).  Exercises hub
+    splitting in the packed engines (VERDICT r3 item 2): one such hub
+    used to knock the whole graph onto the 8-25x slower generic path."""
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+    from pydcop_tpu.generators import generate_graph_coloring
+
+    rng = np.random.default_rng(11)
+    dcop = generate_graph_coloring(
+        n_variables=args.vars, n_colors=args.colors,
+        graph_type="scalefree", m_edge=3, soft=True, n_agents=1, seed=1,
+    )
+    deg: dict = {}
+    neighbors = set()
+    for c in dcop.constraints.values():
+        names = [v.name for v in c.dimensions]
+        for n_ in names:
+            deg[n_] = deg.get(n_, 0) + 1
+    hub = max(deg, key=deg.get)
+    for c in dcop.constraints.values():
+        names = [v.name for v in c.dimensions]
+        if hub in names:
+            neighbors.update(names)
+    hubv = dcop.variables[hub]
+    C = args.colors
+    k = 0
+    for vn, var in dcop.variables.items():
+        if deg[hub] + k >= 520:
+            break
+        if vn == hub or vn in neighbors:
+            continue
+        mat = rng.uniform(0, 1, (C, C)).astype(np.float32) \
+            + np.eye(C, dtype=np.float32) * 10
+        dcop.add_constraint(
+            NAryMatrixRelation([hubv, var], mat, name=f"hub_extra_{k}")
+        )
+        k += 1
+    return dcop, deg[hub] + k
+
+
+def bench_scalefree(args):
+    """Packed-engine rates on the scale-free instance: MaxSum iters/s
+    (fused pallas, hub splitting) + MGM cycles/s.  Returns extras dict."""
+    import jax
+
+    from pydcop_tpu.ops import compile_factor_graph
+    from pydcop_tpu.ops.pallas_maxsum import (
+        packed_cycles, packed_init_state, try_pack_for_pallas,
+    )
+
+    dcop, hub_deg = build_scalefree_dcop(args)
+    out = {"scalefree_hub_degree": hub_deg}
+    if jax.default_backend() == "tpu":
+        tensors = compile_factor_graph(dcop)
+        packed = try_pack_for_pallas(tensors)
+        if packed is None or packed.hub_nsteps == 0:
+            out["scalefree_error"] = "instance did not pack with hub split"
+            return out
+
+        chunk = 5
+
+        @jax.jit
+        def run_n(q, r):
+            def body(carry, _):
+                q, r = carry
+                q2, r2, _, _ = packed_cycles(packed, q, r, chunk,
+                                             damping=0.5)
+                return (q2, r2), ()
+
+            (q, r), _ = jax.lax.scan(
+                body, (q, r), None, length=args.cycles // chunk
+            )
+            return q, r
+
+        q0, r0 = packed_init_state(packed)
+        q, r = run_n(q0, r0)
+        jax.block_until_ready((q, r))
+        times = []
+        for _ in range(args.repeat):
+            t0 = time.perf_counter()
+            q, r = run_n(q0, r0)
+            jax.block_until_ready((q, r))
+            times.append(time.perf_counter() - t0)
+        rate = (args.cycles // chunk * chunk) / robust_best(times)
+        out[f"maxsum_iters_per_sec_scalefree_{args.vars}var"] = round(
+            rate, 1)
+    try:
+        out[f"mgm_cycles_per_sec_scalefree_{args.vars}var"] = round(
+            bench_local_search(dcop, "mgm", repeat=args.repeat), 1)
+    except Exception as e:  # never lose the maxsum number
+        out["scalefree_mgm_error"] = repr(e)
+    return out
+
+
 def bench_convergence_stretch(args):
     """North star: wall-clock to MaxSum convergence on the 100k-var /
     300k-edge coloring instance.
@@ -629,7 +724,7 @@ def main():
     ap.add_argument(
         "--only",
         choices=["all", "maxsum", "dpop", "convergence", "local",
-                 "sharded", "sharded-inner"],
+                 "scalefree", "sharded", "sharded-inner"],
         default="all",
     )
     ap.add_argument("--watchdog", type=float, default=900.0)
@@ -751,6 +846,12 @@ def main():
         except Exception as e:
             extra["local_error"] = repr(e)
 
+    if args.only in ("all", "scalefree"):
+        try:
+            extra.update(bench_scalefree(args))
+        except Exception as e:
+            extra["scalefree_error"] = repr(e)
+
     if args.only in ("all", "convergence"):
         # the tunneled remote-compile service occasionally drops a
         # response mid-read; one retry keeps such a transient from
@@ -779,7 +880,8 @@ def main():
         except Exception as e:
             extra["sharded_error"] = repr(e)
 
-    if args.only in ("dpop", "local", "convergence", "sharded") and not value:
+    if args.only in ("dpop", "local", "convergence", "scalefree",
+                     "sharded") and not value:
         # single-part run: promote the part's headline measurement (not
         # config constants like stretch_vars) to the primary slot
         headline = ("_per_sec", "_wall_s", "_cycles_per")

@@ -47,6 +47,11 @@ from pydcop_tpu.ops.compile import PAD_COST
 from pydcop_tpu.ops.pallas_maxsum import (
     PackedMaxSumGraph,
     _LANES,
+    _compiler_params,
+    _hub_op,
+    _hub_operands,
+    _hub_spread,
+    _hub_sum,
     _resolve_interpret,
     try_pack_for_pallas,
 )
@@ -117,12 +122,17 @@ def pack_from_pg(pg: Optional[PackedMaxSumGraph]
         jnp.asarray(cost_np[j * D: (j + 1) * D, :]) for j in range(D)
     )
     # static neighbor index per slot: expand own indices to slots on the
-    # host, route them through the plan's numpy reference once
+    # host, route them through the plan's numpy reference once.  Uses the
+    # per-COLUMN variable map (col_var) rather than idx_np so a hub's
+    # member sub-columns advertise the hub's index to their neighbors.
+    col_idx = np.full((1, Vp), _BIG_IDX, dtype=np.float32)
+    cv = pg.col_var
+    col_idx[0, cv >= 0] = cv[cv >= 0].astype(np.float32)
     own_idx_slots = np.full((1, N), _BIG_IDX, dtype=np.float32)
     for cls, nvp, voff, soff in pg.buckets:
         for k in range(cls):
             own_idx_slots[0, soff + k * nvp: soff + (k + 1) * nvp] = \
-                idx_np[0, voff: voff + nvp]
+                col_idx[0, voff: voff + nvp]
     mate = pg.plan.apply_numpy(own_idx_slots)
     mate = np.where(sreal > 0, mate, _BIG_IDX).astype(np.float32)
     return PackedLocalSearch(
@@ -200,17 +210,20 @@ def _permute1(pg: PackedMaxSumGraph, row, consts):
 
 
 def _local_tables_body(pg: PackedMaxSumGraph, x_row, slabs, unary, mask_p,
-                       consts):
+                       consts, hub=None):
     """tables[d, v] = unary + Σ_slots cost(v=d | other endpoint at x);
     PAD_COST at invalid (d, v) slots.  One values permute.  ``slabs`` are
     the D per-other-value cost planes [D, N] (see PackedLocalSearch)."""
     D = pg.D
-    xs = _bucket_expand(pg, x_row, 1)  # [1, N] own value per slot
+    # hub members carry the hub's value for their slots
+    xs = _bucket_expand(pg, _hub_spread(pg, x_row, 1, hub), 1)
     xo = _permute1(pg, xs, consts)
     contrib = slabs[0]
     for j in range(1, D):
         contrib = jnp.where(xo == float(j), slabs[j], contrib)
-    tables = unary + _bucket_reduce(pg, contrib, D, jnp.add)
+    tables = _hub_sum(
+        pg, unary + _bucket_reduce(pg, contrib, D, jnp.add), D, hub
+    )
     return jnp.where(mask_p > 0, tables, PAD_COST)
 
 
@@ -242,25 +255,33 @@ def _cur_best_gain(pg: PackedMaxSumGraph, tables, x_row, prefer_change):
 
 
 def _mgm_move(pls: PackedLocalSearch, gain, idx_row, mate_idx, sreal,
-              consts):
+              consts, hub=None):
     """MGM neighborhood arbitration (neighborhood_winner semantics):
     True [1, Vp] where own gain is the strict neighborhood max, lexic
     tie-break by original variable index.  One gains permute; the
     tie-break indices are the STATIC mate_idx array — topology doesn't
     change at runtime, so only gains travel."""
     pg = pls.pg
-    gs = _bucket_expand(pg, gain, 1)
+    # hub member slots must send the hub's gain to their neighbors
+    gs = _bucket_expand(pg, _hub_spread(pg, gain, 1, hub), 1)
     gn = _permute1(pg, gs, consts)
     gn = gn * sreal  # dummy slots pull their own gain via identity: zero it
+    # hub combine: a hub's neighborhood max/tie-break spans ALL its
+    # sub-columns' slots
     neigh_max = jnp.maximum(
-        _bucket_reduce(pg, gn, 1, jnp.maximum), 0.0
+        _hub_op(pg, _bucket_reduce(pg, gn, 1, jnp.maximum), 1, hub,
+                jnp.maximum),
+        0.0,
     )
     nm_exp = _bucket_expand(pg, neigh_max, 1)
     idx_cand = jnp.where(gn >= nm_exp - 1e-9, mate_idx, _BIG_IDX)
     # fill=_BIG_IDX: degree-0 variables have no neighbor at max, so the
     # lexic tie-break must let them through (generic: idx_at_max = V)
-    idx_at_max = _bucket_reduce(pg, idx_cand, 1, jnp.minimum,
-                                fill=_BIG_IDX)
+    idx_at_max = _hub_op(
+        pg,
+        _bucket_reduce(pg, idx_cand, 1, jnp.minimum, fill=_BIG_IDX),
+        1, hub, jnp.minimum,
+    )
     return (gain > 0) & (
         (gain > neigh_max + 1e-9)
         | ((jnp.abs(gain - neigh_max) <= 1e-9) & (idx_row < idx_at_max))
@@ -290,9 +311,16 @@ def packed_mgm_cycles(
     pg = pls.pg
     D, Vp, N = pg.D, pg.Vp, pg.N
 
+    hub_ops = _hub_operands(pg)
+
     def kern(x_ref, unary_ref, maskp_ref, idx_ref, mate_ref, colm_ref,
-             sreal_ref, c_r1, c_g1, c_ss, c_g2, c_r2, *slab_refs_and_out):
-        slab_refs, x_out = slab_refs_and_out[:-1], slab_refs_and_out[-1]
+             sreal_ref, c_r1, c_g1, c_ss, c_g2, c_r2, *rest):
+        if hub_ops:
+            hub = (rest[0][:], rest[1][:], rest[2][:])
+            rest = rest[3:]
+        else:
+            hub = None
+        slab_refs, x_out = rest[:-1], rest[-1]
         slabs = [ref[:] for ref in slab_refs]
         unary = unary_ref[:]
         mask_p = maskp_ref[:]
@@ -304,21 +332,24 @@ def packed_mgm_cycles(
         x = x_ref[:]
         for _ in range(n_cycles):
             tables = _local_tables_body(pg, x, slabs, unary, mask_p,
-                                        consts)
+                                        consts, hub=hub)
             _cur, best_idx, gain = _cur_best_gain(pg, tables, x, False)
-            move = _mgm_move(pls, gain, idx_row, mate_idx, sreal, consts)
+            move = _mgm_move(pls, gain, idx_row, mate_idx, sreal, consts,
+                             hub=hub)
             x = jnp.where(move & (colm > 0), best_idx, x)
         x_out[:] = x
 
-    n_in = 12 + D
+    n_in = 12 + D + len(hub_ops)
     return pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((1, Vp), jnp.float32),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n_in,
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=interpret,
+        compiler_params=_compiler_params(),
     )(x_row, pg.unary_p, pg.mask_p, pls.idx_row, pls.mate_idx,
-      pls.colmask, pls.sreal, *_plan_consts(pg.plan), *pls.cost_slabs)
+      pls.colmask, pls.sreal, *_plan_consts(pg.plan), *hub_ops,
+      *pls.cost_slabs)
 
 
 def packed_dsa_cycles(
@@ -362,12 +393,20 @@ def packed_dsa_cycles(
     prefer_change = variant in ("B", "C")
     adsa_mode = awake_uniforms is not None
 
+    hub_ops = _hub_operands(pg)
+
     def kern(x_ref, u_ref, *rest):
         if adsa_mode:
             au_ref, rest = rest[0], rest[1:]
         (unary_ref, maskp_ref, colm_ref,
          c_r1, c_g1, c_ss, c_g2, c_r2) = rest[:8]
-        slab_refs, x_out = rest[8:-1], rest[-1]
+        rest = rest[8:]
+        if hub_ops:
+            hub = (rest[0][:], rest[1][:], rest[2][:])
+            rest = rest[3:]
+        else:
+            hub = None
+        slab_refs, x_out = rest[:-1], rest[-1]
         slabs = [ref[:] for ref in slab_refs]
         unary = unary_ref[:]
         mask_p = maskp_ref[:]
@@ -376,7 +415,7 @@ def packed_dsa_cycles(
         x = x_ref[:]
         for c in range(n_cycles):
             tables = _local_tables_body(pg, x, slabs, unary, mask_p,
-                                        consts)
+                                        consts, hub=hub)
             cur, best_idx, gain = _cur_best_gain(
                 pg, tables, x, prefer_change
             )
@@ -406,13 +445,14 @@ def packed_dsa_cycles(
     if adsa_mode:
         operands.append(awake_uniforms)
     operands.extend([pg.unary_p, pg.mask_p, pls.colmask,
-                     *_plan_consts(pg.plan), *pls.cost_slabs])
+                     *_plan_consts(pg.plan), *hub_ops, *pls.cost_slabs])
     return pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((1, Vp), jnp.float32),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(operands),
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=interpret,
+        compiler_params=_compiler_params(),
     )(*operands)
 
 

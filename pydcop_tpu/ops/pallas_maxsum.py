@@ -48,29 +48,76 @@ from pydcop_tpu.ops.pallas_permute import _permute_in_kernel, _plan_consts
 
 _LANES = 128
 _TILE = _LANES * _LANES  # elements routed per (b, l) plane
-_VMEM_BUDGET = 13 * 2**20  # leave headroom under ~16MB
+# Working-set budget for the ESTIMATE in _vmem_estimate.  v5e has 128MB
+# of physical VMEM; the default 16MB scoped-allocation limit is raised
+# per-kernel via CompilerParams(vmem_limit_bytes=_VMEM_LIMIT) below, so
+# the budget guards against genuinely oversized graphs, not the
+# compiler's conservative default.  The estimate runs ~40% under the
+# measured scoped allocation (16.3MB actual at 11.7MB estimated), so
+# 40MB estimated ≈ 56MB actual — comfortable headroom under _VMEM_LIMIT.
+_VMEM_BUDGET = 40 * 2**20
+_VMEM_LIMIT = 100 * 2**20
+
+
+def _compiler_params():
+    return pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
 
 
 _MAX_BUCKETS = 24
 # _cycle_body / packed_local_tables unroll a python loop of `cls` slice-adds
 # per degree bucket; a scale-free hub with degree in the thousands would blow
-# trace/compile time and kernel size, so above this slot class we fall back
-# to the generic engine (same spirit as the A>8 guard).  Known limitation:
-# one hub knocks the whole graph off the packed engine — splitting hub slots
-# across multiple padded columns would keep the rest packed (future work).
+# trace/compile time and kernel size, so above this slot class a variable is
+# SPLIT into several sub-columns of ≤ this many slots each (hub splitting,
+# see pack_for_pallas).  The sub-columns live as ordinary columns in the
+# degree-class buckets — dense lanes, no padding blowup — kept contiguous
+# within one 128-lane bin so the cross-column combine is a handful of
+# within-vreg lane gathers (suffix doubling + head spread, _hub_sum/_hub_op).
 _MAX_SLOT_CLASS = 96
 
 
-def _degree_classes(deg: np.ndarray) -> np.ndarray:
-    """Map each variable's degree to its slot-class (the padded per-variable
-    slot count).  Exact degrees when few are distinct; otherwise quantile
-    boundaries so bucket count stays bounded (scale-free graphs)."""
-    nz = np.unique(deg[deg > 0])
+def _class_bounds(deg: np.ndarray) -> np.ndarray:
+    """Slot-class boundaries for a population of (sub-)column degrees.
+    Exact degrees when few are distinct; otherwise boundaries are chosen
+    by a small DP MINIMIZING total padded slots
+    Σ_class cls · ceil(n_class/128)·128 — the quantity that decides
+    whether the graph fits the A≤8 permutation budget.  (Per-quantile
+    boundaries fragmented power-law degree tails into many near-empty
+    128-column bins: a 3-variable class-96 bucket pays 12,288 padded
+    slots.)"""
+    nz, cnt = np.unique(deg[deg > 0], return_counts=True)
     if len(nz) <= _MAX_BUCKETS:
-        return deg.copy()
-    qs = np.quantile(nz, np.linspace(0, 1, _MAX_BUCKETS + 1)[1:])
-    bounds = np.unique(np.ceil(qs).astype(np.int64))
+        return nz.astype(np.int64)
+    csum = np.concatenate([[0], np.cumsum(cnt)])
+    k, B = len(nz), _MAX_BUCKETS
+    INF = np.inf
+    dp = np.full((B + 1, k + 1), INF)
+    dp[0, 0] = 0.0
+    choice = np.zeros((B + 1, k + 1), dtype=np.int64)
+    for bnum in range(1, B + 1):
+        for j in range(1, k + 1):
+            # group = distinct degrees (i..j]; every member pads to nz[j-1]
+            # slots, columns pad to whole 128-lane bins
+            n = csum[j] - csum[:j]
+            cost = dp[bnum - 1, :j] + nz[j - 1] * (
+                np.ceil(n / _LANES) * _LANES
+            )
+            i = int(np.argmin(cost))
+            dp[bnum, j] = cost[i]
+            choice[bnum, j] = i
+    bnum = int(np.argmin(dp[:, k]))
+    bounds = []
+    j = k
+    while j > 0:
+        bounds.append(nz[j - 1])
+        j = int(choice[bnum, j])
+        bnum -= 1
+    return np.array(sorted(bounds), dtype=np.int64)
+
+
+def _apply_bounds(deg: np.ndarray, bounds: np.ndarray) -> np.ndarray:
     cls = np.zeros_like(deg)
+    if len(bounds) == 0:
+        return cls
     pos = np.searchsorted(bounds, deg[deg > 0])
     cls[deg > 0] = bounds[np.minimum(pos, len(bounds) - 1)]
     return cls
@@ -94,19 +141,33 @@ class PackedMaxSumGraph:
     vmask: jnp.ndarray  # [D, N] mask_p spread to slots (0 on dummy slots)
     inv_dcount: jnp.ndarray  # [1, N] 1/|valid values| per slot (0 dummy)
     var_order: jnp.ndarray  # [n_vars] padded column of each original var
+    # original variable id per padded column (-1 = dummy); hub members map
+    # to their hub variable.  Host-side numpy (used by pack_from_pg).
+    col_var: np.ndarray = None
+    # -- hub splitting (variables with degree > _MAX_SLOT_CLASS) ----------
+    # A hub's slots are split across m contiguous sub-columns inside a
+    # normal degree-class bucket; its full belief/table is recovered with
+    # hub_nsteps suffix-doubling lane gathers + one head-spread gather.
+    # The head sub-column is the hub's var_order column (mask/unary there).
+    hub_nsteps: int = 0
+    hub_steps_idx: Optional[jnp.ndarray] = None   # [nsteps*rows, 128] i32
+    hub_steps_mask: Optional[jnp.ndarray] = None  # [nsteps, Vp] f32
+    hub_head_idx: Optional[jnp.ndarray] = None    # [rows, 128] i32
 
     @property
     def vmem_bytes(self) -> int:
-        return _vmem_estimate(self.D, self.N, self.Vp)
+        return _vmem_estimate(self.D, self.N, self.Vp, self.hub_nsteps)
 
 
-def _vmem_estimate(D: int, N: int, Vp: int) -> int:
+def _vmem_estimate(D: int, N: int, Vp: int, hub_nsteps: int = 0) -> int:
     """Rough VMEM working-set bound of the cycle kernel: cost tables, q/r
     in+out, ~2 permute-stage temporaries, belief-side arrays, the 5 Clos
     plan index arrays (~5N int32), plus the A-way select stage of the
     permutation which materializes up to A candidate [D, TILE] planes
-    (A*_TILE == N, so that term is one extra D*N)."""
-    return 4 * (D * D * N + 7 * D * N + 3 * D * Vp + 5 * N)
+    (A*_TILE == N, so that term is one extra D*N).  Hub combines add the
+    step/head index+mask constants and one [D, Vp] gather temporary."""
+    hub = (2 * hub_nsteps + 1) * Vp + (D * Vp if hub_nsteps else 0)
+    return 4 * (D * D * N + 7 * D * N + 3 * D * Vp + 5 * N + hub)
 
 
 def try_pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
@@ -142,23 +203,87 @@ def pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
     edge_var = np.concatenate([vi[:, 0], vi[:, 1]])  # edge id e=p*F+f
     deg = np.bincount(edge_var, minlength=V)
 
-    # group variables by slot class (≈ exact degree, quantized when many)
-    cls_of = _degree_classes(deg)
-    if cls_of.max(initial=0) > _MAX_SLOT_CLASS:
-        return None  # hub degree would unroll too far; generic engine
+    # hub splitting: a variable with degree above the slot-class ceiling is
+    # split into m sub-columns of cls_h ≤ _MAX_SLOT_CLASS slots each (cls_h
+    # rounded up to a multiple of 8 to bound the distinct-bucket count).
+    # Sub-columns must stay inside one 128-lane bin for the gather-based
+    # combine, so per-hub degree is capped at _MAX_SLOT_CLASS * 128.
+    S = _MAX_SLOT_CLASS
+    hub_of = deg > S
+    if int(deg.max(initial=0)) > S * _LANES:
+        return None  # a single hub beyond ~12k neighbors: generic engine
+    hub_vars = np.flatnonzero(hub_of)
+    # balanced split: hub v becomes m = ceil(deg/S) sub-columns of
+    # sub_deg = ceil(deg/m) ≤ S slots each.  Sub-degrees join the class
+    # DP alongside ordinary degrees so sub-columns share buckets with
+    # the regular population (a fixed per-hub class would fragment the
+    # tail into near-empty 128-column bins).
+    hub_m = np.zeros(V, dtype=np.int64)
+    sub_deg = np.zeros(V, dtype=np.int64)
+    for v in hub_vars:
+        hub_m[v] = int(np.ceil(deg[v] / S))
+        sub_deg[v] = int(np.ceil(deg[v] / hub_m[v]))
+    pop = np.concatenate(
+        [deg[~hub_of]]
+        + [np.full(hub_m[v], sub_deg[v]) for v in hub_vars]
+    )
+    bounds = _class_bounds(pop)
+    cls_of = _apply_bounds(np.where(hub_of, 0, deg), bounds)
+    hub_cls = _apply_bounds(sub_deg, bounds)
+    classes = sorted(
+        set(cls_of[~hub_of].tolist())
+        | set(hub_cls[hub_vars].tolist())
+    )
+
+    # column layout per class bucket: hub groups first (first-fit
+    # descending into 128-lane bins, so no group straddles a bin), then
+    # single variables fill the gaps
     buckets: List[Tuple[int, int, int, int]] = []
-    var_pcol = np.empty(V, dtype=np.int64)  # original var -> padded column
-    order_parts: List[np.ndarray] = []
+    var_pcol = np.full(V, -1, dtype=np.int64)  # var -> its (head) column
+    col_var_parts: List[np.ndarray] = []
+    group_heads: List[Tuple[int, int]] = []  # (head column, m)
+    max_m = 1
     voff = 0
-    for cls in sorted(set(cls_of.tolist())):
-        vs = np.flatnonzero(cls_of == cls)
-        nvp = max(_LANES, int(np.ceil(len(vs) / _LANES)) * _LANES)
-        var_pcol[vs] = voff + np.arange(len(vs))
-        order_parts.append(vs)
+    for cls in classes:
+        gvars = [v for v in hub_vars if hub_cls[v] == cls]
+        svars = np.flatnonzero((cls_of == cls) & ~hub_of).tolist()
+        if not gvars and not svars:
+            continue
+        bins: List[List[int]] = []  # per 128-lane bin: var id per column
+        for v in sorted(gvars, key=lambda u: -hub_m[u]):
+            m = int(hub_m[v])
+            max_m = max(max_m, m)
+            for bi, cols in enumerate(bins):
+                if len(cols) + m <= _LANES:
+                    break
+            else:
+                bins.append([])
+                bi = len(bins) - 1
+            cols = bins[bi]
+            head = voff + bi * _LANES + len(cols)
+            var_pcol[v] = head
+            group_heads.append((head, m))
+            cols.extend([v] * m)
+        for v in svars:
+            for bi, cols in enumerate(bins):
+                if len(cols) < _LANES:
+                    break
+            else:
+                bins.append([])
+                bi = len(bins) - 1
+            cols = bins[bi]
+            var_pcol[v] = voff + bi * _LANES + len(cols)
+            cols.append(v)
+        nvp = max(_LANES, len(bins) * _LANES)
+        colv = np.full(nvp, -1, dtype=np.int64)
+        for bi, cols in enumerate(bins):
+            colv[bi * _LANES: bi * _LANES + len(cols)] = cols
+        col_var_parts.append(colv)
         if cls > 0:
-            buckets.append((cls, nvp, voff, -1))  # slot offsets assigned below
+            buckets.append((cls, nvp, voff, -1))  # slot offsets below
         voff += nvp
     Vp = voff
+    col_var = np.concatenate(col_var_parts)
 
     soff = 0
     with_slots = []
@@ -171,16 +296,27 @@ def pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
         return None  # permutation select stage degrades; use generic engine
     N = A * _TILE
 
-    # slot assignment: edge e is the k-th incoming edge of its variable
+    # per-column bucket lookups for vectorized slot assignment
+    col_soff = np.zeros(Vp, dtype=np.int64)
+    col_nvp = np.ones(Vp, dtype=np.int64)
+    col_voff = np.zeros(Vp, dtype=np.int64)
+    for cls, nvp, bvoff, bsoff in with_slots:
+        col_soff[bvoff: bvoff + nvp] = bsoff
+        col_nvp[bvoff: bvoff + nvp] = nvp
+        col_voff[bvoff: bvoff + nvp] = bvoff
+
+    # slot assignment: edge e is the k-th incoming edge of its variable;
+    # hub edges spill into sub-column k // cls_h at rank k % cls_h
     order = np.argsort(edge_var, kind="stable")
     k_of = np.empty(2 * F, dtype=np.int64)
     start = np.concatenate([[0], np.cumsum(deg)[:-1]])
     k_of[order] = np.arange(2 * F) - start[edge_var[order]]
-    slot_of_edge = np.empty(2 * F, dtype=np.int64)
-    for cls, nvp, bvoff, bsoff in with_slots:
-        sel = np.flatnonzero((cls_of[edge_var] == cls))
-        col = var_pcol[edge_var[sel]] - bvoff
-        slot_of_edge[sel] = bsoff + k_of[sel] * nvp + col
+    split = np.where(hub_cls > 0, hub_cls, 1 << 30)[edge_var]
+    sub_j = k_of // split
+    k_loc = k_of - sub_j * split
+    cole = var_pcol[edge_var] + sub_j
+    slot_of_edge = col_soff[cole] + k_loc * col_nvp[cole] + (
+        cole - col_voff[cole])
 
     # mate permutation: slot of edge (f,p) pulls from slot of edge (f,1-p)
     perm = np.arange(N, dtype=np.int64)  # dummies: identity
@@ -209,6 +345,32 @@ def pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
     dcount = vmask_np.sum(axis=0, keepdims=True)
     inv_dcount = np.where(dcount > 0, 1.0 / np.maximum(dcount, 1.0), 0.0)
 
+    # hub combine constants: suffix-doubling partner gathers confined to
+    # each group's lane range, plus the head-spread gather.  Identity
+    # (and mask 0) everywhere else, so non-hub columns pass through.
+    rows = Vp // _LANES
+    nsteps = 0
+    steps_idx = steps_mask = head_idx = None
+    if group_heads:
+        nsteps = max(1, int(np.ceil(np.log2(max_m))))
+        lane_id = np.tile(
+            np.arange(_LANES, dtype=np.int32), (rows, 1))
+        head_np = lane_id.copy()
+        sidx_np = np.tile(lane_id, (nsteps, 1))
+        smask_np = np.zeros((nsteps, Vp), dtype=np.float32)
+        for head, m in group_heads:
+            r0, l0 = head // _LANES, head % _LANES
+            head_np[r0, l0: l0 + m] = l0
+            for s in range(nsteps):
+                step = 1 << s
+                for lane in range(l0, l0 + m):
+                    if lane + step < l0 + m:
+                        sidx_np[s * rows + r0, lane] = lane + step
+                        smask_np[s, r0 * _LANES + lane] = 1.0
+        steps_idx = jnp.asarray(sidx_np)
+        steps_mask = jnp.asarray(smask_np)
+        head_idx = jnp.asarray(head_np)
+
     pg = PackedMaxSumGraph(
         D=D, n_vars=V, Vp=Vp, N=N, plan=plan,
         buckets=tuple(with_slots),
@@ -218,10 +380,72 @@ def pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
         vmask=jnp.asarray(vmask_np),
         inv_dcount=jnp.asarray(inv_dcount.astype(np.float32)),
         var_order=jnp.asarray(var_pcol.astype(np.int32)),
+        col_var=col_var,
+        hub_nsteps=nsteps,
+        hub_steps_idx=steps_idx,
+        hub_steps_mask=steps_mask,
+        hub_head_idx=head_idx,
     )
     if pg.vmem_bytes > _VMEM_BUDGET:
         return None
     return pg
+
+
+# ---------------------------------------------------------------------------
+# hub cross-column combine (traced; no-ops when the graph has no hubs)
+# ---------------------------------------------------------------------------
+
+
+def _hub_operands(pg: PackedMaxSumGraph) -> Tuple[jnp.ndarray, ...]:
+    """Extra kernel operands for hub graphs (empty tuple otherwise)."""
+    if pg.hub_nsteps == 0:
+        return ()
+    return (pg.hub_steps_idx, pg.hub_steps_mask, pg.hub_head_idx)
+
+
+def _hub_gather(arr, idx, R: int, rows: int):
+    """Within-vreg lane gather of [R, rows*128] by per-bin indices
+    idx [rows, 128] (same Mosaic-supported pattern as the Clos stages)."""
+    vi = arr.reshape(R * rows, _LANES)
+    ii = jnp.broadcast_to(
+        idx.reshape(1, rows, _LANES), (R, rows, _LANES)
+    ).reshape(R * rows, _LANES)
+    return jnp.take_along_axis(vi, ii, axis=1).reshape(R, rows * _LANES)
+
+
+def _hub_sum(pg: PackedMaxSumGraph, arr, R: int, hub):
+    """Replace every hub group's columns with the full-group SUM (suffix
+    doubling with masked adds, then spread from the group head); identity
+    on all other columns.  ``hub`` is the traced operand triple or None."""
+    if hub is None:
+        return arr
+    steps_idx, steps_mask, head_idx = hub
+    rows = pg.Vp // _LANES
+    for s in range(pg.hub_nsteps):
+        got = _hub_gather(arr, steps_idx[s * rows: (s + 1) * rows], R, rows)
+        arr = arr + got * steps_mask[s: s + 1, :]
+    return _hub_gather(arr, head_idx, R, rows)
+
+
+def _hub_op(pg: PackedMaxSumGraph, arr, R: int, hub, op):
+    """Full-group combine under an idempotent ``op`` (max/min): clamped
+    partners gather their own lane, so op(a, a) = a needs no mask."""
+    if hub is None:
+        return arr
+    steps_idx, _, head_idx = hub
+    rows = pg.Vp // _LANES
+    for s in range(pg.hub_nsteps):
+        got = _hub_gather(arr, steps_idx[s * rows: (s + 1) * rows], R, rows)
+        arr = op(arr, got)
+    return _hub_gather(arr, head_idx, R, rows)
+
+
+def _hub_spread(pg: PackedMaxSumGraph, arr, R: int, hub):
+    """Copy each hub group's head-column value to all its member columns
+    (identity elsewhere) — used to give member slots the hub's value."""
+    if hub is None:
+        return arr
+    return _hub_gather(arr, hub[2], R, pg.Vp // _LANES)
 
 
 def _resolve_interpret(interpret: Optional[bool]) -> bool:
@@ -243,7 +467,7 @@ def packed_init_state(pg: PackedMaxSumGraph
 
 
 def _cycle_body(pg: PackedMaxSumGraph, damping: float, q, r, cost, unary,
-                vmask, invd, plan_consts):
+                vmask, invd, plan_consts, hub=None):
     """Traced cycle math shared by the pallas kernel and interpret mode."""
     D, N = pg.D, pg.N
     qm = _permute_in_kernel(q, pg.plan, D, plan_consts)
@@ -275,6 +499,10 @@ def _cycle_body(pg: PackedMaxSumGraph, damping: float, q, r, cost, unary,
     beliefs = unary + (
         bparts[0] if len(bparts) == 1 else jnp.concatenate(bparts, axis=1)
     )
+    # hub groups: sum the per-sub-column partial beliefs (head's unary
+    # counted once — member columns carry zero unary) and give every
+    # member the combined belief for the expansion below
+    beliefs = _hub_sum(pg, beliefs, D, hub)
     # outgoing q' = beliefs(var) - r', normalized to zero masked mean.
     # expansion = lane-aligned repeats of each bucket's belief block (plain
     # VMEM copies; broadcast+reshape would force a Mosaic relayout)
@@ -334,8 +562,16 @@ def packed_cycles(
     interpret = _resolve_interpret(interpret)
     D, N, Vp = pg.D, pg.N, pg.Vp
 
+    hub_ops = _hub_operands(pg)
+
     def kern(q_ref, r_ref, cost_ref, unary_ref, vmask_ref,
-             invd_ref, c_r1, c_g1, c_ss, c_g2, c_r2, q_out, r_out, b_out):
+             invd_ref, c_r1, c_g1, c_ss, c_g2, c_r2, *rest):
+        if hub_ops:
+            hub = (rest[0][:], rest[1][:], rest[2][:])
+            rest = rest[3:]
+        else:
+            hub = None
+        q_out, r_out, b_out = rest
         cost = cost_ref[:]
         unary = unary_ref[:]
         vmask = vmask_ref[:]
@@ -349,7 +585,8 @@ def packed_cycles(
         bel = None
         for _ in range(n_cycles):
             qn, rn, bel = _cycle_body(
-                pg, damping, qn, rn, cost, unary, vmask, invd, consts
+                pg, damping, qn, rn, cost, unary, vmask, invd, consts,
+                hub=hub,
             )
         q_out[:] = qn
         r_out[:] = rn
@@ -362,11 +599,13 @@ def packed_cycles(
             jax.ShapeDtypeStruct((D, N), jnp.float32),
             jax.ShapeDtypeStruct((D, Vp), jnp.float32),
         ),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 11,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * (
+            11 + len(hub_ops)),
         out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 3),
         interpret=interpret,
+        compiler_params=_compiler_params(),
     )(q, r, pg.cost_rows, pg.unary_p, pg.vmask, pg.inv_dcount,
-      *_plan_consts(pg.plan))
+      *_plan_consts(pg.plan), *hub_ops)
     values = packed_values(pg, beliefs)
     return q_new, r_new, beliefs, values
 
@@ -400,9 +639,18 @@ def packed_local_tables(pg: PackedMaxSumGraph, x: jnp.ndarray,
         x.astype(jnp.float32)[None, :]
     )
 
+    hub_ops = _hub_operands(pg)
+
     def kern(xp_ref, cost_ref, unary_ref, c_r1, c_g1, c_ss, c_g2, c_r2,
-             t_out):
-        xp = xp_ref[:]
+             *rest):
+        if hub_ops:
+            hub = (rest[0][:], rest[1][:], rest[2][:])
+            rest = rest[3:]
+        else:
+            hub = None
+        (t_out,) = rest
+        # hub members carry the hub's current value for their slots
+        xp = _hub_spread(pg, xp_ref[:], D, hub)
         cost = cost_ref[:]
         # expand values to slots (aligned repeats, as in _cycle_body)
         parts = []
@@ -437,17 +685,20 @@ def packed_local_tables(pg: PackedMaxSumGraph, x: jnp.ndarray,
         while voff_expect < Vp:
             bparts.append(jnp.zeros((D, _LANES), dtype=contrib.dtype))
             voff_expect += _LANES
-        t_out[:] = unary_ref[:] + (
+        tables = unary_ref[:] + (
             bparts[0] if len(bparts) == 1 else jnp.concatenate(bparts, axis=1)
         )
+        t_out[:] = _hub_sum(pg, tables, D, hub)
 
     tables_p = pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((D, Vp), jnp.float32),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 8,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * (
+            8 + len(hub_ops)),
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=interpret,
-    )(x_p, pg.cost_rows, pg.unary_p, *_plan_consts(pg.plan))
+        compiler_params=_compiler_params(),
+    )(x_p, pg.cost_rows, pg.unary_p, *_plan_consts(pg.plan), *hub_ops)
     tables = tables_p[:, pg.var_order].T  # [V, D] original order
     mask = pg.mask_p[:, pg.var_order].T
     return jnp.where(mask > 0, tables, PAD_COST)

@@ -1,0 +1,189 @@
+"""Hub splitting in the lane-packed pallas engines (VERDICT r3 item 2).
+
+A variable with degree above _MAX_SLOT_CLASS (96) is split into several
+sub-columns inside the normal degree-class buckets; its belief / local
+table / neighborhood arbitration are recovered with a handful of
+within-vreg lane gathers.  These tests check the packed engines
+bit-match the generic XLA engines on scale-free (Barabási–Albert-like)
+and star instances — the graphs that previously knocked the whole
+problem onto the 8-25x slower generic path.
+
+Kernels run in interpret mode (CPU test env); the traced math is the
+same on TPU.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from pydcop_tpu.ops.compile import (
+    compile_binary_from_arrays,
+    local_cost_tables,
+)
+from pydcop_tpu.ops.maxsum_kernels import init_messages, maxsum_cycle
+from pydcop_tpu.ops.pallas_maxsum import (
+    _MAX_SLOT_CLASS,
+    pack_for_pallas,
+    packed_cycle,
+    packed_init_state,
+    packed_local_tables,
+)
+
+
+def barabasi_albert_edges(V: int, m: int, seed: int = 0):
+    """Degree-biased preferential attachment; returns (ei, ej) with a
+    heavy-tailed degree distribution (guaranteed hubs for small seeds)."""
+    rng = np.random.default_rng(seed)
+    targets = list(range(m))
+    repeated: list = list(range(m))
+    ei, ej = [], []
+    for v in range(m, V):
+        for t in set(targets):
+            ei.append(v)
+            ej.append(t)
+            repeated.extend([v, t])
+        targets = [repeated[rng.integers(0, len(repeated))] for _ in range(m)]
+    return np.asarray(ei), np.asarray(ej)
+
+
+def _scalefree_instance(V=400, m=3, D=3, seed=0, boost_hub=True):
+    rng = np.random.default_rng(seed + 100)
+    ei, ej = barabasi_albert_edges(V, m, seed)
+    if boost_hub:
+        # wire every 3rd variable to the max-degree node so its degree
+        # far exceeds the slot-class ceiling
+        deg = np.bincount(np.concatenate([ei, ej]), minlength=V)
+        hub = int(np.argmax(deg))
+        extra = np.array(
+            [v for v in range(0, V, 3) if v != hub], dtype=np.int64
+        )
+        ei = np.concatenate([ei, extra])
+        ej = np.concatenate([ej, np.full(len(extra), hub)])
+    F = len(ei)
+    mats = rng.uniform(0, 5, (F, D, D)).astype(np.float32)
+    un = rng.uniform(0, 1, (V, D)).astype(np.float32)
+    return compile_binary_from_arrays(ei, ej, mats, V, unary=un)
+
+
+class TestHubLayout:
+    def test_hub_is_split_not_rejected(self):
+        t = _scalefree_instance()
+        pg = pack_for_pallas(t)
+        assert pg is not None
+        assert pg.hub_nsteps > 0
+        deg = np.zeros(t.n_vars, dtype=np.int64)
+        vi = np.asarray(t.buckets[0].var_idx)
+        for col in (vi[:, 0], vi[:, 1]):
+            deg += np.bincount(col, minlength=t.n_vars)
+        assert deg.max() > _MAX_SLOT_CLASS
+        # every variable still has exactly one head column
+        cols = np.asarray(pg.var_order)
+        assert len(set(cols.tolist())) == t.n_vars
+        # member columns map back to their hub in col_var
+        cv = pg.col_var
+        assert (np.bincount(cv[cv >= 0], minlength=t.n_vars) >= 1).all()
+
+    def test_groups_stay_inside_bins(self):
+        t = _scalefree_instance()
+        pg = pack_for_pallas(t)
+        cv = pg.col_var
+        # group = run of equal var ids; must not straddle a 128 boundary
+        counts = np.bincount(cv[cv >= 0], minlength=t.n_vars)
+        for v in np.flatnonzero(counts > 1):
+            cols = np.flatnonzero(cv == v)
+            assert cols.max() - cols.min() == len(cols) - 1  # contiguous
+            assert cols.min() // 128 == cols.max() // 128
+
+
+class TestHubMaxSum:
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_cycle_matches_generic_engine(self, seed):
+        t = _scalefree_instance(seed=seed)
+        pg = pack_for_pallas(t)
+        assert pg is not None and pg.hub_nsteps > 0
+        q, r = init_messages(t)
+        qp, rp = packed_init_state(pg)
+        for _ in range(4):
+            q, r, bel, vals = maxsum_cycle(t, q, r, damping=0.5)
+            qp, rp, belp, valsp = packed_cycle(
+                pg, qp, rp, damping=0.5, interpret=True
+            )
+        belp_orig = np.asarray(belp)[:, np.asarray(pg.var_order)].T
+        assert np.allclose(np.asarray(bel), belp_orig, atol=1e-3)
+        assert np.array_equal(np.asarray(vals), np.asarray(valsp))
+
+    def test_local_tables_match_generic(self):
+        t = _scalefree_instance(seed=2)
+        pg = pack_for_pallas(t)
+        assert pg is not None and pg.hub_nsteps > 0
+        rng = np.random.default_rng(3)
+        x = np.asarray(rng.integers(0, 3, t.n_vars), dtype=np.int32)
+        ref = np.asarray(local_cost_tables(t, jnp.asarray(x)))
+        got = np.asarray(
+            packed_local_tables(pg, jnp.asarray(x), interpret=True)
+        )
+        assert np.allclose(ref, got, atol=1e-3)
+
+
+class TestHubLocalSearch:
+    def _dcop(self, V=300, seed=4):
+        """A scale-free coloring DCOP built through the public model API
+        (so generic and packed solvers share tensors)."""
+        from pydcop_tpu.dcop.dcop import DCOP
+        from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+        from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+        rng = np.random.default_rng(seed)
+        ei, ej = barabasi_albert_edges(V, 3, seed)
+        deg = np.bincount(np.concatenate([ei, ej]), minlength=V)
+        hub = int(np.argmax(deg))
+        extra = np.array(
+            [v for v in range(0, V, 2) if v != hub], dtype=np.int64
+        )
+        ei = np.concatenate([ei, extra])
+        ej = np.concatenate([ej, np.full(len(extra), hub)])
+        dcop = DCOP("hubtest", objective="min")
+        dom = Domain("colors", "colors", [0, 1, 2])
+        vs = [Variable(f"v{i}", dom) for i in range(V)]
+        for v in vs:
+            dcop.add_variable(v)
+        seen = set()
+        for k, (i, j) in enumerate(zip(ei.tolist(), ej.tolist())):
+            if i == j or (i, j) in seen or (j, i) in seen:
+                continue
+            seen.add((i, j))
+            mat = rng.uniform(0, 5, (3, 3)).astype(np.float32)
+            dcop.add_constraint(
+                NAryMatrixRelation([vs[i], vs[j]], mat, name=f"c{k}")
+            )
+        dcop.add_agents([AgentDef("a0")])
+        return dcop
+
+    def _solver_pair(self, algo, dcop):
+        """(generic solver, packed solver) with identical seeds."""
+        import jax
+        from pydcop_tpu.algorithms import (
+            AlgorithmDef,
+            load_algorithm_module,
+        )
+
+        mod = load_algorithm_module(algo)
+        algo_def = AlgorithmDef.build_with_default_params(algo)
+        generic = mod.build_solver(dcop, algo_def=algo_def)
+        assert generic.packed is None  # CPU → generic
+        import unittest.mock as mock
+
+        with mock.patch.object(jax, "default_backend", lambda: "tpu"):
+            packed = mod.build_solver(dcop, algo_def=algo_def)
+        assert packed.packed is not None
+        assert packed.packed.hub_nsteps > 0
+        return generic, packed
+
+    @pytest.mark.parametrize("algo", ["mgm", "dsa"])
+    def test_fused_matches_generic(self, algo):
+        dcop = self._dcop()
+        generic, packed = self._solver_pair(algo, dcop)
+        rg = generic.run(cycles=10, chunk=10)
+        rp = packed.run(cycles=10, chunk=10)
+        # same PRNG stream + same move rules → identical assignments
+        assert rg.assignment == rp.assignment
+        assert rg.cost == pytest.approx(rp.cost, rel=1e-5)

@@ -122,9 +122,9 @@ class TestPackedEngine:
         pg = pack_for_pallas(t)
         assert isinstance(pg.vmem_bytes, int) and pg.vmem_bytes > 0
 
-    def test_pack_rejects_huge_hub_degree(self):
-        # a star graph: center variable degree far above _MAX_SLOT_CLASS
-        # would unroll thousands of slice-adds per bucket — must fall back
+    def test_star_hub_packs_and_matches_generic(self):
+        # a star graph: center degree above _MAX_SLOT_CLASS is split into
+        # sub-columns (hub splitting) and must bit-match the generic engine
         from pydcop_tpu.ops.pallas_maxsum import _MAX_SLOT_CLASS
 
         rng = np.random.default_rng(7)
@@ -132,6 +132,30 @@ class TestPackedEngine:
         ei = np.zeros(F, dtype=np.int64)
         ej = np.arange(1, F + 1)
         mats = rng.uniform(0, 1, (F, 3, 3)).astype(np.float32)
+        un = rng.uniform(0, 1, (F + 1, 3)).astype(np.float32)
+        t = compile_binary_from_arrays(ei, ej, mats, F + 1, unary=un)
+        pg = pack_for_pallas(t)
+        assert pg is not None and pg.hub_nsteps > 0
+        q, r = init_messages(t)
+        qp, rp = packed_init_state(pg)
+        for _ in range(4):
+            q, r, bel, vals = maxsum_cycle(t, q, r, damping=0.5)
+            qp, rp, belp, valsp = packed_cycle(
+                pg, qp, rp, damping=0.5, interpret=True
+            )
+        belp_orig = np.asarray(belp)[:, np.asarray(pg.var_order)].T
+        assert np.allclose(np.asarray(bel), belp_orig, atol=1e-4)
+        assert np.array_equal(np.asarray(vals), np.asarray(valsp))
+
+    def test_pack_rejects_bin_overflow_hub(self):
+        # a hub beyond _MAX_SLOT_CLASS * 128 sub-column slots cannot keep
+        # its group inside one 128-lane bin — must fall back
+        from pydcop_tpu.ops.pallas_maxsum import _LANES, _MAX_SLOT_CLASS
+
+        F = _MAX_SLOT_CLASS * _LANES + 1
+        ei = np.zeros(F, dtype=np.int64)
+        ej = np.arange(1, F + 1)
+        mats = np.ones((F, 2, 2), dtype=np.float32)
         t = compile_binary_from_arrays(ei, ej, mats, F + 1)
         assert pack_for_pallas(t) is None
 
