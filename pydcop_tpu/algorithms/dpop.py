@@ -21,7 +21,11 @@ from typing import Dict, List, Optional
 import jax.numpy as jnp
 import numpy as np
 
-from pydcop_tpu.algorithms import AlgorithmDef, DEFAULT_INFINITY
+from pydcop_tpu.algorithms import (
+    AlgoParameterDef,
+    AlgorithmDef,
+    DEFAULT_INFINITY,
+)
 from pydcop_tpu.algorithms.base import SolveResult
 from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.graph import pseudotree as pt_module
@@ -37,7 +41,15 @@ from pydcop_tpu.ops.dpop_kernels import (
 
 GRAPH_TYPE = "pseudotree"
 
-algo_params = []  # reference: no parameters (dpop.py:45)
+# reference: no parameters (dpop.py:45).  `engine` is a framework-side
+# addition: "auto" picks the level-scan sweep (compiles in seconds);
+# "wholesweep" forces the single-launch pallas kernel (~50x faster per
+# sweep on width-1 trees but minutes of one-time Mosaic compile — worth
+# it for repeated same-topology solves, see ops/pallas_dpop.py).
+algo_params = [
+    AlgoParameterDef("engine", "str", ["auto", "sweep", "wholesweep"],
+                     "auto"),
+]
 
 
 class DpopSolver:
@@ -56,6 +68,10 @@ class DpopSolver:
         self.infinity = DEFAULT_INFINITY
         self.msg_count = 0
         self.msg_size = 0
+        self.engine = (
+            algo_def.params.get("engine", "auto")
+            if algo_def is not None and algo_def.params else "auto"
+        )
 
     def _node_constraint_table(self, node: PseudoTreeNode):
         """Join the node's own constraints + its variable costs into one
@@ -122,14 +138,43 @@ class DpopSolver:
         return self._run_pernode()
 
     def _run_sweep(self, plan, perlevel: bool = False) -> SolveResult:
+        import jax
+
         from pydcop_tpu.ops.dpop_sweep import run_sweep, run_sweep_perlevel
 
         t0 = perf_counter()
         self.last_engine = "sweep_perlevel" if perlevel else "sweep"
         tree = self.tree
-        assign_idx, _ = (
-            run_sweep_perlevel(plan) if perlevel else run_sweep(plan)
-        )
+        assign_idx = None
+        if (not perlevel and self.engine == "wholesweep"
+                and jax.default_backend() == "tpu"):
+            # single-launch whole-sweep pallas kernel (width-1 trees):
+            # the level scan is dispatch-latency-bound — L levels of tiny
+            # kernels — while one launch holds all tables in VMEM.
+            # Opt-in (--algo_params engine:wholesweep): ~50x faster per
+            # sweep but minutes of one-time Mosaic compile, so "auto"
+            # keeps the level scan for one-shot solves
+            try:
+                from pydcop_tpu.ops.pallas_dpop import (
+                    pack_sweep,
+                    whole_sweep_values,
+                )
+
+                ps = pack_sweep(plan)
+                if ps is not None:
+                    assign_idx = np.asarray(
+                        jax.device_get(whole_sweep_values(ps)))
+                    self.last_engine = "wholesweep"
+            except Exception:  # pragma: no cover — engine bug must not
+                import logging  # take down an exact solve
+
+                logging.getLogger("pydcop_tpu.dpop").exception(
+                    "whole-sweep kernel failed; using the level scan")
+                assign_idx = None
+        if assign_idx is None:
+            assign_idx, _ = (
+                run_sweep_perlevel(plan) if perlevel else run_sweep(plan)
+            )
         assignment = {}
         for gidx, name in enumerate(plan.gid_to_name):
             v = tree.computation(name).variable

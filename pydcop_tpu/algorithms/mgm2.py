@@ -70,6 +70,8 @@ class Mgm2Solver(LocalSearchSolver):
         # 5 rounds per cycle, one message per neighbor pair each
         self.msgs_per_cycle = 5 * int(tensors.neighbor_src.shape[0])
         self._build_pair_structures()
+        self._packed_mgm2 = None
+        self._packed_mgm2_built = False
 
     def _build_pair_structures(self):
         """Static pair-edge arrays from the arity-2 bucket."""
@@ -100,6 +102,56 @@ class Mgm2Solver(LocalSearchSolver):
                 inc_s[v, k] = s
         self.inc_e = jnp.asarray(inc_e)
         self.inc_s = jnp.asarray(inc_s)
+
+    @property
+    def packed_mgm2(self):
+        """Fused-kernel extras, built lazily from the packed layout."""
+        if not self._packed_mgm2_built:
+            self._packed_mgm2_built = True
+            if self.packed_ls is not None and self.n_pairs > 0:
+                from pydcop_tpu.ops.pallas_mgm2 import pack_mgm2_from_pls
+
+                self._packed_mgm2 = pack_mgm2_from_pls(self.packed_ls)
+        return self._packed_mgm2
+
+    def _chunk_runner(self, n, collect: bool = True):
+        """Fused fast path (ops.pallas_mgm2.packed_mgm2_cycles): the
+        whole 5-round pairing protocol per cycle in one pallas kernel,
+        consuming the generic path's exact 3-way key-split PRNG stream
+        — bit-identical to :meth:`cycle`."""
+        if collect or self.packed_mgm2 is None:
+            return super()._chunk_runner(n, collect)
+        import jax as _jax
+
+        from pydcop_tpu.ops.pallas_local_search import pack_x, unpack_x
+        from pydcop_tpu.ops.pallas_mgm2 import (
+            packed_mgm2_cycles,
+            uniforms_for_mgm2,
+        )
+
+        pm = self.packed_mgm2
+
+        def build_runner(group):
+            @_jax.jit
+            def run_chunk(state, keys):
+                (x,) = state
+                x_row = pack_x(pm.pls, x)
+                uo, up, uf = uniforms_for_mgm2(pm, keys)
+                shape = (n // group, group, uo.shape[1])
+                xs = (uo.reshape(shape), up.reshape(shape),
+                      uf.reshape(shape))
+
+                def body(xr, us):
+                    return packed_mgm2_cycles(
+                        pm, xr, *us, self.threshold, self.favor
+                    ), None
+
+                x_row, _ = _jax.lax.scan(body, x_row, xs)
+                return (unpack_x(pm.pls, x_row),), None
+
+            return run_chunk
+
+        return self._fused_chunk_runner(n, collect, build_runner)
 
     def cycle(self, state, key):
         (x,) = state

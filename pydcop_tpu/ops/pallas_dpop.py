@@ -1,0 +1,365 @@
+"""Whole-sweep DPOP engine: UTIL + VALUE in ONE pallas kernel.
+
+The batched level-scan engine (ops/dpop_sweep.py) replaced the
+reference's per-assignment python join/projection loops
+(pydcop/dcop/relations.py:1622-1706, driven by
+pydcop/algorithms/dpop.py:239-425), but a single sweep remains
+dispatch-latency-bound: L sequential scan levels of tiny XLA kernels
+leave the chip >99% idle (docs/performance.rst).  This module is the
+single-launch TPU-first formulation for width-1 pseudo-trees (separator
+= {parent} for every node — true trees, the overwhelmingly common DPOP
+case and both BASELINE.md DPOP metrics):
+
+* the whole forest lives in the lane-packed layout of the MaxSum engine
+  (ops/pallas_maxsum): one column per node, one slot per tree edge
+  endpoint, messages ``[D, N]`` with the domain on sublanes;
+* slot k=0 of every column is the node's UP edge, slots k>=1 its
+  children — so "sum the children's messages" is the bucket slice-add
+  skipping k=0, and "read the parent's value" is the k=0 block;
+* child->parent and parent->child routing are the SAME static lane
+  permutation (an involution), compiled once through the Clos planner;
+* UTIL = L in-kernel iterations of (child-sum, D-slab min, route); a
+  node's outgoing message becomes correct once all its descendants'
+  have - i.e. after height(n) iterations - so L iterations fix the
+  whole forest with no level masking at all.  VALUE = L iterations of
+  (route values down, slab-select by parent value, argmin).  2L
+  statically-unrolled permutes, everything VMEM-resident, ONE launch.
+
+Tables are stored twice (own-value-major for UTIL's min, parent-value-
+major for VALUE's select) - 2*D^2*Vp floats; trading VMEM for full-slab
+vector ops both phases.
+
+Falls back (returns None from :func:`pack_sweep`) for W>1 plans, deep
+trees (unroll bound), many-children hubs, or oversized working sets -
+callers keep the level-scan engine.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from pydcop_tpu.ops.clos_routing import PermutationPlan, plan_permutation
+from pydcop_tpu.ops.dpop_sweep import BIG, DpopSweepPlan
+from pydcop_tpu.ops.pallas_maxsum import (
+    _LANES,
+    _TILE,
+    _class_bounds,
+    _apply_bounds,
+    _compiler_params,
+    _resolve_interpret,
+)
+from pydcop_tpu.ops.pallas_permute import _permute_in_kernel, _plan_consts
+
+#: 2L permutes are statically unrolled in the kernel; deeper trees fall
+#: back to the level-scan engine (compile time grows linearly with L)
+_MAX_LEVELS = 48
+#: per-node slot class = children + 1 (the up edge); beyond this the
+#: bucket slice-add unroll gets too long - level-scan engine instead
+_MAX_CHILDREN = 95
+_VMEM_BUDGET = 40 * 2**20
+
+
+@dataclass(eq=False)  # identity hash: instances key the jit cache
+class PackedSweep:
+    """Lane-packed whole-forest layout of a width-1 DPOP plan."""
+
+    D: int          # Dmax (digit radix, = sublane rows)
+    n_nodes: int
+    Vp: int         # padded node columns
+    N: int          # padded edge-endpoint slots (= plan.n)
+    L: int          # tree levels (unrolled iterations per phase)
+    mode: str       # "min" | "max"
+    plan: PermutationPlan
+    buckets: Tuple[Tuple[int, int, int, int], ...]  # (cls, nvp, voff, soff)
+    local_own: jnp.ndarray  # [D*D, Vp] row i*D+j = local(own=i, par=j)
+    local_par: jnp.ndarray  # [D*D, Vp] row j*D+i = local(own=i, par=j)
+    node_col: np.ndarray    # [n_nodes] gid -> column
+
+    @property
+    def vmem_bytes(self) -> int:
+        # two table copies + ~4 live [D, N] message planes + the 5 Clos
+        # index arrays + permute temporaries (~2 more [D, N])
+        return 4 * (2 * self.D * self.D * self.Vp
+                    + 6 * self.D * self.N + 5 * self.N)
+
+
+def pack_sweep(plan: DpopSweepPlan) -> Optional[PackedSweep]:
+    """Compile a width-1 DpopSweepPlan into the whole-sweep layout, or
+    None when out of scope (W>1, deep, hubby, oversized)."""
+    if plan.W != 1 or plan.L > _MAX_LEVELS:
+        return None
+    D, N_nodes, L, Bmax = plan.Dmax, plan.n_nodes, plan.L, plan.Bmax
+    node_ids = np.asarray(plan.node_ids)
+    parent_slot = np.asarray(plan.parent_slot)
+    sep_ids = np.asarray(plan.sep_ids)
+
+    # per-node parent gid (or -1 for roots); verify the single separator
+    # IS the parent - a W=1 plan could in principle carry a pseudo-parent
+    parent = np.full(N_nodes, -1, dtype=np.int64)
+    loc_flat = np.zeros((N_nodes, plan.S), dtype=np.float32)
+    for li in range(L):
+        for bi in range(Bmax):
+            gid = int(node_ids[li, bi])
+            if gid > N_nodes:  # padding sentinel N+1
+                continue
+            loc_flat[gid] = plan.local[li, bi]
+            ps = int(parent_slot[li, bi])
+            if li > 0 and ps < Bmax:
+                pgid = int(node_ids[li - 1, ps])
+                parent[gid] = pgid
+                if int(sep_ids[li, bi, 0]) != pgid:
+                    return None  # separator is not the parent
+    n_children = np.bincount(parent[parent >= 0], minlength=N_nodes)
+    if int(n_children.max(initial=0)) > _MAX_CHILDREN:
+        return None
+
+    # -- column layout: bucket nodes by cls = children + 1 ---------------
+    cls_node = n_children + 1
+    bounds = _class_bounds(cls_node)
+    cls_of = _apply_bounds(cls_node, bounds)
+    buckets = []
+    node_col = np.empty(N_nodes, dtype=np.int64)
+    voff = 0
+    for cls in sorted(set(cls_of.tolist())):
+        vs = np.flatnonzero(cls_of == cls)
+        nvp = max(_LANES, int(np.ceil(len(vs) / _LANES)) * _LANES)
+        node_col[vs] = voff + np.arange(len(vs))
+        buckets.append([int(cls), nvp, voff, -1])
+        voff += nvp
+    Vp = voff
+
+    soff = 0
+    with_slots = []
+    for cls, nvp, bvoff, _ in buckets:
+        with_slots.append((cls, nvp, bvoff, soff))
+        soff += cls * nvp
+    n_slots = soff
+    A = max(1, int(np.ceil(n_slots / _TILE)))
+    if A > 8:
+        return None
+    N = A * _TILE
+
+    col_soff = np.zeros(Vp, dtype=np.int64)
+    col_nvp = np.ones(Vp, dtype=np.int64)
+    col_voff = np.zeros(Vp, dtype=np.int64)
+    for cls, nvp, bvoff, bsoff in with_slots:
+        col_soff[bvoff: bvoff + nvp] = bsoff
+        col_nvp[bvoff: bvoff + nvp] = nvp
+        col_voff[bvoff: bvoff + nvp] = bvoff
+
+    def slot(col: np.ndarray, k: np.ndarray) -> np.ndarray:
+        return col_soff[col] + k * col_nvp[col] + (col - col_voff[col])
+
+    # -- permutation: up-slot(child) <-> child-slot(parent, rank) --------
+    child_ids = np.flatnonzero(parent >= 0)
+    order = np.argsort(parent[child_ids], kind="stable")
+    ranks = np.empty(len(child_ids), dtype=np.int64)
+    starts = np.concatenate([[0], np.cumsum(np.bincount(
+        parent[child_ids], minlength=N_nodes))[:-1]])
+    ranks[order] = np.arange(len(child_ids)) - starts[
+        parent[child_ids[order]]]
+    up = slot(node_col[child_ids], np.zeros(len(child_ids), np.int64))
+    down = slot(node_col[parent[child_ids]], 1 + ranks)
+    perm = np.arange(N, dtype=np.int64)
+    perm[up] = down
+    perm[down] = up
+    plan_p = plan_permutation(perm, A, _LANES, _LANES)
+
+    # -- tables, twice ---------------------------------------------------
+    # plan.local digit layout at W=1: flat = own * Dmax + parent
+    local_own = np.zeros((D * D, Vp), dtype=np.float32)
+    local_own[:, node_col] = loc_flat.T
+    local_par = np.zeros((D * D, Vp), dtype=np.float32)
+    lp = loc_flat.reshape(N_nodes, D, D).transpose(0, 2, 1).reshape(
+        N_nodes, D * D)
+    local_par[:, node_col] = lp.T
+
+    ps = PackedSweep(
+        D=D, n_nodes=N_nodes, Vp=Vp, N=N, L=L, mode=plan.mode,
+        plan=plan_p, buckets=tuple(with_slots),
+        local_own=jnp.asarray(local_own),
+        local_par=jnp.asarray(local_par),
+        node_col=node_col,
+    )
+    if ps.vmem_bytes > _VMEM_BUDGET:
+        return None
+    return ps
+
+
+# ---------------------------------------------------------------------------
+# traced kernel body pieces
+# ---------------------------------------------------------------------------
+
+
+def _childsum(ps: PackedSweep, r, R: int):
+    """[R, N] slot rows -> [R, Vp] per-node sums over the k>=1 (child)
+    slots; the k=0 up slot is excluded by construction."""
+    parts = []
+    voff_expect = 0
+    for cls, nvp, voff, soff in ps.buckets:
+        while voff_expect < voff:
+            parts.append(jnp.zeros((R, _LANES), dtype=r.dtype))
+            voff_expect += _LANES
+        if cls > 1:
+            acc = r[:, soff + nvp: soff + 2 * nvp]
+            for k in range(2, cls):
+                acc = acc + r[:, soff + k * nvp: soff + (k + 1) * nvp]
+        else:
+            acc = jnp.zeros((R, nvp), dtype=r.dtype)
+        parts.append(acc)
+        voff_expect += nvp
+    while voff_expect < ps.Vp:
+        parts.append(jnp.zeros((R, _LANES), dtype=r.dtype))
+        voff_expect += _LANES
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+
+
+def _expand(ps: PackedSweep, arr, R: int):
+    """[R, Vp] per-node rows -> [R, N] (value repeated at ALL the node's
+    slots, up slot included)."""
+    parts = []
+    for cls, nvp, voff, soff in ps.buckets:
+        parts.extend([arr[:, voff: voff + nvp]] * cls)
+    out = jnp.concatenate(parts, axis=1) if parts else arr
+    if out.shape[1] < ps.N:
+        out = jnp.concatenate(
+            [out, jnp.zeros((R, ps.N - out.shape[1]), out.dtype)], axis=1
+        )
+    return out
+
+
+def _up_block(ps: PackedSweep, r, R: int):
+    """[R, N] slot rows -> [R, Vp]: each node's k=0 (up) slot value."""
+    parts = []
+    voff_expect = 0
+    for cls, nvp, voff, soff in ps.buckets:
+        while voff_expect < voff:
+            parts.append(jnp.zeros((R, _LANES), dtype=r.dtype))
+            voff_expect += _LANES
+        parts.append(r[:, soff: soff + nvp])
+        voff_expect += nvp
+    while voff_expect < ps.Vp:
+        parts.append(jnp.zeros((R, _LANES), dtype=r.dtype))
+        voff_expect += _LANES
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+
+
+def _sweep_body(ps: PackedSweep, lown, lpar, consts):
+    """The full UTIL+VALUE math (traced).  Returns values [1, Vp]."""
+    D = ps.D
+    red = jnp.minimum if ps.mode == "min" else jnp.maximum
+
+    # ---- UTIL: L iterations; height-h nodes correct after h rounds
+    r = jnp.zeros((D, ps.N), dtype=jnp.float32)
+    cs = None
+    for _ in range(ps.L):
+        cs = _childsum(ps, r, D)
+        # out[j] = red_i local(i, j) + cs[i]  - D own-value slabs
+        out = lown[0: D, :] + cs[0: 1, :]
+        for i in range(1, D):
+            out = red(out, lown[i * D: (i + 1) * D, :] + cs[i: i + 1, :])
+        r = _permute_in_kernel(_expand(ps, out, D), ps.plan, D, consts)
+    cs = _childsum(ps, r, D)  # final child sums (messages now exact)
+
+    # ---- VALUE: L iterations; depth-d nodes correct after d+1 rounds
+    v = jnp.zeros((1, ps.Vp), dtype=jnp.float32)
+    for _ in range(ps.L):
+        vs = _permute_in_kernel(_expand(ps, v, 1), ps.plan, 1, consts)
+        vup = _up_block(ps, vs, 1)  # parent's current value per node
+        # score[i] = local(i, vup) + cs[i]  - D parent-value slabs
+        score = lpar[0: D, :]
+        for j in range(1, D):
+            score = jnp.where(
+                vup == float(j), lpar[j * D: (j + 1) * D, :], score
+            )
+        score = score + cs
+        # argmin/argmax via axis-0 reductions: reductions give the row a
+        # canonical vector layout — a row-slice compare chain leaves a
+        # sublane offset that the _expand concat (zero-fill pieces) above
+        # cannot reconcile (Mosaic "offset mismatch on non-concat dim")
+        if ps.mode == "min":
+            bc = jnp.min(score, axis=0, keepdims=True)
+            at = score <= bc
+        else:
+            bc = jnp.max(score, axis=0, keepdims=True)
+            at = score >= bc
+        iota = jax.lax.broadcasted_iota(
+            jnp.int32, (D, ps.Vp), 0).astype(jnp.float32)
+        v = jnp.min(jnp.where(at, iota, float(D)), axis=0, keepdims=True)
+    return v
+
+
+def _launch_sweep(ps: PackedSweep, lown, lpar, consts, interpret: bool):
+    """The one pallas launch (traced): tables in, assign [n_nodes] out.
+    Single source for the solver path and the benchmark throughput fn."""
+
+    def kern(lown_ref, lpar_ref, c_r1, c_g1, c_ss, c_g2, c_r2, v_out):
+        kconsts = (c_r1[:], c_g1[:], c_ss[:], c_g2[:], c_r2[:])
+        v_out[:] = _sweep_body(ps, lown_ref[:], lpar_ref[:], kconsts)
+
+    v = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((1, ps.Vp), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 7,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+        compiler_params=_compiler_params(),
+    )(lown, lpar, *consts)
+    return v[0, jnp.asarray(ps.node_col)].astype(jnp.int32)
+
+
+def _sweep_callable(ps: PackedSweep, interpret: bool):
+    """Jitted single-launch sweep for a packed plan, cached on the plan
+    instance — pl.pallas_call re-lowers the whole kernel on every
+    un-jitted invocation (~minutes for deep unrolls)."""
+    cached = getattr(ps, "_jit_cache", None)
+    if cached is not None and cached[0] == interpret:
+        return cached[1]
+
+    @jax.jit
+    def run(lown, lpar, consts):
+        return _launch_sweep(ps, lown, lpar, consts, interpret)
+
+    ps._jit_cache = (interpret, run)
+    return run
+
+
+def whole_sweep_values(
+    ps: PackedSweep, interpret: Optional[bool] = None
+) -> jnp.ndarray:
+    """Run UTIL+VALUE in one pallas launch.  Returns assign [n_nodes]
+    int32 in gid order (same contract as dpop_sweep run_sweep)."""
+    interpret = _resolve_interpret(interpret)
+    run = _sweep_callable(ps, interpret)
+    return run(ps.local_own, ps.local_par, _plan_consts(ps.plan))
+
+
+def make_whole_sweep_fn(ps: PackedSweep, reps: int = 1):
+    """(jitted fn, args) running ``reps`` whole sweeps in one program
+    (same per-rep data-dependence discipline as
+    dpop_sweep.make_throughput_fn so no repetition can be elided)."""
+    eps = jnp.asarray(np.arange(1, reps + 1, dtype=np.float32) * 1e-6)
+
+    interpret = _resolve_interpret(None)
+
+    @jax.jit
+    def run(lown, lpar):
+        def body(assign_prev, eps_r):
+            carry = assign_prev[0].astype(jnp.float32) * 1e-12
+            assign = _launch_sweep(
+                ps, lown + eps_r + carry, lpar + eps_r + carry,
+                _plan_consts(ps.plan), interpret,
+            )
+            return assign, None
+
+        assign0 = jnp.zeros((ps.n_nodes,), dtype=jnp.int32)
+        assign, _ = jax.lax.scan(body, assign0, eps)
+        return assign
+
+    return run, (ps.local_own, ps.local_par)

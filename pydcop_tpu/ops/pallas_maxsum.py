@@ -144,6 +144,10 @@ class PackedMaxSumGraph:
     # original variable id per padded column (-1 = dummy); hub members map
     # to their hub variable.  Host-side numpy (used by pack_from_pg).
     col_var: np.ndarray = None
+    # slot of each edge endpoint (edge e = p*F + f for factor f, side p);
+    # host-side numpy — lets packings built on top (mgm2 pairing) map
+    # factor-indexed data onto slots
+    slot_of_edge: np.ndarray = None
     # -- hub splitting (variables with degree > _MAX_SLOT_CLASS) ----------
     # A hub's slots are split across m contiguous sub-columns inside a
     # normal degree-class bucket; its full belief/table is recovered with
@@ -381,6 +385,7 @@ def pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
         inv_dcount=jnp.asarray(inv_dcount.astype(np.float32)),
         var_order=jnp.asarray(var_pcol.astype(np.int32)),
         col_var=col_var,
+        slot_of_edge=slot_of_edge,
         hub_nsteps=nsteps,
         hub_steps_idx=steps_idx,
         hub_steps_mask=steps_mask,

@@ -1,0 +1,398 @@
+"""Fused MGM-2 engine (Pallas TPU kernel) — the whole 5-round pairing
+protocol in one kernel per cycle group.
+
+MGM-2 (reference pydcop/algorithms/mgm2.py:398-1061) was the last
+local-search family member running its move rules in XLA ops: the
+pair-matching scatters (offer selection, response acceptance, committed
+payload placement) gather/scatter over edge arrays, which XLA
+scalarizes.  On the lane-packed layout (ops/pallas_maxsum) every one of
+those rounds is vectorizable:
+
+* *offer*: an offerer's "pick one random incident edge" is a per-slot
+  compare of the static pick-rank array against the variable's expanded
+  pick draw — no scatter;
+* *joint tables*: the pair's joint-gain optimum is computed per SLOT
+  from the per-slot exclusive tables (own table minus this edge's
+  contribution) and the mate's, routed by the Clos permutation;
+* *response / commit*: per-receiver maxima and first-edge tie-breaks
+  are the bucket slice reductions; the accepted payload returns to the
+  offerer through the same permutation;
+* *gain/go*: neighborhood arbitration as in fused MGM, except the
+  tie-break id (min of the pair) is dynamic, so ids ride the
+  permutation alongside the gains.
+
+PRNG discipline: the three per-cycle draws (offer coin, pick, favor
+coin) are pre-drawn OUTSIDE the kernel from the generic solver's exact
+key-split stream (uniforms_for_mgm2), so fused and generic paths make
+identical random choices.
+
+Tie-break parity with Mgm2Solver.cycle: the flat row-major argmin over
+the joint [D, D] table is reproduced as (first best row, then first
+best column within it); receiver acceptance uses the same
+lowest-edge-id rule via the static per-slot edge-id array.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from pydcop_tpu.ops.compile import PAD_COST
+from pydcop_tpu.ops.pallas_local_search import (
+    PackedLocalSearch,
+    _BIG_IDX,
+    _bucket_expand,
+    _bucket_reduce,
+)
+from pydcop_tpu.ops.pallas_maxsum import (
+    _compiler_params,
+    _hub_op,
+    _hub_operands,
+    _hub_spread,
+    _hub_sum,
+    _resolve_interpret,
+)
+from pydcop_tpu.ops.pallas_permute import _permute_in_kernel, _plan_consts
+
+
+@dataclass
+class PackedMgm2:
+    """Static pairing arrays on top of the packed local-search layout."""
+
+    pls: PackedLocalSearch
+    pick_rank: jnp.ndarray  # [1, N] f32 — slot's index in inc[v] order
+    edge_id: jnp.ndarray    # [1, N] f32 — pair-edge id (BIG on dummies)
+    deg_col: jnp.ndarray    # [1, Vp] f32 — per-column pair degree
+
+
+def pack_mgm2_from_pls(
+    pls: Optional[PackedLocalSearch],
+) -> Optional[PackedMgm2]:
+    if pls is None:
+        return None
+    pg = pls.pg
+    if pg.slot_of_edge is None:
+        return None
+    N = pg.N
+    F = len(pg.slot_of_edge) // 2
+    if F == 0:
+        return None
+    # inc[v] ordering of Mgm2Solver._build_pair_structures: edges in id
+    # order, side 0 before side 1 — the pick draw indexes THIS order.
+    # Endpoint vars are reconstructed from slot_of_edge + col_var:
+    # slot -> column -> var
+    slot_col = np.zeros(N, dtype=np.int64)
+    for cls, nvp, voff, soff in pg.buckets:
+        for k in range(cls):
+            slot_col[soff + k * nvp: soff + (k + 1) * nvp] = np.arange(
+                voff, voff + nvp)
+    edge_var = pg.col_var[slot_col[pg.slot_of_edge]]  # [2F]
+    V = pg.n_vars
+    counter = np.zeros(V, dtype=np.int64)
+    rank = np.zeros(2 * F, dtype=np.int64)
+    for e in range(F):
+        for side in (0, 1):
+            v = edge_var[side * F + e]
+            rank[side * F + e] = counter[v]
+            counter[v] += 1
+    pick_rank = np.full((1, N), _BIG_IDX, dtype=np.float32)
+    pick_rank[0, pg.slot_of_edge] = rank.astype(np.float32)
+    edge_id = np.full((1, N), _BIG_IDX, dtype=np.float32)
+    edge_id[0, pg.slot_of_edge[:F]] = np.arange(F, dtype=np.float32)
+    edge_id[0, pg.slot_of_edge[F:]] = np.arange(F, dtype=np.float32)
+    deg_col = np.zeros((1, pg.Vp), dtype=np.float32)
+    cv = pg.col_var
+    deg_col[0, cv >= 0] = counter[cv[cv >= 0]].astype(np.float32)
+    return PackedMgm2(
+        pls=pls,
+        pick_rank=jnp.asarray(pick_rank),
+        edge_id=jnp.asarray(edge_id),
+        deg_col=jnp.asarray(deg_col),
+    )
+
+
+# ---------------------------------------------------------------------------
+# traced cycle body
+# ---------------------------------------------------------------------------
+
+
+def _rowmin_argfirst(rows, Vp, mode_min=True):
+    """rows: [D, Vp].  Returns (best [1, Vp], first index [1, Vp]) via
+    axis-0 reductions (canonical layouts; first index on ties, matching
+    argmin)."""
+    D = rows.shape[0]
+    best = (jnp.min if mode_min else jnp.max)(rows, axis=0, keepdims=True)
+    at = rows <= best if mode_min else rows >= best
+    iota = jax.lax.broadcasted_iota(jnp.int32, (D, Vp), 0).astype(
+        jnp.float32)
+    first = jnp.min(jnp.where(at, iota, float(D)), axis=0, keepdims=True)
+    return best, first
+
+
+def _select_row(arr, idx_row, D):
+    """arr [D, W], idx_row [1, W] — per-lane row select Σ_i [idx==i]·arr[i]
+    (onehot sum keeps canonical layouts)."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, arr.shape, 0).astype(
+        jnp.float32)
+    return jnp.sum(jnp.where(iota == idx_row, arr, 0.0), axis=0,
+                   keepdims=True)
+
+
+def _mgm2_cycle(pm: PackedMgm2, x, u_off, u_pick, u_fav, slabs, unary,
+                mask_p, idx_row, colm, sreal, mate_idx, pick_rank,
+                edge_id, deg_col, consts, hub, threshold: float,
+                favor: str):
+    pls = pm.pls
+    pg = pls.pg
+    D, Vp, N = pg.D, pg.Vp, pg.N
+    eps = 1e-9
+
+    # ---- local tables (hub members get the hub's REAL table: masking
+    # by the spread domain mask, not the head-only mask_p)
+    xs = _bucket_expand(pg, _hub_spread(pg, x, 1, hub), 1)
+    xo = _permute_in_kernel(xs, pg.plan, 1, consts)
+    contrib = slabs[0]
+    for j in range(1, D):
+        contrib = jnp.where(xo == float(j), slabs[j], contrib)
+    raw = _hub_sum(pg, unary + _bucket_reduce(pg, contrib, D, jnp.add),
+                   D, hub)
+    dmask = _hub_spread(pg, mask_p, D, hub)
+    tables = jnp.where(dmask > 0, raw, PAD_COST)
+
+    # ---- own (unilateral) gain per column
+    iota = jax.lax.broadcasted_iota(jnp.int32, (D, Vp), 0).astype(
+        jnp.float32)
+    onehot = jnp.where(iota == x, 1.0, 0.0)
+    cur = jnp.sum(tables * onehot, axis=0, keepdims=True)
+    best_cost, best_idx = _rowmin_argfirst(tables, Vp)
+    own_gain = jnp.maximum(cur - best_cost, 0.0)
+
+    # ---- offer round (spreads stay f32: Mosaic lane gathers take
+    # float vectors, not i1 masks)
+    offerer = _hub_spread(
+        pg, jnp.where(u_off < threshold, 1.0, 0.0), 1, hub)
+    pick = _hub_spread(
+        pg, jnp.floor(u_pick * jnp.maximum(deg_col, 1.0)), 1, hub)
+    off_s = _bucket_expand(pg, offerer, 1)
+    pick_s = _bucket_expand(pg, pick, 1)
+    sel = (off_s > 0) & (pick_rank == pick_s) & (sreal > 0)
+    mate_off = _permute_in_kernel(off_s, pg.plan, 1, consts) * sreal
+    offered = sel & (mate_off == 0)  # my offer on this slot
+
+    # ---- joint gain at the offerer's slot.  A = own table minus this
+    # edge's contribution; the mate's A and cur ride one permutation.
+    A = _bucket_expand(pg, _hub_spread(pg, tables, D, hub), D) - contrib
+    cur_s = _bucket_expand(pg, _hub_spread(pg, cur, 1, hub), 1)
+    Am_cm = _permute_in_kernel(
+        jnp.concatenate([A, cur_s], axis=0), pg.plan, D + 1, consts
+    )
+    Am, cur_m = Am_cm[:D], Am_cm[D: D + 1]
+    cc = jnp.sum(contrib * jnp.where(
+        jax.lax.broadcasted_iota(jnp.int32, (D, N), 0).astype(jnp.float32)
+        == xs, 1.0, 0.0), axis=0, keepdims=True)
+    cur_joint = cur_s + cur_m - cc
+    # flat row-major argmin over the joint [D_own, D_mate] table:
+    # rowmin per own value du (min over mate's dw), then first best du,
+    # then first best dw within that row — exactly argmin(flat)
+    rowmins = []
+    for du in range(D):
+        rm = Am[0: 1, :] + slabs[0][du: du + 1, :]
+        for dw in range(1, D):
+            rm = jnp.minimum(rm, Am[dw: dw + 1, :]
+                             + slabs[dw][du: du + 1, :])
+        rowmins.append(A[du: du + 1, :] + rm)
+    rowmin = jnp.concatenate(rowmins, axis=0)  # [D(own), N]
+    best_joint, du_star = _rowmin_argfirst(rowmin, N)
+    Adu = _select_row(A, du_star, D)
+    cands = []
+    for dw in range(D):
+        Mdw = _select_row(slabs[dw], du_star, D)
+        cands.append(Adu + Am[dw: dw + 1, :] + Mdw)
+    _, dw_star = _rowmin_argfirst(jnp.concatenate(cands, axis=0), N)
+    jg = jnp.maximum(cur_joint - best_joint, 0.0)
+    jg = jnp.where(offered, jg, 0.0)
+
+    # ---- route the offer to the receiver's side
+    off_f = jnp.where(offered, 1.0, 0.0)
+    routed = _permute_in_kernel(
+        jnp.concatenate([off_f, jg, du_star, dw_star], axis=0),
+        pg.plan, 4, consts,
+    )
+    off_in = (routed[0: 1] * sreal) > 0
+    jg_in, du_in, dw_in = routed[1: 2], routed[2: 3], routed[3: 4]
+
+    # ---- response round (per receiver column)
+    pos = off_in & (jg_in > eps)
+    rec_max = _hub_op(
+        pg,
+        _bucket_reduce(pg, jnp.where(pos, jg_in, -1.0), 1, jnp.maximum,
+                       fill=-1.0),
+        1, hub, jnp.maximum,
+    )
+    rm_exp = _bucket_expand(pg, rec_max, 1)
+    at_best = pos & (jg_in >= rm_exp - eps)
+    first_e = _hub_op(
+        pg,
+        _bucket_reduce(pg, jnp.where(at_best, edge_id, _BIG_IDX), 1,
+                       jnp.minimum, fill=_BIG_IDX),
+        1, hub, jnp.minimum,
+    )
+    beats = rec_max > own_gain + eps
+    ties = jnp.abs(rec_max - own_gain) <= eps
+    if favor == "coordinated":
+        commits = beats | ties
+    elif favor == "no":
+        commits = beats | (ties & (u_fav > 0.5))
+    else:  # unilateral
+        commits = beats
+    commits_s = _bucket_expand(
+        pg, _hub_spread(pg, jnp.where(commits, 1.0, 0.0), 1, hub), 1) > 0
+    accepted = at_best & (edge_id == _bucket_expand(pg, first_e, 1)) \
+        & commits_s
+
+    # ---- committed payload, both sides.  Receiver side reads its
+    # accepted slot; the acceptance flag returns to the offerer through
+    # the permutation.
+    acc_f = jnp.where(accepted, 1.0, 0.0)
+    acc_back_r = _permute_in_kernel(acc_f, pg.plan, 1, consts)
+    acc_back = (acc_back_r * sreal) > 0  # my offer was accepted
+    mine = accepted | acc_back           # my pairing slot (either side)
+
+    def col_reduce(slot_rows, op, fill):
+        return _hub_op(
+            pg, _bucket_reduce(pg, slot_rows, 1, op, fill=fill), 1, hub,
+            op)
+
+    committed = col_reduce(jnp.where(mine, 1.0, 0.0), jnp.maximum, 0.0) > 0
+    # target: receiver takes dw* of its accepted slot, offerer du* of
+    # its returned slot
+    tgt_slot = jnp.where(accepted, dw_in,
+                         jnp.where(acc_back, du_star, -1.0))
+    pair_target = col_reduce(tgt_slot, jnp.maximum, -1.0)
+    gain_slot = jnp.where(accepted, jg_in, jnp.where(acc_back, jg, 0.0))
+    pair_gain = col_reduce(gain_slot, jnp.maximum, 0.0)
+    partner = col_reduce(jnp.where(mine, mate_idx, _BIG_IDX),
+                         jnp.minimum, _BIG_IDX)
+
+    # ---- gain & go rounds: arbitration with the pair's shared id
+    gain = jnp.where(committed, pair_gain, own_gain)
+    pid = jnp.where(committed, jnp.minimum(idx_row, partner), idx_row)
+    gp = _permute_in_kernel(
+        jnp.concatenate([
+            _bucket_expand(pg, _hub_spread(pg, gain, 1, hub), 1),
+            _bucket_expand(pg, _hub_spread(pg, pid, 1, hub), 1),
+        ], axis=0), pg.plan, 2, consts,
+    )
+    gn = gp[0: 1] * sreal
+    pn = jnp.where(sreal > 0, gp[1: 2], _BIG_IDX)
+    neigh_max = jnp.maximum(
+        col_reduce(gn, jnp.maximum, 0.0), 0.0)
+    nm_exp = _bucket_expand(pg, neigh_max, 1)
+    idx_at_max = col_reduce(
+        jnp.where(gn >= nm_exp - eps, pn, _BIG_IDX), jnp.minimum,
+        _BIG_IDX)
+    winner = (gain > eps) & (
+        (gain > neigh_max + eps)
+        | ((jnp.abs(gain - neigh_max) <= eps) & (pid <= idx_at_max))
+    )
+    win_s = _bucket_expand(
+        pg, _hub_spread(pg, jnp.where(winner, 1.0, 0.0), 1, hub), 1)
+    win_m = _permute_in_kernel(win_s, pg.plan, 1, consts)
+    partner_win = col_reduce(
+        jnp.where(mine, win_m, 1.0), jnp.minimum, 1.0) > 0
+
+    pair_go = committed & winner & partner_win
+    x2 = jnp.where(pair_go & (colm > 0), pair_target, x)
+    solo = ~committed & winner
+    x2 = jnp.where(solo & (colm > 0), best_idx, x2)
+    return x2
+
+
+# ---------------------------------------------------------------------------
+# fused multi-cycle kernel + PRNG plumbing
+# ---------------------------------------------------------------------------
+
+
+def packed_mgm2_cycles(
+    pm: PackedMgm2,
+    x_row: jnp.ndarray,
+    u_off: jnp.ndarray,   # [n_cycles, Vp]
+    u_pick: jnp.ndarray,  # [n_cycles, Vp]
+    u_fav: jnp.ndarray,   # [n_cycles, Vp]
+    threshold: float,
+    favor: str,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """``n_cycles`` fused MGM-2 cycles in ONE pallas kernel.  Uniform
+    draws are pre-drawn per cycle from the generic solver's exact PRNG
+    stream (uniforms_for_mgm2)."""
+    n_cycles = int(u_off.shape[0])
+    if not 1 <= n_cycles <= 64:
+        raise ValueError(f"n_cycles must be in [1, 64], got {n_cycles}")
+    if favor not in ("unilateral", "no", "coordinated"):
+        raise ValueError(f"unknown favor mode {favor!r}")
+    interpret = _resolve_interpret(interpret)
+    pls = pm.pls
+    pg = pls.pg
+    D, Vp = pg.D, pg.Vp
+    hub_ops = _hub_operands(pg)
+
+    def kern(x_ref, uo_ref, up_ref, uf_ref, unary_ref, maskp_ref,
+             idx_ref, mate_ref, colm_ref, sreal_ref, pickr_ref,
+             eid_ref, degc_ref, c_r1, c_g1, c_ss, c_g2, c_r2, *rest):
+        if hub_ops:
+            hub = (rest[0][:], rest[1][:], rest[2][:])
+            rest = rest[3:]
+        else:
+            hub = None
+        slab_refs, x_out = rest[:-1], rest[-1]
+        slabs = [ref[:] for ref in slab_refs]
+        consts = (c_r1[:], c_g1[:], c_ss[:], c_g2[:], c_r2[:])
+        x = x_ref[:]
+        for c in range(n_cycles):
+            x = _mgm2_cycle(
+                pm, x, uo_ref[c: c + 1, :], up_ref[c: c + 1, :],
+                uf_ref[c: c + 1, :], slabs, unary_ref[:], maskp_ref[:],
+                idx_ref[:], colm_ref[:], sreal_ref[:], mate_ref[:],
+                pickr_ref[:], eid_ref[:], degc_ref[:], consts, hub,
+                threshold, favor,
+            )
+        x_out[:] = x
+
+    operands = [
+        x_row, u_off, u_pick, u_fav, pg.unary_p, pg.mask_p, pls.idx_row,
+        pls.mate_idx, pls.colmask, pls.sreal, pm.pick_rank, pm.edge_id,
+        pm.deg_col, *_plan_consts(pg.plan), *hub_ops, *pls.cost_slabs,
+    ]
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((1, Vp), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(operands),
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+        compiler_params=_compiler_params(),
+    )(*operands)
+
+
+def uniforms_for_mgm2(pm: PackedMgm2, keys: jnp.ndarray):
+    """(u_off, u_pick, u_fav) [n, Vp] matching Mgm2Solver.cycle's
+    ``k_off, k_pick, k_favor = jax.random.split(key, 3)`` draws exactly
+    (pads get 1.0 = never offer / coin favors unilateral)."""
+    V, Vp = pm.pls.pg.n_vars, pm.pls.pg.Vp
+    order = pm.pls.pg.var_order
+
+    def one(k):
+        k_off, k_pick, k_fav = jax.random.split(k, 3)
+        pad = jnp.ones((Vp,), jnp.float32)
+        return (
+            pad.at[order].set(jax.random.uniform(k_off, (V,))),
+            pad.at[order].set(jax.random.uniform(k_pick, (V,))),
+            pad.at[order].set(jax.random.uniform(k_fav, (V,))),
+        )
+
+    return jax.vmap(one)(keys)

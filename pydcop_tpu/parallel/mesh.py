@@ -336,8 +336,13 @@ class ShardedLocalSearch:
         assert isinstance(tensors, ConstraintGraphTensors), (
             "ShardedLocalSearch needs constraint-graph tensors"
         )
-        if rule not in ("mgm", "dsa", "dba", "gdba"):
+        if rule not in ("mgm", "dsa", "adsa", "dba", "gdba"):
             raise ValueError(f"unknown sharded local-search rule {rule!r}")
+        if rule == "adsa" and (algo_params or {}).get(
+                "variant", "B") not in ("A", "B", "C"):
+            raise ValueError(
+                f"unknown adsa variant {(algo_params or {})['variant']!r}"
+            )
         self.base = tensors
         self.mesh = mesh or build_mesh()
         self.n_shards = self.mesh.devices.size
@@ -530,13 +535,42 @@ class ShardedLocalSearch:
             )
             cur, best_val, gain, _ = gains_and_best(
                 base, x, tables=tables,
-                prefer_change=(self.rule == "dsa"),
+                prefer_change=(self.rule in ("dsa", "adsa")),
             )
             if self.rule == "dsa":
                 activate = (
                     jax.random.uniform(key, (st.n_vars,)) < self.probability
                 )
                 move = (gain > 1e-9) & activate
+            elif self.rule == "adsa":
+                # ADsaSolver.cycle semantics over the mesh: a wake mask
+                # emulates the reference's per-agent period timer
+                # (pydcop/algorithms/adsa.py:126), then the DSA-B move
+                # rule — same split-key PRNG discipline as the
+                # single-device solver
+                from pydcop_tpu.algorithms._local_search import (
+                    HARD_THRESHOLD,
+                )
+
+                k_wake, k_move = jax.random.split(key)
+                activation = float(self.params.get("activation", 0.5))
+                awake = (
+                    jax.random.uniform(k_wake, (st.n_vars,)) < activation
+                )
+                activate = (
+                    jax.random.uniform(k_move, (st.n_vars,))
+                    < self.probability
+                )
+                improving = gain > 1e-9
+                lateral = (gain <= 1e-9) & (best_val != x)
+                variant = self.params.get("variant", "B")
+                if variant == "A":
+                    want = improving
+                elif variant == "B":
+                    want = improving | (lateral & (cur >= HARD_THRESHOLD))
+                else:
+                    want = improving | lateral
+                move = want & activate & awake
             else:  # mgm-style arbitration (also dba/gdba)
                 move = neighborhood_winner(base, gain)
             x2 = jnp.where(move, best_val, x).astype(jnp.int32)
