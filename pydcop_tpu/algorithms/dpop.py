@@ -146,6 +146,14 @@ class DpopSolver:
         self.last_engine = "sweep_perlevel" if perlevel else "sweep"
         tree = self.tree
         assign_idx = None
+        if self.engine == "wholesweep" and jax.default_backend() != "tpu":
+            import logging
+
+            logging.getLogger("pydcop_tpu.dpop").warning(
+                "engine:wholesweep requested on a %s backend; the pallas "
+                "whole-sweep kernel targets TPU — using the level scan",
+                jax.default_backend(),
+            )
         if (not perlevel and self.engine == "wholesweep"
                 and jax.default_backend() == "tpu"):
             # single-launch whole-sweep pallas kernel (width-1 trees):

@@ -22,6 +22,7 @@ from __future__ import annotations
 from typing import List, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from pydcop_tpu.ops.compile import FactorBucket, FactorGraphTensors
 from pydcop_tpu.ops.segments import masked_argmin, masked_mean, segment_sum
@@ -75,15 +76,19 @@ def all_factor_messages(
 
 
 def var_beliefs_and_messages(
-    tensors: FactorGraphTensors, r_flat: jnp.ndarray
+    tensors: FactorGraphTensors, r_flat: jnp.ndarray,
+    edges_sorted: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Variable beliefs [V, D] and outgoing var→factor messages [E, D].
 
     beliefs[v] = unary[v] + Σ_{incoming edges} r;
     q[e] = beliefs[var(e)] − r[e], normalized to zero masked mean.
+    ``edges_sorted``: promise that edge_var is non-decreasing (the
+    edge-slab big-graph path re-orders edges for gather locality).
     """
     V = tensors.n_vars
-    beliefs = tensors.unary_costs + segment_sum(r_flat, tensors.edge_var, V)
+    beliefs = tensors.unary_costs + segment_sum(
+        r_flat, tensors.edge_var, V, indices_are_sorted=edges_sorted)
     vmask = tensors.domain_mask[tensors.edge_var]  # [E, D]
     q = beliefs[tensors.edge_var] - r_flat
     q = (q - masked_mean(q, vmask)) * vmask
@@ -123,3 +128,89 @@ def init_messages(tensors: FactorGraphTensors) -> Tuple[jnp.ndarray, jnp.ndarray
     E, D = tensors.n_edges, tensors.max_domain_size
     z = jnp.zeros((E, D), dtype=jnp.float32)
     return z, z
+
+
+# ---------------------------------------------------------------------------
+# edge-slab factor side for very large all-binary graphs
+# ---------------------------------------------------------------------------
+
+
+class EdgeSlabs:
+    """Per-other-value cost slabs for an all-binary graph.
+
+    The [F, D, D] broadcast-add + min formulation above compiles in
+    seconds up to a few hundred thousand factors, but XLA's TPU codegen
+    on the fused 3-D reduce degenerates to MINUTES of compile beyond
+    ~10^6 factors (measured: 27s at 100k vars, 36s at 200k, >600s at
+    1M; the variable side compiles in ~1s at every size).  These slabs
+    re-express the factor update with 2-D elementwise ops only:
+
+        r'[e, i] = min_j (slab_j[e, i] + q[mate(e), j])
+
+    where slab_j[e, i] = cost of this edge's factor at (target=i,
+    other=j) and mate(e) is the factor's other edge.  D gathers + D
+    [E, D] mins — each an op class whose compile time is flat in E.
+    """
+
+    def __init__(self, tensors: FactorGraphTensors,
+                 sort_edges: bool = False):
+        b = tensors.buckets[0]
+        assert len(tensors.buckets) == 1 and b.arity == 2
+        F = b.n_factors
+        D = tensors.max_domain_size
+        T = np.asarray(b.tensors)  # [F, D, D]
+        # edge order in the flat arrays: [F, a, D] reshaped → e = f*2 + p
+        slabs = np.empty((D, 2 * F, D), dtype=np.float32)
+        for j in range(D):
+            slabs[j, 0::2, :] = T[:, :, j]  # p=0 target: other is pos 1
+            slabs[j, 1::2, :] = T[:, j, :]  # p=1 target: other is pos 0
+        mate = np.empty(2 * F, dtype=np.int32)
+        mate[0::2] = np.arange(F) * 2 + 1
+        mate[1::2] = np.arange(F) * 2
+        ev = np.asarray(tensors.edge_var)
+        if sort_edges:
+            # group each variable's edges: the belief scatter and gather
+            # become near-sequential (and indices_are_sorted unlocks the
+            # sorted segment lowering).  The q/r message state then lives
+            # in SORTED edge order — opaque to callers, who only see
+            # per-variable beliefs/values.
+            sigma = np.argsort(ev, kind="stable")
+            inv = np.empty_like(sigma)
+            inv[sigma] = np.arange(len(sigma))
+            slabs = slabs[:, sigma]
+            mate = inv[mate[sigma]].astype(np.int32)
+            ev = ev[sigma]
+        self.slabs = [jnp.asarray(slabs[j]) for j in range(D)]
+        self.mate = jnp.asarray(mate)
+        self.edge_var = jnp.asarray(ev.astype(np.int32))
+        self.sorted = sort_edges
+        self.D = D
+
+
+def maxsum_cycle_edge_slabs(
+    tensors: FactorGraphTensors,
+    slabs: EdgeSlabs,
+    q_flat: jnp.ndarray,
+    r_flat: jnp.ndarray,
+    damping: float = 0.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One MaxSum cycle, identical math to :func:`maxsum_cycle`, with
+    the factor side in edge-slab form (see :class:`EdgeSlabs`).  The
+    message arrays follow the slab's edge order (sorted when the slabs
+    were built with ``sort_edges``)."""
+    ev = slabs.edge_var
+    V = tensors.n_vars
+    vmask = tensors.domain_mask[ev]
+    qm = q_flat[slabs.mate]  # [E, D]
+    r_new = slabs.slabs[0] + qm[:, 0:1]
+    for j in range(1, slabs.D):
+        r_new = jnp.minimum(r_new, slabs.slabs[j] + qm[:, j: j + 1])
+    r_new = r_new * vmask
+    if damping:
+        r_new = damping * r_flat + (1.0 - damping) * r_new
+    beliefs = tensors.unary_costs + segment_sum(
+        r_new, ev, V, indices_are_sorted=slabs.sorted)
+    q_new = beliefs[ev] - r_new
+    q_new = (q_new - masked_mean(q_new, vmask)) * vmask
+    values = select_values(tensors, beliefs)
+    return q_new, r_new, beliefs, values

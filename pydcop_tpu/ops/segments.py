@@ -11,10 +11,11 @@ import jax
 import jax.numpy as jnp
 
 
-def segment_sum(data, segment_ids, num_segments: int):
+def segment_sum(data, segment_ids, num_segments: int,
+                indices_are_sorted: bool = False):
     return jax.ops.segment_sum(
         data, segment_ids, num_segments=num_segments,
-        indices_are_sorted=False,
+        indices_are_sorted=indices_are_sorted,
     )
 
 
