@@ -490,6 +490,69 @@ def bench_scalefree(args):
     return out
 
 
+def bench_mixed_arity(args):
+    """Packed-engine rate on a mixed-arity SECP instance (VERDICT r4
+    item 7): 3900 vars, arity-1 light costs + arity-2/3 model and rule
+    factors — the family that previously fell entirely to the generic
+    engine.  Also confirms a PEAV meeting-scheduling instance (unary
+    preference factors + binary equality/overlap factors) rides the
+    mixed packer."""
+    import jax
+
+    from pydcop_tpu.generators.meetingscheduling import (
+        generate_meetings_peav,
+    )
+    from pydcop_tpu.generators.secp import generate_secp
+    from pydcop_tpu.ops import compile_factor_graph
+    from pydcop_tpu.ops.pallas_maxsum import (
+        packed_cycles, packed_init_state, try_pack_for_pallas,
+    )
+
+    out = {}
+    dcop = generate_secp(n_lights=3000, n_models=900, n_rules=300,
+                         max_model_size=2, seed=1)
+    tensors = compile_factor_graph(dcop)
+    packed = try_pack_for_pallas(tensors)
+    out["secp_mixed_packed"] = bool(packed is not None and packed.mixed)
+    if packed is None or jax.default_backend() != "tpu":
+        return out
+
+    chunk = 5
+
+    @jax.jit
+    def run_n(q, r):
+        def body(carry, _):
+            q, r = carry
+            q2, r2, _, _ = packed_cycles(packed, q, r, chunk, damping=0.5)
+            return (q2, r2), ()
+
+        (q, r), _ = jax.lax.scan(
+            body, (q, r), None, length=args.cycles // chunk)
+        return q, r
+
+    q0, r0 = packed_init_state(packed)
+    q, r = run_n(q0, r0)
+    jax.block_until_ready((q, r))
+    times = []
+    for _ in range(args.repeat):
+        t0 = time.perf_counter()
+        q, r = run_n(q0, r0)
+        jax.block_until_ready((q, r))
+        times.append(time.perf_counter() - t0)
+    out["maxsum_iters_per_sec_secp_mixed_arity"] = round(
+        (args.cycles // chunk * chunk) / robust_best(times), 1)
+
+    # PEAV meeting scheduling: unary preference factors + binary
+    # equality/overlap factors → the mixed packer (slots_count 7 keeps
+    # the value domain within the engine's D <= 8)
+    peav, _ = generate_meetings_peav(
+        slots_count=7, events_count=40, resources_count=30,
+        max_resources_event=3, seed=1)
+    ppacked = try_pack_for_pallas(compile_factor_graph(peav))
+    out["peav_packed"] = bool(ppacked is not None and ppacked.mixed)
+    return out
+
+
 def bench_convergence_stretch(args, V=None, E=None, prefix="stretch",
                               max_cycles=None, check_messages=True,
                               plateau_patience=5):
@@ -882,7 +945,8 @@ def main():
     ap.add_argument(
         "--only",
         choices=["all", "maxsum", "dpop", "convergence", "convergence2",
-                 "local", "scalefree", "sharded", "sharded-inner"],
+                 "local", "scalefree", "mixed", "sharded",
+                 "sharded-inner"],
         default="all",
     )
     # watchdog covers the FULL run: the wholesweep DPOP kernel compile
@@ -1019,6 +1083,12 @@ def main():
         except Exception as e:
             extra["scalefree_error"] = repr(e)
 
+    if args.only in ("all", "mixed"):
+        try:
+            extra.update(bench_mixed_arity(args))
+        except Exception as e:
+            extra["mixed_error"] = repr(e)
+
     def run_with_transient_retry(fn, err_key):
         # the tunneled remote-compile service occasionally drops a
         # response mid-read; one retry keeps such a transient from
@@ -1080,7 +1150,7 @@ def main():
             extra["sharded_error"] = repr(e)
 
     if args.only in ("dpop", "local", "convergence", "convergence2",
-                     "scalefree", "sharded") and not value:
+                     "scalefree", "mixed", "sharded") and not value:
         # single-part run: promote the part's headline measurement (not
         # config constants like stretch_vars) to the primary slot
         headline = ("_per_sec", "_wall_s", "_cycles_per")

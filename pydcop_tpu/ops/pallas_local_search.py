@@ -104,8 +104,12 @@ def pack_from_pg(pg: Optional[PackedMaxSumGraph]
                  ) -> Optional[PackedLocalSearch]:
     """Build the local-search extras on top of an existing packed graph
     (lets solvers that already hold a PackedMaxSumGraph for the tables
-    kernel upgrade lazily, without re-packing)."""
-    if pg is None or pg.D < 2:
+    kernel upgrade lazily, without re-packing).
+
+    Mixed-arity packings are refused: the fused MOVE kernels assume the
+    all-binary slot layout (solvers then run generic moves while still
+    using the packed local-tables kernel for the n-ary costs)."""
+    if pg is None or pg.D < 2 or pg.mixed:
         return None
     Vp, N = pg.Vp, pg.N
     var_order = np.asarray(pg.var_order)

@@ -148,6 +148,17 @@ class PackedMaxSumGraph:
     # host-side numpy — lets packings built on top (mgm2 pairing) map
     # factor-indexed data onto slots
     slot_of_edge: np.ndarray = None
+    # -- mixed arity (pack_mixed_for_pallas) ------------------------------
+    # Each bucket's slots are grouped by arity: k in [0, c1) unary
+    # factors, [c1, c1+c2) binary, [c1+c2, cls) ternary; plan routes the
+    # first sibling, plan2 the second (identity elsewhere).
+    mixed: bool = False
+    buckets_arity: Tuple[Tuple[int, int, int], ...] = ()  # (c1, c2, c3)
+    plan2: Optional[PermutationPlan] = None
+    cost1_rows: Optional[jnp.ndarray] = None  # [D, N]
+    cost3_rows: Optional[jnp.ndarray] = None  # [D*D*D, N] row (j*D+k)*D+i
+    arity_mask2: Optional[jnp.ndarray] = None  # [1, N] 1 on binary slots
+    arity_mask3: Optional[jnp.ndarray] = None  # [1, N] 1 on ternary slots
     # -- hub splitting (variables with degree > _MAX_SLOT_CLASS) ----------
     # A hub's slots are split across m contiguous sub-columns inside a
     # normal degree-class bucket; its full belief/table is recovered with
@@ -178,9 +189,15 @@ def try_pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
     """Fail-safe engine selection: any packing bug degrades to the generic
     engine (with a logged warning) instead of taking the solve down.  Solvers
     must use this, never :func:`pack_for_pallas` directly — a broken packed
-    engine on TPU would otherwise crash every solve on the target hardware."""
+    engine on TPU would otherwise crash every solve on the target hardware.
+
+    All-binary graphs take the binary packer (hub splitting, DP classes);
+    mixed arity-1/2/3 graphs the mixed packer."""
     try:
-        return pack_for_pallas(t)
+        pg = pack_for_pallas(t)
+        if pg is None:
+            pg = pack_mixed_for_pallas(t)
+        return pg
     except Exception:  # noqa: BLE001 — deliberate blanket fallback
         import logging
 
@@ -396,6 +413,220 @@ def pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
     return pg
 
 
+def pack_mixed_for_pallas(t: FactorGraphTensors
+                          ) -> Optional[PackedMaxSumGraph]:
+    """Compile a MIXED-arity (1/2/3) graph into the lane-packed layout
+    (ROADMAP §2a / VERDICT r4 item 7 — SECP model factors, n-ary rule
+    tables).  Column classes are exact per-arity slot-count triples
+    (c1, c2, c3); each bucket's slots are grouped by arity so the kernel
+    applies the right update on aligned lane ranges; the third endpoint
+    of ternary factors rides a SECOND Clos permutation.
+
+    Returns None out of scope: arity > 3, D > 5 (the ternary slab array
+    is D^3 rows), hubs (degree > _MAX_SLOT_CLASS — mixed hub splitting
+    not implemented), too many distinct classes, or VMEM.
+    """
+    by_arity = {b.arity: b for b in t.buckets if b.n_factors > 0}
+    if not by_arity or any(a not in (1, 2, 3) for a in by_arity):
+        return None
+    V, D = t.n_vars, t.max_domain_size
+    if 3 in by_arity and D > 5:
+        return None
+    if D > 8:
+        return None
+
+    # per-arity endpoint lists and per-var degrees
+    ends = {
+        a: np.asarray(b.var_idx).T.ravel()  # e = p*F + f ordering
+        for a, b in by_arity.items()
+    }
+    deg_a = {
+        a: np.bincount(e, minlength=V) for a, e in ends.items()
+    }
+    deg = sum(deg_a.values())
+    if int(deg.max(initial=0)) > _MAX_SLOT_CLASS:
+        return None  # mixed hub splitting: not implemented — generic
+
+    # class triples, each component quantized up a short ladder so the
+    # product space stays small (a variable pads each arity section to
+    # its quantized count with zero-masked dummy slots).  Vectorized:
+    # a per-variable python loop here would be O(V^2) with the zeros
+    # default, and this path also runs as the FALLBACK for large binary
+    # graphs that the binary packer rejects.
+    ladder = np.array((0, 1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96),
+                      dtype=np.int64)
+    zero = np.zeros(V, dtype=np.int64)
+    keys = np.stack([
+        ladder[np.minimum(
+            np.searchsorted(ladder, deg_a.get(a, zero)),
+            len(ladder) - 1)]
+        for a in (1, 2, 3)
+    ], axis=1)  # [V, 3]
+    key_of = [tuple(row) for row in keys.tolist()]
+    classes = sorted(set(key_of))
+    if len(classes) > 2 * _MAX_BUCKETS:
+        return None
+
+    buckets: List[Tuple[int, int, int, int]] = []
+    buckets_arity: List[Tuple[int, int, int]] = []
+    var_pcol = np.full(V, -1, dtype=np.int64)
+    col_var_parts: List[np.ndarray] = []
+    voff = 0
+    for key in classes:
+        vs = [v for v in range(V) if key_of[v] == key]
+        nvp = max(_LANES, int(np.ceil(len(vs) / _LANES)) * _LANES)
+        var_pcol[vs] = voff + np.arange(len(vs))
+        colv = np.full(nvp, -1, dtype=np.int64)
+        colv[: len(vs)] = vs
+        col_var_parts.append(colv)
+        cls = sum(key)
+        if cls > 0:
+            buckets.append([cls, nvp, voff, -1])
+            buckets_arity.append(key)
+        voff += nvp
+    Vp = voff
+    col_var = np.concatenate(col_var_parts)
+
+    soff = 0
+    with_slots = []
+    for cls, nvp, bvoff, _ in buckets:
+        with_slots.append((cls, nvp, bvoff, soff))
+        soff += cls * nvp
+    n_slots = soff
+    A = max(1, int(np.ceil(n_slots / _TILE)))
+    if A > 8:
+        return None
+    N = A * _TILE
+
+    col_soff = np.zeros(Vp, dtype=np.int64)
+    col_nvp = np.ones(Vp, dtype=np.int64)
+    col_voff = np.zeros(Vp, dtype=np.int64)
+    col_base = {a: np.zeros(Vp, dtype=np.int64) for a in (1, 2, 3)}
+    for (cls, nvp, bvoff, bsoff), key in zip(with_slots, buckets_arity):
+        sl = slice(bvoff, bvoff + nvp)
+        col_soff[sl] = bsoff
+        col_nvp[sl] = nvp
+        col_voff[sl] = bvoff
+        col_base[1][sl] = 0
+        col_base[2][sl] = key[0]
+        col_base[3][sl] = key[0] + key[1]
+
+    # slot per edge endpoint, per arity: rank within (var, arity)
+    slot_of = {}
+    for a, e in ends.items():
+        order = np.argsort(e, kind="stable")
+        rank = np.empty(len(e), dtype=np.int64)
+        start = np.concatenate([[0], np.cumsum(deg_a[a])[:-1]])
+        rank[order] = np.arange(len(e)) - start[e[order]]
+        col = var_pcol[e]
+        k = col_base[a][col] + rank
+        slot_of[a] = col_soff[col] + k * col_nvp[col] + (
+            col - col_voff[col])
+
+    # two routing permutations: plan = first sibling, plan2 = second
+    perm1 = np.arange(N, dtype=np.int64)
+    perm2 = np.arange(N, dtype=np.int64)
+    if 2 in by_arity:
+        F2 = by_arity[2].n_factors
+        s2 = slot_of[2]
+        perm1[s2[:F2]] = s2[F2:]
+        perm1[s2[F2:]] = s2[:F2]
+    if 3 in by_arity:
+        F3 = by_arity[3].n_factors
+        s3 = slot_of[3]
+        for p in range(3):
+            mine = s3[p * F3: (p + 1) * F3]
+            sib1 = ((p + 1) % 3)
+            sib2 = ((p + 2) % 3)
+            perm1[mine] = s3[sib1 * F3: (sib1 + 1) * F3]
+            perm2[mine] = s3[sib2 * F3: (sib2 + 1) * F3]
+    plan = plan_permutation(perm1, A, _LANES, _LANES)
+    plan2 = plan_permutation(perm2, A, _LANES, _LANES) \
+        if 3 in by_arity else None
+
+    # cost arrays per arity
+    cost1 = np.zeros((D, N), dtype=np.float32)
+    if 1 in by_arity:
+        T1 = np.asarray(by_arity[1].tensors)  # [F1, D]
+        cost1[:, slot_of[1]] = T1.T
+    cost_rows = np.zeros((D * D, N), dtype=np.float32)
+    if 2 in by_arity:
+        b2 = by_arity[2]
+        F2 = b2.n_factors
+        T2 = np.asarray(b2.tensors)
+        e2 = np.arange(2 * F2)
+        f_of, p_of = e2 % F2, e2 // F2
+        for i in range(D):
+            for j in range(D):
+                vals = np.where(
+                    p_of == 0, T2[f_of, i, j], T2[f_of, j, i])
+                cost_rows[j * D + i, slot_of[2]] = vals
+    cost3 = None
+    if 3 in by_arity:
+        b3 = by_arity[3]
+        F3 = b3.n_factors
+        T3 = np.asarray(b3.tensors)  # [F3, D, D, D]
+        cost3 = np.zeros((D * D * D, N), dtype=np.float32)
+        for p in range(3):
+            mine = slot_of[3][p * F3: (p + 1) * F3]
+            # move the target axis first, then sib1 ((p+1)%3), sib2
+            axes = (0, 1 + p, 1 + (p + 1) % 3, 1 + (p + 2) % 3)
+            Tp = np.transpose(T3, axes)  # [F3, i, j, k]
+            for i in range(D):
+                for j in range(D):
+                    for k in range(D):
+                        cost3[(j * D + k) * D + i, mine] = Tp[:, i, j, k]
+
+    mask_np = np.zeros((D, Vp), dtype=np.float32)
+    unary_np = np.zeros((D, Vp), dtype=np.float32)
+    mask_np[:, var_pcol] = np.asarray(t.domain_mask).T
+    unary_np[:, var_pcol] = np.asarray(t.unary_costs).T * mask_np[:, var_pcol]
+    vmask_np = np.zeros((D, N), dtype=np.float32)
+    for a, e in ends.items():
+        vmask_np[:, slot_of[a]] = mask_np[:, var_pcol[e]]
+    dcount = vmask_np.sum(axis=0, keepdims=True)
+    inv_dcount = np.where(dcount > 0, 1.0 / np.maximum(dcount, 1.0), 0.0)
+
+    # slot_of_edge for the BINARY bucket only (mgm2 pairing contract)
+    soe = slot_of.get(2)
+
+    am2 = np.zeros((1, N), dtype=np.float32)
+    am3 = np.zeros((1, N), dtype=np.float32)
+    if 2 in slot_of:
+        am2[0, slot_of[2]] = 1.0
+    if 3 in slot_of:
+        am3[0, slot_of[3]] = 1.0
+
+    pg = PackedMaxSumGraph(
+        D=D, n_vars=V, Vp=Vp, N=N, plan=plan,
+        buckets=tuple(with_slots),
+        cost_rows=jnp.asarray(cost_rows),
+        unary_p=jnp.asarray(unary_np),
+        mask_p=jnp.asarray(mask_np),
+        vmask=jnp.asarray(vmask_np),
+        inv_dcount=jnp.asarray(inv_dcount.astype(np.float32)),
+        var_order=jnp.asarray(var_pcol.astype(np.int32)),
+        col_var=col_var,
+        slot_of_edge=soe,
+        mixed=True,
+        buckets_arity=tuple(buckets_arity),
+        plan2=plan2,
+        cost1_rows=jnp.asarray(cost1),
+        cost3_rows=jnp.asarray(cost3) if cost3 is not None else None,
+        arity_mask2=jnp.asarray(am2),
+        arity_mask3=jnp.asarray(am3),
+    )
+    # extra working set over the binary estimate: the ternary slab
+    # array (D^3 rows), the unary rows, the two arity masks, plan2's 5
+    # index arrays, and ~2 [D, N] temporaries of the second permutation
+    extra = D * N + 2 * N
+    if cost3 is not None:
+        extra += D * D * D * N + 5 * N + 2 * D * N
+    if 4 * extra + pg.vmem_bytes > _VMEM_BUDGET:
+        return None
+    return pg
+
+
 # ---------------------------------------------------------------------------
 # hub cross-column combine (traced; no-ops when the graph has no hubs)
 # ---------------------------------------------------------------------------
@@ -406,6 +637,34 @@ def _hub_operands(pg: PackedMaxSumGraph) -> Tuple[jnp.ndarray, ...]:
     if pg.hub_nsteps == 0:
         return ()
     return (pg.hub_steps_idx, pg.hub_steps_mask, pg.hub_head_idx)
+
+
+def _mixed_operands(pg: PackedMaxSumGraph) -> Tuple[jnp.ndarray, ...]:
+    """Extra kernel operands for mixed-arity graphs: the unary cost
+    rows, then (arity-3 graphs only) the ternary slab array and the
+    second permutation's 5 index arrays."""
+    if not pg.mixed:
+        return ()
+    ops = [pg.cost1_rows, pg.arity_mask2, pg.arity_mask3]
+    if pg.cost3_rows is not None:
+        ops.append(pg.cost3_rows)
+        ops.extend(_plan_consts(pg.plan2))
+    return tuple(ops)
+
+
+def _parse_mixed_refs(pg: PackedMaxSumGraph, rest):
+    """(mixed_ops, remaining rest) from kernel ref list — inverse of
+    :func:`_mixed_operands`."""
+    if not pg.mixed:
+        return None, rest
+    cost1, am2, am3 = rest[0][:], rest[1][:], rest[2][:]
+    rest = rest[3:]
+    cost3 = consts2 = None
+    if pg.cost3_rows is not None:
+        cost3 = rest[0][:]
+        consts2 = tuple(r[:] for r in rest[1: 6])
+        rest = rest[6:]
+    return (cost1, cost3, consts2, am2, am3), rest
 
 
 def _hub_gather(arr, idx, R: int, rows: int):
@@ -471,18 +730,79 @@ def packed_init_state(pg: PackedMaxSumGraph
     return z, z
 
 
+def _mixed_contrib(pg: PackedMaxSumGraph, xo1, xo2, cost, cost1, cost3,
+                   am2, am3):
+    """Per-slot cost row given the sibling endpoints' current values
+    (mixed-arity local tables): binary select by xo1, ternary by
+    (xo1, xo2), assembled FULL-width with the static arity masks —
+    per-range lane slicing trips Mosaic layout inference (a broadcast
+    of a lane-sliced row is rejected)."""
+    D = pg.D
+    cb = cost[0: D, :]
+    for j in range(1, D):
+        cb = jnp.where(xo1 == float(j), cost[j * D: (j + 1) * D, :], cb)
+    out = jnp.where(am2 > 0, cb, cost1)
+    if cost3 is not None:
+        ct = cost3[0: D, :]
+        for j in range(D):
+            for k in range(D):
+                if j == 0 and k == 0:
+                    continue
+                row = (j * D + k) * D
+                ct = jnp.where(
+                    (xo1 == float(j)) & (xo2 == float(k)),
+                    cost3[row: row + D, :], ct,
+                )
+        out = jnp.where(am3 > 0, ct, out)
+    return out
+
+
+def _mixed_r_new(pg: PackedMaxSumGraph, qm1, qm2, cost, cost1, cost3,
+                 am2, am3):
+    """factor→var messages for the mixed-arity layout: unary slots take
+    their constant cost rows, binary slots the D-slab min over the
+    routed sibling, ternary slots the D²-slab min over BOTH routed
+    siblings — all computed FULL-width and combined with the static
+    arity masks (see :func:`_mixed_contrib` for the layout rationale)."""
+    D = pg.D
+    rb = cost[0: D, :] + qm1[0: 1, :]
+    for j in range(1, D):
+        rb = jnp.minimum(
+            rb, cost[j * D: (j + 1) * D, :] + qm1[j: j + 1, :]
+        )
+    out = jnp.where(am2 > 0, rb, cost1)
+    if cost3 is not None:
+        rt = None
+        for j in range(D):
+            for k in range(D):
+                row = (j * D + k) * D
+                cand = (cost3[row: row + D, :]
+                        + qm1[j: j + 1, :] + qm2[k: k + 1, :])
+                rt = cand if rt is None else jnp.minimum(rt, cand)
+        out = jnp.where(am3 > 0, rt, out)
+    return out
+
+
 def _cycle_body(pg: PackedMaxSumGraph, damping: float, q, r, cost, unary,
-                vmask, invd, plan_consts, hub=None):
+                vmask, invd, plan_consts, hub=None, mixed_ops=None):
     """Traced cycle math shared by the pallas kernel and interpret mode."""
     D, N = pg.D, pg.N
     qm = _permute_in_kernel(q, pg.plan, D, plan_consts)
-    # factor→var: r'[i] = min_j cost[j*D+i] + qm[j] — full-sublane [D, N]
-    # slabs (cost is other-value-major, see pack_for_pallas)
-    r_new = cost[0: D, :] + qm[0: 1, :]
-    for j in range(1, D):
-        r_new = jnp.minimum(
-            r_new, cost[j * D: (j + 1) * D, :] + qm[j: j + 1, :]
+    if mixed_ops is not None:
+        cost1, cost3, consts2, am2, am3 = mixed_ops
+        qm2 = (
+            _permute_in_kernel(q, pg.plan2, D, consts2)
+            if consts2 is not None else qm
         )
+        r_new = _mixed_r_new(pg, qm, qm2, cost, cost1, cost3, am2, am3)
+    else:
+        # factor→var: r'[i] = min_j cost[j*D+i] + qm[j] — full-sublane
+        # [D, N] slabs (cost is other-value-major, see pack_for_pallas)
+        r_new = cost[0: D, :] + qm[0: 1, :]
+        for j in range(1, D):
+            r_new = jnp.minimum(
+                r_new, cost[j * D: (j + 1) * D, :] + qm[j: j + 1, :]
+            )
     r_new = r_new * vmask
     if damping:
         r_new = damping * r + (1.0 - damping) * r_new
@@ -568,6 +888,7 @@ def packed_cycles(
     D, N, Vp = pg.D, pg.N, pg.Vp
 
     hub_ops = _hub_operands(pg)
+    mixed_ops_in = _mixed_operands(pg)
 
     def kern(q_ref, r_ref, cost_ref, unary_ref, vmask_ref,
              invd_ref, c_r1, c_g1, c_ss, c_g2, c_r2, *rest):
@@ -576,6 +897,7 @@ def packed_cycles(
             rest = rest[3:]
         else:
             hub = None
+        mixed, rest = _parse_mixed_refs(pg, rest)
         q_out, r_out, b_out = rest
         cost = cost_ref[:]
         unary = unary_ref[:]
@@ -591,7 +913,7 @@ def packed_cycles(
         for _ in range(n_cycles):
             qn, rn, bel = _cycle_body(
                 pg, damping, qn, rn, cost, unary, vmask, invd, consts,
-                hub=hub,
+                hub=hub, mixed_ops=mixed,
             )
         q_out[:] = qn
         r_out[:] = rn
@@ -605,12 +927,12 @@ def packed_cycles(
             jax.ShapeDtypeStruct((D, Vp), jnp.float32),
         ),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * (
-            11 + len(hub_ops)),
+            11 + len(hub_ops) + len(mixed_ops_in)),
         out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 3),
         interpret=interpret,
         compiler_params=_compiler_params(),
     )(q, r, pg.cost_rows, pg.unary_p, pg.vmask, pg.inv_dcount,
-      *_plan_consts(pg.plan), *hub_ops)
+      *_plan_consts(pg.plan), *hub_ops, *mixed_ops_in)
     values = packed_values(pg, beliefs)
     return q_new, r_new, beliefs, values
 
@@ -645,6 +967,7 @@ def packed_local_tables(pg: PackedMaxSumGraph, x: jnp.ndarray,
     )
 
     hub_ops = _hub_operands(pg)
+    mixed_ops_in = _mixed_operands(pg)
 
     def kern(xp_ref, cost_ref, unary_ref, c_r1, c_g1, c_ss, c_g2, c_r2,
              *rest):
@@ -653,6 +976,7 @@ def packed_local_tables(pg: PackedMaxSumGraph, x: jnp.ndarray,
             rest = rest[3:]
         else:
             hub = None
+        mixed, rest = _parse_mixed_refs(pg, rest)
         (t_out,) = rest
         # hub members carry the hub's current value for their slots
         xp = _hub_spread(pg, xp_ref[:], D, hub)
@@ -666,15 +990,23 @@ def packed_local_tables(pg: PackedMaxSumGraph, x: jnp.ndarray,
             xs = jnp.concatenate(
                 [xs, jnp.zeros((D, N - xs.shape[1]), xs.dtype)], axis=1
             )
-        xo = _permute_in_kernel(
-            xs, pg.plan, D, (c_r1[:], c_g1[:], c_ss[:], c_g2[:], c_r2[:])
-        )
-        # per-slot cost row for the other endpoint's current value
-        contrib = cost[0: D, :]
-        for j in range(1, D):
-            contrib = jnp.where(
-                xo == float(j), cost[j * D: (j + 1) * D, :], contrib
+        consts1 = (c_r1[:], c_g1[:], c_ss[:], c_g2[:], c_r2[:])
+        xo = _permute_in_kernel(xs, pg.plan, D, consts1)
+        if mixed is not None:
+            cost1, cost3, consts2, am2, am3 = mixed
+            xo2 = (
+                _permute_in_kernel(xs, pg.plan2, D, consts2)
+                if consts2 is not None else xo
             )
+            contrib = _mixed_contrib(
+                pg, xo, xo2, cost, cost1, cost3, am2, am3)
+        else:
+            # per-slot cost row for the other endpoint's current value
+            contrib = cost[0: D, :]
+            for j in range(1, D):
+                contrib = jnp.where(
+                    xo == float(j), cost[j * D: (j + 1) * D, :], contrib
+                )
         # bucket-sum slots per variable (as in _cycle_body's beliefs)
         bparts = []
         voff_expect = 0
@@ -699,11 +1031,12 @@ def packed_local_tables(pg: PackedMaxSumGraph, x: jnp.ndarray,
         kern,
         out_shape=jax.ShapeDtypeStruct((D, Vp), jnp.float32),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * (
-            8 + len(hub_ops)),
+            8 + len(hub_ops) + len(mixed_ops_in)),
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=interpret,
         compiler_params=_compiler_params(),
-    )(x_p, pg.cost_rows, pg.unary_p, *_plan_consts(pg.plan), *hub_ops)
+    )(x_p, pg.cost_rows, pg.unary_p, *_plan_consts(pg.plan), *hub_ops,
+      *mixed_ops_in)
     tables = tables_p[:, pg.var_order].T  # [V, D] original order
     mask = pg.mask_p[:, pg.var_order].T
     return jnp.where(mask > 0, tables, PAD_COST)

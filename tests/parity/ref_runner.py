@@ -9,8 +9,22 @@ websocket_server module injected into sys.modules); no reference file
 is modified or copied.
 """
 import json
+import os
 import sys
 import types
+
+# Older-interpreter mode (the DPOP parity oracle, VERDICT r3 item 8):
+# the reference's join() uses ndarray.itemset (removed in NumPy 2) and
+# its computation threads die under py3.12, so test_reference_parity
+# re-runs DPOP cases under the image's python3.11 + NumPy 1.24.  That
+# interpreter lacks the pure-python deps (networkx/yaml); REF_EXTRA_PATH
+# names the py3.12 site-packages to borrow them from — APPENDED so the
+# 3.11 interpreter's own numpy stays first (the 3.12 numpy is a 2.x
+# C-extension build that cannot load), and yaml falls back to its pure
+# loader when its 3.12 _yaml extension fails to import.
+_extra = os.environ.get("REF_EXTRA_PATH")
+if _extra:
+    sys.path.append(_extra)
 
 # --- py3.12 compat for the 3.7-era reference
 import collections
@@ -56,7 +70,11 @@ def main():
         algo, {}, parameters_definitions=mod.algo_params,
         mode=dcop.objective,
     )
-    assignment = solve(dcop, algo_def, "adhoc", timeout=timeout)
+    # oneagent for dpop: the reference's dpop.computation_memory raises
+    # NotImplementedError (dpop.py:81), which the adhoc distribution
+    # calls; oneagent needs no memory callback
+    dist = "oneagent" if algo == "dpop" else "adhoc"
+    assignment = solve(dcop, algo_def, dist, timeout=timeout)
     violation, cost = (None, None)
     if assignment:
         # reference solution_cost returns (hard_violations, soft_cost)

@@ -4,16 +4,20 @@ Each case runs the real /root/reference pyDCOP (thread-mode actors, via
 tests/parity/ref_runner.py in a subprocess with py3.12 shims) and our
 tensor runtime on the same instance, then compares solution quality.
 
-Reference DPOP is excluded: under the shimmed 3.12 runtime it returns an
-empty assignment (its computation threads die silently — reproduced on
-the unmodified reference via its own orchestrator); our DPOP is instead
-cross-checked against brute force in tests/api/test_api_complete.py,
-which is the stronger oracle for an exact algorithm.
+Reference DPOP dies under the shimmed py3.12 runtime (its computation
+threads exit silently and its join() needs the NumPy-1 ndarray.itemset),
+so DPOP cases re-run the reference under the image's python3.11 +
+NumPy 1.24 interpreter instead (VERDICT r3 item 8), borrowing the
+pure-python deps from the 3.12 site-packages via REF_EXTRA_PATH — see
+ref_runner.py.  Brute-force cross-checks remain in
+tests/api/test_api_complete.py.
 """
 import json
 import os
+import shutil
 import subprocess
 import sys
+import sysconfig
 
 import pytest
 
@@ -24,12 +28,20 @@ REPO = os.path.join(os.path.dirname(__file__), "..", "..")
 INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
 RUNNER = os.path.join(os.path.dirname(__file__), "ref_runner.py")
 
+#: interpreter for the DPOP oracle: NumPy 1.x (ndarray.itemset) and a
+#: pre-3.12 threading runtime the 3.7-era reference survives on
+PY311 = shutil.which("python3.11")
 
-def run_reference(instance, algo, timeout=6):
+
+def run_reference(instance, algo, timeout=6, interpreter=None):
+    env = dict(os.environ)
+    cmd_py = interpreter or sys.executable
+    if interpreter is not None:
+        env["REF_EXTRA_PATH"] = sysconfig.get_paths()["purelib"]
     out = subprocess.run(
-        [sys.executable, RUNNER, os.path.join(INSTANCES, instance), algo,
+        [cmd_py, RUNNER, os.path.join(INSTANCES, instance), algo,
          str(timeout)],
-        capture_output=True, text=True, timeout=180,
+        capture_output=True, text=True, timeout=180, env=env,
     )
     assert out.returncode == 0, out.stderr[-1200:]
     return json.loads(out.stdout.strip().splitlines()[-1])
@@ -68,6 +80,29 @@ def test_tuto_maxsum_assignment_parity():
     ref = run_reference("graph_coloring_tuto.yaml", "maxsum")
     ours = run_ours("graph_coloring_tuto.yaml", "maxsum")
     assert ours.assignment == ref["assignment"]  # all-G, unique optimum
+
+
+@pytest.mark.skipif(PY311 is None, reason="python3.11 not in image")
+@pytest.mark.parametrize("instance", [
+    "graph_coloring_tuto.yaml",
+    "coloring_intention.yaml",
+])
+def test_dpop_exact_parity(instance):
+    """The REAL reference DPOP (under python3.11 + NumPy 1.24) and our
+    sweep engine are both exact: costs must agree exactly on
+    pseudo-tree instances — the end-to-end oracle the py3.12 shims
+    could not provide (VERDICT r3 item 8)."""
+    ref = run_reference(instance, "dpop", interpreter=PY311)
+    assert ref["assignment"], "reference DPOP returned empty assignment"
+    ours = run_ours(instance, "dpop")
+    assert ours.cost == pytest.approx(ref["cost"], abs=1e-4)
+    # re-evaluate the reference's assignment under OUR cost model: no
+    # hard violations, and exactly our optimum (ties may differ in the
+    # chosen assignment, never in its cost)
+    dcop = load_dcop_from_file(os.path.join(INSTANCES, instance))
+    v_ref, c_ref = dcop.solution_cost(ref["assignment"], 10000)
+    assert v_ref == 0
+    assert c_ref == pytest.approx(ours.cost, abs=1e-4)
 
 
 def test_intention_mgm_cost_parity():
