@@ -107,6 +107,18 @@ class MaxSumSolver(SynchronousTensorSolver):
             from pydcop_tpu.ops.pallas_maxsum import try_pack_for_pallas
 
             self.packed = try_pack_for_pallas(self.tensors)
+        # megascale tier: beyond ~1M edge endpoints the [F, D, D]
+        # broadcast-min form compiles for >10 MINUTES through the TPU
+        # toolchain (measured; docs/performance.rst) — the edge-slab
+        # form is bit-identical and compiles in seconds at any size
+        self.eslabs = None
+        if (self.packed is None
+                and self.tensors.n_edges >= 1_000_000
+                and len(self.tensors.buckets) == 1
+                and self.tensors.buckets[0].arity == 2):
+            from pydcop_tpu.ops.maxsum_kernels import EdgeSlabs
+
+            self.eslabs = EdgeSlabs(self.tensors)
 
     def initial_state(self):
         if self.packed is not None:
@@ -126,6 +138,14 @@ class MaxSumSolver(SynchronousTensorSolver):
 
             q2, r2, beliefs, values = packed_cycle(
                 self.packed, q, r, damping=self.damping
+            )
+        elif self.eslabs is not None:
+            from pydcop_tpu.ops.maxsum_kernels import (
+                maxsum_cycle_edge_slabs,
+            )
+
+            q2, r2, beliefs, values = maxsum_cycle_edge_slabs(
+                self.tensors, self.eslabs, q, r, damping=self.damping
             )
         else:
             q2, r2, beliefs, values = maxsum_cycle(
@@ -154,11 +174,62 @@ class MaxSumSolver(SynchronousTensorSolver):
             messages_stable(prev_state[1], state[1], self.stability)
         ))
 
+    def _eslab_chunk_runner(self, n, collect: bool):
+        """Megascale chunk runner: the slab/unary/mask arrays ride as
+        explicit jit ARGUMENTS — as closure constants they would be
+        embedded into the HLO shipped to the (remote) compiler, which
+        at 100-200MB is exactly the compile-time failure mode this
+        engine exists to avoid."""
+        import dataclasses
+
+        from pydcop_tpu.ops.maxsum_kernels import (
+            EdgeSlabs,
+            edge_slab_total_cost,
+            maxsum_cycle_edge_slabs,
+        )
+
+        cache_key = (n, collect, "eslab")
+        if cache_key not in self._compiled_chunks:
+            sl = self.eslabs
+            was_sorted = sl.sorted
+            big = (tuple(sl.slabs), sl.mate, sl.edge_var,
+                   self.tensors.unary_costs, self.tensors.domain_mask)
+
+            @jax.jit
+            def run_args(state, keys, big):
+                slab_arrs, mate, ev, un, dm = big
+                t2 = dataclasses.replace(
+                    self.tensors, unary_costs=un, domain_mask=dm)
+                sl2 = EdgeSlabs.from_arrays(
+                    slab_arrs, mate, ev, self.tensors.max_domain_size,
+                    was_sorted)
+
+                def body(st, k):
+                    q, r, _ = st
+                    q2, r2, _, v = maxsum_cycle_edge_slabs(
+                        t2, sl2, q, r, damping=self.damping)
+                    # collected cost from the slab args — total_cost
+                    # would pull the [F, D, D] bucket tensors in as a
+                    # 100-200MB closure constant at exactly this scale
+                    return (q2, r2, v), (
+                        edge_slab_total_cost(sl2, un, dm, v)
+                        if collect else None)
+
+                return jax.lax.scan(body, state, keys)
+
+            def runner(state, keys):
+                return run_args(state, keys, big)
+
+            self._compiled_chunks[cache_key] = runner
+        return self._compiled_chunks[cache_key]
+
     def _chunk_runner(self, n, collect: bool = True):
         """Packed-engine fast path: when per-cycle metrics are not
         collected, fuse groups of cycles into single pallas kernels
         (ops.pallas_maxsum.packed_cycles) — measured ~28% faster than
         one kernel per cycle at benchmark sizes."""
+        if self.eslabs is not None:
+            return self._eslab_chunk_runner(n, collect)
         if collect or self.packed is None or n < 2:
             return super()._chunk_runner(n, collect)
         groups = [g for g in (5, 4, 3, 2) if n % g == 0]

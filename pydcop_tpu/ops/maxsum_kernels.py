@@ -186,6 +186,37 @@ class EdgeSlabs:
         self.sorted = sort_edges
         self.D = D
 
+    @classmethod
+    def from_arrays(cls, slabs, mate, edge_var, D: int,
+                    sorted_edges: bool) -> "EdgeSlabs":
+        """Rebuild from (possibly traced) arrays — for jit functions
+        that take the big arrays as ARGUMENTS instead of closure
+        constants (the whole point of this engine at megascale)."""
+        sl = cls.__new__(cls)
+        sl.slabs = list(slabs)
+        sl.mate = mate
+        sl.edge_var = edge_var
+        sl.sorted = sorted_edges
+        sl.D = D
+        return sl
+
+
+def edge_slab_total_cost(sl: EdgeSlabs, unary, domain_mask, x):
+    """Total cost of assignment ``x`` computed FROM the slab arrays —
+    ops.compile.total_cost iterates tensors.buckets, whose [F, D, D]
+    tensors would ride into a jit as a 100-200MB closure constant at
+    the scales this engine targets.  Each factor is seen from both its
+    edges, hence the half."""
+    x_own = x[sl.edge_var]
+    x_oth = x_own[sl.mate]
+    contrib = sl.slabs[0]
+    for j in range(1, sl.D):
+        contrib = jnp.where((x_oth == j)[:, None], sl.slabs[j], contrib)
+    pair = jnp.take_along_axis(contrib, x_own[:, None], axis=1)[:, 0]
+    V = unary.shape[0]
+    un = unary[jnp.arange(V), x] * domain_mask[jnp.arange(V), x]
+    return 0.5 * jnp.sum(pair) + jnp.sum(un)
+
 
 def maxsum_cycle_edge_slabs(
     tensors: FactorGraphTensors,
