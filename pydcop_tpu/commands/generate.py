@@ -50,6 +50,16 @@ def set_parser(subparsers):
     p.add_argument("--col_count", type=int, default=None)
     p.add_argument("--bin_range", type=float, default=1.6)
     p.add_argument("--un_range", type=float, default=0.05)
+    p.add_argument("--intentional", action="store_true",
+                   help="intentional (expression) constraints "
+                   "(default is extensive form)")
+    p.add_argument("--no_agents", action="store_true",
+                   help="generate the problem without agents")
+    p.add_argument("--fg_dist", action="store_true",
+                   help="also emit a factor-graph distribution (one "
+                   "variable + 3 factors per agent)")
+    p.add_argument("--var_dist", action="store_true",
+                   help="also emit a one-variable-per-agent distribution")
     p.add_argument("--seed", type=int, default=0)
 
     p = gen_sub.add_parser("secp")
@@ -135,6 +145,33 @@ def _write(args, text: str):
     return 0
 
 
+def _write_dist(args, mapping, tag: str, graph: str, dist_algo: str = "NA"):
+    """Emit a distribution next to the generated DCOP: to
+    ``<output>_<tag><ext>`` when --output is set, else as an extra YAML
+    document on stdout (the reference prints both to stdout,
+    ising.py:249-271)."""
+    import yaml as _yaml
+
+    text = _yaml.dump({
+        "inputs": {
+            "dist_algo": dist_algo,
+            "dcop": args.output or "NA",
+            "graph": graph,
+            "algo": "NA",
+        },
+        "distribution": mapping,
+        "cost": None,
+    })
+    if args.output:
+        import os as _os
+
+        path, ext = _os.path.splitext(args.output)
+        with open(f"{path}_{tag}{ext}", "w", encoding="utf-8") as f:
+            f.write(text)
+    else:
+        sys.stdout.write("---\n" + text)
+
+
 def _graphcoloring(args):
     from pydcop_tpu.dcop import dcop_yaml
     from pydcop_tpu.generators import generate_graph_coloring
@@ -159,8 +196,6 @@ def _graphcoloring(args):
 
 
 def _meetings_peav(args):
-    import yaml as _yaml
-
     from pydcop_tpu.dcop import dcop_yaml
     from pydcop_tpu.generators import generate_meetings_peav
 
@@ -177,30 +212,10 @@ def _meetings_peav(args):
         routes_default=args.routes_default,
         capacity=args.capacity,
     )
-    dist_text = None
-    if mapping is not None:
-        dist_text = _yaml.dump({
-            "inputs": {
-                "dist_algo": "peav",
-                "dcop": args.output or "NA",
-                "graph": "constraints_graph",
-                "algo": "NA",
-            },
-            "distribution": mapping,
-            "cost": None,
-        })
     rc = _write(args, dcop_yaml(dcop))
-    if dist_text is not None:
-        if args.output:
-            import os as _os
-
-            path, ext = _os.path.splitext(args.output)
-            with open(f"{path}_dist{ext}", "w", encoding="utf-8") as f:
-                f.write(dist_text)
-        else:
-            # separate YAML document on stdout, so consumers can split
-            # the DCOP and the distribution with a multi-doc load
-            sys.stdout.write("---\n" + dist_text)
+    if mapping is not None:
+        _write_dist(args, mapping, "dist", "constraints_graph",
+                    dist_algo="peav")
     return rc
 
 
@@ -208,14 +223,27 @@ def _ising(args):
     from pydcop_tpu.dcop import dcop_yaml
     from pydcop_tpu.generators import generate_ising
 
-    dcop = generate_ising(
+    dcop, var_mapping, fg_mapping = generate_ising(
         rows=args.row_count,
         cols=args.col_count or args.row_count,
         bin_range=args.bin_range,
         un_range=args.un_range,
         seed=args.seed,
+        intentional=args.intentional,
+        no_agents=args.no_agents,
+        fg_dist=args.fg_dist,
+        var_dist=args.var_dist,
     )
-    return _write(args, dcop_yaml(dcop))
+    rc = _write(args, dcop_yaml(dcop))
+
+    # emit the requested distribution(s) next to the DCOP, as
+    # <name>_fgdist / <name>_vardist files (reference ising.py:249-271)
+    graph = "factor_graph" if args.fg_dist else "constraints_graph"
+    if args.fg_dist:
+        _write_dist(args, fg_mapping, "fgdist", graph)
+    if args.var_dist:
+        _write_dist(args, var_mapping, "vardist", graph)
+    return rc
 
 
 def _secp(args):

@@ -1,66 +1,133 @@
-"""Ising-model factor-graph generator.
+"""Ising-model benchmark generator.
 
 Equivalent capability to the reference's pydcop/commands/generators/ising.py
-(:158-334): a grid of binary spins with random pairwise couplings and unary
-fields — the standard MaxSum benchmark topology.
+(generate_ising :274-331, constraint builders :343-430): a toroidal grid of
+binary spins where each variable carries a unary field constraint
+``cu_v_{r}_{c}`` (cost k at 0, -k at 1, k ~ U[-un_range, un_range]) and each
+grid edge a coupling constraint ``cb_v_{r1}_{c1}_v_{r2}_{c2}`` (cost k if the
+spins agree, -k otherwise, k ~ U[-bin_range, bin_range]).
+
+Supports the reference's full option surface: extensive (tensor) or
+intentional (expression) constraints, agent-less output, and the two
+distribution mappings (one-variable-per-agent ``var_dist`` and the
+factor-graph ``fg_dist`` that gives each agent its variable, its unary
+factor, and the two couplings left/below it — ising.py:301-318).
+
+Deviation (documented): randomness is drawn from a seeded
+``np.random.default_rng`` instead of the global ``random`` module, so
+instances are reproducible.
 """
 from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Tuple
 
 import numpy as np
 
 from pydcop_tpu.dcop.dcop import DCOP
-from pydcop_tpu.dcop.objects import AgentDef, Domain, VariableWithCostDict
-from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation, constraint_from_str
 
 
 def generate_ising(
     rows: int,
-    cols: int,
+    cols: int | None = None,
     bin_range: float = 1.6,
     un_range: float = 0.05,
     seed: int = 0,
     capacity: float = 100,
-) -> DCOP:
-    """rows×cols toroidal Ising grid: spin variables with random unary
-    fields in [-un_range, un_range] and couplings in [-bin_range,
-    bin_range] (cost k·si·sj with si, sj ∈ {-1, 1})."""
+    intentional: bool = False,
+    no_agents: bool = False,
+    fg_dist: bool = False,
+    var_dist: bool = False,
+) -> Tuple[DCOP, Dict[str, List[str]], Dict[str, List[str]]]:
+    """Build a rows×cols toroidal Ising DCOP.
+
+    Returns ``(dcop, var_mapping, fg_mapping)`` where the mappings are the
+    agent→computations distributions requested via ``var_dist`` /
+    ``fg_dist`` (empty dicts otherwise), mirroring the reference's
+    generate_ising return shape (ising.py:283, :331).
+    """
+    if rows <= 2:
+        raise ValueError("row_count: the size must be > 2")
+    if cols is None:
+        cols = rows
+    elif cols <= 2:
+        raise ValueError("col_count: the size must be > 2")
+
     rng = np.random.default_rng(seed)
-    dcop = DCOP(f"ising_{rows}x{cols}", "min")
-    domain = Domain("spin", "spin", [-1, 1])
+    dcop = DCOP(f"Ising_{rows}_{cols}_{bin_range}_{un_range}", "min")
+    domain = Domain("var_domain", "binary", [0, 1])
 
-    variables = {}
+    variables: Dict[Tuple[int, int], Variable] = {}
     for r in range(rows):
         for c in range(cols):
-            name = f"s_{r}_{c}"
-            u = float(rng.uniform(-un_range, un_range))
-            variables[(r, c)] = VariableWithCostDict(
-                name, domain, {-1: -u, 1: u}
+            v = Variable(f"v_{r}_{c}", domain)
+            variables[(r, c)] = v
+            dcop.add_variable(v)
+
+    # unary field constraints (reference ising.py:399-430)
+    for (r, c), v in variables.items():
+        k = float(rng.uniform(-un_range, un_range))
+        if intentional:
+            cu = constraint_from_str(
+                f"cu_{v.name}", f"-{k} if {v.name} == 1 else {k}", [v]
             )
-            dcop.add_variable(variables[(r, c)])
+        else:
+            cu = NAryMatrixRelation([v], np.array([k, -k]), f"cu_{v.name}")
+        dcop.add_constraint(cu)
 
-    k = 0
+    # toroidal grid couplings: each cell connects up and right, which
+    # enumerates every edge of the periodic grid exactly once for
+    # rows, cols > 2 (reference walks nx.grid_2d_graph(periodic=True))
+    edges = set()
     for r in range(rows):
         for c in range(cols):
-            for dr, dc in ((0, 1), (1, 0)):
-                r2, c2 = (r + dr) % rows, (c + dc) % cols
-                if (r2, c2) == (r, c):
-                    continue
-                coupling = float(rng.uniform(-bin_range, bin_range))
-                # cost(si, sj) = k * si * sj
-                m = np.array(
-                    [[coupling, -coupling], [-coupling, coupling]],
-                    dtype=np.float32,
-                )
-                dcop.add_constraint(
-                    NAryMatrixRelation(
-                        [variables[(r, c)], variables[(r2, c2)]],
-                        m,
-                        f"c{k:06d}",
-                    )
-                )
-                k += 1
+            for other in ((r - 1) % rows, c), (r, (c + 1) % cols):
+                edges.add(tuple(sorted([(r, c), other])))
+    for (r1, c1), (r2, c2) in sorted(edges):
+        v1, v2 = variables[(r1, c1)], variables[(r2, c2)]
+        k = float(rng.uniform(-bin_range, bin_range))
+        name = f"cb_{v1.name}_{v2.name}"
+        if intentional:
+            cb = constraint_from_str(
+                name, f"{k} if {v1.name} == {v2.name} else -{k}", [v1, v2]
+            )
+        else:
+            cb = NAryMatrixRelation(
+                [v1, v2], np.array([[k, -k], [-k, k]]), name
+            )
+        dcop.add_constraint(cb)
 
-    dcop.add_agents(
-        [AgentDef(f"a{i}", capacity=capacity) for i in range(rows * cols)]
-    )
-    return dcop
+    # mappings are built regardless of no_agents (the reference drops the
+    # agents from the DCOP but still emits the distributions, supporting
+    # the add-agents-later workflow — ising.py:298-322)
+    var_mapping: Dict[str, List[str]] = defaultdict(list)
+    fg_mapping: Dict[str, List[str]] = defaultdict(list)
+    agents = []
+    for r in range(rows):
+        for c in range(cols):
+            agent = AgentDef(f"a_{r}_{c}", capacity=capacity)
+            agents.append(agent)
+            if var_dist:
+                var_mapping[agent.name].append(f"v_{r}_{c}")
+            if fg_dist:
+                # the agent owns its variable, its unary factor, and
+                # the couplings toward (r-1, c) and (r, c+1)
+                # (reference ising.py:311-318)
+                fg_mapping[agent.name].append(f"v_{r}_{c}")
+                fg_mapping[agent.name].append(f"cu_v_{r}_{c}")
+                up = ((r - 1) % rows, c)
+                (ra, ca), (rb, cb_) = sorted([(r, c), up])
+                fg_mapping[agent.name].append(
+                    f"cb_v_{ra}_{ca}_v_{rb}_{cb_}"
+                )
+                right = (r, (c + 1) % cols)
+                (ra, ca), (rb, cb_) = sorted([(r, c), right])
+                fg_mapping[agent.name].append(
+                    f"cb_v_{ra}_{ca}_v_{rb}_{cb_}"
+                )
+    if not no_agents:
+        dcop.add_agents(agents)
+
+    return dcop, dict(var_mapping), dict(fg_mapping)

@@ -35,7 +35,7 @@ def test_secp_maxsum_near_optimal():
 
 def test_ising_maxsum():
     """Ising grid BP (reference config #3)."""
-    dcop = generate_ising(4, 4, seed=2)
+    dcop, _, _ = generate_ising(4, 4, seed=2)
     res = solve_result(dcop, "maxsum", cycles=40)
     assert res.status == "FINISHED"
     assert res.violation == 0

@@ -109,7 +109,54 @@ class TestGenerate:
 
         dcop = load_dcop_from_file(out_file)
         assert len(dcop.variables) == 9
-        assert len(dcop.constraints) == 18  # toroidal 2 per cell
+        # 9 unary fields + 18 toroidal couplings (2 per cell)
+        assert len(dcop.constraints) == 27
+        assert "cu_v_0_0" in dcop.constraints
+        assert len(dcop.agents) == 9
+
+    def test_generate_ising_options(self, tmp_path):
+        """Reference option surface: --intentional --no_agents
+        --fg_dist --var_dist (ising.py:155-240)."""
+        out_file = str(tmp_path / "ising.yaml")
+        run_cli("--output", out_file, "generate", "ising",
+                "--row_count", "3", "--intentional",
+                "--fg_dist", "--var_dist")
+        from pydcop_tpu.dcop import load_dcop_from_file
+
+        dcop = load_dcop_from_file(out_file)
+        assert len(dcop.constraints) == 27
+        # intentional form survives the YAML round-trip as expressions
+        cu = dcop.constraints["cu_v_0_0"]
+        assert cu(v_0_0=0) == -cu(v_0_0=1)
+        # both distributions written next to the DCOP
+        import yaml as _yaml
+
+        fg = _yaml.safe_load(
+            open(str(tmp_path / "ising_fgdist.yaml"), encoding="utf-8"))
+        var = _yaml.safe_load(
+            open(str(tmp_path / "ising_vardist.yaml"), encoding="utf-8"))
+        assert var["distribution"]["a_0_0"] == ["v_0_0"]
+        fg00 = fg["distribution"]["a_0_0"]
+        assert "v_0_0" in fg00 and "cu_v_0_0" in fg00
+        assert sum(c.startswith("cb_") for c in fg00) == 2
+        # every computation is mapped exactly once in the fg dist
+        mapped = [c for comps in fg["distribution"].values()
+                  for c in comps]
+        assert len(mapped) == len(set(mapped)) == 27 + 9
+        # the generated distribution solves with maxsum
+        out = json_out(run_cli(
+            "solve", "--algo", "maxsum", "--distribution",
+            str(tmp_path / "ising_fgdist.yaml"), out_file))
+        assert out["status"] in ("FINISHED", "TIMEOUT")
+
+    def test_generate_ising_no_agents(self, tmp_path):
+        out_file = str(tmp_path / "ising.yaml")
+        run_cli("--output", out_file, "generate", "ising",
+                "--row_count", "3", "--no_agents")
+        from pydcop_tpu.dcop import load_dcop_from_file
+
+        dcop = load_dcop_from_file(out_file)
+        assert len(dcop.agents) == 0
 
     @pytest.mark.parametrize(
         "gen_args",
