@@ -238,11 +238,10 @@ def _ising(args):
 
     # emit the requested distribution(s) next to the DCOP, as
     # <name>_fgdist / <name>_vardist files (reference ising.py:249-271)
-    graph = "factor_graph" if args.fg_dist else "constraints_graph"
     if args.fg_dist:
-        _write_dist(args, fg_mapping, "fgdist", graph)
+        _write_dist(args, fg_mapping, "fgdist", "factor_graph")
     if args.var_dist:
-        _write_dist(args, var_mapping, "vardist", graph)
+        _write_dist(args, var_mapping, "vardist", "constraints_graph")
     return rc
 
 

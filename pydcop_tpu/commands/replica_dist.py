@@ -2,11 +2,12 @@
 
 Equivalent capability to the reference's pydcop/commands/replica_dist.py:
 given a DCOP, an algorithm and a distribution, place k replicas of every
-computation and print the mapping.
+computation and emit the mapping as a replica-distribution YAML document
+(reference :219-233) that `pydcop_tpu run --replica_dist` can consume.
 """
 from __future__ import annotations
 
-from pydcop_tpu.commands._utils import output_metrics
+import sys
 
 
 def set_parser(subparsers):
@@ -33,11 +34,16 @@ def run_cmd(args):
     cg = load_graph_module(algo_module.GRAPH_TYPE).build_computation_graph(
         dcop
     )
-    dist = load_distribution_module(args.distribution).distribute(
-        cg, dcop.agents.values(), hints=dcop.dist_hints,
-        computation_memory=algo_module.computation_memory,
-        communication_load=algo_module.communication_load,
-    )
+    try:
+        dist = load_distribution_module(args.distribution).distribute(
+            cg, dcop.agents.values(), hints=dcop.dist_hints,
+            computation_memory=algo_module.computation_memory,
+            communication_load=algo_module.communication_load,
+        )
+    except Exception as e:
+        print(f"replica_dist: cannot distribute with "
+              f"'{args.distribution}': {e}", file=sys.stderr)
+        return 1
     replicas = place_replicas(
         [n.name for n in cg.nodes], dist, dcop.agents.values(),
         args.ktarget,
@@ -45,7 +51,18 @@ def run_cmd(args):
             cg.computation(c)
         ),
     )
-    output_metrics(
-        {"replica_dist": replicas.mapping(), "status": "OK"}, args.output
-    )
+    from pydcop_tpu.replication.yamlformat import yaml_replica_dist
+
+    text = yaml_replica_dist(replicas, inputs={
+        "dcop": list(args.dcop_files),
+        "algo": args.algo,
+        "distribution": args.distribution,
+        "replication": "dist_ucs_hostingcosts",
+        "k": args.ktarget,
+    })
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
     return 0

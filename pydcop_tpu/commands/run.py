@@ -38,6 +38,9 @@ def set_parser(subparsers):
                         "/state on this port (ws on port+1)")
     parser.add_argument("--ktarget", type=int, default=3,
                         help="replication level k")
+    parser.add_argument("--replica_dist", default=None,
+                        help="pre-computed replica-distribution YAML "
+                        "(from `replica_dist`); skips online replication")
     parser.add_argument("--seed", type=int, default=0)
     return parser
 
@@ -65,7 +68,13 @@ def run_cmd(args):
         seed=args.seed,
     )
     orch.deploy_computations()
-    if args.ktarget:
+    if args.replica_dist:
+        from pydcop_tpu.replication.yamlformat import (
+            load_replica_dist_from_file,
+        )
+
+        orch.replicas = load_replica_dist_from_file(args.replica_dist)
+    elif args.ktarget:
         orch.start_replication(args.ktarget)
     ui = None
     if args.uiport:

@@ -240,6 +240,56 @@ events:
         )
 
 
+    def test_replica_dist_yaml_roundtrip_into_run(self, tmp_path):
+        """`replica_dist` saves a replica-distribution YAML; `run
+        --replica_dist` consumes it for repair (reference
+        replication/yamlformat.py + commands/replica_dist.py:219-233)."""
+        rep_file = tmp_path / "replicas.yaml"
+        proc = run_cli(
+            "--output", str(rep_file), "replica_dist", "--algo", "maxsum",
+            "--distribution", "adhoc", "-k", "2", TUTO,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        from pydcop_tpu.replication.yamlformat import (
+            load_replica_dist_from_file,
+        )
+
+        replicas = load_replica_dist_from_file(str(rep_file))
+        # every computation of the factor graph has 2 replicas
+        mapping = replicas.mapping()
+        assert sorted(mapping) == sorted(
+            ["v1", "v2", "v3", "v4", "c_1_2", "c_1_3", "c_2_3", "c_2_4"]
+        )
+        assert all(len(hosts) == 2 for hosts in mapping.values())
+
+        scen = tmp_path / "scen.yaml"
+        scen.write_text(
+            """
+events:
+  - id: d1
+    delay: 1
+  - id: e1
+    actions:
+      - type: remove_agent
+        agent: a2
+"""
+        )
+        out = json_out(
+            run_cli(
+                "--timeout", "40", "run", "--algo", "maxsum",
+                "--distribution", "adhoc", "--scenario", str(scen),
+                "--replica_dist", str(rep_file), TUTO,
+            )
+        )
+        assert out["status"] in ("FINISHED", "TIMEOUT")
+        assert "a2" not in out["distribution"]
+        # repair respected the saved replica placement: every computation
+        # that lived on a2 moved to one of its saved replica holders
+        assert out["replicas"] == {
+            c: hosts for c, hosts in mapping.items()
+        }
+
+
 class TestBatchConsolidate:
     def test_batch_and_consolidate(self, tmp_path):
         batch_def = tmp_path / "batch.yaml"

@@ -175,3 +175,51 @@ class TestRepairQuality:
         for a in orch.distribution.agents:
             all_comps.extend(orch.distribution.computations_hosted(a))
         assert len(all_comps) == len(set(all_comps))
+
+
+class TestReplicaDistYaml:
+    """Round-trip of the replica-distribution YAML format (reference
+    replication/yamlformat.py:44-58)."""
+
+    def test_roundtrip(self):
+        from pydcop_tpu.replication import ReplicaDistribution
+        from pydcop_tpu.replication.yamlformat import (
+            load_replica_dist,
+            yaml_replica_dist,
+        )
+
+        replicas = ReplicaDistribution(
+            {"v1": ["a2", "a3"], "c_1_2": ["a1"]}
+        )
+        text = yaml_replica_dist(replicas, inputs={"k": 2})
+        loaded = load_replica_dist(text)
+        assert loaded.mapping() == replicas.mapping()
+
+    def test_invalid_file_rejected(self):
+        from pydcop_tpu.replication.yamlformat import load_replica_dist
+
+        with pytest.raises(ValueError):
+            load_replica_dist("distribution:\n  a1: [v1]\n")
+        with pytest.raises(ValueError):
+            load_replica_dist("replica_dist: [not, a, mapping]\n")
+
+    def test_file_roundtrip(self, tmp_path):
+        from pydcop_tpu.replication import ReplicaDistribution
+        from pydcop_tpu.replication.yamlformat import (
+            load_replica_dist_from_file,
+            yaml_replica_dist,
+        )
+
+        path = tmp_path / "rep.yaml"
+        replicas = ReplicaDistribution({"v1": ["a2"]})
+        path.write_text(yaml_replica_dist(replicas))
+        assert load_replica_dist_from_file(
+            str(path)).mapping() == {"v1": ["a2"]}
+
+    def test_scalar_replicas_rejected(self):
+        from pydcop_tpu.replication.yamlformat import load_replica_dist
+
+        with pytest.raises(ValueError):
+            load_replica_dist("replica_dist:\n  v1: a2\n")
+        with pytest.raises(ValueError):
+            load_replica_dist("replica_dist:\n  v1:\n")
