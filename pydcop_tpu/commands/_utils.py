@@ -74,6 +74,7 @@ def warn_process_mode(mode: str) -> None:
             "note: --mode process runs the same single-process tensor "
             "engine as thread mode (one process IS the whole agent "
             "population); for true multi-process execution use "
-            "'pydcop_tpu agent --multihost'",
+            "'pydcop_tpu agent --multihost' or the library API "
+            "run_local_process_dcop (spawns N localhost mesh ranks)",
             file=sys.stderr,
         )

@@ -54,6 +54,9 @@ def set_parser(subparsers):
                         help="repeated name:value algorithm parameters "
                         "(e.g. gdba's modifier/violation/increase_mode)")
     parser.add_argument("--cycles", type=int, default=30)
+    parser.add_argument("--seed", type=int, default=0,
+                        help="PRNG seed for the local-search rules "
+                        "(must be identical on all ranks)")
     return parser
 
 
@@ -100,7 +103,7 @@ def run_multihost(args):
     if args.algo in LS_RULES:
         values, n_devices, tensors = run_multihost_local_search(
             dcop, rule=args.algo, cycles=args.cycles,
-            algo_params=algo_params)
+            seed=args.seed, algo_params=algo_params)
     else:
         # amaxsum: per-edge activation masks in the sharded engine (same
         # emulation as AMaxSumSolver, decorrelated per shard)
@@ -112,7 +115,8 @@ def run_multihost(args):
                 algo_params.get("activation", DEFAULT_ACTIVATION)
             )
         values, n_devices, tensors = run_multihost_maxsum(
-            dcop, cycles=args.cycles, activation=activation)
+            dcop, cycles=args.cycles, activation=activation,
+            seed=args.seed)
     assignment = tensors.assignment_from_indices(values)
     violation, cost = dcop.solution_cost(assignment, DEFAULT_INFINITY)
     output_metrics({

@@ -75,12 +75,14 @@ def global_mesh():
 
 
 def run_multihost_maxsum(dcop, cycles: int = 15, damping: float = 0.5,
-                         activation: Optional[float] = None):
+                         activation: Optional[float] = None,
+                         seed: int = 0):
     """Solve `dcop` with MaxSum sharded over the global multi-process
     mesh.  Returns (values, n_global_devices, tensors).  Every process
     must call this with an identical dcop (SPMD).  ``activation`` < 1
     runs the amaxsum emulation (per-edge activation masks,
-    ShardedMaxSum)."""
+    ShardedMaxSum); ``seed`` drives its activation PRNG and must be
+    identical on all ranks."""
     from pydcop_tpu.ops.compile import compile_factor_graph
     from pydcop_tpu.parallel.mesh import ShardedMaxSum
 
@@ -88,7 +90,7 @@ def run_multihost_maxsum(dcop, cycles: int = 15, damping: float = 0.5,
     mesh = global_mesh()
     sharded = ShardedMaxSum(tensors, mesh, damping=damping,
                             activation=activation)
-    values, _q, _r = sharded.run(cycles=cycles)
+    values, _q, _r = sharded.run(cycles=cycles, seed=seed)
     return values, mesh.devices.size, tensors
 
 
@@ -153,6 +155,9 @@ def main(argv=None) -> int:
             from pydcop_tpu.algorithms.amaxsum import DEFAULT_ACTIVATION
 
             activation = DEFAULT_ACTIVATION
+        # note: --seed names the generated INSTANCE here; the run PRNG
+        # stays at the engines' default so every rank and the
+        # single-process comparison stream match
         values, n_devices, _tensors = run_multihost_maxsum(
             dcop, cycles=args.cycles, activation=activation)
     else:

@@ -238,9 +238,8 @@ def run_local_thread_dcop(
     seed: int = 0,
 ):
     """Reference-parity constructor (infrastructure/run.py:145): returns a
-    deployed orchestrator.  In the tensor runtime "thread mode" and
-    "process mode" are the same engine — one process IS the whole agent
-    population — so both names build a VirtualOrchestrator."""
+    deployed orchestrator.  In thread mode the tensor runtime is the whole
+    agent population in one process."""
     from pydcop_tpu.runtime.orchestrator import VirtualOrchestrator
 
     orch = VirtualOrchestrator(
@@ -252,6 +251,41 @@ def run_local_thread_dcop(
     return orch
 
 
-#: reference-parity alias (infrastructure/run.py:225) — see
-#: run_local_thread_dcop
-run_local_process_dcop = run_local_thread_dcop
+def run_local_process_dcop(
+    dcop: DCOP,
+    algo: Union[str, AlgorithmDef],
+    distribution: Union[str, Any] = "adhoc",
+    graph: Optional[str] = None,
+    collector=None,
+    collect_moment: str = "value_change",
+    period: Optional[float] = None,
+    replication: Optional[str] = None,
+    seed: int = 0,
+    n_processes: int = 2,
+    platform: Optional[str] = "cpu",
+    local_devices: Optional[int] = None,
+):
+    """Reference-parity constructor (infrastructure/run.py:225-287):
+    returns a deployed orchestrator whose solve REALLY runs across
+    ``n_processes`` OS processes on this host — each process is one rank
+    of a global ``jax.distributed`` device mesh (Gloo on CPU, ICI/DCN on
+    TPU pods) and the per-cycle ``psum`` replaces the reference's HTTP
+    agent messaging.
+
+    Supported for the sharded engine families (maxsum/amaxsum and
+    mgm/dsa/dba/gdba); ``collector``/``collect_moment``/``period`` are
+    accepted for signature parity but per-cycle collection is a
+    thread-mode feature (ranks report end metrics only — documented
+    deviation).  ``platform`` defaults to "cpu" so localhost ranks never
+    fight over a single-tenant TPU chip; pass ``None`` on a real pod to
+    autodetect the local chips.
+    """
+    from pydcop_tpu.runtime.process import ProcessOrchestrator
+
+    orch = ProcessOrchestrator(
+        dcop, algo, distribution=distribution, graph=graph, seed=seed,
+        n_processes=n_processes, platform=platform,
+        local_devices=local_devices,
+    )
+    orch.deploy_computations()
+    return orch
