@@ -58,8 +58,9 @@ algo_params = [
 class Mgm2Solver(LocalSearchSolver):
     """State = (x,)."""
 
-    def __init__(self, dcop, tensors, algo_def, seed=0):
-        super().__init__(dcop, tensors, algo_def, seed)
+    def __init__(self, dcop, tensors, algo_def, seed=0, use_packed=None):
+        super().__init__(dcop, tensors, algo_def, seed,
+                         use_packed=use_packed)
         self.threshold = float(self.params.get("threshold", 0.5))
         self.favor = str(self.params.get("favor", "unilateral"))
         if self.favor not in ("unilateral", "no", "coordinated"):

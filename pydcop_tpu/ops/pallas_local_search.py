@@ -10,6 +10,11 @@ gain computation, and (for MGM) the neighborhood gain arbitration — runs
 in ONE pallas kernel, with multiple cycles statically unrolled per kernel
 launch.
 
+Covers BOTH packed layouts: all-binary graphs (per-other-value cost
+slabs, one Clos permutation) and mixed-arity 1/2/3 graphs (the packed
+graph's cost_rows/cost1/cost3 arrays with arity-masked assembly, a
+second permutation for the ternary sibling — VERDICT r4 item 1).
+
 Layout (shared with ops.pallas_maxsum.PackedMaxSumGraph — an all-binary
 constraints hypergraph IS an all-binary factor graph, with var-var
 neighbor pairs as factor mates):
@@ -52,6 +57,9 @@ from pydcop_tpu.ops.pallas_maxsum import (
     _hub_operands,
     _hub_spread,
     _hub_sum,
+    _mixed_contrib,
+    _mixed_operands,
+    _parse_mixed_refs,
     _resolve_interpret,
     try_pack_for_pallas,
 )
@@ -71,18 +79,29 @@ class PackedLocalSearch:
     idx_row: jnp.ndarray    # [1, Vp] f32 — original var index (BIG on pads)
     colmask: jnp.ndarray    # [1, Vp] f32 — 1 on real variable columns
     sreal: jnp.ndarray      # [1, N]  f32 — 1 on real edge slots
-    # cost_rows split into D separate [D, N] slabs (slab j = costs given
-    # the other endpoint holds value j).  Passing each slab as its own
-    # kernel operand keeps every read in Mosaic's canonical vector layout;
-    # row-slicing one [D*D, N] array gives slices sublane-offset layouts
-    # that tpu.concatenate cannot reconcile with the zero-fill pieces of
-    # the bucket reduce (verified on hardware).
+    # ALL-BINARY layout: cost_rows split into D separate [D, N] slabs
+    # (slab j = costs given the other endpoint holds value j).  Passing
+    # each slab as its own kernel operand keeps every read in Mosaic's
+    # canonical vector layout; row-slicing one [D*D, N] array gives
+    # slices sublane-offset layouts that tpu.concatenate cannot
+    # reconcile with the zero-fill pieces of the bucket reduce (verified
+    # on hardware).  Empty on MIXED packings — those read the packed
+    # graph's cost arrays through the where-select assembly of
+    # pallas_maxsum._mixed_contrib, which hardware-compiles fine.
     cost_slabs: Tuple[jnp.ndarray, ...] = ()
     # [1, N] — original variable index of each slot's factor mate (the
     # neighbor on the other end), BIG on dummy slots.  The graph topology
     # is static, so MGM's tie-break index exchange needs NO runtime
     # permute — only the gains travel.
     mate_idx: jnp.ndarray = None
+    # [1, N] — 1 exactly where mate_idx is a real neighbor (= sreal for
+    # all-binary packings; excludes unary slots on mixed packings).  Gains
+    # routed onto masked slots are zeroed before the neighborhood max.
+    gmask1: jnp.ndarray = None
+    # mixed+ternary packings only: the SECOND sibling's index per slot
+    # (routed by pg.plan2), BIG off ternary slots; its gain mask is
+    # pg.arity_mask3
+    mate2_idx: Optional[jnp.ndarray] = None
 
     @property
     def n_vars(self) -> int:
@@ -95,7 +114,7 @@ class PackedLocalSearch:
 
 def pack_local_search(tensors) -> Optional[PackedLocalSearch]:
     """Compile the packed local-search layout, or None when the graph is
-    not packable (non-binary, hub overflow, VMEM) — callers fall back to
+    not packable (arity > 3, hub overflow, VMEM) — callers fall back to
     the generic engine."""
     return pack_from_pg(try_pack_for_pallas(tensors))
 
@@ -106,10 +125,11 @@ def pack_from_pg(pg: Optional[PackedMaxSumGraph]
     (lets solvers that already hold a PackedMaxSumGraph for the tables
     kernel upgrade lazily, without re-packing).
 
-    Mixed-arity packings are refused: the fused MOVE kernels assume the
-    all-binary slot layout (solvers then run generic moves while still
-    using the packed local-tables kernel for the n-ary costs)."""
-    if pg is None or pg.D < 2 or pg.mixed:
+    Handles both layouts: all-binary packings get the per-other-value
+    cost slabs; mixed-arity (1/2/3) packings reuse the packed graph's
+    own cost arrays (cost_rows/cost1/cost3 + arity masks) and carry a
+    second mate-index array for the ternary siblings."""
+    if pg is None or pg.D < 2:
         return None
     Vp, N = pg.Vp, pg.N
     var_order = np.asarray(pg.var_order)
@@ -120,11 +140,26 @@ def pack_from_pg(pg: Optional[PackedMaxSumGraph]
     # real-slot mask: row 0 of vmask is 1 exactly on real slots (every
     # variable's value 0 is valid)
     sreal = np.asarray(pg.vmask)[0:1, :].astype(np.float32)
+    sreal_j = jnp.asarray(sreal)
     D = pg.D
-    cost_np = np.asarray(pg.cost_rows)
-    slabs = tuple(
-        jnp.asarray(cost_np[j * D: (j + 1) * D, :]) for j in range(D)
-    )
+    if pg.mixed:
+        # mixed kernels slice pg.cost_rows/cost1/cost3 in-kernel (the
+        # layout packed_local_tables already proves on hardware)
+        slabs = ()
+        gmask1 = np.clip(
+            np.asarray(pg.arity_mask2) + np.asarray(pg.arity_mask3),
+            0.0, 1.0,
+        ).astype(np.float32)
+        gmask1_j = jnp.asarray(gmask1)
+    else:
+        cost_np = np.asarray(pg.cost_rows)
+        slabs = tuple(
+            jnp.asarray(cost_np[j * D: (j + 1) * D, :]) for j in range(D)
+        )
+        # same mask: alias the device buffer instead of re-uploading a
+        # second [1, N] copy (tens of MB at stretch scale)
+        gmask1 = sreal
+        gmask1_j = sreal_j
     # static neighbor index per slot: expand own indices to slots on the
     # host, route them through the plan's numpy reference once.  Uses the
     # per-COLUMN variable map (col_var) rather than idx_np so a hub's
@@ -138,14 +173,22 @@ def pack_from_pg(pg: Optional[PackedMaxSumGraph]
             own_idx_slots[0, soff + k * nvp: soff + (k + 1) * nvp] = \
                 col_idx[0, voff: voff + nvp]
     mate = pg.plan.apply_numpy(own_idx_slots)
-    mate = np.where(sreal > 0, mate, _BIG_IDX).astype(np.float32)
+    mate = np.where(gmask1 > 0, mate, _BIG_IDX).astype(np.float32)
+    mate2 = None
+    if pg.mixed and pg.plan2 is not None:
+        m2 = pg.plan2.apply_numpy(own_idx_slots)
+        mate2 = jnp.asarray(np.where(
+            np.asarray(pg.arity_mask3) > 0, m2, _BIG_IDX
+        ).astype(np.float32))
     return PackedLocalSearch(
         pg=pg,
         idx_row=jnp.asarray(idx_np),
         colmask=jnp.asarray(colmask),
-        sreal=jnp.asarray(sreal),
+        sreal=sreal_j,
         cost_slabs=slabs,
         mate_idx=jnp.asarray(mate),
+        gmask1=gmask1_j,
+        mate2_idx=mate2,
     )
 
 
@@ -214,17 +257,30 @@ def _permute1(pg: PackedMaxSumGraph, row, consts):
 
 
 def _local_tables_body(pg: PackedMaxSumGraph, x_row, slabs, unary, mask_p,
-                       consts, hub=None):
-    """tables[d, v] = unary + Σ_slots cost(v=d | other endpoint at x);
-    PAD_COST at invalid (d, v) slots.  One values permute.  ``slabs`` are
-    the D per-other-value cost planes [D, N] (see PackedLocalSearch)."""
+                       consts, hub=None, mixed=None, cost=None):
+    """tables[d, v] = unary + Σ_slots cost(v=d | other endpoints at x);
+    PAD_COST at invalid (d, v) slots.  One values permute (two on
+    ternary graphs).  All-binary layout: ``slabs`` are the D
+    per-other-value cost planes [D, N] (see PackedLocalSearch).  Mixed
+    layout: ``cost`` is the full [D*D, N] binary array and ``mixed`` the
+    parsed (cost1, cost3, consts2, am2, am3) refs — per-slot rows are
+    assembled by pallas_maxsum._mixed_contrib, exactly as the
+    packed_local_tables kernel does."""
     D = pg.D
     # hub members carry the hub's value for their slots
     xs = _bucket_expand(pg, _hub_spread(pg, x_row, 1, hub), 1)
     xo = _permute1(pg, xs, consts)
-    contrib = slabs[0]
-    for j in range(1, D):
-        contrib = jnp.where(xo == float(j), slabs[j], contrib)
+    if mixed is not None:
+        cost1, cost3, consts2, am2, am3 = mixed
+        xo2 = (
+            _permute_in_kernel(xs, pg.plan2, 1, consts2)
+            if consts2 is not None else xo
+        )
+        contrib = _mixed_contrib(pg, xo, xo2, cost, cost1, cost3, am2, am3)
+    else:
+        contrib = slabs[0]
+        for j in range(1, D):
+            contrib = jnp.where(xo == float(j), slabs[j], contrib)
     tables = _hub_sum(
         pg, unary + _bucket_reduce(pg, contrib, D, jnp.add), D, hub
     )
@@ -258,27 +314,38 @@ def _cur_best_gain(pg: PackedMaxSumGraph, tables, x_row, prefer_change):
     return cur, best_idx, gain
 
 
-def _mgm_move(pls: PackedLocalSearch, gain, idx_row, mate_idx, sreal,
-              consts, hub=None):
+def _mgm_move(pls: PackedLocalSearch, gain, idx_row, mate_idx, gmask1,
+              consts, hub=None, mate2=None, gmask2=None, consts2=None):
     """MGM neighborhood arbitration (neighborhood_winner semantics):
     True [1, Vp] where own gain is the strict neighborhood max, lexic
-    tie-break by original variable index.  One gains permute; the
-    tie-break indices are the STATIC mate_idx array — topology doesn't
-    change at runtime, so only gains travel."""
+    tie-break by original variable index.  One gains permute (a second
+    on ternary graphs for the other sibling); the tie-break indices are
+    the STATIC mate arrays — topology doesn't change at runtime, so only
+    gains travel.  ``gmask1``/``gmask2`` zero the slots whose permute
+    routes no real neighbor (dummies, and unary slots on mixed
+    layouts, which route identity)."""
     pg = pls.pg
     # hub member slots must send the hub's gain to their neighbors
     gs = _bucket_expand(pg, _hub_spread(pg, gain, 1, hub), 1)
-    gn = _permute1(pg, gs, consts)
-    gn = gn * sreal  # dummy slots pull their own gain via identity: zero it
+    gn = _permute1(pg, gs, consts) * gmask1
+    gn2 = None
+    if mate2 is not None:
+        gn2 = _permute_in_kernel(gs, pg.plan2, 1, consts2) * gmask2
+    gboth = gn if gn2 is None else jnp.maximum(gn, gn2)
     # hub combine: a hub's neighborhood max/tie-break spans ALL its
     # sub-columns' slots
     neigh_max = jnp.maximum(
-        _hub_op(pg, _bucket_reduce(pg, gn, 1, jnp.maximum), 1, hub,
+        _hub_op(pg, _bucket_reduce(pg, gboth, 1, jnp.maximum), 1, hub,
                 jnp.maximum),
         0.0,
     )
     nm_exp = _bucket_expand(pg, neigh_max, 1)
+    # masked slots are safe here: their gn is 0 and their mate is BIG
     idx_cand = jnp.where(gn >= nm_exp - 1e-9, mate_idx, _BIG_IDX)
+    if gn2 is not None:
+        idx_cand = jnp.minimum(
+            idx_cand, jnp.where(gn2 >= nm_exp - 1e-9, mate2, _BIG_IDX)
+        )
     # fill=_BIG_IDX: degree-0 variables have no neighbor at max, so the
     # lexic tie-break must let them through (generic: idx_at_max = V)
     idx_at_max = _hub_op(
@@ -313,47 +380,69 @@ def packed_mgm_cycles(
         raise ValueError(f"n_cycles must be in [1, 64], got {n_cycles}")
     interpret = _resolve_interpret(interpret)
     pg = pls.pg
-    D, Vp, N = pg.D, pg.Vp, pg.N
+    Vp = pg.Vp
+    mixed = pg.mixed
+    has_m2 = pls.mate2_idx is not None
 
     hub_ops = _hub_operands(pg)
+    cost_ops = ((pg.cost_rows,) + _mixed_operands(pg)) if mixed \
+        else pls.cost_slabs
 
     def kern(x_ref, unary_ref, maskp_ref, idx_ref, mate_ref, colm_ref,
-             sreal_ref, c_r1, c_g1, c_ss, c_g2, c_r2, *rest):
+             g1_ref, c_r1, c_g1, c_ss, c_g2, c_r2, *rest):
+        if has_m2:
+            mate2, rest = rest[0][:], rest[1:]
+        else:
+            mate2 = None
         if hub_ops:
             hub = (rest[0][:], rest[1][:], rest[2][:])
             rest = rest[3:]
         else:
             hub = None
-        slab_refs, x_out = rest[:-1], rest[-1]
-        slabs = [ref[:] for ref in slab_refs]
+        if mixed:
+            cost = rest[0][:]
+            mixed_refs, rest = _parse_mixed_refs(pg, rest[1:])
+            slabs = None
+            consts2 = mixed_refs[2]
+            gmask2 = mixed_refs[4]  # am3: gain mask of the 2nd sibling
+        else:
+            cost = mixed_refs = consts2 = gmask2 = None
+            slabs = [ref[:] for ref in rest[:-1]]
+            rest = rest[-1:]
+        (x_out,) = rest
         unary = unary_ref[:]
         mask_p = maskp_ref[:]
         idx_row = idx_ref[:]
         mate_idx = mate_ref[:]
         colm = colm_ref[:]
-        sreal = sreal_ref[:]
+        g1 = g1_ref[:]
         consts = (c_r1[:], c_g1[:], c_ss[:], c_g2[:], c_r2[:])
         x = x_ref[:]
         for _ in range(n_cycles):
             tables = _local_tables_body(pg, x, slabs, unary, mask_p,
-                                        consts, hub=hub)
+                                        consts, hub=hub,
+                                        mixed=mixed_refs, cost=cost)
             _cur, best_idx, gain = _cur_best_gain(pg, tables, x, False)
-            move = _mgm_move(pls, gain, idx_row, mate_idx, sreal, consts,
-                             hub=hub)
+            move = _mgm_move(pls, gain, idx_row, mate_idx, g1, consts,
+                             hub=hub, mate2=mate2, gmask2=gmask2,
+                             consts2=consts2)
             x = jnp.where(move & (colm > 0), best_idx, x)
         x_out[:] = x
 
-    n_in = 12 + D + len(hub_ops)
+    operands = [x_row, pg.unary_p, pg.mask_p, pls.idx_row, pls.mate_idx,
+                pls.colmask, pls.gmask1, *_plan_consts(pg.plan)]
+    if has_m2:
+        operands.append(pls.mate2_idx)
+    operands.extend(hub_ops)
+    operands.extend(cost_ops)
     return pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((1, Vp), jnp.float32),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n_in,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(operands),
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=interpret,
         compiler_params=_compiler_params(),
-    )(x_row, pg.unary_p, pg.mask_p, pls.idx_row, pls.mate_idx,
-      pls.colmask, pls.sreal, *_plan_consts(pg.plan), *hub_ops,
-      *pls.cost_slabs)
+    )(*operands)
 
 
 def packed_dsa_cycles(
@@ -396,8 +485,11 @@ def packed_dsa_cycles(
     D, Vp = pg.D, pg.Vp
     prefer_change = variant in ("B", "C")
     adsa_mode = awake_uniforms is not None
+    mixed = pg.mixed
 
     hub_ops = _hub_operands(pg)
+    cost_ops = ((pg.cost_rows,) + _mixed_operands(pg)) if mixed \
+        else pls.cost_slabs
 
     def kern(x_ref, u_ref, *rest):
         if adsa_mode:
@@ -410,8 +502,15 @@ def packed_dsa_cycles(
             rest = rest[3:]
         else:
             hub = None
-        slab_refs, x_out = rest[:-1], rest[-1]
-        slabs = [ref[:] for ref in slab_refs]
+        if mixed:
+            cost = rest[0][:]
+            mixed_refs, rest = _parse_mixed_refs(pg, rest[1:])
+            slabs = None
+        else:
+            cost = mixed_refs = None
+            slabs = [ref[:] for ref in rest[:-1]]
+            rest = rest[-1:]
+        (x_out,) = rest
         unary = unary_ref[:]
         mask_p = maskp_ref[:]
         colm = colm_ref[:]
@@ -419,7 +518,8 @@ def packed_dsa_cycles(
         x = x_ref[:]
         for c in range(n_cycles):
             tables = _local_tables_body(pg, x, slabs, unary, mask_p,
-                                        consts, hub=hub)
+                                        consts, hub=hub,
+                                        mixed=mixed_refs, cost=cost)
             cur, best_idx, gain = _cur_best_gain(
                 pg, tables, x, prefer_change
             )
@@ -449,7 +549,7 @@ def packed_dsa_cycles(
     if adsa_mode:
         operands.append(awake_uniforms)
     operands.extend([pg.unary_p, pg.mask_p, pls.colmask,
-                     *_plan_consts(pg.plan), *hub_ops, *pls.cost_slabs])
+                     *_plan_consts(pg.plan), *hub_ops, *cost_ops])
     return pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((1, Vp), jnp.float32),

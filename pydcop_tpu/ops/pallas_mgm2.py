@@ -76,6 +76,11 @@ def pack_mgm2_from_pls(
     if pls is None:
         return None
     pg = pls.pg
+    if pg.mixed:
+        # the 5-round kernel reads the binary cost slabs (exclusive and
+        # joint tables); mixed layouts don't carry them — generic moves
+        # (on packed tables) until a mixed mgm2 kernel exists
+        return None
     if pg.slot_of_edge is None:
         return None
     N = pg.N
