@@ -55,6 +55,9 @@ from pydcop_tpu.ops.pallas_maxsum import (
     _hub_operands,
     _hub_spread,
     _hub_sum,
+    _mixed_contrib,
+    _mixed_operands,
+    _parse_mixed_refs,
     _resolve_interpret,
 )
 from pydcop_tpu.ops.pallas_permute import _permute_in_kernel, _plan_consts
@@ -76,11 +79,6 @@ def pack_mgm2_from_pls(
     if pls is None:
         return None
     pg = pls.pg
-    if pg.mixed:
-        # the 5-round kernel reads the binary cost slabs (exclusive and
-        # joint tables); mixed layouts don't carry them — generic moves
-        # (on packed tables) until a mixed mgm2 kernel exists
-        return None
     if pg.slot_of_edge is None:
         return None
     N = pg.N
@@ -151,19 +149,48 @@ def _select_row(arr, idx_row, D):
 def _mgm2_cycle(pm: PackedMgm2, x, u_off, u_pick, u_fav, slabs, unary,
                 mask_p, idx_row, colm, sreal, mate_idx, pick_rank,
                 edge_id, deg_col, consts, hub, threshold: float,
-                favor: str):
+                favor: str, cost=None, mixed=None, gmask1=None):
+    """One MGM-2 cycle.  All-binary layout: ``slabs`` are the D
+    per-other-value cost planes.  Mixed layout: ``slabs`` is None,
+    ``cost`` the [D*D, N] binary array (zeros off binary slots),
+    ``mixed`` the parsed (cost1, cost3, consts2, am2, am3) refs and
+    ``gmask1`` the first-sibling gain mask — pairing stays binary-only
+    (pick_rank/edge_id are BIG off binary slots) while tables and the
+    gain/go arbitration cover every arity."""
     pls = pm.pls
     pg = pls.pg
     D, Vp, N = pg.D, pg.Vp, pg.N
     eps = 1e-9
+    if gmask1 is None:
+        gmask1 = sreal
+
+    def slab(j):
+        # per-other-value binary cost plane [D, N].  The mixed branch
+        # row-slices the [D*D, N] array in-kernel; unlike the binary
+        # move kernels' zero-fill bucket reduce, these slices only feed
+        # adds/minima/concats of same-provenance slices, which Mosaic
+        # compiles fine (verified on v5e hardware: the mixed MGM-2
+        # parity run bit-matched the generic solver, non-interpret)
+        return slabs[j] if slabs is not None \
+            else cost[j * D: (j + 1) * D, :]
 
     # ---- local tables (hub members get the hub's REAL table: masking
     # by the spread domain mask, not the head-only mask_p)
     xs = _bucket_expand(pg, _hub_spread(pg, x, 1, hub), 1)
     xo = _permute_in_kernel(xs, pg.plan, 1, consts)
-    contrib = slabs[0]
-    for j in range(1, D):
-        contrib = jnp.where(xo == float(j), slabs[j], contrib)
+    if mixed is not None:
+        cost1, cost3, consts2, am2, am3 = mixed
+        xo2 = (
+            _permute_in_kernel(xs, pg.plan2, 1, consts2)
+            if consts2 is not None else xo
+        )
+        contrib = _mixed_contrib(pg, xo, xo2, cost, cost1, cost3, am2,
+                                 am3)
+    else:
+        consts2 = None
+        contrib = slab(0)
+        for j in range(1, D):
+            contrib = jnp.where(xo == float(j), slab(j), contrib)
     raw = _hub_sum(pg, unary + _bucket_reduce(pg, contrib, D, jnp.add),
                    D, hub)
     dmask = _hub_spread(pg, mask_p, D, hub)
@@ -206,17 +233,17 @@ def _mgm2_cycle(pm: PackedMgm2, x, u_off, u_pick, u_fav, slabs, unary,
     # then first best dw within that row — exactly argmin(flat)
     rowmins = []
     for du in range(D):
-        rm = Am[0: 1, :] + slabs[0][du: du + 1, :]
+        rm = Am[0: 1, :] + slab(0)[du: du + 1, :]
         for dw in range(1, D):
             rm = jnp.minimum(rm, Am[dw: dw + 1, :]
-                             + slabs[dw][du: du + 1, :])
+                             + slab(dw)[du: du + 1, :])
         rowmins.append(A[du: du + 1, :] + rm)
     rowmin = jnp.concatenate(rowmins, axis=0)  # [D(own), N]
     best_joint, du_star = _rowmin_argfirst(rowmin, N)
     Adu = _select_row(A, du_star, D)
     cands = []
     for dw in range(D):
-        Mdw = _select_row(slabs[dw], du_star, D)
+        Mdw = _select_row(slab(dw), du_star, D)
         cands.append(Adu + Am[dw: dw + 1, :] + Mdw)
     _, dw_star = _rowmin_argfirst(jnp.concatenate(cands, axis=0), N)
     jg = jnp.maximum(cur_joint - best_joint, 0.0)
@@ -284,23 +311,36 @@ def _mgm2_cycle(pm: PackedMgm2, x, u_off, u_pick, u_fav, slabs, unary,
     partner = col_reduce(jnp.where(mine, mate_idx, _BIG_IDX),
                          jnp.minimum, _BIG_IDX)
 
-    # ---- gain & go rounds: arbitration with the pair's shared id
+    # ---- gain & go rounds: arbitration with the pair's shared id.
+    # Gains/ids travel the first-sibling permutation (masked by gmask1:
+    # unary slots route identity and must not echo the own gain) and,
+    # on ternary graphs, the second-sibling permutation too — the
+    # generic arbitration spans ALL co-constrained pairs
+    # (mgm2.py cycle: t.neighbor_src/neighbor_dst).
     gain = jnp.where(committed, pair_gain, own_gain)
     pid = jnp.where(committed, jnp.minimum(idx_row, partner), idx_row)
-    gp = _permute_in_kernel(
-        jnp.concatenate([
-            _bucket_expand(pg, _hub_spread(pg, gain, 1, hub), 1),
-            _bucket_expand(pg, _hub_spread(pg, pid, 1, hub), 1),
-        ], axis=0), pg.plan, 2, consts,
-    )
-    gn = gp[0: 1] * sreal
-    pn = jnp.where(sreal > 0, gp[1: 2], _BIG_IDX)
+    gain_pid_s = jnp.concatenate([
+        _bucket_expand(pg, _hub_spread(pg, gain, 1, hub), 1),
+        _bucket_expand(pg, _hub_spread(pg, pid, 1, hub), 1),
+    ], axis=0)
+    gp = _permute_in_kernel(gain_pid_s, pg.plan, 2, consts)
+    gn = gp[0: 1] * gmask1
+    pn = jnp.where(gmask1 > 0, gp[1: 2], _BIG_IDX)
+    gboth = gn
+    if mixed is not None and consts2 is not None:
+        am3 = mixed[4]
+        gp2 = _permute_in_kernel(gain_pid_s, pg.plan2, 2, consts2)
+        gn2 = gp2[0: 1] * am3
+        pn2 = jnp.where(am3 > 0, gp2[1: 2], _BIG_IDX)
+        gboth = jnp.maximum(gn, gn2)
     neigh_max = jnp.maximum(
-        col_reduce(gn, jnp.maximum, 0.0), 0.0)
+        col_reduce(gboth, jnp.maximum, 0.0), 0.0)
     nm_exp = _bucket_expand(pg, neigh_max, 1)
-    idx_at_max = col_reduce(
-        jnp.where(gn >= nm_exp - eps, pn, _BIG_IDX), jnp.minimum,
-        _BIG_IDX)
+    idx_cand = jnp.where(gn >= nm_exp - eps, pn, _BIG_IDX)
+    if mixed is not None and consts2 is not None:
+        idx_cand = jnp.minimum(
+            idx_cand, jnp.where(gn2 >= nm_exp - eps, pn2, _BIG_IDX))
+    idx_at_max = col_reduce(idx_cand, jnp.minimum, _BIG_IDX)
     winner = (gain > eps) & (
         (gain > neigh_max + eps)
         | ((jnp.abs(gain - neigh_max) <= eps) & (pid <= idx_at_max))
@@ -346,6 +386,11 @@ def packed_mgm2_cycles(
     pg = pls.pg
     D, Vp = pg.D, pg.Vp
     hub_ops = _hub_operands(pg)
+    mixed = pg.mixed
+    if mixed:
+        cost_ops = (pg.cost_rows,) + _mixed_operands(pg)
+    else:
+        cost_ops = pls.cost_slabs
 
     def kern(x_ref, uo_ref, up_ref, uf_ref, unary_ref, maskp_ref,
              idx_ref, mate_ref, colm_ref, sreal_ref, pickr_ref,
@@ -355,8 +400,18 @@ def packed_mgm2_cycles(
             rest = rest[3:]
         else:
             hub = None
-        slab_refs, x_out = rest[:-1], rest[-1]
-        slabs = [ref[:] for ref in slab_refs]
+        if mixed:
+            # gmask1 only travels on mixed layouts (on all-binary ones
+            # it aliases sreal — no second [1, N] VMEM buffer)
+            g1 = rest[0][:]
+            cost = rest[1][:]
+            mixed_refs, rest = _parse_mixed_refs(pg, rest[2:])
+            slabs = None
+        else:
+            g1 = cost = mixed_refs = None
+            slabs = [ref[:] for ref in rest[:-1]]
+            rest = rest[-1:]
+        (x_out,) = rest
         consts = (c_r1[:], c_g1[:], c_ss[:], c_g2[:], c_r2[:])
         x = x_ref[:]
         for c in range(n_cycles):
@@ -365,15 +420,19 @@ def packed_mgm2_cycles(
                 uf_ref[c: c + 1, :], slabs, unary_ref[:], maskp_ref[:],
                 idx_ref[:], colm_ref[:], sreal_ref[:], mate_ref[:],
                 pickr_ref[:], eid_ref[:], degc_ref[:], consts, hub,
-                threshold, favor,
+                threshold, favor, cost=cost, mixed=mixed_refs,
+                gmask1=g1,
             )
         x_out[:] = x
 
     operands = [
         x_row, u_off, u_pick, u_fav, pg.unary_p, pg.mask_p, pls.idx_row,
-        pls.mate_idx, pls.colmask, pls.sreal, pm.pick_rank, pm.edge_id,
-        pm.deg_col, *_plan_consts(pg.plan), *hub_ops, *pls.cost_slabs,
+        pls.mate_idx, pls.colmask, pls.sreal, pm.pick_rank,
+        pm.edge_id, pm.deg_col, *_plan_consts(pg.plan), *hub_ops,
     ]
+    if mixed:
+        operands.append(pls.gmask1)
+    operands.extend(cost_ops)
     return pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((1, Vp), jnp.float32),

@@ -434,15 +434,53 @@ def test_mixed_solver_fused_path_matches_generic(algo):
     assert res_f.cost == res_g.cost
 
 
-def test_mixed_mgm2_falls_back_to_generic_moves():
-    """MGM-2's 5-round kernel is binary-only: on mixed graphs the solver
-    must decline the fused path (and still solve correctly)."""
+@pytest.mark.parametrize("favor", ["unilateral", "no", "coordinated"])
+def test_mixed_mgm2_fused_matches_generic(favor):
+    """The 5-round MGM-2 kernel on a mixed graph ≡ the generic solver:
+    pairing stays on binary edges, tables and the gain/go arbitration
+    cover unary+ternary too (both sibling permutations)."""
+    from pydcop_tpu.algorithms.mgm2 import Mgm2Solver
+    from pydcop_tpu.ops.pallas_mgm2 import (
+        pack_mgm2_from_pls,
+        packed_mgm2_cycles,
+        uniforms_for_mgm2,
+    )
+
+    dcop, tensors = _mixed_instance(seed=7, V=30, n2=40, n3=15, n1=6)
+    pls = pack_local_search(tensors)
+    pm = pack_mgm2_from_pls(pls)
+    assert pm is not None and pls.pg.mixed
+    solver = Mgm2Solver(
+        dcop, tensors,
+        AlgorithmDef.build_with_default_params("mgm2", {"favor": favor}),
+        seed=0, use_packed=False)
+    x = random_valid_values(tensors, jax.random.PRNGKey(13))
+    keys = jax.random.split(jax.random.PRNGKey(42), 6)
+    state = (x,)
+    for k in keys:
+        state = solver.cycle(state, k)
+    uo, up, uf = uniforms_for_mgm2(pm, keys)
+    got = np.asarray(unpack_x(pls, packed_mgm2_cycles(
+        pm, pack_x(pls, x), uo, up, uf, solver.threshold, favor)))
+    np.testing.assert_array_equal(got, np.asarray(state[0]))
+
+
+def test_mixed_mgm2_solver_fused_path():
+    """Solver-level equivalence on a mixed instance through the fused
+    chunk runner."""
     from pydcop_tpu.algorithms.mgm2 import Mgm2Solver
 
-    dcop, tensors = _mixed_instance(seed=7, V=24, n2=30, n3=10, n1=4)
+    dcop, _ = _mixed_instance(seed=9, V=24, n2=30, n3=10, n1=4)
     algo_def = AlgorithmDef.build_with_default_params("mgm2")
-    solver = Mgm2Solver(dcop, tensors, algo_def, seed=1, use_packed=True)
-    assert solver.packed_ls is not None
-    assert solver.packed_mgm2 is None
-    res = solver.run(cycles=10, chunk=10)
-    assert res.status == "FINISHED"
+
+    generic = Mgm2Solver(dcop, compile_constraint_graph(dcop), algo_def,
+                         seed=2, use_packed=False)
+    res_g = generic.run(cycles=12, chunk=12)
+
+    fused = Mgm2Solver(dcop, compile_constraint_graph(dcop), algo_def,
+                       seed=2, use_packed=True)
+    assert fused.packed_mgm2 is not None
+    res_f = fused.run(cycles=12, chunk=12)
+
+    assert res_f.assignment == res_g.assignment
+    assert res_f.cost == res_g.cost
