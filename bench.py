@@ -542,6 +542,16 @@ def bench_mixed_arity(args):
     out["maxsum_iters_per_sec_secp_mixed_arity"] = round(
         (args.cycles // chunk * chunk) / robust_best(times), 1)
 
+    # fused mixed-arity MOVE kernels (VERDICT r5 item 1): the local
+    # search family on the same SECP instance rides the packed engines
+    # (previously a 10-20x generic-engine cliff)
+    for algo in ("mgm", "dsa", "mgm2"):
+        try:
+            out[f"{algo}_cycles_per_sec_secp_mixed"] = round(
+                bench_local_search(dcop, algo, repeat=args.repeat), 1)
+        except Exception as e:  # keep the other rates
+            out[f"secp_mixed_{algo}_error"] = repr(e)
+
     # PEAV meeting scheduling: unary preference factors + binary
     # equality/overlap factors → the mixed packer (slots_count 7 keeps
     # the value domain within the engine's D <= 8)

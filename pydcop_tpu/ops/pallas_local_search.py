@@ -53,11 +53,11 @@ from pydcop_tpu.ops.pallas_maxsum import (
     PackedMaxSumGraph,
     _LANES,
     _compiler_params,
+    _contrib_for_values,
     _hub_op,
     _hub_operands,
     _hub_spread,
     _hub_sum,
-    _mixed_contrib,
     _mixed_operands,
     _parse_mixed_refs,
     _resolve_interpret,
@@ -270,17 +270,8 @@ def _local_tables_body(pg: PackedMaxSumGraph, x_row, slabs, unary, mask_p,
     # hub members carry the hub's value for their slots
     xs = _bucket_expand(pg, _hub_spread(pg, x_row, 1, hub), 1)
     xo = _permute1(pg, xs, consts)
-    if mixed is not None:
-        cost1, cost3, consts2, am2, am3 = mixed
-        xo2 = (
-            _permute_in_kernel(xs, pg.plan2, 1, consts2)
-            if consts2 is not None else xo
-        )
-        contrib = _mixed_contrib(pg, xo, xo2, cost, cost1, cost3, am2, am3)
-    else:
-        contrib = slabs[0]
-        for j in range(1, D):
-            contrib = jnp.where(xo == float(j), slabs[j], contrib)
+    contrib = _contrib_for_values(pg, xs, xo, mixed, cost=cost,
+                                  slabs=slabs)
     tables = _hub_sum(
         pg, unary + _bucket_reduce(pg, contrib, D, jnp.add), D, hub
     )

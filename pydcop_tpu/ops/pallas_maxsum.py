@@ -185,6 +185,31 @@ def _vmem_estimate(D: int, N: int, Vp: int, hub_nsteps: int = 0) -> int:
     return 4 * (D * D * N + 7 * D * N + 3 * D * Vp + 5 * N + hub)
 
 
+@dataclass(frozen=True)
+class ForcedLayout:
+    """A cross-shard-uniform column layout for :func:`pack_for_pallas`.
+
+    The sharded packed engine (parallel/packed_mesh.py) runs ONE
+    shard_map trace over every device, so each shard's packing must have
+    IDENTICAL static structure — same class boundaries, same per-class
+    column counts (hence same buckets, Vp, N, A); only the array
+    contents differ.  ``bounds`` are the slot-class boundaries and
+    ``nvp`` maps every class (including 0, the zero-degree gap block)
+    to its padded column count — both maxima over all shards.
+    """
+
+    bounds: Tuple[int, ...]
+    nvp: Tuple[Tuple[int, int], ...]  # sorted (class, columns) pairs
+
+    @property
+    def classes(self):
+        return [c for c, _ in self.nvp]
+
+    @property
+    def nvp_of(self):
+        return dict(self.nvp)
+
+
 def try_pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
     """Fail-safe engine selection: any packing bug degrades to the generic
     engine (with a logged warning) instead of taking the solve down.  Solvers
@@ -757,6 +782,30 @@ def _mixed_contrib(pg: PackedMaxSumGraph, xo1, xo2, cost, cost1, cost3,
     return out
 
 
+def _contrib_for_values(pg: PackedMaxSumGraph, xs, xo, mixed, cost=None,
+                        slabs=None):
+    """Per-slot cost row given each slot's sibling endpoints' current
+    values — the table/exclusive-cost building block shared by the
+    local-tables, MGM/DSA and MGM-2 kernels.  ``xs`` are the expanded
+    own values (needed for the second permute), ``xo`` the first-sibling
+    values already routed by ``pg.plan``.  Mixed layouts (``mixed`` =
+    parsed (cost1, cost3, consts2, am2, am3) refs + ``cost`` [D*D, N])
+    run the arity-masked assembly with a second permute for ternary
+    slots; all-binary layouts select from the D ``slabs``."""
+    if mixed is not None:
+        cost1, cost3, consts2, am2, am3 = mixed
+        R = xs.shape[0]
+        xo2 = (
+            _permute_in_kernel(xs, pg.plan2, R, consts2)
+            if consts2 is not None else xo
+        )
+        return _mixed_contrib(pg, xo, xo2, cost, cost1, cost3, am2, am3)
+    contrib = slabs[0]
+    for j in range(1, pg.D):
+        contrib = jnp.where(xo == float(j), slabs[j], contrib)
+    return contrib
+
+
 def _mixed_r_new(pg: PackedMaxSumGraph, qm1, qm2, cost, cost1, cost3,
                  am2, am3):
     """factor→var messages for the mixed-arity layout: unary slots take
@@ -992,21 +1041,11 @@ def packed_local_tables(pg: PackedMaxSumGraph, x: jnp.ndarray,
             )
         consts1 = (c_r1[:], c_g1[:], c_ss[:], c_g2[:], c_r2[:])
         xo = _permute_in_kernel(xs, pg.plan, D, consts1)
-        if mixed is not None:
-            cost1, cost3, consts2, am2, am3 = mixed
-            xo2 = (
-                _permute_in_kernel(xs, pg.plan2, D, consts2)
-                if consts2 is not None else xo
-            )
-            contrib = _mixed_contrib(
-                pg, xo, xo2, cost, cost1, cost3, am2, am3)
-        else:
-            # per-slot cost row for the other endpoint's current value
-            contrib = cost[0: D, :]
-            for j in range(1, D):
-                contrib = jnp.where(
-                    xo == float(j), cost[j * D: (j + 1) * D, :], contrib
-                )
+        contrib = _contrib_for_values(
+            pg, xs, xo, mixed, cost=cost,
+            slabs=None if mixed is not None
+            else [cost[j * D: (j + 1) * D, :] for j in range(D)],
+        )
         # bucket-sum slots per variable (as in _cycle_body's beliefs)
         bparts = []
         voff_expect = 0

@@ -51,11 +51,11 @@ from pydcop_tpu.ops.pallas_local_search import (
 )
 from pydcop_tpu.ops.pallas_maxsum import (
     _compiler_params,
+    _contrib_for_values,
     _hub_op,
     _hub_operands,
     _hub_spread,
     _hub_sum,
-    _mixed_contrib,
     _mixed_operands,
     _parse_mixed_refs,
     _resolve_interpret,
@@ -178,19 +178,11 @@ def _mgm2_cycle(pm: PackedMgm2, x, u_off, u_pick, u_fav, slabs, unary,
     # by the spread domain mask, not the head-only mask_p)
     xs = _bucket_expand(pg, _hub_spread(pg, x, 1, hub), 1)
     xo = _permute_in_kernel(xs, pg.plan, 1, consts)
-    if mixed is not None:
-        cost1, cost3, consts2, am2, am3 = mixed
-        xo2 = (
-            _permute_in_kernel(xs, pg.plan2, 1, consts2)
-            if consts2 is not None else xo
-        )
-        contrib = _mixed_contrib(pg, xo, xo2, cost, cost1, cost3, am2,
-                                 am3)
-    else:
-        consts2 = None
-        contrib = slab(0)
-        for j in range(1, D):
-            contrib = jnp.where(xo == float(j), slab(j), contrib)
+    consts2 = mixed[2] if mixed is not None else None
+    contrib = _contrib_for_values(
+        pg, xs, xo, mixed, cost=cost,
+        slabs=None if mixed is not None else [slab(j) for j in range(D)],
+    )
     raw = _hub_sum(pg, unary + _bucket_reduce(pg, contrib, D, jnp.add),
                    D, hub)
     dmask = _hub_spread(pg, mask_p, D, hub)
