@@ -205,17 +205,21 @@ def _mgm2_cycle(pm: PackedMgm2, x, u_off, u_pick, u_fav, slabs, unary,
     off_s = _bucket_expand(pg, offerer, 1)
     pick_s = _bucket_expand(pg, pick, 1)
     sel = (off_s > 0) & (pick_rank == pick_s) & (sreal > 0)
-    mate_off = _permute_in_kernel(off_s, pg.plan, 1, consts) * sreal
-    offered = sel & (mate_off == 0)  # my offer on this slot
 
     # ---- joint gain at the offerer's slot.  A = own table minus this
-    # edge's contribution; the mate's A and cur ride one permutation.
+    # edge's contribution; the mate's A, cur AND offer flag ride ONE
+    # permutation (off_s is independent of the joint math, and
+    # `offered` is not consumed until after it — merging saves a whole
+    # permute launch per cycle)
     A = _bucket_expand(pg, _hub_spread(pg, tables, D, hub), D) - contrib
     cur_s = _bucket_expand(pg, _hub_spread(pg, cur, 1, hub), 1)
     Am_cm = _permute_in_kernel(
-        jnp.concatenate([A, cur_s], axis=0), pg.plan, D + 1, consts
+        jnp.concatenate([A, cur_s, off_s], axis=0), pg.plan, D + 2,
+        consts,
     )
     Am, cur_m = Am_cm[:D], Am_cm[D: D + 1]
+    mate_off = Am_cm[D + 1: D + 2] * sreal
+    offered = sel & (mate_off == 0)  # my offer on this slot
     cc = jnp.sum(contrib * jnp.where(
         jax.lax.broadcasted_iota(jnp.int32, (D, N), 0).astype(jnp.float32)
         == xs, 1.0, 0.0), axis=0, keepdims=True)
@@ -241,17 +245,19 @@ def _mgm2_cycle(pm: PackedMgm2, x, u_off, u_pick, u_fav, slabs, unary,
     jg = jnp.maximum(cur_joint - best_joint, 0.0)
     jg = jnp.where(offered, jg, 0.0)
 
-    # ---- route the offer to the receiver's side
-    off_f = jnp.where(offered, 1.0, 0.0)
+    # ---- route the offer to the receiver's side.  No separate offer
+    # flag travels: jg is zero on every non-offered slot, and the
+    # response round only considers strictly positive joint gains, so
+    # (jg_in > eps) already implies "a real offer arrived here"
     routed = _permute_in_kernel(
-        jnp.concatenate([off_f, jg, du_star, dw_star], axis=0),
-        pg.plan, 4, consts,
+        jnp.concatenate([jg, du_star, dw_star], axis=0),
+        pg.plan, 3, consts,
     )
-    off_in = (routed[0: 1] * sreal) > 0
-    jg_in, du_in, dw_in = routed[1: 2], routed[2: 3], routed[3: 4]
+    jg_in = routed[0: 1] * sreal
+    du_in, dw_in = routed[1: 2], routed[2: 3]
 
     # ---- response round (per receiver column)
-    pos = off_in & (jg_in > eps)
+    pos = jg_in > eps
     rec_max = _hub_op(
         pg,
         _bucket_reduce(pg, jnp.where(pos, jg_in, -1.0), 1, jnp.maximum,
