@@ -185,29 +185,29 @@ def _vmem_estimate(D: int, N: int, Vp: int, hub_nsteps: int = 0) -> int:
     return 4 * (D * D * N + 7 * D * N + 3 * D * Vp + 5 * N + hub)
 
 
-@dataclass(frozen=True)
+@dataclass
 class ForcedLayout:
     """A cross-shard-uniform column layout for :func:`pack_for_pallas`.
 
     The sharded packed engine (parallel/packed_mesh.py) runs ONE
     shard_map trace over every device, so each shard's packing must have
-    IDENTICAL static structure — same class boundaries, same per-class
-    column counts (hence same buckets, Vp, N, A); only the array
-    contents differ.  ``bounds`` are the slot-class boundaries and
-    ``nvp`` maps every class (including 0, the zero-degree gap block)
-    to its padded column count — both maxima over all shards.
+    IDENTICAL static structure — same class blocks (hence same buckets,
+    Vp, N, A) AND the same variable→column assignment, so per-shard
+    partial beliefs align column-wise and the cross-shard combine is a
+    bare ``psum`` on ``[D, Vp]`` (no scatter/gather through the global
+    variable axis — measured to dominate the cycle otherwise).
+
+    Built from the per-variable MAXIMUM shard degree: every variable's
+    class holds its slots on every shard (shards where it has fewer
+    edges leave padding slots empty).
     """
 
-    bounds: Tuple[int, ...]
-    nvp: Tuple[Tuple[int, int], ...]  # sorted (class, columns) pairs
+    nvp: Tuple[Tuple[int, int], ...]  # ordered (class, columns) blocks
+    var_pcol: "np.ndarray"            # [V] fixed column per variable
 
     @property
     def classes(self):
         return [c for c, _ in self.nvp]
-
-    @property
-    def nvp_of(self):
-        return dict(self.nvp)
 
 
 def try_pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
@@ -236,8 +236,19 @@ def try_pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
         return None
 
 
-def pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
-    """Compile the packed layout, or None when not applicable."""
+def pack_for_pallas(
+    t: FactorGraphTensors, layout: Optional[ForcedLayout] = None,
+) -> Optional[PackedMaxSumGraph]:
+    """Compile the packed layout, or None when not applicable.
+
+    ``layout`` forces a cross-shard-uniform column layout (see
+    :class:`ForcedLayout`): class blocks and the variable→column map
+    come from the layout instead of this graph's own DP, so every shard
+    of a partitioned graph packs with identical static structure AND
+    aligned columns.  Hub splitting is disabled under a forced layout;
+    each variable's degree must fit its forced class (the caller builds
+    the layout from max-over-shard degrees, so this holds by
+    construction)."""
     if len(t.buckets) != 1 or t.buckets[0].arity != 2:
         return None
     b = t.buckets[0]
@@ -253,9 +264,11 @@ def pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
     # split into m sub-columns of cls_h ≤ _MAX_SLOT_CLASS slots each (cls_h
     # rounded up to a multiple of 8 to bound the distinct-bucket count).
     # Sub-columns must stay inside one 128-lane bin for the gather-based
-    # combine, so per-hub degree is capped at _MAX_SLOT_CLASS * 128.
+    # combine, so per-hub degree is capped at _MAX_SLOT_CLASS * _LANES.
     S = _MAX_SLOT_CLASS
     hub_of = deg > S
+    if layout is not None and bool(hub_of.any()):
+        return None  # forced layouts carry no per-shard hub structure
     if int(deg.max(initial=0)) > S * _LANES:
         return None  # a single hub beyond ~12k neighbors: generic engine
     hub_vars = np.flatnonzero(hub_of)
@@ -269,67 +282,87 @@ def pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
     for v in hub_vars:
         hub_m[v] = int(np.ceil(deg[v] / S))
         sub_deg[v] = int(np.ceil(deg[v] / hub_m[v]))
-    pop = np.concatenate(
-        [deg[~hub_of]]
-        + [np.full(hub_m[v], sub_deg[v]) for v in hub_vars]
-    )
-    bounds = _class_bounds(pop)
-    cls_of = _apply_bounds(np.where(hub_of, 0, deg), bounds)
-    hub_cls = _apply_bounds(sub_deg, bounds)
-    classes = sorted(
-        set(cls_of[~hub_of].tolist())
-        | set(hub_cls[hub_vars].tolist())
-    )
 
-    # column layout per class bucket: hub groups first (first-fit
-    # descending into 128-lane bins, so no group straddles a bin), then
-    # single variables fill the gaps
     buckets: List[Tuple[int, int, int, int]] = []
-    var_pcol = np.full(V, -1, dtype=np.int64)  # var -> its (head) column
-    col_var_parts: List[np.ndarray] = []
     group_heads: List[Tuple[int, int]] = []  # (head column, m)
     max_m = 1
-    voff = 0
-    for cls in classes:
-        gvars = [v for v in hub_vars if hub_cls[v] == cls]
-        svars = np.flatnonzero((cls_of == cls) & ~hub_of).tolist()
-        if not gvars and not svars:
-            continue
-        bins: List[List[int]] = []  # per 128-lane bin: var id per column
-        for v in sorted(gvars, key=lambda u: -hub_m[u]):
-            m = int(hub_m[v])
-            max_m = max(max_m, m)
+    if layout is not None:
+        # fixed column assignment: blocks and var→column from the layout
+        hub_cls = np.zeros(V, dtype=np.int64)  # no hubs under layouts
+        var_pcol = np.asarray(layout.var_pcol, dtype=np.int64)
+        voff = 0
+        col_class = np.zeros(0, dtype=np.int64)
+        for cls, nvp in layout.nvp:
+            if cls > 0:
+                buckets.append((int(cls), int(nvp), voff, -1))
+            col_class = np.concatenate(
+                [col_class, np.full(nvp, cls, dtype=np.int64)]
+            )
+            voff += int(nvp)
+        Vp = voff
+        if np.any(deg > col_class[var_pcol]):
+            return None  # a degree outgrew its forced class
+        col_var = np.full(Vp, -1, dtype=np.int64)
+        col_var[var_pcol] = np.arange(V)
+    else:
+        pop = np.concatenate(
+            [deg[~hub_of]]
+            + [np.full(hub_m[v], sub_deg[v]) for v in hub_vars]
+        )
+        bounds = _class_bounds(pop)
+        cls_of = _apply_bounds(np.where(hub_of, 0, deg), bounds)
+        hub_cls = _apply_bounds(sub_deg, bounds)
+        classes = sorted(
+            set(cls_of[~hub_of].tolist())
+            | set(hub_cls[hub_vars].tolist())
+        )
+
+        # column layout per class bucket: hub groups first (first-fit
+        # descending into 128-lane bins, so no group straddles a bin),
+        # then single variables fill the gaps
+        var_pcol = np.full(V, -1, dtype=np.int64)  # var -> (head) column
+        col_var_parts: List[np.ndarray] = []
+        voff = 0
+        for cls in classes:
+            gvars = [v for v in hub_vars if hub_cls[v] == cls]
+            svars = np.flatnonzero((cls_of == cls) & ~hub_of).tolist()
+            if not gvars and not svars:
+                continue
+            bins: List[List[int]] = []  # per 128-lane bin: vars/columns
+            for v in sorted(gvars, key=lambda u: -hub_m[u]):
+                m = int(hub_m[v])
+                max_m = max(max_m, m)
+                for bi, cols in enumerate(bins):
+                    if len(cols) + m <= _LANES:
+                        break
+                else:
+                    bins.append([])
+                    bi = len(bins) - 1
+                cols = bins[bi]
+                head = voff + bi * _LANES + len(cols)
+                var_pcol[v] = head
+                group_heads.append((head, m))
+                cols.extend([v] * m)
+            for v in svars:
+                for bi, cols in enumerate(bins):
+                    if len(cols) < _LANES:
+                        break
+                else:
+                    bins.append([])
+                    bi = len(bins) - 1
+                cols = bins[bi]
+                var_pcol[v] = voff + bi * _LANES + len(cols)
+                cols.append(v)
+            nvp = max(_LANES, len(bins) * _LANES)
+            colv = np.full(nvp, -1, dtype=np.int64)
             for bi, cols in enumerate(bins):
-                if len(cols) + m <= _LANES:
-                    break
-            else:
-                bins.append([])
-                bi = len(bins) - 1
-            cols = bins[bi]
-            head = voff + bi * _LANES + len(cols)
-            var_pcol[v] = head
-            group_heads.append((head, m))
-            cols.extend([v] * m)
-        for v in svars:
-            for bi, cols in enumerate(bins):
-                if len(cols) < _LANES:
-                    break
-            else:
-                bins.append([])
-                bi = len(bins) - 1
-            cols = bins[bi]
-            var_pcol[v] = voff + bi * _LANES + len(cols)
-            cols.append(v)
-        nvp = max(_LANES, len(bins) * _LANES)
-        colv = np.full(nvp, -1, dtype=np.int64)
-        for bi, cols in enumerate(bins):
-            colv[bi * _LANES: bi * _LANES + len(cols)] = cols
-        col_var_parts.append(colv)
-        if cls > 0:
-            buckets.append((cls, nvp, voff, -1))  # slot offsets below
-        voff += nvp
-    Vp = voff
-    col_var = np.concatenate(col_var_parts)
+                colv[bi * _LANES: bi * _LANES + len(cols)] = cols
+            col_var_parts.append(colv)
+            if cls > 0:
+                buckets.append((cls, nvp, voff, -1))  # slot offsets below
+            voff += nvp
+        Vp = voff
+        col_var = np.concatenate(col_var_parts)
 
     soff = 0
     with_slots = []

@@ -1,0 +1,179 @@
+"""Uniform per-shard lane packing for the sharded engines.
+
+VERDICT r4 item 3: parallel/mesh.py's shard_map cycles ran the generic
+``[E, D]`` kernels per shard, so on a real pod each chip would LOSE the
+lane-packed engineering that makes the single-chip engines 10-25x
+faster.  This module builds one lane-packed layout PER SHARD with
+IDENTICAL static structure AND an identical variable→column map on
+every shard (shard_map is SPMD — one trace), so:
+
+* the per-shard cycle runs the pallas kernels of ops/pallas_sharded;
+* per-shard partial beliefs align column-wise, making the cross-shard
+  combine a bare ``psum`` on ``[D, Vp]`` — no scatter/gather through
+  the global variable axis (measured to dominate the cycle otherwise).
+
+Classes come from each variable's MAXIMUM per-shard degree, so every
+shard's edges fit the common slot classes; shards where a variable has
+fewer edges leave padding slots empty.  Everything shard-specific —
+cost rows, slot masks, Clos plan index arrays — is stacked on a leading
+shard axis and fed through ``shard_map`` as data.
+
+Scope: all-binary graphs whose per-shard degrees fit one slot class
+(≤ 96); note sharding itself shrinks per-shard degrees, so graphs with
+moderate hubs pack here even when the single-chip packer needs hub
+splitting.  Out-of-scope graphs return None and the callers keep the
+generic sharded engine.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from pydcop_tpu.ops.compile import FactorBucket, FactorGraphTensors
+from pydcop_tpu.ops.pallas_maxsum import (
+    ForcedLayout,
+    PackedMaxSumGraph,
+    _LANES,
+    _MAX_SLOT_CLASS,
+    _TILE,
+    _class_bounds,
+    _apply_bounds,
+    pack_for_pallas,
+)
+from pydcop_tpu.ops.pallas_permute import _plan_consts
+from pydcop_tpu.parallel.partition import partition_factors
+
+
+@dataclasses.dataclass
+class StackedShardPack:
+    """Per-shard packed layouts with shard-invariant static structure
+    and a shard-invariant column map.
+
+    ``pg0`` carries the common statics (D, Vp, N, buckets, plan A/B/L,
+    mask_p, var_order); ``unary_p`` is the REAL packed unary costs (the
+    per-shard packs carry zeros so unary is counted once, after the
+    psum).  The stacked arrays hold every shard's data on axis 0, ready
+    for a ``P(AXIS)`` sharding.
+    """
+
+    pg0: PackedMaxSumGraph           # statics + common column map
+    n_shards: int
+    unary_p: jnp.ndarray             # [D, Vp] — global, post-psum add
+    cost_rows: jnp.ndarray           # [S, D*D, N]
+    vmask: jnp.ndarray               # [S, D, N]
+    inv_dcount: jnp.ndarray          # [S, 1, N]
+    consts: List[jnp.ndarray]        # 5 stacked plan index arrays [S, ...]
+
+    @property
+    def D(self) -> int:
+        return self.pg0.D
+
+    @property
+    def Vp(self) -> int:
+        return self.pg0.Vp
+
+    @property
+    def N(self) -> int:
+        return self.pg0.N
+
+
+def build_shard_packs(
+    tensors: FactorGraphTensors,
+    n_shards: int,
+    assigns: Optional[List[np.ndarray]] = None,
+) -> Optional[StackedShardPack]:
+    """Pack every shard's factor subset under one ForcedLayout, or None
+    when the graph is out of scope (non-binary, per-shard degree > one
+    slot class, VMEM, Clos budget)."""
+    if len(tensors.buckets) != 1 or tensors.buckets[0].arity != 2:
+        return None
+    b = tensors.buckets[0]
+    F, V = b.n_factors, tensors.n_vars
+    if F == 0 or tensors.max_domain_size > 8 or n_shards < 1:
+        return None
+    # cheap pre-check before any per-shard layout work: ≥ 2F/S slots per
+    # shard must fit the Clos A ≤ 8 budget (A·128·128 slots), or the
+    # packer would run its column layout only to reject on A — at
+    # megascale (stretch2: 3M edges) that wasted minutes
+    if 2 * F / n_shards > 8 * _TILE:
+        return None
+    if assigns is None:
+        assigns = partition_factors([b.var_idx], V, n_shards)
+    assign = np.asarray(assigns[0])
+
+    vi = np.asarray(b.var_idx)
+    t_np = np.asarray(b.tensors)
+
+    # per-variable MAX shard degree → the common classes and the fixed
+    # column map (sharding shrinks degrees, so moderate global hubs fit)
+    shard_deg = np.zeros((n_shards, V), dtype=np.int64)
+    for s in range(n_shards):
+        e = vi[assign == s].reshape(-1)
+        shard_deg[s] = np.bincount(e, minlength=V)
+    deg_max = shard_deg.max(axis=0)
+    if int(deg_max.max(initial=0)) > _MAX_SLOT_CLASS:
+        return None
+    pos = deg_max[deg_max > 0]
+    if pos.size == 0:
+        return None
+    bounds = _class_bounds(pos)
+    cls_v = _apply_bounds(deg_max, bounds)
+    classes = sorted(set(cls_v.tolist()))
+    var_pcol = np.full(V, -1, dtype=np.int64)
+    nvp_pairs = []
+    voff = 0
+    for c in classes:
+        vs = np.flatnonzero(cls_v == c)
+        nvp = max(_LANES, int(np.ceil(vs.size / _LANES)) * _LANES)
+        var_pcol[vs] = voff + np.arange(vs.size)
+        nvp_pairs.append((int(c), nvp))
+        voff += nvp
+    layout = ForcedLayout(nvp=tuple(nvp_pairs), var_pcol=var_pcol)
+
+    zero_unary = jnp.zeros_like(tensors.unary_costs)
+    packs: List[PackedMaxSumGraph] = []
+    for s in range(n_shards):
+        idx = np.flatnonzero(assign == s)
+        sub_bucket = FactorBucket(
+            arity=2,
+            tensors=jnp.asarray(t_np[idx]),
+            var_idx=vi[idx],
+            factor_ids=np.asarray(b.factor_ids)[idx]
+            if b.factor_ids is not None else np.arange(idx.size),
+            edge_offset=0,
+        )
+        t_s = dataclasses.replace(
+            tensors, buckets=[sub_bucket], unary_costs=zero_unary,
+            edge_var=jnp.asarray(
+                np.concatenate([vi[idx, 0], vi[idx, 1]]).astype(np.int32)
+            ),
+        )
+        pg = pack_for_pallas(t_s, layout=layout)
+        if pg is None:
+            return None
+        packs.append(pg)
+
+    pg0 = packs[0]
+    # the real packed unary costs (per-shard packs carry zeros)
+    D, Vp = pg0.D, pg0.Vp
+    mask_np = np.asarray(pg0.mask_p)
+    unary_np = np.zeros((D, Vp), dtype=np.float32)
+    unary_np[:, var_pcol] = (
+        np.asarray(tensors.unary_costs).T * mask_np[:, var_pcol]
+    )
+
+    consts_per = [_plan_consts(pg.plan) for pg in packs]
+    return StackedShardPack(
+        pg0=pg0,
+        n_shards=n_shards,
+        unary_p=jnp.asarray(unary_np),
+        cost_rows=jnp.stack([pg.cost_rows for pg in packs]),
+        vmask=jnp.stack([pg.vmask for pg in packs]),
+        inv_dcount=jnp.stack([pg.inv_dcount for pg in packs]),
+        consts=[
+            jnp.stack([cp[i] for cp in consts_per]) for i in range(5)
+        ],
+    )
