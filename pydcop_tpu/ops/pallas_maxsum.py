@@ -205,10 +205,6 @@ class ForcedLayout:
     nvp: Tuple[Tuple[int, int], ...]  # ordered (class, columns) blocks
     var_pcol: "np.ndarray"            # [V] fixed column per variable
 
-    @property
-    def classes(self):
-        return [c for c, _ in self.nvp]
-
 
 def try_pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
     """Fail-safe engine selection: any packing bug degrades to the generic
