@@ -317,14 +317,36 @@ def _launch_sweep(ps: PackedSweep, lown, lpar, consts, interpret: bool):
 def _sweep_callable(ps: PackedSweep, interpret: bool):
     """Jitted single-launch sweep for a packed plan, cached on the plan
     instance — pl.pallas_call re-lowers the whole kernel on every
-    un-jitted invocation (~minutes for deep unrolls)."""
+    un-jitted invocation (~minutes for deep unrolls).
+
+    On real hardware the compiled executable is also persisted to disk
+    (ops/sweep_cache): a later PROCESS solving any instance with the
+    same tree shape skips the minutes-long Mosaic compile entirely
+    (ROADMAP item 4; JAX's own persistent cache does not round-trip the
+    remote-compile service)."""
     cached = getattr(ps, "_jit_cache", None)
     if cached is not None and cached[0] == interpret:
         return cached[1]
 
-    @jax.jit
-    def run(lown, lpar, consts):
+    def f(lown, lpar, consts):
         return _launch_sweep(ps, lown, lpar, consts, interpret)
+
+    run = None
+    if not interpret:
+        from pydcop_tpu.ops.sweep_cache import (
+            load_sweep_executable,
+            save_sweep_executable,
+        )
+
+        run = load_sweep_executable(ps)
+        if run is None:
+            compiled = jax.jit(f).lower(
+                ps.local_own, ps.local_par, _plan_consts(ps.plan)
+            ).compile()
+            save_sweep_executable(ps, compiled)
+            run = compiled
+    if run is None:
+        run = jax.jit(f)
 
     ps._jit_cache = (interpret, run)
     return run

@@ -1,0 +1,151 @@
+"""Persistent on-disk cache for compiled whole-sweep executables.
+
+The whole-sweep DPOP kernel (ops/pallas_dpop) unrolls 2L Clos-routed
+permutations into ONE pallas launch; its Mosaic compile takes ~25 s at
+2k nodes and ~2 min at 10k — per PROCESS, because JAX's own persistent
+compilation cache does not round-trip through this environment's
+remote-compile service (measured, ROADMAP item 4).  What DOES
+round-trip is the AOT-compiled executable itself:
+``jax.jit(f).lower(args).compile()`` → ``serialize()`` → bytes on disk
+→ ``deserialize_and_load()`` in a fresh process (measured: a 4.8 MB
+payload reloads in well under a second vs the 25 s recompile).
+
+The cache key captures everything that shapes the lowered program: the
+packed plan's static structure (D, node count, Vp, N, L, mode, buckets)
+and the software/hardware versions (jax, jaxlib, device kind).  Array
+CONTENTS (cost tables, Clos index arrays) are runtime arguments, so
+re-solving a different instance over the same tree SHAPE hits the
+cache.
+
+Default location: ``~/.cache/pydcop_tpu`` (override with
+``PYDCOP_TPU_CACHE_DIR``; set it empty to disable).
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import pickle
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+def cache_dir() -> Optional[str]:
+    d = os.environ.get("PYDCOP_TPU_CACHE_DIR")
+    if d == "":
+        return None  # explicitly disabled
+    if d is None:
+        # per the XDG spec, an EMPTY XDG_CACHE_HOME means unset (a
+        # cwd-relative cache dir would litter working directories and
+        # fragment hits per-cwd)
+        base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+            os.path.expanduser("~"), ".cache"
+        )
+        d = os.path.join(base, "pydcop_tpu")
+    return d
+
+
+def _kernel_fingerprint() -> str:
+    """Digest of the kernel implementation: a code change to the sweep
+    kernel or the Clos permutation stages must invalidate every cached
+    executable (a manually-bumped version tag would rot)."""
+    import pydcop_tpu.ops.pallas_dpop as _pd
+    import pydcop_tpu.ops.pallas_permute as _pp
+
+    h = hashlib.sha256()
+    for mod in (_pd, _pp):
+        try:
+            with open(mod.__file__, "rb") as f:
+                h.update(f.read())
+        except OSError:  # pragma: no cover - zipapp etc.
+            h.update(repr(mod).encode())
+    return h.hexdigest()[:16]
+
+
+def sweep_cache_key(ps) -> str:
+    """Stable digest of everything that shapes the lowered program."""
+    import jax
+    import jaxlib
+
+    try:
+        device_kind = jax.devices()[0].device_kind
+    except Exception:  # pragma: no cover - backendless
+        device_kind = "unknown"
+    payload = repr((
+        _kernel_fingerprint(),
+        jax.__version__,
+        getattr(jaxlib, "__version__", ""),
+        device_kind,
+        ps.D, ps.n_nodes, ps.Vp, ps.N, ps.L, ps.mode, ps.buckets,
+        ps.plan.A, ps.plan.B, ps.plan.L,
+    )).encode()
+    return hashlib.sha256(payload).hexdigest()[:32]
+
+
+def _sweep_cache_path(ps) -> Optional[str]:
+    d = cache_dir()
+    if d is None:
+        return None
+    return os.path.join(d, f"sweep-{sweep_cache_key(ps)}.bin")
+
+
+def has_cached_sweep(ps) -> bool:
+    """True when a persisted executable exists for this plan shape —
+    the DPOP auto tier's probe.  Never raises."""
+    try:
+        path = _sweep_cache_path(ps)
+        return path is not None and os.path.exists(path)
+    except Exception:  # noqa: BLE001 — probing must be free
+        return False
+
+
+def load_sweep_executable(ps):
+    """Deserialize a cached executable for this plan shape, or None.
+    Best-effort: any failure (including key computation) degrades to a
+    fresh compile, never to a crash."""
+    path = None
+    try:
+        path = _sweep_cache_path(ps)
+        if path is None or not os.path.exists(path):
+            return None
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load,
+        )
+
+        with open(path, "rb") as f:
+            trees_len = int.from_bytes(f.read(8), "little")
+            in_tree, out_tree = pickle.loads(f.read(trees_len))
+            payload = f.read()
+        return deserialize_and_load(payload, in_tree, out_tree)
+    except Exception:  # noqa: BLE001 — stale/corrupt cache: recompile
+        log.warning("sweep cache at %s failed to load; recompiling",
+                    path, exc_info=True)
+        if path is not None:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        return None
+
+
+def save_sweep_executable(ps, compiled) -> None:
+    """Serialize a compiled sweep executable for future processes."""
+    try:
+        path = _sweep_cache_path(ps)
+        if path is None:
+            return
+        from jax.experimental.serialize_executable import serialize
+
+        payload, in_tree, out_tree = serialize(compiled)
+        trees = pickle.dumps((in_tree, out_tree))
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(len(trees).to_bytes(8, "little"))
+            f.write(trees)
+            f.write(payload)
+        os.replace(tmp, path)
+    except Exception:  # noqa: BLE001 — caching is best-effort
+        log.warning("could not persist the sweep executable",
+                    exc_info=True)

@@ -128,3 +128,45 @@ def test_refuses_wide_separators():
     if plan is None or plan.W == 1:
         pytest.skip("instance did not produce a wide separator")
     assert pack_sweep(plan) is None
+
+
+class TestSweepCache:
+    """Persistent executable cache mechanics (ops/sweep_cache) — the
+    serialize round-trip itself needs real hardware (driven in the
+    bench/TPU flow); these cover key stability, disable, and corrupt
+    file handling."""
+
+    def _ps(self, N=40, seed=5):
+        dcop = _tree_dcop(N=N, D=3, seed=seed)
+        tree = pseudotree.build_computation_graph(dcop)
+        plan = compile_sweep(tree, dcop, "min")
+        ps = pack_sweep(plan)
+        assert ps is not None
+        return ps
+
+    def test_key_stable_and_shape_sensitive(self):
+        from pydcop_tpu.ops.sweep_cache import sweep_cache_key
+
+        ps = self._ps()
+        assert sweep_cache_key(ps) == sweep_cache_key(ps)
+        ps2 = self._ps(N=80, seed=7)
+        assert sweep_cache_key(ps) != sweep_cache_key(ps2)
+
+    def test_disabled_by_empty_env(self, monkeypatch):
+        from pydcop_tpu.ops import sweep_cache
+
+        monkeypatch.setenv("PYDCOP_TPU_CACHE_DIR", "")
+        assert sweep_cache.cache_dir() is None
+        assert sweep_cache.load_sweep_executable(self._ps()) is None
+        # save must be a silent no-op
+        sweep_cache.save_sweep_executable(self._ps(), object())
+
+    def test_corrupt_cache_file_recompiles(self, tmp_path, monkeypatch):
+        from pydcop_tpu.ops import sweep_cache
+
+        monkeypatch.setenv("PYDCOP_TPU_CACHE_DIR", str(tmp_path))
+        ps = self._ps()
+        path = tmp_path / f"sweep-{sweep_cache.sweep_cache_key(ps)}.bin"
+        path.write_bytes(b"\x08\x00\x00\x00\x00\x00\x00\x00garbage")
+        assert sweep_cache.load_sweep_executable(ps) is None
+        assert not path.exists()  # corrupt entry evicted
