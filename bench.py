@@ -181,6 +181,33 @@ def robust_best(times, floor: float = 0.02):
     return min(sane) if sane else med
 
 
+def measure_rate(run_fn, n_units: float, repeat: int,
+                 floor: float = 0.02, retries: int = 2) -> float:
+    """units/sec from repeated timed calls of ``run_fn`` (which must
+    block until the device work is done), with glitch-burst retries.
+
+    robust_best's sub-floor fallback bounds the damage of a FULL glitch
+    burst (every block_until_ready returning early) but still yields a
+    physically impossible rate — the r5 full run recorded 37M iters/s
+    for a measurement that sanely reads ~5k.  When no sample clears the
+    floor, the whole burst is re-measured up to ``retries`` times; only
+    if EVERY burst stays sub-floor does the median of the last burst
+    stand — the representative answer on a direct-attached device where
+    sub-floor calls are legitimate, and bounded damage in the (now
+    retries-deep) tunnel-glitch case."""
+    best = None
+    for _ in range(retries + 1):
+        times = []
+        for _r in range(repeat):
+            t0 = time.perf_counter()
+            run_fn()
+            times.append(time.perf_counter() - t0)
+        best = robust_best(times, floor)
+        if best >= floor:
+            return n_units / best
+    return n_units / best
+
+
 def build_stretch_tensors(args, V=None, E=None):
     """The stretch coloring instance (single source for the --stretch
     compat mode and the convergence bench — same rng(1) data).  V/E
@@ -253,13 +280,9 @@ def bench_maxsum(args):
     )
     q, r = run_n(q0, r0)  # warmup / compile
     jax.block_until_ready((q, r))
-    times = []
-    for _ in range(args.repeat):
-        t0 = time.perf_counter()
-        q, r = run_n(q0, r0)
-        jax.block_until_ready((q, r))
-        times.append(time.perf_counter() - t0)
-    iters_per_sec = (args.cycles // chunk * chunk) / robust_best(times)
+    iters_per_sec = measure_rate(
+        lambda: jax.block_until_ready(run_n(q0, r0)),
+        args.cycles // chunk * chunk, args.repeat)
 
     ref_cycle_s = python_reference_cycle_time(tensors)
     vs = iters_per_sec * ref_cycle_s if ref_cycle_s > 0 else 0.0
@@ -388,11 +411,8 @@ def bench_local_search(dcop, algo: str, cycles: int = 2000, repeat: int = 3):
     algo_def = AlgorithmDef.build_with_default_params(algo)
     solver = mod.build_solver(dcop, algo_def=algo_def)
     solver.run(cycles=cycles, chunk=cycles)  # warmup incl. compile
-    times = []
-    for _ in range(repeat):
-        res = solver.run(cycles=cycles, chunk=cycles)
-        times.append(res.time)
-    return cycles / robust_best(times)
+    return measure_rate(
+        lambda: solver.run(cycles=cycles, chunk=cycles), cycles, repeat)
 
 
 def build_scalefree_dcop(args):
@@ -473,13 +493,9 @@ def bench_scalefree(args):
         q0, r0 = packed_init_state(packed)
         q, r = run_n(q0, r0)
         jax.block_until_ready((q, r))
-        times = []
-        for _ in range(args.repeat):
-            t0 = time.perf_counter()
-            q, r = run_n(q0, r0)
-            jax.block_until_ready((q, r))
-            times.append(time.perf_counter() - t0)
-        rate = (args.cycles // chunk * chunk) / robust_best(times)
+        rate = measure_rate(
+            lambda: jax.block_until_ready(run_n(q0, r0)),
+            args.cycles // chunk * chunk, args.repeat)
         out[f"maxsum_iters_per_sec_scalefree_{args.vars}var"] = round(
             rate, 1)
     try:
@@ -526,14 +542,10 @@ def bench_scalefree(args):
             q0, r0 = packed_init_state(p3)
             q, r = run3(q0, r0)
             jax.block_until_ready((q, r))
-            times = []
-            for _ in range(args.repeat):
-                t0 = time.perf_counter()
-                q, r = run3(q0, r0)
-                jax.block_until_ready((q, r))
-                times.append(time.perf_counter() - t0)
             out["maxsum_iters_per_sec_scalefree_ternary"] = round(
-                (args.cycles // chunk * chunk) / robust_best(times), 1)
+                measure_rate(
+                    lambda: jax.block_until_ready(run3(q0, r0)),
+                    args.cycles // chunk * chunk, args.repeat), 1)
     except Exception as e:
         out["scalefree_ternary_error"] = repr(e)
     return out
@@ -582,14 +594,10 @@ def bench_mixed_arity(args):
     q0, r0 = packed_init_state(packed)
     q, r = run_n(q0, r0)
     jax.block_until_ready((q, r))
-    times = []
-    for _ in range(args.repeat):
-        t0 = time.perf_counter()
-        q, r = run_n(q0, r0)
-        jax.block_until_ready((q, r))
-        times.append(time.perf_counter() - t0)
     out["maxsum_iters_per_sec_secp_mixed_arity"] = round(
-        (args.cycles // chunk * chunk) / robust_best(times), 1)
+        measure_rate(
+            lambda: jax.block_until_ready(run_n(q0, r0)),
+            args.cycles // chunk * chunk, args.repeat), 1)
 
     # fused mixed-arity MOVE kernels (VERDICT r5 item 1): the local
     # search family on the same SECP instance rides the packed engines
@@ -1177,8 +1185,6 @@ def main():
         # the single-chip engineering — measured 11.7k vs 1.1k generic
         # at 10k vars when this landed
         try:
-            import time as _time
-
             import jax as _jax
 
             if _jax.default_backend() == "tpu":
@@ -1189,13 +1195,10 @@ def main():
                 shp = ShardedMaxSum(_tensors, build_mesh(1), damping=0.5)
                 if shp.packs is not None:
                     shp.run(cycles=args.cycles)  # warmup / compile
-                    times = []
-                    for _ in range(args.repeat):
-                        t0 = _time.perf_counter()
-                        shp.run(cycles=args.cycles)
-                        times.append(_time.perf_counter() - t0)
                     extra["sharded_packed_maxsum_iters_per_sec_tpu"] = \
-                        round(args.cycles / robust_best(times), 1)
+                        round(measure_rate(
+                            lambda: shp.run(cycles=args.cycles),
+                            args.cycles, args.repeat), 1)
         except Exception as e:  # never lose the primary
             extra["sharded_packed_tpu_error"] = repr(e)
 
