@@ -1182,8 +1182,9 @@ def main():
             raise SystemExit(1)
         # the SHARDED path on the real chip (1-device mesh): the
         # lane-packed per-shard engine (VERDICT r4 item 3) must carry
-        # the single-chip engineering — measured 11.7k vs 1.1k generic
-        # at 10k vars when this landed
+        # the single-chip engineering — 11.7k vs 1.1k generic at 10k
+        # vars when this landed; ~14.1k after the rotated single-launch
+        # cycle (ROADMAP item 7)
         try:
             import jax as _jax
 

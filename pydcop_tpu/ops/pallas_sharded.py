@@ -6,15 +6,16 @@ lane-packing engineering.  These kernels run the lane-packed layout
 INSIDE a shard — the irreducible global step (the cross-shard belief
 combine) stays outside as the one ``psum`` per cycle:
 
-* :func:`packed_shard_phase_a` — the factor side of a MaxSum cycle on
-  this shard's packed slots: Clos-permute q to the factor mates,
-  min-reduce the cost slabs into fresh factor→var messages (with
-  damping), and bucket-reduce them into per-COLUMN partial beliefs.
-* :func:`packed_shard_phase_b` — the variable side after the psum:
-  expand the globally-combined beliefs back to slots and compute the
-  mean-centred outgoing q.
-* :func:`packed_shard_tables` — the local-search analogue of phase A:
-  per-column partial local cost tables for the current assignment.
+* :func:`packed_shard_fused_ba` — ONE launch per MaxSum cycle: the
+  pending variable side of the previous cycle (expand the
+  globally-combined beliefs back to slots, mean-centred outgoing q)
+  rotated into the same kernel as this cycle's factor side
+  (Clos-permute q to the factor mates, min-reduce the cost slabs into
+  fresh factor→var messages with damping, bucket-reduce them into
+  per-COLUMN partial beliefs).
+* :func:`packed_shard_tables` — the local-search analogue of the factor
+  side: per-column partial local cost tables for the current
+  assignment.
 
 All shards execute ONE trace (SPMD): the static structure (D, Vp, N,
 buckets, plan A/B/L) is common — built by
@@ -43,84 +44,104 @@ from pydcop_tpu.ops.pallas_maxsum import (
 from pydcop_tpu.ops.pallas_permute import _permute_in_kernel
 
 
-def packed_shard_phase_a(
+def packed_shard_fused_ba(
     pg: PackedMaxSumGraph,
-    q: jnp.ndarray,            # [D, N] this shard's outgoing messages
-    r: jnp.ndarray,            # [D, N] previous factor→var messages
-    cost: jnp.ndarray,         # [D*D, N] this shard's cost rows
+    bel_g: jnp.ndarray,        # [D, Vp] last cycle's global beliefs
+    r_u: jnp.ndarray,          # [D, N] last cycle's UNMASKED factor msgs
+    q_m: Optional[jnp.ndarray],  # [D, N] masked carry (activation only)
+    r_m: Optional[jnp.ndarray],  # [D, N] masked carry (activation only)
+    active: Optional[jnp.ndarray],  # [1, N] activation mask, or None
+    cost: jnp.ndarray,         # [D*D, N]
     vmask: jnp.ndarray,        # [D, N]
-    consts: Tuple[jnp.ndarray, ...],  # this shard's 5 plan index arrays
+    inv_dcount: jnp.ndarray,   # [1, N]
+    consts: Tuple[jnp.ndarray, ...],
     damping: float,
     interpret: Optional[bool] = None,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Factor side of one sharded MaxSum cycle.  Returns
-    ``(r_new [D, N], partial beliefs [D, Vp])`` — beliefs carry NO
-    unary term (added once, globally, after the psum)."""
+) -> Tuple[jnp.ndarray, ...]:
+    """ONE launch per sharded cycle: the pending variable side of the
+    PREVIOUS cycle (phase B on ``bel_g``/``r_u``) rotated into the same
+    kernel as this cycle's factor side (phase A).  The psum stays where
+    the BP schedule puts it — between A and B — because the composition
+    is rotated, not reordered: cycle n's B executes at the START of
+    launch n+1 instead of the end of launch n.  Message streams are
+    bit-identical to the two-launch engine (the per-op DAG is unchanged);
+    on a fresh zero state the pending B is a natural no-op (expand(0) -
+    0, mean-centred, = 0), so no first-step flag is needed.
+
+    Without activation the whole cycle state is ``(r_u, bel_g)`` — the
+    committed q is recomputed from them — so ``q_m``/``r_m``/``active``
+    must be None and the launch returns ``(r_new, bel_partial)``.  With
+    activation (the amaxsum emulation) the commit selects ride inside
+    the kernel and it returns ``(r_new, bel_partial, q1, r1)`` where
+    q1/r1 are the committed messages this cycle's A consumed (the next
+    masked carry).
+    """
     interpret = _resolve_interpret(interpret)
     D, N, Vp = pg.D, pg.N, pg.Vp
+    has_act = active is not None
 
-    def kern(q_ref, r_ref, cost_ref, vmask_ref, c1, c2, c3, c4, c5,
-             r_out, bel_out):
-        consts_t = (c1[:], c2[:], c3[:], c4[:], c5[:])
-        qm = _permute_in_kernel(q_ref[:], pg.plan, D, consts_t)
+    def kern(bel_ref, ru_ref, *rest):
+        if has_act:
+            qm_ref, rm_ref, act_ref = rest[:3]
+            cost_ref, vmask_ref, invd_ref = rest[3:6]
+            c_refs = rest[6:11]
+            r_out, bel_out, q1_out, r1_out = rest[11:]
+        else:
+            cost_ref, vmask_ref, invd_ref = rest[:3]
+            c_refs = rest[3:8]
+            r_out, bel_out = rest[8:]
+        consts_t = tuple(c[:] for c in c_refs)
+        ru_t = ru_ref[:]
+        vmask_t = vmask_ref[:]
+        # pending phase B of the previous cycle (no-op on zero state)
+        expanded = _bucket_expand(pg, bel_ref[:], D)
+        q_cand = expanded - ru_t
+        mean = (q_cand * vmask_t).sum(axis=0, keepdims=True) * invd_ref[:]
+        q_cand = (q_cand - mean) * vmask_t
+        if has_act:
+            act_t = act_ref[:]
+            q1 = jnp.where(act_t > 0, q_cand, qm_ref[:])
+            r1 = jnp.where(act_t > 0, ru_t, rm_ref[:])
+        else:
+            q1, r1 = q_cand, ru_t
+        # this cycle's phase A
+        qm = _permute_in_kernel(q1, pg.plan, D, consts_t)
         cost_t = cost_ref[:]
         r_new = cost_t[0: D, :] + qm[0: 1, :]
         for j in range(1, D):
             r_new = jnp.minimum(
                 r_new, cost_t[j * D: (j + 1) * D, :] + qm[j: j + 1, :]
             )
-        r_new = r_new * vmask_ref[:]
+        r_new = r_new * vmask_t
         if damping:
-            r_new = damping * r_ref[:] + (1.0 - damping) * r_new
+            r_new = damping * r1 + (1.0 - damping) * r_new
         r_out[:] = r_new
         bel_out[:] = _bucket_reduce(pg, r_new, D, jnp.add)
+        if has_act:
+            q1_out[:] = q1
+            r1_out[:] = r1
 
+    ops = [bel_g, r_u]
+    if has_act:
+        ops += [q_m, r_m, active]
+    ops += [cost, vmask, inv_dcount, *consts]
+    n_out = 4 if has_act else 2
+    out_shape = (
+        jax.ShapeDtypeStruct((D, N), jnp.float32),
+        jax.ShapeDtypeStruct((D, Vp), jnp.float32),
+        jax.ShapeDtypeStruct((D, N), jnp.float32),
+        jax.ShapeDtypeStruct((D, N), jnp.float32),
+    )[:n_out]
     return pl.pallas_call(
         kern,
-        out_shape=(
-            jax.ShapeDtypeStruct((D, N), jnp.float32),
-            jax.ShapeDtypeStruct((D, Vp), jnp.float32),
-        ),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 9,
-        out_specs=(
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(ops),
+        out_specs=tuple(
+            pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(n_out)
         ),
         interpret=interpret,
         compiler_params=_compiler_params(),
-    )(q, r, cost, vmask, *consts)
-
-
-def packed_shard_phase_b(
-    pg: PackedMaxSumGraph,
-    bel_pack: jnp.ndarray,     # [D, Vp] globally-combined beliefs
-    r_new: jnp.ndarray,        # [D, N] from phase A
-    vmask: jnp.ndarray,        # [D, N]
-    inv_dcount: jnp.ndarray,   # [1, N]
-    interpret: Optional[bool] = None,
-) -> jnp.ndarray:
-    """Variable side after the psum: q' = beliefs(var) - r', zero-mean
-    over each slot's valid values (maxsum_kernels var_to_factor
-    semantics).  Returns the new q [D, N]."""
-    interpret = _resolve_interpret(interpret)
-    D, N = pg.D, pg.N
-
-    def kern(bel_ref, r_ref, vmask_ref, invd_ref, q_out):
-        r_new_t = r_ref[:]
-        vmask_t = vmask_ref[:]
-        expanded = _bucket_expand(pg, bel_ref[:], D)
-        q_new = expanded - r_new_t
-        mean = (q_new * vmask_t).sum(axis=0, keepdims=True) * invd_ref[:]
-        q_out[:] = (q_new - mean) * vmask_t
-
-    return pl.pallas_call(
-        kern,
-        out_shape=jax.ShapeDtypeStruct((D, N), jnp.float32),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 4,
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        interpret=interpret,
-        compiler_params=_compiler_params(),
-    )(bel_pack, r_new, vmask, inv_dcount)
+    )(*ops)
 
 
 def packed_shard_tables(

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -310,16 +310,20 @@ class ShardedMaxSum:
         self._make_run_n(sharded)
 
     def _build_packed(self):
-        """shard_map cycle over the lane-packed per-shard layouts: the
-        pallas phase kernels bracket the one psum of partial beliefs.
-        The column map is shard-invariant (packed_mesh ForcedLayout), so
-        the psum runs directly on the packed [D, Vp] partials — no
-        scatter/gather through the global variable axis."""
+        """shard_map cycle over the lane-packed per-shard layouts, ONE
+        pallas launch per cycle (ROADMAP item 7): the previous cycle's
+        variable side (phase B) is ROTATED into the same launch as this
+        cycle's factor side (phase A), with the one psum of partial
+        beliefs between them — the BP schedule is unchanged, only the
+        launch boundary moves.  The scan carries the pending state
+        (q/r committed carries, last unmasked r, last global beliefs,
+        pending activation key); values are derived from the final
+        beliefs AFTER the scan instead of per cycle.  The column map is
+        shard-invariant (packed_mesh ForcedLayout), so the psum runs
+        directly on the packed [D, Vp] partials — no scatter/gather
+        through the global variable axis."""
         from pydcop_tpu.ops.compile import PAD_COST
-        from pydcop_tpu.ops.pallas_sharded import (
-            packed_shard_phase_a,
-            packed_shard_phase_b,
-        )
+        from pydcop_tpu.ops.pallas_sharded import packed_shard_fused_ba
 
         sp = self.packs
         pg = sp.pg0
@@ -328,48 +332,82 @@ class ShardedMaxSum:
         shard0 = NamedSharding(self.mesh, P(AXIS))
         repl = NamedSharding(self.mesh, P())
 
-        def cycle_fn(q, r, key, unary_p, mask_p, vmask, invd, cost,
-                     c1, c2, c3, c4, c5):
-            q0, r0 = q[0], r[0]
-            consts = (c1[0], c2[0], c3[0], c4[0], c5[0])
-            r_new, bel = packed_shard_phase_a(
-                pg, q0, r0, cost[0], vmask[0], consts, damping
-            )
-            # the ONE collective: columns align across shards
-            beliefs_p = unary_p + jax.lax.psum(bel, AXIS)  # [D, Vp]
-            q_new = packed_shard_phase_b(
-                pg, beliefs_p, r_new, vmask[0], invd[0]
-            )
-            values_p = jnp.argmin(
-                jnp.where(mask_p > 0, beliefs_p, PAD_COST), axis=0
-            ).astype(jnp.int32)
-            if activation is not None:
-                skey = jax.random.fold_in(key, jax.lax.axis_index(AXIS))
+        if activation is not None:
+            def cycle_fn(qm, rm, ru, bel_g, key_p, key, unary_p, vmask,
+                         invd, cost, c1, c2, c3, c4, c5):
+                consts = (c1[0], c2[0], c3[0], c4[0], c5[0])
+                # the PENDING mask: cycle n's commit decision (key n)
+                # applied at the start of launch n+1, exactly where the
+                # rotation moved cycle n's phase B
+                skey = jax.random.fold_in(
+                    key_p, jax.lax.axis_index(AXIS)
+                )
                 active = (
                     jax.random.uniform(skey, (1, pg.N)) < activation
+                ).astype(jnp.float32)
+                r_new, bel, q1, r1 = packed_shard_fused_ba(
+                    pg, bel_g, ru[0], qm[0], rm[0], active, cost[0],
+                    vmask[0], invd[0], consts, damping,
                 )
-                q_new = jnp.where(active, q_new, q0)
-                r_new = jnp.where(active, r_new, r0)
-            return q_new[None], r_new[None], values_p
+                # the ONE collective: columns align across shards
+                beliefs_p = unary_p + jax.lax.psum(bel, AXIS)
+                return q1[None], r1[None], r_new[None], beliefs_p, key
 
-        in_specs = [P(AXIS), P(AXIS), P(), P(), P()] + [P(AXIS)] * 8
+            in_specs = (
+                [P(AXIS), P(AXIS), P(AXIS), P(), P(), P(), P()]
+                + [P(AXIS)] * 8
+            )
+            out_specs = (P(AXIS), P(AXIS), P(AXIS), P(), P())
+        else:
+            # no activation: the whole cycle state is (r_u, beliefs) —
+            # the committed q is recomputed inside the launch, so the
+            # scan carries no dead [S, D, N] arrays (code-review r5)
+            def cycle_fn(ru, bel_g, key, unary_p, vmask, invd, cost,
+                         c1, c2, c3, c4, c5):
+                consts = (c1[0], c2[0], c3[0], c4[0], c5[0])
+                r_new, bel = packed_shard_fused_ba(
+                    pg, bel_g, ru[0], None, None, None, cost[0],
+                    vmask[0], invd[0], consts, damping,
+                )
+                # the ONE collective: columns align across shards
+                beliefs_p = unary_p + jax.lax.psum(bel, AXIS)
+                return r_new[None], beliefs_p
+
+            in_specs = [P(AXIS), P(), P(), P()] + [P(AXIS)] * 8
+            out_specs = (P(AXIS), P())
         sharded = jax.shard_map(
             cycle_fn,
             mesh=self.mesh,
             in_specs=tuple(in_specs),
-            out_specs=(P(AXIS), P(AXIS), P()),
+            out_specs=out_specs,
             check_vma=False,
         )
+        # mask_p rides _run_args too: jit ARGUMENTS, not closure
+        # constants — multi-process meshes reject closing over arrays
+        # with non-addressable shards
         self._run_args = (
-            jax.device_put(sp.unary_p, repl),
             jax.device_put(pg.mask_p, repl),
+            jax.device_put(sp.unary_p, repl),
             *(jax.device_put(a, shard0) for a in (
                 sp.vmask, sp.inv_dcount, sp.cost_rows, *sp.consts,
             )),
         )
         # run() maps packed column values back to variable order
         self._values_map = np.asarray(pg.var_order)
-        self._make_run_n(sharded)
+        bel_idx = 3 if activation is not None else 1
+
+        def run_n(state, keys, mask_p, *args):
+            def body(carry, k):
+                carry = sharded(*carry, k, *args)
+                return carry, None
+
+            state, _ = jax.lax.scan(body, state, keys)
+            values_p = jnp.argmin(
+                jnp.where(mask_p > 0, state[bel_idx], PAD_COST), axis=0
+            ).astype(jnp.int32)
+            return state, values_p
+
+        self._run_n = jax.jit(run_n)
 
     def _make_run_n(self, sharded):
         # global arrays must be jit ARGUMENTS, not closure constants —
@@ -385,15 +423,26 @@ class ShardedMaxSum:
 
         self._run_n = jax.jit(run_n)
 
-    def init_messages(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    def init_messages(self, seed: int = 0):
         if self.packs is not None:
             sp = self.packs
             sharding = NamedSharding(self.mesh, P(AXIS, None, None))
+            repl = NamedSharding(self.mesh, P())
             z = jax.device_put(
                 jnp.zeros((sp.n_shards, sp.D, sp.N), dtype=jnp.float32),
                 sharding,
             )
-            return z, z
+            bel0 = jax.device_put(
+                jnp.zeros((sp.D, sp.Vp), dtype=jnp.float32), repl
+            )
+            if self.activation is None:
+                state = (z, bel0)
+                return state, state
+            # key_p: the pending-commit key; on a fresh zero state the
+            # pending mask is a no-op, so any key works here
+            key0 = jax.device_put(jax.random.PRNGKey(seed), repl)
+            state = (z, z, z, bel0, key0)
+            return state, state
         st = self.st
         E, D = st.edge_var.shape[0], st.max_domain_size
         sharding = NamedSharding(self.mesh, P(AXIS, None))
@@ -403,11 +452,13 @@ class ShardedMaxSum:
     def run(self, cycles: int = 20, q=None, r=None, seed: int = 0):
         """Run `cycles` sharded cycles; returns (values [V], q, r).
         Pass the previous call's (q, r) to continue instead of
-        restarting from zero messages."""
+        restarting from zero messages.  (q, r) are OPAQUE continuation
+        state: the packed engine carries its rotated-launch scan state
+        in them — callers must not peek inside."""
         if self._run_n is None:
             self._build()
         if q is None or r is None:
-            q, r = self.init_messages()
+            q, r = self.init_messages(seed)
             self._epoch = 0
         # identical on every process (SPMD); the epoch advances the stream
         # across chunked/resumed runs so activation patterns don't replay
@@ -416,11 +467,12 @@ class ShardedMaxSum:
         keys = jax.random.split(
             jax.random.fold_in(jax.random.PRNGKey(seed), epoch), cycles
         )
-        q, r, values = self._run_n(q, r, keys, *self._run_args)
-        values = np.asarray(values)
         if self.packs is not None:
-            values = values[self._values_map]
-        return values, q, r
+            state, values = self._run_n(q, keys, *self._run_args)
+            values = np.asarray(values)[self._values_map]
+            return values, state, state
+        q, r, values = self._run_n(q, r, keys, *self._run_args)
+        return np.asarray(values), q, r
 
 
 def st_factors(sb: ShardedBucket) -> int:

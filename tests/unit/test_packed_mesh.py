@@ -116,6 +116,38 @@ class TestPackedShardedMaxSum:
         va, _, _ = a.run(cycles=6)
         assert va.shape == (t.n_vars,)
 
+    def test_activation_rotated_semantics_pinned(self):
+        """Regression pin for the rotated activation (amaxsum) path:
+        the pending-commit key rides ONE launch behind (key_p), and the
+        commit selects pick the fresh q/r on active slots.  Verified
+        bit-identical to the pre-rotation two-launch engine when the
+        rotation landed (code-review r5); the golden array pins that
+        semantics — a future edit that folds the wrong key or swaps a
+        where-arm changes these values."""
+        dcop = generate_graph_coloring(
+            n_variables=24, n_colors=3, n_edges=40, soft=True,
+            n_agents=1, seed=7,
+        )
+        t = compile_factor_graph(dcop)
+        mesh = build_mesh(4)
+        a = ShardedMaxSum(t, mesh, damping=0.5, activation=0.6,
+                          use_packed=True)
+        va, _, _ = a.run(cycles=6, seed=11)
+        golden = [0, 2, 2, 1, 0, 2, 0, 0, 0, 0, 0, 1, 0, 0, 1, 2, 1, 2,
+                  0, 1, 2, 1, 0, 2]
+        np.testing.assert_array_equal(va, golden)
+        plain = ShardedMaxSum(t, mesh, damping=0.5, use_packed=True)
+        vp, _, _ = plain.run(cycles=6)
+        # masking has an effect at 0.6 ...
+        assert (va != vp).any()
+        # ... and an (effectively) always-active mask reduces to the
+        # slim no-activation engine exactly, pinning the where-arm
+        # orientation (stale-carry arms would win everywhere instead)
+        near_one = ShardedMaxSum(t, mesh, damping=0.5,
+                                 activation=0.9999999, use_packed=True)
+        vn, _, _ = near_one.run(cycles=6, seed=11)
+        np.testing.assert_array_equal(vn, vp)
+
     def test_placement_assigns_drive_packs(self):
         """An explicit factor→shard assignment flows into the packed
         layout (the placement-driven solve path)."""
