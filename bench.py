@@ -609,6 +609,24 @@ def bench_mixed_arity(args):
         except Exception as e:  # keep the other rates
             out[f"secp_mixed_{algo}_error"] = repr(e)
 
+    # the SHARDED path on the same mixed instance (1-device mesh):
+    # ROADMAP item 7's first half — mixed-arity graphs ride the
+    # lane-packed per-shard kernels under a shared MixedLayout
+    # (~15.4k iters/s when this landed vs sub-1k for the generic
+    # sharded engine)
+    try:
+        from pydcop_tpu.parallel.mesh import ShardedMaxSum, build_mesh
+
+        shp = ShardedMaxSum(tensors, build_mesh(1), damping=0.5)
+        if shp.packs is not None and shp.packs.mixed:
+            shp.run(cycles=args.cycles)  # warmup / compile
+            out["sharded_packed_secp_iters_per_sec_tpu"] = round(
+                measure_rate(
+                    lambda: shp.run(cycles=args.cycles),
+                    args.cycles, args.repeat), 1)
+    except Exception as e:  # never lose the single-chip rates
+        out["sharded_packed_secp_error"] = repr(e)
+
     # PEAV meeting scheduling: unary preference factors + binary
     # equality/overlap factors → the mixed packer (slots_count 7 keeps
     # the value domain within the engine's D <= 8)

@@ -574,87 +574,55 @@ def _hub_constants(group_heads, Vp: int, max_m: int):
             jnp.asarray(head_np))
 
 
-def pack_mixed_for_pallas(t: FactorGraphTensors
-                          ) -> Optional[PackedMaxSumGraph]:
-    """Compile a MIXED-arity (1/2/3) graph into the lane-packed layout
-    (ROADMAP §2a / VERDICT r4 item 7 — SECP model factors, n-ary rule
-    tables).  Column classes are exact per-arity slot-count triples
-    (c1, c2, c3); each bucket's slots are grouped by arity so the kernel
-    applies the right update on aligned lane ranges; the third endpoint
-    of ternary factors rides a SECOND Clos permutation.
+#: slot-count quantization ladder for mixed class triples — short so the
+#: class-triple product space stays small
+_QUANT_LADDER = np.array(
+    (0, 1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96), dtype=np.int64)
 
-    Hubs (total degree > _MAX_SLOT_CLASS — VERDICT r4 item 4): a hub is
-    split into m = ceil(deg/96) sub-columns, each holding the quantized
-    per-arity shares ceil(deg_a/m); the group lives contiguously inside
-    one 128-lane bin and is combined with the same suffix-doubling
-    gathers as the binary packer (the hub machinery is arity-agnostic —
-    it operates on columns).
 
-    Returns None out of scope: arity > 3, D > 5 (the ternary slab array
-    is D^3 rows), a hub beyond _MAX_SLOT_CLASS*128 total edges, too
-    many distinct classes, or VMEM.
-    """
-    by_arity = {b.arity: b for b in t.buckets if b.n_factors > 0}
-    if not by_arity or any(a not in (1, 2, 3) for a in by_arity):
-        return None
-    V, D = t.n_vars, t.max_domain_size
-    if 3 in by_arity and D > 5:
-        return None
-    if D > 8:
-        return None
+def _quantize_up(counts: np.ndarray) -> np.ndarray:
+    return _QUANT_LADDER[np.minimum(
+        np.searchsorted(_QUANT_LADDER, counts), len(_QUANT_LADDER) - 1)]
 
-    # per-arity endpoint lists and per-var degrees
-    ends = {
-        a: np.asarray(b.var_idx).T.ravel()  # e = p*F + f ordering
-        for a, b in by_arity.items()
-    }
-    deg_a = {
-        a: np.bincount(e, minlength=V) for a, e in ends.items()
-    }
-    deg = sum(deg_a.values())
-    S = _MAX_SLOT_CLASS
-    if int(deg.max(initial=0)) > S * _LANES:
-        return None  # a hub beyond ~12k edges: generic engine
-    hub_of = deg > S
-    hub_vars = np.flatnonzero(hub_of)
-    hub_m = np.zeros(V, dtype=np.int64)
-    for v in hub_vars:
-        hub_m[v] = int(np.ceil(deg[v] / S))
 
-    # class triples, each component quantized up a short ladder so the
-    # product space stays small (a variable pads each arity section to
-    # its quantized count with zero-masked dummy slots).  Vectorized:
-    # a per-variable python loop here would be O(V^2) with the zeros
-    # default, and this path also runs as the FALLBACK for large binary
-    # graphs that the binary packer rejects.  A hub's key is the
-    # quantized triple of its per-arity sub-column shares.
-    ladder = np.array((0, 1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96),
-                      dtype=np.int64)
-    zero = np.zeros(V, dtype=np.int64)
+@dataclass
+class MixedLayout:
+    """Column/slot layout of a mixed-arity packing, independent of any
+    particular edge set — built by :func:`_mixed_layout` from the
+    per-variable class triples.  parallel/packed_mesh builds ONE from
+    per-variable MAX per-shard degrees and forces it on every shard's
+    :func:`pack_mixed_for_pallas` call so the packed statics (D, Vp, N,
+    buckets, plan shapes) are shard-invariant (SPMD single trace)."""
 
-    def quantize(counts):
-        return ladder[np.minimum(
-            np.searchsorted(ladder, counts), len(ladder) - 1)]
+    keys: np.ndarray                     # [V, 3] post-merge triples
+    hub_of: np.ndarray                   # [V] bool
+    hub_m: np.ndarray                    # [V] sub-columns per hub
+    var_pcol: np.ndarray                 # [V] head column
+    col_var: np.ndarray                  # [Vp] var per column (-1 dummy)
+    with_slots: List[Tuple[int, int, int, int]]
+    buckets_arity: List[Tuple[int, int, int]]
+    group_heads: List[Tuple[int, int]]
+    max_m: int
+    Vp: int
+    N: int
+    col_soff: np.ndarray
+    col_nvp: np.ndarray
+    col_voff: np.ndarray
+    col_base: dict
 
-    share = np.maximum(hub_m, 1)
-    keys = np.stack([
-        quantize(-(-deg_a.get(a, zero) // share))  # ceil(deg_a / m)
-        for a in (1, 2, 3)
-    ], axis=1)  # [V, 3]
-    # merge fragmented classes until both the class count and the Clos
-    # A ≤ 8 slot budget fit (power-law degree tails with ternary
-    # presence fork a fresh 128-column block per triple otherwise)
-    rep = _merge_mixed_classes(keys, hub_m, 2 * _MAX_BUCKETS, 8 * _TILE)
-    if rep is None:
-        return None
-    keys = np.array([rep[tuple(k)] for k in keys.tolist()],
-                    dtype=np.int64)
+
+def _mixed_layout(keys: np.ndarray, hub_of: np.ndarray,
+                  hub_m: np.ndarray) -> Optional[MixedLayout]:
+    """Column layout per class triple: hub groups first (first-fit
+    descending into 128-lane bins so no group straddles a bin), then
+    singles fill the gaps — same scheme as the binary packer.  Pure
+    function of (keys, hub_of, hub_m); returns None when the slot count
+    exceeds the Clos A ≤ 8 budget."""
+    V = keys.shape[0]
     key_of = [tuple(row) for row in keys.tolist()]
     classes = sorted(set(key_of))
+    hub_vars = np.flatnonzero(hub_of)
 
-    # column layout per class: hub groups first (first-fit descending
-    # into 128-lane bins so no group straddles a bin), then singles
-    # fill the gaps — same scheme as the binary packer
     buckets: List[Tuple[int, int, int, int]] = []
     buckets_arity: List[Tuple[int, int, int]] = []
     var_pcol = np.full(V, -1, dtype=np.int64)
@@ -727,6 +695,125 @@ def pack_mixed_for_pallas(t: FactorGraphTensors
         col_base[1][sl] = 0
         col_base[2][sl] = key[0]
         col_base[3][sl] = key[0] + key[1]
+    return MixedLayout(
+        keys=keys, hub_of=hub_of, hub_m=hub_m, var_pcol=var_pcol,
+        col_var=col_var, with_slots=with_slots,
+        buckets_arity=buckets_arity, group_heads=group_heads,
+        max_m=max_m, Vp=Vp, N=N, col_soff=col_soff, col_nvp=col_nvp,
+        col_voff=col_voff, col_base=col_base,
+    )
+
+
+def pack_mixed_for_pallas(t: FactorGraphTensors,
+                          layout: Optional[MixedLayout] = None,
+                          ) -> Optional[PackedMaxSumGraph]:
+    """Compile a MIXED-arity (1/2/3) graph into the lane-packed layout
+    (ROADMAP §2a / VERDICT r4 item 7 — SECP model factors, n-ary rule
+    tables).  Column classes are exact per-arity slot-count triples
+    (c1, c2, c3); each bucket's slots are grouped by arity so the kernel
+    applies the right update on aligned lane ranges; the third endpoint
+    of ternary factors rides a SECOND Clos permutation.
+
+    Hubs (total degree > _MAX_SLOT_CLASS — VERDICT r4 item 4): a hub is
+    split into m = ceil(deg/96) sub-columns, each holding the quantized
+    per-arity shares ceil(deg_a/m); the group lives contiguously inside
+    one 128-lane bin and is combined with the same suffix-doubling
+    gathers as the binary packer (the hub machinery is arity-agnostic —
+    it operates on columns).
+
+    ``layout`` forces a pre-built :class:`MixedLayout` (the sharded
+    packer builds one from max-per-shard degrees so every shard's pack
+    shares the statics); when forced, sections the layout reserves for
+    an arity this subgraph lacks still get their plan/cost arrays
+    (identity routing, zero rows) so the traced structure stays
+    invariant across shards.
+
+    Returns None out of scope: arity > 3, D > 5 (the ternary slab array
+    is D^3 rows), a hub beyond _MAX_SLOT_CLASS*128 total edges, too
+    many distinct classes, edges that don't fit a forced layout, or
+    VMEM.
+    """
+    by_arity = {b.arity: b for b in t.buckets if b.n_factors > 0}
+    if layout is None:
+        if not by_arity:
+            return None
+    if any(a not in (1, 2, 3) for a in by_arity):
+        return None
+    V, D = t.n_vars, t.max_domain_size
+    has3 = 3 in by_arity or (
+        layout is not None and bool((layout.keys[:, 2] > 0).any())
+    )
+    if has3 and D > 5:
+        return None
+    if D > 8:
+        return None
+
+    # per-arity endpoint lists and per-var degrees
+    ends = {
+        a: np.asarray(b.var_idx).T.ravel()  # e = p*F + f ordering
+        for a, b in by_arity.items()
+    }
+    deg_a = {
+        a: np.bincount(e, minlength=V) for a, e in ends.items()
+    }
+    zero = np.zeros(V, dtype=np.int64)
+    S = _MAX_SLOT_CLASS
+    if layout is None:
+        deg = sum(deg_a.values())
+        if int(deg.max(initial=0)) > S * _LANES:
+            return None  # a hub beyond ~12k edges: generic engine
+        hub_of = deg > S
+        hub_vars = np.flatnonzero(hub_of)
+        hub_m = np.zeros(V, dtype=np.int64)
+        for v in hub_vars:
+            hub_m[v] = int(np.ceil(deg[v] / S))
+
+        # class triples, each component quantized up a short ladder so
+        # the product space stays small (a variable pads each arity
+        # section to its quantized count with zero-masked dummy slots).
+        # Vectorized: a per-variable python loop here would be O(V^2)
+        # with the zeros default, and this path also runs as the
+        # FALLBACK for large binary graphs that the binary packer
+        # rejects.  A hub's key is the quantized triple of its per-arity
+        # sub-column shares.
+        share = np.maximum(hub_m, 1)
+        keys = np.stack([
+            _quantize_up(-(-deg_a.get(a, zero) // share))  # ceil(deg/m)
+            for a in (1, 2, 3)
+        ], axis=1)  # [V, 3]
+        # merge fragmented classes until both the class count and the
+        # Clos A ≤ 8 slot budget fit (power-law degree tails with
+        # ternary presence fork a fresh 128-column block per triple
+        # otherwise)
+        rep = _merge_mixed_classes(keys, hub_m, 2 * _MAX_BUCKETS,
+                                   8 * _TILE)
+        if rep is None:
+            return None
+        keys = np.array([rep[tuple(k)] for k in keys.tolist()],
+                        dtype=np.int64)
+        layout = _mixed_layout(keys, hub_of, hub_m)
+        if layout is None:
+            return None
+    else:
+        # defensive: this subgraph's per-arity degrees must fit the
+        # forced per-arity shares
+        share = np.maximum(layout.hub_m, 1)
+        for a in (1, 2, 3):
+            if (-(-deg_a.get(a, zero) // share)
+                    > layout.keys[:, a - 1]).any():
+                return None
+
+    keys = layout.keys
+    hub_m = layout.hub_m
+    var_pcol = layout.var_pcol
+    col_var = layout.col_var
+    with_slots = layout.with_slots
+    buckets_arity = layout.buckets_arity
+    group_heads = layout.group_heads
+    max_m = layout.max_m
+    Vp, N = layout.Vp, layout.N
+    col_soff, col_nvp = layout.col_soff, layout.col_nvp
+    col_voff, col_base = layout.col_voff, layout.col_base
 
     # slot per edge endpoint, per arity: rank within (var, arity).
     # Hub edges spill into sub-column rank // share at local rank
@@ -747,6 +834,7 @@ def pack_mixed_for_pallas(t: FactorGraphTensors
             col - col_voff[col])
 
     # two routing permutations: plan = first sibling, plan2 = second
+    A = N // _TILE
     perm1 = np.arange(N, dtype=np.int64)
     perm2 = np.arange(N, dtype=np.int64)
     if 2 in by_arity:
@@ -764,8 +852,10 @@ def pack_mixed_for_pallas(t: FactorGraphTensors
             perm1[mine] = s3[sib1 * F3: (sib1 + 1) * F3]
             perm2[mine] = s3[sib2 * F3: (sib2 + 1) * F3]
     plan = plan_permutation(perm1, A, _LANES, _LANES)
-    plan2 = plan_permutation(perm2, A, _LANES, _LANES) \
-        if 3 in by_arity else None
+    # has3 (not `3 in by_arity`): a forced layout with ternary sections
+    # keeps plan2 (identity here) even when THIS subgraph has no ternary
+    # factors, so the traced structure is shard-invariant
+    plan2 = plan_permutation(perm2, A, _LANES, _LANES) if has3 else None
 
     # cost arrays per arity
     cost1 = np.zeros((D, N), dtype=np.float32)
@@ -784,12 +874,11 @@ def pack_mixed_for_pallas(t: FactorGraphTensors
                 vals = np.where(
                     p_of == 0, T2[f_of, i, j], T2[f_of, j, i])
                 cost_rows[j * D + i, slot_of[2]] = vals
-    cost3 = None
+    cost3 = np.zeros((D * D * D, N), dtype=np.float32) if has3 else None
     if 3 in by_arity:
         b3 = by_arity[3]
         F3 = b3.n_factors
         T3 = np.asarray(b3.tensors)  # [F3, D, D, D]
-        cost3 = np.zeros((D * D * D, N), dtype=np.float32)
         for p in range(3):
             mine = slot_of[3][p * F3: (p + 1) * F3]
             # move the target axis first, then sib1 ((p+1)%3), sib2

@@ -39,9 +39,20 @@ from pydcop_tpu.ops.pallas_local_search import (
 from pydcop_tpu.ops.pallas_maxsum import (
     PackedMaxSumGraph,
     _compiler_params,
+    _contrib_for_values,
+    _mixed_r_new,
+    _parse_mixed_refs,
     _resolve_interpret,
 )
 from pydcop_tpu.ops.pallas_permute import _permute_in_kernel
+
+
+#: operand bundle for mixed-arity shard kernels:
+#: (cost1 [D,N], cost3 [D^3,N] | None, am2 [1,N], am3 [1,N],
+#:  consts2 tuple-of-5 | None) — cost3/consts2 are None iff the shared
+#: layout has no ternary sections (then they are None on EVERY shard:
+#: the layout is shard-invariant, so the traced structure is too)
+MixedOps = Tuple
 
 
 def packed_shard_fused_ba(
@@ -56,6 +67,7 @@ def packed_shard_fused_ba(
     inv_dcount: jnp.ndarray,   # [1, N]
     consts: Tuple[jnp.ndarray, ...],
     damping: float,
+    mixed: Optional[MixedOps] = None,
     interpret: Optional[bool] = None,
 ) -> Tuple[jnp.ndarray, ...]:
     """ONE launch per sharded cycle: the pending variable side of the
@@ -75,21 +87,33 @@ def packed_shard_fused_ba(
     the kernel and it returns ``(r_new, bel_partial, q1, r1)`` where
     q1/r1 are the committed messages this cycle's A consumed (the next
     masked carry).
+
+    ``mixed`` (a :data:`MixedOps` bundle) switches the factor side to
+    the arity-masked mixed update (pallas_maxsum._mixed_r_new), with
+    the second Clos permutation for ternary siblings.
     """
     interpret = _resolve_interpret(interpret)
     D, N, Vp = pg.D, pg.N, pg.Vp
     has_act = active is not None
+    has3 = mixed is not None and mixed[1] is not None
 
     def kern(bel_ref, ru_ref, *rest):
+        outs = rest[-(4 if has_act else 2):]
+        ins = rest[:len(rest) - len(outs)]
+        i = 0
         if has_act:
-            qm_ref, rm_ref, act_ref = rest[:3]
-            cost_ref, vmask_ref, invd_ref = rest[3:6]
-            c_refs = rest[6:11]
-            r_out, bel_out, q1_out, r1_out = rest[11:]
-        else:
-            cost_ref, vmask_ref, invd_ref = rest[:3]
-            c_refs = rest[3:8]
-            r_out, bel_out = rest[8:]
+            qm_ref, rm_ref, act_ref = ins[i: i + 3]
+            i += 3
+        cost_ref, vmask_ref, invd_ref = ins[i: i + 3]
+        i += 3
+        c_refs = ins[i: i + 5]
+        i += 5
+        mx = None
+        if mixed is not None:
+            # one parser for the MixedOps operand order everywhere
+            # (pallas_maxsum._mixed_operands defines the contract)
+            mx, _ = _parse_mixed_refs(pg, ins[i:])
+        r_out, bel_out = outs[:2]
         consts_t = tuple(c[:] for c in c_refs)
         ru_t = ru_ref[:]
         vmask_t = vmask_ref[:]
@@ -107,24 +131,39 @@ def packed_shard_fused_ba(
         # this cycle's phase A
         qm = _permute_in_kernel(q1, pg.plan, D, consts_t)
         cost_t = cost_ref[:]
-        r_new = cost_t[0: D, :] + qm[0: 1, :]
-        for j in range(1, D):
-            r_new = jnp.minimum(
-                r_new, cost_t[j * D: (j + 1) * D, :] + qm[j: j + 1, :]
+        if mx is not None:
+            cost1_t, cost3_t, c2_t, am2_t, am3_t = mx
+            qm2 = (
+                _permute_in_kernel(q1, pg.plan2, D, c2_t)
+                if c2_t is not None else qm
             )
+            r_new = _mixed_r_new(
+                pg, qm, qm2, cost_t, cost1_t, cost3_t, am2_t, am3_t
+            )
+        else:
+            r_new = cost_t[0: D, :] + qm[0: 1, :]
+            for j in range(1, D):
+                r_new = jnp.minimum(
+                    r_new, cost_t[j * D: (j + 1) * D, :] + qm[j: j + 1, :]
+                )
         r_new = r_new * vmask_t
         if damping:
             r_new = damping * r1 + (1.0 - damping) * r_new
         r_out[:] = r_new
         bel_out[:] = _bucket_reduce(pg, r_new, D, jnp.add)
         if has_act:
-            q1_out[:] = q1
-            r1_out[:] = r1
+            outs[2][:] = q1
+            outs[3][:] = r1
 
     ops = [bel_g, r_u]
     if has_act:
         ops += [q_m, r_m, active]
     ops += [cost, vmask, inv_dcount, *consts]
+    if mixed is not None:
+        cost1, cost3, am2, am3, consts2 = mixed
+        ops += [cost1, am2, am3]
+        if has3:
+            ops += [cost3, *consts2]
     n_out = 4 if has_act else 2
     out_shape = (
         jax.ShapeDtypeStruct((D, N), jnp.float32),
@@ -149,31 +188,44 @@ def packed_shard_tables(
     x_cols: jnp.ndarray,       # [1, Vp] current value per column (f32)
     cost: jnp.ndarray,         # [D*D, N]
     consts: Tuple[jnp.ndarray, ...],
+    mixed: Optional[MixedOps] = None,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Per-column partial local cost tables [D, Vp] for this shard's
     constraints under the current assignment (no unary; the caller adds
-    it globally after the psum)."""
+    it globally after the psum).  ``mixed`` switches the contribution
+    to the arity-masked assembly (pallas_maxsum._mixed_contrib)."""
     interpret = _resolve_interpret(interpret)
     D, N, Vp = pg.D, pg.N, pg.Vp
+    has3 = mixed is not None and mixed[1] is not None
 
-    def kern(x_ref, cost_ref, c1, c2, c3, c4, c5, t_out):
-        consts_t = (c1[:], c2[:], c3[:], c4[:], c5[:])
+    def kern(x_ref, cost_ref, *rest):
+        t_out = rest[-1]
+        ins = rest[:-1]
+        consts_t = tuple(c[:] for c in ins[:5])
         xs = _bucket_expand(pg, x_ref[:], 1)
         xo = _permute_in_kernel(xs, pg.plan, 1, consts_t)
         cost_t = cost_ref[:]
-        contrib = cost_t[0: D, :]
-        for j in range(1, D):
-            contrib = jnp.where(
-                xo == float(j), cost_t[j * D: (j + 1) * D, :], contrib
-            )
+        mx = None
+        if mixed is not None:
+            mx, _ = _parse_mixed_refs(pg, ins[5:])
+        contrib = _contrib_for_values(
+            pg, xs, xo, mx, cost=cost_t,
+            slabs=[cost_t[j * D: (j + 1) * D, :] for j in range(D)],
+        )
         t_out[:] = _bucket_reduce(pg, contrib, D, jnp.add)
 
+    ops = [x_cols, cost, *consts]
+    if mixed is not None:
+        cost1, cost3, am2, am3, consts2 = mixed
+        ops += [cost1, am2, am3]
+        if has3:
+            ops += [cost3, *consts2]
     return pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((D, Vp), jnp.float32),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 7,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(ops),
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=interpret,
         compiler_params=_compiler_params(),
-    )(x_cols, cost, *consts)
+    )(*ops)

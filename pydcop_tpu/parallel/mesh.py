@@ -61,6 +61,44 @@ def _try_build_packs(tensors, n_shards, assigns=None):
         return None
 
 
+def _mixed_operands(sp, mesh):
+    """Device-side mixed-arity operand blocks + their shard_map specs
+    (empty for all-binary packs).  Order matches :func:`_mixed_bundle`:
+    cost1 (sharded), am2/am3 (replicated, section-derived), then —
+    when the layout has ternary sections — cost3 + the 5 plan2 index
+    arrays (sharded)."""
+    if not getattr(sp, "mixed", False):
+        return (), []
+    shard0 = NamedSharding(mesh, P(AXIS))
+    repl = NamedSharding(mesh, P())
+    args = [
+        jax.device_put(sp.cost1_rows, shard0),
+        jax.device_put(sp.am2, repl),
+        jax.device_put(sp.am3, repl),
+    ]
+    specs = [P(AXIS), P(), P()]
+    if sp.cost3_rows is not None:
+        args.append(jax.device_put(sp.cost3_rows, shard0))
+        specs.append(P(AXIS))
+        for c in sp.consts2:
+            args.append(jax.device_put(c, shard0))
+            specs.append(P(AXIS))
+    return tuple(args), specs
+
+
+def _mixed_bundle(sp, extra):
+    """Slice the per-shard blocks of :func:`_mixed_operands` into the
+    kernels' MixedOps bundle (inside shard_map); None for all-binary."""
+    if not getattr(sp, "mixed", False):
+        return None
+    cost1, am2, am3 = extra[0][0], extra[1], extra[2]
+    cost3 = c2 = None
+    if sp.cost3_rows is not None:
+        cost3 = extra[3][0]
+        c2 = tuple(c[0] for c in extra[4:9])
+    return (cost1, cost3, am2, am3, c2)
+
+
 def build_mesh(n_devices: Optional[int] = None, axis_name: str = AXIS) -> Mesh:
     devices = jax.devices()
     n = n_devices or len(devices)
@@ -334,7 +372,7 @@ class ShardedMaxSum:
 
         if activation is not None:
             def cycle_fn(qm, rm, ru, bel_g, key_p, key, unary_p, vmask,
-                         invd, cost, c1, c2, c3, c4, c5):
+                         invd, cost, c1, c2, c3, c4, c5, *extra):
                 consts = (c1[0], c2[0], c3[0], c4[0], c5[0])
                 # the PENDING mask: cycle n's commit decision (key n)
                 # applied at the start of launch n+1, exactly where the
@@ -348,6 +386,7 @@ class ShardedMaxSum:
                 r_new, bel, q1, r1 = packed_shard_fused_ba(
                     pg, bel_g, ru[0], qm[0], rm[0], active, cost[0],
                     vmask[0], invd[0], consts, damping,
+                    mixed=_mixed_bundle(sp, extra),
                 )
                 # the ONE collective: columns align across shards
                 beliefs_p = unary_p + jax.lax.psum(bel, AXIS)
@@ -363,11 +402,12 @@ class ShardedMaxSum:
             # the committed q is recomputed inside the launch, so the
             # scan carries no dead [S, D, N] arrays (code-review r5)
             def cycle_fn(ru, bel_g, key, unary_p, vmask, invd, cost,
-                         c1, c2, c3, c4, c5):
+                         c1, c2, c3, c4, c5, *extra):
                 consts = (c1[0], c2[0], c3[0], c4[0], c5[0])
                 r_new, bel = packed_shard_fused_ba(
                     pg, bel_g, ru[0], None, None, None, cost[0],
                     vmask[0], invd[0], consts, damping,
+                    mixed=_mixed_bundle(sp, extra),
                 )
                 # the ONE collective: columns align across shards
                 beliefs_p = unary_p + jax.lax.psum(bel, AXIS)
@@ -375,6 +415,8 @@ class ShardedMaxSum:
 
             in_specs = [P(AXIS), P(), P(), P()] + [P(AXIS)] * 8
             out_specs = (P(AXIS), P())
+        extra_args, extra_specs = _mixed_operands(sp, self.mesh)
+        in_specs += extra_specs
         sharded = jax.shard_map(
             cycle_fn,
             mesh=self.mesh,
@@ -391,6 +433,7 @@ class ShardedMaxSum:
             *(jax.device_put(a, shard0) for a in (
                 sp.vmask, sp.inv_dcount, sp.cost_rows, *sp.consts,
             )),
+            *extra_args,
         )
         # run() maps packed column values back to variable order
         self._values_map = np.asarray(pg.var_order)
@@ -689,12 +732,15 @@ class ShardedLocalSearch:
         in_specs = [P(), P(), P(AXIS)]  # x, key, aux (pytree prefix)
         if sp is not None:
             # lane-packed per-shard tables (ops/pallas_sharded):
-            # cost rows + 5 plan const arrays
+            # cost rows + 5 plan const arrays (+ mixed-arity extras)
             bucket_args.extend(
                 jax.device_put(a, shard0)
                 for a in (sp.cost_rows, *sp.consts)
             )
             in_specs.extend([P(AXIS)] * 6)
+            mx_args, mx_specs = _mixed_operands(sp, self.mesh)
+            bucket_args.extend(mx_args)
+            in_specs.extend(mx_specs)
             extras = []
             n_buckets = 0
         else:
@@ -726,7 +772,10 @@ class ShardedLocalSearch:
                     jnp.zeros((1, sp.Vp), jnp.float32)
                     .at[0, vorder].set(x.astype(jnp.float32))
                 )
-                bel = packed_shard_tables(sp.pg0, x_cols, cost[0], consts)
+                bel = packed_shard_tables(
+                    sp.pg0, x_cols, cost[0], consts,
+                    mixed=_mixed_bundle(sp, rest[6:]),
+                )
                 # columns align across shards: psum in packed space,
                 # then one [V]-column gather back to variable order
                 total_p = jax.lax.psum(bel, AXIS)

@@ -35,13 +35,19 @@ import numpy as np
 from pydcop_tpu.ops.compile import FactorBucket, FactorGraphTensors
 from pydcop_tpu.ops.pallas_maxsum import (
     ForcedLayout,
+    MixedLayout,
     PackedMaxSumGraph,
     _LANES,
+    _MAX_BUCKETS,
     _MAX_SLOT_CLASS,
     _TILE,
     _class_bounds,
     _apply_bounds,
+    _merge_mixed_classes,
+    _mixed_layout,
+    _quantize_up,
     pack_for_pallas,
+    pack_mixed_for_pallas,
 )
 from pydcop_tpu.ops.pallas_permute import _plan_consts
 from pydcop_tpu.parallel.partition import partition_factors
@@ -57,6 +63,12 @@ class StackedShardPack:
     per-shard packs carry zeros so unary is counted once, after the
     psum).  The stacked arrays hold every shard's data on axis 0, ready
     for a ``P(AXIS)`` sharding.
+
+    Mixed-arity graphs (``mixed=True``) add the per-arity cost arrays
+    and the second Clos permutation's index arrays; ``am2``/``am3`` are
+    SECTION-derived (from the shared MixedLayout, not per-shard slot
+    occupancy) so they are shard-invariant — safe because cost rows are
+    zero on dummy slots and r_new is vmask-multiplied in the kernel.
     """
 
     pg0: PackedMaxSumGraph           # statics + common column map
@@ -66,6 +78,12 @@ class StackedShardPack:
     vmask: jnp.ndarray               # [S, D, N]
     inv_dcount: jnp.ndarray          # [S, 1, N]
     consts: List[jnp.ndarray]        # 5 stacked plan index arrays [S, ...]
+    mixed: bool = False
+    cost1_rows: Optional[jnp.ndarray] = None   # [S, D, N]
+    cost3_rows: Optional[jnp.ndarray] = None   # [S, D^3, N]
+    am2: Optional[jnp.ndarray] = None          # [1, N] shard-invariant
+    am3: Optional[jnp.ndarray] = None          # [1, N] shard-invariant
+    consts2: Optional[List[jnp.ndarray]] = None  # 5 stacked [S, ...]
 
     @property
     def D(self) -> int:
@@ -85,11 +103,13 @@ def build_shard_packs(
     n_shards: int,
     assigns: Optional[List[np.ndarray]] = None,
 ) -> Optional[StackedShardPack]:
-    """Pack every shard's factor subset under one ForcedLayout, or None
-    when the graph is out of scope (non-binary, per-shard degree > one
-    slot class, VMEM, Clos budget)."""
+    """Pack every shard's factor subset under one forced layout, or None
+    when the graph is out of scope (arity > 3, per-shard degree > one
+    slot class, VMEM, Clos budget).  All-binary graphs take the slot-
+    class layout below; mixed-arity (1/2/3) graphs take the MixedLayout
+    path (ROADMAP item 7, round 5)."""
     if len(tensors.buckets) != 1 or tensors.buckets[0].arity != 2:
-        return None
+        return _build_mixed_shard_packs(tensors, n_shards, assigns)
     b = tensors.buckets[0]
     F, V = b.n_factors, tensors.n_vars
     if F == 0 or tensors.max_domain_size > 8 or n_shards < 1:
@@ -176,4 +196,133 @@ def build_shard_packs(
         consts=[
             jnp.stack([cp[i] for cp in consts_per]) for i in range(5)
         ],
+    )
+
+
+def _mixed_section_masks(layout: MixedLayout):
+    """Shard-invariant arity masks from the layout's SECTION ranges
+    (slots a class reserves for an arity), not per-shard occupancy.
+    Dummy slots inside a section carry zero cost rows and zero vmask,
+    so marking them with the section's arity is harmless."""
+    am2 = np.zeros((1, layout.N), dtype=np.float32)
+    am3 = np.zeros((1, layout.N), dtype=np.float32)
+    for (cls, nvp, _voff, soff), key in zip(
+            layout.with_slots, layout.buckets_arity):
+        c1, c2, _c3 = key
+        am2[0, soff + c1 * nvp: soff + (c1 + c2) * nvp] = 1.0
+        am3[0, soff + (c1 + c2) * nvp: soff + cls * nvp] = 1.0
+    return am2, am3
+
+
+def _build_mixed_shard_packs(
+    tensors: FactorGraphTensors,
+    n_shards: int,
+    assigns: Optional[List[np.ndarray]] = None,
+) -> Optional[StackedShardPack]:
+    """Per-shard MIXED-arity (1/2/3) packs under one shared MixedLayout
+    built from each variable's MAX per-shard per-arity degree, so the
+    packed statics (D, Vp, N, buckets, both plans' shapes) are shard-
+    invariant and the psum runs on aligned [D, Vp] partials.  Hubs
+    (max-per-shard total degree > one slot class) fall back to the
+    generic sharded engine — sharding itself already splits global hubs
+    S ways, so this only excludes instances a single shard can't hold.
+    """
+    buckets = [b for b in tensors.buckets if b.n_factors > 0]
+    if not buckets or any(b.arity not in (1, 2, 3) for b in buckets):
+        return None
+    V, D = tensors.n_vars, tensors.max_domain_size
+    has3 = any(b.arity == 3 for b in buckets)
+    if D > (5 if has3 else 8):
+        return None
+    if n_shards < 1:
+        return None
+    # cheap A-budget pre-check before any per-shard layout work (the
+    # megascale guard, same rationale as the binary builder)
+    tot_slots = sum(b.arity * b.n_factors for b in buckets)
+    if tot_slots == 0 or tot_slots / n_shards > 8 * _TILE:
+        return None
+    if assigns is None:
+        assigns = partition_factors(
+            [b.var_idx for b in buckets], V, n_shards)
+
+    # per-variable MAX per-shard degree, per arity
+    deg_max = {a: np.zeros(V, dtype=np.int64) for a in (1, 2, 3)}
+    for b, asg in zip(buckets, assigns):
+        vi = np.asarray(b.var_idx)
+        asg = np.asarray(asg)
+        for s in range(n_shards):
+            e = vi[asg == s].reshape(-1)
+            deg_max[b.arity] = np.maximum(
+                deg_max[b.arity], np.bincount(e, minlength=V))
+    total_max = deg_max[1] + deg_max[2] + deg_max[3]
+    if int(total_max.max(initial=0)) > _MAX_SLOT_CLASS:
+        return None
+    keys = np.stack(
+        [_quantize_up(deg_max[a]) for a in (1, 2, 3)], axis=1)
+    rep = _merge_mixed_classes(
+        keys, np.zeros(V, dtype=np.int64), 2 * _MAX_BUCKETS, 8 * _TILE)
+    if rep is None:
+        return None
+    keys = np.array(
+        [rep[tuple(k)] for k in keys.tolist()], dtype=np.int64)
+    layout = _mixed_layout(
+        keys, np.zeros(V, dtype=bool), np.zeros(V, dtype=np.int64))
+    if layout is None:
+        return None
+
+    zero_unary = jnp.zeros_like(tensors.unary_costs)
+    packs: List[PackedMaxSumGraph] = []
+    for s in range(n_shards):
+        sub: List[FactorBucket] = []
+        for b, asg in zip(buckets, assigns):
+            idx = np.flatnonzero(np.asarray(asg) == s)
+            sub.append(FactorBucket(
+                arity=b.arity,
+                tensors=jnp.asarray(np.asarray(b.tensors)[idx]),
+                var_idx=np.asarray(b.var_idx)[idx],
+                factor_ids=np.asarray(b.factor_ids)[idx]
+                if b.factor_ids is not None else np.arange(idx.size),
+                edge_offset=0,
+            ))
+        t_s = dataclasses.replace(
+            tensors, buckets=sub, unary_costs=zero_unary)
+        pg = pack_mixed_for_pallas(t_s, layout=layout)
+        if pg is None:
+            return None
+        packs.append(pg)
+
+    pg0 = packs[0]
+    mask_np = np.asarray(pg0.mask_p)
+    unary_np = np.zeros((pg0.D, pg0.Vp), dtype=np.float32)
+    unary_np[:, layout.var_pcol] = (
+        np.asarray(tensors.unary_costs).T * mask_np[:, layout.var_pcol]
+    )
+    am2, am3 = _mixed_section_masks(layout)
+    consts_per = [_plan_consts(pg.plan) for pg in packs]
+    consts2_per = (
+        [_plan_consts(pg.plan2) for pg in packs]
+        if pg0.plan2 is not None else None
+    )
+    return StackedShardPack(
+        pg0=pg0,
+        n_shards=n_shards,
+        unary_p=jnp.asarray(unary_np),
+        cost_rows=jnp.stack([pg.cost_rows for pg in packs]),
+        vmask=jnp.stack([pg.vmask for pg in packs]),
+        inv_dcount=jnp.stack([pg.inv_dcount for pg in packs]),
+        consts=[
+            jnp.stack([cp[i] for cp in consts_per]) for i in range(5)
+        ],
+        mixed=True,
+        cost1_rows=jnp.stack([pg.cost1_rows for pg in packs]),
+        cost3_rows=(
+            jnp.stack([pg.cost3_rows for pg in packs])
+            if pg0.cost3_rows is not None else None
+        ),
+        am2=jnp.asarray(am2),
+        am3=jnp.asarray(am3),
+        consts2=(
+            [jnp.stack([cp[i] for cp in consts2_per]) for i in range(5)]
+            if consts2_per is not None else None
+        ),
     )

@@ -48,12 +48,28 @@ class TestBuildShardPacks:
         total_real = int(np.asarray(sp.vmask)[:, 0, :].sum())
         assert total_real == 2 * t.n_factors
 
-    def test_rejects_nonbinary(self):
+    def test_mixed_arity_packs(self):
+        """ROADMAP item 7 (round 5): SECP-class mixed (1/2/3) graphs
+        build per-shard packs under one shared MixedLayout."""
         from pydcop_tpu.generators.secp import generate_secp
 
         dcop = generate_secp(n_lights=8, n_models=3, n_rules=2,
                              max_model_size=2, seed=1)
         t = compile_factor_graph(dcop)
+        sp = build_shard_packs(t, 4)
+        assert sp is not None and sp.mixed
+        assert sp.cost1_rows.shape[0] == 4
+        # section-derived arity masks are shard-invariant singles
+        assert sp.am2.shape == (1, sp.N)
+
+    def test_mixed_rejects_high_arity(self):
+        """Arity > 3 still falls back to the generic sharded engine."""
+        from pydcop_tpu.generators.secp import generate_secp
+
+        dcop = generate_secp(n_lights=10, n_models=3, n_rules=2,
+                             max_model_size=3, seed=1)
+        t = compile_factor_graph(dcop)
+        assert any(b.arity > 3 for b in t.buckets)
         assert build_shard_packs(t, 4) is None
 
     def test_rejects_megascale_cheaply(self):
@@ -163,6 +179,62 @@ class TestPackedShardedMaxSum:
                                 use_packed=False)
         vg, _, _ = generic.run(cycles=8)
         np.testing.assert_array_equal(vp, vg)
+
+
+def _secp_instance(seed=3, **kw):
+    from pydcop_tpu.generators.secp import generate_secp
+
+    kw.setdefault("n_lights", 30)
+    kw.setdefault("n_models", 10)
+    kw.setdefault("n_rules", 6)
+    kw.setdefault("max_model_size", 2)
+    return generate_secp(seed=seed, **kw)
+
+
+class TestMixedPackedSharded:
+    """ROADMAP item 7 (round 5): the mixed-arity (1/2/3) family rides
+    the lane-packed per-shard kernels, bit-matching the generic sharded
+    engine."""
+
+    def test_maxsum_matches_generic(self):
+        t = compile_factor_graph(_secp_instance())
+        mesh = build_mesh(4)
+        packed = ShardedMaxSum(t, mesh, damping=0.5, use_packed=True)
+        assert packed.packs is not None and packed.packs.mixed
+        vp, _, _ = packed.run(cycles=8)
+        generic = ShardedMaxSum(t, mesh, damping=0.5, use_packed=False)
+        vg, _, _ = generic.run(cycles=8)
+        np.testing.assert_array_equal(vp, vg)
+
+    def test_sparse_ternary_shards_and_chunking(self):
+        """Shards with NO ternary factors keep the shard-invariant
+        traced structure (zero cost3 rows, identity plan2), and the
+        rotated-launch state round-trips across chunks."""
+        t = compile_factor_graph(_secp_instance(
+            seed=5, n_lights=40, n_models=4, n_rules=2))
+        assert any(b.arity == 3 for b in t.buckets)
+        mesh = build_mesh(8)
+        packed = ShardedMaxSum(t, mesh, damping=0.5, use_packed=True)
+        assert packed.packs is not None
+        v1, q1, r1 = packed.run(cycles=4)
+        v2, _, _ = packed.run(cycles=4, q=q1, r=r1)
+        generic = ShardedMaxSum(t, mesh, damping=0.5, use_packed=False)
+        vg, _, _ = generic.run(cycles=8)
+        np.testing.assert_array_equal(v2, vg)
+
+    @pytest.mark.parametrize("rule", ["mgm", "dsa", "adsa"])
+    def test_local_search_matches_generic(self, rule):
+        from pydcop_tpu.ops.compile import compile_constraint_graph
+
+        t = compile_constraint_graph(_secp_instance(seed=4))
+        mesh = build_mesh(4)
+        packed = ShardedLocalSearch(t, mesh, rule=rule, use_packed=True)
+        assert packed.packs is not None and packed.packs.mixed
+        generic = ShardedLocalSearch(t, mesh, rule=rule,
+                                     use_packed=False)
+        np.testing.assert_array_equal(
+            packed.run(cycles=8, seed=3), generic.run(cycles=8, seed=3)
+        )
 
 
 class TestPackedShardedLocalSearch:
