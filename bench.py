@@ -627,6 +627,40 @@ def bench_mixed_arity(args):
     except Exception as e:  # never lose the single-chip rates
         out["sharded_packed_secp_error"] = repr(e)
 
+    # arity-4 SECP (3-light models — round 5, the last packed-path
+    # capability gap): the quaternary packing with its third Clos
+    # permutation and narrow 8-row-aligned D^3-block slabs
+    try:
+        dcop4 = generate_secp(n_lights=3000, n_models=900, n_rules=300,
+                              max_model_size=3, seed=1)
+        t4 = compile_factor_graph(dcop4)
+        p4 = try_pack_for_pallas(t4)
+        out["secp4_packed"] = bool(
+            p4 is not None and p4.cost4_rows is not None)
+        if p4 is not None and jax.default_backend() == "tpu":
+            @jax.jit
+            def run4(q, r):
+                def body(carry, _):
+                    q, r = carry
+                    q2, r2, _, _ = packed_cycles(p4, q, r, chunk,
+                                                 damping=0.5)
+                    return (q2, r2), ()
+
+                (q, r), _ = jax.lax.scan(
+                    body, (q, r), None, length=args.cycles // chunk)
+                return q, r
+
+            q40, r40 = packed_init_state(p4)
+            jax.block_until_ready(run4(q40, r40))
+            out["maxsum_iters_per_sec_secp4_arity4"] = round(
+                measure_rate(
+                    lambda: jax.block_until_ready(run4(q40, r40)),
+                    args.cycles // chunk * chunk, args.repeat), 1)
+            out["mgm_cycles_per_sec_secp4"] = round(
+                bench_local_search(dcop4, "mgm", repeat=args.repeat), 1)
+    except Exception as e:
+        out["secp4_error"] = repr(e)
+
     # PEAV meeting scheduling: unary preference factors + binary
     # equality/overlap factors → the mixed packer (slots_count 7 keeps
     # the value domain within the engine's D <= 8)

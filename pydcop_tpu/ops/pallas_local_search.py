@@ -98,10 +98,12 @@ class PackedLocalSearch:
     # all-binary packings; excludes unary slots on mixed packings).  Gains
     # routed onto masked slots are zeroed before the neighborhood max.
     gmask1: jnp.ndarray = None
-    # mixed+ternary packings only: the SECOND sibling's index per slot
-    # (routed by pg.plan2), BIG off ternary slots; its gain mask is
-    # pg.arity_mask3
+    # mixed+ternary/quaternary packings only: the SECOND sibling's
+    # index per slot (routed by pg.plan2), BIG off arity ≥ 3 slots; its
+    # gain mask is am3 + am4.  mate3 likewise for the THIRD sibling
+    # (plan3, quaternary slots, mask am4).
     mate2_idx: Optional[jnp.ndarray] = None
+    mate3_idx: Optional[jnp.ndarray] = None
 
     @property
     def n_vars(self) -> int:
@@ -114,7 +116,7 @@ class PackedLocalSearch:
 
 def pack_local_search(tensors) -> Optional[PackedLocalSearch]:
     """Compile the packed local-search layout, or None when the graph is
-    not packable (arity > 3, hub overflow, VMEM) — callers fall back to
+    not packable (arity > 4, hub overflow, VMEM) — callers fall back to
     the generic engine."""
     return pack_from_pg(try_pack_for_pallas(tensors))
 
@@ -126,9 +128,10 @@ def pack_from_pg(pg: Optional[PackedMaxSumGraph]
     kernel upgrade lazily, without re-packing).
 
     Handles both layouts: all-binary packings get the per-other-value
-    cost slabs; mixed-arity (1/2/3) packings reuse the packed graph's
-    own cost arrays (cost_rows/cost1/cost3 + arity masks) and carry a
-    second mate-index array for the ternary siblings."""
+    cost slabs; mixed-arity (1/2/3/4) packings reuse the packed graph's
+    own cost arrays (cost_rows/cost1/cost3/cost4 + arity masks) and
+    carry second/third mate-index arrays for the ternary/quaternary
+    siblings."""
     if pg is None or pg.D < 2:
         return None
     Vp, N = pg.Vp, pg.N
@@ -146,8 +149,13 @@ def pack_from_pg(pg: Optional[PackedMaxSumGraph]
         # mixed kernels slice pg.cost_rows/cost1/cost3 in-kernel (the
         # layout packed_local_tables already proves on hardware)
         slabs = ()
+        am4 = (
+            np.asarray(pg.arity_mask4)
+            if pg.arity_mask4 is not None else 0.0
+        )
         gmask1 = np.clip(
-            np.asarray(pg.arity_mask2) + np.asarray(pg.arity_mask3),
+            np.asarray(pg.arity_mask2) + np.asarray(pg.arity_mask3)
+            + am4,
             0.0, 1.0,
         ).astype(np.float32)
         gmask1_j = jnp.asarray(gmask1)
@@ -174,12 +182,22 @@ def pack_from_pg(pg: Optional[PackedMaxSumGraph]
                 col_idx[0, voff: voff + nvp]
     mate = pg.plan.apply_numpy(own_idx_slots)
     mate = np.where(gmask1 > 0, mate, _BIG_IDX).astype(np.float32)
-    mate2 = None
+    mate2 = mate3 = None
     if pg.mixed and pg.plan2 is not None:
+        am3 = np.asarray(pg.arity_mask3)
+        am4 = (
+            np.asarray(pg.arity_mask4)
+            if pg.arity_mask4 is not None else np.zeros_like(am3)
+        )
         m2 = pg.plan2.apply_numpy(own_idx_slots)
         mate2 = jnp.asarray(np.where(
-            np.asarray(pg.arity_mask3) > 0, m2, _BIG_IDX
+            am3 + am4 > 0, m2, _BIG_IDX
         ).astype(np.float32))
+        if pg.plan3 is not None:
+            m3 = pg.plan3.apply_numpy(own_idx_slots)
+            mate3 = jnp.asarray(np.where(
+                am4 > 0, m3, _BIG_IDX
+            ).astype(np.float32))
     return PackedLocalSearch(
         pg=pg,
         idx_row=jnp.asarray(idx_np),
@@ -189,6 +207,7 @@ def pack_from_pg(pg: Optional[PackedMaxSumGraph]
         mate_idx=jnp.asarray(mate),
         gmask1=gmask1_j,
         mate2_idx=mate2,
+        mate3_idx=mate3,
     )
 
 
@@ -262,9 +281,9 @@ def _local_tables_body(pg: PackedMaxSumGraph, x_row, slabs, unary, mask_p,
     PAD_COST at invalid (d, v) slots.  One values permute (two on
     ternary graphs).  All-binary layout: ``slabs`` are the D
     per-other-value cost planes [D, N] (see PackedLocalSearch).  Mixed
-    layout: ``cost`` is the full [D*D, N] binary array and ``mixed`` the
-    parsed (cost1, cost3, consts2, am2, am3) refs — per-slot rows are
-    assembled by pallas_maxsum._mixed_contrib, exactly as the
+    layout: ``cost`` is the full [D*D, N] binary array and ``mixed``
+    the parsed 8-tuple of pallas_maxsum._parse_mixed_refs — per-slot
+    rows are assembled by pallas_maxsum._mixed_contrib, exactly as the
     packed_local_tables kernel does."""
     D = pg.D
     # hub members carry the hub's value for their slots
@@ -306,7 +325,8 @@ def _cur_best_gain(pg: PackedMaxSumGraph, tables, x_row, prefer_change):
 
 
 def _mgm_move(pls: PackedLocalSearch, gain, idx_row, mate_idx, gmask1,
-              consts, hub=None, mate2=None, gmask2=None, consts2=None):
+              consts, hub=None, mate2=None, gmask2=None, consts2=None,
+              mate3=None, gmask3=None, consts3=None):
     """MGM neighborhood arbitration (neighborhood_winner semantics):
     True [1, Vp] where own gain is the strict neighborhood max, lexic
     tie-break by original variable index.  One gains permute (a second
@@ -319,10 +339,14 @@ def _mgm_move(pls: PackedLocalSearch, gain, idx_row, mate_idx, gmask1,
     # hub member slots must send the hub's gain to their neighbors
     gs = _bucket_expand(pg, _hub_spread(pg, gain, 1, hub), 1)
     gn = _permute1(pg, gs, consts) * gmask1
-    gn2 = None
+    gn2 = gn3 = None
     if mate2 is not None:
         gn2 = _permute_in_kernel(gs, pg.plan2, 1, consts2) * gmask2
+    if mate3 is not None:
+        gn3 = _permute_in_kernel(gs, pg.plan3, 1, consts3) * gmask3
     gboth = gn if gn2 is None else jnp.maximum(gn, gn2)
+    if gn3 is not None:
+        gboth = jnp.maximum(gboth, gn3)
     # hub combine: a hub's neighborhood max/tie-break spans ALL its
     # sub-columns' slots
     neigh_max = jnp.maximum(
@@ -336,6 +360,10 @@ def _mgm_move(pls: PackedLocalSearch, gain, idx_row, mate_idx, gmask1,
     if gn2 is not None:
         idx_cand = jnp.minimum(
             idx_cand, jnp.where(gn2 >= nm_exp - 1e-9, mate2, _BIG_IDX)
+        )
+    if gn3 is not None:
+        idx_cand = jnp.minimum(
+            idx_cand, jnp.where(gn3 >= nm_exp - 1e-9, mate3, _BIG_IDX)
         )
     # fill=_BIG_IDX: degree-0 variables have no neighbor at max, so the
     # lexic tie-break must let them through (generic: idx_at_max = V)
@@ -374,6 +402,7 @@ def packed_mgm_cycles(
     Vp = pg.Vp
     mixed = pg.mixed
     has_m2 = pls.mate2_idx is not None
+    has_m3 = pls.mate3_idx is not None
 
     hub_ops = _hub_operands(pg)
     cost_ops = ((pg.cost_rows,) + _mixed_operands(pg)) if mixed \
@@ -385,6 +414,10 @@ def packed_mgm_cycles(
             mate2, rest = rest[0][:], rest[1:]
         else:
             mate2 = None
+        if has_m3:
+            mate3, rest = rest[0][:], rest[1:]
+        else:
+            mate3 = None
         if hub_ops:
             hub = (rest[0][:], rest[1][:], rest[2][:])
             rest = rest[3:]
@@ -396,8 +429,15 @@ def packed_mgm_cycles(
             slabs = None
             consts2 = mixed_refs[2]
             gmask2 = mixed_refs[4]  # am3: gain mask of the 2nd sibling
+            consts3 = mixed_refs[6]
+            gmask3 = mixed_refs[7]  # am4: gain mask of the 3rd sibling
+            if gmask3 is not None:
+                # quaternary slots route a second sibling too (masks
+                # are disjoint, so plain add is already 0/1)
+                gmask2 = gmask2 + gmask3
         else:
             cost = mixed_refs = consts2 = gmask2 = None
+            consts3 = gmask3 = None
             slabs = [ref[:] for ref in rest[:-1]]
             rest = rest[-1:]
         (x_out,) = rest
@@ -416,7 +456,8 @@ def packed_mgm_cycles(
             _cur, best_idx, gain = _cur_best_gain(pg, tables, x, False)
             move = _mgm_move(pls, gain, idx_row, mate_idx, g1, consts,
                              hub=hub, mate2=mate2, gmask2=gmask2,
-                             consts2=consts2)
+                             consts2=consts2, mate3=mate3,
+                             gmask3=gmask3, consts3=consts3)
             x = jnp.where(move & (colm > 0), best_idx, x)
         x_out[:] = x
 
@@ -424,6 +465,8 @@ def packed_mgm_cycles(
                 pls.colmask, pls.gmask1, *_plan_consts(pg.plan)]
     if has_m2:
         operands.append(pls.mate2_idx)
+    if has_m3:
+        operands.append(pls.mate3_idx)
     operands.extend(hub_ops)
     operands.extend(cost_ops)
     return pl.pallas_call(

@@ -56,6 +56,9 @@ _TILE = _LANES * _LANES  # elements routed per (b, l) plane
 # measured scoped allocation (16.3MB actual at 11.7MB estimated), so
 # 40MB estimated ≈ 56MB actual — comfortable headroom under _VMEM_LIMIT.
 _VMEM_BUDGET = 40 * 2**20
+#: sublane stride of quaternary (j, k, m) cost blocks — a full 8-row
+#: tile per block so in-kernel slices are sublane-aligned (D ≤ 5)
+_Q4_STRIDE = 8
 _VMEM_LIMIT = 100 * 2**20
 
 
@@ -150,15 +153,28 @@ class PackedMaxSumGraph:
     slot_of_edge: np.ndarray = None
     # -- mixed arity (pack_mixed_for_pallas) ------------------------------
     # Each bucket's slots are grouped by arity: k in [0, c1) unary
-    # factors, [c1, c1+c2) binary, [c1+c2, cls) ternary; plan routes the
-    # first sibling, plan2 the second (identity elsewhere).
+    # factors, [c1, c1+c2) binary, [c1+c2, c1+c2+c3) ternary,
+    # [c1+c2+c3, cls) quaternary; plan routes the first sibling, plan2
+    # the second, plan3 the third (identity elsewhere).
     mixed: bool = False
-    buckets_arity: Tuple[Tuple[int, int, int], ...] = ()  # (c1, c2, c3)
+    buckets_arity: Tuple[Tuple[int, ...], ...] = ()  # (c1, c2, c3, c4)
     plan2: Optional[PermutationPlan] = None
     cost1_rows: Optional[jnp.ndarray] = None  # [D, N]
     cost3_rows: Optional[jnp.ndarray] = None  # [D*D*D, N] row (j*D+k)*D+i
     arity_mask2: Optional[jnp.ndarray] = None  # [1, N] 1 on binary slots
     arity_mask3: Optional[jnp.ndarray] = None  # [1, N] 1 on ternary slots
+    # -- arity 4 (round 5): present only when the graph has quaternary
+    # factors; cost row ((j*D+k)*D+l)*D+i for siblings (j, k, l) routed
+    # by (plan, plan2, plan3).  The D^4-row slab would be ~41MB at full
+    # width even for a tiny graph (N ≥ one 16384-lane tile), so it is
+    # stored NARROW — only the 4-ary section lanes, which are 128-
+    # aligned ranges (q4_sections) gathered/spread in-kernel with the
+    # same static lane slicing as the bucket reduce.
+    plan3: Optional[PermutationPlan] = None
+    cost4_rows: Optional[jnp.ndarray] = None  # [D^3*8, M4] (narrow,
+    #                                            8-row-aligned blocks)
+    arity_mask4: Optional[jnp.ndarray] = None  # [1, N] 1 on 4-ary slots
+    q4_sections: Tuple[Tuple[int, int], ...] = ()  # (start, width) lanes
     # -- hub splitting (variables with degree > _MAX_SLOT_CLASS) ----------
     # A hub's slots are split across m contiguous sub-columns inside a
     # normal degree-class bucket; its full belief/table is recovered with
@@ -213,7 +229,7 @@ def try_pack_for_pallas(t: FactorGraphTensors) -> Optional[PackedMaxSumGraph]:
     engine on TPU would otherwise crash every solve on the target hardware.
 
     All-binary graphs take the binary packer (hub splitting, DP classes);
-    mixed arity-1/2/3 graphs the mixed packer."""
+    mixed arity-1/2/3/4 graphs the mixed packer."""
     try:
         pg = pack_for_pallas(t)
         if pg is None:
@@ -594,13 +610,13 @@ class MixedLayout:
     :func:`pack_mixed_for_pallas` call so the packed statics (D, Vp, N,
     buckets, plan shapes) are shard-invariant (SPMD single trace)."""
 
-    keys: np.ndarray                     # [V, 3] post-merge triples
+    keys: np.ndarray                     # [V, 4] post-merge tuples
     hub_of: np.ndarray                   # [V] bool
     hub_m: np.ndarray                    # [V] sub-columns per hub
     var_pcol: np.ndarray                 # [V] head column
     col_var: np.ndarray                  # [Vp] var per column (-1 dummy)
     with_slots: List[Tuple[int, int, int, int]]
-    buckets_arity: List[Tuple[int, int, int]]
+    buckets_arity: List[Tuple[int, ...]]      # (c1, c2, c3, c4)
     group_heads: List[Tuple[int, int]]
     max_m: int
     Vp: int
@@ -624,7 +640,7 @@ def _mixed_layout(keys: np.ndarray, hub_of: np.ndarray,
     hub_vars = np.flatnonzero(hub_of)
 
     buckets: List[Tuple[int, int, int, int]] = []
-    buckets_arity: List[Tuple[int, int, int]] = []
+    buckets_arity: List[Tuple[int, ...]] = []  # (c1, c2, c3, c4)
     var_pcol = np.full(V, -1, dtype=np.int64)
     col_var_parts: List[np.ndarray] = []
     group_heads: List[Tuple[int, int]] = []
@@ -686,7 +702,7 @@ def _mixed_layout(keys: np.ndarray, hub_of: np.ndarray,
     col_soff = np.zeros(Vp, dtype=np.int64)
     col_nvp = np.ones(Vp, dtype=np.int64)
     col_voff = np.zeros(Vp, dtype=np.int64)
-    col_base = {a: np.zeros(Vp, dtype=np.int64) for a in (1, 2, 3)}
+    col_base = {a: np.zeros(Vp, dtype=np.int64) for a in (1, 2, 3, 4)}
     for (cls, nvp, bvoff, bsoff), key in zip(with_slots, buckets_arity):
         sl = slice(bvoff, bvoff + nvp)
         col_soff[sl] = bsoff
@@ -695,6 +711,7 @@ def _mixed_layout(keys: np.ndarray, hub_of: np.ndarray,
         col_base[1][sl] = 0
         col_base[2][sl] = key[0]
         col_base[3][sl] = key[0] + key[1]
+        col_base[4][sl] = key[0] + key[1] + key[2]
     return MixedLayout(
         keys=keys, hub_of=hub_of, hub_m=hub_m, var_pcol=var_pcol,
         col_var=col_var, with_slots=with_slots,
@@ -707,12 +724,13 @@ def _mixed_layout(keys: np.ndarray, hub_of: np.ndarray,
 def pack_mixed_for_pallas(t: FactorGraphTensors,
                           layout: Optional[MixedLayout] = None,
                           ) -> Optional[PackedMaxSumGraph]:
-    """Compile a MIXED-arity (1/2/3) graph into the lane-packed layout
-    (ROADMAP §2a / VERDICT r4 item 7 — SECP model factors, n-ary rule
-    tables).  Column classes are exact per-arity slot-count triples
-    (c1, c2, c3); each bucket's slots are grouped by arity so the kernel
-    applies the right update on aligned lane ranges; the third endpoint
-    of ternary factors rides a SECOND Clos permutation.
+    """Compile a MIXED-arity (1/2/3/4) graph into the lane-packed
+    layout (ROADMAP §2a / VERDICT r4 item 7 — SECP model factors, n-ary
+    rule tables).  Column classes are exact per-arity slot-count tuples
+    (c1, c2, c3, c4); each bucket's slots are grouped by arity so the
+    kernel applies the right update on aligned lane ranges; the third
+    endpoint of ternary factors rides a SECOND Clos permutation, the
+    fourth endpoint of quaternary factors a THIRD.
 
     Hubs (total degree > _MAX_SLOT_CLASS — VERDICT r4 item 4): a hub is
     split into m = ceil(deg/96) sub-columns, each holding the quantized
@@ -728,19 +746,32 @@ def pack_mixed_for_pallas(t: FactorGraphTensors,
     (identity routing, zero rows) so the traced structure stays
     invariant across shards.
 
-    Returns None out of scope: arity > 3, D > 5 (the ternary slab array
-    is D^3 rows), a hub beyond _MAX_SLOT_CLASS*128 total edges, too
-    many distinct classes, edges that don't fit a forced layout, or
-    VMEM.
+    Arity-4 factors (SECP models with 3 lights — VERDICT r4's last
+    capability gap) ride a THIRD Clos permutation; their D^3-block cost
+    slabs are stored NARROW (quaternary section lanes only, 8-row-
+    aligned blocks) because a full-width D^4-row array would be ~41MB
+    even for a tiny graph.
+
+    Returns None out of scope: arity > 4, D > 5 (the ternary/quaternary
+    slab arrays grow as D^3/D^4), a hub beyond _MAX_SLOT_CLASS*128
+    total edges, too many distinct classes, edges that don't fit a
+    forced layout, or VMEM.
     """
     by_arity = {b.arity: b for b in t.buckets if b.n_factors > 0}
     if layout is None:
         if not by_arity:
             return None
-    if any(a not in (1, 2, 3) for a in by_arity):
+    if any(a not in (1, 2, 3, 4) for a in by_arity):
         return None
     V, D = t.n_vars, t.max_domain_size
-    has3 = 3 in by_arity or (
+    has4 = 4 in by_arity or (
+        layout is not None and bool((layout.keys[:, 3] > 0).any())
+    )
+    # quaternary presence forces the ternary structures too (zero rows
+    # when no ternary factors exist): plan2 routes the second sibling
+    # for BOTH arities, and keeping cost3/am3 alongside keeps the
+    # operand contract (_mixed_operands) a simple chain of presences
+    has3 = has4 or 3 in by_arity or (
         layout is not None and bool((layout.keys[:, 2] > 0).any())
     )
     if has3 and D > 5:
@@ -779,8 +810,8 @@ def pack_mixed_for_pallas(t: FactorGraphTensors,
         share = np.maximum(hub_m, 1)
         keys = np.stack([
             _quantize_up(-(-deg_a.get(a, zero) // share))  # ceil(deg/m)
-            for a in (1, 2, 3)
-        ], axis=1)  # [V, 3]
+            for a in (1, 2, 3, 4)
+        ], axis=1)  # [V, 4]
         # merge fragmented classes until both the class count and the
         # Clos A ≤ 8 slot budget fit (power-law degree tails with
         # ternary presence fork a fresh 128-column block per triple
@@ -798,7 +829,7 @@ def pack_mixed_for_pallas(t: FactorGraphTensors,
         # defensive: this subgraph's per-arity degrees must fit the
         # forced per-arity shares
         share = np.maximum(layout.hub_m, 1)
-        for a in (1, 2, 3):
+        for a in (1, 2, 3, 4):
             if (-(-deg_a.get(a, zero) // share)
                     > layout.keys[:, a - 1]).any():
                 return None
@@ -833,10 +864,12 @@ def pack_mixed_for_pallas(t: FactorGraphTensors,
         slot_of[a] = col_soff[col] + k * col_nvp[col] + (
             col - col_voff[col])
 
-    # two routing permutations: plan = first sibling, plan2 = second
+    # routing permutations: plan = first sibling, plan2 = second,
+    # plan3 = third (quaternary factors only)
     A = N // _TILE
     perm1 = np.arange(N, dtype=np.int64)
     perm2 = np.arange(N, dtype=np.int64)
+    perm3 = np.arange(N, dtype=np.int64)
     if 2 in by_arity:
         F2 = by_arity[2].n_factors
         s2 = slot_of[2]
@@ -851,11 +884,20 @@ def pack_mixed_for_pallas(t: FactorGraphTensors,
             sib2 = ((p + 2) % 3)
             perm1[mine] = s3[sib1 * F3: (sib1 + 1) * F3]
             perm2[mine] = s3[sib2 * F3: (sib2 + 1) * F3]
+    if 4 in by_arity:
+        F4 = by_arity[4].n_factors
+        s4 = slot_of[4]
+        for p in range(4):
+            mine = s4[p * F4: (p + 1) * F4]
+            for step, perm in enumerate((perm1, perm2, perm3), start=1):
+                sib = (p + step) % 4
+                perm[mine] = s4[sib * F4: (sib + 1) * F4]
     plan = plan_permutation(perm1, A, _LANES, _LANES)
     # has3 (not `3 in by_arity`): a forced layout with ternary sections
     # keeps plan2 (identity here) even when THIS subgraph has no ternary
     # factors, so the traced structure is shard-invariant
     plan2 = plan_permutation(perm2, A, _LANES, _LANES) if has3 else None
+    plan3 = plan_permutation(perm3, A, _LANES, _LANES) if has4 else None
 
     # cost arrays per arity
     cost1 = np.zeros((D, N), dtype=np.float32)
@@ -888,6 +930,41 @@ def pack_mixed_for_pallas(t: FactorGraphTensors,
                 for j in range(D):
                     for k in range(D):
                         cost3[(j * D + k) * D + i, mine] = Tp[:, i, j, k]
+    cost4 = None
+    q4_sections: List[Tuple[int, int]] = []
+    if has4:
+        # 128-aligned lane ranges of the quaternary sections, and the
+        # narrow (section-concatenated) column of each full-width slot
+        narrow_of = np.full(N, -1, dtype=np.int64)
+        pos = 0
+        for (cls, nvp, _bv, soff), key in zip(with_slots, buckets_arity):
+            c123 = key[0] + key[1] + key[2]
+            if cls > c123:
+                st, w = soff + c123 * nvp, (cls - c123) * nvp
+                q4_sections.append((int(st), int(w)))
+                narrow_of[st: st + w] = pos + np.arange(w)
+                pos += w
+        # each (j, k, m) block is padded to a full 8-row sublane tile
+        # so every in-kernel slice starts at sublane offset 0 — Mosaic
+        # rejects concatenating pieces with mismatched non-concat-dim
+        # offsets (measured on v5e via _spread_q4), and D ≤ 5 here
+        cost4 = np.zeros((D ** 3 * _Q4_STRIDE, max(pos, _LANES)),
+                         dtype=np.float32)
+    if 4 in by_arity:
+        b4 = by_arity[4]
+        F4 = b4.n_factors
+        T4 = np.asarray(b4.tensors)  # [F4, D, D, D, D]
+        for p in range(4):
+            mine = narrow_of[slot_of[4][p * F4: (p + 1) * F4]]
+            axes = (0, 1 + p, 1 + (p + 1) % 4, 1 + (p + 2) % 4,
+                    1 + (p + 3) % 4)
+            Tp = np.transpose(T4, axes)  # [F4, i, j, k, l]
+            for i in range(D):
+                for j in range(D):
+                    for k in range(D):
+                        for m in range(D):
+                            row = ((j * D + k) * D + m) * _Q4_STRIDE + i
+                            cost4[row, mine] = Tp[:, i, j, k, m]
 
     mask_np = np.zeros((D, Vp), dtype=np.float32)
     unary_np = np.zeros((D, Vp), dtype=np.float32)
@@ -904,10 +981,13 @@ def pack_mixed_for_pallas(t: FactorGraphTensors,
 
     am2 = np.zeros((1, N), dtype=np.float32)
     am3 = np.zeros((1, N), dtype=np.float32)
+    am4 = np.zeros((1, N), dtype=np.float32) if has4 else None
     if 2 in slot_of:
         am2[0, slot_of[2]] = 1.0
     if 3 in slot_of:
         am3[0, slot_of[3]] = 1.0
+    if 4 in slot_of:
+        am4[0, slot_of[4]] = 1.0
 
     nsteps, steps_idx, steps_mask, head_idx = _hub_constants(
         group_heads, Vp, max_m
@@ -930,6 +1010,10 @@ def pack_mixed_for_pallas(t: FactorGraphTensors,
         cost3_rows=jnp.asarray(cost3) if cost3 is not None else None,
         arity_mask2=jnp.asarray(am2),
         arity_mask3=jnp.asarray(am3),
+        plan3=plan3,
+        cost4_rows=jnp.asarray(cost4) if cost4 is not None else None,
+        arity_mask4=jnp.asarray(am4) if am4 is not None else None,
+        q4_sections=tuple(q4_sections),
         hub_nsteps=nsteps,
         hub_steps_idx=steps_idx,
         hub_steps_mask=steps_mask,
@@ -938,9 +1022,13 @@ def pack_mixed_for_pallas(t: FactorGraphTensors,
     # extra working set over the binary estimate: the ternary slab
     # array (D^3 rows), the unary rows, the two arity masks, plan2's 5
     # index arrays, and ~2 [D, N] temporaries of the second permutation
+    # (same again, one power of D bigger, for the quaternary slabs)
     extra = D * N + 2 * N
     if cost3 is not None:
         extra += D * D * D * N + 5 * N + 2 * D * N
+    if cost4 is not None:
+        M4 = cost4.shape[1]
+        extra += D ** 3 * _Q4_STRIDE * M4 + 6 * N + 2 * D * N + 3 * D * M4
     if 4 * extra + pg.vmem_bytes > _VMEM_BUDGET:
         return None
     return pg
@@ -960,20 +1048,28 @@ def _hub_operands(pg: PackedMaxSumGraph) -> Tuple[jnp.ndarray, ...]:
 
 def _mixed_operands(pg: PackedMaxSumGraph) -> Tuple[jnp.ndarray, ...]:
     """Extra kernel operands for mixed-arity graphs: the unary cost
-    rows, then (arity-3 graphs only) the ternary slab array and the
-    second permutation's 5 index arrays."""
+    rows, then (arity ≥ 3 only) the ternary slab array and the second
+    permutation's 5 index arrays, then (arity-4 only) the quaternary
+    slab array, the third permutation's 5 index arrays and the 4-ary
+    mask.  THE operand-order contract — every kernel parses it back
+    with :func:`_parse_mixed_refs`."""
     if not pg.mixed:
         return ()
     ops = [pg.cost1_rows, pg.arity_mask2, pg.arity_mask3]
     if pg.cost3_rows is not None:
         ops.append(pg.cost3_rows)
         ops.extend(_plan_consts(pg.plan2))
+    if pg.cost4_rows is not None:
+        ops.append(pg.cost4_rows)
+        ops.extend(_plan_consts(pg.plan3))
+        ops.append(pg.arity_mask4)
     return tuple(ops)
 
 
 def _parse_mixed_refs(pg: PackedMaxSumGraph, rest):
     """(mixed_ops, remaining rest) from kernel ref list — inverse of
-    :func:`_mixed_operands`."""
+    :func:`_mixed_operands`.  The bundle appends quaternary entries
+    AFTER the original 5, so positional reads of [0..4] stay valid."""
     if not pg.mixed:
         return None, rest
     cost1, am2, am3 = rest[0][:], rest[1][:], rest[2][:]
@@ -983,7 +1079,13 @@ def _parse_mixed_refs(pg: PackedMaxSumGraph, rest):
         cost3 = rest[0][:]
         consts2 = tuple(r[:] for r in rest[1: 6])
         rest = rest[6:]
-    return (cost1, cost3, consts2, am2, am3), rest
+    cost4 = consts3 = am4 = None
+    if pg.cost4_rows is not None:
+        cost4 = rest[0][:]
+        consts3 = tuple(r[:] for r in rest[1: 6])
+        am4 = rest[6][:]
+        rest = rest[7:]
+    return (cost1, cost3, consts2, am2, am3, cost4, consts3, am4), rest
 
 
 def _hub_gather(arr, idx, R: int, rows: int):
@@ -1049,13 +1151,44 @@ def packed_init_state(pg: PackedMaxSumGraph
     return z, z
 
 
+def _gather_q4(pg: PackedMaxSumGraph, arr):
+    """[R, N] → [R, M4]: concatenate the (128-aligned) quaternary
+    section lane ranges — static slicing, same pattern as the bucket
+    reduce."""
+    parts = [arr[:, st: st + w] for st, w in pg.q4_sections]
+    out = jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+    M4 = pg.cost4_rows.shape[1]
+    if out.shape[1] < M4:  # packer pads M4 up to one lane tile
+        out = jnp.concatenate(
+            [out, jnp.zeros((arr.shape[0], M4 - out.shape[1]),
+                            out.dtype)], axis=1)
+    return out
+
+
+def _spread_q4(pg: PackedMaxSumGraph, narrow, R: int):
+    """[R, M4] → [R, N]: place each quaternary section's block back at
+    its full-width lane range, zeros elsewhere."""
+    parts = []
+    at = 0
+    pos = 0
+    for st, w in pg.q4_sections:
+        if at < st:
+            parts.append(jnp.zeros((R, st - at), narrow.dtype))
+        parts.append(narrow[:, pos: pos + w])
+        pos += w
+        at = st + w
+    if at < pg.N:
+        parts.append(jnp.zeros((R, pg.N - at), narrow.dtype))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+
+
 def _mixed_contrib(pg: PackedMaxSumGraph, xo1, xo2, cost, cost1, cost3,
-                   am2, am3):
+                   am2, am3, xo3=None, cost4=None, am4=None):
     """Per-slot cost row given the sibling endpoints' current values
     (mixed-arity local tables): binary select by xo1, ternary by
-    (xo1, xo2), assembled FULL-width with the static arity masks —
-    per-range lane slicing trips Mosaic layout inference (a broadcast
-    of a lane-sliced row is rejected)."""
+    (xo1, xo2), quaternary by (xo1, xo2, xo3) — assembled FULL-width
+    with the static arity masks — per-range lane slicing trips Mosaic
+    layout inference (a broadcast of a lane-sliced row is rejected)."""
     D = pg.D
     cb = cost[0: D, :]
     for j in range(1, D):
@@ -1073,6 +1206,25 @@ def _mixed_contrib(pg: PackedMaxSumGraph, xo1, xo2, cost, cost1, cost3,
                     cost3[row: row + D, :], ct,
                 )
         out = jnp.where(am3 > 0, ct, out)
+    if cost4 is not None:
+        # narrow compute on the quaternary section lanes only (the
+        # full-width D^4 slab would be tens of MB — see cost4_rows)
+        n1 = _gather_q4(pg, xo1)
+        n2 = _gather_q4(pg, xo2)
+        n3 = _gather_q4(pg, xo3)
+        cq = cost4[0: D, :]
+        for j in range(D):
+            for k in range(D):
+                for m in range(D):
+                    if j == 0 and k == 0 and m == 0:
+                        continue
+                    row = ((j * D + k) * D + m) * _Q4_STRIDE
+                    cq = jnp.where(
+                        (n1 == float(j)) & (n2 == float(k))
+                        & (n3 == float(m)),
+                        cost4[row: row + D, :], cq,
+                    )
+        out = jnp.where(am4 > 0, _spread_q4(pg, cq, D), out)
     return out
 
 
@@ -1083,17 +1235,23 @@ def _contrib_for_values(pg: PackedMaxSumGraph, xs, xo, mixed, cost=None,
     local-tables, MGM/DSA and MGM-2 kernels.  ``xs`` are the expanded
     own values (needed for the second permute), ``xo`` the first-sibling
     values already routed by ``pg.plan``.  Mixed layouts (``mixed`` =
-    parsed (cost1, cost3, consts2, am2, am3) refs + ``cost`` [D*D, N])
-    run the arity-masked assembly with a second permute for ternary
-    slots; all-binary layouts select from the D ``slabs``."""
+    the parsed 8-tuple of :func:`_parse_mixed_refs` + ``cost``
+    [D*D, N]) run the arity-masked assembly with a second permute for
+    ternary slots and a third for quaternary; all-binary layouts select
+    from the D ``slabs``."""
     if mixed is not None:
-        cost1, cost3, consts2, am2, am3 = mixed
+        cost1, cost3, consts2, am2, am3, cost4, consts3, am4 = mixed
         R = xs.shape[0]
         xo2 = (
             _permute_in_kernel(xs, pg.plan2, R, consts2)
             if consts2 is not None else xo
         )
-        return _mixed_contrib(pg, xo, xo2, cost, cost1, cost3, am2, am3)
+        xo3 = (
+            _permute_in_kernel(xs, pg.plan3, R, consts3)
+            if consts3 is not None else xo
+        )
+        return _mixed_contrib(pg, xo, xo2, cost, cost1, cost3, am2, am3,
+                              xo3=xo3, cost4=cost4, am4=am4)
     contrib = slabs[0]
     for j in range(1, pg.D):
         contrib = jnp.where(xo == float(j), slabs[j], contrib)
@@ -1101,12 +1259,13 @@ def _contrib_for_values(pg: PackedMaxSumGraph, xs, xo, mixed, cost=None,
 
 
 def _mixed_r_new(pg: PackedMaxSumGraph, qm1, qm2, cost, cost1, cost3,
-                 am2, am3):
+                 am2, am3, qm3=None, cost4=None, am4=None):
     """factor→var messages for the mixed-arity layout: unary slots take
     their constant cost rows, binary slots the D-slab min over the
     routed sibling, ternary slots the D²-slab min over BOTH routed
-    siblings — all computed FULL-width and combined with the static
-    arity masks (see :func:`_mixed_contrib` for the layout rationale)."""
+    siblings, quaternary slots the D³-slab min over all THREE — all
+    computed FULL-width and combined with the static arity masks (see
+    :func:`_mixed_contrib` for the layout rationale)."""
     D = pg.D
     rb = cost[0: D, :] + qm1[0: 1, :]
     for j in range(1, D):
@@ -1123,6 +1282,23 @@ def _mixed_r_new(pg: PackedMaxSumGraph, qm1, qm2, cost, cost1, cost3,
                         + qm1[j: j + 1, :] + qm2[k: k + 1, :])
                 rt = cand if rt is None else jnp.minimum(rt, cand)
         out = jnp.where(am3 > 0, rt, out)
+    if cost4 is not None:
+        # narrow compute on the quaternary section lanes only
+        n1 = _gather_q4(pg, qm1)
+        n2 = _gather_q4(pg, qm2)
+        n3 = _gather_q4(pg, qm3)
+        rq = None
+        for j in range(D):
+            for k in range(D):
+                # hoist the (j, k) part of the sibling sum out of the
+                # inner loop: D² adds instead of D³
+                qjk = n1[j: j + 1, :] + n2[k: k + 1, :]
+                for m in range(D):
+                    row = ((j * D + k) * D + m) * _Q4_STRIDE
+                    cand = (cost4[row: row + D, :]
+                            + qjk + n3[m: m + 1, :])
+                    rq = cand if rq is None else jnp.minimum(rq, cand)
+        out = jnp.where(am4 > 0, _spread_q4(pg, rq, D), out)
     return out
 
 
@@ -1132,12 +1308,17 @@ def _cycle_body(pg: PackedMaxSumGraph, damping: float, q, r, cost, unary,
     D, N = pg.D, pg.N
     qm = _permute_in_kernel(q, pg.plan, D, plan_consts)
     if mixed_ops is not None:
-        cost1, cost3, consts2, am2, am3 = mixed_ops
+        (cost1, cost3, consts2, am2, am3, cost4, consts3, am4) = mixed_ops
         qm2 = (
             _permute_in_kernel(q, pg.plan2, D, consts2)
             if consts2 is not None else qm
         )
-        r_new = _mixed_r_new(pg, qm, qm2, cost, cost1, cost3, am2, am3)
+        qm3 = (
+            _permute_in_kernel(q, pg.plan3, D, consts3)
+            if consts3 is not None else qm
+        )
+        r_new = _mixed_r_new(pg, qm, qm2, cost, cost1, cost3, am2, am3,
+                             qm3=qm3, cost4=cost4, am4=am4)
     else:
         # factor→var: r'[i] = min_j cost[j*D+i] + qm[j] — full-sublane
         # [D, N] slabs (cost is other-value-major, see pack_for_pallas)

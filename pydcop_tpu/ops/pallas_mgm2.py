@@ -153,7 +153,7 @@ def _mgm2_cycle(pm: PackedMgm2, x, u_off, u_pick, u_fav, slabs, unary,
     """One MGM-2 cycle.  All-binary layout: ``slabs`` are the D
     per-other-value cost planes.  Mixed layout: ``slabs`` is None,
     ``cost`` the [D*D, N] binary array (zeros off binary slots),
-    ``mixed`` the parsed (cost1, cost3, consts2, am2, am3) refs and
+    ``mixed`` the parsed 8-tuple of pallas_maxsum._parse_mixed_refs and
     ``gmask1`` the first-sibling gain mask — pairing stays binary-only
     (pick_rank/edge_id are BIG off binary slots) while tables and the
     gain/go arbitration cover every arity."""
@@ -325,12 +325,23 @@ def _mgm2_cycle(pm: PackedMgm2, x, u_off, u_pick, u_fav, slabs, unary,
     gn = gp[0: 1] * gmask1
     pn = jnp.where(gmask1 > 0, gp[1: 2], _BIG_IDX)
     gboth = gn
+    gn3 = pn3 = None
     if mixed is not None and consts2 is not None:
         am3 = mixed[4]
+        am4 = mixed[7]
+        consts3 = mixed[6]
+        # second-sibling mask: arity ≥ 3 slots (disjoint masks — the
+        # plain add is already 0/1)
+        m2 = am3 if am4 is None else am3 + am4
         gp2 = _permute_in_kernel(gain_pid_s, pg.plan2, 2, consts2)
-        gn2 = gp2[0: 1] * am3
-        pn2 = jnp.where(am3 > 0, gp2[1: 2], _BIG_IDX)
+        gn2 = gp2[0: 1] * m2
+        pn2 = jnp.where(m2 > 0, gp2[1: 2], _BIG_IDX)
         gboth = jnp.maximum(gn, gn2)
+        if consts3 is not None:
+            gp3 = _permute_in_kernel(gain_pid_s, pg.plan3, 2, consts3)
+            gn3 = gp3[0: 1] * am4
+            pn3 = jnp.where(am4 > 0, gp3[1: 2], _BIG_IDX)
+            gboth = jnp.maximum(gboth, gn3)
     neigh_max = jnp.maximum(
         col_reduce(gboth, jnp.maximum, 0.0), 0.0)
     nm_exp = _bucket_expand(pg, neigh_max, 1)
@@ -338,6 +349,9 @@ def _mgm2_cycle(pm: PackedMgm2, x, u_off, u_pick, u_fav, slabs, unary,
     if mixed is not None and consts2 is not None:
         idx_cand = jnp.minimum(
             idx_cand, jnp.where(gn2 >= nm_exp - eps, pn2, _BIG_IDX))
+        if gn3 is not None:
+            idx_cand = jnp.minimum(
+                idx_cand, jnp.where(gn3 >= nm_exp - eps, pn3, _BIG_IDX))
     idx_at_max = col_reduce(idx_cand, jnp.minimum, _BIG_IDX)
     winner = (gain > eps) & (
         (gain > neigh_max + eps)

@@ -47,11 +47,13 @@ from pydcop_tpu.ops.pallas_maxsum import (
 from pydcop_tpu.ops.pallas_permute import _permute_in_kernel
 
 
-#: operand bundle for mixed-arity shard kernels:
-#: (cost1 [D,N], cost3 [D^3,N] | None, am2 [1,N], am3 [1,N],
-#:  consts2 tuple-of-5 | None) — cost3/consts2 are None iff the shared
-#: layout has no ternary sections (then they are None on EVERY shard:
-#: the layout is shard-invariant, so the traced structure is too)
+#: operand bundle for mixed-arity shard kernels: a FLAT sequence of
+#: this shard's arrays in the canonical pallas_maxsum._mixed_operands
+#: order (cost1, am2, am3, [cost3, 5×consts2], [cost4, 5×consts3,
+#: am4]) — kernels append it to their operand list verbatim and parse
+#: it back with _parse_mixed_refs, so the order contract lives in ONE
+#: place.  Entries an arity lacks are absent on EVERY shard (the
+#: shared layout is shard-invariant, so the traced structure is too).
 MixedOps = Tuple
 
 
@@ -95,7 +97,6 @@ def packed_shard_fused_ba(
     interpret = _resolve_interpret(interpret)
     D, N, Vp = pg.D, pg.N, pg.Vp
     has_act = active is not None
-    has3 = mixed is not None and mixed[1] is not None
 
     def kern(bel_ref, ru_ref, *rest):
         outs = rest[-(4 if has_act else 2):]
@@ -132,13 +133,19 @@ def packed_shard_fused_ba(
         qm = _permute_in_kernel(q1, pg.plan, D, consts_t)
         cost_t = cost_ref[:]
         if mx is not None:
-            cost1_t, cost3_t, c2_t, am2_t, am3_t = mx
+            (cost1_t, cost3_t, c2_t, am2_t, am3_t,
+             cost4_t, c3_t, am4_t) = mx
             qm2 = (
                 _permute_in_kernel(q1, pg.plan2, D, c2_t)
                 if c2_t is not None else qm
             )
+            qm3 = (
+                _permute_in_kernel(q1, pg.plan3, D, c3_t)
+                if c3_t is not None else qm
+            )
             r_new = _mixed_r_new(
-                pg, qm, qm2, cost_t, cost1_t, cost3_t, am2_t, am3_t
+                pg, qm, qm2, cost_t, cost1_t, cost3_t, am2_t, am3_t,
+                qm3=qm3, cost4=cost4_t, am4=am4_t,
             )
         else:
             r_new = cost_t[0: D, :] + qm[0: 1, :]
@@ -160,10 +167,7 @@ def packed_shard_fused_ba(
         ops += [q_m, r_m, active]
     ops += [cost, vmask, inv_dcount, *consts]
     if mixed is not None:
-        cost1, cost3, am2, am3, consts2 = mixed
-        ops += [cost1, am2, am3]
-        if has3:
-            ops += [cost3, *consts2]
+        ops += list(mixed)
     n_out = 4 if has_act else 2
     out_shape = (
         jax.ShapeDtypeStruct((D, N), jnp.float32),
@@ -197,7 +201,6 @@ def packed_shard_tables(
     to the arity-masked assembly (pallas_maxsum._mixed_contrib)."""
     interpret = _resolve_interpret(interpret)
     D, N, Vp = pg.D, pg.N, pg.Vp
-    has3 = mixed is not None and mixed[1] is not None
 
     def kern(x_ref, cost_ref, *rest):
         t_out = rest[-1]
@@ -217,10 +220,7 @@ def packed_shard_tables(
 
     ops = [x_cols, cost, *consts]
     if mixed is not None:
-        cost1, cost3, am2, am3, consts2 = mixed
-        ops += [cost1, am2, am3]
-        if has3:
-            ops += [cost3, *consts2]
+        ops += list(mixed)
     return pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((D, Vp), jnp.float32),

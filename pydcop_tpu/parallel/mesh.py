@@ -61,42 +61,53 @@ def _try_build_packs(tensors, n_shards, assigns=None):
         return None
 
 
+def _mixed_entries(sp):
+    """(stacked array, sharded?) entries for a mixed StackedShardPack,
+    in the canonical pallas_maxsum._mixed_operands order (that producer
+    and its parser _parse_mixed_refs define the contract; this is the
+    ONE mesh-side encoding of it — both the device_put/spec list and
+    the in-shard slicing derive from this list).  The arity masks are
+    section-derived and shard-invariant, hence replicated; everything
+    else is per-shard data stacked on axis 0."""
+    if not getattr(sp, "mixed", False):
+        return []
+    ents = [(sp.cost1_rows, True), (sp.am2, False), (sp.am3, False)]
+    if sp.cost3_rows is not None:
+        ents.append((sp.cost3_rows, True))
+        ents.extend((c, True) for c in sp.consts2)
+    if sp.cost4_rows is not None:
+        ents.append((sp.cost4_rows, True))
+        ents.extend((c, True) for c in sp.consts3)
+        ents.append((sp.am4, False))
+    return ents
+
+
 def _mixed_operands(sp, mesh):
     """Device-side mixed-arity operand blocks + their shard_map specs
-    (empty for all-binary packs).  Order matches :func:`_mixed_bundle`:
-    cost1 (sharded), am2/am3 (replicated, section-derived), then —
-    when the layout has ternary sections — cost3 + the 5 plan2 index
-    arrays (sharded)."""
-    if not getattr(sp, "mixed", False):
+    (empty for all-binary packs)."""
+    ents = _mixed_entries(sp)
+    if not ents:
         return (), []
     shard0 = NamedSharding(mesh, P(AXIS))
     repl = NamedSharding(mesh, P())
-    args = [
-        jax.device_put(sp.cost1_rows, shard0),
-        jax.device_put(sp.am2, repl),
-        jax.device_put(sp.am3, repl),
-    ]
-    specs = [P(AXIS), P(), P()]
-    if sp.cost3_rows is not None:
-        args.append(jax.device_put(sp.cost3_rows, shard0))
-        specs.append(P(AXIS))
-        for c in sp.consts2:
-            args.append(jax.device_put(c, shard0))
-            specs.append(P(AXIS))
-    return tuple(args), specs
+    args = tuple(
+        jax.device_put(a, shard0 if sh else repl) for a, sh in ents
+    )
+    specs = [P(AXIS) if sh else P() for _a, sh in ents]
+    return args, specs
 
 
 def _mixed_bundle(sp, extra):
     """Slice the per-shard blocks of :func:`_mixed_operands` into the
-    kernels' MixedOps bundle (inside shard_map); None for all-binary."""
-    if not getattr(sp, "mixed", False):
+    kernels' FLAT MixedOps sequence (inside shard_map); None for
+    all-binary.  Replicated entries (the arity masks) pass through,
+    sharded blocks drop their leading shard axis."""
+    ents = _mixed_entries(sp)
+    if not ents:
         return None
-    cost1, am2, am3 = extra[0][0], extra[1], extra[2]
-    cost3 = c2 = None
-    if sp.cost3_rows is not None:
-        cost3 = extra[3][0]
-        c2 = tuple(c[0] for c in extra[4:9])
-    return (cost1, cost3, am2, am3, c2)
+    return tuple(
+        e[0] if sh else e for e, (_a, sh) in zip(extra, ents)
+    )
 
 
 def build_mesh(n_devices: Optional[int] = None, axis_name: str = AXIS) -> Mesh:

@@ -84,6 +84,11 @@ class StackedShardPack:
     am2: Optional[jnp.ndarray] = None          # [1, N] shard-invariant
     am3: Optional[jnp.ndarray] = None          # [1, N] shard-invariant
     consts2: Optional[List[jnp.ndarray]] = None  # 5 stacked [S, ...]
+    cost4_rows: Optional[jnp.ndarray] = None   # [S, D^3*8, M4] narrow
+    #   (8-row-aligned (j,k,m) blocks on the 4-ary section lanes only
+    #   — see pallas_maxsum.PackedMaxSumGraph.cost4_rows)
+    am4: Optional[jnp.ndarray] = None          # [1, N] shard-invariant
+    consts3: Optional[List[jnp.ndarray]] = None  # 5 stacked [S, ...]
 
     @property
     def D(self) -> int:
@@ -104,10 +109,10 @@ def build_shard_packs(
     assigns: Optional[List[np.ndarray]] = None,
 ) -> Optional[StackedShardPack]:
     """Pack every shard's factor subset under one forced layout, or None
-    when the graph is out of scope (arity > 3, per-shard degree > one
+    when the graph is out of scope (arity > 4, per-shard degree > one
     slot class, VMEM, Clos budget).  All-binary graphs take the slot-
-    class layout below; mixed-arity (1/2/3) graphs take the MixedLayout
-    path (ROADMAP item 7, round 5)."""
+    class layout below; mixed-arity (1/2/3/4) graphs take the
+    MixedLayout path (ROADMAP item 7, round 5)."""
     if len(tensors.buckets) != 1 or tensors.buckets[0].arity != 2:
         return _build_mixed_shard_packs(tensors, n_shards, assigns)
     b = tensors.buckets[0]
@@ -206,12 +211,15 @@ def _mixed_section_masks(layout: MixedLayout):
     so marking them with the section's arity is harmless."""
     am2 = np.zeros((1, layout.N), dtype=np.float32)
     am3 = np.zeros((1, layout.N), dtype=np.float32)
+    am4 = np.zeros((1, layout.N), dtype=np.float32)
     for (cls, nvp, _voff, soff), key in zip(
             layout.with_slots, layout.buckets_arity):
-        c1, c2, _c3 = key
+        c1, c2, c3 = key[0], key[1], key[2]
         am2[0, soff + c1 * nvp: soff + (c1 + c2) * nvp] = 1.0
-        am3[0, soff + (c1 + c2) * nvp: soff + cls * nvp] = 1.0
-    return am2, am3
+        am3[0, soff + (c1 + c2) * nvp:
+             soff + (c1 + c2 + c3) * nvp] = 1.0
+        am4[0, soff + (c1 + c2 + c3) * nvp: soff + cls * nvp] = 1.0
+    return am2, am3, am4
 
 
 def _build_mixed_shard_packs(
@@ -228,10 +236,10 @@ def _build_mixed_shard_packs(
     S ways, so this only excludes instances a single shard can't hold.
     """
     buckets = [b for b in tensors.buckets if b.n_factors > 0]
-    if not buckets or any(b.arity not in (1, 2, 3) for b in buckets):
+    if not buckets or any(b.arity not in (1, 2, 3, 4) for b in buckets):
         return None
     V, D = tensors.n_vars, tensors.max_domain_size
-    has3 = any(b.arity == 3 for b in buckets)
+    has3 = any(b.arity >= 3 for b in buckets)
     if D > (5 if has3 else 8):
         return None
     if n_shards < 1:
@@ -246,7 +254,7 @@ def _build_mixed_shard_packs(
             [b.var_idx for b in buckets], V, n_shards)
 
     # per-variable MAX per-shard degree, per arity
-    deg_max = {a: np.zeros(V, dtype=np.int64) for a in (1, 2, 3)}
+    deg_max = {a: np.zeros(V, dtype=np.int64) for a in (1, 2, 3, 4)}
     for b, asg in zip(buckets, assigns):
         vi = np.asarray(b.var_idx)
         asg = np.asarray(asg)
@@ -254,11 +262,11 @@ def _build_mixed_shard_packs(
             e = vi[asg == s].reshape(-1)
             deg_max[b.arity] = np.maximum(
                 deg_max[b.arity], np.bincount(e, minlength=V))
-    total_max = deg_max[1] + deg_max[2] + deg_max[3]
+    total_max = sum(deg_max.values())
     if int(total_max.max(initial=0)) > _MAX_SLOT_CLASS:
         return None
     keys = np.stack(
-        [_quantize_up(deg_max[a]) for a in (1, 2, 3)], axis=1)
+        [_quantize_up(deg_max[a]) for a in (1, 2, 3, 4)], axis=1)
     rep = _merge_mixed_classes(
         keys, np.zeros(V, dtype=np.int64), 2 * _MAX_BUCKETS, 8 * _TILE)
     if rep is None:
@@ -297,11 +305,15 @@ def _build_mixed_shard_packs(
     unary_np[:, layout.var_pcol] = (
         np.asarray(tensors.unary_costs).T * mask_np[:, layout.var_pcol]
     )
-    am2, am3 = _mixed_section_masks(layout)
+    am2, am3, am4 = _mixed_section_masks(layout)
     consts_per = [_plan_consts(pg.plan) for pg in packs]
     consts2_per = (
         [_plan_consts(pg.plan2) for pg in packs]
         if pg0.plan2 is not None else None
+    )
+    consts3_per = (
+        [_plan_consts(pg.plan3) for pg in packs]
+        if pg0.plan3 is not None else None
     )
     return StackedShardPack(
         pg0=pg0,
@@ -324,5 +336,14 @@ def _build_mixed_shard_packs(
         consts2=(
             [jnp.stack([cp[i] for cp in consts2_per]) for i in range(5)]
             if consts2_per is not None else None
+        ),
+        cost4_rows=(
+            jnp.stack([pg.cost4_rows for pg in packs])
+            if pg0.cost4_rows is not None else None
+        ),
+        am4=jnp.asarray(am4) if pg0.cost4_rows is not None else None,
+        consts3=(
+            [jnp.stack([cp[i] for cp in consts3_per]) for i in range(5)]
+            if consts3_per is not None else None
         ),
     )

@@ -1,8 +1,9 @@
-"""Mixed-arity (1/2/3) lane packing (VERDICT r4 item 7 / ROADMAP §2a):
-the packed MaxSum engine and the packed local-tables kernel must
-bit-match the generic engines on graphs with unary, binary AND ternary
-factors — SECP model/rule structure, the family that previously fell
-to the generic path entirely.  Kernels run in interpret mode here."""
+"""Mixed-arity (1/2/3/4) lane packing (VERDICT r4 item 7 / ROADMAP
+§2a): the packed MaxSum engine and the packed local-tables kernel must
+bit-match the generic engines on graphs with unary, binary, ternary
+AND quaternary factors — SECP model/rule structure, the family that
+previously fell to the generic path entirely.  Kernels run in
+interpret mode here."""
 import numpy as np
 import pytest
 import jax.numpy as jnp
@@ -21,7 +22,8 @@ from pydcop_tpu.ops.pallas_maxsum import (
 )
 
 
-def _mixed_dcop(V=40, n2=60, n3=25, n1=10, D=4, seed=0, ragged=False):
+def _mixed_dcop(V=40, n2=60, n3=25, n1=10, D=4, seed=0, ragged=False,
+                n4=0):
     rng = np.random.default_rng(seed)
     dcop = DCOP("mixed", objective="min")
     doms = [Domain("d", "vals", list(range(D)))]
@@ -54,6 +56,13 @@ def _mixed_dcop(V=40, n2=60, n3=25, n1=10, D=4, seed=0, ragged=False):
     for _ in range(n1):
         i = int(rng.integers(0, V))
         sc = [vs[i]]
+        dcop.add_constraint(NAryMatrixRelation(
+            sc, rng.uniform(0, 5, dims(sc)).astype(np.float32),
+            name=f"c{k}"))
+        k += 1
+    for _ in range(n4):
+        i, j, l, m = rng.choice(V, 4, replace=False)
+        sc = [vs[i], vs[j], vs[l], vs[m]]
         dcop.add_constraint(NAryMatrixRelation(
             sc, rng.uniform(0, 5, dims(sc)).astype(np.float32),
             name=f"c{k}"))
@@ -123,13 +132,13 @@ class TestMixedPacking:
         pgm = try_pack_for_pallas(tm)
         assert pgm is not None and pgm.mixed
 
-    def test_rejects_arity_4(self):
+    def test_rejects_arity_5(self):
         rng = np.random.default_rng(0)
         dcop = _mixed_dcop(V=20, n2=10, n3=0, n1=0, seed=9)
-        vs = list(dcop.variables.values())[:4]
+        vs = list(dcop.variables.values())[:5]
         dcop.add_constraint(NAryMatrixRelation(
             vs, rng.uniform(0, 1, [len(v.domain) for v in vs]).astype(
-                np.float32), name="quad"))
+                np.float32), name="quint"))
         t = compile_factor_graph(dcop)
         assert pack_mixed_for_pallas(t) is None
 
@@ -321,3 +330,93 @@ class TestMixedHubPacking:
         got = np.asarray(unpack_x(pls, packed_mgm2_cycles(
             pm, pack_x(pls, x), uo, up, uf, m2.threshold, favor)))
         np.testing.assert_array_equal(got, np.asarray(state[0]))
+
+
+class TestQuaternaryPacking:
+    """Arity-4 factors (round 5 — SECP models with 3 lights, the last
+    packed-path capability gap): a THIRD Clos permutation routes the
+    remaining sibling, and the D^3-block cost slabs are stored NARROW
+    (quaternary section lanes only, 8-row-aligned blocks).  All engines
+    must bit-match their generic twins; hardware-verified on v5e."""
+
+    def _dcop(self, **kw):
+        kw.setdefault("V", 30)
+        kw.setdefault("n2", 20)
+        kw.setdefault("n3", 10)
+        kw.setdefault("n1", 8)
+        kw.setdefault("n4", 12)
+        kw.setdefault("seed", 4)
+        return _mixed_dcop(**kw)
+
+    def test_maxsum_matches_generic(self):
+        t = compile_factor_graph(self._dcop())
+        pg = pack_mixed_for_pallas(t)
+        assert pg is not None and pg.cost4_rows is not None
+        assert pg.plan3 is not None and pg.q4_sections
+        q, r = init_messages(t)
+        qp, rp = packed_init_state(pg)
+        for _ in range(4):
+            q, r, _bel, vals = maxsum_cycle(t, q, r, damping=0.5)
+            qp, rp, _belp, valsp = packed_cycle(
+                pg, qp, rp, damping=0.5, interpret=True
+            )
+        np.testing.assert_array_equal(np.asarray(vals),
+                                      np.asarray(valsp))
+
+    def test_quaternary_without_ternary_forces_structures(self):
+        """An arity {1,2,4} graph still builds plan2/cost3 (zero rows)
+        so the kernel structure matches the quaternary contract."""
+        t = compile_factor_graph(self._dcop(n3=0))
+        pg = pack_mixed_for_pallas(t)
+        assert pg is not None and pg.cost4_rows is not None
+        assert pg.plan2 is not None and pg.cost3_rows is not None
+        q, r = init_messages(t)
+        qp, rp = packed_init_state(pg)
+        for _ in range(3):
+            q, r, _bel, vals = maxsum_cycle(t, q, r, damping=0.5)
+            qp, rp, _belp, valsp = packed_cycle(
+                pg, qp, rp, damping=0.5, interpret=True
+            )
+        np.testing.assert_array_equal(np.asarray(vals),
+                                      np.asarray(valsp))
+
+    def test_local_tables_match_generic(self):
+        from pydcop_tpu.ops.compile import compile_constraint_graph
+
+        dcop = self._dcop()
+        t = compile_constraint_graph(dcop)
+        pg = pack_mixed_for_pallas(t)
+        assert pg is not None and pg.cost4_rows is not None
+        rng = np.random.default_rng(2)
+        x = np.array([rng.integers(0, len(v.domain)) for v in
+                      dcop.variables.values()], dtype=np.int32)
+        ref = np.asarray(local_cost_tables(t, jnp.asarray(x)))
+        got = np.asarray(
+            packed_local_tables(pg, jnp.asarray(x), interpret=True))
+        assert np.allclose(ref, got, atol=1e-3)
+
+    @pytest.mark.parametrize("algo", ["mgm", "dsa", "adsa", "mgm2"])
+    def test_solvers_match_generic_stream(self, algo):
+        """PRNG-stream-identical packed vs generic on the quaternary
+        SECP instance for the whole move family."""
+        from unittest import mock
+
+        import jax
+
+        from pydcop_tpu.algorithms import (
+            AlgorithmDef,
+            load_algorithm_module,
+        )
+        from pydcop_tpu.generators.secp import generate_secp
+
+        dcop = generate_secp(n_lights=12, n_models=4, n_rules=3,
+                             max_model_size=3, seed=2)
+        mod = load_algorithm_module(algo)
+        ad = AlgorithmDef.build_with_default_params(algo)
+        rg = mod.build_solver(dcop, algo_def=ad, seed=3).run(
+            cycles=8, chunk=8)
+        with mock.patch.object(jax, "default_backend", lambda: "tpu"):
+            sp = mod.build_solver(dcop, algo_def=ad, seed=3)
+        assert getattr(sp, "packed", None) is not None
+        rp = sp.run(cycles=8, chunk=8)
+        assert rg.assignment == rp.assignment

@@ -62,14 +62,25 @@ class TestBuildShardPacks:
         # section-derived arity masks are shard-invariant singles
         assert sp.am2.shape == (1, sp.N)
 
-    def test_mixed_rejects_high_arity(self):
-        """Arity > 3 still falls back to the generic sharded engine."""
+    def test_quaternary_packs(self):
+        """SECP with 3-light models (arity 4) packs too (round 5)."""
         from pydcop_tpu.generators.secp import generate_secp
 
         dcop = generate_secp(n_lights=10, n_models=3, n_rules=2,
                              max_model_size=3, seed=1)
         t = compile_factor_graph(dcop)
-        assert any(b.arity > 3 for b in t.buckets)
+        assert any(b.arity == 4 for b in t.buckets)
+        sp = build_shard_packs(t, 4)
+        assert sp is not None and sp.cost4_rows is not None
+
+    def test_mixed_rejects_high_arity(self):
+        """Arity > 4 still falls back to the generic sharded engine."""
+        from pydcop_tpu.generators.secp import generate_secp
+
+        dcop = generate_secp(n_lights=10, n_models=6, n_rules=2,
+                             max_model_size=4, seed=1)
+        t = compile_factor_graph(dcop)
+        assert any(b.arity > 4 for b in t.buckets)
         assert build_shard_packs(t, 4) is None
 
     def test_rejects_megascale_cheaply(self):
@@ -234,6 +245,29 @@ class TestMixedPackedSharded:
                                      use_packed=False)
         np.testing.assert_array_equal(
             packed.run(cycles=8, seed=3), generic.run(cycles=8, seed=3)
+        )
+
+    def test_quaternary_matches_generic(self):
+        """Arity-4 SECP (3-light models) through the sharded packed
+        engines — MaxSum and MGM both bit-match generic (round 5)."""
+        from pydcop_tpu.ops.compile import compile_constraint_graph
+
+        dcop = _secp_instance(seed=3, max_model_size=3)
+        t = compile_factor_graph(dcop)
+        assert any(b.arity == 4 for b in t.buckets)
+        mesh = build_mesh(4)
+        packed = ShardedMaxSum(t, mesh, damping=0.5, use_packed=True)
+        assert packed.packs is not None
+        assert packed.packs.cost4_rows is not None
+        vp, _, _ = packed.run(cycles=8)
+        generic = ShardedMaxSum(t, mesh, damping=0.5, use_packed=False)
+        vg, _, _ = generic.run(cycles=8)
+        np.testing.assert_array_equal(vp, vg)
+        tc = compile_constraint_graph(dcop)
+        pls = ShardedLocalSearch(tc, mesh, rule="mgm", use_packed=True)
+        gls = ShardedLocalSearch(tc, mesh, rule="mgm", use_packed=False)
+        np.testing.assert_array_equal(
+            pls.run(cycles=8, seed=3), gls.run(cycles=8, seed=3)
         )
 
 
