@@ -76,7 +76,9 @@ def global_mesh():
 
 def run_multihost_maxsum(dcop, cycles: int = 15, damping: float = 0.5,
                          activation: Optional[float] = None,
-                         seed: int = 0):
+                         seed: int = 0,
+                         use_packed: Optional[bool] = None,
+                         info: Optional[dict] = None):
     """Solve `dcop` with MaxSum sharded over the global multi-process
     mesh.  Returns (values, n_global_devices, tensors).  Every process
     must call this with an identical dcop (SPMD).  ``activation`` < 1
@@ -89,7 +91,12 @@ def run_multihost_maxsum(dcop, cycles: int = 15, damping: float = 0.5,
     tensors = compile_factor_graph(dcop)
     mesh = global_mesh()
     sharded = ShardedMaxSum(tensors, mesh, damping=damping,
-                            activation=activation)
+                            activation=activation,
+                            use_packed=use_packed)
+    if info is not None:
+        # which engine actually ran: use_packed=True is a REQUEST — the
+        # packer can decline (scope/VMEM) and fall back to generic
+        info["packed"] = sharded.packs is not None
     values, _q, _r = sharded.run(cycles=cycles, seed=seed)
     return values, mesh.devices.size, tensors
 
@@ -136,6 +143,10 @@ def main(argv=None) -> int:
     ap.add_argument("--edges", type=int, default=120)
     ap.add_argument("--cycles", type=int, default=15)
     ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--packed", action="store_true",
+                    help="force the lane-packed per-shard engine "
+                    "(maxsum only; default: platform auto — packed on "
+                    "TPU shards, generic elsewhere)")
     args = ap.parse_args(argv)
 
     init_multihost(
@@ -158,19 +169,24 @@ def main(argv=None) -> int:
         # note: --seed names the generated INSTANCE here; the run PRNG
         # stays at the engines' default so every rank and the
         # single-process comparison stream match
+        info: dict = {}
         values, n_devices, _tensors = run_multihost_maxsum(
-            dcop, cycles=args.cycles, activation=activation)
+            dcop, cycles=args.cycles, activation=activation,
+            use_packed=True if args.packed else None, info=info)
     else:
         values, n_devices, _tensors = run_multihost_local_search(
             dcop, rule=args.algo, cycles=args.cycles)
     import numpy as np
 
-    print(json.dumps({
+    out = {
         "process_id": args.process_id,
         "n_global_devices": int(n_devices),
         "values_checksum": int(np.asarray(values).sum()),
         "n_values": int(len(values)),
-    }), flush=True)
+    }
+    if args.algo in ("maxsum", "amaxsum"):
+        out["packed"] = bool(info.get("packed", False))
+    print(json.dumps(out), flush=True)
     return 0
 
 
