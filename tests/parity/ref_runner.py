@@ -79,8 +79,13 @@ def main():
     if assignment:
         # reference solution_cost returns (hard_violations, soft_cost)
         violation, cost = dcop.solution_cost(assignment, 10000)
+    def _py(o):
+        # reference assignments can carry numpy scalars (e.g. int64
+        # domain values on SECP instances); JSON needs plain python
+        return o.item() if hasattr(o, "item") else str(o)
+
     print(json.dumps({"assignment": assignment, "cost": cost,
-                      "violation": violation}), flush=True)
+                      "violation": violation}, default=_py), flush=True)
 
 
 if __name__ == "__main__":
