@@ -420,3 +420,54 @@ class TestQuaternaryPacking:
         assert getattr(sp, "packed", None) is not None
         rp = sp.run(cycles=8, chunk=8)
         assert rg.assignment == rp.assignment
+
+
+class TestQuaternaryHubPacking:
+    def test_hub_with_quaternary_factors_matches_generic(self):
+        """Hub splitting composed with the arity-4 packing: a degree-155
+        hub holding binary AND ternary AND quaternary factors rides the
+        packed engine, bit-matching the generic engine (integer costs:
+        exact float sums, same rationale as _hub_mixed_dcop)."""
+        rng = np.random.default_rng(6)
+        V, D = 60, 4
+        dcop = DCOP("hub4", objective="min")
+        dom = Domain("d", "vals", list(range(D)))
+        vs = [Variable(f"v{i:02d}", dom) for i in range(V)]
+        for v in vs:
+            dcop.add_variable(v)
+        k = 0
+        for _ in range(120):
+            j = int(rng.integers(1, V))
+            sc = [vs[0], vs[j]]
+            dcop.add_constraint(NAryMatrixRelation(
+                sc, rng.integers(0, 9, (D, D)).astype(np.float32),
+                name=f"c{k}"))
+            k += 1
+        for _ in range(20):
+            j, l = rng.choice(np.arange(1, V), 2, replace=False)
+            sc = [vs[0], vs[j], vs[l]]
+            dcop.add_constraint(NAryMatrixRelation(
+                sc, rng.integers(0, 9, (D,) * 3).astype(np.float32),
+                name=f"c{k}"))
+            k += 1
+        for _ in range(15):
+            j, l, m = rng.choice(np.arange(1, V), 3, replace=False)
+            sc = [vs[0], vs[j], vs[l], vs[m]]
+            dcop.add_constraint(NAryMatrixRelation(
+                sc, rng.integers(0, 9, (D,) * 4).astype(np.float32),
+                name=f"c{k}"))
+            k += 1
+        dcop.add_agents([AgentDef("a0")])
+        t = compile_factor_graph(dcop)
+        pg = pack_mixed_for_pallas(t)
+        assert pg is not None and pg.hub_nsteps > 0
+        assert pg.cost4_rows is not None
+        q, r = init_messages(t)
+        qp, rp = packed_init_state(pg)
+        for _ in range(5):
+            q, r, _bel, vals = maxsum_cycle(t, q, r, damping=0.5)
+            qp, rp, _belp, valsp = packed_cycle(
+                pg, qp, rp, damping=0.5, interpret=True
+            )
+        np.testing.assert_array_equal(np.asarray(vals),
+                                      np.asarray(valsp))
