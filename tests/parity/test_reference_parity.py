@@ -125,7 +125,15 @@ def test_secp_nary_cost_parity(algo):
             ref = run_reference("secp_small.yaml", algo, timeout=8)
             if ref["cost"] is not None and ref["violation"] == 0:
                 break
-        except AssertionError:
+        except subprocess.TimeoutExpired:
+            ref = None  # starved threads never joined; retry/skip
+        except AssertionError as e:
+            # starvation surfaces as the runner's 'incomplete
+            # assignment' ValueError (nonzero rc, stderr in the assert
+            # message); any OTHER runner crash is a real regression in
+            # the oracle and must fail loudly, not skip
+            if "incomplete assignment" not in str(e):
+                raise
             ref = None
     if ref is None or ref["cost"] is None or ref["violation"] != 0:
         pytest.skip("reference runtime did not complete an assignment "
