@@ -1263,7 +1263,7 @@ def main():
 
             if _jax.default_backend() == "tpu":
                 from pydcop_tpu.parallel.mesh import (
-                    ShardedMaxSum, build_mesh,
+                    ShardedLocalSearch, ShardedMaxSum, build_mesh,
                 )
 
                 shp = ShardedMaxSum(_tensors, build_mesh(1), damping=0.5)
@@ -1273,6 +1273,28 @@ def main():
                         round(measure_rate(
                             lambda: shp.run(cycles=args.cycles),
                             args.cycles, args.repeat), 1)
+                # sharded LOCAL SEARCH on the chip (round 5: this path
+                # previously failed Mosaic compile on hardware — the
+                # in-kernel cost row-slicing — so it had never been
+                # timed; the packed tables kernel runs per shard but
+                # the replicated move rule + variable-axis transfers
+                # cap the cycle well below the fused single-chip
+                # kernels — see ROADMAP)
+                from pydcop_tpu.ops.compile import (
+                    compile_constraint_graph,
+                )
+
+                _ct = compile_constraint_graph(dcop)
+                for rule in ("mgm", "dsa"):
+                    sls = ShardedLocalSearch(_ct, build_mesh(1),
+                                             rule=rule)
+                    if sls.packs is None:
+                        continue
+                    sls.run(cycles=200)  # warmup / compile
+                    extra[f"sharded_packed_{rule}_cycles_per_sec_tpu"] \
+                        = round(measure_rate(
+                            lambda: sls.run(cycles=200),
+                            200, args.repeat), 1)
         except Exception as e:  # never lose the primary
             extra["sharded_packed_tpu_error"] = repr(e)
 

@@ -190,7 +190,7 @@ def packed_shard_fused_ba(
 def packed_shard_tables(
     pg: PackedMaxSumGraph,
     x_cols: jnp.ndarray,       # [1, Vp] current value per column (f32)
-    cost: jnp.ndarray,         # [D*D, N]
+    cost,                      # mixed: [D*D, N]; binary: D slabs [D, N]
     consts: Tuple[jnp.ndarray, ...],
     mixed: Optional[MixedOps] = None,
     interpret: Optional[bool] = None,
@@ -198,27 +198,42 @@ def packed_shard_tables(
     """Per-column partial local cost tables [D, Vp] for this shard's
     constraints under the current assignment (no unary; the caller adds
     it globally after the psum).  ``mixed`` switches the contribution
-    to the arity-masked assembly (pallas_maxsum._mixed_contrib)."""
+    to the arity-masked assembly (pallas_maxsum._mixed_contrib) and
+    ``cost`` is then the [D*D, N] binary array; on ALL-BINARY packs
+    ``cost`` must be a sequence of D separate per-other-value slab
+    operands [D, N] — in-kernel row slices of one [D*D, N] array have
+    sublane-offset layouts whose where-selects Mosaic cannot
+    reconcile with the bucket reduce's zero-fill concat (the same
+    hardware constraint PackedLocalSearch.cost_slabs documents; the
+    where-chains of the MIXED assembly canonicalize through their
+    full-array operands and compile fine, as do the add/min chains of
+    the fused maxsum kernel)."""
     interpret = _resolve_interpret(interpret)
     D, N, Vp = pg.D, pg.N, pg.Vp
+    n_cost = 1 if mixed is not None else len(cost)
 
-    def kern(x_ref, cost_ref, *rest):
+    def kern(x_ref, *rest):
         t_out = rest[-1]
-        ins = rest[:-1]
+        cost_refs = rest[:n_cost]
+        ins = rest[n_cost:-1]
         consts_t = tuple(c[:] for c in ins[:5])
         xs = _bucket_expand(pg, x_ref[:], 1)
         xo = _permute_in_kernel(xs, pg.plan, 1, consts_t)
-        cost_t = cost_ref[:]
         mx = None
+        cost_t = slabs_t = None
         if mixed is not None:
+            cost_t = cost_refs[0][:]
             mx, _ = _parse_mixed_refs(pg, ins[5:])
+        else:
+            slabs_t = [r[:] for r in cost_refs]
         contrib = _contrib_for_values(
-            pg, xs, xo, mx, cost=cost_t,
-            slabs=[cost_t[j * D: (j + 1) * D, :] for j in range(D)],
+            pg, xs, xo, mx, cost=cost_t, slabs=slabs_t,
         )
         t_out[:] = _bucket_reduce(pg, contrib, D, jnp.add)
 
-    ops = [x_cols, cost, *consts]
+    ops = [x_cols]
+    ops += [cost] if mixed is not None else list(cost)
+    ops += list(consts)
     if mixed is not None:
         ops += list(mixed)
     return pl.pallas_call(

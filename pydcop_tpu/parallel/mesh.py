@@ -743,12 +743,24 @@ class ShardedLocalSearch:
         in_specs = [P(), P(), P(AXIS)]  # x, key, aux (pytree prefix)
         if sp is not None:
             # lane-packed per-shard tables (ops/pallas_sharded):
-            # cost rows + 5 plan const arrays (+ mixed-arity extras)
+            # cost arrays + 5 plan const arrays (+ mixed-arity extras).
+            # ALL-BINARY packs ship D separate per-other-value slab
+            # operands — in-kernel row slices of one [D*D, N] array
+            # fail Mosaic's concat layout check on hardware (see
+            # packed_shard_tables); MIXED packs keep the single array
+            # (their where-assembly canonicalizes)
+            D = sp.D
+            cost_args = (
+                [sp.cost_rows] if sp.mixed else
+                [sp.cost_rows[:, j * D: (j + 1) * D, :]
+                 for j in range(D)]
+            )
+            n_cost = len(cost_args)
             bucket_args.extend(
                 jax.device_put(a, shard0)
-                for a in (sp.cost_rows, *sp.consts)
+                for a in (*cost_args, *sp.consts)
             )
-            in_specs.extend([P(AXIS)] * 6)
+            in_specs.extend([P(AXIS)] * (n_cost + 5))
             mx_args, mx_specs = _mixed_operands(sp, self.mesh)
             bucket_args.extend(mx_args)
             in_specs.extend(mx_specs)
@@ -776,16 +788,20 @@ class ShardedLocalSearch:
                     packed_shard_tables,
                 )
 
-                cost = rest[0]
-                consts = tuple(c[0] for c in rest[1: 6])
+                nc = 1 if sp.mixed else sp.D
+                cost = (
+                    rest[0][0] if sp.mixed
+                    else [r[0] for r in rest[:nc]]
+                )
+                consts = tuple(c[0] for c in rest[nc: nc + 5])
                 vorder = sp.pg0.var_order  # [V] column per variable
                 x_cols = (
                     jnp.zeros((1, sp.Vp), jnp.float32)
                     .at[0, vorder].set(x.astype(jnp.float32))
                 )
                 bel = packed_shard_tables(
-                    sp.pg0, x_cols, cost[0], consts,
-                    mixed=_mixed_bundle(sp, rest[6:]),
+                    sp.pg0, x_cols, cost, consts,
+                    mixed=_mixed_bundle(sp, rest[nc + 5:]),
                 )
                 # columns align across shards: psum in packed space,
                 # then one [V]-column gather back to variable order
