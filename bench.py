@@ -1285,16 +1285,18 @@ def main():
                 )
 
                 _ct = compile_constraint_graph(dcop)
-                for rule in ("mgm", "dsa"):
+                # chunk sizes sized so one timed call clears the ~70ms
+                # tunnel dispatch floor at each rule's measured rate
+                for rule, n_cyc in (("mgm", 200), ("dsa", 800)):
                     sls = ShardedLocalSearch(_ct, build_mesh(1),
                                              rule=rule)
                     if sls.packs is None:
                         continue
-                    sls.run(cycles=200)  # warmup / compile
+                    sls.run(cycles=n_cyc)  # warmup / compile
                     extra[f"sharded_packed_{rule}_cycles_per_sec_tpu"] \
                         = round(measure_rate(
-                            lambda: sls.run(cycles=200),
-                            200, args.repeat), 1)
+                            lambda: sls.run(cycles=n_cyc),
+                            n_cyc, args.repeat), 1)
         except Exception as e:  # never lose the primary
             extra["sharded_packed_tpu_error"] = repr(e)
 
