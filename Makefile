@@ -1,6 +1,6 @@
 # Test/check targets (reference twin: pyDcop Makefile:1-21)
 
-.PHONY: test unit api cli doctest all-tests bench
+.PHONY: test unit api cli doctest all-tests bench faults
 
 test: all-tests
 
@@ -22,3 +22,10 @@ all-tests:
 
 bench:
 	python bench.py
+
+# fault-tolerance suite only (docs/resilience.rst); tier-1 subset —
+# the multi-process crash tests beyond ~30s are marked slow
+faults:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/unit/test_faults.py tests/api/test_api_process_faults.py \
+		-q -m 'not slow'

@@ -1107,6 +1107,18 @@ def regression_check(value: float, extra: dict, here: str,
                 "prev": old, "cur": cur, "drop_pct": round(100 * drop, 1),
                 "prev_round": rnd,
             }
+            if (name == "primary"
+                    and extra.get("primary_policy")
+                    and not prev_extra.get("primary_policy")):
+                # the baseline round still reported max-of-2-bursts;
+                # this round reports the steady-state second burst — an
+                # apparent drop up to the burst spread is the policy
+                # change, not a code regression
+                regressions[name]["note"] = (
+                    "primary policy changed max-of-2-bursts -> "
+                    "second-burst steady state; compare against "
+                    "prev round's primary_burst2 if recorded"
+                )
     if regressions:
         extra["regressions"] = regressions
 
@@ -1425,15 +1437,24 @@ def main():
         # second primary burst ~30 min of wall after the first: the
         # tunnel's throughput drifts on a minutes timescale, so one
         # burst under-reads whenever it lands in a trough (r5 measured
-        # 15.0k vs 21.4k for identical code).  Max over the two bursts;
-        # both are recorded so the spread stays visible.
+        # 15.0k vs 21.4k for identical code).  POLICY (changed from
+        # max-of-2, ADVICE r5): the primary is the SECOND burst — by
+        # then dispatch caches and the tunnel are warm, so it is the
+        # steady-state number and comparable round over round, where a
+        # max-of-2 is order-statistic-biased upward and makes honest
+        # regressions look like drift.  Both bursts stay recorded;
+        # regression_check baselines written before this round carry a
+        # max-of-2 primary, so a one-round apparent drop up to the
+        # burst spread is the policy change, not a code regression
+        # (flagged via extra["primary_policy"]).
         extra["primary_burst1"] = round(value, 2)
+        extra["primary_policy"] = "burst2_steady_state"
         try:
             second = remeasure_primary()
             extra["primary_burst2"] = round(second, 2)
-            if second > value:
-                vs = vs * (second / value) if value else vs
-                value = second
+            if second and value:
+                vs = vs * (second / value)
+            value = second
         except Exception as e:
             extra["primary_remeasure_error"] = repr(e)
 

@@ -57,7 +57,44 @@ def set_parser(subparsers):
     parser.add_argument("--seed", type=int, default=0,
                         help="PRNG seed for the local-search rules "
                         "(must be identical on all ranks)")
+    # crash-resilience plumbing (runtime/process.py watchdog contract)
+    parser.add_argument("--heartbeat-file", default=None,
+                        help="touch this file every --heartbeat-interval "
+                        "seconds (coordinator stall detection)")
+    parser.add_argument("--heartbeat-interval", type=float, default=0.5)
+    parser.add_argument("--checkpoint-dir", default=None,
+                        help="rotating snapshot directory; rank 0 saves "
+                        "mesh state every --checkpoint-every cycles and "
+                        "every rank auto-resumes from the latest valid "
+                        "snapshot (maxsum family)")
+    parser.add_argument("--checkpoint-every", type=int, default=5)
     return parser
+
+
+def _resilience_hooks(args):
+    """Heartbeat writer (started BEFORE the heavy jax import so the
+    coordinator sees a live rank immediately), fault injector (from the
+    coordinator's env channel) and checkpoint manager for this rank."""
+    from pydcop_tpu.runtime.faults import (
+        FaultPlan,
+        HeartbeatWriter,
+        RankFaultInjector,
+    )
+
+    hb = None
+    if args.heartbeat_file:
+        hb = HeartbeatWriter(args.heartbeat_file,
+                             args.heartbeat_interval).start()
+    injector = None
+    plan = FaultPlan.from_env()
+    if plan is not None and args.process_id is not None:
+        injector = RankFaultInjector(plan, args.process_id)
+    mgr = None
+    if args.checkpoint_dir:
+        from pydcop_tpu.runtime.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(args.checkpoint_dir)
+    return hb, injector, mgr
 
 
 def run_multihost(args):
@@ -81,10 +118,15 @@ def run_multihost(args):
              f"family ({', '.join(LS_RULES)}), not {args.algo!r}"},
             args.output)
         return 1
+    # heartbeat/injector/checkpoints must exist before the jax import +
+    # rendezvous (the longest silent stretch of a rank's life)
+    hb, injector, ckpt_mgr = _resilience_hooks(args)
+
     from pydcop_tpu.parallel.multihost import (
         init_multihost,
         run_multihost_local_search,
         run_multihost_maxsum,
+        run_multihost_maxsum_resumable,
     )
 
     init_multihost(
@@ -100,7 +142,18 @@ def run_multihost(args):
     from pydcop_tpu.commands._utils import parse_algo_params
 
     algo_params = parse_algo_params(getattr(args, "algo_params", None))
+    resumed_from = 0
     if args.algo in LS_RULES:
+        if ckpt_mgr is not None or (
+                injector is not None and injector.cycle_faults_pending):
+            import logging
+
+            logging.getLogger("pydcop_tpu.agent").warning(
+                "checkpoint/resume and cycle faults need message-state "
+                "continuation — a maxsum-family feature; the %s rule "
+                "runs unchunked (a relaunch restarts it from cycle 0, "
+                "which is deterministic for the same seed)", args.algo,
+            )
         values, n_devices, tensors = run_multihost_local_search(
             dcop, rule=args.algo, cycles=args.cycles,
             seed=args.seed, algo_params=algo_params)
@@ -114,11 +167,50 @@ def run_multihost(args):
             activation = float(
                 algo_params.get("activation", DEFAULT_ACTIVATION)
             )
-        values, n_devices, tensors = run_multihost_maxsum(
-            dcop, cycles=args.cycles, activation=activation,
-            seed=args.seed)
+        if ckpt_mgr is None and injector is None:
+            values, n_devices, tensors = run_multihost_maxsum(
+                dcop, cycles=args.cycles, activation=activation,
+                seed=args.seed)
+        else:
+            state = None
+            epoch = 0
+            if ckpt_mgr is not None:
+                latest = ckpt_mgr.latest_valid_state()
+                if latest is not None:
+                    cycle, meta, arrays = latest
+                    if (meta.get("algo") == args.algo
+                            and meta.get("seed") == args.seed):
+                        state, resumed_from = arrays, cycle
+                        epoch = int(meta.get("epoch", 0))
+
+            def on_chunk(done, sharded, q, r):
+                # injection FIRST: a rank killed at this boundary leaves
+                # the previous boundary's snapshot as the resume point
+                if injector is not None:
+                    injector.at_cycle(done)
+                if (ckpt_mgr is not None and done < args.cycles
+                        and done % max(1, args.checkpoint_every) == 0):
+                    # the allgather below is a collective — every rank
+                    # participates; only rank 0 touches the filesystem
+                    arrays = sharded.state_to_host(q, r)
+                    if args.process_id == 0:
+                        ckpt_mgr.save_state(done, arrays, {
+                            "kind": "mesh_state",
+                            "algo": args.algo,
+                            "seed": args.seed,
+                            "epoch": getattr(sharded, "_epoch", 0),
+                        })
+
+            values, n_devices, tensors = run_multihost_maxsum_resumable(
+                dcop, cycles=args.cycles, activation=activation,
+                seed=args.seed,
+                chunk=max(1, args.checkpoint_every),
+                start_cycle=resumed_from, state=state, epoch=epoch,
+                on_chunk=on_chunk)
     assignment = tensors.assignment_from_indices(values)
     violation, cost = dcop.solution_cost(assignment, DEFAULT_INFINITY)
+    if hb is not None:
+        hb.stop()
     output_metrics({
         "status": "FINISHED",
         "assignment": assignment,
@@ -128,6 +220,7 @@ def run_multihost(args):
         "time": time.time() - t0,
         "process_id": args.process_id,
         "n_global_devices": int(n_devices),
+        "resumed_from": resumed_from,
     }, args.output)
     return 0
 

@@ -42,6 +42,19 @@ def set_parser(subparsers):
                         help="pre-computed replica-distribution YAML "
                         "(from `replica_dist`); skips online replication")
     parser.add_argument("--seed", type=int, default=0)
+    # crash resilience (docs/resilience.rst)
+    parser.add_argument("--fault-plan", default=None,
+                        help="fault-plan YAML (runtime/faults.py): "
+                        "kill_agent faults fire at phase boundaries and "
+                        "route through the replica-repair handshake")
+    parser.add_argument("--checkpoint", default=None,
+                        help="rotating snapshot directory: solver state "
+                        "is persisted every --checkpoint-every cycles "
+                        "(atomic + checksummed)")
+    parser.add_argument("--checkpoint-every", type=int, default=10)
+    parser.add_argument("--resume", action="store_true",
+                        help="warm-start from the newest valid snapshot "
+                        "in --checkpoint (corrupt files are skipped)")
     return parser
 
 
@@ -59,6 +72,17 @@ def run_cmd(args):
     algo_def = AlgorithmDef.build_with_default_params(
         args.algo, algo_params, mode=dcop.objective
     )
+    fault_plan = None
+    if args.fault_plan:
+        from pydcop_tpu.runtime.faults import FaultPlan
+
+        try:
+            fault_plan = FaultPlan.from_yaml(args.fault_plan)
+        except (OSError, ValueError) as e:
+            output_metrics(
+                {"status": "ERROR",
+                 "error": f"cannot load fault plan: {e}"}, args.output)
+            return 1
     collected = []
     orch = VirtualOrchestrator(
         dcop, algo_def, distribution=args.distribution,
@@ -66,6 +90,10 @@ def run_cmd(args):
         collector=(lambda t, m: collected.append((t, m)))
         if args.run_metrics else None,
         seed=args.seed,
+        fault_plan=fault_plan,
+        checkpoint_dir=args.checkpoint,
+        checkpoint_every=args.checkpoint_every,
+        auto_resume=args.resume,
     )
     orch.deploy_computations()
     if args.replica_dist:

@@ -57,6 +57,15 @@ def set_parser(subparsers):
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--cycles", type=int, default=None,
                         help="run exactly this many cycles")
+    # crash resilience (docs/resilience.rst)
+    parser.add_argument("--checkpoint", default=None,
+                        help="rotating snapshot directory: solver state "
+                        "is persisted every --checkpoint-every cycles "
+                        "(atomic + checksummed)")
+    parser.add_argument("--checkpoint-every", type=int, default=10)
+    parser.add_argument("--resume", action="store_true",
+                        help="warm-start from the newest valid snapshot "
+                        "in --checkpoint (corrupt files are skipped)")
     return parser
 
 
@@ -112,6 +121,9 @@ def run_cmd(args):
             seed=args.seed,
             collect_cycles=args.run_metrics is not None
             or args.collect_on == "cycle_change",
+            checkpoint_dir=args.checkpoint,
+            checkpoint_every=args.checkpoint_every,
+            resume=args.resume,
         )
     except Exception as e:
         output_metrics({"status": "ERROR", "error": str(e)}, args.output)

@@ -63,7 +63,11 @@ _VMEM_LIMIT = 100 * 2**20
 
 
 def _compiler_params():
-    return pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
+    # CompilerParams was TPUCompilerParams on older jax (version shim,
+    # same gate/stub policy as parallel/compat.py)
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(vmem_limit_bytes=_VMEM_LIMIT)
 
 
 _MAX_BUCKETS = 24
@@ -992,6 +996,16 @@ def pack_mixed_for_pallas(t: FactorGraphTensors,
     nsteps, steps_idx, steps_mask, head_idx = _hub_constants(
         group_heads, Vp, max_m
     )
+    if cost4 is not None and not q4_sections:
+        # every quaternary bucket must have contributed a lane range:
+        # _gather_q4 concatenates q4_sections and IndexErrors on an
+        # empty list deep inside the kernel trace — fail at pack time
+        # with the actual invariant instead (ADVICE r5)
+        raise AssertionError(
+            "pack_mixed_for_pallas: cost4_rows is set but no "
+            "q4_sections were collected — a quaternary bucket packed "
+            "without its section lane range (packer invariant broken)"
+        )
     pg = PackedMaxSumGraph(
         D=D, n_vars=V, Vp=Vp, N=N, plan=plan,
         buckets=tuple(with_slots),

@@ -1,0 +1,27 @@
+"""jax API compatibility shims for the mesh engines.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` (keyword
+``check_rep``) to top-level ``jax.shard_map`` (keyword ``check_vma``)
+across jax releases.  The mesh engines target the new spelling; this
+shim lets the same call sites run on the older jaxlib baked into some
+images (no new dependency — gate/stub policy).
+"""
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(fn, *, mesh, in_specs, out_specs, check_vma=False):
+    """Dispatch to whichever shard_map this jax provides, translating
+    the replication/varying-manual-axes check keyword."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )

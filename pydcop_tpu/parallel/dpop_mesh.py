@@ -39,6 +39,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pydcop_tpu.parallel.compat import shard_map
+
 from pydcop_tpu.ops.dpop_sweep import DpopSweepPlan, mode_ops
 from pydcop_tpu.parallel.mesh import AXIS, build_mesh
 
@@ -135,7 +137,7 @@ class ShardedDpopSweep:
             )
             return assign[:N]
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             sweep,
             mesh=self.mesh,
             in_specs=(

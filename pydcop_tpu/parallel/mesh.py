@@ -28,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pydcop_tpu.parallel.compat import shard_map
+
 from pydcop_tpu.ops.compile import FactorBucket, FactorGraphTensors
 from pydcop_tpu.ops.maxsum_kernels import factor_to_var_messages
 from pydcop_tpu.ops.segments import masked_argmin, masked_mean, segment_sum
@@ -345,7 +347,7 @@ class ShardedMaxSum:
             self._edge_var_blk = edge_var
             return self._local_cycle(q, r, key, *pairs(buckets))
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             cycle_fn,
             mesh=self.mesh,
             in_specs=tuple(in_specs),
@@ -428,7 +430,7 @@ class ShardedMaxSum:
             out_specs = (P(AXIS), P())
         extra_args, extra_specs = _mixed_operands(sp, self.mesh)
         in_specs += extra_specs
-        sharded = jax.shard_map(
+        sharded = shard_map(
             cycle_fn,
             mesh=self.mesh,
             in_specs=tuple(in_specs),
@@ -503,17 +505,109 @@ class ShardedMaxSum:
         z = jax.device_put(jnp.zeros((E, D), dtype=jnp.float32), sharding)
         return z, z
 
+    def _state_leaf_shapes(self):
+        """Expected continuation-state leaf shapes (one (q, r) half)."""
+        if self.packs is not None:
+            sp = self.packs
+            z = (sp.n_shards, sp.D, sp.N)
+            bel = (sp.D, sp.Vp)
+            if self.activation is None:
+                return [z, bel]
+            return [z, z, z, bel, (2,)]  # + pending PRNG key
+        st = self.st
+        return [(st.edge_var.shape[0], st.max_domain_size)]
+
+    def _validate_continuation(self, q, r) -> None:
+        """The (q, r) continuation args are OPAQUE — but an arg from a
+        different engine/problem must fail loudly here, not be silently
+        dropped (packed run() ignores ``r``) or crash deep in a kernel."""
+        want = self._state_leaf_shapes()
+        for name, s in (("q", q), ("r", r)):
+            leaves = list(s) if isinstance(s, tuple) else [s]
+            got = [tuple(jnp.shape(l)) for l in leaves]
+            if isinstance(s, tuple) == (self.packs is None):
+                raise ValueError(
+                    f"continuation state mismatch: {name} is "
+                    f"{'a tuple' if isinstance(s, tuple) else 'an array'}"
+                    f" but this solver's "
+                    f"{'packed' if self.packs is not None else 'generic'}"
+                    f" engine carries "
+                    f"{'a state tuple' if self.packs is not None else 'a message array'}"
+                    f" — was it produced by a different engine?"
+                )
+            if got != [tuple(w) for w in want]:
+                raise ValueError(
+                    f"continuation state mismatch: {name} has leaf "
+                    f"shapes {got}, this solver expects {want} — "
+                    f"(q, r) must come from a prior run() of the SAME "
+                    f"solver configuration"
+                )
+
+    # -- host round-trip of the continuation state (checkpoint/resume) ------
+
+    def state_to_host(self, q, r):
+        """Continuation state → flat dict of host numpy arrays (the
+        checkpointable form).  Under a multi-process mesh the sharded
+        leaves are allgathered — a COLLECTIVE, so every rank must call
+        this at the same point."""
+        self._validate_continuation(q, r)
+        leaves, _ = jax.tree.flatten((q, r))
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            host = [np.asarray(multihost_utils.process_allgather(
+                l, tiled=True)) for l in leaves]
+        else:
+            host = [np.asarray(l) for l in leaves]
+        return {f"leaf_{i}": a for i, a in enumerate(host)}
+
+    def state_from_host(self, arrays) -> tuple:
+        """Inverse of :meth:`state_to_host`: rebuild device-resident
+        (q, r) with the engine's shardings (each process materializes
+        only its addressable shards from the replicated host copy)."""
+        if self._run_n is None:
+            self._build()
+        q0, r0 = self.init_messages()
+        ref_leaves, treedef = jax.tree.flatten((q0, r0))
+        try:
+            host = [np.asarray(arrays[f"leaf_{i}"])
+                    for i in range(len(ref_leaves))]
+        except KeyError as e:
+            raise ValueError(
+                f"checkpointed mesh state is missing leaf {e} — "
+                f"foreign or truncated checkpoint"
+            ) from e
+        if len(arrays) != len(ref_leaves):
+            raise ValueError(
+                f"checkpointed mesh state has {len(arrays)} leaves, "
+                f"this engine carries {len(ref_leaves)}"
+            )
+        leaves = []
+        for h, ref in zip(host, ref_leaves):
+            if h.shape != tuple(ref.shape):
+                raise ValueError(
+                    f"checkpointed mesh state leaf shape {h.shape} != "
+                    f"engine {tuple(ref.shape)} — different problem or "
+                    f"engine configuration"
+                )
+            leaves.append(jax.device_put(
+                jnp.asarray(h, dtype=ref.dtype), ref.sharding))
+        return jax.tree.unflatten(treedef, leaves)
+
     def run(self, cycles: int = 20, q=None, r=None, seed: int = 0):
         """Run `cycles` sharded cycles; returns (values [V], q, r).
         Pass the previous call's (q, r) to continue instead of
         restarting from zero messages.  (q, r) are OPAQUE continuation
         state: the packed engine carries its rotated-launch scan state
-        in them — callers must not peek inside."""
+        in them — callers must not peek inside (they are validated
+        against this solver's expected state structure)."""
         if self._run_n is None:
             self._build()
         if q is None or r is None:
             q, r = self.init_messages(seed)
             self._epoch = 0
+        else:
+            self._validate_continuation(q, r)
         # identical on every process (SPMD); the epoch advances the stream
         # across chunked/resumed runs so activation patterns don't replay
         epoch = getattr(self, "_epoch", 0)
@@ -881,7 +975,7 @@ class ShardedLocalSearch:
                                         extra_blocks)
             return x2, aux
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             cycle_fn,
             mesh=self.mesh,
             in_specs=tuple(in_specs),

@@ -101,6 +101,57 @@ def run_multihost_maxsum(dcop, cycles: int = 15, damping: float = 0.5,
     return values, mesh.devices.size, tensors
 
 
+def run_multihost_maxsum_resumable(
+    dcop,
+    cycles: int = 15,
+    damping: float = 0.5,
+    activation: Optional[float] = None,
+    seed: int = 0,
+    use_packed: Optional[bool] = None,
+    chunk: int = 5,
+    start_cycle: int = 0,
+    state=None,
+    epoch: int = 0,
+    on_chunk=None,
+):
+    """Crash-resilient variant of :func:`run_multihost_maxsum`: the
+    solve advances in ``chunk``-cycle pieces, calling
+    ``on_chunk(done_cycles, sharded, q, r)`` at every boundary — the
+    hook is where the rank heartbeats its progress, saves periodic
+    checkpoints (rank 0) and consults its fault injector.
+
+    ``state`` (host arrays from ``ShardedMaxSum.state_to_host``) +
+    ``start_cycle``/``epoch`` resume a previous run mid-stream; for the
+    plain maxsum engines the chunked continuation is bit-identical to
+    an unchunked run (the per-cycle keys are unused), so a resumed run
+    lands on exactly the fault-free result.
+    """
+    from pydcop_tpu.ops.compile import compile_factor_graph
+    from pydcop_tpu.parallel.mesh import ShardedMaxSum
+
+    tensors = compile_factor_graph(dcop)
+    mesh = global_mesh()
+    sharded = ShardedMaxSum(tensors, mesh, damping=damping,
+                            activation=activation,
+                            use_packed=use_packed)
+    q = r = None
+    done = 0
+    if state is not None:
+        q, r = sharded.state_from_host(state)
+        sharded._epoch = int(epoch)
+        # never resume past the end: at least one cycle must run so the
+        # final values exist
+        done = max(0, min(int(start_cycle), cycles - 1))
+    values = None
+    while done < cycles:
+        n = max(1, min(chunk, cycles - done))
+        values, q, r = sharded.run(cycles=n, q=q, r=r, seed=seed)
+        done += n
+        if on_chunk is not None:
+            on_chunk(done, sharded, q, r)
+    return values, mesh.devices.size, tensors
+
+
 def run_multihost_local_search(dcop, rule: str = "mgm", cycles: int = 15,
                                seed: int = 0,
                                algo_params: Optional[dict] = None):

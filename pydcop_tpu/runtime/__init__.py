@@ -6,6 +6,7 @@ runtime compiles the problem to tensors, runs jitted round kernels, and
 reproduces the orchestration surface (deploy/run/pause/stop, scenario
 events, metrics collection) as host-side control flow.
 """
+from pydcop_tpu.runtime.faults import Fault, FaultPlan
 from pydcop_tpu.runtime.run import (
     run_local_process_dcop,
     run_local_thread_dcop,
@@ -14,4 +15,4 @@ from pydcop_tpu.runtime.run import (
 )
 
 __all__ = ["solve", "solve_result", "run_local_thread_dcop",
-           "run_local_process_dcop"]
+           "run_local_process_dcop", "Fault", "FaultPlan"]

@@ -1,52 +1,176 @@
-"""Checkpoint / resume of solver state.
+"""Durable checkpoint / resume of solver and mesh state.
 
 The reference has no checkpointing (resilience is replication-based,
 SURVEY.md §5); for a dense tensor solver a checkpoint is just the state
-pytree, so we add it: save/restore the solver's device state + metadata to
-a single .npz file.  Used by the orchestrator for resilience and by
-long-running batch solves.
+pytree, so we add it — and harden it for long-running jobs where a
+partial or bit-rotted file must NEVER be loaded as state:
+
+* **atomic write**: temp file in the same directory + flush + fsync +
+  ``os.replace`` — a crash mid-write leaves the previous snapshot
+  intact, never a half-written one under the final name;
+* **per-array CRC32** + a **schema version** in the metadata;
+  :func:`load_checkpoint` rejects truncated, corrupted or
+  version-mismatched files with a clear ``ValueError`` instead of
+  returning garbage state;
+* **periodic snapshots with rotation** via :class:`CheckpointManager`
+  (every *k* cycles, keep the newest *n*), whose ``latest_valid()``
+  transparently skips damaged snapshots — the auto-resume path of
+  runtime/process.py and the orchestrator.
 """
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, Optional, Tuple
+import logging
+import os
+import tempfile
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
-import jax
 import numpy as np
 
+logger = logging.getLogger(__name__)
 
-def save_checkpoint(path: str, solver, extra: Optional[Dict] = None) -> None:
+#: current checkpoint schema version.  v1 = the original unversioned,
+#: unchecksummed format (still readable); v2 adds per-array CRC32.
+CHECKPOINT_VERSION = 2
+
+
+# --------------------------------------------------------------------------
+# low-level hardened container (.npz + meta JSON + CRCs)
+# --------------------------------------------------------------------------
+
+def _crc(a: np.ndarray) -> int:
+    a = np.ascontiguousarray(a)
+    return zlib.crc32(a.tobytes()) & 0xFFFFFFFF
+
+
+def write_state_npz(path: str, arrays: Dict[str, np.ndarray],
+                    meta: Dict[str, Any]) -> None:
+    """Atomically persist ``arrays`` + ``meta`` to ``path``.
+
+    The metadata is stamped with the schema version and a CRC32 per
+    array; the write goes through a same-directory temp file + fsync +
+    rename so a crash at any point leaves either the old file or the
+    new one — never a torn mix.
+    """
+    meta = dict(meta)
+    meta["version"] = CHECKPOINT_VERSION
+    meta["crc"] = {k: _crc(np.asarray(v)) for k, v in arrays.items()}
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".ck_tmp_", suffix=".npz")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, __meta__=json.dumps(meta),
+                     **{k: np.asarray(v) for k, v in arrays.items()})
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def read_state_npz(path: str) -> Tuple[Dict[str, Any],
+                                       Dict[str, np.ndarray]]:
+    """Load and VERIFY a checkpoint container.
+
+    Raises ``ValueError`` (with the reason) on: unreadable/truncated
+    zip, missing metadata, unsupported schema version, or any array
+    whose CRC32 does not match the recorded one.  v1 files (no version
+    field, no CRCs) are still accepted — there is nothing to verify.
+    """
+    import zipfile
+
+    try:
+        with np.load(path, allow_pickle=False) as data:
+            if "__meta__" not in data:
+                raise ValueError(
+                    f"checkpoint {path!r} has no __meta__ entry — not a "
+                    f"pydcop_tpu checkpoint"
+                )
+            meta = json.loads(str(data["__meta__"]))
+            arrays = {k: data[k] for k in data.files if k != "__meta__"}
+    except (zipfile.BadZipFile, OSError, EOFError, KeyError) as e:
+        raise ValueError(
+            f"checkpoint {path!r} is unreadable or truncated: {e}"
+        ) from e
+    version = int(meta.get("version", 1))
+    if version > CHECKPOINT_VERSION:
+        raise ValueError(
+            f"checkpoint {path!r} has schema version {version}, this "
+            f"build reads <= {CHECKPOINT_VERSION} — refusing to guess"
+        )
+    crcs = meta.get("crc") or {}
+    for name, want in crcs.items():
+        if name not in arrays:
+            raise ValueError(
+                f"checkpoint {path!r} is missing array {name!r} listed "
+                f"in its checksum table — truncated or tampered file"
+            )
+        got = _crc(arrays[name])
+        if got != int(want):
+            raise ValueError(
+                f"checkpoint {path!r}: checksum mismatch on {name!r} "
+                f"(recorded {int(want):#010x}, computed {got:#010x}) — "
+                f"corrupt file, refusing to load"
+            )
+    return meta, arrays
+
+
+# --------------------------------------------------------------------------
+# solver-level save/load (thread-mode runtime)
+# --------------------------------------------------------------------------
+
+def save_checkpoint(path: str, solver, extra: Optional[Dict] = None,
+                    cycle: Optional[int] = None) -> None:
     """Persist a solver's last run state (host-transferred) + metadata."""
+    import jax
+
     state = getattr(solver, "_last_state", None)
     if state is None:
         raise ValueError("Solver has no state yet — run() it first")
     leaves, treedef = jax.tree.flatten(state)
     meta = {
+        "kind": "solver",
         "algo": solver.algo_def.algo,
         "params": solver.algo_def.params,
         "seed": solver.seed,
         "n_leaves": len(leaves),
         "extra": extra or {},
     }
+    if cycle is not None:
+        meta["cycle"] = int(cycle)
     arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
     # the PRNG key travels with the state: a warm run after restore must
     # CONTINUE the random stream, not replay it from the seed
     key = getattr(solver, "_last_key", None)
     if key is not None:
         arrays["__prng_key__"] = np.asarray(key)
-    np.savez(path, __meta__=json.dumps(meta), **arrays)
+    write_state_npz(path, arrays, meta)
 
 
 def load_checkpoint(path: str, solver) -> Dict[str, Any]:
     """Restore a solver's state; returns the checkpoint metadata.
 
-    The solver must have been built for the same problem (leaf shapes are
-    validated against a freshly initialized state).
+    The solver must have been built for the same problem (leaf shapes
+    are validated against a freshly initialized state).  Corrupt,
+    truncated or version-mismatched files raise ``ValueError`` before
+    any state is touched.
     """
-    with np.load(path, allow_pickle=False) as data:
-        meta = json.loads(str(data["__meta__"]))
-        leaves = [data[f"leaf_{i}"] for i in range(meta["n_leaves"])]
-        key = data["__prng_key__"] if "__prng_key__" in data else None
+    import jax
+
+    meta, arrays = read_state_npz(path)
+    try:
+        leaves = [arrays[f"leaf_{i}"] for i in range(meta["n_leaves"])]
+    except KeyError as e:
+        raise ValueError(
+            f"checkpoint {path!r} is missing state leaf {e} — truncated "
+            f"or foreign file"
+        ) from e
+    key = arrays.get("__prng_key__")
     ref_state = solver.initial_state()
     ref_leaves, treedef = jax.tree.flatten(ref_state)
     if len(ref_leaves) != len(leaves):
@@ -66,3 +190,103 @@ def load_checkpoint(path: str, solver) -> Dict[str, Any]:
 
         solver._last_key = jnp.asarray(key)
     return meta
+
+
+# --------------------------------------------------------------------------
+# snapshot directories: periodic saves + rotation + resume
+# --------------------------------------------------------------------------
+
+class CheckpointManager:
+    """Rotating snapshot directory: ``<dir>/ck_<cycle>.npz``.
+
+    ``save*()`` writes a snapshot for a cycle and prunes all but the
+    ``keep`` newest; ``latest_valid*()`` walks snapshots newest-first,
+    skipping (and logging) any that fail verification — one corrupt
+    file costs one snapshot of progress, not the run.
+    """
+
+    PREFIX = "ck_"
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = directory
+        self.keep = max(1, keep)
+
+    def path_for(self, cycle: int) -> str:
+        return os.path.join(self.directory,
+                            f"{self.PREFIX}{int(cycle):08d}.npz")
+
+    def snapshots(self) -> List[Tuple[int, str]]:
+        """(cycle, path) list, newest (highest cycle) first."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for name in names:
+            if not (name.startswith(self.PREFIX)
+                    and name.endswith(".npz")):
+                continue
+            try:
+                cycle = int(name[len(self.PREFIX):-len(".npz")])
+            except ValueError:
+                continue
+            out.append((cycle, os.path.join(self.directory, name)))
+        return sorted(out, reverse=True)
+
+    def latest(self) -> Optional[Tuple[int, str]]:
+        snaps = self.snapshots()
+        return snaps[0] if snaps else None
+
+    def _rotate(self) -> None:
+        for _cycle, path in self.snapshots()[self.keep:]:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    # -- raw state (mesh ranks) ---------------------------------------------
+
+    def save_state(self, cycle: int, arrays: Dict[str, np.ndarray],
+                   meta: Dict[str, Any]) -> str:
+        os.makedirs(self.directory, exist_ok=True)
+        meta = dict(meta)
+        meta["cycle"] = int(cycle)
+        path = self.path_for(cycle)
+        write_state_npz(path, arrays, meta)
+        self._rotate()
+        return path
+
+    def latest_valid_state(self) -> Optional[
+            Tuple[int, Dict[str, Any], Dict[str, np.ndarray]]]:
+        for cycle, path in self.snapshots():
+            try:
+                meta, arrays = read_state_npz(path)
+            except ValueError as e:
+                logger.warning("skipping damaged checkpoint %s: %s",
+                               path, e)
+                continue
+            return cycle, meta, arrays
+        return None
+
+    # -- solver state (thread-mode runtime) ---------------------------------
+
+    def save_solver(self, solver, cycle: int,
+                    extra: Optional[Dict] = None) -> str:
+        os.makedirs(self.directory, exist_ok=True)
+        path = self.path_for(cycle)
+        save_checkpoint(path, solver, extra=extra, cycle=cycle)
+        self._rotate()
+        return path
+
+    def load_latest_into(self, solver) -> Optional[Dict[str, Any]]:
+        """Restore the newest loadable snapshot into ``solver``; skips
+        corrupt files (logged) AND shape-mismatched ones (a different
+        problem's directory should not brick the run when resuming is
+        best-effort).  Returns its metadata, or None."""
+        for _cycle, path in self.snapshots():
+            try:
+                return load_checkpoint(path, solver)
+            except ValueError as e:
+                logger.warning("skipping unusable checkpoint %s: %s",
+                               path, e)
+        return None

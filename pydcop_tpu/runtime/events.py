@@ -41,3 +41,16 @@ class EventDispatcher:
 #: process-global bus, disabled unless observability is turned on
 #: (reference: Events.py event_bus :103)
 event_bus = EventDispatcher()
+
+
+#: fault/recovery topic prefix (runtime/faults.py).  Topics:
+#: ``faults.injected.<kind>``, ``faults.detected.<rank|agent>``,
+#: ``faults.recovered.<resume|repair|degrade>`` — subscribe with
+#: ``faults.*`` (the UI server pushes them to ws/SSE clients).
+FAULT_TOPIC_PREFIX = "faults."
+
+
+def send_fault(event: str, payload) -> None:
+    """Publish a fault/recovery event on the global bus (no-op unless
+    observability is enabled, like every other topic)."""
+    event_bus.send(FAULT_TOPIC_PREFIX + event, payload)

@@ -1,0 +1,370 @@
+"""Deterministic fault injection + liveness primitives.
+
+The reference's resilience story is exercised by scenario events
+(remove_agent) only; nothing in either codebase could *test* the
+machinery against real failures — a crashed OS rank, a stalled rank
+wedged inside a collective, a checkpoint file cut short by a power
+loss.  This module is the harness for all of those:
+
+* :class:`FaultPlan` — a seedable, YAML-loadable list of
+  :class:`Fault` specs (kill rank *r* at cycle *c*, stall a rank,
+  kill an agent mid-scenario, corrupt/truncate a checkpoint file).
+  Driven from ``pydcop_tpu run --fault-plan plan.yaml`` and usable
+  directly from tests.
+* :class:`RankFaultInjector` — the rank-side consumer: the multihost
+  agent consults it at every cycle-chunk boundary and the injector
+  kills (``os._exit``) or stalls (``SIGSTOP``) the process exactly
+  once per matching fault.
+* :class:`HeartbeatWriter` / :func:`stalled_ranks` — the liveness
+  channel between ranks and the coordinator watchdog: a daemon thread
+  touches a per-rank file; a rank whose heartbeat goes stale is
+  declared stalled.  ``SIGSTOP`` freezes the writer thread too, so an
+  injected stall is indistinguishable from a real one.
+* :func:`corrupt_checkpoint` — deterministic byte-flips / truncation
+  for hardening tests of runtime/checkpoint.py.
+
+Every random choice flows from an explicit seed; the same plan + seed
+produces the same failure at the same point on every run.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: exit code of a fault-injected rank kill — the coordinator watchdog
+#: classifies it (like signal deaths) as a retryable crash
+KILL_EXIT_CODE = 101
+
+#: env channel coordinator → ranks (a spawned rank cannot take the plan
+#: as a Python object); value is ``FaultPlan.to_json()``
+ENV_FAULT_PLAN = "PYDCOP_TPU_FAULT_PLAN"
+#: env channel for the launch attempt counter (0 on the first launch,
+#: +1 per watchdog relaunch) so faults can target one attempt only
+ENV_FAULT_ATTEMPT = "PYDCOP_TPU_FAULT_ATTEMPT"
+
+KINDS = ("kill_rank", "stall_rank", "kill_agent", "corrupt_checkpoint",
+         "truncate_checkpoint")
+
+
+@dataclasses.dataclass
+class Fault:
+    """One fault spec.  ``cycle`` faults fire at the first cycle-chunk
+    boundary >= cycle (rank faults) or phase boundary (agent faults);
+    ``attempt`` restricts a fault to one launch attempt (default 0 =
+    the first launch only, so a relaunch can demonstrate recovery;
+    None = every attempt)."""
+
+    kind: str
+    rank: Optional[int] = None  # kill_rank / stall_rank
+    cycle: int = 0
+    duration: float = 0.0  # stall_rank: seconds stopped
+    agent: Optional[str] = None  # kill_agent
+    path: Optional[str] = None  # checkpoint faults: explicit file
+    attempt: Optional[int] = 0
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; expected one of "
+                f"{KINDS}"
+            )
+        if self.kind in ("kill_rank", "stall_rank") and self.rank is None:
+            raise ValueError(f"{self.kind} fault needs a 'rank'")
+        if self.kind == "stall_rank" and self.duration <= 0:
+            raise ValueError("stall_rank fault needs a 'duration' > 0")
+        if self.kind == "kill_agent" and not self.agent:
+            raise ValueError("kill_agent fault needs an 'agent'")
+
+    def to_dict(self) -> Dict:
+        # 'attempt' must survive even as None (None = every attempt —
+        # dropping it would deserialize back to the default of 0)
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None or k == "attempt"}
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """An ordered, seedable set of faults.
+
+    YAML schema (see docs/resilience.rst)::
+
+        seed: 7
+        faults:
+          - kind: kill_rank
+            rank: 1
+            cycle: 8          # fire at first chunk boundary >= 8
+            attempt: 0        # first launch only (default)
+          - kind: stall_rank
+            rank: 0
+            cycle: 4
+            duration: 60      # seconds SIGSTOPped
+          - kind: kill_agent
+            agent: a3
+            cycle: 10         # thread-mode phase boundary
+          - kind: corrupt_checkpoint   # or truncate_checkpoint
+            attempt: 1        # mangle the latest snapshot before
+                              # relaunch attempt 1 resumes from it
+    """
+
+    faults: List[Fault] = dataclasses.field(default_factory=list)
+    seed: int = 0
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "FaultPlan":
+        if not isinstance(d, dict) or "faults" not in d:
+            raise ValueError(
+                "fault plan must be a mapping with a 'faults' list"
+            )
+        faults = []
+        for i, f in enumerate(d["faults"] or []):
+            if not isinstance(f, dict) or "kind" not in f:
+                raise ValueError(
+                    f"fault #{i} must be a mapping with a 'kind'"
+                )
+            known = {fl.name for fl in dataclasses.fields(Fault)}
+            unknown = set(f) - known
+            if unknown:
+                raise ValueError(
+                    f"fault #{i} has unknown fields {sorted(unknown)}"
+                )
+            faults.append(Fault(**f))
+        return cls(faults=faults, seed=int(d.get("seed", 0)))
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "FaultPlan":
+        import yaml
+
+        with open(path, encoding="utf-8") as f:
+            return cls.from_dict(yaml.safe_load(f))
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"seed": self.seed, "faults": [f.to_dict() for f in self.faults]}
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "FaultPlan":
+        return cls.from_dict(json.loads(s))
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        raw = os.environ.get(ENV_FAULT_PLAN)
+        return cls.from_json(raw) if raw else None
+
+    # -- queries ------------------------------------------------------------
+
+    def for_rank(self, rank: int) -> List[Fault]:
+        return [f for f in self.faults
+                if f.kind in ("kill_rank", "stall_rank") and f.rank == rank]
+
+    def agent_kills(self) -> List[Fault]:
+        return [f for f in self.faults if f.kind == "kill_agent"]
+
+    def checkpoint_faults(self, attempt: Optional[int] = None) -> List[Fault]:
+        out = [f for f in self.faults
+               if f.kind in ("corrupt_checkpoint", "truncate_checkpoint")]
+        if attempt is not None:
+            out = [f for f in out
+                   if f.attempt is None or f.attempt == attempt]
+        return out
+
+    @property
+    def has_rank_faults(self) -> bool:
+        return any(f.kind in ("kill_rank", "stall_rank")
+                   for f in self.faults)
+
+
+# --------------------------------------------------------------------------
+# rank-side injection
+# --------------------------------------------------------------------------
+
+def _default_stall(duration: float) -> None:
+    """Freeze THIS process (all threads, heartbeat writer included) for
+    ``duration`` seconds: a helper process sends SIGCONT later, then we
+    SIGSTOP ourselves.  From outside this is a genuine stall — exactly
+    what a wedged collective or a livelocked rank looks like."""
+    pid = os.getpid()
+    subprocess.Popen(
+        [sys.executable, "-c",
+         "import time, os, signal, sys\n"
+         f"time.sleep({float(duration)})\n"
+         "try:\n"
+         f"    os.kill({pid}, signal.SIGCONT)\n"
+         "except ProcessLookupError:\n"
+         "    pass\n"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    os.kill(pid, signal.SIGSTOP)
+
+
+class RankFaultInjector:
+    """Consulted by a mesh rank at every cycle-chunk boundary.
+
+    ``at_cycle(c)`` fires every not-yet-fired fault addressed to this
+    rank whose cycle is <= c and whose attempt matches — a kill
+    ``os._exit``\\ s with :data:`KILL_EXIT_CODE`, a stall freezes the
+    process.  The exit/stall hooks are injectable for unit tests.
+    """
+
+    def __init__(self, plan: FaultPlan, rank: int,
+                 attempt: Optional[int] = None,
+                 _exit=os._exit, _stall=_default_stall):
+        if attempt is None:
+            attempt = int(os.environ.get(ENV_FAULT_ATTEMPT, "0"))
+        self.rank = rank
+        self.attempt = attempt
+        self._exit = _exit
+        self._stall = _stall
+        self._pending = [
+            f for f in plan.for_rank(rank)
+            if f.attempt is None or f.attempt == attempt
+        ]
+
+    @property
+    def cycle_faults_pending(self) -> bool:
+        return bool(self._pending)
+
+    def next_cycle(self) -> Optional[int]:
+        """The earliest pending fault cycle (chunking hint), or None."""
+        return min((f.cycle for f in self._pending), default=None)
+
+    def at_cycle(self, cycle: int) -> None:
+        due = [f for f in self._pending if f.cycle <= cycle]
+        self._pending = [f for f in self._pending if f.cycle > cycle]
+        for f in due:
+            if f.kind == "stall_rank":
+                self._stall(f.duration)
+            elif f.kind == "kill_rank":
+                self._exit(KILL_EXIT_CODE)
+
+
+# --------------------------------------------------------------------------
+# liveness: heartbeat files + stall detection
+# --------------------------------------------------------------------------
+
+class HeartbeatWriter:
+    """Daemon thread touching ``path`` every ``interval`` seconds.
+
+    Started before the rank's heavy imports so the watchdog sees a live
+    rank from the first second.  A SIGSTOP (injected or real) freezes
+    this thread with the rest of the process, so staleness of the file
+    is a faithful liveness signal — unlike a heartbeat written only
+    from the main solve loop, it does NOT go stale during long compiles.
+    """
+
+    def __init__(self, path: str, interval: float = 0.5):
+        self.path = path
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self) -> None:
+        with open(self.path, "a", encoding="utf-8"):
+            os.utime(self.path, None)
+
+    def start(self) -> "HeartbeatWriter":
+        self.beat()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"heartbeat-{os.path.basename(self.path)}",
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.beat()
+            except OSError:  # pragma: no cover - tmpdir vanished
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def stalled_ranks(
+    hb_paths: Dict[int, str],
+    stall_timeout: float,
+    now: Optional[float] = None,
+) -> List[int]:
+    """Ranks whose heartbeat file exists but has not been touched for
+    more than ``stall_timeout`` seconds.  A missing file is NOT a stall
+    (the rank may still be forking); rank death is detected separately
+    through the exit code."""
+    now = time.time() if now is None else now
+    out = []
+    for rank, path in sorted(hb_paths.items()):
+        try:
+            age = now - os.stat(path).st_mtime
+        except OSError:
+            continue
+        if age > stall_timeout:
+            out.append(rank)
+    return out
+
+
+# --------------------------------------------------------------------------
+# checkpoint file faults
+# --------------------------------------------------------------------------
+
+def corrupt_checkpoint(path: str, seed: int = 0,
+                       mode: str = "corrupt") -> None:
+    """Deterministically damage a checkpoint file in place.
+
+    ``mode='corrupt'`` flips 16 bytes in the data region (positions
+    drawn from ``random.Random(seed)``); ``mode='truncate'`` cuts the
+    file to a seed-chosen fraction (30-70%) of its length.  Same seed,
+    same file size → same damage, so tests are reproducible.
+    """
+    import random
+
+    rng = random.Random(seed)
+    size = os.path.getsize(path)
+    if mode == "truncate":
+        keep = max(1, int(size * (0.3 + 0.4 * rng.random())))
+        with open(path, "r+b") as f:
+            f.truncate(keep)
+        return
+    if mode != "corrupt":
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    with open(path, "r+b") as f:
+        # skip the first 512 bytes: flipping the zip local-file header
+        # is indistinguishable from truncation; aim at array data so
+        # the CRC check (not the zip layer) is what must catch it
+        lo = min(512, size // 2)
+        for _ in range(16):
+            pos = rng.randrange(lo, size)
+            f.seek(pos)
+            b = f.read(1)
+            f.seek(pos)
+            f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+
+
+def apply_checkpoint_faults(plan: FaultPlan, directory: Optional[str],
+                            attempt: int) -> List[str]:
+    """Host-side: fire the plan's checkpoint faults due at ``attempt``
+    against their explicit paths or the newest snapshot in
+    ``directory``.  Returns the damaged paths (for logging/metrics)."""
+    from pydcop_tpu.runtime.checkpoint import CheckpointManager
+
+    damaged = []
+    for f in plan.checkpoint_faults(attempt):
+        path = f.path
+        if path is None and directory:
+            latest = CheckpointManager(directory).latest()
+            path = latest[1] if latest else None
+        if path and os.path.exists(path):
+            mode = ("truncate" if f.kind == "truncate_checkpoint"
+                    else "corrupt")
+            corrupt_checkpoint(path, seed=plan.seed, mode=mode)
+            damaged.append(path)
+    return damaged

@@ -27,7 +27,9 @@ from pydcop_tpu.distribution.objects import Distribution
 from pydcop_tpu.graph import load_graph_module
 from pydcop_tpu.replication import ReplicaDistribution, place_replicas
 from pydcop_tpu.reparation import build_repair_dcop, solve_repair_dcop
-from pydcop_tpu.runtime.events import event_bus
+from pydcop_tpu.runtime.events import event_bus, send_fault
+from pydcop_tpu.runtime.faults import FaultPlan
+from pydcop_tpu.runtime.stats import FaultCounters
 
 
 class VirtualOrchestrator:
@@ -41,6 +43,10 @@ class VirtualOrchestrator:
         period: Optional[float] = None,
         collector: Optional[Callable[[float, Dict], None]] = None,
         seed: int = 0,
+        fault_plan: Optional[FaultPlan] = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 10,
+        auto_resume: bool = False,
     ):
         self.dcop = dcop
         self.algo_def = (
@@ -85,6 +91,20 @@ class VirtualOrchestrator:
         self.start_time: Optional[float] = None
         #: measured device rate (cycles/s) for scenario delay budgets
         self._cycle_rate: Optional[float] = None
+        # -- resilience: fault injection + checkpoint/auto-resume ----------
+        self.fault_plan = fault_plan
+        self.fault_counters = FaultCounters()
+        self._pending_agent_kills = list(
+            fault_plan.agent_kills()) if fault_plan else []
+        self.checkpoint_every = max(1, checkpoint_every)
+        self.auto_resume = auto_resume
+        self._ckpt_mgr = None
+        self._last_ckpt_cycle = 0
+        self._resume_done = False
+        if checkpoint_dir:
+            from pydcop_tpu.runtime.checkpoint import CheckpointManager
+
+            self._ckpt_mgr = CheckpointManager(checkpoint_dir)
 
     # -- lifecycle (reference: deploy/run/pause/stop broadcasts) ------------
 
@@ -168,7 +188,70 @@ class VirtualOrchestrator:
                 self.collector(h["time"], m)
                 self.run_metrics_log.append(m)
         event_bus.send("computations.cycle.*", self._cycles_done)
+        self._fire_due_agent_kills()
+        self._maybe_checkpoint()
         return res
+
+    # -- resilience hooks (phase boundaries) --------------------------------
+
+    def _fire_due_agent_kills(self) -> None:
+        """Fault-plan agent kills fire at the first phase boundary past
+        their cycle — the fault-injection twin of a scenario's
+        remove_agent event, routed through the same replica-repair
+        handshake."""
+        due = [f for f in self._pending_agent_kills
+               if f.cycle <= self._cycles_done]
+        self._pending_agent_kills = [
+            f for f in self._pending_agent_kills
+            if f.cycle > self._cycles_done
+        ]
+        for f in due:
+            if f.agent not in self.dcop.agents:
+                continue  # already removed (scenario or earlier fault)
+            self.fault_counters.inc("faults_injected")
+            send_fault("injected.kill_agent", {
+                "agent": f.agent, "cycle": self._cycles_done,
+            })
+            self._agents_removal([f.agent])
+            self.events_log.append(
+                {"fault": "kill_agent", "agent": f.agent,
+                 "cycle": self._cycles_done}
+            )
+
+    def _maybe_checkpoint(self) -> None:
+        if self._ckpt_mgr is None:
+            return
+        if self._cycles_done - self._last_ckpt_cycle < self.checkpoint_every:
+            return
+        if getattr(self.solver, "_last_state", None) is None:
+            return  # host-driven solver without retained device state
+        try:
+            self._ckpt_mgr.save_solver(self.solver, self._cycles_done)
+        except ValueError:
+            return
+        self._last_ckpt_cycle = self._cycles_done
+        self.fault_counters.inc("checkpoints_saved")
+
+    def _maybe_resume(self) -> None:
+        """Auto-resume: warm-start from the newest valid snapshot once,
+        before the first phase (corrupt snapshots are skipped by the
+        manager with a warning — one bad file must not cost the run)."""
+        if not (self.auto_resume and self._ckpt_mgr) or self._resume_done:
+            return
+        self._resume_done = True
+        n_snaps = len(self._ckpt_mgr.snapshots())
+        meta = self._ckpt_mgr.load_latest_into(self.solver)
+        if meta is None:
+            if n_snaps:
+                self.fault_counters.inc("checkpoints_rejected", n_snaps)
+            return
+        self._resume_next = True
+        cycle = int(meta.get("cycle", 0) or 0)
+        self._cycles_done = cycle
+        self._last_ckpt_cycle = cycle
+        self.fault_counters.inc("resumes")
+        send_fault("recovered.resume", {"cycle": cycle})
+        self.events_log.append({"resumed_from": cycle})
 
     def run(
         self,
@@ -190,11 +273,12 @@ class VirtualOrchestrator:
         if self.status == "INITIAL":
             self.deploy_computations()
         self.status = "RUNNING"
+        self._maybe_resume()
         resume = getattr(self, "_resume_next", False)
         self._resume_next = False
 
         if scenario is None or not len(scenario):
-            res = self._run_phase(cycles, timeout, resume=resume)
+            res = self._run_plain(cycles, timeout, resume=resume)
             self.status = res.status
             return self._finalize(res)
         res: Optional[SolveResult] = None
@@ -248,6 +332,31 @@ class VirtualOrchestrator:
             res.status = "TIMEOUT"
         self.status = res.status
         return self._finalize(res)
+
+    def _run_plain(self, cycles: Optional[int], timeout: Optional[float],
+                   resume: bool) -> SolveResult:
+        """A scenario-less run; with an explicit cycle budget the run is
+        split at fault-plan agent-kill cycles (so each kill fires
+        MID-run and the solve re-converges after the repair) and at
+        checkpoint boundaries (so snapshots land every *k* cycles, not
+        only at the end).  With no explicit budget the solver runs its
+        default phase unbroken."""
+        target = None if cycles is None else self._cycles_done + cycles
+        res = None
+        while True:
+            n = cycles
+            stops = [f.cycle for f in self._pending_agent_kills
+                     if f.cycle > self._cycles_done]
+            if self._ckpt_mgr is not None:
+                stops.append(self._cycles_done + self.checkpoint_every)
+            if target is not None:
+                stop = min(stops + [target])
+                n = stop - self._cycles_done
+            res = self._run_phase(n, timeout, resume=resume)
+            resume = True
+            if target is None or self._cycles_done >= target \
+                    or res.status == "TIMEOUT":
+                return res
 
     #: cycles of the rate-calibration phase (first delay event) and the
     #: upper bound on any single delay phase's budget
@@ -316,6 +425,14 @@ class VirtualOrchestrator:
     def _finalize(self, res: SolveResult) -> SolveResult:
         res.cycle = self._cycles_done
         res.time = perf_counter() - self.start_time
+        if self._ckpt_mgr is not None \
+                and self._cycles_done > self._last_ckpt_cycle:
+            # final snapshot: a new orchestrator can auto-resume from
+            # exactly where this run ended
+            self._last_ckpt_cycle = self._cycles_done
+            if getattr(self.solver, "_last_state", None) is not None:
+                self._ckpt_mgr.save_solver(self.solver, self._cycles_done)
+                self.fault_counters.inc("checkpoints_saved")
         return res
 
     # -- scenario actions ---------------------------------------------------
@@ -407,6 +524,10 @@ class VirtualOrchestrator:
         for comp, agent in placement.items():
             self.distribution.host_on_agent(agent, [comp])
         self.events_log.append({"repaired": placement})
+        self.fault_counters.inc("repairs")
+        send_fault("recovered.repair", {
+            "orphans": orphans, "placement": placement,
+        })
 
     # -- metrics ------------------------------------------------------------
 
@@ -419,4 +540,5 @@ class VirtualOrchestrator:
         if self.replicas is not None:
             m["replicas"] = self.replicas.mapping()
         m["events"] = self.events_log
+        m["resilience"] = self.fault_counters.as_dict()
         return m

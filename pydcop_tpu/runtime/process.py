@@ -10,6 +10,18 @@ HTTP message traffic.  Ranks are the existing ``pydcop_tpu agent
 --multihost`` CLI workers, spawned on localhost with an OS-assigned
 coordinator port.
 
+Crash resilience: every rank heartbeats a per-rank file
+(runtime/faults.py HeartbeatWriter) and a coordinator watchdog monitors
+exit codes + heartbeat staleness.  A rank that dies by signal or by an
+injected kill, or whose heartbeat goes stale (a wedged collective),
+triggers a clean teardown of the whole mesh (no orphan processes, no
+indefinite hang) and a relaunch with exponential backoff that resumes
+from the latest valid checkpoint (maxsum family, ``checkpoint_every``);
+after ``max_retries`` failed relaunches the solve degrades to thread
+mode instead of failing.  Deterministic rank *errors* (a Python
+exception, a bad argument) still raise immediately — retrying a
+deterministic bug only hides it.
+
 Scope (documented deviation): the multi-process mesh executes the sharded
 engine families — factor-graph BP (maxsum/amaxsum) and local search
 (mgm/dsa/dba/gdba).  Dynamic scenarios and per-cycle collection remain
@@ -19,11 +31,14 @@ ncbb) gain nothing from extra processes and are rejected loudly.
 from __future__ import annotations
 
 import json
+import logging
 import os
+import shutil
 import socket
 import subprocess
 import sys
 import tempfile
+import time
 from time import perf_counter
 from typing import Any, Dict, List, Optional, Union
 
@@ -33,6 +48,18 @@ from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.distribution import load_distribution_module
 from pydcop_tpu.distribution.objects import Distribution
 from pydcop_tpu.graph import load_graph_module
+from pydcop_tpu.runtime.events import send_fault
+from pydcop_tpu.runtime.faults import (
+    ENV_FAULT_ATTEMPT,
+    ENV_FAULT_PLAN,
+    KILL_EXIT_CODE,
+    FaultPlan,
+    apply_checkpoint_faults,
+    stalled_ranks,
+)
+from pydcop_tpu.runtime.stats import FaultCounters
+
+logger = logging.getLogger(__name__)
 
 #: algorithms with a sharded multi-process engine (parallel/multihost.py)
 PROCESS_MODE_ALGOS = ("maxsum", "amaxsum", "mgm", "dsa", "dba", "gdba")
@@ -62,6 +89,25 @@ _BIND_FAILURE_TOKENS = (
 )
 
 
+class _RankFailure(RuntimeError):
+    """A RETRYABLE rank failure: killed by signal / injected kill, or
+    declared stalled by the heartbeat watchdog.  Deterministic errors
+    (clean nonzero exits) do NOT raise this — they raise RuntimeError
+    straight out, as retrying a reproducible bug only hides it."""
+
+    def __init__(self, rank: int, stalled: bool,
+                 returncode: Optional[int] = None, stderr: str = ""):
+        self.rank = rank
+        self.stalled = stalled
+        self.returncode = returncode
+        self.stderr = stderr
+        what = (
+            f"stalled (heartbeat stale)" if stalled
+            else f"died (rc={returncode})"
+        )
+        super().__init__(f"process-mode rank {rank} {what}")
+
+
 class ProcessOrchestrator:
     """Orchestrates a solve across N real localhost processes.
 
@@ -80,6 +126,15 @@ class ProcessOrchestrator:
         n_processes: int = 2,
         platform: Optional[str] = "cpu",
         local_devices: Optional[int] = None,
+        fault_plan: Optional[FaultPlan] = None,
+        stall_timeout: float = 30.0,
+        heartbeat_interval: float = 0.5,
+        max_retries: int = 2,
+        backoff_base: float = 0.5,
+        backoff_max: float = 8.0,
+        checkpoint_every: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        degrade_to_thread: bool = True,
     ):
         if n_processes < 1:
             raise ValueError("n_processes must be >= 1")
@@ -117,10 +172,22 @@ class ProcessOrchestrator:
         self.n_processes = n_processes
         self.platform = platform
         self.local_devices = local_devices
+        self.fault_plan = fault_plan
+        self.stall_timeout = stall_timeout
+        self.heartbeat_interval = heartbeat_interval
+        self.max_retries = max_retries
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.checkpoint_every = checkpoint_every
+        self.checkpoint_dir = checkpoint_dir
+        self.degrade_to_thread = degrade_to_thread
+        self.fault_counters = FaultCounters()
+        self.fault_log: List[Dict[str, Any]] = []
         self.status = "INITIAL"
         self._procs: List[subprocess.Popen] = []
         self._last_result: Optional[SolveResult] = None
         self._dcop_file: Optional[str] = None
+        self._owns_ckpt_dir = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -143,11 +210,20 @@ class ProcessOrchestrator:
         with os.fdopen(fd, "w", encoding="utf-8") as f:
             f.write(dcop_yaml(self.dcop))
         self._dcop_file = path
+        if self.checkpoint_every and not self.checkpoint_dir:
+            # the snapshot directory must OUTLIVE each launch attempt
+            # (a relaunch resumes from it), so it is not part of the
+            # per-attempt rank tmpdir
+            self.checkpoint_dir = tempfile.mkdtemp(
+                prefix="pydcop_tpu_ckpt_"
+            )
+            self._owns_ckpt_dir = True
         self.status = "DEPLOYED"
 
     def _spawn(self, rank: int, port: int, cycles: int,
                timeout: Optional[float], out_file: str,
-               err_file) -> subprocess.Popen:
+               err_file, hb_file: Optional[str] = None,
+               attempt: int = 0) -> subprocess.Popen:
         cmd = [
             sys.executable, "-m", "pydcop_tpu",
             "--output", out_file,
@@ -167,10 +243,19 @@ class ProcessOrchestrator:
             cmd += ["--platform", self.platform]
         if self.local_devices:
             cmd += ["--local-devices", str(self.local_devices)]
+        if hb_file:
+            cmd += ["--heartbeat-file", hb_file,
+                    "--heartbeat-interval", str(self.heartbeat_interval)]
+        if self.checkpoint_every and self.checkpoint_dir:
+            cmd += ["--checkpoint-dir", self.checkpoint_dir,
+                    "--checkpoint-every", str(self.checkpoint_every)]
         for name, value in (self.algo_def.params or {}).items():
             if value is not None:
                 cmd += ["--algo_params", f"{name}:{value}"]
         env = {**os.environ}
+        env[ENV_FAULT_ATTEMPT] = str(attempt)
+        if self.fault_plan is not None and self.fault_plan.has_rank_faults:
+            env[ENV_FAULT_PLAN] = self.fault_plan.to_json()
         env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get(
             "PYTHONPATH", ""
         )
@@ -188,59 +273,96 @@ class ProcessOrchestrator:
             text=True, env=env,
         )
 
-    def _run_once(self, n_cycles: int, timeout: Optional[float]):
-        """One rendezvous attempt: spawn every rank, wait, parse.
-        Returns the per-rank result dicts, or None on timeout (budget
-        exhausted or a rank force-exited by the CLI watchdog)."""
+    #: watchdog poll period (exit codes + heartbeat staleness)
+    WATCH_POLL = 0.1
+
+    def _classify_exit(self, rank: int, rc: int, err_path: str):
+        """Map a nonzero rank exit onto the failure taxonomy (raises)."""
+        try:
+            with open(err_path, encoding="utf-8") as f:
+                stderr = f.read()
+        except OSError:
+            stderr = ""
+        if rc == KILL_EXIT_CODE or rc < 0:
+            # injected kill or death by signal (OOM-kill, preemption,
+            # kill -9 ...) — the retryable class
+            raise _RankFailure(rank, stalled=False, returncode=rc,
+                               stderr=stderr[-2000:])
+        low = stderr.lower()
+        if any(t in low for t in _BIND_FAILURE_TOKENS):
+            raise _CoordinatorBindError(stderr[-500:])
+        raise RuntimeError(
+            f"process-mode rank failed "
+            f"(rc={rc}): {stderr[-2000:]}"
+        )
+
+    def _run_once(self, n_cycles: int, timeout: Optional[float],
+                  attempt: int = 0):
+        """One rendezvous attempt: spawn every rank, watch, parse.
+
+        The watchdog loop polls exit codes and heartbeat files: a rank
+        dead by signal/injected kill raises :class:`_RankFailure`
+        (retryable), a stale heartbeat raises it with ``stalled=True``,
+        a deterministic error raises RuntimeError, and the whole mesh
+        is torn down in ``finally`` on every path — no orphans, no
+        indefinite hang.  Returns the per-rank result dicts, or None on
+        timeout (budget exhausted or a rank force-exited by the CLI
+        watchdog).
+        """
         port = _free_port()
         tmpdir = tempfile.mkdtemp(prefix="pydcop_tpu_ranks_")
         out_files: List[str] = []
         err_paths: List[str] = []
+        hb_paths: Dict[int, str] = {}
         err_handles = []
         try:
             for rank in range(self.n_processes):
                 out_file = os.path.join(tmpdir, f"rank{rank}.json")
                 err_path = os.path.join(tmpdir, f"rank{rank}.err")
+                hb_path = os.path.join(tmpdir, f"rank{rank}.hb")
                 out_files.append(out_file)
                 err_paths.append(err_path)
+                hb_paths[rank] = hb_path
                 fh = open(err_path, "w", encoding="utf-8")
                 err_handles.append(fh)
                 self._procs.append(
                     self._spawn(rank, port, n_cycles, timeout, out_file,
-                                fh)
+                                fh, hb_file=hb_path, attempt=attempt)
                 )
             self.status = "RUNNING"
+            deadline = None
+            if timeout is not None:
+                # generous grace over the solve timeout: rank startup +
+                # gloo rendezvous + compile are not solve time
+                deadline = perf_counter() + max(30.0, timeout * 3)
+            procs = list(self._procs)
+            pending = set(range(self.n_processes))
+            while pending:
+                for rank in sorted(pending):
+                    rc = procs[rank].poll()
+                    if rc is None:
+                        continue
+                    pending.discard(rank)
+                    if rc == 0:
+                        continue
+                    if rc == 42:
+                        # the rank's own CLI watchdog force-exited it
+                        # at timeout + slack (cli.py TIMEOUT_SLACK)
+                        return None
+                    self._classify_exit(rank, rc, err_paths[rank])
+                if not pending:
+                    break
+                stalled = stalled_ranks(
+                    {r: hb_paths[r] for r in pending},
+                    self.stall_timeout,
+                )
+                if stalled:
+                    raise _RankFailure(stalled[0], stalled=True)
+                if deadline is not None and perf_counter() > deadline:
+                    return None
+                time.sleep(self.WATCH_POLL)
             results = []
-            for p, out_file, err_path in zip(
-                self._procs, out_files, err_paths
-            ):
-                budget = None
-                if timeout is not None:
-                    # generous grace over the solve timeout: rank
-                    # startup + gloo rendezvous + compile are not solve
-                    # time
-                    budget = max(30.0, timeout * 3)
-                try:
-                    p.wait(timeout=budget)
-                except subprocess.TimeoutExpired:
-                    return None
-                if p.returncode == 42:
-                    # the rank's own CLI watchdog force-exited it at
-                    # timeout + slack (cli.py TIMEOUT_SLACK)
-                    return None
-                if p.returncode != 0:
-                    try:
-                        with open(err_path, encoding="utf-8") as f:
-                            stderr = f.read()
-                    except OSError:
-                        stderr = ""
-                    low = stderr.lower()
-                    if any(t in low for t in _BIND_FAILURE_TOKENS):
-                        raise _CoordinatorBindError(stderr[-500:])
-                    raise RuntimeError(
-                        f"process-mode rank failed "
-                        f"(rc={p.returncode}): {stderr[-2000:]}"
-                    )
+            for out_file in out_files:
                 with open(out_file, encoding="utf-8") as f:
                     results.append(json.load(f))
             return results
@@ -248,7 +370,7 @@ class ProcessOrchestrator:
             self._kill_all()
             for fh in err_handles:
                 fh.close()
-            for f in out_files + err_paths:
+            for f in out_files + err_paths + list(hb_paths.values()):
                 try:
                     os.unlink(f)
                 except OSError:
@@ -275,16 +397,64 @@ class ProcessOrchestrator:
         n_cycles = cycles if cycles is not None else 30
         t0 = perf_counter()
         results = None
-        for attempt in range(3):
+        bind_failures = 0
+        attempt = 0  # fault-relaunch attempt (0 = first launch)
+        while True:
+            if self.fault_plan is not None:
+                damaged = apply_checkpoint_faults(
+                    self.fault_plan, self.checkpoint_dir, attempt
+                )
+                if damaged:
+                    self.fault_counters.inc("faults_injected",
+                                            len(damaged))
+                    self.fault_log.append(
+                        {"fault": "checkpoint", "paths": damaged,
+                         "attempt": attempt}
+                    )
             try:
-                results = self._run_once(n_cycles, timeout)
+                results = self._run_once(n_cycles, timeout, attempt)
                 break
             except _CoordinatorBindError:
                 # _free_port() is inherently racy (the probed port is
                 # released before rank 0 re-binds it as coordinator);
-                # retry the whole rendezvous on a fresh port
-                if attempt == 2:
+                # retry the whole rendezvous on a fresh port.  Not a
+                # fault: does not consume a fault-retry attempt.
+                bind_failures += 1
+                if bind_failures >= 3:
                     raise
+            except _RankFailure as failure:
+                kind = "rank_stalls" if failure.stalled \
+                    else "rank_crashes"
+                self.fault_counters.inc(kind)
+                if failure.returncode == KILL_EXIT_CODE:
+                    # the kill was ours (fault plan), not the world's
+                    self.fault_counters.inc("faults_injected")
+                self.fault_log.append({
+                    "fault": "stall" if failure.stalled else "crash",
+                    "rank": failure.rank,
+                    "returncode": failure.returncode,
+                    "attempt": attempt,
+                })
+                send_fault(f"detected.rank{failure.rank}", {
+                    "rank": failure.rank,
+                    "stalled": failure.stalled,
+                    "attempt": attempt,
+                })
+                logger.warning("watchdog: %s (attempt %d)", failure,
+                               attempt)
+                if attempt >= self.max_retries:
+                    if self.degrade_to_thread:
+                        return self._degrade(n_cycles, timeout, t0,
+                                             failure)
+                    raise RuntimeError(
+                        f"{failure}; giving up after {attempt} "
+                        f"relaunch(es)"
+                    ) from failure
+                delay = min(self.backoff_max,
+                            self.backoff_base * (2 ** attempt))
+                time.sleep(delay)
+                attempt += 1
+                self.fault_counters.inc("retries")
         if results is None:  # timed out
             self.status = "TIMEOUT"
             self._last_result = SolveResult(
@@ -296,6 +466,12 @@ class ProcessOrchestrator:
 
         # SPMD invariant: every rank computed the same global solve
         first = results[0]
+        if int(first.get("resumed_from", 0) or 0) > 0:
+            self.fault_counters.inc("resumes")
+            send_fault("recovered.resume", {
+                "cycle": int(first["resumed_from"]),
+                "attempt": attempt,
+            })
         for other in results[1:]:
             if other["assignment"] != first["assignment"]:
                 raise RuntimeError(
@@ -321,6 +497,29 @@ class ProcessOrchestrator:
         self.n_global_devices = int(first.get("n_global_devices", 0))
         return self._last_result
 
+    def _degrade(self, n_cycles: int, timeout: Optional[float],
+                 t0: float, failure: _RankFailure) -> SolveResult:
+        """Last-resort graceful degradation: after max_retries failed
+        relaunches the solve runs in thread mode (one process IS the
+        whole agent population) — slower scale-out, same answer."""
+        logger.error(
+            "process mode unrecoverable after %d relaunch(es) (%s); "
+            "degrading to thread mode", self.max_retries, failure,
+        )
+        self.fault_counters.inc("degraded_to_thread")
+        send_fault("recovered.degrade", {"reason": str(failure)})
+        from pydcop_tpu.runtime.run import solve_result
+
+        res = solve_result(
+            self.dcop, self.algo_def, timeout=timeout,
+            cycles=n_cycles, seed=self.seed,
+        )
+        res.time = perf_counter() - t0
+        self._last_result = res
+        self.status = res.status
+        self.n_global_devices = 0
+        return res
+
     def _kill_all(self) -> None:
         for p in self._procs:
             if p.poll() is None:
@@ -343,6 +542,10 @@ class ProcessOrchestrator:
             except OSError:
                 pass
             self._dcop_file = None
+        if self._owns_ckpt_dir and self.checkpoint_dir:
+            shutil.rmtree(self.checkpoint_dir, ignore_errors=True)
+            self.checkpoint_dir = None
+            self._owns_ckpt_dir = False
         if self.status != "FINISHED":
             self.status = "STOPPED"
 
@@ -353,4 +556,13 @@ class ProcessOrchestrator:
         m["status"] = self.status
         m["distribution"] = self.distribution.mapping()
         m["n_processes"] = self.n_processes
+        if self.checkpoint_dir:
+            from pydcop_tpu.runtime.checkpoint import CheckpointManager
+
+            self.fault_counters.counts["checkpoints_saved"] = len(
+                CheckpointManager(self.checkpoint_dir).snapshots()
+            )
+        m["resilience"] = self.fault_counters.as_dict()
+        if self.fault_log:
+            m["fault_log"] = list(self.fault_log)
         return m

@@ -37,6 +37,9 @@ def solve_result(
     algo_params: Optional[Dict[str, Any]] = None,
     seed: int = 0,
     collect_cycles: bool = False,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: Optional[int] = None,
+    resume: bool = False,
 ) -> SolveResult:
     """Solve a DCOP and return the full result + metrics.
 
@@ -47,6 +50,12 @@ def solve_result(
     actually drives execution — factors are sharded onto the device mesh
     by their host agents (reference parity: pydcop/commands/solve.py
     :483-507 runs under the given placement).
+
+    ``checkpoint_dir`` + ``checkpoint_every`` persist rotating state
+    snapshots every *k* cycles (runtime/checkpoint.CheckpointManager);
+    ``resume=True`` warm-starts from the newest valid snapshot in that
+    directory (corrupt snapshots are skipped with a warning).  Not
+    supported on the placement-driven path.
     """
     from pydcop_tpu.distribution.objects import Distribution
 
@@ -54,6 +63,12 @@ def solve_result(
     algo_module = load_algorithm_module(algo_def.algo)
 
     if isinstance(distribution, Distribution):
+        if checkpoint_dir or resume:
+            raise ValueError(
+                "checkpointing is not supported on the placement-"
+                "driven solve path; rerun without an explicit "
+                "distribution object"
+            )
         # placement-driven path compiles straight from the dcop; don't
         # build the computation graph it would never read
         return _solve_under_placement(
@@ -83,9 +98,78 @@ def solve_result(
         if cycles is not None
         else (algo_def.params.get("stop_cycle") or None)
     )
+    if checkpoint_dir:
+        return _run_with_checkpoints(
+            solver, checkpoint_dir, checkpoint_every or 10, stop_cycle,
+            timeout, resume, collect_cycles,
+        )
     return solver.run(
         cycles=stop_cycle, timeout=timeout, collect_cycles=collect_cycles
     )
+
+
+def _run_with_checkpoints(
+    solver,
+    checkpoint_dir: str,
+    checkpoint_every: int,
+    cycles: Optional[int],
+    timeout: Optional[float],
+    resume: bool,
+    collect_cycles: bool,
+) -> SolveResult:
+    """Chunked solver run with periodic rotating snapshots.
+
+    Every ``checkpoint_every`` cycles the solver state is snapshotted
+    (atomic + checksummed); with ``resume`` the newest valid snapshot
+    warm-starts the run and only the remaining cycles execute.  With no
+    explicit cycle budget the run executes the solver's default budget
+    with a final snapshot at the end.
+    """
+    from time import perf_counter
+
+    from pydcop_tpu.runtime.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(checkpoint_dir)
+    done = 0
+    warm = False
+    if resume:
+        meta = mgr.load_latest_into(solver)
+        if meta is not None:
+            done = int(meta.get("cycle", 0) or 0)
+            warm = True
+    if cycles is None:
+        res = solver.run(timeout=timeout, collect_cycles=collect_cycles,
+                         resume=warm)
+        mgr.save_solver(solver, done + res.cycle)
+        return res
+    t0 = perf_counter()
+    every = max(1, checkpoint_every)
+    res = None
+    history = []
+    while done < cycles:
+        n = min(every, cycles - done)
+        left = None if timeout is None else timeout - (perf_counter() - t0)
+        if left is not None and left <= 0:
+            break
+        res = solver.run(cycles=n, timeout=left,
+                         collect_cycles=collect_cycles, resume=warm)
+        warm = True
+        done += res.cycle
+        if res.history:
+            history.extend(res.history)
+        mgr.save_solver(solver, done)
+        if res.status == "TIMEOUT":
+            break
+    if res is None:  # resumed at/after the requested budget
+        res = solver.run(cycles=1, collect_cycles=collect_cycles,
+                         resume=warm)
+        done += res.cycle
+        mgr.save_solver(solver, done)
+    res.cycle = done
+    res.time = perf_counter() - t0
+    if history:
+        res.history = history
+    return res
 
 
 def _solve_under_placement(
@@ -264,6 +348,7 @@ def run_local_process_dcop(
     n_processes: int = 2,
     platform: Optional[str] = "cpu",
     local_devices: Optional[int] = None,
+    **resilience: Any,
 ):
     """Reference-parity constructor (infrastructure/run.py:225-287):
     returns a deployed orchestrator whose solve REALLY runs across
@@ -279,13 +364,19 @@ def run_local_process_dcop(
     deviation).  ``platform`` defaults to "cpu" so localhost ranks never
     fight over a single-tenant TPU chip; pass ``None`` on a real pod to
     autodetect the local chips.
+
+    Extra keyword arguments (``fault_plan``, ``stall_timeout``,
+    ``max_retries``, ``backoff_base``, ``checkpoint_every``,
+    ``checkpoint_dir``, ``degrade_to_thread``, ...) configure the
+    crash-resilience layer — see :class:`ProcessOrchestrator` and
+    docs/resilience.rst.
     """
     from pydcop_tpu.runtime.process import ProcessOrchestrator
 
     orch = ProcessOrchestrator(
         dcop, algo, distribution=distribution, graph=graph, seed=seed,
         n_processes=n_processes, platform=platform,
-        local_devices=local_devices,
+        local_devices=local_devices, **resilience,
     )
     orch.deploy_computations()
     return orch

@@ -34,6 +34,45 @@ def cycle_op_counts(tensors) -> tuple:
     return ops, max_per_factor
 
 
+#: counter names surfaced under metrics["resilience"] — one schema for
+#: thread mode (VirtualOrchestrator) and process mode
+#: (ProcessOrchestrator) so collectors need no mode-specific parsing
+RESILIENCE_COUNTERS = (
+    "faults_injected",      # fault-plan faults fired (any kind)
+    "rank_crashes",         # ranks seen dead (injected kill or signal)
+    "rank_stalls",          # ranks declared stalled by the watchdog
+    "retries",              # full-mesh relaunches after a failure
+    "resumes",              # runs warm-started from a checkpoint
+    "repairs",              # agent-removal repair DCOPs solved
+    "checkpoints_saved",
+    "checkpoints_rejected",  # snapshots refused (checksum/version)
+    "degraded_to_thread",   # process mode fell back to thread mode
+)
+
+
+class FaultCounters:
+    """Fault + recovery counters collected by the orchestrators and
+    merged into their end metrics (``metrics()['resilience']``)."""
+
+    def __init__(self):
+        self.counts = {k: 0 for k in RESILIENCE_COUNTERS}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        if name not in self.counts:
+            raise KeyError(
+                f"unknown resilience counter {name!r}; add it to "
+                f"RESILIENCE_COUNTERS"
+            )
+        self.counts[name] += n
+
+    def as_dict(self) -> dict:
+        return dict(self.counts)
+
+    @property
+    def any_faults(self) -> bool:
+        return any(self.counts.values())
+
+
 class StatsLogger:
     """Accumulate per-cycle rows and dump them as CSV (reference:
     trace_computation, stats.py:81)."""

@@ -49,6 +49,7 @@ class UiServer:
         event_bus.subscribe("computations.value.*", self._cb_value)
         event_bus.subscribe("agents.add_computation.*", self._cb_add_comp)
         event_bus.subscribe("agents.rem_computation.*", self._cb_rem_comp)
+        event_bus.subscribe("faults.*", self._cb_fault)
 
     # -- event plumbing -----------------------------------------------------
 
@@ -159,6 +160,18 @@ class UiServer:
             self._ws.send_all(json.dumps(
                 {"evt": "rem_comp", "computation": evt}))
 
+    def _cb_fault(self, topic: str, evt) -> None:
+        """Fault + recovery lifecycle (faults.injected.*, .detected.*,
+        .recovered.*) pushed to GUI clients; the SSE /events stream gets
+        them through the wildcard subscription like every topic."""
+        if self._ws is not None:
+            self._ws.send_all(json.dumps(
+                {"evt": "fault",
+                 "kind": topic.split(".", 1)[-1],
+                 "data": evt if isinstance(evt, (dict, list, str, int,
+                                                 float, bool, type(None)))
+                 else repr(evt)}))
+
     # -- server -------------------------------------------------------------
 
     def start(self) -> None:
@@ -217,7 +230,7 @@ class UiServer:
 
     def stop(self) -> None:
         for cb in (self._on_event, self._cb_cycle, self._cb_value,
-                   self._cb_add_comp, self._cb_rem_comp):
+                   self._cb_add_comp, self._cb_rem_comp, self._cb_fault):
             event_bus.unsubscribe(cb)
         if self._server is not None:
             self._server.shutdown()

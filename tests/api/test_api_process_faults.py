@@ -1,0 +1,213 @@
+"""Process-mode crash resilience, end to end with REAL OS processes.
+
+The acceptance scenario of the fault-tolerance layer: under a fault
+plan that kills a rank mid-run, the job neither hangs nor orphans
+processes — the watchdog detects the failure within its timeout, the
+relaunch resumes from the latest checkpoint, and the final cost
+matches the fault-free run on the same seed.
+
+These tests run with ``n_processes=1`` deliberately: a 1-rank mesh
+exercises the ENTIRE resilience machinery (rank spawn, heartbeats,
+injected kill/stall, watchdog classification, backoff relaunch,
+checkpoint save/auto-resume) while staying runnable on jaxlib builds
+whose CPU backend lacks multi-process collectives (this image — see
+tests/api/test_api_process.py baseline).  The 2-process variant is
+marked slow and additionally tolerates the degrade-to-thread path on
+such builds.
+"""
+import os
+import subprocess
+
+import pytest
+
+from pydcop_tpu.dcop import load_dcop_from_file
+from pydcop_tpu.runtime import run_local_process_dcop
+from pydcop_tpu.runtime.faults import Fault, FaultPlan
+
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+TUTO = os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+
+
+def _no_leftover_ranks(orch):
+    """No orphan processes: every spawned rank has been reaped."""
+    assert orch._procs == []
+    out = subprocess.run(
+        ["pgrep", "-f", "pydcop_tpu.*--multihost"],
+        capture_output=True, text=True,
+    )
+    assert out.stdout.strip() == "", f"orphan ranks: {out.stdout}"
+
+
+@pytest.fixture
+def fault_free_result():
+    orch = run_local_process_dcop(
+        load_dcop_from_file(TUTO), "maxsum", distribution="adhoc",
+        n_processes=1,
+    )
+    try:
+        res = orch.run(cycles=20)
+    finally:
+        orch.stop()
+    assert res.status == "FINISHED"
+    return res
+
+
+def test_killed_rank_recovers_from_checkpoint(fault_free_result):
+    """Kill rank 0 at cycle 8 (first launch only): the watchdog sees
+    the injected exit code, relaunches after backoff, the rank resumes
+    from the cycle-8 snapshot and the final cost/assignment match the
+    fault-free run exactly."""
+    plan = FaultPlan(
+        faults=[Fault(kind="kill_rank", rank=0, cycle=8)]
+    )
+    orch = run_local_process_dcop(
+        load_dcop_from_file(TUTO), "maxsum", distribution="adhoc",
+        n_processes=1, fault_plan=plan, checkpoint_every=4,
+        max_retries=2, backoff_base=0.1,
+    )
+    try:
+        res = orch.run(cycles=20)
+        m = orch.end_metrics()
+    finally:
+        orch.stop()
+    assert res.status == "FINISHED"
+    r = m["resilience"]
+    assert r["rank_crashes"] == 1
+    assert r["faults_injected"] >= 1
+    assert r["retries"] == 1
+    assert r["resumes"] == 1
+    assert r["degraded_to_thread"] == 0
+    assert m["fault_log"][0]["fault"] == "crash"
+    # identical final answer to the fault-free run on the same seed
+    assert res.cost == fault_free_result.cost
+    assert res.assignment == fault_free_result.assignment
+    _no_leftover_ranks(orch)
+
+
+def test_stalled_rank_detected_and_recovered(fault_free_result):
+    """SIGSTOP rank 0 for 60s at cycle 4: heartbeats go stale, the
+    watchdog declares a stall well before the 60s elapse (bounded
+    detection), kills the frozen rank and relaunches — no hang."""
+    plan = FaultPlan(
+        faults=[Fault(kind="stall_rank", rank=0, cycle=4, duration=60)]
+    )
+    orch = run_local_process_dcop(
+        load_dcop_from_file(TUTO), "maxsum", distribution="adhoc",
+        n_processes=1, fault_plan=plan, checkpoint_every=4,
+        max_retries=2, backoff_base=0.1, stall_timeout=3.0,
+    )
+    try:
+        res = orch.run(cycles=20)
+        m = orch.end_metrics()
+    finally:
+        orch.stop()
+    assert res.status == "FINISHED"
+    assert m["resilience"]["rank_stalls"] == 1
+    assert m["resilience"]["retries"] == 1
+    assert res.cost == fault_free_result.cost
+    _no_leftover_ranks(orch)
+
+
+def test_degrades_to_thread_after_max_retries(fault_free_result):
+    """A kill that fires on EVERY attempt exhausts max_retries; the
+    orchestrator then degrades to thread mode and still produces the
+    correct result instead of failing the caller."""
+    plan = FaultPlan(
+        faults=[Fault(kind="kill_rank", rank=0, cycle=4, attempt=None)]
+    )
+    orch = run_local_process_dcop(
+        load_dcop_from_file(TUTO), "maxsum", distribution="adhoc",
+        n_processes=1, fault_plan=plan, max_retries=1, backoff_base=0.1,
+    )
+    try:
+        res = orch.run(cycles=20)
+        m = orch.end_metrics()
+    finally:
+        orch.stop()
+    assert res.status == "FINISHED"
+    assert m["resilience"]["degraded_to_thread"] == 1
+    assert m["resilience"]["rank_crashes"] == 2  # initial + 1 retry
+    assert res.cost == fault_free_result.cost
+    _no_leftover_ranks(orch)
+
+
+def test_no_retry_on_deterministic_error(tmp_path):
+    """A rank that fails with a clean nonzero exit (deterministic
+    error, here an unloadable DCOP file) raises immediately — no
+    backoff retries that would only hide a reproducible bug."""
+    orch = run_local_process_dcop(
+        load_dcop_from_file(TUTO), "maxsum", distribution="adhoc",
+        n_processes=1, max_retries=5, backoff_base=30.0,
+    )
+    # sabotage the serialized DCOP the ranks load (clean rank error)
+    with open(orch._dcop_file, "w") as f:
+        f.write("{not yaml: [")
+    from time import perf_counter
+    t0 = perf_counter()
+    try:
+        with pytest.raises(RuntimeError, match="rank failed"):
+            orch.run(cycles=5)
+    finally:
+        orch.stop()
+    # immediate: nothing slept through 30s backoff steps
+    assert perf_counter() - t0 < 25
+    assert orch.fault_counters.counts["retries"] == 0
+    _no_leftover_ranks(orch)
+
+
+def test_corrupt_checkpoint_fault_resumes_from_older(fault_free_result):
+    """Kill at cycle 12 + corrupt the newest snapshot before the
+    relaunch: the rank must skip the damaged file, resume from an older
+    snapshot and still land on the fault-free answer."""
+    plan = FaultPlan(
+        faults=[
+            Fault(kind="kill_rank", rank=0, cycle=12),
+            Fault(kind="corrupt_checkpoint", attempt=1),
+        ],
+        seed=3,
+    )
+    orch = run_local_process_dcop(
+        load_dcop_from_file(TUTO), "maxsum", distribution="adhoc",
+        n_processes=1, fault_plan=plan, checkpoint_every=4,
+        max_retries=2, backoff_base=0.1,
+    )
+    try:
+        res = orch.run(cycles=20)
+        m = orch.end_metrics()
+    finally:
+        orch.stop()
+    assert res.status == "FINISHED"
+    assert m["resilience"]["resumes"] == 1
+    # the checkpoint fault itself was logged
+    assert any(e.get("fault") == "checkpoint" for e in m["fault_log"])
+    assert res.cost == fault_free_result.cost
+    assert res.assignment == fault_free_result.assignment
+    _no_leftover_ranks(orch)
+
+
+@pytest.mark.slow
+def test_two_process_crash_recovery():
+    """The same kill/recover scenario on a REAL 2-process mesh.  On
+    jaxlib builds whose CPU backend implements multi-process
+    collectives the mesh recovers in process mode; on builds that lack
+    them every attempt fails deterministically and the test instead
+    asserts the raise-immediately contract — either way: no hang, no
+    orphans."""
+    plan = FaultPlan(
+        faults=[Fault(kind="kill_rank", rank=1, cycle=8)]
+    )
+    orch = run_local_process_dcop(
+        load_dcop_from_file(TUTO), "maxsum", distribution="adhoc",
+        n_processes=2, fault_plan=plan, checkpoint_every=4,
+        max_retries=2, backoff_base=0.1,
+    )
+    try:
+        try:
+            res = orch.run(cycles=20)
+        except RuntimeError as e:
+            assert "rank failed" in str(e)  # deterministic backend gap
+        else:
+            assert res.status in ("FINISHED", "TIMEOUT")
+    finally:
+        orch.stop()
+    _no_leftover_ranks(orch)

@@ -1,0 +1,483 @@
+"""Fault-injection harness + hardened checkpoint unit tests.
+
+Covers runtime/faults.py (plan parsing, deterministic injection,
+heartbeat/stall detection, checkpoint corruption helpers),
+runtime/checkpoint.py hardening (atomic write, CRC rejection, version
+gate, rotation) and the thread-mode orchestrator's fault/auto-resume
+integration.  The real multi-process crash/watchdog path is exercised
+in tests/api/test_api_process_faults.py.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop import load_dcop_from_file
+from pydcop_tpu.runtime.checkpoint import (
+    CHECKPOINT_VERSION,
+    CheckpointManager,
+    load_checkpoint,
+    read_state_npz,
+    save_checkpoint,
+    write_state_npz,
+)
+from pydcop_tpu.runtime.faults import (
+    KILL_EXIT_CODE,
+    Fault,
+    FaultPlan,
+    HeartbeatWriter,
+    RankFaultInjector,
+    corrupt_checkpoint,
+    stalled_ranks,
+)
+
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+
+
+@pytest.fixture
+def tuto():
+    return load_dcop_from_file(
+        os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+    )
+
+
+class TestFaultPlan:
+    def test_yaml_roundtrip(self, tmp_path):
+        plan_yaml = tmp_path / "plan.yaml"
+        plan_yaml.write_text(
+            "seed: 7\n"
+            "faults:\n"
+            "  - kind: kill_rank\n"
+            "    rank: 1\n"
+            "    cycle: 8\n"
+            "  - kind: stall_rank\n"
+            "    rank: 0\n"
+            "    cycle: 4\n"
+            "    duration: 30\n"
+            "  - kind: kill_agent\n"
+            "    agent: a3\n"
+            "    cycle: 10\n"
+            "  - kind: corrupt_checkpoint\n"
+            "    attempt: 1\n"
+        )
+        plan = FaultPlan.from_yaml(str(plan_yaml))
+        assert plan.seed == 7
+        assert [f.kind for f in plan.faults] == [
+            "kill_rank", "stall_rank", "kill_agent", "corrupt_checkpoint"
+        ]
+        assert plan.for_rank(1)[0].cycle == 8
+        assert plan.for_rank(0)[0].duration == 30
+        assert plan.agent_kills()[0].agent == "a3"
+        assert plan.checkpoint_faults(attempt=1)
+        assert not plan.checkpoint_faults(attempt=0)
+        # env/json channel preserves everything, including attempt=None
+        plan.faults[0].attempt = None
+        again = FaultPlan.from_json(plan.to_json())
+        assert again.faults[0].attempt is None
+        assert again.faults[3].attempt == 1
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            Fault(kind="explode")
+        with pytest.raises(ValueError, match="rank"):
+            Fault(kind="kill_rank")
+        with pytest.raises(ValueError, match="duration"):
+            Fault(kind="stall_rank", rank=0)
+        with pytest.raises(ValueError, match="agent"):
+            Fault(kind="kill_agent")
+        with pytest.raises(ValueError, match="unknown fields"):
+            FaultPlan.from_dict(
+                {"faults": [{"kind": "kill_rank", "rank": 0,
+                             "banana": 1}]}
+            )
+        with pytest.raises(ValueError, match="faults"):
+            FaultPlan.from_dict({"seed": 1})
+
+
+class TestRankFaultInjector:
+    def _plan(self, **kw):
+        return FaultPlan(faults=[Fault(**kw)])
+
+    def test_kill_fires_at_first_boundary_past_cycle(self):
+        exits = []
+        inj = RankFaultInjector(
+            self._plan(kind="kill_rank", rank=2, cycle=8), rank=2,
+            attempt=0, _exit=exits.append,
+        )
+        inj.at_cycle(5)
+        assert not exits
+        inj.at_cycle(10)  # first boundary >= 8
+        assert exits == [KILL_EXIT_CODE]
+        inj.at_cycle(15)  # fires once
+        assert exits == [KILL_EXIT_CODE]
+
+    def test_attempt_scoping(self):
+        exits = []
+        inj = RankFaultInjector(
+            self._plan(kind="kill_rank", rank=0, cycle=2, attempt=0),
+            rank=0, attempt=1, _exit=exits.append,
+        )
+        inj.at_cycle(10)
+        assert not exits  # attempt-0 fault must not fire on attempt 1
+        inj_any = RankFaultInjector(
+            self._plan(kind="kill_rank", rank=0, cycle=2, attempt=None),
+            rank=0, attempt=3, _exit=exits.append,
+        )
+        inj_any.at_cycle(10)
+        assert exits == [KILL_EXIT_CODE]
+
+    def test_other_ranks_untouched(self):
+        exits = []
+        inj = RankFaultInjector(
+            self._plan(kind="kill_rank", rank=1, cycle=0), rank=0,
+            attempt=0, _exit=exits.append,
+        )
+        inj.at_cycle(100)
+        assert not exits
+
+    def test_stall_uses_duration(self):
+        stalls = []
+        inj = RankFaultInjector(
+            self._plan(kind="stall_rank", rank=0, cycle=4, duration=7.5),
+            rank=0, attempt=0, _stall=stalls.append,
+        )
+        assert inj.next_cycle() == 4
+        inj.at_cycle(4)
+        assert stalls == [7.5]
+
+
+class TestHeartbeats:
+    def test_writer_touches_file(self, tmp_path):
+        path = str(tmp_path / "rank0.hb")
+        hb = HeartbeatWriter(path, interval=0.05)
+        hb.start()
+        try:
+            assert os.path.exists(path)
+        finally:
+            hb.stop()
+
+    def test_stalled_ranks_by_mtime(self, tmp_path):
+        fresh = str(tmp_path / "r0.hb")
+        stale = str(tmp_path / "r1.hb")
+        for p in (fresh, stale):
+            with open(p, "w"):
+                pass
+        old = os.stat(stale).st_mtime - 60
+        os.utime(stale, (old, old))
+        assert stalled_ranks({0: fresh, 1: stale}, stall_timeout=5) == [1]
+        # a missing file is startup, not a stall
+        assert stalled_ranks(
+            {0: str(tmp_path / "nope.hb")}, stall_timeout=5) == []
+
+
+class TestCorruption:
+    def test_deterministic_damage(self, tmp_path):
+        a, b = str(tmp_path / "a.bin"), str(tmp_path / "b.bin")
+        payload = bytes(range(256)) * 64
+        for p in (a, b):
+            with open(p, "wb") as f:
+                f.write(payload)
+        corrupt_checkpoint(a, seed=5)
+        corrupt_checkpoint(b, seed=5)
+        assert open(a, "rb").read() == open(b, "rb").read()
+        assert open(a, "rb").read() != payload
+
+    def test_truncate_shrinks(self, tmp_path):
+        p = str(tmp_path / "t.bin")
+        with open(p, "wb") as f:
+            f.write(b"x" * 10000)
+        corrupt_checkpoint(p, seed=1, mode="truncate")
+        assert 0 < os.path.getsize(p) < 10000
+
+
+class TestHardenedContainer:
+    def _write(self, path):
+        arrays = {"leaf_0": np.arange(12, dtype=np.float32).reshape(3, 4),
+                  "leaf_1": np.ones(5, dtype=np.int32)}
+        write_state_npz(path, arrays, {"kind": "test", "cycle": 3})
+        return arrays
+
+    def test_roundtrip_with_crcs(self, tmp_path):
+        p = str(tmp_path / "ck.npz")
+        arrays = self._write(p)
+        meta, got = read_state_npz(p)
+        assert meta["version"] == CHECKPOINT_VERSION
+        assert set(meta["crc"]) == {"leaf_0", "leaf_1"}
+        np.testing.assert_array_equal(got["leaf_0"], arrays["leaf_0"])
+        # no temp residue from the atomic write
+        assert [f for f in os.listdir(tmp_path)
+                if f.startswith(".ck_tmp_")] == []
+
+    def test_corrupted_rejected(self, tmp_path):
+        p = str(tmp_path / "ck.npz")
+        self._write(p)
+        corrupt_checkpoint(p, seed=3)
+        with pytest.raises(ValueError,
+                           match="checksum mismatch|unreadable"):
+            read_state_npz(p)
+
+    def test_truncated_rejected(self, tmp_path):
+        p = str(tmp_path / "ck.npz")
+        self._write(p)
+        corrupt_checkpoint(p, seed=3, mode="truncate")
+        with pytest.raises(ValueError, match="unreadable or truncated"):
+            read_state_npz(p)
+
+    def test_future_version_rejected(self, tmp_path):
+        p = str(tmp_path / "ck.npz")
+        np.savez(p, __meta__=json.dumps({"version": 99}),
+                 leaf_0=np.zeros(3))
+        with pytest.raises(ValueError, match="schema version 99"):
+            read_state_npz(p)
+
+    def test_v1_files_still_load(self, tmp_path):
+        # the original unversioned format: no version, no CRC table
+        p = str(tmp_path / "v1.npz")
+        np.savez(p, __meta__=json.dumps({"n_leaves": 1}),
+                 leaf_0=np.arange(3))
+        meta, arrays = read_state_npz(p)
+        assert meta["n_leaves"] == 1
+
+    def test_not_a_checkpoint_rejected(self, tmp_path):
+        p = str(tmp_path / "foreign.npz")
+        np.savez(p, x=np.zeros(3))
+        with pytest.raises(ValueError, match="no __meta__"):
+            read_state_npz(p)
+
+
+class TestCheckpointManager:
+    def test_rotation_keeps_newest(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        for cycle in (5, 10, 15, 20):
+            mgr.save_state(cycle, {"leaf_0": np.full(3, cycle)},
+                           {"kind": "t"})
+        cycles = [c for c, _ in mgr.snapshots()]
+        assert cycles == [20, 15]
+
+    def test_latest_valid_skips_corrupt(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=3)
+        for cycle in (5, 10):
+            mgr.save_state(cycle, {"leaf_0": np.full(8, cycle,
+                                                     np.float32)},
+                           {"kind": "t"})
+        corrupt_checkpoint(mgr.path_for(10), seed=0)
+        got = mgr.latest_valid_state()
+        assert got is not None
+        cycle, meta, arrays = got
+        assert cycle == 5
+        np.testing.assert_array_equal(arrays["leaf_0"], np.full(8, 5))
+
+    def test_all_corrupt_is_none(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save_state(5, {"leaf_0": np.zeros(64, np.float32)},
+                       {"kind": "t"})
+        corrupt_checkpoint(mgr.path_for(5), seed=0, mode="truncate")
+        assert mgr.latest_valid_state() is None
+
+
+class TestSolverCheckpointHardening:
+    def test_corrupt_solver_checkpoint_rejected(self, tuto, tmp_path):
+        """Acceptance: a deliberately damaged checkpoint is rejected by
+        load_checkpoint with a clear ValueError, never loaded."""
+        from pydcop_tpu.algorithms.maxsum import build_solver
+
+        solver = build_solver(tuto)
+        solver.run(cycles=4)
+        path = str(tmp_path / "ck.npz")
+        save_checkpoint(path, solver)
+        corrupt_checkpoint(path, seed=11)
+        fresh = build_solver(tuto)
+        with pytest.raises(ValueError,
+                           match="checksum mismatch|unreadable"):
+            load_checkpoint(path, fresh)
+        assert getattr(fresh, "_last_state", None) is None
+
+    def test_truncated_solver_checkpoint_rejected(self, tuto, tmp_path):
+        from pydcop_tpu.algorithms.maxsum import build_solver
+
+        solver = build_solver(tuto)
+        solver.run(cycles=4)
+        path = str(tmp_path / "ck.npz")
+        save_checkpoint(path, solver)
+        corrupt_checkpoint(path, seed=2, mode="truncate")
+        with pytest.raises(ValueError, match="unreadable or truncated"):
+            load_checkpoint(path, build_solver(tuto))
+
+
+class TestOrchestratorFaults:
+    def test_kill_agent_fault_routes_through_repair(self, tuto):
+        from pydcop_tpu.runtime.orchestrator import VirtualOrchestrator
+
+        victim = sorted(tuto.agents)[0]
+        plan = FaultPlan(
+            faults=[Fault(kind="kill_agent", agent=victim, cycle=10)]
+        )
+        orch = VirtualOrchestrator(tuto, "maxsum", distribution="adhoc",
+                                   fault_plan=plan)
+        orch.deploy_computations()
+        orch.start_replication(2)
+        res = orch.run(cycles=20)
+        m = orch.end_metrics()
+        assert res.status == "FINISHED"
+        assert res.cycle == 20  # the kill split, not shortened, the run
+        assert victim not in orch.dcop.agents
+        assert m["resilience"]["faults_injected"] == 1
+        assert m["resilience"]["repairs"] == 1
+        assert victim not in m["distribution"]
+        # every computation survived the failure, re-hosted elsewhere
+        hosted = [c for a in m["distribution"]
+                  for c in m["distribution"][a]]
+        assert sorted(hosted) == sorted(
+            n.name for n in orch.cg.nodes)
+
+    def test_checkpoint_and_auto_resume(self, tuto, tmp_path):
+        from pydcop_tpu.runtime.orchestrator import VirtualOrchestrator
+
+        d = str(tmp_path)
+        orch = VirtualOrchestrator(tuto, "maxsum", distribution="adhoc",
+                                   checkpoint_dir=d, checkpoint_every=5)
+        orch.deploy_computations()
+        res = orch.run(cycles=12)
+        assert orch.end_metrics()["resilience"]["checkpoints_saved"] >= 1
+        assert CheckpointManager(d).latest()[0] == 12
+
+        # a fresh orchestrator resumes exactly where the run ended —
+        # 8 more cycles land on the same state as one 20-cycle run
+        orch2 = VirtualOrchestrator(
+            load_same(tuto), "maxsum", distribution="adhoc",
+            checkpoint_dir=d, auto_resume=True,
+        )
+        orch2.deploy_computations()
+        res2 = orch2.run(cycles=8)
+        m2 = orch2.end_metrics()
+        assert m2["resilience"]["resumes"] == 1
+        assert res2.cycle == 20
+
+        straight = VirtualOrchestrator(load_same(tuto), "maxsum",
+                                       distribution="adhoc")
+        straight.deploy_computations()
+        res_straight = straight.run(cycles=20)
+        assert res2.assignment == res_straight.assignment
+        assert res2.cost == res_straight.cost
+
+    def test_auto_resume_survives_corrupt_snapshot(self, tuto, tmp_path):
+        from pydcop_tpu.runtime.orchestrator import VirtualOrchestrator
+
+        d = str(tmp_path)
+        orch = VirtualOrchestrator(tuto, "maxsum", distribution="adhoc",
+                                   checkpoint_dir=d, checkpoint_every=5)
+        orch.deploy_computations()
+        orch.run(cycles=10)
+        # newest snapshot corrupted: resume falls back to an older one
+        newest = CheckpointManager(d).latest()[1]
+        corrupt_checkpoint(newest, seed=4)
+        orch2 = VirtualOrchestrator(
+            load_same(tuto), "maxsum", distribution="adhoc",
+            checkpoint_dir=d, auto_resume=True,
+        )
+        orch2.deploy_computations()
+        res = orch2.run(cycles=5)
+        assert res.status == "FINISHED"
+        assert orch2.end_metrics()["resilience"]["resumes"] == 1
+        assert res.cycle < 15  # resumed from an OLDER cycle than 10
+
+
+def load_same(dcop):
+    """Fresh copy of the tuto instance (orchestrators mutate agents)."""
+    return load_dcop_from_file(
+        os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+    )
+
+
+class TestSolveResultCheckpointing:
+    def test_solve_checkpoint_then_resume(self, tuto, tmp_path):
+        from pydcop_tpu.runtime import solve_result
+
+        d = str(tmp_path)
+        res = solve_result(tuto, "maxsum", cycles=10,
+                           checkpoint_dir=d, checkpoint_every=4)
+        assert res.status == "FINISHED"
+        assert CheckpointManager(d).latest()[0] == 10
+        res2 = solve_result(load_same(tuto), "maxsum", cycles=20,
+                            checkpoint_dir=d, checkpoint_every=4,
+                            resume=True)
+        assert res2.cycle == 20
+        straight = solve_result(load_same(tuto), "maxsum", cycles=20)
+        assert res2.assignment == straight.assignment
+
+    def test_placement_path_rejects_checkpointing(self, tuto, tmp_path):
+        from pydcop_tpu.distribution.objects import Distribution
+        from pydcop_tpu.runtime import solve_result
+
+        dist = Distribution({a: [] for a in tuto.agents})
+        with pytest.raises(ValueError, match="not supported"):
+            solve_result(tuto, "maxsum", distribution=dist,
+                         checkpoint_dir=str(tmp_path))
+
+
+class TestMeshContinuationValidation:
+    """Satellite (ADVICE r5): the packed engine silently dropped a
+    mismatched ``r`` continuation arg; both engines must now reject
+    foreign (q, r) state with a clear error."""
+
+    def _tensors(self):
+        from pydcop_tpu.generators import generate_graph_coloring
+        from pydcop_tpu.ops.compile import compile_factor_graph
+
+        return compile_factor_graph(generate_graph_coloring(
+            n_variables=12, n_colors=3, n_edges=20, soft=True,
+            n_agents=1, seed=3,
+        ))
+
+    def test_generic_rejects_foreign_state(self):
+        import jax.numpy as jnp
+
+        from pydcop_tpu.parallel.mesh import ShardedMaxSum, build_mesh
+
+        solver = ShardedMaxSum(self._tensors(), build_mesh(4),
+                               damping=0.5)
+        _v, q, r = solver.run(cycles=2)
+        bad = jnp.zeros((3, 3), dtype=jnp.float32)
+        with pytest.raises(ValueError, match="continuation state"):
+            solver.run(cycles=2, q=bad, r=r)
+        with pytest.raises(ValueError, match="continuation state"):
+            solver.run(cycles=2, q=q, r=bad)
+        # a tuple (packed-engine state) into the generic engine
+        with pytest.raises(ValueError, match="different engine"):
+            solver.run(cycles=2, q=(q, q), r=r)
+
+    def test_valid_continuation_still_works(self):
+        from pydcop_tpu.parallel.mesh import ShardedMaxSum, build_mesh
+
+        solver = ShardedMaxSum(self._tensors(), build_mesh(4),
+                               damping=0.5)
+        v_full, _, _ = solver.run(cycles=6)
+        _v, q, r = solver.run(cycles=3)
+        v2, _, _ = solver.run(cycles=3, q=q, r=r)
+        np.testing.assert_array_equal(v2, v_full)
+
+    def test_state_host_roundtrip(self):
+        from pydcop_tpu.parallel.mesh import ShardedMaxSum, build_mesh
+
+        t = self._tensors()
+        solver = ShardedMaxSum(t, build_mesh(4), damping=0.5)
+        v_full, _, _ = solver.run(cycles=6)
+        _v, q, r = solver.run(cycles=3)
+        host = solver.state_to_host(q, r)
+        # a NEW engine (fresh process after a crash) restores the state
+        solver2 = ShardedMaxSum(t, build_mesh(4), damping=0.5)
+        q2, r2 = solver2.state_from_host(host)
+        v2, _, _ = solver2.run(cycles=3, q=q2, r=r2)
+        np.testing.assert_array_equal(v2, v_full)
+
+    def test_state_from_host_rejects_mismatch(self):
+        from pydcop_tpu.parallel.mesh import ShardedMaxSum, build_mesh
+
+        solver = ShardedMaxSum(self._tensors(), build_mesh(4),
+                               damping=0.5)
+        _v, q, r = solver.run(cycles=2)
+        host = solver.state_to_host(q, r)
+        host["leaf_0"] = host["leaf_0"][:-1]  # wrong shape
+        with pytest.raises(ValueError, match="leaf shape|leaves"):
+            solver.state_from_host(host)

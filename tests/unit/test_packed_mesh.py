@@ -160,9 +160,19 @@ class TestPackedShardedMaxSum:
         a = ShardedMaxSum(t, mesh, damping=0.5, activation=0.6,
                           use_packed=True)
         va, _, _ = a.run(cycles=6, seed=11)
-        golden = [0, 2, 2, 1, 0, 2, 0, 0, 0, 0, 0, 1, 0, 0, 1, 2, 1, 2,
-                  0, 1, 2, 1, 0, 2]
-        np.testing.assert_array_equal(va, golden)
+        if jax.devices()[0].platform == "cpu" and hasattr(jax,
+                                                          "shard_map"):
+            # the pinned values were produced by the CPU interpret-mode
+            # run of the packed kernels on a jax with native
+            # jax.shard_map; real TPU Mosaic lowering may legitimately
+            # differ in float association on near-ties, and older jax
+            # (experimental shard_map) draws a slightly different
+            # activation stream — so the exact golden is only asserted
+            # on the stack that minted it (ADVICE r5); the semantic
+            # assertions below run everywhere
+            golden = [0, 2, 2, 1, 0, 2, 0, 0, 0, 0, 0, 1, 0, 0, 1, 2, 1,
+                      2, 0, 1, 2, 1, 0, 2]
+            np.testing.assert_array_equal(va, golden)
         plain = ShardedMaxSum(t, mesh, damping=0.5, use_packed=True)
         vp, _, _ = plain.run(cycles=6)
         # masking has an effect at 0.6 ...
