@@ -1,6 +1,6 @@
 # Test/check targets (reference twin: pyDcop Makefile:1-21)
 
-.PHONY: test unit api cli doctest all-tests bench faults
+.PHONY: test unit api cli doctest all-tests bench bench-probe faults
 
 test: all-tests
 
@@ -22,6 +22,12 @@ all-tests:
 
 bench:
 	python bench.py
+
+# calibration probe + sharded local-search micro-bench only: a
+# minutes-long spot check of the lane-packed move-rule rate with its
+# drift anchor (docs/performance.rst "Drift-normalized benchmarking")
+bench-probe:
+	python bench.py --only probe
 
 # fault-tolerance suite only (docs/resilience.rst); tier-1 subset —
 # the multi-process crash tests beyond ~30s are marked slow

@@ -121,6 +121,122 @@ def python_reference_dpop_time(D: int, n_nodes: int, n_children: int = 1,
 
 
 # --------------------------------------------------------------------------
+# drift calibration probe (round-5 verdict item 1)
+# --------------------------------------------------------------------------
+
+#: probe kernel geometry — FIXED across rounds (the whole point: a
+#: constant-shape, constant-cost kernel whose only variable is the
+#: host/tunnel/device state).  Changing these invalidates normalized
+#: comparisons against earlier rounds.
+PROBE_DIM = 1024
+PROBE_CHAIN = 400
+
+
+def make_drift_probe(repeat: int = 3, dim: int = PROBE_DIM,
+                     chain: int = PROBE_CHAIN):
+    """Calibration probe for tunnel/host drift normalization.
+
+    The shared chip's effective throughput drifts on a minutes-to-hours
+    scale (round 5's 28.4% primary drop could not be separated from
+    environment).  This builds ONE jitted fixed-shape kernel — a chain
+    of ``PROBE_CHAIN`` [PROBE_DIM]² f32 matmuls with a tanh squash to
+    keep values bounded — whose device cost is constant by
+    construction, and returns a ``probe()`` closure measuring it in
+    matmuls/sec with the same ``measure_rate`` discipline as the
+    primary.  Timed INSIDE every burst, right next to the primary
+    measurement, it sees the same tunnel state: the ratio
+    ``primary / probe_rate`` (``primary_normalized``) cancels the
+    environment term, so a normalized round-over-round drop is code,
+    not drift.  ``dim``/``chain`` exist for the unit tests; recorded
+    rounds must keep the defaults."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(
+        rng.uniform(-1, 1, (dim, dim)).astype(np.float32)
+    )
+
+    @jax.jit
+    def chain_fn(x):
+        def body(c, _):
+            return jnp.tanh(c @ a), ()
+
+        c, _ = jax.lax.scan(body, x, None, length=chain)
+        return c
+
+    jax.block_until_ready(chain_fn(a))  # warmup / compile
+
+    def probe():
+        return measure_rate(
+            lambda: jax.block_until_ready(chain_fn(a)), chain, repeat
+        )
+
+    return probe
+
+
+def drift_verdict(value: float, extra: dict, here: str):
+    """One-line verdict on the PREVIOUS round's primary drop, recorded
+    into extra (the round-5 ask: was the 28.4% drop drift or real?).
+
+    Before the probe existed the only retroactive evidence is this
+    run's RAW primary against the last two rounds': a recovery back to
+    the round-before-last level with no intervening kernel change means
+    the dropped round sat in a slow environment window; staying at the
+    dropped level is consistent with a real regression (or a persistent
+    window — which ``primary_normalized``, recorded from this round on,
+    disambiguates next time)."""
+    import glob
+    import re
+
+    rounds = {}
+    for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                v, _extra = _primary_from_record(json.load(f))
+            if v:
+                rounds[int(m.group(1))] = float(v)
+        except (OSError, ValueError):
+            continue
+    if len(rounds) < 2 or not value:
+        return
+    r_last, r_before = sorted(rounds)[-1], sorted(rounds)[-2]
+    v_last, v_before = rounds[r_last], rounds[r_before]
+    drop = 1.0 - v_last / v_before if v_before else 0.0
+    if drop <= 0.10:
+        return
+    if value >= v_before * 0.9:
+        verdict = (
+            f"drift: this run's raw primary ({value:.0f}) recovered to "
+            f"round {r_before}'s level ({v_before:.0f}) with no "
+            f"intervening kernel change, so round {r_last}'s "
+            f"{100 * drop:.1f}% drop was environment"
+        )
+    elif value <= v_last * 1.1:
+        verdict = (
+            f"real-or-persistent: this run's raw primary ({value:.0f}) "
+            f"stays at round {r_last}'s dropped level ({v_last:.0f}); "
+            f"compare primary_normalized from this round on to "
+            f"separate code from a persistent slow window"
+        )
+    else:
+        verdict = (
+            f"partial recovery ({value:.0f} between {v_last:.0f} and "
+            f"{v_before:.0f}): inconclusive on raw — trust "
+            f"primary_normalized from this round on"
+        )
+    extra["prior_round_drop"] = {
+        "rounds": [r_before, r_last],
+        "raw": [v_before, v_last],
+        "drop_pct": round(100 * drop, 1),
+        "verdict": verdict,
+    }
+
+
+# --------------------------------------------------------------------------
 # watchdog: guarantee the one-JSON-line contract even if the device wedges
 # --------------------------------------------------------------------------
 
@@ -935,6 +1051,45 @@ def bench_convergence_stretch(args, V=None, E=None, prefix="stretch",
     return out
 
 
+def bench_sharded_local_tpu(args, extra, dcop=None):
+    """Sharded LOCAL-SEARCH micro-bench on the real chip (1-device
+    mesh): the lane-packed move rule (this round's tentpole — packed
+    tables + column-space coins + routed-gain pmax/pmin arbitration)
+    must carry the single-chip engineering, where the round-5 replicated
+    generic move rule capped MGM at ~520 cycles/s.  Chunk sizes sized so
+    one timed call clears the ~70ms tunnel dispatch floor at the TARGET
+    rates (≥5k cycles/s)."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        extra["sharded_local_note"] = (
+            "sharded local-search micro-bench needs the TPU backend; "
+            "CPU-mesh validation lives in the sharded canary"
+        )
+        return
+    from pydcop_tpu.generators import generate_graph_coloring
+    from pydcop_tpu.ops.compile import compile_constraint_graph
+    from pydcop_tpu.parallel.mesh import ShardedLocalSearch, build_mesh
+
+    if dcop is None:
+        dcop = generate_graph_coloring(
+            n_variables=args.vars, n_colors=args.colors,
+            n_edges=args.edges, soft=True, n_agents=1, seed=1,
+        )
+    _ct = compile_constraint_graph(dcop)
+    for rule, n_cyc in (("mgm", 1000), ("dsa", 2000)):
+        sls = ShardedLocalSearch(_ct, build_mesh(1), rule=rule)
+        if sls.packs is None:
+            extra[f"sharded_packed_{rule}_error"] = (
+                "instance did not shard-pack"
+            )
+            continue
+        sls.run(cycles=n_cyc)  # warmup / compile
+        extra[f"sharded_packed_{rule}_cycles_per_sec_tpu"] = round(
+            measure_rate(
+                lambda: sls.run(cycles=n_cyc), n_cyc, args.repeat), 1)
+
+
 def bench_sharded_subprocess(args):
     """ShardedMaxSum on a virtual 8-device CPU mesh, in a subprocess so
     the forced-CPU platform doesn't poison this process's TPU backend."""
@@ -1060,6 +1215,26 @@ GUARDED_HEADLINES = (
 )
 
 
+def _primary_from_record(rec: dict):
+    """(primary value, extras) from a driver BENCH_r*.json record.
+
+    The driver usually archives the full parsed JSON line; when parsing
+    failed on its side (round 5) only the output TAIL survives — the
+    steady-state burst recorded in extra is recovered from it so the
+    drift verdict and the regression guard keep their history."""
+    import re
+
+    parsed = rec.get("parsed") or {}
+    if parsed.get("value"):
+        return float(parsed["value"]), parsed.get("extra") or {}
+    tail = rec.get("tail") or ""
+    m = (re.search(r'"primary_burst2": ([0-9.]+)', tail)
+         or re.search(r'"primary_burst1": ([0-9.]+)', tail))
+    if m:
+        return float(m.group(1)), {}
+    return None, {}
+
+
 def load_previous_bench(here: str):
     """(round, primary value, extras) from the newest BENCH_r*.json the
     driver left in the repo root, or None."""
@@ -1079,8 +1254,8 @@ def load_previous_bench(here: str):
     try:
         with open(best[1], encoding="utf-8") as f:
             rec = json.load(f)
-        parsed = rec.get("parsed") or {}
-        return best[0], parsed.get("value"), parsed.get("extra") or {}
+        value, extras = _primary_from_record(rec)
+        return best[0], value, extras
     except (OSError, ValueError):
         return None
 
@@ -1095,8 +1270,18 @@ def regression_check(value: float, extra: dict, here: str,
     rnd, prev_value, prev_extra = prev
     regressions = {}
     for name in GUARDED_HEADLINES:
+        basis = None
         if name == "primary":
             cur, old = value, prev_value
+            # prefer the drift-normalized primary when BOTH rounds
+            # carry it (round-5 verdict item 1): a raw drop that the
+            # normalized value doesn't show is environment, not code —
+            # and must not be flagged
+            cur_n = (extra or {}).get("primary_normalized")
+            old_n = (prev_extra or {}).get("primary_normalized")
+            if cur_n and old_n:
+                cur, old = cur_n, old_n
+                basis = "primary_normalized"
         else:
             cur, old = extra.get(name), prev_extra.get(name)
         if cur is None or old is None or not old:
@@ -1107,6 +1292,8 @@ def regression_check(value: float, extra: dict, here: str,
                 "prev": old, "cur": cur, "drop_pct": round(100 * drop, 1),
                 "prev_round": rnd,
             }
+            if basis:
+                regressions[name]["basis"] = basis
             if (name == "primary"
                     and extra.get("primary_policy")
                     and not prev_extra.get("primary_policy")):
@@ -1164,7 +1351,7 @@ def main():
         "--only",
         choices=["all", "maxsum", "dpop", "convergence", "convergence2",
                  "local", "scalefree", "mixed", "sharded",
-                 "sharded-inner"],
+                 "sharded-inner", "probe"],
         default="all",
     )
     # watchdog covers the FULL run: the wholesweep DPOP kernel compile
@@ -1252,11 +1439,42 @@ def main():
     value = vs = 0.0
     dcop = None
 
+    # drift calibration (round-5 verdict item 1): compile the probe
+    # once up front; each burst then times it ADJACENT to the primary
+    # measurement so both see the same tunnel state
+    probe = None
+    if args.only in ("all", "maxsum", "probe"):
+        try:
+            probe = make_drift_probe(repeat=args.repeat)
+        except Exception as e:
+            extra["probe_error"] = repr(e)
+
+    if args.only == "probe":
+        # `make bench-probe`: the sharded local-search micro-bench +
+        # the calibration probe only (a minutes-long spot check of the
+        # tentpole rate with its drift anchor, vs the ~30min full run).
+        # The probe runs ADJACENT to the rates so the normalized values
+        # are comparable across runs regardless of tunnel state.
+        try:
+            bench_sharded_local_tpu(args, extra)
+        except Exception as e:
+            extra["sharded_local_error"] = repr(e)
+        if probe is not None:
+            pr = round(probe(), 1)
+            extra["probe_rate_burst1"] = pr
+            for rule in ("mgm", "dsa"):
+                k = f"sharded_packed_{rule}_cycles_per_sec_tpu"
+                if extra.get(k) and pr:
+                    extra[f"{k}_normalized"] = round(extra[k] / pr, 4)
+
     remeasure_primary = None
     if args.only in ("all", "maxsum"):
         try:
             (value, vs, dcop, _tensors,
              remeasure_primary) = bench_maxsum(args)
+            if probe is not None:
+                # burst-1 probe: timed right after the burst-1 primary
+                extra["probe_rate_burst1"] = round(probe(), 1)
         except BenchAbort as e:
             if watchdog:
                 watchdog.cancel()
@@ -1275,7 +1493,7 @@ def main():
 
             if _jax.default_backend() == "tpu":
                 from pydcop_tpu.parallel.mesh import (
-                    ShardedLocalSearch, ShardedMaxSum, build_mesh,
+                    ShardedMaxSum, build_mesh,
                 )
 
                 shp = ShardedMaxSum(_tensors, build_mesh(1), damping=0.5)
@@ -1285,30 +1503,10 @@ def main():
                         round(measure_rate(
                             lambda: shp.run(cycles=args.cycles),
                             args.cycles, args.repeat), 1)
-                # sharded LOCAL SEARCH on the chip (round 5: this path
-                # previously failed Mosaic compile on hardware — the
-                # in-kernel cost row-slicing — so it had never been
-                # timed; the packed tables kernel runs per shard but
-                # the replicated move rule + variable-axis transfers
-                # cap the cycle well below the fused single-chip
-                # kernels — see ROADMAP)
-                from pydcop_tpu.ops.compile import (
-                    compile_constraint_graph,
-                )
-
-                _ct = compile_constraint_graph(dcop)
-                # chunk sizes sized so one timed call clears the ~70ms
-                # tunnel dispatch floor at each rule's measured rate
-                for rule, n_cyc in (("mgm", 200), ("dsa", 800)):
-                    sls = ShardedLocalSearch(_ct, build_mesh(1),
-                                             rule=rule)
-                    if sls.packs is None:
-                        continue
-                    sls.run(cycles=n_cyc)  # warmup / compile
-                    extra[f"sharded_packed_{rule}_cycles_per_sec_tpu"] \
-                        = round(measure_rate(
-                            lambda: sls.run(cycles=n_cyc),
-                            n_cyc, args.repeat), 1)
+                # sharded LOCAL SEARCH on the chip: the lane-packed
+                # move rule (this round's tentpole) — see
+                # bench_sharded_local_tpu
+                bench_sharded_local_tpu(args, extra, dcop=dcop)
         except Exception as e:  # never lose the primary
             extra["sharded_packed_tpu_error"] = repr(e)
 
@@ -1418,10 +1616,11 @@ def main():
             extra["sharded_error"] = repr(e)
 
     if args.only in ("dpop", "local", "convergence", "convergence2",
-                     "scalefree", "mixed", "sharded") and not value:
+                     "scalefree", "mixed", "sharded", "probe") \
+            and not value:
         # single-part run: promote the part's headline measurement (not
         # config constants like stretch_vars) to the primary slot
-        headline = ("_per_sec", "_wall_s", "_cycles_per")
+        headline = ("_per_sec", "_wall_s", "_cycles_per", "probe_rate")
         k = next(
             (k for k in extra if any(h in k for h in headline)),
             next((k for k in extra if not k.endswith("_error")), None),
@@ -1452,16 +1651,30 @@ def main():
         try:
             second = remeasure_primary()
             extra["primary_burst2"] = round(second, 2)
+            if probe is not None:
+                # burst-2 probe: same tunnel state as the burst that
+                # defines the primary
+                extra["probe_rate_burst2"] = round(probe(), 1)
             if second and value:
                 vs = vs * (second / value)
             value = second
         except Exception as e:
             extra["primary_remeasure_error"] = repr(e)
 
+    if value and args.only in ("all", "maxsum"):
+        # the drift-normalized primary: engine rate per unit of probe
+        # rate, measured in the SAME burst — dimensionless, so it
+        # cancels tunnel/host drift round over round.  regression_check
+        # prefers it over the raw primary when both rounds carry it.
+        pr = (extra.get("probe_rate_burst2")
+              or extra.get("probe_rate_burst1"))
+        if pr:
+            extra["primary_normalized"] = round(value / pr, 4)
+
     if args.only == "all":
-        regression_check(
-            value, extra, os.path.dirname(os.path.abspath(__file__)) or "."
-        )
+        here = os.path.dirname(os.path.abspath(__file__)) or "."
+        drift_verdict(value, extra, here)
+        regression_check(value, extra, here)
 
     if watchdog:
         watchdog.cancel()

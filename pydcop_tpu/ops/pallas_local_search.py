@@ -121,19 +121,14 @@ def pack_local_search(tensors) -> Optional[PackedLocalSearch]:
     return pack_from_pg(try_pack_for_pallas(tensors))
 
 
-def pack_from_pg(pg: Optional[PackedMaxSumGraph]
-                 ) -> Optional[PackedLocalSearch]:
-    """Build the local-search extras on top of an existing packed graph
-    (lets solvers that already hold a PackedMaxSumGraph for the tables
-    kernel upgrade lazily, without re-packing).
+def move_extras(pg: PackedMaxSumGraph) -> dict:
+    """Host-side static arrays the packed MOVE rules need, as numpy
+    (shared by :func:`pack_from_pg` and the sharded packer
+    parallel/packed_mesh, which stacks one set per shard):
 
-    Handles both layouts: all-binary packings get the per-other-value
-    cost slabs; mixed-arity (1/2/3/4) packings reuse the packed graph's
-    own cost arrays (cost_rows/cost1/cost3/cost4 + arity masks) and
-    carry second/third mate-index arrays for the ternary/quaternary
-    siblings."""
-    if pg is None or pg.D < 2:
-        return None
+    ``idx_row``/``colmask`` [1, Vp], ``sreal``/``gmask1``/``mate``
+    [1, N], plus ``mate2``/``mate3`` (or None) for the ternary /
+    quaternary siblings of mixed packings."""
     Vp, N = pg.Vp, pg.N
     var_order = np.asarray(pg.var_order)
     idx_np = np.full((1, Vp), _BIG_IDX, dtype=np.float32)
@@ -143,12 +138,7 @@ def pack_from_pg(pg: Optional[PackedMaxSumGraph]
     # real-slot mask: row 0 of vmask is 1 exactly on real slots (every
     # variable's value 0 is valid)
     sreal = np.asarray(pg.vmask)[0:1, :].astype(np.float32)
-    sreal_j = jnp.asarray(sreal)
-    D = pg.D
     if pg.mixed:
-        # mixed kernels slice pg.cost_rows/cost1/cost3 in-kernel (the
-        # layout packed_local_tables already proves on hardware)
-        slabs = ()
         am4 = (
             np.asarray(pg.arity_mask4)
             if pg.arity_mask4 is not None else 0.0
@@ -158,16 +148,8 @@ def pack_from_pg(pg: Optional[PackedMaxSumGraph]
             + am4,
             0.0, 1.0,
         ).astype(np.float32)
-        gmask1_j = jnp.asarray(gmask1)
     else:
-        cost_np = np.asarray(pg.cost_rows)
-        slabs = tuple(
-            jnp.asarray(cost_np[j * D: (j + 1) * D, :]) for j in range(D)
-        )
-        # same mask: alias the device buffer instead of re-uploading a
-        # second [1, N] copy (tens of MB at stretch scale)
         gmask1 = sreal
-        gmask1_j = sreal_j
     # static neighbor index per slot: expand own indices to slots on the
     # host, route them through the plan's numpy reference once.  Uses the
     # per-COLUMN variable map (col_var) rather than idx_np so a hub's
@@ -190,24 +172,59 @@ def pack_from_pg(pg: Optional[PackedMaxSumGraph]
             if pg.arity_mask4 is not None else np.zeros_like(am3)
         )
         m2 = pg.plan2.apply_numpy(own_idx_slots)
-        mate2 = jnp.asarray(np.where(
-            am3 + am4 > 0, m2, _BIG_IDX
-        ).astype(np.float32))
+        mate2 = np.where(am3 + am4 > 0, m2, _BIG_IDX).astype(np.float32)
         if pg.plan3 is not None:
             m3 = pg.plan3.apply_numpy(own_idx_slots)
-            mate3 = jnp.asarray(np.where(
-                am4 > 0, m3, _BIG_IDX
-            ).astype(np.float32))
+            mate3 = np.where(am4 > 0, m3, _BIG_IDX).astype(np.float32)
+    return {
+        "idx_row": idx_np, "colmask": colmask, "sreal": sreal,
+        "gmask1": gmask1, "mate": mate, "mate2": mate2, "mate3": mate3,
+    }
+
+
+def pack_from_pg(pg: Optional[PackedMaxSumGraph]
+                 ) -> Optional[PackedLocalSearch]:
+    """Build the local-search extras on top of an existing packed graph
+    (lets solvers that already hold a PackedMaxSumGraph for the tables
+    kernel upgrade lazily, without re-packing).
+
+    Handles both layouts: all-binary packings get the per-other-value
+    cost slabs; mixed-arity (1/2/3/4) packings reuse the packed graph's
+    own cost arrays (cost_rows/cost1/cost3/cost4 + arity masks) and
+    carry second/third mate-index arrays for the ternary/quaternary
+    siblings."""
+    if pg is None or pg.D < 2:
+        return None
+    ex = move_extras(pg)
+    D = pg.D
+    sreal_j = jnp.asarray(ex["sreal"])
+    if pg.mixed:
+        # mixed kernels slice pg.cost_rows/cost1/cost3 in-kernel (the
+        # layout packed_local_tables already proves on hardware)
+        slabs = ()
+        gmask1_j = jnp.asarray(ex["gmask1"])
+    else:
+        cost_np = np.asarray(pg.cost_rows)
+        slabs = tuple(
+            jnp.asarray(cost_np[j * D: (j + 1) * D, :]) for j in range(D)
+        )
+        # same mask: alias the device buffer instead of re-uploading a
+        # second [1, N] copy (tens of MB at stretch scale)
+        gmask1_j = sreal_j
     return PackedLocalSearch(
         pg=pg,
-        idx_row=jnp.asarray(idx_np),
-        colmask=jnp.asarray(colmask),
+        idx_row=jnp.asarray(ex["idx_row"]),
+        colmask=jnp.asarray(ex["colmask"]),
         sreal=sreal_j,
         cost_slabs=slabs,
-        mate_idx=jnp.asarray(mate),
+        mate_idx=jnp.asarray(ex["mate"]),
         gmask1=gmask1_j,
-        mate2_idx=mate2,
-        mate3_idx=mate3,
+        mate2_idx=(
+            jnp.asarray(ex["mate2"]) if ex["mate2"] is not None else None
+        ),
+        mate3_idx=(
+            jnp.asarray(ex["mate3"]) if ex["mate3"] is not None else None
+        ),
     )
 
 
@@ -324,37 +341,45 @@ def _cur_best_gain(pg: PackedMaxSumGraph, tables, x_row, prefer_change):
     return cur, best_idx, gain
 
 
-def _mgm_move(pls: PackedLocalSearch, gain, idx_row, mate_idx, gmask1,
-              consts, hub=None, mate2=None, gmask2=None, consts2=None,
-              mate3=None, gmask3=None, consts3=None):
-    """MGM neighborhood arbitration (neighborhood_winner semantics):
-    True [1, Vp] where own gain is the strict neighborhood max, lexic
-    tie-break by original variable index.  One gains permute (a second
-    on ternary graphs for the other sibling); the tie-break indices are
-    the STATIC mate arrays — topology doesn't change at runtime, so only
-    gains travel.  ``gmask1``/``gmask2`` zero the slots whose permute
-    routes no real neighbor (dummies, and unary slots on mixed
-    layouts, which route identity)."""
-    pg = pls.pg
+def _routed_gains(pg: PackedMaxSumGraph, gain, consts, gmask1, hub=None,
+                  consts2=None, gmask2=None, consts3=None, gmask3=None):
+    """Expand per-column gains to slots and Clos-route each slot's
+    sibling gains: (gn, gn2, gn3) [1, N] rows (gn2/gn3 None without a
+    second/third permutation).  ``gmask*`` zero the slots whose permute
+    routes no real neighbor (dummies, and unary slots on mixed layouts,
+    which route identity)."""
     # hub member slots must send the hub's gain to their neighbors
     gs = _bucket_expand(pg, _hub_spread(pg, gain, 1, hub), 1)
     gn = _permute1(pg, gs, consts) * gmask1
     gn2 = gn3 = None
-    if mate2 is not None:
+    if consts2 is not None:
         gn2 = _permute_in_kernel(gs, pg.plan2, 1, consts2) * gmask2
-    if mate3 is not None:
+    if consts3 is not None:
         gn3 = _permute_in_kernel(gs, pg.plan3, 1, consts3) * gmask3
+    return gn, gn2, gn3
+
+
+def _neigh_max_partial(pg: PackedMaxSumGraph, gn, gn2=None, gn3=None,
+                       hub=None):
+    """[1, Vp] per-column max of the routed neighbor gains over the
+    LOCAL slots — the full neighborhood max on one chip; a per-shard
+    partial (combine with ``pmax`` over the mesh axis) when the slots
+    are sharded."""
     gboth = gn if gn2 is None else jnp.maximum(gn, gn2)
     if gn3 is not None:
         gboth = jnp.maximum(gboth, gn3)
     # hub combine: a hub's neighborhood max/tie-break spans ALL its
     # sub-columns' slots
-    neigh_max = jnp.maximum(
-        _hub_op(pg, _bucket_reduce(pg, gboth, 1, jnp.maximum), 1, hub,
-                jnp.maximum),
-        0.0,
-    )
-    nm_exp = _bucket_expand(pg, neigh_max, 1)
+    return _hub_op(pg, _bucket_reduce(pg, gboth, 1, jnp.maximum), 1, hub,
+                   jnp.maximum)
+
+
+def _tiebreak_idx_partial(pg: PackedMaxSumGraph, nm_exp, gn, mate_idx,
+                          gn2=None, mate2=None, gn3=None, mate3=None,
+                          hub=None):
+    """[1, Vp] min neighbor index achieving the neighborhood max, over
+    the LOCAL slots (sharded callers ``pmin`` the partials).  ``nm_exp``
+    is the GLOBAL neighborhood max expanded to slots."""
     # masked slots are safe here: their gn is 0 and their mate is BIG
     idx_cand = jnp.where(gn >= nm_exp - 1e-9, mate_idx, _BIG_IDX)
     if gn2 is not None:
@@ -367,15 +392,47 @@ def _mgm_move(pls: PackedLocalSearch, gain, idx_row, mate_idx, gmask1,
         )
     # fill=_BIG_IDX: degree-0 variables have no neighbor at max, so the
     # lexic tie-break must let them through (generic: idx_at_max = V)
-    idx_at_max = _hub_op(
+    return _hub_op(
         pg,
         _bucket_reduce(pg, idx_cand, 1, jnp.minimum, fill=_BIG_IDX),
         1, hub, jnp.minimum,
     )
+
+
+def _mgm_decision(gain, idx_row, neigh_max, idx_at_max):
+    """neighborhood_winner's final predicate: move iff own gain is the
+    strict neighborhood max, lexic (variable-index) tie-break."""
     return (gain > 0) & (
         (gain > neigh_max + 1e-9)
         | ((jnp.abs(gain - neigh_max) <= 1e-9) & (idx_row < idx_at_max))
     )
+
+
+def _mgm_move(pls: PackedLocalSearch, gain, idx_row, mate_idx, gmask1,
+              consts, hub=None, mate2=None, gmask2=None, consts2=None,
+              mate3=None, gmask3=None, consts3=None):
+    """MGM neighborhood arbitration (neighborhood_winner semantics):
+    True [1, Vp] where own gain is the strict neighborhood max, lexic
+    tie-break by original variable index.  One gains permute (a second
+    on ternary graphs for the other sibling); the tie-break indices are
+    the STATIC mate arrays — topology doesn't change at runtime, so only
+    gains travel.  Composed from the partial-arbitration helpers above
+    so the sharded engine (parallel/mesh.py) runs the SAME op DAG with a
+    pmax/pmin pair between the partials."""
+    pg = pls.pg
+    gn, gn2, gn3 = _routed_gains(
+        pg, gain, consts, gmask1, hub=hub,
+        consts2=consts2 if mate2 is not None else None, gmask2=gmask2,
+        consts3=consts3 if mate3 is not None else None, gmask3=gmask3,
+    )
+    neigh_max = jnp.maximum(
+        _neigh_max_partial(pg, gn, gn2, gn3, hub=hub), 0.0
+    )
+    nm_exp = _bucket_expand(pg, neigh_max, 1)
+    idx_at_max = _tiebreak_idx_partial(
+        pg, nm_exp, gn, mate_idx, gn2, mate2, gn3, mate3, hub=hub,
+    )
+    return _mgm_decision(gain, idx_row, neigh_max, idx_at_max)
 
 
 # ---------------------------------------------------------------------------

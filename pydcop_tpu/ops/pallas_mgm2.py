@@ -48,6 +48,7 @@ from pydcop_tpu.ops.pallas_local_search import (
     _BIG_IDX,
     _bucket_expand,
     _bucket_reduce,
+    _neigh_max_partial,
 )
 from pydcop_tpu.ops.pallas_maxsum import (
     _compiler_params,
@@ -324,8 +325,7 @@ def _mgm2_cycle(pm: PackedMgm2, x, u_off, u_pick, u_fav, slabs, unary,
     gp = _permute_in_kernel(gain_pid_s, pg.plan, 2, consts)
     gn = gp[0: 1] * gmask1
     pn = jnp.where(gmask1 > 0, gp[1: 2], _BIG_IDX)
-    gboth = gn
-    gn3 = pn3 = None
+    gn2 = gn3 = pn3 = None
     if mixed is not None and consts2 is not None:
         am3 = mixed[4]
         am4 = mixed[7]
@@ -336,14 +336,14 @@ def _mgm2_cycle(pm: PackedMgm2, x, u_off, u_pick, u_fav, slabs, unary,
         gp2 = _permute_in_kernel(gain_pid_s, pg.plan2, 2, consts2)
         gn2 = gp2[0: 1] * m2
         pn2 = jnp.where(m2 > 0, gp2[1: 2], _BIG_IDX)
-        gboth = jnp.maximum(gn, gn2)
         if consts3 is not None:
             gp3 = _permute_in_kernel(gain_pid_s, pg.plan3, 2, consts3)
             gn3 = gp3[0: 1] * am4
             pn3 = jnp.where(am4 > 0, gp3[1: 2], _BIG_IDX)
-            gboth = jnp.maximum(gboth, gn3)
+    # same per-column neighborhood-max reduce as fused MGM and the
+    # sharded move rule (ONE source of the arbitration semantics)
     neigh_max = jnp.maximum(
-        col_reduce(gboth, jnp.maximum, 0.0), 0.0)
+        _neigh_max_partial(pg, gn, gn2, gn3, hub=hub), 0.0)
     nm_exp = _bucket_expand(pg, neigh_max, 1)
     idx_cand = jnp.where(gn >= nm_exp - eps, pn, _BIG_IDX)
     if mixed is not None and consts2 is not None:

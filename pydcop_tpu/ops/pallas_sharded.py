@@ -35,6 +35,8 @@ from jax.experimental.pallas import tpu as pltpu
 from pydcop_tpu.ops.pallas_local_search import (
     _bucket_expand,
     _bucket_reduce,
+    _neigh_max_partial,
+    _routed_gains,
 )
 from pydcop_tpu.ops.pallas_maxsum import (
     PackedMaxSumGraph,
@@ -175,6 +177,84 @@ def packed_shard_fused_ba(
         jax.ShapeDtypeStruct((D, N), jnp.float32),
         jax.ShapeDtypeStruct((D, N), jnp.float32),
     )[:n_out]
+    return pl.pallas_call(
+        kern,
+        out_shape=out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(ops),
+        out_specs=tuple(
+            pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(n_out)
+        ),
+        interpret=interpret,
+        compiler_params=_compiler_params(),
+    )(*ops)
+
+
+def packed_shard_route_gains(
+    pg: PackedMaxSumGraph,
+    gain: jnp.ndarray,         # [1, Vp] global per-column gains (f32)
+    consts: Tuple[jnp.ndarray, ...],
+    gmask1: jnp.ndarray,       # [1, N] this shard's real-neighbor mask
+    consts2: Optional[Tuple[jnp.ndarray, ...]] = None,
+    gmask2: Optional[jnp.ndarray] = None,
+    consts3: Optional[Tuple[jnp.ndarray, ...]] = None,
+    gmask3: Optional[jnp.ndarray] = None,
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, ...]:
+    """The per-shard HALF of the MGM neighborhood arbitration (the
+    lane-packed sharded move rule): expand the (replicated, post-psum)
+    per-column gains to this shard's slots, Clos-route each slot's
+    sibling gains, and reduce the LOCAL per-column neighborhood-max
+    partial.  Returns ``(nm_part [1, Vp], gn [1, N][, gn2][, gn3])`` —
+    the caller combines ``nm_part`` across shards with one ``pmax``,
+    then feeds the routed gain rows to the (XLA slice-reduce) tie-break
+    partial and a ``pmin``.  Only the Clos permutes live here; there is
+    deliberately NO per-variable gather anywhere in the move rule.
+
+    Unlike the cost arrays, the operands are [1, N]-row sized, so the
+    launch is cheap next to the tables kernel."""
+    interpret = _resolve_interpret(interpret)
+    N, Vp = pg.N, pg.Vp
+    has2, has3 = consts2 is not None, consts3 is not None
+
+    def kern(g_ref, gm1_ref, *rest):
+        i = 0
+        c1 = tuple(r[:] for r in rest[i: i + 5])
+        i += 5
+        c2 = gm2 = c3 = gm3 = None
+        if has2:
+            c2 = tuple(r[:] for r in rest[i: i + 5])
+            gm2 = rest[i + 5][:]
+            i += 6
+        if has3:
+            c3 = tuple(r[:] for r in rest[i: i + 5])
+            gm3 = rest[i + 5][:]
+            i += 6
+        outs = rest[i:]
+        gn, gn2, gn3 = _routed_gains(
+            pg, g_ref[:], c1, gm1_ref[:],
+            consts2=c2, gmask2=gm2, consts3=c3, gmask3=gm3,
+        )
+        outs[0][:] = _neigh_max_partial(pg, gn, gn2, gn3)
+        outs[1][:] = gn
+        j = 2
+        if has2:
+            outs[j][:] = gn2
+            j += 1
+        if has3:
+            outs[j][:] = gn3
+
+    ops = [gain, gmask1, *consts]
+    if has2:
+        ops += [*consts2, gmask2]
+    if has3:
+        ops += [*consts3, gmask3]
+    n_out = 2 + int(has2) + int(has3)
+    out_shape = (
+        jax.ShapeDtypeStruct((1, Vp), jnp.float32),
+    ) + tuple(
+        jax.ShapeDtypeStruct((1, N), jnp.float32)
+        for _ in range(n_out - 1)
+    )
     return pl.pallas_call(
         kern,
         out_shape=out_shape,

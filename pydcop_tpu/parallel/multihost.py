@@ -154,13 +154,20 @@ def run_multihost_maxsum_resumable(
 
 def run_multihost_local_search(dcop, rule: str = "mgm", cycles: int = 15,
                                seed: int = 0,
-                               algo_params: Optional[dict] = None):
-    """Solve `dcop` with a local-search rule (mgm / dsa / dba / gdba)
-    sharded over the global multi-process mesh.  Returns
+                               algo_params: Optional[dict] = None,
+                               use_packed: Optional[bool] = None,
+                               info: Optional[dict] = None):
+    """Solve `dcop` with a local-search rule (mgm / dsa / adsa / dba /
+    gdba) sharded over the global multi-process mesh.  Returns
     (values, n_global_devices, tensors).  SPMD: identical dcop on every
     process; the breakout rules' weight state is shard-local, so the one
     psum of partial cost tables per cycle is the only cross-process
-    traffic."""
+    traffic (the lane-packed mgm move rule adds its one pmax/pmin
+    arbitration pair — see ShardedLocalSearch).  ``use_packed`` requests
+    the lane-packed per-shard engine for mgm/dsa/adsa (default:
+    platform auto — packed on TPU shards); the packer can decline and
+    fall back to generic, so ``info['packed']`` reports which engine
+    actually ran."""
     from pydcop_tpu.ops.compile import compile_constraint_graph
     from pydcop_tpu.parallel.mesh import ShardedLocalSearch
 
@@ -171,7 +178,10 @@ def run_multihost_local_search(dcop, rule: str = "mgm", cycles: int = 15,
         tensors, mesh, rule=rule,
         probability=float(params.get("probability", 0.7)),
         algo_params=params,
+        use_packed=use_packed,
     )
+    if info is not None:
+        info["packed"] = sharded.packs is not None
     values = sharded.run(cycles=cycles, seed=seed)
     return values, mesh.devices.size, tensors
 
@@ -188,16 +198,17 @@ def main(argv=None) -> int:
                     help="default: autodetect (real TPU hosts); pass "
                     "'cpu' for testing")
     ap.add_argument("--algo", default="maxsum",
-                    choices=["maxsum", "amaxsum", "mgm", "dsa", "dba",
-                             "gdba"])
+                    choices=["maxsum", "amaxsum", "mgm", "dsa", "adsa",
+                             "dba", "gdba"])
     ap.add_argument("--vars", type=int, default=60)
     ap.add_argument("--edges", type=int, default=120)
     ap.add_argument("--cycles", type=int, default=15)
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--packed", action="store_true",
                     help="force the lane-packed per-shard engine "
-                    "(maxsum only; default: platform auto — packed on "
-                    "TPU shards, generic elsewhere)")
+                    "(maxsum/amaxsum and the mgm/dsa/adsa move rules; "
+                    "default: platform auto — packed on TPU shards, "
+                    "generic elsewhere)")
     args = ap.parse_args(argv)
 
     init_multihost(
@@ -225,8 +236,10 @@ def main(argv=None) -> int:
             dcop, cycles=args.cycles, activation=activation,
             use_packed=True if args.packed else None, info=info)
     else:
+        info = {}
         values, n_devices, _tensors = run_multihost_local_search(
-            dcop, rule=args.algo, cycles=args.cycles)
+            dcop, rule=args.algo, cycles=args.cycles,
+            use_packed=True if args.packed else None, info=info)
     import numpy as np
 
     out = {
@@ -235,8 +248,7 @@ def main(argv=None) -> int:
         "values_checksum": int(np.asarray(values).sum()),
         "n_values": int(len(values)),
     }
-    if args.algo in ("maxsum", "amaxsum"):
-        out["packed"] = bool(info.get("packed", False))
+    out["packed"] = bool(info.get("packed", False))
     print(json.dumps(out), flush=True)
     return 0
 

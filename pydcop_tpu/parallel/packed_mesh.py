@@ -89,6 +89,16 @@ class StackedShardPack:
     #   — see pallas_maxsum.PackedMaxSumGraph.cost4_rows)
     am4: Optional[jnp.ndarray] = None          # [1, N] shard-invariant
     consts3: Optional[List[jnp.ndarray]] = None  # 5 stacked [S, ...]
+    # --- lane-packed MOVE-rule extras (ShardedLocalSearch): the static
+    # arbitration arrays of ops/pallas_local_search.move_extras, one set
+    # per shard (each shard's Clos plan routes different mates).
+    # idx_row/colmask are column-map-derived, hence shard-invariant.
+    idx_row: Optional[jnp.ndarray] = None      # [1, Vp] shard-invariant
+    colmask: Optional[jnp.ndarray] = None      # [1, Vp] shard-invariant
+    mate_idx: Optional[jnp.ndarray] = None     # [S, 1, N]
+    gmask1: Optional[jnp.ndarray] = None       # [S, 1, N]
+    mate2_idx: Optional[jnp.ndarray] = None    # [S, 1, N] (plan2 only)
+    mate3_idx: Optional[jnp.ndarray] = None    # [S, 1, N] (plan3 only)
 
     @property
     def D(self) -> int:
@@ -201,7 +211,34 @@ def build_shard_packs(
         consts=[
             jnp.stack([cp[i] for cp in consts_per]) for i in range(5)
         ],
+        **_stacked_move_extras(packs),
     )
+
+
+def _stacked_move_extras(packs: List[PackedMaxSumGraph]) -> dict:
+    """Per-shard MOVE-rule statics (pallas_local_search.move_extras)
+    stacked on a leading shard axis, ready for ``P(AXIS)`` shardings —
+    how ShardedLocalSearch's packed move rule gets each shard's mate
+    indices / gain masks without any per-variable gather at runtime.
+    Empty dict when the layout can't carry a move rule (D < 2)."""
+    from pydcop_tpu.ops.pallas_local_search import move_extras
+
+    if packs[0].D < 2:
+        return {}
+    ex = [move_extras(pg) for pg in packs]
+    out = {
+        "idx_row": jnp.asarray(ex[0]["idx_row"]),
+        "colmask": jnp.asarray(ex[0]["colmask"]),
+        "mate_idx": jnp.asarray(np.stack([e["mate"] for e in ex])),
+        "gmask1": jnp.asarray(np.stack([e["gmask1"] for e in ex])),
+    }
+    if ex[0]["mate2"] is not None:
+        out["mate2_idx"] = jnp.asarray(
+            np.stack([e["mate2"] for e in ex]))
+    if ex[0]["mate3"] is not None:
+        out["mate3_idx"] = jnp.asarray(
+            np.stack([e["mate3"] for e in ex]))
+    return out
 
 
 def _mixed_section_masks(layout: MixedLayout):
@@ -346,4 +383,5 @@ def _build_mixed_shard_packs(
             [jnp.stack([cp[i] for cp in consts3_per]) for i in range(5)]
             if consts3_per is not None else None
         ),
+        **_stacked_move_extras(packs),
     )

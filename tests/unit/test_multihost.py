@@ -187,6 +187,41 @@ def test_agent_multihost_rejects_missing_args():
     assert "num-processes" in out.stdout or "num-processes" in out.stderr
 
 
+def test_local_search_packed_engine_plumbing():
+    """run_multihost_local_search's ``use_packed``/``info`` plumbing
+    (the lane-packed sharded move rule, round 6) — exercised in-process
+    over the 8-device virtual mesh, which IS the global mesh of a
+    single-process run: the packed request must reach
+    ShardedLocalSearch, info must report the engine that actually ran,
+    and coin-free MGM must agree with the direct packed solver."""
+    import numpy as np
+
+    from pydcop_tpu.generators import generate_graph_coloring
+    from pydcop_tpu.ops.compile import compile_constraint_graph
+    from pydcop_tpu.parallel.mesh import ShardedLocalSearch, build_mesh
+    from pydcop_tpu.parallel.multihost import run_multihost_local_search
+
+    dcop = generate_graph_coloring(
+        n_variables=40, n_colors=3, n_edges=80, soft=True, n_agents=1,
+        seed=1,
+    )
+    info = {}
+    values, n_dev, _t = run_multihost_local_search(
+        dcop, rule="mgm", cycles=10, seed=0, use_packed=True, info=info)
+    assert n_dev == 8
+    assert info["packed"] is True
+    tensors = compile_constraint_graph(dcop)
+    direct = ShardedLocalSearch(tensors, build_mesh(8), rule="mgm",
+                                use_packed=True)
+    np.testing.assert_array_equal(values, direct.run(cycles=10, seed=0))
+    # the generic request is honored too (and reported honestly)
+    info_g = {}
+    run_multihost_local_search(
+        dcop, rule="mgm", cycles=2, seed=0, use_packed=False,
+        info=info_g)
+    assert info_g["packed"] is False
+
+
 def test_two_process_mesh_dba():
     """The breakout family rides the multi-process mesh too: 2 real
     processes x 4 virtual devices run sharded DBA (shard-local weight
