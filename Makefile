@@ -1,6 +1,7 @@
 # Test/check targets (reference twin: pyDcop Makefile:1-21)
 
-.PHONY: test unit api cli doctest all-tests bench bench-probe faults
+.PHONY: test unit api cli doctest all-tests bench bench-probe faults \
+	bench-batch batch-smoke
 
 test: all-tests
 
@@ -28,6 +29,18 @@ bench:
 # drift anchor (docs/performance.rst "Drift-normalized benchmarking")
 bench-probe:
 	python bench.py --only probe
+
+# batched multi-instance throughput only: instances/sec at B in
+# {1, 8, 32} on the graph-coloring family with compile-cache counters
+# (docs/performance.rst "Batched solving")
+bench-batch:
+	python bench.py --only batch
+
+# 2-bucket / 6-instance in-process sweep smoke on the CPU backend —
+# the same scenario the tier-1 CLI test pins, runnable standalone
+batch-smoke:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/cli/test_batch_cli.py -q -m 'not slow'
 
 # fault-tolerance suite only (docs/resilience.rst); tier-1 subset —
 # the multi-process crash tests beyond ~30s are marked slow

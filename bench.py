@@ -1090,6 +1090,62 @@ def bench_sharded_local_tpu(args, extra, dcop=None):
                 lambda: sls.run(cycles=n_cyc), n_cyc, args.repeat), 1)
 
 
+def bench_batch(args, probe=None):
+    """Batched multi-instance throughput (the batch/ subsystem):
+    instances/sec completing a fixed-cycle MGM solve on the
+    graph-coloring family at B ∈ {1, 8, 32} — one compile + one
+    vmapped dispatch chain per shape bucket vs one chain per instance.
+    Drift-normalized against the calibration probe like the primary
+    (``batch_throughput_b*_normalized``); the engine's compile-cache
+    hit/miss counts ride along so a round where the cache stopped
+    working is visible in the JSON, not just slower."""
+    from pydcop_tpu.batch import BatchEngine, BatchItem
+    from pydcop_tpu.batch.cache import CompileCache
+    from pydcop_tpu.generators import generate_graph_coloring
+
+    V, E, C = args.batch_vars, args.batch_vars * 3, args.colors
+    cycles = 50
+    sizes = (1, 8, 32)
+    # seeds vary per instance: same family/shape signature, different
+    # cost tables + PRNG streams — the sweep-traffic profile
+    dcops = [
+        generate_graph_coloring(
+            n_variables=V, n_colors=C, n_edges=E, soft=True,
+            n_agents=1, seed=100 + i,
+        )
+        for i in range(max(sizes))
+    ]
+    out = {}
+    engine = BatchEngine(cache=CompileCache())
+    for b in sizes:
+        items = [
+            BatchItem(dcops[i], "mgm", seed=i, label=f"gc{i}")
+            for i in range(b)
+        ]
+        engine.solve(items, cycles=cycles)  # warmup incl. compile
+        rate = measure_rate(
+            lambda: engine.solve(items, cycles=cycles), b, args.repeat
+        )
+        out[f"batch_throughput_b{b}"] = round(rate, 2)
+        if probe is not None:
+            pr = probe()
+            if pr:
+                out[f"batch_throughput_b{b}_normalized"] = round(
+                    rate / pr, 6
+                )
+    b1, bmax = out.get("batch_throughput_b1"), out.get(
+        f"batch_throughput_b{max(sizes)}"
+    )
+    if b1 and bmax:
+        out["batch_speedup_b32_vs_b1"] = round(bmax / b1, 2)
+    out["batch_compile_cache"] = engine.cache.stats()
+    out["batch_counters"] = {
+        k: v for k, v in engine.counters.as_dict().items()
+        if k in ("buckets_formed", "compile_hits", "compile_misses")
+    }
+    return out
+
+
 def bench_sharded_subprocess(args):
     """ShardedMaxSum on a virtual 8-device CPU mesh, in a subprocess so
     the forced-CPU platform doesn't poison this process's TPU backend."""
@@ -1212,6 +1268,7 @@ GUARDED_HEADLINES = (
     "dsa_cycles_per_sec_10000var",
     "sharded_maxsum_iters_per_sec_8dev_2000var",
     "sharded_packed_maxsum_iters_per_sec_tpu",
+    "batch_throughput_b32",
 )
 
 
@@ -1340,6 +1397,12 @@ def main():
     )
     ap.add_argument("--sharded-vars", type=int, default=2_000)
     ap.add_argument(
+        "--batch-vars", type=int, default=500,
+        help="variables per instance in the batched-throughput bench "
+        "(edges = 3x); small enough that B=32 stacks comfortably, big "
+        "enough that per-instance device work is real",
+    )
+    ap.add_argument(
         "--stretch", action="store_true",
         help="compat: run ONLY the 100k stretch instance as primary",
     )
@@ -1351,7 +1414,7 @@ def main():
         "--only",
         choices=["all", "maxsum", "dpop", "convergence", "convergence2",
                  "local", "scalefree", "mixed", "sharded",
-                 "sharded-inner", "probe"],
+                 "sharded-inner", "probe", "batch"],
         default="all",
     )
     # watchdog covers the FULL run: the wholesweep DPOP kernel compile
@@ -1443,7 +1506,7 @@ def main():
     # once up front; each burst then times it ADJACENT to the primary
     # measurement so both see the same tunnel state
     probe = None
-    if args.only in ("all", "maxsum", "probe"):
+    if args.only in ("all", "maxsum", "probe", "batch"):
         try:
             probe = make_drift_probe(repeat=args.repeat)
         except Exception as e:
@@ -1554,6 +1617,12 @@ def main():
         except Exception as e:
             extra["mixed_error"] = repr(e)
 
+    if args.only in ("all", "batch"):
+        try:
+            extra.update(bench_batch(args, probe=probe))
+        except Exception as e:
+            extra["batch_error"] = repr(e)
+
     def run_with_transient_retry(fn, err_key):
         # the tunneled remote-compile service occasionally drops a
         # response mid-read; one retry keeps such a transient from
@@ -1616,11 +1685,12 @@ def main():
             extra["sharded_error"] = repr(e)
 
     if args.only in ("dpop", "local", "convergence", "convergence2",
-                     "scalefree", "mixed", "sharded", "probe") \
+                     "scalefree", "mixed", "sharded", "probe", "batch") \
             and not value:
         # single-part run: promote the part's headline measurement (not
         # config constants like stretch_vars) to the primary slot
-        headline = ("_per_sec", "_wall_s", "_cycles_per", "probe_rate")
+        headline = ("_per_sec", "_wall_s", "_cycles_per", "probe_rate",
+                    "batch_throughput")
         k = next(
             (k for k in extra if any(h in k for h in headline)),
             next((k for k in extra if not k.endswith("_error")), None),

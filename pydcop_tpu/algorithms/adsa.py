@@ -37,6 +37,31 @@ algo_params = [
 ]
 
 
+def adsa_cycle(tensors, x, wake_u, move_u, probability, variant,
+               activation, tables=None):
+    """One A-DSA cycle as a pure function: ``wake_u``/``move_u`` are the
+    [V] uniforms the generic path draws from the cycle key's
+    ``jax.random.split`` pair — pre-drawing them keeps fused and batched
+    consumers bit-identical to the per-key stream."""
+    awake = wake_u < activation
+    prefer_change = variant in ("B", "C")
+    cur, best_val, gain, tables = gains_and_best(
+        tensors, x, tables=tables, prefer_change=prefer_change,
+    )
+    activate = move_u < probability
+    improving = gain > 1e-9
+    lateral = (gain <= 1e-9) & (best_val != x)
+    if variant == "A":
+        want = improving
+    elif variant == "B":
+        in_conflict = conflicted(tensors, x, tables, HARD_THRESHOLD)
+        want = improving | (lateral & in_conflict)
+    else:
+        want = improving | lateral
+    move = want & activate & awake
+    return jnp.where(move, best_val, x).astype(jnp.int32)
+
+
 class ADsaSolver(LocalSearchSolver):
     def __init__(self, dcop, tensors, algo_def, seed=0, use_packed=None):
         super().__init__(dcop, tensors, algo_def, seed,
@@ -48,30 +73,14 @@ class ADsaSolver(LocalSearchSolver):
     def cycle(self, state, key):
         (x,) = state
         k_wake, k_move = jax.random.split(key)
-        awake = (
-            jax.random.uniform(k_wake, (self.tensors.n_vars,))
-            < self.activation
-        )
-        prefer_change = self.variant in ("B", "C")
-        cur, best_val, gain, tables = gains_and_best(
-            self.tensors, x, tables=self.local_tables(x),
-            prefer_change=prefer_change,
-        )
-        activate = (
-            jax.random.uniform(k_move, (self.tensors.n_vars,))
-            < self.probability
-        )
-        improving = gain > 1e-9
-        lateral = (gain <= 1e-9) & (best_val != x)
-        if self.variant == "A":
-            want = improving
-        elif self.variant == "B":
-            in_conflict = conflicted(self.tensors, x, tables, HARD_THRESHOLD)
-            want = improving | (lateral & in_conflict)
-        else:
-            want = improving | lateral
-        move = want & activate & awake
-        return (jnp.where(move, best_val, x).astype(jnp.int32),)
+        V = self.tensors.n_vars
+        return (adsa_cycle(
+            self.tensors, x,
+            jax.random.uniform(k_wake, (V,)),
+            jax.random.uniform(k_move, (V,)),
+            self.probability, self.variant, self.activation,
+            tables=self.local_tables(x),
+        ),)
 
     def _chunk_runner(self, n, collect: bool = True):
         """Fused fast path (ops.pallas_local_search.packed_dsa_cycles

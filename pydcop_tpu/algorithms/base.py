@@ -54,6 +54,36 @@ class SolveResult:
         }
 
 
+def default_chunk(
+    target: Optional[int],
+    collect: bool,
+    caller_chunk: bool,
+    timeout: Optional[float],
+    limit: int,
+) -> int:
+    """The harness's chunk-size policy, shared verbatim by
+    :meth:`SynchronousTensorSolver.run` and the batched engine
+    (pydcop_tpu.batch): the per-chunk PRNG stream (one key split per
+    chunk, one subkey per cycle) depends on the chunk boundaries, so any
+    runner that wants bit-identical results MUST reproduce this policy,
+    not approximate it.
+
+    * default 7 — prime, so an oscillation whose period divides the
+      chunk size cannot alias to a fixed point (see :meth:`run`);
+    * fixed-cycle, no-metrics, no-deadline runs raise the floor to 100
+      to amortize per-dispatch cost.
+    """
+    chunk = 7
+    if (
+        target is not None
+        and not collect
+        and not caller_chunk
+        and timeout is None
+    ):
+        chunk = min(limit, max(chunk, 100))
+    return chunk
+
+
 class SynchronousTensorSolver:
     """Base class for batched synchronous-round solvers.
 
@@ -157,28 +187,22 @@ class SynchronousTensorSolver:
         target = cycles if cycles else None
         limit = target if target is not None else max_cycles
 
-        caller_chunk = chunk is not None
+        # prime default: chunk_converged compares states one chunk
+        # apart, so an oscillation whose period divides the chunk
+        # size would look like a fixed point — with a prime chunk
+        # only period-7 (and true fixed points) can alias, and two
+        # stable chunks in a row (stable_chunks=2, 14 cycles) rules
+        # out period 7 too unless the period is exactly 7 AND 14.
+        # Fixed-cycle, no-metrics, no-deadline runs only check
+        # convergence between chunks: larger chunks amortize
+        # per-dispatch cost (~70ms on a tunneled device).  A
+        # caller-provided chunk or a timeout keeps the finer grain —
+        # the timeout is only honored between chunks, so a raised
+        # floor could overshoot a tight deadline by ~100 cycles.
         if chunk is None:
-            # prime default: chunk_converged compares states one chunk
-            # apart, so an oscillation whose period divides the chunk
-            # size would look like a fixed point — with a prime chunk
-            # only period-7 (and true fixed points) can alias, and two
-            # stable chunks in a row (stable_chunks=2, 14 cycles) rules
-            # out period 7 too unless the period is exactly 7 AND 14
-            chunk = 7
-        if (
-            target is not None
-            and not collect_cycles
-            and not caller_chunk
-            and timeout is None
-        ):
-            # fixed-cycle, no-metrics, no-deadline runs only check
-            # convergence between chunks: larger chunks amortize
-            # per-dispatch cost (~70ms on a tunneled device).  A
-            # caller-provided chunk or a timeout keeps the finer grain —
-            # the timeout is only honored between chunks, so a raised
-            # floor could overshoot a tight deadline by ~100 cycles.
-            chunk = min(limit, max(chunk, 100))
+            chunk = default_chunk(
+                target, collect_cycles, False, timeout, limit
+            )
 
         warm = resume and getattr(self, "_last_state", None) is not None
         state = self._last_state if warm else self.initial_state()

@@ -36,6 +36,31 @@ algo_params = [
 ]
 
 
+def dsa_cycle(tensors, x, u, probability, variant, tables=None):
+    """One DSA cycle as a pure function: ``u`` is the [V] per-cycle
+    activation uniform (the generic path draws it as
+    ``jax.random.uniform(key, (V,))``; pre-drawing it keeps any consumer
+    — fused pallas kernels, the batched vmap engine — bit-identical to
+    the per-key stream).  Traceable with the tensor-graph arrays as
+    jit/vmap arguments."""
+    prefer_change = variant in ("B", "C")
+    cur, best_val, gain, tables = gains_and_best(
+        tensors, x, tables=tables, prefer_change=prefer_change,
+    )
+    activate = u < probability
+    improving = gain > 1e-9
+    lateral = (gain <= 1e-9) & (best_val != x)
+    if variant == "A":
+        want = improving
+    elif variant == "B":
+        in_conflict = conflicted(tensors, x, tables, HARD_THRESHOLD)
+        want = improving | (lateral & in_conflict)
+    else:  # C
+        want = improving | lateral
+    move = want & activate
+    return jnp.where(move, best_val, x).astype(jnp.int32)
+
+
 class DsaSolver(LocalSearchSolver):
     """State = (x,)."""
 
@@ -47,25 +72,11 @@ class DsaSolver(LocalSearchSolver):
 
     def cycle(self, state, key):
         (x,) = state
-        prefer_change = self.variant in ("B", "C")
-        cur, best_val, gain, tables = gains_and_best(
-            self.tensors, x, tables=self.local_tables(x),
-            prefer_change=prefer_change,
-        )
-        activate = (
-            jax.random.uniform(key, (self.tensors.n_vars,)) < self.probability
-        )
-        improving = gain > 1e-9
-        lateral = (gain <= 1e-9) & (best_val != x)
-        if self.variant == "A":
-            want = improving
-        elif self.variant == "B":
-            in_conflict = conflicted(self.tensors, x, tables, HARD_THRESHOLD)
-            want = improving | (lateral & in_conflict)
-        else:  # C
-            want = improving | lateral
-        move = want & activate
-        return (jnp.where(move, best_val, x).astype(jnp.int32),)
+        u = jax.random.uniform(key, (self.tensors.n_vars,))
+        return (dsa_cycle(
+            self.tensors, x, u, self.probability, self.variant,
+            tables=self.local_tables(x),
+        ),)
 
     def _chunk_runner(self, n, collect: bool = True):
         """Fused fast path: groups of cycles as single pallas kernels

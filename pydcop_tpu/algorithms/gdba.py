@@ -123,6 +123,51 @@ def increase_mask(t: jnp.ndarray, vals: jnp.ndarray,
     return mask
 
 
+def gdba_cycle(tensors, x, ws, fmins, fmaxs, modifier, violation,
+               increase_mode):
+    """One GDBA cycle as a pure function of the tensor graph, current
+    assignment ``x`` and breakout weights ``ws`` (one array per arity
+    bucket).  ``fmins``/``fmaxs`` are the per-bucket masked factor
+    min/max of the BASE costs (constant across cycles).  Single source
+    of semantics for :class:`GdbaSolver` and the batched vmap engine
+    (pydcop_tpu.batch), both of which pass the arrays as traced
+    arguments."""
+    t = tensors
+    V = t.n_vars
+    eff = [
+        effective_tensor(b.tensors, w, modifier)
+        for b, w in zip(t.buckets, ws)
+    ]
+    tables = local_cost_tables(t, x, bucket_tensors=eff)
+    cur, best_val, gain, _ = gains_and_best(t, x, tables=tables)
+    move = neighborhood_winner(t, gain)
+    x2 = jnp.where(move, best_val, x).astype(jnp.int32)
+
+    src, dst = t.neighbor_src, t.neighbor_dst
+    if src.shape[0] > 0:
+        neigh_max = jnp.maximum(segment_max(gain[src], dst, V), 0.0)
+    else:
+        neigh_max = jnp.zeros(V)
+    stuck = jnp.maximum(gain, neigh_max) <= 1e-9
+
+    ws2 = []
+    for bi, b in enumerate(t.buckets):
+        w = ws[bi]
+        if b.n_factors == 0:
+            ws2.append(w)
+            continue
+        F, a = b.n_factors, b.arity
+        vals = x[b.var_idx]  # [F, a]
+        idx = tuple(vals[:, p] for p in range(a))
+        base_cur = b.tensors[(jnp.arange(F),) + idx]  # [F]
+        viol = violation_mask(base_cur, fmins[bi], fmaxs[bi], violation)
+        qlm_any = jnp.any(stuck[b.var_idx], axis=1)
+        do_inc = (viol & qlm_any).astype(jnp.float32)  # [F]
+        mask = increase_mask(b.tensors, vals, increase_mode)
+        ws2.append(w + mask * do_inc.reshape([F] + [1] * a))
+    return x2, tuple(ws2)
+
+
 class GdbaSolver(LocalSearchSolver):
     """State = (x, [W_b per bucket])."""
 
@@ -158,39 +203,10 @@ class GdbaSolver(LocalSearchSolver):
 
     def cycle(self, state, key):
         x, ws = state
-        t = self.tensors
-        V = t.n_vars
-        eff = self._effective(ws)
-        tables = local_cost_tables(t, x, bucket_tensors=eff)
-        cur, best_val, gain, _ = gains_and_best(t, x, tables=tables)
-        move = neighborhood_winner(t, gain)
-        x2 = jnp.where(move, best_val, x).astype(jnp.int32)
-
-        src, dst = t.neighbor_src, t.neighbor_dst
-        if src.shape[0] > 0:
-            neigh_max = jnp.maximum(segment_max(gain[src], dst, V), 0.0)
-        else:
-            neigh_max = jnp.zeros(V)
-        stuck = jnp.maximum(gain, neigh_max) <= 1e-9
-
-        ws2 = []
-        for bi, b in enumerate(t.buckets):
-            w = ws[bi]
-            if b.n_factors == 0:
-                ws2.append(w)
-                continue
-            F, a = b.n_factors, b.arity
-            vals = x[b.var_idx]  # [F, a]
-            idx = tuple(vals[:, p] for p in range(a))
-            base_cur = b.tensors[(jnp.arange(F),) + idx]  # [F]
-            viol = violation_mask(
-                base_cur, self._fmin[bi], self._fmax[bi], self.violation
-            )
-            qlm_any = jnp.any(stuck[b.var_idx], axis=1)
-            do_inc = (viol & qlm_any).astype(jnp.float32)  # [F]
-            mask = increase_mask(b.tensors, vals, self.increase_mode)
-            ws2.append(w + mask * do_inc.reshape([F] + [1] * a))
-        return (x2, tuple(ws2))
+        return gdba_cycle(
+            self.tensors, x, ws, self._fmin, self._fmax,
+            self.modifier, self.violation, self.increase_mode,
+        )
 
 
 def build_solver(dcop: DCOP, computation_graph=None, algo_def=None, seed=0):

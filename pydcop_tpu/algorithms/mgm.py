@@ -31,6 +31,15 @@ algo_params = [
 ]
 
 
+def mgm_cycle(tensors, x, tables=None):
+    """One MGM cycle as a pure function of (tensors, x) — traceable with
+    the tensor-graph arrays as jit/vmap ARGUMENTS, which is how the
+    batched engine (pydcop_tpu.batch) runs B instances per dispatch."""
+    cur, best_val, gain, tables = gains_and_best(tensors, x, tables=tables)
+    move = neighborhood_winner(tensors, gain)
+    return jnp.where(move, best_val, x).astype(jnp.int32)
+
+
 class MgmSolver(LocalSearchSolver):
     """State = (x,).  One cycle = the reference's value+gain rounds."""
 
@@ -42,11 +51,7 @@ class MgmSolver(LocalSearchSolver):
 
     def cycle(self, state, key):
         (x,) = state
-        cur, best_val, gain, tables = gains_and_best(
-            self.tensors, x, tables=self.local_tables(x)
-        )
-        move = neighborhood_winner(self.tensors, gain)
-        return (jnp.where(move, best_val, x).astype(jnp.int32),)
+        return (mgm_cycle(self.tensors, x, tables=self.local_tables(x)),)
 
     def _chunk_runner(self, n, collect: bool = True):
         """Fused fast path: groups of cycles as single pallas kernels

@@ -1,0 +1,34 @@
+"""Batched multi-instance solve engine.
+
+One compile and one device dispatch chain per *bucket* of
+similarly-shaped instances instead of one per instance: compiled
+tensor graphs are grouped by shape signature (pydcop_tpu.batch.bucketing),
+padded to a common shape under a bounded padding-waste policy, stacked
+into ``[B, ...]`` arrays and advanced with ``jax.vmap``-ed cycle
+functions (pydcop_tpu.batch.engine).  A two-level compile cache
+(pydcop_tpu.batch.cache) — in-memory jitted-runner cache keyed by bucket
+signature plus the persistent XLA compilation cache on disk — makes
+repeated sweeps and long-running services compile each (bucket, algo)
+pair exactly once.
+
+The design follows PGMax's batched factor-graph inference (PAPERS.md,
+arxiv 2202.04110 — pad to uniform shapes, vmap across instances) and
+the batched GPU DCOP kernels of Fioretto et al. (arxiv 1608.05288);
+see docs/performance.rst "Batched solving".
+"""
+from pydcop_tpu.batch.bucketing import (  # noqa: F401
+    BucketPlan,
+    InstanceDims,
+    dims_of,
+    plan_buckets,
+)
+from pydcop_tpu.batch.cache import (  # noqa: F401
+    CompileCache,
+    enable_persistent_cache,
+    global_compile_cache,
+)
+from pydcop_tpu.batch.engine import (  # noqa: F401
+    BatchEngine,
+    BatchItem,
+    SUPPORTED_ALGOS,
+)

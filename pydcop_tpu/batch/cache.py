@@ -1,0 +1,103 @@
+"""Two-level compile cache for the batched solve engine.
+
+Level 1 — in-memory: jitted bucket runners keyed by the full bucket
+signature (algo, params, padded shapes, batch size, chunk length).  A
+hit returns the SAME callable object, so jax performs no re-trace and
+no compile; a long-running service that keeps seeing the same traffic
+shapes compiles each (bucket, algo) pair exactly once per process.
+
+Level 2 — persistent: the XLA compilation cache directory
+(``jax_compilation_cache_dir``).  A fresh process re-traces but XLA
+re-loads the compiled executable from disk instead of recompiling, so
+repeated sweeps across CLI invocations skip the expensive half too.
+
+Hit/miss counts are exported both as ``batch.compile.hit|miss`` events
+(runtime/events.py) and via :meth:`CompileCache.stats` — the bench's
+``compile_cache`` record and the tests' one-compile-per-bucket pin
+read them.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+
+class CompileCache:
+    """In-memory level of the two-level compile cache."""
+
+    def __init__(self):
+        self._fns: Dict[Tuple, Any] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_build(self, key: Tuple, builder: Callable[[], Any]
+                     ) -> Tuple[Any, bool]:
+        """(runner, was_hit) for ``key``; ``builder`` runs on a miss."""
+        from pydcop_tpu.runtime.events import send_batch
+
+        if key in self._fns:
+            self.hits += 1
+            send_batch("compile.hit", {"key": _printable(key)})
+            return self._fns[key], True
+        self.misses += 1
+        send_batch("compile.miss", {"key": _printable(key)})
+        fn = builder()
+        self._fns[key] = fn
+        return fn, False
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "entries": len(self._fns),
+        }
+
+    def clear(self) -> None:
+        self._fns.clear()
+        self.hits = 0
+        self.misses = 0
+
+
+#: process-wide default cache: engines share it unless given their own,
+#: so a service constructing one BatchEngine per request still compiles
+#: each (bucket, algo) pair once per process
+_GLOBAL_CACHE = CompileCache()
+
+
+def global_compile_cache() -> CompileCache:
+    return _GLOBAL_CACHE
+
+
+def enable_persistent_cache(
+    cache_dir: str,
+    min_entry_size_bytes: int = -1,
+    min_compile_time_secs: float = 0.0,
+) -> bool:
+    """Point the persistent XLA compilation cache at ``cache_dir``
+    (level 2 of the cache).  The floor options are lowered so even the
+    small bucket programs of test-scale sweeps persist.  Returns False
+    (with a warning) when this jax build lacks the options instead of
+    failing the solve — the engine works without level 2, it just
+    recompiles per process."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_entry_size_bytes",
+            min_entry_size_bytes,
+        )
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            min_compile_time_secs,
+        )
+        return True
+    except Exception as e:  # unsupported jax build: degrade, don't fail
+        log.warning("persistent compile cache unavailable: %s", e)
+        return False
+
+
+def _printable(key: Tuple) -> str:
+    return "/".join(str(k) for k in key)

@@ -1,0 +1,792 @@
+"""BatchEngine — vmapped multi-instance solving.
+
+One instance's solve is a ``lax.scan`` of a pure cycle function over a
+compiled tensor graph (algorithms/base.py); B same-shaped instances are
+the SAME program vmapped over stacked ``[B, ...]`` arrays — one trace,
+one XLA compile and one dispatch chain per bucket instead of B.
+
+Bit-identity with the sequential path is a hard contract (pinned per
+algorithm in tests/unit/test_batch_engine.py), which drives three
+design points:
+
+* **randomness is drawn at each instance's TRUE shape** and padded
+  afterwards — ``jax.random`` draws depend on the requested shape, so
+  initial values come from each instance's own solver and the DSA/A-DSA
+  per-cycle uniforms are pre-drawn from the exact key stream
+  ``SynchronousTensorSolver.run`` would use (same per-chunk key splits,
+  via the shared :func:`algorithms.base.default_chunk` policy) and fed
+  to the vmapped cycle as scan inputs — the same trick the fused pallas
+  kernels use (ops/pallas_local_search.uniforms_for_keys);
+* **padding is inert by routing**: padded variables get a single valid
+  value and no factors; padded factors hold all-zero cost tensors and
+  point every position at a reserved dummy variable, so their messages
+  and table rows land on the dummy only; padded neighbor pairs connect
+  the dummy to itself.  Real variables' reductions see exactly the
+  arrays they would see unpadded;
+* **convergence mirrors the harness**: per-instance chunk-boundary
+  comparison with the same prime chunk size and two-stable-chunks rule;
+  converged instances are frozen (their state no longer advances) and
+  the bucket exits early once every instance converged or the cycle
+  limit is reached.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from time import perf_counter
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pydcop_tpu.algorithms import AlgorithmDef, load_algorithm_module
+from pydcop_tpu.algorithms.base import SolveResult, default_chunk
+from pydcop_tpu.batch.bucketing import (
+    BucketPlan,
+    InstanceDims,
+    dims_of,
+    plan_buckets,
+)
+from pydcop_tpu.batch.cache import (
+    CompileCache,
+    enable_persistent_cache,
+    global_compile_cache,
+)
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.ops.compile import (
+    ConstraintGraphTensors,
+    FactorBucket,
+    FactorGraphTensors,
+    PAD_COST,
+)
+from pydcop_tpu.runtime.events import send_batch
+from pydcop_tpu.runtime.stats import BatchCounters
+
+#: algorithms with a vmapped batched engine; anything else is solved
+#: sequentially by the fallback path (counted, never silently dropped)
+SUPPORTED_ALGOS = ("maxsum", "mgm", "dsa", "adsa", "gdba")
+
+#: default cycle ceiling for run-to-convergence, mirroring
+#: SynchronousTensorSolver.run(max_cycles=2000)
+DEFAULT_MAX_CYCLES = 2000
+
+
+@dataclasses.dataclass
+class BatchItem:
+    """One solve request: a problem plus how to solve it."""
+
+    dcop: DCOP
+    algo: Union[str, AlgorithmDef]
+    algo_params: Optional[Dict[str, Any]] = None
+    seed: int = 0
+    label: Optional[str] = None
+
+    def algo_def(self) -> AlgorithmDef:
+        if isinstance(self.algo, AlgorithmDef):
+            return self.algo
+        return AlgorithmDef.build_with_default_params(
+            self.algo, self.algo_params or {}, mode=self.dcop.objective
+        )
+
+
+@dataclasses.dataclass
+class _Spec:
+    """One compiled instance inside a group."""
+
+    item: BatchItem
+    solver: Any
+    tensors: Any  # the solver's (possibly noise-adjusted) tensor graph
+    dims: InstanceDims
+
+
+# ---------------------------------------------------------------------------
+# padding + stacking
+# ---------------------------------------------------------------------------
+
+
+def pad_instance(tensors, target: InstanceDims) -> Dict[str, np.ndarray]:
+    """Pad one compiled instance's arrays to the bucket target shape.
+
+    Returns the per-instance array dict the vmapped cycle functions are
+    rebuilt from (:func:`rebuild_tensors`).  Padding is inert by
+    construction — see the module docstring."""
+    V, D = tensors.n_vars, tensors.max_domain_size
+    Vp, Dp = target.V, target.D
+    dummy = Vp - 1  # only ever routed to when factors/pairs pad
+
+    mask = np.zeros((Vp, Dp), np.float32)
+    mask[:V, :D] = np.asarray(tensors.domain_mask)
+    mask[V:, 0] = 1.0  # padded vars: one valid value
+    unary = np.full((Vp, Dp), PAD_COST, np.float32)
+    unary[:V, :D] = np.asarray(tensors.unary_costs)
+    unary[V:, :] = PAD_COST
+    unary[V:, 0] = 0.0
+    arr: Dict[str, np.ndarray] = {"mask": mask, "unary": unary}
+
+    ev_parts: List[np.ndarray] = []
+    for i, (a, fp) in enumerate(zip(target.arities, target.F)):
+        b = tensors.buckets[i]
+        F = b.n_factors
+        t = np.full((fp,) + (Dp,) * a, PAD_COST, np.float32)
+        t[(slice(0, F),) + (slice(0, D),) * a] = np.asarray(b.tensors)
+        # padded factors: zero costs routed at the dummy var — zero
+        # messages / zero table rows, landing on the dummy only
+        t[F:] = 0.0
+        vi = np.full((fp, a), dummy, np.int32)
+        vi[:F] = b.var_idx
+        arr[f"bt{i}"] = t
+        arr[f"bv{i}"] = vi
+        ev_parts.append(vi.reshape(-1))
+    arr["edge_var"] = (
+        np.concatenate(ev_parts) if ev_parts else np.zeros(0, np.int32)
+    )
+
+    if target.graph_type == "constraints_hypergraph":
+        src = np.asarray(tensors.neighbor_src)
+        dst = np.asarray(tensors.neighbor_dst)
+        M = src.shape[0]
+        nsrc = np.full(target.M, dummy, np.int32)
+        ndst = np.full(target.M, dummy, np.int32)
+        nsrc[:M] = src
+        ndst[:M] = dst
+        arr["nsrc"] = nsrc
+        arr["ndst"] = ndst
+    return arr
+
+
+def pad_vec(x: np.ndarray, n: int, fill) -> np.ndarray:
+    """Pad a 1-D per-variable vector to length ``n``."""
+    x = np.asarray(x)
+    if x.shape[0] == n:
+        return x
+    out = np.full((n,) + x.shape[1:], fill, dtype=x.dtype)
+    out[: x.shape[0]] = x
+    return out
+
+
+def _stack(dicts: Sequence[Dict[str, np.ndarray]]) -> Dict[str, jnp.ndarray]:
+    return {
+        k: jnp.asarray(np.stack([d[k] for d in dicts]))
+        for k in dicts[0]
+    }
+
+
+def rebuild_tensors(meta: "BucketMeta", arr: Dict[str, jnp.ndarray]):
+    """Per-instance tensor-graph dataclass from (traced) arrays, inside
+    jit/vmap — the shared ops cycle functions (maxsum_cycle,
+    local_cost_tables, gains_and_best, ...) then run on it unchanged.
+    Host-only fields (names, domain values) are placeholders of the
+    right LENGTH: device math reads only lengths and arrays."""
+    buckets: List[FactorBucket] = []
+    off = 0
+    for i, (a, f) in enumerate(zip(meta.arities, meta.F)):
+        buckets.append(
+            FactorBucket(
+                arity=a,
+                tensors=arr[f"bt{i}"],
+                var_idx=arr[f"bv{i}"],
+                factor_ids=np.arange(f, dtype=np.int32),
+                edge_offset=off,
+            )
+        )
+        off += f * a
+    common = dict(
+        var_names=[""] * meta.V,
+        domain_values=[()] * meta.V,
+        domain_sizes=np.ones(meta.V, np.int32),
+        domain_mask=arr["mask"],
+        unary_costs=arr["unary"],
+        buckets=buckets,
+        edge_var=arr["edge_var"],
+        factor_names=[""] * sum(meta.F),
+        sign=1.0,
+        initial_values=np.zeros(meta.V, np.int32),
+        has_initial=np.zeros(meta.V, bool),
+    )
+    if meta.graph_type == "factor_graph":
+        return FactorGraphTensors(**common)
+    return ConstraintGraphTensors(
+        **common, neighbor_src=arr["nsrc"], neighbor_dst=arr["ndst"]
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketMeta:
+    """Static shape info a bucket's traced code closes over."""
+
+    graph_type: str
+    V: int
+    D: int
+    arities: Tuple[int, ...]
+    F: Tuple[int, ...]
+
+    @classmethod
+    def of(cls, target: InstanceDims) -> "BucketMeta":
+        return cls(target.graph_type, target.V, target.D,
+                   target.arities, target.F)
+
+
+# ---------------------------------------------------------------------------
+# per-chunk PRNG streams (drawn at TRUE shapes, padded afterwards)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n", "V", "Vp"))
+def _dsa_chunk_uniforms(key, n: int, V: int, Vp: int):
+    """(next_key, [n, Vp] uniforms) reproducing the harness stream for
+    one chunk: ``key, sub = split(key); cycle_keys = split(sub, n)``,
+    then DsaSolver.cycle's ``uniform(cycle_key, (V,))`` — padded columns
+    get 1.0 (never activate; padded vars cannot move anyway)."""
+    key2, sub = jax.random.split(key)
+    ks = jax.random.split(sub, n)
+
+    def one(k):
+        u = jax.random.uniform(k, (V,))
+        return jnp.concatenate([u, jnp.ones((Vp - V,), jnp.float32)])
+
+    return key2, jax.vmap(one)(ks)
+
+
+@partial(jax.jit, static_argnames=("n", "V", "Vp"))
+def _adsa_chunk_uniforms(key, n: int, V: int, Vp: int):
+    """(next_key, ([n, Vp] wake, [n, Vp] move)) matching ADsaSolver's
+    per-cycle ``k_wake, k_move = split(cycle_key)`` draws exactly."""
+    key2, sub = jax.random.split(key)
+    ks = jax.random.split(sub, n)
+    pad = jnp.ones((Vp - V,), jnp.float32)
+
+    def one(k):
+        kw, km = jax.random.split(k)
+        w = jnp.concatenate([jax.random.uniform(kw, (V,)), pad])
+        m = jnp.concatenate([jax.random.uniform(km, (V,)), pad])
+        return w, m
+
+    return key2, jax.vmap(one)(ks)
+
+
+# ---------------------------------------------------------------------------
+# per-algorithm adapters
+# ---------------------------------------------------------------------------
+
+
+class _AdapterBase:
+    """What the engine needs to batch one algorithm family."""
+
+    algo: str = ""
+    uses_keys = False
+
+    def build_spec(self, item: BatchItem) -> _Spec:
+        raise NotImplementedError
+
+    def extra_arrays(self, spec: _Spec, target: InstanceDims
+                     ) -> Dict[str, np.ndarray]:
+        return {}
+
+    def initial_state(self, spec: _Spec, target: InstanceDims):
+        """Per-instance padded initial state (np pytree), computed from
+        the instance's own solver at its TRUE shape."""
+        raise NotImplementedError
+
+    def make_cycle(self, params: Dict[str, Any]):
+        """cycle(tensors, arr, state, xs) -> state, traced per instance
+        inside the vmapped runner."""
+        raise NotImplementedError
+
+    def chunk_xs(self, keys: List[Any], n: int,
+                 specs: Sequence[_Spec], target: InstanceDims):
+        """(advanced keys, stacked per-cycle scan inputs or None)."""
+        return keys, None
+
+    def values_np(self, state) -> np.ndarray:
+        """[B, Vp] value indices from a batched state."""
+        return np.asarray(state[0])
+
+    def converged(self, spec: _Spec, prev_state_i, state_i) -> bool:
+        """Per-instance chunk-boundary convergence test, mirroring the
+        solver's chunk_converged."""
+        return bool(np.array_equal(
+            np.asarray(prev_state_i[0]), np.asarray(state_i[0])
+        ))
+
+
+class _LocalSearchAdapter(_AdapterBase):
+    """mgm / dsa / adsa — state = (x,)."""
+
+    def __init__(self, algo: str):
+        self.algo = algo
+        self.uses_keys = algo in ("dsa", "adsa")
+
+    def build_spec(self, item: BatchItem) -> _Spec:
+        from pydcop_tpu.ops.compile import compile_constraint_graph
+
+        mod = load_algorithm_module(self.algo)
+        tensors = compile_constraint_graph(item.dcop)
+        solver_cls = {
+            "mgm": "MgmSolver", "dsa": "DsaSolver", "adsa": "ADsaSolver",
+        }[self.algo]
+        solver = getattr(mod, solver_cls)(
+            item.dcop, tensors, item.algo_def(), seed=item.seed,
+            use_packed=False,
+        )
+        return _Spec(item, solver, solver.tensors,
+                     dims_of(solver.tensors, "constraints_hypergraph"))
+
+    def initial_state(self, spec: _Spec, target: InstanceDims):
+        (x,) = spec.solver.initial_state()
+        return (pad_vec(np.asarray(x), target.V, 0).astype(np.int32),)
+
+    def make_cycle(self, params: Dict[str, Any]):
+        if self.algo == "mgm":
+            from pydcop_tpu.algorithms.mgm import mgm_cycle
+
+            def cycle(t, arr, st, xs):
+                return (mgm_cycle(t, st[0]),)
+        elif self.algo == "dsa":
+            from pydcop_tpu.algorithms.dsa import dsa_cycle
+
+            p = float(params.get("probability", 0.7))
+            variant = params.get("variant", "B")
+
+            def cycle(t, arr, st, xs):
+                return (dsa_cycle(t, st[0], xs, p, variant),)
+        else:  # adsa
+            from pydcop_tpu.algorithms.adsa import adsa_cycle
+
+            p = float(params.get("probability", 0.7))
+            variant = params.get("variant", "B")
+            act = float(params.get("activation", 0.5))
+
+            def cycle(t, arr, st, xs):
+                wake, move = xs
+                return (adsa_cycle(t, st[0], wake, move, p, variant,
+                                   act),)
+        return cycle
+
+    def chunk_xs(self, keys, n, specs, target):
+        if not self.uses_keys:
+            return keys, None
+        draw = (_dsa_chunk_uniforms if self.algo == "dsa"
+                else _adsa_chunk_uniforms)
+        new_keys, parts = [], []
+        for key, spec in zip(keys, specs):
+            key2, u = draw(key, n=n, V=spec.dims.V, Vp=target.V)
+            new_keys.append(key2)
+            parts.append(u)
+        if self.algo == "dsa":
+            xs = jnp.stack(parts)  # [B, n, Vp]
+        else:
+            xs = (jnp.stack([p[0] for p in parts]),
+                  jnp.stack([p[1] for p in parts]))
+        return new_keys, xs
+
+
+class _GdbaAdapter(_AdapterBase):
+    """gdba — state = (x, per-bucket weights)."""
+
+    algo = "gdba"
+
+    def build_spec(self, item: BatchItem) -> _Spec:
+        from pydcop_tpu.algorithms.gdba import GdbaSolver
+        from pydcop_tpu.ops.compile import compile_constraint_graph
+
+        tensors = compile_constraint_graph(item.dcop)
+        solver = GdbaSolver(item.dcop, tensors, item.algo_def(),
+                            seed=item.seed)
+        return _Spec(item, solver, solver.tensors,
+                     dims_of(solver.tensors, "constraints_hypergraph"))
+
+    def extra_arrays(self, spec, target):
+        out = {}
+        for i, (a, fp) in enumerate(zip(target.arities, target.F)):
+            fmin = pad_vec(np.asarray(spec.solver._fmin[i]), fp, 0.0)
+            fmax = pad_vec(np.asarray(spec.solver._fmax[i]), fp, 0.0)
+            out[f"fmin{i}"] = fmin.astype(np.float32)
+            out[f"fmax{i}"] = fmax.astype(np.float32)
+        return out
+
+    def initial_state(self, spec, target):
+        x, ws = spec.solver.initial_state()
+        init = 0.0 if spec.solver.modifier == "A" else 1.0
+        ws_p = []
+        for i, (a, fp) in enumerate(zip(target.arities, target.F)):
+            w = np.full((fp,) + (target.D,) * a, init, np.float32)
+            true = np.asarray(ws[i])
+            w[(slice(0, true.shape[0]),)
+              + (slice(0, true.shape[1]),) * a] = true
+            ws_p.append(w)
+        return (pad_vec(np.asarray(x), target.V, 0).astype(np.int32),
+                tuple(ws_p))
+
+    def make_cycle(self, params):
+        from pydcop_tpu.algorithms.gdba import gdba_cycle
+
+        modifier = params.get("modifier", "A")
+        violation = params.get("violation", "NZ")
+        increase_mode = params.get("increase_mode", "E")
+
+        def cycle(t, arr, st, xs):
+            x, ws = st
+            fmins = [arr[f"fmin{i}"] for i in range(len(t.buckets))]
+            fmaxs = [arr[f"fmax{i}"] for i in range(len(t.buckets))]
+            return gdba_cycle(t, x, ws, fmins, fmaxs, modifier,
+                              violation, increase_mode)
+
+        return cycle
+
+
+class _MaxSumAdapter(_AdapterBase):
+    """maxsum — state = (q, r, values)."""
+
+    algo = "maxsum"
+
+    def build_spec(self, item: BatchItem) -> _Spec:
+        from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+        from pydcop_tpu.ops.compile import compile_factor_graph
+
+        tensors = compile_factor_graph(item.dcop)
+        # use_packed=False: the batch engine vmaps the generic cycle;
+        # solver construction bakes the symmetry-breaking noise into
+        # unary costs at the instance's TRUE shape (bit-identity)
+        solver = MaxSumSolver(item.dcop, tensors, item.algo_def(),
+                              seed=item.seed, use_packed=False)
+        return _Spec(item, solver, solver.tensors,
+                     dims_of(solver.tensors, "factor_graph"))
+
+    def initial_state(self, spec, target):
+        q, r, values = spec.solver.initial_state()
+        Ep = sum(f * a for f, a in zip(target.F, target.arities))
+        # messages start at zero, so padding them is trivial — but edge
+        # offsets shift when factor counts pad, so build fresh zeros at
+        # the padded layout rather than padding the true arrays
+        zq = np.zeros((Ep, target.D), np.float32)
+        return (
+            zq,
+            zq.copy(),
+            pad_vec(np.asarray(values), target.V, 0).astype(np.int32),
+        )
+
+    def make_cycle(self, params):
+        from pydcop_tpu.ops.maxsum_kernels import maxsum_cycle
+
+        damping = params.get("damping")
+        damping = 0.5 if damping is None else float(damping)
+
+        def cycle(t, arr, st, xs):
+            q, r, _ = st
+            q2, r2, _beliefs, values = maxsum_cycle(
+                t, q, r, damping=damping
+            )
+            return (q2, r2, values)
+
+        return cycle
+
+    def values_np(self, state) -> np.ndarray:
+        return np.asarray(state[2])
+
+    def converged(self, spec, prev_state_i, state_i) -> bool:
+        if np.array_equal(np.asarray(prev_state_i[2]),
+                          np.asarray(state_i[2])):
+            return True
+        # the reference's approx_match message-stability test
+        # (algorithms/maxsum.messages_stable), in numpy on this
+        # instance's r messages
+        stability = spec.solver.stability
+        r_prev = np.asarray(prev_state_i[1])
+        r_cur = np.asarray(state_i[1])
+        delta = np.abs(r_cur - r_prev)
+        denom = np.abs(r_cur + r_prev)
+        return bool(np.all((delta == 0) | (2 * delta < stability * denom)))
+
+
+def _adapter_for(algo: str) -> _AdapterBase:
+    if algo in ("mgm", "dsa", "adsa"):
+        return _LocalSearchAdapter(algo)
+    if algo == "gdba":
+        return _GdbaAdapter()
+    if algo == "maxsum":
+        return _MaxSumAdapter()
+    raise KeyError(algo)
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+
+def _params_key(params: Dict[str, Any]) -> Tuple:
+    return tuple(sorted((k, str(v)) for k, v in (params or {}).items()))
+
+
+def _select_state(done_mask: np.ndarray, old_state, new_state):
+    """Freeze converged instances: keep their old leaves."""
+    mask = jnp.asarray(done_mask)
+
+    def sel(old, new):
+        m = mask.reshape((-1,) + (1,) * (old.ndim - 1))
+        return jnp.where(m, old, new)
+
+    return jax.tree_util.tree_map(sel, old_state, new_state)
+
+
+def _index_state(state, i: int):
+    return jax.tree_util.tree_map(lambda a: a[i], state)
+
+
+class BatchEngine:
+    """Shape-bucketed vmapped solver for sweeps and services.
+
+    >>> # doctest-free sketch:
+    >>> # eng = BatchEngine()
+    >>> # results = eng.solve([BatchItem(dcop, "mgm", seed=s) ...],
+    >>> #                     cycles=30)
+
+    ``cache=None`` shares the process-wide compile cache; pass a fresh
+    :class:`CompileCache` to isolate (the tests do).
+    ``persistent_cache_dir`` additionally turns on the on-disk XLA
+    compilation cache (level 2) for compile reuse ACROSS processes.
+    """
+
+    def __init__(
+        self,
+        max_padding_waste: float = 0.25,
+        cache: Optional[CompileCache] = None,
+        persistent_cache_dir: Optional[str] = None,
+        counters: Optional[BatchCounters] = None,
+    ):
+        self.max_padding_waste = float(max_padding_waste)
+        self.cache = cache if cache is not None else global_compile_cache()
+        self.counters = counters if counters is not None else BatchCounters()
+        self.persistent_cache_enabled = False
+        if persistent_cache_dir:
+            self.persistent_cache_enabled = enable_persistent_cache(
+                persistent_cache_dir
+            )
+
+    def metrics(self) -> Dict[str, Any]:
+        out = self.counters.as_dict()
+        out["padding_waste"] = round(self.counters.padding_waste, 4)
+        out["cache"] = self.cache.stats()
+        return out
+
+    # -- public API ---------------------------------------------------------
+
+    def solve(
+        self,
+        items: Sequence[BatchItem],
+        cycles: Optional[int] = None,
+        timeout: Optional[float] = None,
+        max_cycles: int = DEFAULT_MAX_CYCLES,
+    ) -> List[SolveResult]:
+        """Solve every item; results align with ``items`` by index.
+
+        ``cycles`` set → every instance runs exactly that many cycles
+        (the sequential harness's fixed-cycle mode: no early freeze, so
+        results stay bit-identical to ``solver.run(cycles=n)``).
+        ``cycles=None`` → run-to-convergence with per-instance freeze
+        masks and early bucket exit.
+        """
+        t0 = perf_counter()
+        self.counters.inc("instances_enqueued", len(items))
+        results: List[Optional[SolveResult]] = [None] * len(items)
+
+        groups: Dict[Tuple, List[int]] = {}
+        for i, item in enumerate(items):
+            algo_def = item.algo_def()
+            groups.setdefault(
+                (algo_def.algo, _params_key(algo_def.params)), []
+            ).append(i)
+
+        n_buckets = 0
+        for (algo, pkey), idxs in sorted(groups.items()):
+            if algo not in SUPPORTED_ALGOS:
+                self._solve_fallback(items, idxs, results, cycles, timeout)
+                continue
+            adapter = _adapter_for(algo)
+            specs = [adapter.build_spec(items[i]) for i in idxs]
+            plans = plan_buckets(
+                [s.dims for s in specs], self.max_padding_waste
+            )
+            for plan in plans:
+                n_buckets += 1
+                self.counters.inc("buckets_formed")
+                self.counters.inc(
+                    "stacked_cells", plan.target.cells * plan.batch_size
+                )
+                self.counters.inc(
+                    "padded_cells",
+                    plan.target.cells * plan.batch_size
+                    - sum(specs[j].dims.cells for j in plan.indices),
+                )
+                send_batch("bucket.formed", {
+                    "algo": algo,
+                    "signature": plan.signature(),
+                    "size": plan.batch_size,
+                    "waste": plan.waste,
+                })
+                bucket_specs = [specs[j] for j in plan.indices]
+                bucket_results = self._solve_bucket(
+                    adapter, bucket_specs, plan, cycles, timeout,
+                    max_cycles,
+                )
+                for j, res in zip(plan.indices, bucket_results):
+                    results[idxs[j]] = res
+        self.counters.inc("instances_solved", len(items))
+        send_batch("run.done", {
+            "instances": len(items),
+            "buckets": n_buckets,
+            "wall": round(perf_counter() - t0, 3),
+            "cache": self.cache.stats(),
+        })
+        return results  # type: ignore[return-value]
+
+    # -- internals ----------------------------------------------------------
+
+    def _solve_fallback(self, items, idxs, results, cycles, timeout):
+        """Sequential per-instance path for algorithms without a
+        batched engine — counted, never silent."""
+        from pydcop_tpu.runtime.run import solve_result
+
+        for i in idxs:
+            item = items[i]
+            self.counters.inc("fallback_sequential")
+            results[i] = solve_result(
+                item.dcop, item.algo_def(), cycles=cycles,
+                timeout=timeout, seed=item.seed,
+            )
+
+    def _runner_key(self, adapter, plan: BucketPlan, pkey: Tuple,
+                    n: int) -> Tuple:
+        return (adapter.algo, pkey) + plan.signature() + (n,)
+
+    def _build_runner(self, adapter: _AdapterBase, meta: BucketMeta,
+                      params: Dict[str, Any], n: int):
+        cycle = adapter.make_cycle(params)
+
+        @jax.jit
+        def run_chunk(arrays, state, xs):
+            def one(arr_i, st_i, xs_i):
+                t = rebuild_tensors(meta, arr_i)
+
+                def body(st, x_in):
+                    return cycle(t, arr_i, st, x_in), None
+
+                st, _ = jax.lax.scan(body, st_i, xs_i, length=n)
+                return st
+
+            return jax.vmap(one)(arrays, state, xs)
+
+        return run_chunk
+
+    def _solve_bucket(
+        self,
+        adapter: _AdapterBase,
+        specs: List[_Spec],
+        plan: BucketPlan,
+        cycles: Optional[int],
+        timeout: Optional[float],
+        max_cycles: int,
+    ) -> List[SolveResult]:
+        t0 = perf_counter()
+        B = len(specs)
+        target = plan.target
+        meta = BucketMeta.of(target)
+        algo_def = specs[0].item.algo_def()
+        params = algo_def.params
+        pkey = _params_key(params)
+
+        arrays = _stack([
+            {**pad_instance(s.tensors, target),
+             **adapter.extra_arrays(s, target)}
+            for s in specs
+        ])
+        state = jax.tree_util.tree_map(
+            lambda *leaves: jnp.asarray(np.stack(leaves)),
+            *[adapter.initial_state(s, target) for s in specs],
+        )
+        keys = [jax.random.PRNGKey(s.item.seed) for s in specs]
+
+        target_cycles = cycles if cycles else None
+        limit = target_cycles if target_cycles is not None else max_cycles
+        chunk = default_chunk(target_cycles, False, False, timeout, limit)
+
+        done = 0
+        done_mask = np.zeros(B, bool)
+        stable = np.zeros(B, np.int64)
+        stop_cycle = np.zeros(B, np.int64)
+        statuses = ["FINISHED"] * B
+        prev_state = None
+
+        while done < limit:
+            n = min(chunk, limit - done)
+            key = self._runner_key(adapter, plan, pkey, n)
+            runner, hit = self.cache.get_or_build(
+                key,
+                lambda: self._build_runner(adapter, meta, params, n),
+            )
+            self.counters.inc("compile_hits" if hit else "compile_misses")
+            keys, xs = adapter.chunk_xs(keys, n, specs, target)
+            new_state = runner(arrays, state, xs)
+            if done_mask.any():
+                new_state = _select_state(done_mask, state, new_state)
+            done += n
+            stop_cycle[~done_mask] = done
+
+            if target_cycles is None:
+                if prev_state is not None:
+                    for i in range(B):
+                        if done_mask[i]:
+                            continue
+                        conv = adapter.converged(
+                            specs[i],
+                            _index_state(prev_state, i),
+                            _index_state(new_state, i),
+                        )
+                        stable[i] = stable[i] + 1 if conv else 0
+                        if stable[i] >= 2:
+                            done_mask[i] = True
+                            self.counters.inc("instances_converged")
+                            send_batch("instance.converged", {
+                                "label": specs[i].item.label or i,
+                                "cycle": int(stop_cycle[i]),
+                            })
+                prev_state = new_state
+                state = new_state
+                if done_mask.all():
+                    break
+            else:
+                state = new_state
+            if timeout is not None and perf_counter() - t0 > timeout:
+                for i in range(B):
+                    if not done_mask[i]:
+                        statuses[i] = "TIMEOUT"
+                break
+
+        wall = perf_counter() - t0
+        out: List[SolveResult] = []
+        values = adapter.values_np(state)
+        from pydcop_tpu.algorithms import DEFAULT_INFINITY
+
+        for i, spec in enumerate(specs):
+            V = spec.dims.V
+            assignment = spec.tensors.assignment_from_indices(
+                values[i][:V]
+            )
+            violation, cost = spec.item.dcop.solution_cost(
+                assignment, DEFAULT_INFINITY
+            )
+            n_cyc = int(stop_cycle[i])
+            solver = spec.solver
+            out.append(SolveResult(
+                status=statuses[i],
+                assignment=assignment,
+                cost=cost,
+                violation=violation,
+                cycle=n_cyc,
+                msg_count=solver.msgs_per_cycle * n_cyc,
+                msg_size=(solver.msgs_per_cycle * n_cyc
+                          * solver.msg_size_per_msg),
+                time=wall,
+            ))
+        return out

@@ -25,6 +25,19 @@ def set_parser(subparsers):
     )
     parser.set_defaults(func=run_cmd)
     parser.add_argument("dcop_files", nargs="+", help="DCOP YAML file(s)")
+    parser.add_argument(
+        "--batch", action="store_true",
+        help="treat each DCOP file as a SEPARATE instance and solve "
+        "them all through the batched vmap engine (shape-bucketed, one "
+        "compile per bucket) instead of merging the files into one "
+        "problem; prints one metrics object per file")
+    parser.add_argument(
+        "--max-padding-waste", type=float, default=0.25,
+        help="with --batch: bucketing waste bound (see docs/performance"
+        ".rst 'Batched solving')")
+    parser.add_argument(
+        "--compile-cache-dir", default=None,
+        help="with --batch: persistent XLA compile cache directory")
     parser.add_argument("-a", "--algo", required=True,
                         help="algorithm name")
     parser.add_argument(
@@ -72,6 +85,9 @@ def set_parser(subparsers):
 def run_cmd(args):
     from pydcop_tpu.dcop import load_dcop_from_file
     from pydcop_tpu.runtime import solve_result
+
+    if args.batch:
+        return _run_batch(args)
 
     try:
         dcop = load_dcop_from_file(args.dcop_files)
@@ -145,3 +161,61 @@ def run_cmd(args):
         add_csvline(args.end_metrics, args.collect_on, metrics)
     output_metrics(metrics, args.output)
     return 0 if res.status in ("FINISHED", "TIMEOUT") else 1
+
+
+def _run_batch(args):
+    """``solve --batch f1.yaml f2.yaml ...`` — the multi-instance front
+    door: each file is one instance, solved through the batched vmap
+    engine (pydcop_tpu.batch).  Prints a JSON object with per-file
+    metrics plus the engine's bucket/cache summary."""
+    from pydcop_tpu.batch import BatchEngine, BatchItem
+    from pydcop_tpu.dcop import load_dcop_from_file
+
+    if args.distribution or args.checkpoint or args.resume:
+        output_metrics(
+            {"status": "ERROR",
+             "error": "--batch does not combine with --distribution or "
+             "checkpointing; solve the instances separately"},
+            args.output,
+        )
+        return 1
+    algo_params = parse_algo_params(args.algo_params)
+    warn_process_mode(args.mode)
+
+    items, errors = [], {}
+    for fn in args.dcop_files:
+        try:
+            items.append(BatchItem(
+                load_dcop_from_file([fn]), args.algo,
+                algo_params=algo_params, seed=args.seed, label=fn,
+            ))
+        except Exception as e:
+            errors[fn] = {"status": "ERROR", "error": str(e)}
+
+    engine = BatchEngine(
+        max_padding_waste=args.max_padding_waste,
+        persistent_cache_dir=args.compile_cache_dir,
+    )
+    try:
+        results = engine.solve(
+            items, cycles=args.cycles, timeout=args.timeout
+        )
+    except Exception as e:
+        output_metrics({"status": "ERROR", "error": str(e)}, args.output)
+        return 1
+
+    per_file = dict(errors)
+    for item, res in zip(items, results):
+        per_file[item.label] = res.metrics()
+    ok = not errors and all(
+        r.status in ("FINISHED", "TIMEOUT") for r in results
+    )
+    output_metrics(
+        {
+            "status": "FINISHED" if ok else "ERROR",
+            "results": per_file,
+            "batch": engine.metrics(),
+        },
+        args.output,
+    )
+    return 0 if ok else 1

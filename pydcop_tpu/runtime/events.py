@@ -54,3 +54,18 @@ def send_fault(event: str, payload) -> None:
     """Publish a fault/recovery event on the global bus (no-op unless
     observability is enabled, like every other topic)."""
     event_bus.send(FAULT_TOPIC_PREFIX + event, payload)
+
+
+#: batched-solve topic prefix (pydcop_tpu.batch).  Topics:
+#: ``batch.bucket.formed`` (signature, size, waste),
+#: ``batch.compile.hit`` / ``batch.compile.miss`` (cache key),
+#: ``batch.instance.converged`` (label, cycle),
+#: ``batch.run.done`` (instances, buckets, wall) — subscribe with
+#: ``batch.*`` (the UI server pushes them to ws/SSE clients).
+BATCH_TOPIC_PREFIX = "batch."
+
+
+def send_batch(event: str, payload) -> None:
+    """Publish a batched-solve lifecycle event on the global bus
+    (no-op unless observability is enabled)."""
+    event_bus.send(BATCH_TOPIC_PREFIX + event, payload)

@@ -73,6 +73,46 @@ class FaultCounters:
         return any(self.counts.values())
 
 
+#: counter names surfaced under metrics["batch"] by the batched solve
+#: engine (pydcop_tpu.batch.engine.BatchEngine.counters) — one schema
+#: for the library API, the in-process CLI runner and the bench
+BATCH_COUNTERS = (
+    "instances_enqueued",     # items handed to BatchEngine.solve
+    "instances_solved",
+    "instances_converged",    # converged before the cycle limit
+    "buckets_formed",
+    "compile_hits",           # in-memory runner-cache hits
+    "compile_misses",         # runners traced+compiled this process
+    "fallback_sequential",    # algos outside the vmap set, solved 1-by-1
+    "padded_cells",           # stacked array cells holding padding
+    "stacked_cells",          # total stacked array cells
+)
+
+
+class BatchCounters:
+    """Batched-solve counters collected by the BatchEngine and merged
+    into its run summary (``BatchEngine.metrics()``)."""
+
+    def __init__(self):
+        self.counts = {k: 0 for k in BATCH_COUNTERS}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        if name not in self.counts:
+            raise KeyError(
+                f"unknown batch counter {name!r}; add it to "
+                f"BATCH_COUNTERS"
+            )
+        self.counts[name] += n
+
+    def as_dict(self) -> dict:
+        return dict(self.counts)
+
+    @property
+    def padding_waste(self) -> float:
+        total = self.counts["stacked_cells"]
+        return self.counts["padded_cells"] / total if total else 0.0
+
+
 class StatsLogger:
     """Accumulate per-cycle rows and dump them as CSV (reference:
     trace_computation, stats.py:81)."""

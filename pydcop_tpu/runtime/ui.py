@@ -50,6 +50,7 @@ class UiServer:
         event_bus.subscribe("agents.add_computation.*", self._cb_add_comp)
         event_bus.subscribe("agents.rem_computation.*", self._cb_rem_comp)
         event_bus.subscribe("faults.*", self._cb_fault)
+        event_bus.subscribe("batch.*", self._cb_batch)
 
     # -- event plumbing -----------------------------------------------------
 
@@ -167,6 +168,19 @@ class UiServer:
         if self._ws is not None:
             self._ws.send_all(json.dumps(
                 {"evt": "fault",
+                 "kind": topic.split(".", 1)[-1],
+                 "data": evt if isinstance(evt, (dict, list, str, int,
+                                                 float, bool, type(None)))
+                 else repr(evt)}))
+
+    def _cb_batch(self, topic: str, evt) -> None:
+        """Batched-solve lifecycle (batch.bucket.formed,
+        batch.compile.hit|miss, batch.instance.converged,
+        batch.run.done) pushed to GUI clients; the SSE /events stream
+        gets them through the wildcard subscription like every topic."""
+        if self._ws is not None:
+            self._ws.send_all(json.dumps(
+                {"evt": "batch",
                  "kind": topic.split(".", 1)[-1],
                  "data": evt if isinstance(evt, (dict, list, str, int,
                                                  float, bool, type(None)))

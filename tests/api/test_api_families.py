@@ -36,7 +36,11 @@ def test_secp_maxsum_near_optimal():
 def test_ising_maxsum():
     """Ising grid BP (reference config #3)."""
     dcop, _, _ = generate_ising(4, 4, seed=2)
-    res = solve_result(dcop, "maxsum", cycles=40)
+    # BP oscillates on frustrated grids (docs/performance.rst, the
+    # stretch convergence study): at 40 cycles the anytime assignment
+    # still rides an oscillation crest (-11.96 measured); by 200 it has
+    # visited the DSA-reachable basin (-14.35)
+    res = solve_result(dcop, "maxsum", cycles=200)
     assert res.status == "FINISHED"
     assert res.violation == 0
     # BP on the frustrated grid should land near the DSA-reachable level

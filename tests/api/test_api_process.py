@@ -23,6 +23,15 @@ def test_process_mode_matches_thread_mode(tuto):
     )
     try:
         res = orch.run(cycles=20)
+    except RuntimeError as e:
+        if "Multiprocess computations aren't implemented" in str(e):
+            pytest.skip(
+                "environment: this jaxlib's CPU backend lacks "
+                "multi-process (Gloo) collectives, so 2-rank process "
+                "mode cannot form a mesh on this host (see "
+                "tests/unit/test_multihost.py assert_rank_ok)"
+            )
+        raise
     finally:
         orch.stop()
     assert res.status == "FINISHED"

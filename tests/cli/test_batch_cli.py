@@ -1,0 +1,143 @@
+"""CLI surface of the batched solve engine: ``solve --batch`` and
+``pydcop_tpu batch --engine in-process`` (the `make batch-smoke`
+scenario: a 2-bucket, 6-instance in-process sweep on the CPU backend,
+small enough for the tier-1 time budget)."""
+import json
+import os
+import subprocess
+import sys
+
+import yaml
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+TUTO = os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+CSP = os.path.join(INSTANCES, "coloring_csp.yaml")
+INTENTION = os.path.join(INSTANCES, "coloring_intention.yaml")
+
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "PYTHONPATH": REPO,
+}
+
+
+def run_cli(*args, timeout=180):
+    return subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu", *args],
+        capture_output=True, text=True, timeout=timeout, env=ENV,
+        cwd=REPO,
+    )
+
+
+class TestSolveBatch:
+    def test_solve_batch_two_files(self):
+        proc = run_cli(
+            "solve", "--batch", "-a", "mgm", "--cycles", "20",
+            TUTO, CSP,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout)
+        assert out["status"] == "FINISHED"
+        assert out["results"][TUTO]["cost"] == 12
+        assert out["results"][CSP]["cost"] == 0
+        assert out["batch"]["buckets_formed"] >= 1
+        assert out["batch"]["cache"]["misses"] >= 1
+
+    def test_solve_batch_rejects_distribution(self):
+        proc = run_cli(
+            "solve", "--batch", "-a", "mgm", "-d", "oneagent", TUTO, CSP
+        )
+        assert proc.returncode != 0
+        assert "batch" in json.loads(proc.stdout)["error"]
+
+
+class TestInProcessBatchCommand:
+    """The `make batch-smoke` sweep: 6 solve jobs over two shape
+    families (2-color tuto + 3-color csp/intention), routed through the
+    BatchEngine with the JID resume protocol intact."""
+
+    def _definition(self):
+        return {
+            "sets": {
+                "smoke": {
+                    "path": [TUTO, CSP, INTENTION],
+                    "iterations": 1,
+                },
+            },
+            "batches": {
+                "sweep": {
+                    "command": "solve",
+                    "command_options": {
+                        "algo": ["mgm", "dsa"],
+                        "cycles": 15,
+                    },
+                },
+            },
+        }
+
+    def test_in_process_sweep_two_buckets(self, tmp_path):
+        bdef = tmp_path / "smoke.yaml"
+        bdef.write_text(yaml.safe_dump(self._definition()))
+        out_dir = tmp_path / "out"
+        proc = run_cli(
+            "batch", "--engine", "in-process",
+            "--output_dir", str(out_dir), str(bdef),
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "in-process engine solved 6 jobs" in proc.stdout
+        outputs = sorted(p for p in os.listdir(out_dir)
+                         if p.endswith(".json"))
+        assert len(outputs) == 6
+        for p in outputs:
+            with open(out_dir / p) as f:
+                m = json.load(f)
+            assert m["status"] == "FINISHED"
+            assert m["batch_engine"] == "in-process"
+            assert m["cycle"] == 15
+        # sweep completed → progress file became the done_ record with
+        # one JID per job (resume-protocol parity with subprocess mode)
+        done = [p for p in os.listdir(out_dir) if p.startswith("done_")]
+        assert len(done) == 1
+        with open(out_dir / done[0]) as f:
+            jids = [ln for ln in f if ln.startswith("JID: ")]
+        assert len(jids) == 6
+
+    def test_in_process_resume_skips_done_jobs(self, tmp_path):
+        bdef = tmp_path / "smoke.yaml"
+        bdef.write_text(yaml.safe_dump(self._definition()))
+        out_dir = tmp_path / "out"
+        run_cli("batch", "--engine", "in-process",
+                "--output_dir", str(out_dir), str(bdef))
+        # re-run after completion: outputs are trusted, nothing re-runs
+        proc = run_cli(
+            "batch", "--engine", "in-process",
+            "--output_dir", str(out_dir), str(bdef),
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "ran 0, skipped 6" in proc.stdout
+
+    def test_in_process_matches_subprocess_output(self, tmp_path):
+        """Same job, both engines → same metrics JSON (modulo wall
+        time and the engine tag)."""
+        bdef = tmp_path / "one.yaml"
+        bdef.write_text(yaml.safe_dump({
+            "sets": {"s": {"path": [CSP], "iterations": 1}},
+            "batches": {"b": {
+                "command": "solve",
+                "command_options": {"algo": ["dsa"], "cycles": 15},
+            }},
+        }))
+        outs = {}
+        for engine in ("in-process", "subprocess"):
+            out_dir = tmp_path / engine
+            proc = run_cli("batch", "--engine", engine,
+                           "--output_dir", str(out_dir), str(bdef))
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            (job,) = [p for p in os.listdir(out_dir)
+                      if p.endswith(".json")]
+            with open(out_dir / job) as f:
+                outs[engine] = json.load(f)
+        for key in ("assignment", "cost", "violation", "cycle",
+                    "msg_count", "msg_size", "status"):
+            assert outs["in-process"][key] == outs["subprocess"][key], key

@@ -1,0 +1,309 @@
+"""Batched multi-instance solve engine (pydcop_tpu.batch).
+
+Three contracts pinned here:
+
+* the bucketing policy (pure host arithmetic, no device),
+* per-algorithm BIT-IDENTITY of BatchEngine results vs sequential
+  single-instance solves on mixed-shape instance sets — including
+  instances that only share a bucket through padding,
+* exactly one compile per (bucket, algo) pair, via the compile cache's
+  hit/miss counters.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.batch import (
+    BatchEngine,
+    BatchItem,
+    InstanceDims,
+    plan_buckets,
+)
+from pydcop_tpu.batch.bucketing import bucket_waste, padded_target
+from pydcop_tpu.batch.cache import CompileCache
+from pydcop_tpu.dcop import load_dcop_from_file
+from pydcop_tpu.runtime.run import solve_result
+
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+
+
+def _load(name):
+    return load_dcop_from_file([os.path.join(INSTANCES, name)])
+
+
+def _dims(graph="constraints_hypergraph", D=3, arities=(2,), V=10,
+          F=(20,), M=40):
+    return InstanceDims(graph_type=graph, D=D, arities=arities, V=V,
+                        F=F, M=M)
+
+
+class TestBucketingPolicy:
+    def test_identical_shapes_one_bucket_no_padding(self):
+        dims = [_dims() for _ in range(5)]
+        plans = plan_buckets(dims, max_waste=0.25)
+        assert len(plans) == 1
+        assert plans[0].batch_size == 5
+        assert plans[0].waste == 0.0
+        # no padding → no dummy variable slot
+        assert plans[0].target.V == 10
+
+    def test_arity_sets_never_mix(self):
+        plans = plan_buckets(
+            [_dims(arities=(2,), F=(20,)),
+             _dims(arities=(1, 2), F=(5, 20,))],
+            max_waste=1.0,
+        )
+        assert len(plans) == 2
+
+    def test_graph_families_never_mix(self):
+        plans = plan_buckets(
+            [_dims(), _dims(graph="factor_graph", M=0)], max_waste=1.0
+        )
+        assert len(plans) == 2
+
+    def test_waste_bound_splits(self):
+        big = _dims(V=100, F=(300,), M=600)
+        small = _dims(V=4, F=(4,), M=8)
+        # together the small instance is nearly all padding
+        assert bucket_waste([big, small]) > 0.4
+        plans = plan_buckets([big, small], max_waste=0.25)
+        assert len(plans) == 2
+        # ... but a permissive bound merges them
+        plans = plan_buckets([big, small], max_waste=0.9)
+        assert len(plans) == 1
+
+    def test_padding_reserves_dummy_slot(self):
+        a = _dims(V=10, F=(20,), M=40)
+        b = _dims(V=10, F=(18,), M=36)
+        target = padded_target([a, b])
+        # factor padding needs a dummy variable to route to
+        assert target.V == 11
+        assert target.F == (20,)
+        assert target.M == 40
+
+    def test_plan_is_deterministic_and_size_sorted(self):
+        dims = [_dims(V=v, F=(v * 2,), M=v * 4) for v in (4, 50, 4, 50)]
+        p1 = plan_buckets(dims, max_waste=0.25)
+        p2 = plan_buckets(list(dims), max_waste=0.25)
+        assert [p.indices for p in p1] == [p.indices for p in p2]
+        # big instances are packed first
+        assert p1[0].indices == [1, 3]
+        assert p1[1].indices == [0, 2]
+
+
+FILES = ["graph_coloring_tuto.yaml", "coloring_csp.yaml",
+         "coloring_intention.yaml", "ising_grid.yaml"]
+
+ALGO_CASES = [
+    ("maxsum", None),
+    ("mgm", None),
+    ("dsa", None),
+    ("dsa", {"variant": "C", "probability": 0.8}),
+    ("adsa", None),
+    ("gdba", None),
+    ("gdba", {"modifier": "M", "violation": "NM", "increase_mode": "R"}),
+]
+
+
+class TestBitMatch:
+    """BatchEngine results vs sequential solver.run, bit for bit."""
+
+    @pytest.fixture(scope="class")
+    def dcops(self):
+        return {f: _load(f) for f in FILES}
+
+    @pytest.mark.parametrize(
+        "algo,params", ALGO_CASES,
+        ids=[f"{a}-{i}" for i, (a, _p) in enumerate(ALGO_CASES)],
+    )
+    def test_fixed_cycles_bit_identical(self, dcops, algo, params):
+        # waste bound 0.9 forces mixed-shape instances into shared
+        # padded buckets — the padding-inertness contract under test
+        items = [
+            BatchItem(dcops[f], algo, algo_params=params, seed=s,
+                      label=f"{f}:{s}")
+            for f in FILES for s in (0, 1)
+        ]
+        engine = BatchEngine(cache=CompileCache(), max_padding_waste=0.9)
+        results = engine.solve(items, cycles=21)
+        assert engine.counters.counts["buckets_formed"] >= 2
+        for item, res in zip(items, results):
+            seq = solve_result(item.dcop, algo, cycles=21,
+                               algo_params=params, seed=item.seed)
+            assert res.assignment == seq.assignment, item.label
+            assert res.cost == seq.cost, item.label
+            assert res.cycle == seq.cycle
+            assert res.msg_count == seq.msg_count
+            assert res.status == "FINISHED"
+
+    def test_convergence_mode_bit_identical(self, dcops):
+        # cycles=None: per-instance convergence masks + freeze must
+        # reproduce the sequential harness's stop states AND stop cycles
+        items = [
+            BatchItem(dcops[f], "mgm", seed=s, label=f"{f}:{s}")
+            for f in FILES[:3] for s in (0, 1)
+        ]
+        engine = BatchEngine(cache=CompileCache(), max_padding_waste=0.9)
+        results = engine.solve(items)
+        assert engine.counters.counts["instances_converged"] == len(items)
+        for item, res in zip(items, results):
+            seq = solve_result(item.dcop, "mgm", seed=item.seed)
+            assert res.assignment == seq.assignment, item.label
+            assert res.cycle == seq.cycle, item.label
+
+
+class TestCompileCache:
+    def test_one_compile_per_bucket_algo_pair(self):
+        """Acceptance pin: a mixed set of ≥8 instances from ≥2 shape
+        buckets solves with EXACTLY one compile per (bucket, algo)
+        pair, and a repeat sweep is all cache hits."""
+        dcops = {f: _load(f) for f in FILES}
+        items = [
+            BatchItem(dcops[f], "mgm", seed=s, label=f"{f}:{s}")
+            for f in FILES for s in (0, 1)
+        ]
+        assert len(items) >= 8
+        cache = CompileCache()
+        engine = BatchEngine(cache=cache)
+        engine.solve(items, cycles=20)  # 20 ≤ 100 → a single chunk
+        n_buckets = engine.counters.counts["buckets_formed"]
+        assert n_buckets >= 2
+        assert cache.misses == n_buckets
+        assert cache.hits == 0
+
+        # second sweep over the same shapes: zero new compiles
+        engine2 = BatchEngine(cache=cache)
+        engine2.solve(items, cycles=20)
+        assert cache.misses == n_buckets
+        assert cache.hits == n_buckets
+
+    def test_cache_key_covers_params(self):
+        dcop = _load("coloring_csp.yaml")
+        cache = CompileCache()
+        engine = BatchEngine(cache=cache)
+        engine.solve([BatchItem(dcop, "dsa", seed=0)], cycles=10)
+        engine.solve(
+            [BatchItem(dcop, "dsa", algo_params={"variant": "C"},
+                       seed=0)],
+            cycles=10,
+        )
+        # same shapes, different move rule → different compiled runner
+        assert cache.misses == 2
+
+    def test_persistent_cache_dir_enabled(self, tmp_path):
+        import jax
+
+        # enable_persistent_cache flips PROCESS-GLOBAL jax config; a
+        # leaked cache dir makes every later compile in this pytest
+        # process pay persistent-cache writes (measured 3-4x per
+        # pallas-interpret test) — restore all three knobs
+        saved = {
+            k: getattr(jax.config, k)
+            for k in ("jax_compilation_cache_dir",
+                      "jax_persistent_cache_min_entry_size_bytes",
+                      "jax_persistent_cache_min_compile_time_secs")
+        }
+        try:
+            engine = BatchEngine(
+                cache=CompileCache(),
+                persistent_cache_dir=str(tmp_path / "xla"),
+            )
+            assert engine.persistent_cache_enabled
+            engine.solve(
+                [BatchItem(_load("coloring_csp.yaml"), "mgm", seed=0)],
+                cycles=10,
+            )
+        finally:
+            for k, v in saved.items():
+                jax.config.update(k, v)
+
+
+class TestEventsAndCounters:
+    def test_batch_events_emitted(self):
+        from pydcop_tpu.runtime.events import event_bus
+
+        seen = []
+        cb = lambda topic, evt: seen.append((topic, evt))  # noqa: E731
+        event_bus.enabled = True
+        event_bus.subscribe("batch.*", cb)
+        try:
+            dcops = [_load(f) for f in FILES[:2]]
+            engine = BatchEngine(cache=CompileCache())
+            engine.solve(
+                [BatchItem(d, "mgm", seed=0) for d in dcops], cycles=10
+            )
+        finally:
+            event_bus.unsubscribe(cb)
+            event_bus.enabled = False
+        topics = [t for t, _ in seen]
+        assert any(t == "batch.bucket.formed" for t in topics)
+        assert any(t == "batch.compile.miss" for t in topics)
+        assert any(t == "batch.run.done" for t in topics)
+
+    def test_converged_event_and_counter(self):
+        from pydcop_tpu.runtime.events import event_bus
+
+        seen = []
+        cb = lambda topic, evt: seen.append((topic, evt))  # noqa: E731
+        event_bus.enabled = True
+        event_bus.subscribe("batch.instance.converged", cb)
+        try:
+            engine = BatchEngine(cache=CompileCache())
+            engine.solve(
+                [BatchItem(_load("graph_coloring_tuto.yaml"), "mgm",
+                           seed=0, label="tuto")],
+            )
+        finally:
+            event_bus.unsubscribe(cb)
+            event_bus.enabled = False
+        assert engine.counters.counts["instances_converged"] == 1
+        assert seen and seen[0][1]["label"] == "tuto"
+        assert engine.metrics()["cache"]["misses"] >= 1
+
+    def test_fallback_sequential_counted(self):
+        engine = BatchEngine(cache=CompileCache())
+        res = engine.solve(
+            [BatchItem(_load("graph_coloring_tuto.yaml"), "dpop")],
+        )
+        assert res[0].cost == 12
+        assert engine.counters.counts["fallback_sequential"] == 1
+
+
+class TestPaddingInertness:
+    def test_padded_instance_values_match_unpadded(self):
+        """Direct pin of the routing argument: solving an instance
+        alone (no padding) and inside a padded mixed bucket yields the
+        same bits."""
+        dcops = {f: _load(f) for f in FILES[:2]}
+        alone = BatchEngine(cache=CompileCache()).solve(
+            [BatchItem(dcops[FILES[1]], "mgm", seed=3)], cycles=15
+        )[0]
+        mixed_items = [
+            BatchItem(dcops[FILES[0]], "mgm", seed=3),
+            BatchItem(dcops[FILES[1]], "mgm", seed=3),
+        ]
+        engine = BatchEngine(cache=CompileCache(), max_padding_waste=0.9)
+        mixed = engine.solve(mixed_items, cycles=15)
+        assert engine.counters.counts["buckets_formed"] == 1
+        m = engine.metrics()
+        assert m["padding_waste"] > 0.0
+        assert mixed[1].assignment == alone.assignment
+        assert mixed[1].cost == alone.cost
+
+    def test_uniform_prestream_matches_generic(self):
+        """The pre-drawn per-chunk uniforms reproduce the solver's
+        per-cycle draws (vmap-of-uniform == stacked uniforms)."""
+        import jax
+
+        from pydcop_tpu.batch.engine import _dsa_chunk_uniforms
+
+        key = jax.random.PRNGKey(5)
+        key2, u = _dsa_chunk_uniforms(key, n=4, V=6, Vp=8)
+        k_ref, sub = jax.random.split(jax.random.PRNGKey(5))
+        ks = jax.random.split(sub, 4)
+        for i in range(4):
+            ref = jax.random.uniform(ks[i], (6,))
+            assert np.array_equal(np.asarray(u[i, :6]), np.asarray(ref))
+        assert np.all(np.asarray(u[:, 6:]) == 1.0)
+        assert np.array_equal(np.asarray(key2), np.asarray(k_ref))
