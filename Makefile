@@ -1,7 +1,7 @@
 # Test/check targets (reference twin: pyDcop Makefile:1-21)
 
 .PHONY: test unit api cli doctest all-tests bench bench-probe faults \
-	bench-batch batch-smoke
+	bench-batch batch-smoke bench-harness
 
 test: all-tests
 
@@ -35,6 +35,12 @@ bench-probe:
 # (docs/performance.rst "Batched solving")
 bench-batch:
 	python bench.py --only batch
+
+# harness sync-overhead spot check: blocking vs pipelined chunk
+# dispatch on a convergence-bound solve (docs/performance.rst
+# "Pipelined convergence")
+bench-harness:
+	python bench.py --only harness
 
 # 2-bucket / 6-instance in-process sweep smoke on the CPU backend —
 # the same scenario the tier-1 CLI test pins, runnable standalone

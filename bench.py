@@ -1146,6 +1146,67 @@ def bench_batch(args, probe=None):
     return out
 
 
+def bench_harness(args, probe=None):
+    """Harness sync overhead (round 8): one convergence-bound MGM solve
+    (open-ended, prime chunks, two-stable-chunks rule) timed end to end
+    on the pre-pipeline BLOCKING path (host-compare convergence,
+    per-shape chunk runners) vs the PIPELINED path (device-side
+    convergence scalar, fixed-shape masked runner, one-deep dispatch
+    pipeline) — docs/performance.rst "Pipelined convergence".
+    Drift-normalized like the primary; both runs' HarnessCounters ride
+    along so a regression in the sync budget (host_sync_count per
+    chunk) is visible in the JSON, not just slower."""
+    from pydcop_tpu.algorithms import load_algorithm_module
+    from pydcop_tpu.generators import generate_graph_coloring
+
+    V = args.harness_vars
+    dcop = generate_graph_coloring(
+        n_variables=V, n_colors=args.colors, n_edges=V * 3, soft=True,
+        n_agents=1, seed=7,
+    )
+    mod = load_algorithm_module("mgm")
+    out = {}
+    rates = {}
+    for name, force_host, pipeline in (
+        ("pipelined", False, True),
+        ("blocking", True, False),
+    ):
+        solver = mod.build_solver(dcop, seed=1)
+        solver._force_host_convergence = force_host
+
+        def run(s=solver, p=pipeline):
+            return s.run(max_cycles=400, pipeline=p)
+
+        res = run()  # warmup incl. compile
+        times = []
+        for _ in range(args.repeat):
+            t0 = time.perf_counter()
+            res = run()
+            times.append(time.perf_counter() - t0)
+        rate = res.cycle / robust_best(times)
+        rates[name] = rate
+        out[f"harness_{name}_cycles_per_sec"] = round(rate, 1)
+        h = res.harness or {}
+        out[f"harness_{name}_sync_per_chunk"] = round(
+            h.get("host_sync_count", 0)
+            / max(1, h.get("chunks_dispatched", 1)), 3,
+        )
+        out[f"harness_{name}_stop_cycle"] = res.cycle
+        if probe is not None:
+            pr = probe()
+            if pr:
+                out[f"harness_{name}_cycles_per_sec_normalized"] = round(
+                    rate / pr, 6
+                )
+    if rates.get("blocking"):
+        # > 1.0 means the pipelined path is strictly faster on the
+        # convergence-bound run — the acceptance headline
+        out["harness_sync_overhead"] = round(
+            rates["pipelined"] / rates["blocking"], 3
+        )
+    return out
+
+
 def bench_sharded_subprocess(args):
     """ShardedMaxSum on a virtual 8-device CPU mesh, in a subprocess so
     the forced-CPU platform doesn't poison this process's TPU backend."""
@@ -1397,6 +1458,11 @@ def main():
     )
     ap.add_argument("--sharded-vars", type=int, default=2_000)
     ap.add_argument(
+        "--harness-vars", type=int, default=2000,
+        help="variables in the harness sync-overhead bench's "
+        "convergence-bound MGM instance (edges = 3x)",
+    )
+    ap.add_argument(
         "--batch-vars", type=int, default=500,
         help="variables per instance in the batched-throughput bench "
         "(edges = 3x); small enough that B=32 stacks comfortably, big "
@@ -1414,7 +1480,7 @@ def main():
         "--only",
         choices=["all", "maxsum", "dpop", "convergence", "convergence2",
                  "local", "scalefree", "mixed", "sharded",
-                 "sharded-inner", "probe", "batch"],
+                 "sharded-inner", "probe", "batch", "harness"],
         default="all",
     )
     # watchdog covers the FULL run: the wholesweep DPOP kernel compile
@@ -1506,7 +1572,7 @@ def main():
     # once up front; each burst then times it ADJACENT to the primary
     # measurement so both see the same tunnel state
     probe = None
-    if args.only in ("all", "maxsum", "probe", "batch"):
+    if args.only in ("all", "maxsum", "probe", "batch", "harness"):
         try:
             probe = make_drift_probe(repeat=args.repeat)
         except Exception as e:
@@ -1623,6 +1689,12 @@ def main():
         except Exception as e:
             extra["batch_error"] = repr(e)
 
+    if args.only in ("all", "harness"):
+        try:
+            extra.update(bench_harness(args, probe=probe))
+        except Exception as e:
+            extra["harness_error"] = repr(e)
+
     def run_with_transient_retry(fn, err_key):
         # the tunneled remote-compile service occasionally drops a
         # response mid-read; one retry keeps such a transient from
@@ -1685,7 +1757,8 @@ def main():
             extra["sharded_error"] = repr(e)
 
     if args.only in ("dpop", "local", "convergence", "convergence2",
-                     "scalefree", "mixed", "sharded", "probe", "batch") \
+                     "scalefree", "mixed", "sharded", "probe", "batch",
+                     "harness") \
             and not value:
         # single-part run: promote the part's headline measurement (not
         # config constants like stretch_vars) to the primary slot

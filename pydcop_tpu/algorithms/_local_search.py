@@ -241,6 +241,12 @@ class LocalSearchSolver(SynchronousTensorSolver):
                 self._packed_ls = pack_from_pg(self.packed)
         return self._packed_ls
 
+    def _supports_fixed_chunk(self, collect: bool) -> bool:
+        # the fused multi-cycle pallas kernels engage on the no-metrics
+        # path when the graph packed; they have no fixed-shape masked
+        # form, so those runs keep the per-shape chunk runners
+        return collect or self.packed is None
+
     def _fused_chunk_runner(self, n, collect, build_runner):
         """Shared fused fast-path plumbing for MGM/DSA: cache by
         (n, 'fused'), trial-compile descending unroll tiers, fall back

@@ -7,6 +7,24 @@ reference runs one thread per agent pumping message queues
 over the whole tensor graph, and a run is ``lax.scan`` over cycles, executed
 in chunks so the host can check convergence/timeouts between chunks.
 
+The chunk loop is engineered to keep bulk state device-resident:
+
+* convergence is a **device-side scalar** — the stability test
+  (:meth:`SynchronousTensorSolver.chunk_converged_device`) runs inside
+  the jitted chunk, so the host reads one bool per chunk instead of
+  diffing two full state snapshots;
+* every chunk size runs through **one fixed-shape runner** per
+  (solver, collect) pair — partial tail chunks freeze the surplus
+  cycles under ``lax.cond`` instead of compiling a remainder shape,
+  with the PRNG keys still drawn at the true cycle count so results
+  are bit-identical to per-shape runners;
+* state buffers are **donated** to the chunk runner on backends where
+  XLA aliases them (TPU/GPU), so chunks update in place;
+* with ``pipeline=True`` the next chunk is dispatched before the
+  previous chunk's convergence scalar is read (fetched via
+  ``copy_to_host_async``), overlapping host bookkeeping with device
+  compute at the cost of at most ONE extra chunk past the stop point.
+
 Per-cycle metrics (values, cost) are emitted as scan outputs, giving the
 same observability as the reference's cycle metrics without host round
 trips.
@@ -14,6 +32,7 @@ trips.
 from __future__ import annotations
 
 import dataclasses
+from collections import OrderedDict
 from time import perf_counter
 from typing import Any, Dict, List, Optional
 
@@ -40,9 +59,13 @@ class SolveResult:
     msg_size: float
     time: float
     history: Optional[List[Dict[str, Any]]] = None
+    #: host↔device traffic scorecard of the chunk loop
+    #: (runtime/stats.HarnessCounters), None for solvers that do not
+    #: run through the chunked harness (dpop, syncbb, batch engine)
+    harness: Optional[Dict[str, Any]] = None
 
     def metrics(self) -> Dict[str, Any]:
-        return {
+        out = {
             "status": self.status,
             "assignment": self.assignment,
             "cost": self.cost,
@@ -52,6 +75,9 @@ class SolveResult:
             "msg_size": self.msg_size,
             "time": self.time,
         }
+        if self.harness is not None:
+            out["harness"] = dict(self.harness)
+        return out
 
 
 def default_chunk(
@@ -84,6 +110,84 @@ def default_chunk(
     return chunk
 
 
+def donation_supported() -> bool:
+    """True where ``donate_argnums`` actually buys in-place buffer
+    reuse.  On the CPU backend donation is a no-op that logs a warning
+    per compile, so the runners only request it on TPU/GPU."""
+    try:
+        return jax.default_backend() in ("tpu", "gpu", "cuda", "rocm")
+    except Exception:  # pragma: no cover - backend probing never fatal
+        return False
+
+
+def select_frozen(frozen_mask, old_state, new_state):
+    """Freeze helper shared by the harness's fixed-shape tail masking
+    and the batch engine's converged-instance freeze
+    (pydcop_tpu.batch.engine): where ``frozen_mask`` is True the OLD
+    leaves are kept, elsewhere the new ones.  The mask broadcasts from
+    the leading axes — a scalar freezes a whole state (tail cycles), a
+    ``[B]`` vector freezes per-instance slices of ``[B, ...]`` leaves
+    (batched buckets)."""
+    mask = jnp.asarray(frozen_mask)
+
+    def sel(old, new):
+        m = mask.reshape(mask.shape + (1,) * (old.ndim - mask.ndim))
+        return jnp.where(m, old, new)
+
+    return jax.tree_util.tree_map(sel, old_state, new_state)
+
+
+def clamp_chunk_to_deadline(
+    n: int, rate_cps: Optional[float], remaining_s: Optional[float]
+) -> int:
+    """Deadline-aware chunk shrinking: the largest cycle count ≤ ``n``
+    whose projected wall time (at the measured ``rate_cps`` cycles/sec)
+    fits the remaining timeout budget.  The timeout is only honored
+    between chunks, so without this a large chunk overshoots a tight
+    deadline by a whole chunk of cycles.  Returns at least 1 — the
+    loop's between-chunk timeout check stays the final authority —
+    and ``n`` unchanged until a rate has been measured."""
+    if rate_cps is None or rate_cps <= 0 or remaining_s is None:
+        return n
+    budget = int(remaining_s * rate_cps)
+    return max(1, min(n, budget))
+
+
+class LruCache:
+    """Small LRU for compiled chunk runners.
+
+    The per-solver compile cache previously grew without bound across
+    ``resume=True`` orchestrator runs with varying chunk sizes; this
+    bounds it and counts evictions (surfaced as the
+    ``compile_cache_evictions`` harness counter)."""
+
+    def __init__(self, capacity: int = 16):
+        self.capacity = capacity
+        self.evictions = 0
+        self._d: "OrderedDict[Any, Any]" = OrderedDict()
+
+    def __contains__(self, key) -> bool:
+        return key in self._d
+
+    def __getitem__(self, key):
+        value = self._d[key]
+        self._d.move_to_end(key)
+        return value
+
+    def __setitem__(self, key, value) -> None:
+        self._d[key] = value
+        self._d.move_to_end(key)
+        while len(self._d) > self.capacity:
+            self._d.popitem(last=False)
+            self.evictions += 1
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def clear(self) -> None:
+        self._d.clear()
+
+
 class SynchronousTensorSolver:
     """Base class for batched synchronous-round solvers.
 
@@ -111,7 +215,14 @@ class SynchronousTensorSolver:
         self.params = algo_def.params
         self.seed = seed
         self.infinity = DEFAULT_INFINITY
-        self._compiled_chunks: Dict[Any, Any] = {}
+        self._compiled_chunks = LruCache()
+        self._masked_trace_counts: Dict[Any, int] = {}
+        self._vals_cache = None
+        #: HarnessCounters of the most recent run (None before any run)
+        self.last_counters = None
+        #: escape hatch for benches/tests: force the pre-pipeline
+        #: host-compare chunk loop even where device convergence exists
+        self._force_host_convergence = False
 
     # -- to implement -------------------------------------------------------
 
@@ -125,15 +236,70 @@ class SynchronousTensorSolver:
         """Current value indices [V] for a state."""
         raise NotImplementedError
 
+    # -- convergence --------------------------------------------------------
+
+    def _values_host(self, state: Any) -> np.ndarray:
+        """Host copy of :meth:`values_of`, cached by state identity: the
+        chunk loop compares consecutive boundary states, so the previous
+        chunk's pull is reused instead of re-transferred every chunk."""
+        cached = self._vals_cache
+        if cached is not None and cached[0] is state:
+            return cached[1]
+        vals = np.asarray(self.values_of(state))
+        self._vals_cache = (state, vals)
+        return vals
+
     def chunk_converged(self, prev_state: Any, state: Any) -> bool:
         """Did the solver reach a fixed point between two chunk
         boundaries?  Default: the assignment did not change.  Solvers
         with richer state may widen this (MaxSumSolver adds the
-        reference's message-stability test)."""
+        reference's message-stability test).  Host-side test, used by
+        the pre-pipeline chunk loop; the device loop runs
+        :meth:`chunk_converged_device` instead."""
         return bool(np.array_equal(
-            np.asarray(self.values_of(prev_state)),
-            np.asarray(self.values_of(state)),
+            self._values_host(prev_state),
+            self._values_host(state),
         ))
+
+    def chunk_converged_device(self, prev_state: Any, state: Any):
+        """Traceable twin of :meth:`chunk_converged`: a scalar bool
+        computed INSIDE the jitted chunk runner, so deciding whether to
+        keep running costs one scalar transfer instead of two full
+        state pulls.  A subclass that overrides :meth:`chunk_converged`
+        must override this too (with identical semantics) or the
+        harness falls back to the host-compare loop."""
+        return jnp.all(self.values_of(prev_state) == self.values_of(state))
+
+    @staticmethod
+    def _defining_class(cls, name: str):
+        for c in cls.__mro__:
+            if name in c.__dict__:
+                return c
+        return None
+
+    def _device_convergence_ok(self) -> bool:
+        """Device convergence is only sound when the class that defines
+        :meth:`chunk_converged_device` is at least as derived as the one
+        defining :meth:`chunk_converged` — a subclass customizing the
+        host test without the device twin silently diverging would be a
+        correctness bug, so it falls back to the host loop instead."""
+        if self._force_host_convergence:
+            return False
+        cls = type(self)
+        host = self._defining_class(cls, "chunk_converged")
+        dev = self._defining_class(cls, "chunk_converged_device")
+        return (
+            host is not None and dev is not None and issubclass(dev, host)
+        )
+
+    def _supports_fixed_chunk(self, collect: bool) -> bool:
+        """True when chunks run the base generic ``lax.scan`` over
+        :meth:`cycle` — the precondition for the fixed-shape masked
+        runner being bit-identical to :meth:`_chunk_runner`.
+        Subclasses with specialized chunk engines (fused pallas
+        kernels, the edge-slab megascale form) must return False
+        whenever those engines would engage."""
+        return True
 
     # -- harness ------------------------------------------------------------
 
@@ -143,6 +309,10 @@ class SynchronousTensorSolver:
         with no metric collection only the final state is read, saving
         one full cost-table evaluation per cycle.  Returns
         (state, costs [n]) when collecting, (state, None) otherwise.
+
+        This is the pre-pipeline per-shape runner, still used by the
+        fused/specialized engines (see :meth:`_supports_fixed_chunk`);
+        the generic path runs :meth:`_masked_chunk_runner` instead.
         """
         cache_key = (n, collect)
         if cache_key not in self._compiled_chunks:
@@ -164,73 +334,198 @@ class SynchronousTensorSolver:
             self._compiled_chunks[cache_key] = run_chunk
         return self._compiled_chunks[cache_key]
 
-    def run(
-        self,
-        cycles: Optional[int] = None,
-        timeout: Optional[float] = None,
-        max_cycles: int = 2000,
-        chunk: Optional[int] = None,
-        stable_chunks: int = 2,
-        collect_cycles: bool = False,
-        resume: bool = False,
-    ) -> SolveResult:
-        """Run the solver.
-
-        * ``cycles`` set → run exactly that many cycles (the reference's
-          ``stop_cycle``).
-        * otherwise → run until the assignment is stable for
-          ``stable_chunks`` consecutive chunks, or ``max_cycles``/timeout.
-        * ``resume=True`` continues from the previous run's state (warm
-          restart — used by the orchestrator across scenario events).
+    def _masked_chunk_runner(self, chunk: int, collect: bool = True):
+        """ONE fixed-shape runner per (chunk, collect): always scans
+        ``chunk`` steps, but cycles at index ≥ ``n_active`` pass the
+        state through untouched under ``lax.cond`` — so every remainder
+        chunk size reuses the same XLA executable instead of compiling
+        its own, and a deadline-shrunk chunk costs only its live cycles.
+        The caller draws the PRNG keys at the TRUE cycle count and pads
+        them, keeping the key stream bit-identical to the per-shape
+        runners.  Also computes :meth:`chunk_converged_device` against
+        the input state, and donates the state buffers where supported.
+        Returns (state, costs [chunk] | None, converged bool scalar).
         """
-        t0 = perf_counter()
-        target = cycles if cycles else None
-        limit = target if target is not None else max_cycles
+        cache_key = ("masked", chunk, collect)
+        if cache_key not in self._compiled_chunks:
 
-        # prime default: chunk_converged compares states one chunk
-        # apart, so an oscillation whose period divides the chunk
-        # size would look like a fixed point — with a prime chunk
-        # only period-7 (and true fixed points) can alias, and two
-        # stable chunks in a row (stable_chunks=2, 14 cycles) rules
-        # out period 7 too unless the period is exactly 7 AND 14.
-        # Fixed-cycle, no-metrics, no-deadline runs only check
-        # convergence between chunks: larger chunks amortize
-        # per-dispatch cost (~70ms on a tunneled device).  A
-        # caller-provided chunk or a timeout keeps the finer grain —
-        # the timeout is only honored between chunks, so a raised
-        # floor could overshoot a tight deadline by ~100 cycles.
-        if chunk is None:
-            chunk = default_chunk(
-                target, collect_cycles, False, timeout, limit
+            def run_chunk(state, keys, n_active):
+                self._masked_trace_counts[cache_key] = (
+                    self._masked_trace_counts.get(cache_key, 0) + 1
+                )
+                active = jnp.arange(chunk) < n_active
+
+                def body(st, sc):
+                    k, a = sc
+
+                    def live(s):
+                        s2 = self.cycle(s, k)
+                        out = (
+                            total_cost(self.tensors, self.values_of(s2))
+                            if collect else None
+                        )
+                        return s2, out
+
+                    def frozen(s):
+                        out = jnp.float32(0.0) if collect else None
+                        return s, out
+
+                    return jax.lax.cond(a, live, frozen, st)
+
+                prev = state
+                state2, collected = jax.lax.scan(
+                    body, state, (keys, active)
+                )
+                conv = self.chunk_converged_device(prev, state2)
+                return state2, collected, conv
+
+            donate = (0,) if donation_supported() else ()
+            self._compiled_chunks[cache_key] = jax.jit(
+                run_chunk, donate_argnums=donate
             )
+        return self._compiled_chunks[cache_key]
 
-        warm = resume and getattr(self, "_last_state", None) is not None
-        state = self._last_state if warm else self.initial_state()
-        # a warm restart continues the PRNG stream — re-seeding would
-        # replay the previous run's random choices for stochastic moves
-        key = (
-            self._last_key
-            if warm and getattr(self, "_last_key", None) is not None
-            else jax.random.PRNGKey(self.seed)
-        )
+    def _read_conv(self, conv, counters) -> bool:
+        tw = perf_counter()
+        flag = bool(np.asarray(conv))
+        counters.add("dispatch_wait_s", perf_counter() - tw)
+        counters.add("host_sync_count", 1)
+        return flag
+
+    def _drive_device_chunks(
+        self, state, key, t0, target, limit, chunk, stable_chunks,
+        collect, timeout, pipeline, counters, history,
+    ):
+        """Device-resident chunk loop: fixed-shape masked runner,
+        convergence as an in-chunk scalar, optional one-deep dispatch
+        pipeline.  The host's per-chunk traffic is ONE bool (plus the
+        [n] cost vector when collecting)."""
+        runner = self._masked_chunk_runner(chunk, collect)
+        donating = donation_supported()
         done = 0
-        history: List[Dict[str, Any]] = []
+        completed = 0  # cycles whose device work is known finished
+        stable = 0
+        status = "FINISHED"
+        rate = None
+        pending = None  # (conv scalar, counts_toward_stability, n)
+        first = True
+        while done < limit:
+            n = min(chunk, limit - done)
+            if timeout is not None:
+                n = clamp_chunk_to_deadline(
+                    n, rate, timeout - (perf_counter() - t0)
+                )
+            key, sub = jax.random.split(key)
+            keys = jax.random.split(sub, n)
+            if n < chunk:
+                # frozen cycles never read their key; repeating the last
+                # one keeps the dtype/layout of typed PRNG keys intact
+                pad = jnp.broadcast_to(
+                    keys[-1:], (chunk - n,) + tuple(keys.shape[1:])
+                )
+                keys = jnp.concatenate([keys, pad], axis=0)
+                counters.add("masked_tail_cycles", chunk - n)
+            state, collected, conv = runner(state, keys, n)
+            done += n
+            counters.add("chunks_dispatched", 1)
+            if donating:
+                counters.add("donated_chunks", 1)
+            if collect:
+                tw = perf_counter()
+                costs_np = np.asarray(collected)[:n] * self.tensors.sign
+                counters.add("dispatch_wait_s", perf_counter() - tw)
+                counters.add("host_sync_count", 1)
+                completed = done
+                for i in range(n):
+                    history.append(
+                        {
+                            "cycle": done - n + i + 1,
+                            "cost": float(costs_np[i]),
+                            "time": perf_counter() - t0,
+                        }
+                    )
+            if target is None:
+                if pipeline and not collect:
+                    # one-deep pipeline: this chunk is already running;
+                    # consume the PREVIOUS chunk's scalar (its transfer
+                    # was started asynchronously when it was launched)
+                    if hasattr(conv, "copy_to_host_async"):
+                        conv.copy_to_host_async()
+                    prev, pending = pending, (conv, not first, n)
+                    if prev is not None:
+                        flag = self._read_conv(prev[0], counters)
+                        completed = done - n
+                        if prev[1]:
+                            stable = stable + 1 if flag else 0
+                            if stable >= stable_chunks:
+                                # the chunk launched above runs to
+                                # completion — the documented ≤ one
+                                # chunk of overshoot
+                                counters.add("overshoot_cycles", n)
+                                break
+                else:
+                    flag = self._read_conv(conv, counters)
+                    completed = done
+                    if not first:
+                        stable = stable + 1 if flag else 0
+                        if stable >= stable_chunks:
+                            break
+            first = False
+            if completed > 0:
+                elapsed = perf_counter() - t0
+                if elapsed > 0:
+                    rate = completed / elapsed
+            if timeout is not None:
+                if target is not None and not collect:
+                    # fixed-cycle deadline runs have no conv read to
+                    # block on; sync here so the deadline (and the rate
+                    # the clamp uses) measures completed device work
+                    tw = perf_counter()
+                    jax.block_until_ready(state)
+                    counters.add("dispatch_wait_s", perf_counter() - tw)
+                    completed = done
+                    elapsed = perf_counter() - t0
+                    if elapsed > 0:
+                        rate = completed / elapsed
+                if perf_counter() - t0 > timeout:
+                    status = "TIMEOUT"
+                    break
+        return state, key, done, status
+
+    def _drive_host_chunks(
+        self, state, key, t0, target, limit, chunk, stable_chunks,
+        collect, timeout, counters, history,
+    ):
+        """Pre-pipeline chunk loop: per-(n, collect) runners and a
+        host-side convergence compare.  Kept for solvers whose chunk
+        engines (fused pallas, edge-slab) or custom
+        :meth:`chunk_converged` have no fixed-shape/device twin; the
+        previous boundary's host values are cached
+        (:meth:`_values_host`) so each chunk ships ONE state pull, not
+        two."""
+        done = 0
         prev_state: Any = None
         stable = 0
         status = "FINISHED"
-
+        rate = None
         while done < limit:
             n = min(chunk, limit - done)
+            if timeout is not None:
+                n = clamp_chunk_to_deadline(
+                    n, rate, timeout - (perf_counter() - t0)
+                )
             key, sub = jax.random.split(key)
             keys = jax.random.split(sub, n)
             # per-cycle values/cost are only materialized when a metrics
             # history is requested; the convergence check below reads
             # the chunk-final state directly
-            runner = self._chunk_runner(n, collect=collect_cycles)
+            runner = self._chunk_runner(n, collect=collect)
             state, collected = runner(state, keys)
             done += n
-            if collect_cycles:
+            counters.add("chunks_dispatched", 1)
+            if collect:
                 costs_np = np.asarray(collected) * self.tensors.sign
+                counters.add("host_sync_count", 1)
                 for i in range(n):
                     history.append(
                         {
@@ -248,16 +543,121 @@ class SynchronousTensorSolver:
                         break
                 else:
                     stable = 0
+                counters.add("host_sync_count", 1)
                 prev_state = state
-            if timeout is not None and perf_counter() - t0 > timeout:
-                status = "TIMEOUT"
-                break
+                elapsed = perf_counter() - t0
+                if elapsed > 0:
+                    rate = done / elapsed
+            if timeout is not None:
+                if target is not None:
+                    # measure the deadline against completed device
+                    # work, not the (async) dispatch stream
+                    tw = perf_counter()
+                    jax.block_until_ready(state)
+                    counters.add("dispatch_wait_s", perf_counter() - tw)
+                    elapsed = perf_counter() - t0
+                    if elapsed > 0:
+                        rate = done / elapsed
+                if perf_counter() - t0 > timeout:
+                    status = "TIMEOUT"
+                    break
+        return state, key, done, status
+
+    def run(
+        self,
+        cycles: Optional[int] = None,
+        timeout: Optional[float] = None,
+        max_cycles: int = 2000,
+        chunk: Optional[int] = None,
+        stable_chunks: int = 2,
+        collect_cycles: bool = False,
+        resume: bool = False,
+        pipeline: bool = False,
+    ) -> SolveResult:
+        """Run the solver.
+
+        * ``cycles`` set → run exactly that many cycles (the reference's
+          ``stop_cycle``).
+        * otherwise → run until the assignment is stable for
+          ``stable_chunks`` consecutive chunks, or ``max_cycles``/timeout.
+        * ``resume=True`` continues from the previous run's state (warm
+          restart — used by the orchestrator across scenario events).
+        * ``pipeline=True`` dispatches chunk k+1 before reading chunk
+          k's convergence scalar: host bookkeeping overlaps device
+          compute, at the cost of up to ONE chunk of extra cycles past
+          the stop point (reflected in the reported ``cycle``; the
+          converged assignment is unchanged).  ``pipeline=False`` (the
+          default) keeps stop-cycle behavior bit-identical to the
+          pre-pipeline harness — convergence still rides the in-chunk
+          device scalar, so the host never pulls bulk state either way.
+        """
+        t0 = perf_counter()
+        from pydcop_tpu.runtime.stats import HarnessCounters
+
+        counters = HarnessCounters()
+        target = cycles if cycles else None
+        limit = target if target is not None else max_cycles
+
+        # prime default: chunk convergence compares states one chunk
+        # apart, so an oscillation whose period divides the chunk
+        # size would look like a fixed point — with a prime chunk
+        # only period-7 (and true fixed points) can alias, and two
+        # stable chunks in a row (stable_chunks=2, 14 cycles) rules
+        # out period 7 too unless the period is exactly 7 AND 14.
+        # Fixed-cycle, no-metrics, no-deadline runs only check
+        # convergence between chunks: larger chunks amortize
+        # per-dispatch cost (~70ms on a tunneled device).  A
+        # caller-provided chunk or a timeout keeps the finer grain —
+        # and with a timeout the NEXT chunk is additionally clamped to
+        # the projected remaining budget (clamp_chunk_to_deadline).
+        if chunk is None:
+            chunk = default_chunk(
+                target, collect_cycles, False, timeout, limit
+            )
+
+        warm = resume and getattr(self, "_last_state", None) is not None
+        state = self._last_state if warm else self.initial_state()
+        # a warm restart continues the PRNG stream — re-seeding would
+        # replay the previous run's random choices for stochastic moves
+        key = (
+            self._last_key
+            if warm and getattr(self, "_last_key", None) is not None
+            else jax.random.PRNGKey(self.seed)
+        )
+        history: List[Dict[str, Any]] = []
+
+        use_device = (
+            self._device_convergence_ok()
+            and self._supports_fixed_chunk(collect_cycles)
+        )
+        if use_device:
+            state, key, done, status = self._drive_device_chunks(
+                state, key, t0, target, limit, chunk, stable_chunks,
+                collect_cycles, timeout, pipeline, counters, history,
+            )
+        else:
+            state, key, done, status = self._drive_host_chunks(
+                state, key, t0, target, limit, chunk, stable_chunks,
+                collect_cycles, timeout, counters, history,
+            )
 
         self._last_state = state
         self._last_key = key
-        final_vals = np.asarray(self.values_of(state))
+        counters.counts["compile_cache_evictions"] = (
+            self._compiled_chunks.evictions
+        )
+        self.last_counters = counters
+        final_vals = self._values_host(state)
         assignment = self.tensors.assignment_from_indices(final_vals)
         violation, cost = self.dcop.solution_cost(assignment, self.infinity)
+        from pydcop_tpu.runtime.events import send_harness
+
+        send_harness("run.done", {
+            "algo": self.algo_def.algo,
+            "status": status,
+            "cycle": done,
+            **counters.as_dict(),
+        })
         return SolveResult(
             status=status,
             assignment=assignment,
@@ -268,4 +668,5 @@ class SynchronousTensorSolver:
             msg_size=self.msgs_per_cycle * done * self.msg_size_per_msg,
             time=perf_counter() - t0,
             history=history if collect_cycles else None,
+            harness=counters.as_dict(),
         )

@@ -174,6 +174,22 @@ class MaxSumSolver(SynchronousTensorSolver):
             messages_stable(prev_state[1], state[1], self.stability)
         ))
 
+    def chunk_converged_device(self, prev_state, state):
+        """Device twin of :meth:`chunk_converged` (same semantics, same
+        chunk-boundary caveats): assignment unchanged OR every
+        factor→variable message within the ``stability`` coefficient —
+        one scalar computed inside the chunk runner instead of two full
+        message arrays pulled to the host."""
+        return super().chunk_converged_device(prev_state, state) | jnp.all(
+            messages_stable(prev_state[1], state[1], self.stability)
+        )
+
+    def _supports_fixed_chunk(self, collect: bool) -> bool:
+        # the edge-slab megascale runner and the fused packed-cycles
+        # runner have no fixed-shape masked form; the generic cycle
+        # (incl. packed single-cycle under collect=True) does
+        return self.eslabs is None and (collect or self.packed is None)
+
     def _eslab_chunk_runner(self, n, collect: bool):
         """Megascale chunk runner: the slab/unary/mask arrays ride as
         explicit jit ARGUMENTS — as closure constants they would be

@@ -27,7 +27,11 @@ design points:
   comparison with the same prime chunk size and two-stable-chunks rule;
   converged instances are frozen (their state no longer advances) and
   the bucket exits early once every instance converged or the cycle
-  limit is reached.
+  limit is reached.  Like the sequential harness, the test itself runs
+  ON DEVICE (a [B] bool vector per chunk instead of two state pulls),
+  each bucket compiles ONE fixed-shape runner — remainder chunks run
+  cycle-masked through it (``select_frozen``) — and state buffers are
+  donated where the backend aliases them.
 """
 from __future__ import annotations
 
@@ -42,7 +46,12 @@ import jax
 import jax.numpy as jnp
 
 from pydcop_tpu.algorithms import AlgorithmDef, load_algorithm_module
-from pydcop_tpu.algorithms.base import SolveResult, default_chunk
+from pydcop_tpu.algorithms.base import (
+    SolveResult,
+    default_chunk,
+    donation_supported,
+    select_frozen,
+)
 from pydcop_tpu.batch.bucketing import (
     BucketPlan,
     InstanceDims,
@@ -303,12 +312,16 @@ class _AdapterBase:
         """[B, Vp] value indices from a batched state."""
         return np.asarray(state[0])
 
-    def converged(self, spec: _Spec, prev_state_i, state_i) -> bool:
-        """Per-instance chunk-boundary convergence test, mirroring the
-        solver's chunk_converged."""
-        return bool(np.array_equal(
-            np.asarray(prev_state_i[0]), np.asarray(state_i[0])
-        ))
+    def make_converged(self, params: Dict[str, Any]):
+        """conv(tensors, prev_state_i, state_i) -> bool scalar, traced
+        per instance inside the vmapped runner — the device twin of the
+        sequential solver's chunk_converged, so the host reads one [B]
+        bool vector per chunk instead of pulling both boundary states."""
+
+        def conv(t, prev, cur):
+            return jnp.all(prev[0] == cur[0])
+
+        return conv
 
 
 class _LocalSearchAdapter(_AdapterBase):
@@ -485,19 +498,21 @@ class _MaxSumAdapter(_AdapterBase):
     def values_np(self, state) -> np.ndarray:
         return np.asarray(state[2])
 
-    def converged(self, spec, prev_state_i, state_i) -> bool:
-        if np.array_equal(np.asarray(prev_state_i[2]),
-                          np.asarray(state_i[2])):
-            return True
-        # the reference's approx_match message-stability test
-        # (algorithms/maxsum.messages_stable), in numpy on this
-        # instance's r messages
-        stability = spec.solver.stability
-        r_prev = np.asarray(prev_state_i[1])
-        r_cur = np.asarray(state_i[1])
-        delta = np.abs(r_cur - r_prev)
-        denom = np.abs(r_cur + r_prev)
-        return bool(np.all((delta == 0) | (2 * delta < stability * denom)))
+    def make_converged(self, params):
+        from pydcop_tpu.algorithms.maxsum import messages_stable
+
+        # the reference's approx_match message-stability coefficient —
+        # params are uniform across a bucket (grouping key), so one
+        # closure serves every instance; padded message rows are zeros
+        # on both sides and always compare stable
+        stability = float(params.get("stability", 0.1))
+
+        def conv(t, prev, cur):
+            return jnp.all(prev[2] == cur[2]) | jnp.all(
+                messages_stable(prev[1], cur[1], stability)
+            )
+
+        return conv
 
 
 def _adapter_for(algo: str) -> _AdapterBase:
@@ -519,19 +534,22 @@ def _params_key(params: Dict[str, Any]) -> Tuple:
     return tuple(sorted((k, str(v)) for k, v in (params or {}).items()))
 
 
-def _select_state(done_mask: np.ndarray, old_state, new_state):
-    """Freeze converged instances: keep their old leaves."""
-    mask = jnp.asarray(done_mask)
+def _pad_xs(xs, chunk: int):
+    """Pad per-cycle scan inputs from their true cycle count to the
+    fixed ``chunk`` length on axis 1 ([B, n, ...] → [B, chunk, ...]).
+    The padded rows feed only frozen (masked) cycles; 1.0 keeps the
+    uniforms in their "never activate" convention anyway."""
+    if xs is None:
+        return None
 
-    def sel(old, new):
-        m = mask.reshape((-1,) + (1,) * (old.ndim - 1))
-        return jnp.where(m, old, new)
+    def pad(a):
+        if a.shape[1] == chunk:
+            return a
+        widths = [(0, 0)] * a.ndim
+        widths[1] = (0, chunk - a.shape[1])
+        return jnp.pad(a, widths, constant_values=1.0)
 
-    return jax.tree_util.tree_map(sel, old_state, new_state)
-
-
-def _index_state(state, i: int):
-    return jax.tree_util.tree_map(lambda a: a[i], state)
+    return jax.tree_util.tree_map(pad, xs)
 
 
 class BatchEngine:
@@ -657,27 +675,44 @@ class BatchEngine:
             )
 
     def _runner_key(self, adapter, plan: BucketPlan, pkey: Tuple,
-                    n: int) -> Tuple:
-        return (adapter.algo, pkey) + plan.signature() + (n,)
+                    chunk: int) -> Tuple:
+        return (adapter.algo, pkey) + plan.signature() + ("chunk", chunk)
 
     def _build_runner(self, adapter: _AdapterBase, meta: BucketMeta,
-                      params: Dict[str, Any], n: int):
+                      params: Dict[str, Any], chunk: int):
+        """ONE fixed-shape runner per bucket: always scans ``chunk``
+        cycles, freezing cycles past the dynamic ``n_active`` (remainder
+        chunks reuse the same XLA executable instead of compiling their
+        own shape) and already-converged instances per ``done_mask`` —
+        both through the harness's shared :func:`select_frozen` helper.
+        Also computes the per-instance device convergence vector, so
+        the host's per-chunk read is [B] bools, not two state pytrees.
+        State buffers are donated where the backend aliases them."""
         cycle = adapter.make_cycle(params)
+        conv_fn = adapter.make_converged(params)
 
-        @jax.jit
-        def run_chunk(arrays, state, xs):
+        def run_chunk(arrays, state, xs, n_active, done_mask):
+            active = jnp.arange(chunk) < n_active
+
             def one(arr_i, st_i, xs_i):
                 t = rebuild_tensors(meta, arr_i)
 
-                def body(st, x_in):
-                    return cycle(t, arr_i, st, x_in), None
+                def body(st, sc):
+                    a, x_in = sc
+                    st2 = cycle(t, arr_i, st, x_in)
+                    return select_frozen(~a, st, st2), None
 
-                st, _ = jax.lax.scan(body, st_i, xs_i, length=n)
-                return st
+                st, _ = jax.lax.scan(
+                    body, st_i, (active, xs_i), length=chunk
+                )
+                return st, conv_fn(t, st_i, st)
 
-            return jax.vmap(one)(arrays, state, xs)
+            new_state, conv = jax.vmap(one)(arrays, state, xs)
+            new_state = select_frozen(done_mask, state, new_state)
+            return new_state, conv
 
-        return run_chunk
+        donate = (1,) if donation_supported() else ()
+        return jax.jit(run_chunk, donate_argnums=donate)
 
     def _solve_bucket(
         self,
@@ -716,34 +751,40 @@ class BatchEngine:
         stable = np.zeros(B, np.int64)
         stop_cycle = np.zeros(B, np.int64)
         statuses = ["FINISHED"] * B
-        prev_state = None
+        first_chunk = True
+
+        # ONE fixed-shape runner per bucket: remainder chunk sizes run
+        # cycle-masked through the same executable (randomness is still
+        # drawn at the true cycle count, so the key stream — and with it
+        # bit-identity to the sequential harness — is unchanged)
+        key = self._runner_key(adapter, plan, pkey, chunk)
+        runner, hit = self.cache.get_or_build(
+            key,
+            lambda: self._build_runner(adapter, meta, params, chunk),
+        )
+        self.counters.inc("compile_hits" if hit else "compile_misses")
 
         while done < limit:
             n = min(chunk, limit - done)
-            key = self._runner_key(adapter, plan, pkey, n)
-            runner, hit = self.cache.get_or_build(
-                key,
-                lambda: self._build_runner(adapter, meta, params, n),
-            )
-            self.counters.inc("compile_hits" if hit else "compile_misses")
             keys, xs = adapter.chunk_xs(keys, n, specs, target)
-            new_state = runner(arrays, state, xs)
-            if done_mask.any():
-                new_state = _select_state(done_mask, state, new_state)
+            state, conv = runner(
+                arrays, state, _pad_xs(xs, chunk), n,
+                jnp.asarray(done_mask),
+            )
             done += n
             stop_cycle[~done_mask] = done
 
             if target_cycles is None:
-                if prev_state is not None:
+                # per-instance convergence rides the runner's [B] bool
+                # vector — the only device→host read of the chunk; the
+                # first chunk's flags (vs the initial state) are
+                # skipped, mirroring the sequential harness
+                conv_np = np.asarray(conv)
+                if not first_chunk:
                     for i in range(B):
                         if done_mask[i]:
                             continue
-                        conv = adapter.converged(
-                            specs[i],
-                            _index_state(prev_state, i),
-                            _index_state(new_state, i),
-                        )
-                        stable[i] = stable[i] + 1 if conv else 0
+                        stable[i] = stable[i] + 1 if conv_np[i] else 0
                         if stable[i] >= 2:
                             done_mask[i] = True
                             self.counters.inc("instances_converged")
@@ -751,12 +792,9 @@ class BatchEngine:
                                 "label": specs[i].item.label or i,
                                 "cycle": int(stop_cycle[i]),
                             })
-                prev_state = new_state
-                state = new_state
                 if done_mask.all():
                     break
-            else:
-                state = new_state
+            first_chunk = False
             if timeout is not None and perf_counter() - t0 > timeout:
                 for i in range(B):
                     if not done_mask[i]:

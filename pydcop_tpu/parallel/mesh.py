@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pydcop_tpu.parallel.compat import shard_map
 
+from pydcop_tpu.algorithms.base import donation_supported
 from pydcop_tpu.ops.compile import FactorBucket, FactorGraphTensors
 from pydcop_tpu.ops.maxsum_kernels import factor_to_var_messages
 from pydcop_tpu.ops.segments import masked_argmin, masked_mean, segment_sum
@@ -463,7 +464,12 @@ class ShardedMaxSum:
             ).astype(jnp.int32)
             return state, values_p
 
-        self._run_n = jax.jit(run_n)
+        # donate the scan-state pytree (chunked/resumed runs feed the
+        # previous chunk's output straight back in) — no-op'd on CPU
+        self._run_n = jax.jit(
+            run_n,
+            donate_argnums=(0,) if donation_supported() else (),
+        )
 
     def _make_run_n(self, sharded):
         # global arrays must be jit ARGUMENTS, not closure constants —
@@ -477,33 +483,52 @@ class ShardedMaxSum:
             (q, r), values_hist = jax.lax.scan(body, (q, r), keys)
             return q, r, values_hist[-1]
 
-        self._run_n = jax.jit(run_n)
+        # donate the (q, r) message buffers — each chunked run() call
+        # feeds the previous call's outputs back in, so the [E, D]
+        # blocks update in place instead of doubling peak HBM
+        self._run_n = jax.jit(
+            run_n,
+            donate_argnums=(0, 1) if donation_supported() else (),
+        )
 
     def init_messages(self, seed: int = 0):
+        # every leaf gets its OWN buffer: the run_n runners donate their
+        # state arguments, and XLA rejects the same buffer donated twice
+        # (e.g. a shared zeros array for q and r, or the packed engine's
+        # three message carries)
         if self.packs is not None:
             sp = self.packs
             sharding = NamedSharding(self.mesh, P(AXIS, None, None))
             repl = NamedSharding(self.mesh, P())
-            z = jax.device_put(
-                jnp.zeros((sp.n_shards, sp.D, sp.N), dtype=jnp.float32),
-                sharding,
-            )
+
+            def z():
+                return jax.device_put(
+                    jnp.zeros((sp.n_shards, sp.D, sp.N),
+                              dtype=jnp.float32),
+                    sharding,
+                )
+
             bel0 = jax.device_put(
                 jnp.zeros((sp.D, sp.Vp), dtype=jnp.float32), repl
             )
             if self.activation is None:
-                state = (z, bel0)
+                state = (z(), bel0)
                 return state, state
             # key_p: the pending-commit key; on a fresh zero state the
             # pending mask is a no-op, so any key works here
             key0 = jax.device_put(jax.random.PRNGKey(seed), repl)
-            state = (z, z, z, bel0, key0)
+            state = (z(), z(), z(), bel0, key0)
             return state, state
         st = self.st
         E, D = st.edge_var.shape[0], st.max_domain_size
         sharding = NamedSharding(self.mesh, P(AXIS, None))
-        z = jax.device_put(jnp.zeros((E, D), dtype=jnp.float32), sharding)
-        return z, z
+
+        def z():
+            return jax.device_put(
+                jnp.zeros((E, D), dtype=jnp.float32), sharding
+            )
+
+        return z(), z()
 
     def _state_leaf_shapes(self):
         """Expected continuation-state leaf shapes (one (q, r) half)."""
@@ -594,13 +619,24 @@ class ShardedMaxSum:
                 jnp.asarray(h, dtype=ref.dtype), ref.sharding))
         return jax.tree.unflatten(treedef, leaves)
 
-    def run(self, cycles: int = 20, q=None, r=None, seed: int = 0):
+    def run(self, cycles: int = 20, q=None, r=None, seed: int = 0,
+            host_values: bool = True):
         """Run `cycles` sharded cycles; returns (values [V], q, r).
         Pass the previous call's (q, r) to continue instead of
         restarting from zero messages.  (q, r) are OPAQUE continuation
         state: the packed engine carries its rotated-launch scan state
         in them — callers must not peek inside (they are validated
-        against this solver's expected state structure)."""
+        against this solver's expected state structure).
+
+        ``host_values=False`` skips the device→host values transfer and
+        returns a device array (already in variable order) — chunked
+        drivers that only consume the FINAL values (multihost resumable
+        runs) use it to keep intermediate chunks transfer-free;
+        ``np.asarray`` the last chunk's values when done.
+
+        On TPU/GPU the runner donates its state inputs: once (q, r)
+        have been passed back in, read any host copies you need (e.g.
+        ``state_to_host`` checkpoints) BEFORE the next run() call."""
         if self._run_n is None:
             self._build()
         if q is None or r is None:
@@ -617,10 +653,13 @@ class ShardedMaxSum:
         )
         if self.packs is not None:
             state, values = self._run_n(q, keys, *self._run_args)
-            values = np.asarray(values)[self._values_map]
+            values = (
+                np.asarray(values)[self._values_map] if host_values
+                else values[jnp.asarray(self._values_map)]
+            )
             return values, state, state
         q, r, values = self._run_n(q, r, keys, *self._run_args)
-        return np.asarray(values), q, r
+        return (np.asarray(values) if host_values else values), q, r
 
 
 def st_factors(sb: ShardedBucket) -> int:
@@ -1114,7 +1153,12 @@ class ShardedLocalSearch:
             (x, aux), _ = jax.lax.scan(body, (x, aux), keys)
             return x, aux
 
-        self._run_n = jax.jit(run_n)
+        # donate the assignment row and the breakout weight state (the
+        # bulky gdba per-entry tensors in particular) — no-op'd on CPU
+        self._run_n = jax.jit(
+            run_n,
+            donate_argnums=(0, 2) if donation_supported() else (),
+        )
 
     def run(self, cycles: int = 20, seed: int = 0):
         """Returns the final value indices [V].

@@ -145,11 +145,19 @@ def run_multihost_maxsum_resumable(
     values = None
     while done < cycles:
         n = max(1, min(chunk, cycles - done))
-        values, q, r = sharded.run(cycles=n, q=q, r=r, seed=seed)
+        # host_values=False: intermediate chunks only feed (q, r) back
+        # in — their values row would be a wasted device→host transfer
+        # per chunk; only the final chunk's values are materialized
+        values, q, r = sharded.run(cycles=n, q=q, r=r, seed=seed,
+                                   host_values=False)
         done += n
         if on_chunk is not None:
+            # checkpoint/heartbeat hook: runs BEFORE the next chunk, so
+            # host reads of (q, r) precede their donation to it
             on_chunk(done, sharded, q, r)
-    return values, mesh.devices.size, tensors
+    import numpy as np
+
+    return np.asarray(values), mesh.devices.size, tensors
 
 
 def run_multihost_local_search(dcop, rule: str = "mgm", cycles: int = 15,

@@ -69,3 +69,17 @@ def send_batch(event: str, payload) -> None:
     """Publish a batched-solve lifecycle event on the global bus
     (no-op unless observability is enabled)."""
     event_bus.send(BATCH_TOPIC_PREFIX + event, payload)
+
+
+#: solve-harness topic prefix (algorithms/base).  Topics:
+#: ``harness.run.done`` (algo, status, cycle + the HarnessCounters
+#: scorecard: host_sync_count, dispatch_wait_s, donated_chunks,
+#: masked_tail_cycles, ...) — subscribe with ``harness.*`` (the UI
+#: server pushes them to ws/SSE clients like ``batch.*``).
+HARNESS_TOPIC_PREFIX = "harness."
+
+
+def send_harness(event: str, payload) -> None:
+    """Publish a solve-harness lifecycle event on the global bus
+    (no-op unless observability is enabled)."""
+    event_bus.send(HARNESS_TOPIC_PREFIX + event, payload)

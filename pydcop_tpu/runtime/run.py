@@ -40,8 +40,16 @@ def solve_result(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: Optional[int] = None,
     resume: bool = False,
+    pipeline: bool = False,
 ) -> SolveResult:
     """Solve a DCOP and return the full result + metrics.
+
+    ``pipeline=True`` enables the harness's pipelined chunk dispatch
+    for converging (open-ended) runs: the next chunk launches before
+    the previous chunk's device-side convergence scalar is read, so
+    host bookkeeping overlaps device compute at the cost of up to one
+    chunk of extra cycles past the stop point (see
+    docs/performance.rst, "Pipelined convergence").
 
     The reference twin is infrastructure/run.py:solve (used by all api
     tests).  ``distribution`` as a strategy NAME is computed and validated
@@ -104,7 +112,8 @@ def solve_result(
             timeout, resume, collect_cycles,
         )
     return solver.run(
-        cycles=stop_cycle, timeout=timeout, collect_cycles=collect_cycles
+        cycles=stop_cycle, timeout=timeout, collect_cycles=collect_cycles,
+        pipeline=pipeline,
     )
 
 

@@ -113,6 +113,47 @@ class BatchCounters:
         return self.counts["padded_cells"] / total if total else 0.0
 
 
+#: counter names surfaced under ``SolveResult.metrics()["harness"]`` by
+#: the chunked solve harness (algorithms/base.SynchronousTensorSolver.run)
+#: — the device-residency scorecard of a solve: how often the host
+#: actually blocked on the device and what it paid per chunk
+HARNESS_COUNTERS = (
+    "chunks_dispatched",        # jitted chunk launches
+    "host_sync_count",          # device→host materializations in the loop
+    "dispatch_wait_s",          # wall seconds blocked on device results
+    "donated_chunks",           # chunks run through a donating runner
+    "masked_tail_cycles",       # frozen cycles in fixed-shape tail chunks
+    "overshoot_cycles",         # cycles run past the stop (pipelined mode)
+    "compile_cache_evictions",  # chunk-runner LRU evictions (cumulative)
+)
+
+
+class HarnessCounters:
+    """Host↔device traffic counters collected by the solve harness and
+    merged into its result (``SolveResult.metrics()['harness']``).
+    ``dispatch_wait_s`` accumulates float seconds; everything else is an
+    integer count."""
+
+    def __init__(self):
+        self.counts = {
+            k: (0.0 if k == "dispatch_wait_s" else 0)
+            for k in HARNESS_COUNTERS
+        }
+
+    def add(self, name: str, n=1) -> None:
+        if name not in self.counts:
+            raise KeyError(
+                f"unknown harness counter {name!r}; add it to "
+                f"HARNESS_COUNTERS"
+            )
+        self.counts[name] += n
+
+    def as_dict(self) -> dict:
+        out = dict(self.counts)
+        out["dispatch_wait_s"] = round(out["dispatch_wait_s"], 6)
+        return out
+
+
 class StatsLogger:
     """Accumulate per-cycle rows and dump them as CSV (reference:
     trace_computation, stats.py:81)."""

@@ -51,6 +51,7 @@ class UiServer:
         event_bus.subscribe("agents.rem_computation.*", self._cb_rem_comp)
         event_bus.subscribe("faults.*", self._cb_fault)
         event_bus.subscribe("batch.*", self._cb_batch)
+        event_bus.subscribe("harness.*", self._cb_harness)
 
     # -- event plumbing -----------------------------------------------------
 
@@ -181,6 +182,19 @@ class UiServer:
         if self._ws is not None:
             self._ws.send_all(json.dumps(
                 {"evt": "batch",
+                 "kind": topic.split(".", 1)[-1],
+                 "data": evt if isinstance(evt, (dict, list, str, int,
+                                                 float, bool, type(None)))
+                 else repr(evt)}))
+
+    def _cb_harness(self, topic: str, evt) -> None:
+        """Solve-harness lifecycle (harness.run.done with the
+        HarnessCounters host↔device traffic scorecard) pushed to GUI
+        clients; the SSE /events stream gets them through the wildcard
+        subscription like every topic."""
+        if self._ws is not None:
+            self._ws.send_all(json.dumps(
+                {"evt": "harness",
                  "kind": topic.split(".", 1)[-1],
                  "data": evt if isinstance(evt, (dict, list, str, int,
                                                  float, bool, type(None)))

@@ -1,0 +1,258 @@
+"""Device-resident convergence + pipelined chunk dispatch (the solve
+harness's hot loop).
+
+Pins the acceptance contract of the pipelined harness:
+
+* converging (open-ended) solves are BIT-IDENTICAL — assignments AND
+  reported stop cycles — to the pre-pipeline host-compare harness for
+  all five vmap-factored algorithms with ``pipeline=False``, and
+  assignment-identical with ≤ one chunk of overshoot when pipelined;
+* exactly ONE XLA compile per (solver, collect) pair regardless of
+  remainder-chunk sizes (trace-count + cache-count assertions);
+* the hot loop contains no host round-trip per cycle: convergence is a
+  scalar computed inside the jitted chunk (jaxpr-pinned), and
+  ``host_sync_count`` is ≤ 1 per chunk;
+* warm restarts (``resume=True``) continue the PRNG stream identically
+  on both paths.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms import load_algorithm_module
+from pydcop_tpu.algorithms.base import (
+    LruCache,
+    clamp_chunk_to_deadline,
+)
+from pydcop_tpu.generators import generate_graph_coloring
+
+ALGOS = ["mgm", "dsa", "adsa", "gdba", "maxsum"]
+
+
+def _dcop(seed=1, V=16, E=24):
+    return generate_graph_coloring(
+        n_variables=V, n_colors=3, n_edges=E, soft=True, n_agents=1,
+        seed=seed,
+    )
+
+
+def _solver(algo, dcop, seed=0):
+    return load_algorithm_module(algo).build_solver(dcop, seed=seed)
+
+
+@pytest.fixture(scope="module")
+def dcop():
+    return _dcop()
+
+
+class TestConvergenceParity:
+    """Open-ended solves vs the pre-pipeline harness, per algorithm."""
+
+    @pytest.mark.parametrize("algo", ALGOS)
+    def test_device_convergence_bit_identical(self, dcop, algo):
+        legacy = _solver(algo, dcop)
+        legacy._force_host_convergence = True
+        ref = legacy.run(max_cycles=300)
+        assert not legacy._device_convergence_ok()
+
+        modern = _solver(algo, dcop)
+        assert modern._device_convergence_ok()
+        res = modern.run(max_cycles=300, pipeline=False)
+        assert res.assignment == ref.assignment, algo
+        assert res.cycle == ref.cycle, algo
+        assert res.cost == ref.cost, algo
+        # the device loop reads ONE scalar per chunk, never bulk state
+        h = res.harness
+        assert h["host_sync_count"] <= h["chunks_dispatched"]
+
+    @pytest.mark.parametrize("algo", ALGOS)
+    def test_pipelined_overshoots_at_most_one_chunk(self, dcop, algo):
+        legacy = _solver(algo, dcop)
+        legacy._force_host_convergence = True
+        ref = legacy.run(max_cycles=300)
+
+        piped = _solver(algo, dcop)
+        res = piped.run(max_cycles=300, pipeline=True)
+        assert res.assignment == ref.assignment, algo
+        assert ref.cycle <= res.cycle <= ref.cycle + 7, algo
+        assert res.harness["overshoot_cycles"] == res.cycle - ref.cycle
+
+
+class TestFixedShapeRunner:
+    def test_one_compile_despite_remainder_chunks(self, dcop):
+        solver = _solver("dsa", dcop)
+        res = solver.run(cycles=23, chunk=7)  # chunks 7, 7, 7, tail 2
+        assert res.cycle == 23
+        assert solver._masked_trace_counts == {("masked", 7, False): 1}
+        assert len(solver._compiled_chunks) == 1
+        assert res.harness["chunks_dispatched"] == 4
+        assert res.harness["masked_tail_cycles"] == 5
+        # fixed-cycle runs never block on convergence reads
+        assert res.harness["host_sync_count"] == 0
+
+    def test_masked_tail_bit_identical_to_per_shape_runner(self, dcop):
+        ref = _solver("dsa", dcop)
+        ref._force_host_convergence = True  # per-(n, collect) runners
+        a = ref.run(cycles=23, chunk=7)
+        b = _solver("dsa", dcop).run(cycles=23, chunk=7)
+        assert a.assignment == b.assignment
+        assert a.cost == b.cost
+        # the legacy path really did compile a remainder shape
+        assert (2, False) in ref._compiled_chunks
+
+    def test_collect_cycles_history_matches(self, dcop):
+        ref = _solver("mgm", dcop)
+        ref._force_host_convergence = True
+        a = ref.run(cycles=10, collect_cycles=True)
+        b = _solver("mgm", dcop).run(cycles=10, collect_cycles=True)
+        assert [h["cost"] for h in a.history] == [
+            h["cost"] for h in b.history
+        ]
+        assert [h["cycle"] for h in a.history] == [
+            h["cycle"] for h in b.history
+        ]
+
+
+class TestWarmRestart:
+    @pytest.mark.parametrize("algo", ["dsa", "maxsum"])
+    def test_resume_continues_prng_stream_identically(self, dcop, algo):
+        legacy = _solver(algo, dcop)
+        legacy._force_host_convergence = True
+        legacy.run(cycles=10)
+        legacy.run(cycles=10, resume=True)
+
+        modern = _solver(algo, dcop)
+        modern.run(cycles=10)
+        modern.run(cycles=10, resume=True)
+        assert np.array_equal(
+            np.asarray(legacy._last_key), np.asarray(modern._last_key)
+        )
+        assert np.array_equal(
+            np.asarray(legacy.values_of(legacy._last_state)),
+            np.asarray(modern.values_of(modern._last_state)),
+        )
+
+
+class TestNoHostRoundTripPerCycle:
+    def test_masked_runner_jaxpr_is_one_scan_with_scalar_conv(self, dcop):
+        solver = _solver("mgm", dcop)
+        runner = solver._masked_chunk_runner(7, collect=False)
+        state = solver.initial_state()
+        keys = jax.random.split(jax.random.PRNGKey(0), 7)
+        jaxpr = jax.make_jaxpr(runner)(state, keys, 5)
+
+        prims = []
+
+        def walk(jx):
+            for eqn in jx.eqns:
+                prims.append(eqn.primitive.name)
+                for p in eqn.params.values():
+                    if hasattr(p, "jaxpr"):
+                        walk(p.jaxpr)
+                    elif isinstance(p, (tuple, list)):
+                        for q in p:
+                            if hasattr(q, "jaxpr"):
+                                walk(q.jaxpr)
+
+        walk(jaxpr.jaxpr)
+        # the whole chunk is one scanned program...
+        assert "scan" in prims
+        # ...with no host-callback escape hatches anywhere inside
+        forbidden = {"io_callback", "pure_callback", "outside_call",
+                     "host_callback_call"}
+        assert not forbidden.intersection(prims)
+        # the convergence decision leaves the device as ONE bool scalar
+        conv_aval = jaxpr.out_avals[-1]
+        assert conv_aval.shape == ()
+        assert conv_aval.dtype == np.bool_
+
+
+class TestCountersAndEvents:
+    def test_harness_counters_in_metrics(self, dcop):
+        res = _solver("mgm", dcop).run(max_cycles=300)
+        m = res.metrics()
+        for k in ("host_sync_count", "dispatch_wait_s", "donated_chunks",
+                  "masked_tail_cycles", "chunks_dispatched",
+                  "compile_cache_evictions"):
+            assert k in m["harness"], k
+
+    def test_harness_run_done_event_forwarded(self, dcop):
+        from pydcop_tpu.runtime.events import event_bus
+
+        got = []
+        cb = lambda topic, evt: got.append((topic, evt))  # noqa: E731
+        event_bus.subscribe("harness.*", cb)
+        was = event_bus.enabled
+        event_bus.enabled = True
+        try:
+            _solver("mgm", dcop).run(max_cycles=50)
+        finally:
+            event_bus.enabled = was
+            event_bus.unsubscribe(cb)
+        assert got, "no harness.* event emitted"
+        topic, evt = got[-1]
+        assert topic == "harness.run.done"
+        assert evt["algo"] == "mgm"
+        assert "host_sync_count" in evt
+
+
+class TestDeadlineClamp:
+    def test_no_rate_no_clamp(self):
+        assert clamp_chunk_to_deadline(100, None, 5.0) == 100
+        assert clamp_chunk_to_deadline(100, 10.0, None) == 100
+
+    def test_clamps_to_projected_budget(self):
+        # 10 cycles/sec, 2s left → at most 20 more cycles
+        assert clamp_chunk_to_deadline(100, 10.0, 2.0) == 20
+        assert clamp_chunk_to_deadline(15, 10.0, 2.0) == 15
+
+    def test_floor_of_one_cycle(self):
+        assert clamp_chunk_to_deadline(100, 10.0, 0.01) == 1
+        assert clamp_chunk_to_deadline(100, 10.0, -3.0) == 1
+
+    def test_shrunk_chunk_reuses_the_compiled_runner(self, dcop):
+        # a deadline-shrunk chunk is just a masked tail — same XLA
+        # program, no remainder-shape compile
+        solver = _solver("mgm", dcop)
+        solver.run(cycles=40, chunk=20, timeout=30.0)
+        assert solver._masked_trace_counts == {("masked", 20, False): 1}
+
+
+class TestCompiledChunkLru:
+    def test_eviction_counted(self):
+        c = LruCache(capacity=2)
+        c["a"], c["b"] = 1, 2
+        _ = c["a"]  # refresh a
+        c["c"] = 3  # evicts b
+        assert len(c) == 2
+        assert c.evictions == 1
+        assert "b" not in c and "a" in c and "c" in c
+        c.clear()
+        assert len(c) == 0
+
+    def test_solver_cache_is_bounded(self, dcop):
+        solver = _solver("mgm", dcop)
+        solver._compiled_chunks.capacity = 2
+        solver._force_host_convergence = True  # per-n runners
+        for n in (3, 4, 5, 6):
+            solver.run(cycles=n, chunk=n)
+        assert len(solver._compiled_chunks) <= 2
+        assert solver._compiled_chunks.evictions >= 2
+        res = solver.run(cycles=3, chunk=3)
+        assert res.harness["compile_cache_evictions"] >= 3
+
+
+class TestBatchEngineFixedShape:
+    def test_one_compile_despite_remainder_chunk(self):
+        from pydcop_tpu.batch.cache import CompileCache
+        from pydcop_tpu.batch.engine import BatchEngine, BatchItem
+
+        cache = CompileCache()
+        engine = BatchEngine(cache=cache)
+        items = [BatchItem(_dcop(seed=3), "mgm", seed=0)]
+        # max_cycles=10 → chunks 7 + masked tail 3: one runner compile
+        res = engine.solve(items, max_cycles=10)
+        assert cache.misses == 1
+        assert res[0].cycle <= 10
