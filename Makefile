@@ -1,7 +1,7 @@
 # Test/check targets (reference twin: pyDcop Makefile:1-21)
 
 .PHONY: test unit api cli doctest all-tests bench bench-probe faults \
-	bench-batch batch-smoke bench-harness
+	bench-batch batch-smoke bench-harness bench-sharded
 
 test: all-tests
 
@@ -35,6 +35,12 @@ bench-probe:
 # (docs/performance.rst "Batched solving")
 bench-batch:
 	python bench.py --only batch
+
+# sharded benches only: the 8-device CPU-mesh compact-vs-dense maxsum
+# pair on the partitioned ring-lattice instance (+ packed canary) —
+# docs/performance.rst "Boundary-compacted sharding"
+bench-sharded:
+	python bench.py --only sharded
 
 # harness sync-overhead spot check: blocking vs pipelined chunk
 # dispatch on a convergence-bound solve (docs/performance.rst

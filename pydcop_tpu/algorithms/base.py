@@ -63,6 +63,10 @@ class SolveResult:
     #: (runtime/stats.HarnessCounters), None for solvers that do not
     #: run through the chunked harness (dpop, syncbb, batch engine)
     harness: Optional[Dict[str, Any]] = None
+    #: sharded-collective scorecard (runtime/stats.ShardCommCounters:
+    #: chosen overlap path, cut fraction, per-cycle collective bytes),
+    #: None for single-device solves
+    shard: Optional[Dict[str, Any]] = None
 
     def metrics(self) -> Dict[str, Any]:
         out = {
@@ -77,6 +81,8 @@ class SolveResult:
         }
         if self.harness is not None:
             out["harness"] = dict(self.harness)
+        if self.shard is not None:
+            out["shard"] = dict(self.shard)
         return out
 
 

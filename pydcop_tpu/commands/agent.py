@@ -57,6 +57,16 @@ def set_parser(subparsers):
     parser.add_argument("--seed", type=int, default=0,
                         help="PRNG seed for the local-search rules "
                         "(must be identical on all ranks)")
+    parser.add_argument("--shard-overlap",
+                        choices=["off", "exact", "stale"], default=None,
+                        help="boundary-compacted collective path for "
+                        "the sharded engines (identical on all ranks); "
+                        "default: auto by cut fraction — see "
+                        "docs/performance.rst")
+    parser.add_argument("--shard-boundary-threshold", type=float,
+                        default=0.5,
+                        help="auto-policy cut-fraction threshold above "
+                        "which the dense psum is kept (default 0.5)")
     # crash-resilience plumbing (runtime/process.py watchdog contract)
     parser.add_argument("--heartbeat-file", default=None,
                         help="touch this file every --heartbeat-interval "
@@ -143,6 +153,7 @@ def run_multihost(args):
 
     algo_params = parse_algo_params(getattr(args, "algo_params", None))
     resumed_from = 0
+    shard_info: dict = {}
     if args.algo in LS_RULES:
         if ckpt_mgr is not None or (
                 injector is not None and injector.cycle_faults_pending):
@@ -156,7 +167,10 @@ def run_multihost(args):
             )
         values, n_devices, tensors = run_multihost_local_search(
             dcop, rule=args.algo, cycles=args.cycles,
-            seed=args.seed, algo_params=algo_params)
+            seed=args.seed, algo_params=algo_params,
+            overlap=args.shard_overlap,
+            boundary_threshold=args.shard_boundary_threshold,
+            info=shard_info)
     else:
         # amaxsum: per-edge activation masks in the sharded engine (same
         # emulation as AMaxSumSolver, decorrelated per shard)
@@ -170,7 +184,9 @@ def run_multihost(args):
         if ckpt_mgr is None and injector is None:
             values, n_devices, tensors = run_multihost_maxsum(
                 dcop, cycles=args.cycles, activation=activation,
-                seed=args.seed)
+                seed=args.seed, overlap=args.shard_overlap,
+                boundary_threshold=args.shard_boundary_threshold,
+                info=shard_info)
         else:
             state = None
             epoch = 0
@@ -203,15 +219,16 @@ def run_multihost(args):
 
             values, n_devices, tensors = run_multihost_maxsum_resumable(
                 dcop, cycles=args.cycles, activation=activation,
-                seed=args.seed,
+                seed=args.seed, overlap=args.shard_overlap,
+                boundary_threshold=args.shard_boundary_threshold,
                 chunk=max(1, args.checkpoint_every),
                 start_cycle=resumed_from, state=state, epoch=epoch,
-                on_chunk=on_chunk)
+                on_chunk=on_chunk, info=shard_info)
     assignment = tensors.assignment_from_indices(values)
     violation, cost = dcop.solution_cost(assignment, DEFAULT_INFINITY)
     if hb is not None:
         hb.stop()
-    output_metrics({
+    metrics = {
         "status": "FINISHED",
         "assignment": assignment,
         "cost": cost,
@@ -221,7 +238,10 @@ def run_multihost(args):
         "process_id": args.process_id,
         "n_global_devices": int(n_devices),
         "resumed_from": resumed_from,
-    }, args.output)
+    }
+    if shard_info.get("shard"):
+        metrics["shard"] = shard_info["shard"]
+    output_metrics(metrics, args.output)
     return 0
 
 

@@ -70,6 +70,20 @@ def set_parser(subparsers):
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--cycles", type=int, default=None,
                         help="run exactly this many cycles")
+    # boundary-compacted sharded collectives (docs/performance.rst,
+    # "Boundary-compacted sharding") — meaningful on the multi-device
+    # placement-driven path; the chosen path lands in metrics['shard']
+    parser.add_argument("--shard-overlap",
+                        choices=["off", "exact", "stale"], default=None,
+                        help="sharded-engine collective path: off = "
+                        "dense whole-space psum, exact = boundary-"
+                        "compacted collective (bit-identical), stale = "
+                        "double-buffered boundary exchange (staleness-1 "
+                        "halo); default: auto by cut fraction")
+    parser.add_argument("--shard-boundary-threshold", type=float,
+                        default=0.5,
+                        help="auto-policy cut-fraction threshold above "
+                        "which the dense psum is kept (default 0.5)")
     # crash resilience (docs/resilience.rst)
     parser.add_argument("--checkpoint", default=None,
                         help="rotating snapshot directory: solver state "
@@ -140,6 +154,8 @@ def run_cmd(args):
             checkpoint_dir=args.checkpoint,
             checkpoint_every=args.checkpoint_every,
             resume=args.resume,
+            shard_overlap=args.shard_overlap,
+            shard_boundary_threshold=args.shard_boundary_threshold,
         )
     except Exception as e:
         output_metrics({"status": "ERROR", "error": str(e)}, args.output)

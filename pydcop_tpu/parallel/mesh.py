@@ -38,6 +38,199 @@ from pydcop_tpu.parallel.partition import partition_factors
 
 AXIS = "shard"
 
+#: sentinels for the exchange-path min/max scatter neutrals
+_NEG_BIG = -3.0e38
+_POS_BIG = 3.0e38
+_INT_BIG = np.iinfo(np.int32).max
+
+
+@dataclasses.dataclass
+class CommPlan:
+    """Resolved per-engine collective plan (ISSUE 5 tentpole).
+
+    ``mode``: ``dense`` (the historical whole-space psum), ``exact``
+    (boundary-compacted collective, bit-identical to dense), or
+    ``stale`` (double-buffered boundary exchange, staleness-1 halo).
+    ``collective``: ``psum`` (compact all-reduce), ``ppermute``
+    (edge-colored neighbor exchange rounds — pairwise cuts only), or
+    ``none`` (no boundary at all — the cycle needs NO collective).
+    """
+
+    requested: str
+    mode: str
+    collective: str
+    threshold: float
+    info: Optional[object] = None      # parallel.boundary.BoundaryInfo
+    bnd: Optional[jnp.ndarray] = None  # [Bp] boundary index vector
+    own: Optional[jnp.ndarray] = None  # per-shard ownership mask
+    exch: Optional[tuple] = None       # (send, recv, valid) stacked
+    rounds: Optional[list] = None      # static ppermute perms
+    #: per-shard collective payload width, in columns (dense vs chosen)
+    width_dense: int = 0
+    width_compact: int = 0
+    rows: int = 1                      # rows per column in the payload
+    #: single-row arbitration collectives riding alongside the main
+    #: one (MGM's pmax/pmin pair), counted separately per mode — the
+    #: generic dense engine arbitrates replicated (0 collectives), the
+    #: packed and compact engines exchange 1-row partials
+    extra_dense: int = 0
+    extra_compact: int = 0
+
+    @property
+    def compact(self) -> bool:
+        return self.mode != "dense"
+
+    def counters(self, n_shards: int):
+        from pydcop_tpu.runtime.stats import ShardCommCounters
+
+        info = self.info
+        width_c = (
+            self.width_dense if self.mode == "dense"
+            else self.width_compact
+        )
+        return ShardCommCounters(
+            mode=(
+                "dense" if self.mode == "dense"
+                else f"compact-{self.mode}"
+            ),
+            collective=(
+                "psum" if self.mode == "dense" else self.collective
+            ),
+            n_shards=n_shards,
+            boundary_columns=(info.n_boundary if info else 0),
+            total_columns=self.width_dense,
+            cut_fraction=(info.cut_fraction if info else 0.0),
+            boundary_fraction=(
+                info.boundary_fraction if info else 0.0
+            ),
+            bytes_per_cycle_dense=4 * self.width_dense * (
+                self.rows + self.extra_dense
+            ),
+            bytes_per_cycle_compact=4 * width_c * (
+                self.rows + (self.extra_dense if self.mode == "dense"
+                             else self.extra_compact)
+            ),
+            exchange_rounds=(
+                len(self.rounds)
+                if self.collective == "ppermute" and self.rounds
+                else 0
+            ),
+            threshold=self.threshold,
+        )
+
+
+def _plan_comm(requested, threshold, exchange, info, bnd, own,
+               exch_arrays, rounds, width_dense, rows,
+               extra_dense=0, extra_compact=0) -> CommPlan:
+    """Resolve the overlap request against the partition's boundary
+    analysis.  ``auto`` (the default) compacts only when the boundary
+    fraction is under ``threshold`` — an all-boundary adversarial cut
+    keeps the dense psum, whose single fused collective beats a compact
+    slab that is the whole space anyway.  Explicit ``exact``/``stale``
+    force the compact path (how the parity tests cover adversarial
+    cuts).  The ppermute neighbor exchange engages only on pairwise
+    cuts and only when its payload (rounds x pair width) undercuts the
+    compact slab, unless forced with ``exchange=True``."""
+    req = "auto" if requested in (None, "auto") else str(requested)
+    if req not in ("auto", "off", "dense", "exact", "stale"):
+        raise ValueError(
+            f"unknown shard overlap mode {requested!r}; expected one "
+            f"of off/exact/stale (or auto)"
+        )
+    plan = CommPlan(
+        requested=req, mode="dense", collective="psum",
+        threshold=float(threshold), info=info,
+        width_dense=int(width_dense), rows=int(rows),
+        extra_dense=int(extra_dense), extra_compact=int(extra_compact),
+    )
+    if req in ("off", "dense") or info is None:
+        return plan
+    if req == "auto" and (
+        info.n_touched == 0 or info.cut_fraction > float(threshold)
+    ):
+        return plan
+    mode = "exact" if req == "auto" else req
+    n_bnd = int(bnd.shape[0]) if bnd is not None else 0
+    if n_bnd == 0:
+        # interior-only partition: the cycle needs no collective at all
+        # (stale has nothing to double-buffer — downgrade to exact)
+        plan.mode, plan.collective = "exact", "none"
+        plan.bnd, plan.own = bnd, own
+        plan.width_compact = 0
+        return plan
+    plan.mode = mode
+    plan.bnd, plan.own = bnd, own
+    plan.width_compact = n_bnd
+    use_exch = False
+    if exch_arrays is not None and mode == "exact":
+        lanes = len(rounds) * int(exch_arrays[0].shape[-1])
+        use_exch = exchange is True or (exchange is None
+                                        and lanes < n_bnd)
+    if exchange is True and exch_arrays is None:
+        raise ValueError(
+            "exchange=True requested but the cut graph is not pairwise "
+            "(a boundary variable is shared by 3+ shards) — no "
+            "neighbor-exchange schedule exists for this partition"
+        )
+    if use_exch:
+        plan.collective = "ppermute"
+        plan.exch = exch_arrays
+        plan.rounds = rounds
+        plan.width_compact = len(rounds) * int(exch_arrays[0].shape[-1])
+    return plan
+
+
+def _announce_comm(plan: CommPlan, n_shards: int, engine: str,
+                   packed: bool) -> None:
+    """Publish the chosen collective path on the event bus
+    (``shard.comm.selected`` — no-op unless observability is on)."""
+    from pydcop_tpu.runtime.events import send_shard
+
+    payload = plan.counters(n_shards).as_dict()
+    payload.update(engine=engine, packed=packed)
+    send_shard("comm.selected", payload)
+
+
+def _combine_boundary(part, plan: CommPlan, bnd, axis: int,
+                      op: str = "sum", exch_blocks=None):
+    """Inside ``shard_map``: combine per-shard partials across the mesh
+    at the BOUNDARY indices only, leaving interior entries as the local
+    partial (which IS the global total for an interior column — its
+    owner holds every incident factor).  ``bnd`` is the boundary index
+    OPERAND ([Bp], jit argument — multi-process meshes reject sharded
+    closure constants, so the caller threads it through shard_map);
+    ``exch_blocks`` is the per-shard (send_idx, recv_idx, valid) triple
+    of the neighbor-exchange schedule when the plan chose
+    ``ppermute``."""
+    if plan.collective == "none":
+        return part
+    if plan.collective == "ppermute":
+        send, recv, valid = exch_blocks
+        int_part = jnp.issubdtype(part.dtype, jnp.integer)
+        neutral = {
+            "sum": 0 if int_part else 0.0,
+            "max": -_INT_BIG if int_part else _NEG_BIG,
+            "min": _INT_BIG if int_part else _POS_BIG,
+        }[op]
+        for r, perm in enumerate(plan.rounds):
+            if not perm:
+                continue
+            seg = jnp.take(part, send[r], axis=axis)
+            got = jax.lax.ppermute(seg, AXIS, perm)
+            v = valid[r]
+            if part.ndim == 2:
+                v = v[None, :] if axis == 1 else v[:, None]
+            upd = jnp.where(v > 0, got, neutral)
+            ref = part.at[:, recv[r]] if axis == 1 else part.at[recv[r]]
+            part = getattr(ref, {"sum": "add", "max": "max",
+                                 "min": "min"}[op])(upd)
+        return part
+    slab = jnp.take(part, bnd, axis=axis)
+    tot = {"sum": jax.lax.psum, "max": jax.lax.pmax,
+           "min": jax.lax.pmin}[op](slab, AXIS)
+    ref = part.at[:, bnd] if axis == 1 else part.at[bnd]
+    return ref.set(tot)
+
 
 def _devices_are_tpu(mesh: Mesh) -> bool:
     try:
@@ -140,6 +333,17 @@ class ShardedFactorGraph:
     edges_per_shard: int
     mask_ext: jnp.ndarray  # [V+1, D]; dummy row all-zero
     unary: jnp.ndarray  # [V, D]
+    # --- boundary-compacted collective data (ISSUE 5): the generic
+    # engines' analogue of StackedShardPack's bnd_cols/own_rows, in
+    # VARIABLE-id space (the [V+1, D] partial's row axis).  Derived from
+    # the same parallel/boundary analysis partition_stats reports.
+    boundary: Optional[object] = None          # BoundaryInfo
+    bnd_rows: Optional[jnp.ndarray] = None     # [Bp] int32 variable ids
+    own_rows: Optional[jnp.ndarray] = None     # [S, V] float32 ownership
+    exch_send: Optional[jnp.ndarray] = None    # [S, R, Bpair] int32 ids
+    exch_recv: Optional[jnp.ndarray] = None    # [S, R, Bpair] int32 ids
+    exch_valid: Optional[jnp.ndarray] = None   # [S, R, Bpair] float32
+    exch_rounds: Optional[list] = None         # static ppermute perms
 
     @property
     def n_vars(self) -> int:
@@ -203,6 +407,19 @@ def shard_factor_graph(
     mask_ext = jnp.concatenate(
         [tensors.domain_mask, jnp.zeros((1, D), dtype=jnp.float32)]
     )
+    # boundary analysis over the ORIGINAL (dummy-free) factor lists —
+    # the same source of truth partition_stats reports (ISSUE 5)
+    from pydcop_tpu.parallel.boundary import (
+        analyze_boundary,
+        build_exchange_plan,
+        padded_boundary_idx,
+    )
+
+    var_idx_per_bucket = [np.asarray(b.var_idx) for b in tensors.buckets]
+    info = analyze_boundary(var_idx_per_bucket, assigns, V, n_shards)
+    own = np.zeros((n_shards, V), dtype=np.float32)
+    own[info.owner, np.arange(V)] = 1.0
+    plan = build_exchange_plan(info, var_idx_per_bucket, assigns)
     return ShardedFactorGraph(
         base=tensors,
         n_shards=n_shards,
@@ -211,10 +428,50 @@ def shard_factor_graph(
         edges_per_shard=edges_per_shard,
         mask_ext=mask_ext,
         unary=tensors.unary_costs,
+        boundary=info,
+        bnd_rows=jnp.asarray(padded_boundary_idx(info, quantum=8)),
+        own_rows=jnp.asarray(own),
+        exch_send=(jnp.asarray(plan.send_idx)
+                   if plan is not None else None),
+        exch_recv=(jnp.asarray(plan.recv_idx)
+                   if plan is not None else None),
+        exch_valid=(jnp.asarray(plan.recv_valid)
+                    if plan is not None else None),
+        exch_rounds=(plan.rounds if plan is not None else None),
     )
 
 
-class ShardedMaxSum:
+class _CommPlanMixin:
+    """Shared comm-plan plumbing for the sharded engines (ISSUE 5)."""
+
+    def _make_comm_plan(self, overlap, threshold, exchange,
+                        extra_dense: int = 0,
+                        extra_compact: int = 0) -> CommPlan:
+        src = self.packs if self.packs is not None else self.st
+        if self.packs is not None:
+            width, rows = src.Vp, src.D
+            bnd = src.bnd_cols
+        else:
+            width, rows = src.n_vars + 1, src.max_domain_size
+            bnd = src.bnd_rows
+        exch = (
+            None if src.exch_send is None
+            else (src.exch_send, src.exch_recv, src.exch_valid)
+        )
+        own = src.own_rows
+        return _plan_comm(
+            overlap, threshold, exchange, src.boundary, bnd, own,
+            exch, src.exch_rounds, width_dense=width, rows=rows,
+            extra_dense=extra_dense, extra_compact=extra_compact,
+        )
+
+    def comm_stats(self) -> dict:
+        """The chosen collective path + partition quality as a plain
+        dict (``SolveResult.metrics()['shard']``, bench extras)."""
+        return self.comm.counters(self.n_shards).as_dict()
+
+
+class ShardedMaxSum(_CommPlanMixin):
     """MaxSum over a device mesh: one psum of partial beliefs per cycle.
 
     All-binary graphs run the LANE-PACKED pallas engine per shard
@@ -239,6 +496,9 @@ class ShardedMaxSum:
         assigns: Optional[List[np.ndarray]] = None,
         activation: Optional[float] = None,
         use_packed: Optional[bool] = None,
+        overlap: Optional[str] = None,
+        boundary_threshold: float = 0.5,
+        exchange: Optional[bool] = None,
     ):
         self.mesh = mesh or build_mesh()
         self.n_shards = self.mesh.devices.size
@@ -262,19 +522,23 @@ class ShardedMaxSum:
             None if activation is None or activation >= 1.0
             else float(activation)
         )
+        self.comm = self._make_comm_plan(
+            overlap, boundary_threshold, exchange
+        )
+        _announce_comm(self.comm, self.n_shards,
+                       engine="maxsum", packed=self.packs is not None)
         self._run_n = None
+        self._finalize = None
 
     # -- kernel -------------------------------------------------------------
 
-    def _local_cycle(self, q_blk, r_blk, key, *bucket_blocks):
-        """Per-shard block of one cycle; runs inside shard_map.
-
-        q_blk/r_blk: [Es, D] local message blocks.
-        key: per-cycle PRNG key (replicated; folded with the shard index).
-        bucket_blocks: per bucket (tensors_blk, var_idx_blk).
-        """
+    def _r_new_block(self, q_blk, r_blk, bucket_blocks, vmask=None):
+        """Per-shard damped+masked factor→var messages [Es, D] (inside
+        shard_map) — the factor side shared by the dense and compact
+        cycles.  ``vmask`` defaults to the global-table gather; the
+        local-row cycle passes its local-row equivalent (same rows)."""
         st = self.st
-        V, D = st.n_vars, st.max_domain_size
+        D = st.max_domain_size
         # factor → var messages, bucket by bucket (static offsets)
         parts = []
         off = 0
@@ -295,24 +559,28 @@ class ShardedMaxSum:
             )
             off += Fs * a
         r_new = jnp.concatenate(parts, axis=0) if parts else r_blk
-        edge_var_blk = self._edge_var_blk
-        vmask = st.mask_ext[edge_var_blk]
+        if vmask is None:
+            vmask = st.mask_ext[self._edge_var_blk]
         r_new = r_new * vmask
         if self.damping:
             r_new = self.damping * r_blk + (1.0 - self.damping) * r_new
-        # partial belief sums; the one collective of the cycle
-        partial = segment_sum(r_new, edge_var_blk, V + 1)
-        total = jax.lax.psum(partial, AXIS)
-        beliefs = st.unary + total[:V]
+        return r_new, vmask
+
+    def _var_side(self, q_blk, r_blk, r_new, vmask, beliefs, key):
+        """Variable side of a generic cycle: mean-centred outgoing q
+        from the (combined) beliefs, plus the amaxsum activation commit
+        — shared by the dense and compact cycles."""
+        st = self.st
+        D = st.max_domain_size
+        edge_var_blk = self._edge_var_blk
         beliefs_ext = jnp.concatenate(
             [beliefs, jnp.zeros((1, D), dtype=beliefs.dtype)]
         )
         q_new = (beliefs_ext[edge_var_blk] - r_new)
         q_new = (q_new - masked_mean(q_new, vmask)) * vmask
-        values = masked_argmin(beliefs, self.st.base.domain_mask)
         if self.activation is not None:
-            # amaxsum emulation: only a random subset of edges commits its
-            # new messages this cycle (AMaxSumSolver.cycle semantics)
+            # amaxsum emulation: only a random subset of edges commits
+            # its new messages this cycle (AMaxSumSolver.cycle semantics)
             skey = jax.random.fold_in(key, jax.lax.axis_index(AXIS))
             active = (
                 jax.random.uniform(skey, (q_blk.shape[0], 1))
@@ -320,46 +588,307 @@ class ShardedMaxSum:
             )
             q_new = jnp.where(active, q_new, q_blk)
             r_new = jnp.where(active, r_new, r_blk)
+        return q_new, r_new
+
+    def _local_cycle(self, q_blk, r_blk, key, *bucket_blocks):
+        """Per-shard block of one DENSE cycle; runs inside shard_map.
+
+        q_blk/r_blk: [Es, D] local message blocks.
+        key: per-cycle PRNG key (replicated; folded with the shard index).
+        bucket_blocks: per bucket (tensors_blk, var_idx_blk).
+        """
+        st = self.st
+        V = st.n_vars
+        r_new, vmask = self._r_new_block(q_blk, r_blk, bucket_blocks)
+        # partial belief sums; the one collective of the cycle
+        partial = segment_sum(r_new, self._edge_var_blk, V + 1)
+        total = jax.lax.psum(partial, AXIS)
+        beliefs = st.unary + total[:V]
+        values = masked_argmin(beliefs, st.base.domain_mask)
+        q_new, r_new = self._var_side(
+            q_blk, r_blk, r_new, vmask, beliefs, key
+        )
         return q_new, r_new, values
+
+    def _local_cycle_compact(self, q_blk, r_blk, key, bucket_blocks,
+                             tail, pend):
+        """Per-shard block of one BOUNDARY-COMPACTED cycle (ISSUE 5):
+        the collective carries only the boundary rows of the [V+1, D]
+        partial; interior rows keep the local partial (the owner's
+        partial IS the global total).  Returns the per-shard beliefs
+        VIEW as an extra carry leaf — correct at this shard's touched
+        variables, reconciled once per run by the owner-masked
+        finalize.  In ``stale`` mode the psum of the PREVIOUS cycle's
+        boundary slab is issued first, independent of this cycle's
+        factor work, so the collective overlaps the compute."""
+        st = self.st
+        V = st.n_vars
+        comm = self.comm
+        r_new, vmask = self._r_new_block(q_blk, r_blk, bucket_blocks)
+        partial = segment_sum(r_new, self._edge_var_blk, V + 1)
+        pend2 = None
+        if comm.mode == "stale":
+            bnd = tail[0]
+            tot = jax.lax.psum(pend, AXIS)
+            pend2 = jnp.take(partial, bnd, axis=0)
+            total = partial.at[bnd].set(tot)
+        elif comm.collective == "ppermute":
+            total = _combine_boundary(
+                partial, comm, None, axis=0, op="sum",
+                exch_blocks=tuple(t[0] for t in tail),
+            )
+        elif comm.collective == "none":
+            total = partial
+        else:
+            total = _combine_boundary(partial, comm, tail[0], axis=0)
+        beliefs = st.unary + total[:V]
+        q_new, r_new = self._var_side(
+            q_blk, r_blk, r_new, vmask, beliefs, key
+        )
+        out = (q_new, r_new, beliefs[None])
+        if pend2 is not None:
+            out += (pend2[None],)
+        return out
+
+    def _local_cycle_lr(self, q_blk, r_blk, key, bucket_blocks,
+                        lr_blocks, tail, pend):
+        """LOCAL-ROW compact cycle (ISSUE 5, "combine locally"): the
+        per-shard belief reduction runs entirely in a compact local row
+        space — a padded slot-table gather + ordered fold replaces the
+        [V+1, D] scatter-add (the dominant cycle cost on CPU meshes;
+        see _local_row_layout) — and only the [Bp, D] boundary slab,
+        gathered from the local rows, touches the collective.  The fold
+        adds slots in the scatter's visit order, so the trajectory is
+        bit-identical to the dense engine."""
+        comm = self.comm
+        D = self.st.max_domain_size
+        lr = self._lr
+        gather_tbl, edge_loc, unary_loc, dmask_loc, slab_loc = (
+            b[0] for b in lr_blocks
+        )
+        vmask = dmask_loc[edge_loc]
+        r_new, vmask = self._r_new_block(
+            q_blk, r_blk, bucket_blocks, vmask
+        )
+        r_ext = jnp.concatenate(
+            [r_new, jnp.zeros((1, D), r_new.dtype)]
+        )
+        g = r_ext[gather_tbl].reshape(lr["rows"], lr["deg"], D)
+        partial = g[:, 0]
+        for k in range(1, lr["deg"]):  # ordered fold == scatter order
+            partial = partial + g[:, k]
+        pend2 = None
+        if comm.mode == "stale":
+            tot = jax.lax.psum(pend, AXIS)
+            pend2 = partial[slab_loc]
+            partial = partial.at[slab_loc].set(tot)
+        elif comm.collective == "ppermute":
+            partial = _combine_boundary(
+                partial, comm, None, axis=0, op="sum",
+                exch_blocks=tuple(t[0] for t in tail),
+            )
+        elif comm.collective == "psum":
+            tot = jax.lax.psum(partial[slab_loc], AXIS)
+            partial = partial.at[slab_loc].set(tot)
+        beliefs = unary_loc + partial
+        # var side on local rows (beliefs gather via edge_loc)
+        q_new = (beliefs[edge_loc] - r_new)
+        q_new = (q_new - masked_mean(q_new, vmask)) * vmask
+        if self.activation is not None:
+            skey = jax.random.fold_in(key, jax.lax.axis_index(AXIS))
+            active = (
+                jax.random.uniform(skey, (q_blk.shape[0], 1))
+                < self.activation
+            )
+            q_new = jnp.where(active, q_new, q_blk)
+            r_new = jnp.where(active, r_new, r_blk)
+        out = (q_new, r_new, beliefs[None])
+        if pend2 is not None:
+            out += (pend2[None],)
+        return out
 
     def _build(self):
         if self.packs is not None:
             self._build_packed()
             return
         st = self.st
+        comm = self.comm
+        compact, stale = comm.compact, comm.mode == "stale"
         # operands are device_put with explicit shardings: required under
         # multi-process meshes (each process materializes only its
         # addressable shards from the replicated host copy), free on a
         # single process.  Each shard has its own edge_var slice, passed
         # as a sharded operand.
         shard0 = NamedSharding(self.mesh, P(AXIS))
+        repl = NamedSharding(self.mesh, P())
         bucket_args = []
-        # q, r, per-cycle key (replicated), edge_var
-        in_specs = [P(AXIS), P(AXIS), P(), P(AXIS)]
+        bucket_specs = []
         for sb in st.buckets:
             bucket_args.extend([
                 jax.device_put(sb.tensors, shard0),
                 jax.device_put(sb.var_idx, shard0),
             ])
-            in_specs.extend([P(AXIS), P(AXIS)])
+            bucket_specs.extend([P(AXIS), P(AXIS)])
+        n_buckets = len(st.buckets)
+        # local-row reduction layout (gather+fold instead of the
+        # [V+1, D] scatter) — the compact generic engine's fast path
+        self._lr = (
+            _local_row_layout(st, np.asarray(comm.bnd))
+            if compact and comm.bnd is not None else None
+        )
+        lr = self._lr
+        lr_args, lr_specs = [], []
+        if lr is not None:
+            lr_args = [jax.device_put(lr[k], shard0) for k in (
+                "gather_tbl", "edge_loc", "unary_loc", "dmask_loc",
+                "slab_loc",
+            )]
+            lr_specs = [P(AXIS)] * 5
+        comm_args, comm_specs = [], []
+        if compact and comm.collective == "ppermute":
+            exch = comm.exch
+            if lr is not None:
+                # translate the exchange schedule's variable ids into
+                # each shard's local rows (sent/received columns are
+                # always touched by that shard, so the map is total)
+                exch = _exchange_to_local(st, lr, comm)
+            comm_args = [jax.device_put(a, shard0) for a in exch]
+            comm_specs = [P(AXIS)] * 3
+        elif compact and comm.collective != "none" and lr is None:
+            comm_args = [jax.device_put(comm.bnd, repl)]
+            comm_specs = [P()]
 
-        def cycle_fn(q, r, key, edge_var, *buckets):
-            # inside shard_map: blocks carry the per-shard slices
-            self._edge_var_blk = edge_var
-            return self._local_cycle(q, r, key, *pairs(buckets))
+        if compact:
+            n_lr, n_comm = len(lr_args), len(comm_args)
+
+            def cycle_fn(q, r, belv, *a):
+                # belv is carried for the post-scan finalize only; the
+                # cycle recomputes beliefs fresh from this cycle's r
+                pend = None
+                if stale:
+                    pend, a = a[0][0], a[1:]
+                key, edge_var = a[0], a[1]
+                rest = a[2:]
+                self._edge_var_blk = edge_var
+                tail = rest[len(rest) - n_comm:] if n_comm else ()
+                rest = rest[:len(rest) - n_comm] if n_comm else rest
+                if lr is not None:
+                    return self._local_cycle_lr(
+                        q, r, key, pairs(rest[:2 * n_buckets]),
+                        rest[2 * n_buckets:], tail, pend,
+                    )
+                return self._local_cycle_compact(
+                    q, r, key, pairs(rest[:2 * n_buckets]), tail, pend,
+                )
+
+            in_specs = (
+                [P(AXIS), P(AXIS), P(AXIS)]
+                + ([P(AXIS)] if stale else [])
+                + [P(), P(AXIS)] + bucket_specs + lr_specs
+                + comm_specs
+            )
+            out_specs = (
+                (P(AXIS), P(AXIS), P(AXIS))
+                + ((P(AXIS),) if stale else ())
+            )
+        else:
+            def cycle_fn(q, r, key, edge_var, *buckets):
+                # inside shard_map: blocks carry the per-shard slices
+                self._edge_var_blk = edge_var
+                return self._local_cycle(q, r, key, *pairs(buckets))
+
+            in_specs = (
+                [P(AXIS), P(AXIS), P(), P(AXIS)] + bucket_specs
+            )
+            out_specs = (P(AXIS), P(AXIS), P())
 
         sharded = shard_map(
             cycle_fn,
             mesh=self.mesh,
             in_specs=tuple(in_specs),
-            out_specs=(P(AXIS), P(AXIS), P()),
+            out_specs=out_specs,
             check_vma=False,
         )
 
         self._run_args = (
-            jax.device_put(st.edge_var, shard0), *bucket_args
+            jax.device_put(st.edge_var, shard0), *bucket_args,
+            *lr_args, *comm_args,
         )
-        self._make_run_n(sharded)
+        if not compact:
+            self._make_run_n(sharded)
+            return
+
+        # the beliefs VIEW (and stale's pending halo slab) are scan
+        # carries INTERNAL to run_n — the generic engine's public
+        # continuation state stays the plain (q, r) message arrays, so
+        # checkpoints and chunked callers are mode-agnostic.  Stale's
+        # halo buffer therefore restarts at zero each run() chunk (a
+        # 1-cycle boundary re-warm per chunk, documented).
+        S, V, D = self.n_shards, st.n_vars, st.max_domain_size
+        Bp = int(comm.bnd.shape[0]) if comm.bnd is not None else 0
+        bel_rows = lr["rows"] if lr is not None else V
+
+        def run_n(q, r, keys, *args):
+            carry0 = (q, r, jnp.zeros((S, bel_rows, D), jnp.float32))
+            if stale:
+                carry0 += (jnp.zeros((S, Bp, D), jnp.float32),)
+
+            def body(carry, k):
+                carry = sharded(*carry, k, *args)
+                return carry, None
+
+            carry, _ = jax.lax.scan(body, carry0, keys)
+            return carry[0], carry[1], carry[2]
+
+        self._run_n = jax.jit(
+            run_n,
+            donate_argnums=(0, 1) if donation_supported() else (),
+        )
+        if lr is not None:
+            own_loc = np.zeros((S, lr["rows"]), dtype=np.float32)
+            glob = np.asarray(lr["glob_loc"])
+            own_g = np.asarray(st.own_rows)
+            own_ext = np.concatenate(
+                [own_g, np.zeros((S, 1), np.float32)], axis=1
+            )
+            own_loc = np.take_along_axis(own_ext, glob, axis=1)
+            self._fin_args = (
+                jax.device_put(lr["dmask_loc"], shard0),
+                jax.device_put(jnp.asarray(own_loc), shard0),
+                jax.device_put(lr["glob_loc"], shard0),
+            )
+
+            def fin(belv, dmask_loc, own, glob):
+                vals = masked_argmin(
+                    belv[0], dmask_loc[0]
+                ).astype(jnp.int32)
+                contrib = jnp.zeros((V + 1,), jnp.int32).at[
+                    glob[0]
+                ].add(jnp.where(own[0] > 0, vals, 0))
+                # owner-masked reconcile: one [V] int psum PER RUN
+                return jax.lax.psum(contrib, AXIS)[:V]
+
+            self._finalize = jax.jit(shard_map(
+                fin, mesh=self.mesh,
+                in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+                out_specs=P(), check_vma=False,
+            ))
+            return
+        self._fin_args = (
+            jax.device_put(st.own_rows, shard0),
+            jax.device_put(st.base.domain_mask, repl),
+        )
+
+        def fin(belv, own, dmask):
+            vals = masked_argmin(belv[0], dmask).astype(jnp.int32)
+            # owner-masked reconcile: one [V] int psum PER RUN
+            return jax.lax.psum(jnp.where(own[0] > 0, vals, 0), AXIS)
+
+        self._finalize = jax.jit(shard_map(
+            fin, mesh=self.mesh,
+            in_specs=(P(AXIS), P(AXIS), P()), out_specs=P(),
+            check_vma=False,
+        ))
 
     def _build_packed(self):
         """shard_map cycle over the lane-packed per-shard layouts, ONE
@@ -373,7 +902,21 @@ class ShardedMaxSum:
         beliefs AFTER the scan instead of per cycle.  The column map is
         shard-invariant (packed_mesh ForcedLayout), so the psum runs
         directly on the packed [D, Vp] partials — no scatter/gather
-        through the global variable axis."""
+        through the global variable axis.
+
+        Boundary-compacted modes (ISSUE 5): with ``comm.compact`` the
+        collective carries only the [D, Bp] boundary slab (psum, or
+        edge-colored ppermute rounds on pairwise cuts) and the beliefs
+        carry becomes a per-shard VIEW [S, D, Vp] — correct at the
+        columns each shard touches, reconciled once per run by the
+        owner-masked finalize.  ``exact`` is bit-identical to the dense
+        psum (interior totals ARE the owner's partial; boundary totals
+        sum the same operands in the same order).  ``stale`` double-
+        buffers the boundary slab: the psum of cycle n-1's slab is
+        issued at the top of launch n, independent of the launch's
+        kernel, so XLA can overlap it with the interior factor/belief
+        work — boundary beliefs trail interior by one cycle
+        (staleness-1 halo, docs/performance.rst)."""
         from pydcop_tpu.ops.compile import PAD_COST
         from pydcop_tpu.ops.pallas_sharded import packed_shard_fused_ba
 
@@ -381,12 +924,55 @@ class ShardedMaxSum:
         pg = sp.pg0
         damping = self.damping
         activation = self.activation
+        comm = self.comm
+        compact, stale = comm.compact, comm.mode == "stale"
         shard0 = NamedSharding(self.mesh, P(AXIS))
         repl = NamedSharding(self.mesh, P())
 
+        # comm operands ride LAST (jit arguments, not closure constants)
+        comm_args, comm_specs = [], []
+        if compact and comm.collective == "ppermute":
+            comm_args = [jax.device_put(a, shard0) for a in comm.exch]
+            comm_specs = [P(AXIS)] * 3
+        elif compact and comm.collective != "none":
+            comm_args = [jax.device_put(comm.bnd, repl)]
+            comm_specs = [P()]
+        n_comm = len(comm_args)
+
+        def split_tail(rest):
+            if not n_comm:
+                return rest, ()
+            return rest[:len(rest) - n_comm], rest[len(rest) - n_comm:]
+
+        def combine(bel, tail, pend=None):
+            """(beliefs partial with cross-shard totals merged at the
+            boundary columns, next pending slab)."""
+            if not compact:
+                return jax.lax.psum(bel, AXIS), None
+            if comm.collective == "none":
+                return bel, None
+            if stale:
+                bnd = tail[0]
+                tot = jax.lax.psum(pend, AXIS)
+                return bel.at[:, bnd].set(tot), jnp.take(bel, bnd, axis=1)
+            if comm.collective == "ppermute":
+                blocks = tuple(t[0] for t in tail)
+                return _combine_boundary(
+                    bel, comm, None, axis=1, op="sum",
+                    exch_blocks=blocks,
+                ), None
+            return _combine_boundary(bel, comm, tail[0], axis=1), None
+
         if activation is not None:
-            def cycle_fn(qm, rm, ru, bel_g, key_p, key, unary_p, vmask,
-                         invd, cost, c1, c2, c3, c4, c5, *extra):
+            def cycle_fn(qm, rm, ru, bel_g, *a):
+                pend = None
+                if stale:
+                    pend, a = a[0][0], a[1:]
+                key_p, key = a[0], a[1]
+                rest, tail = split_tail(a[2:])
+                unary_p, vmask, invd, cost = rest[:4]
+                c1, c2, c3, c4, c5 = rest[4:9]
+                extra = rest[9:]
                 consts = (c1[0], c2[0], c3[0], c4[0], c5[0])
                 # the PENDING mask: cycle n's commit decision (key n)
                 # applied at the start of launch n+1, exactly where the
@@ -398,39 +984,69 @@ class ShardedMaxSum:
                     jax.random.uniform(skey, (1, pg.N)) < activation
                 ).astype(jnp.float32)
                 r_new, bel, q1, r1 = packed_shard_fused_ba(
-                    pg, bel_g, ru[0], qm[0], rm[0], active, cost[0],
-                    vmask[0], invd[0], consts, damping,
-                    mixed=_mixed_bundle(sp, extra),
+                    pg, bel_g[0] if compact else bel_g, ru[0], qm[0],
+                    rm[0], active, cost[0], vmask[0], invd[0], consts,
+                    damping, mixed=_mixed_bundle(sp, extra),
                 )
-                # the ONE collective: columns align across shards
-                beliefs_p = unary_p + jax.lax.psum(bel, AXIS)
-                return q1[None], r1[None], r_new[None], beliefs_p, key
+                bel, pend2 = combine(bel, tail, pend)
+                beliefs_p = unary_p + bel
+                out = (q1[None], r1[None], r_new[None],
+                       beliefs_p[None] if compact else beliefs_p)
+                if stale:
+                    out += (pend2[None],)
+                return out + (key,)
 
+            bel_spec = P(AXIS) if compact else P()
             in_specs = (
-                [P(AXIS), P(AXIS), P(AXIS), P(), P(), P(), P()]
+                [P(AXIS), P(AXIS), P(AXIS), bel_spec]
+                + ([P(AXIS)] if stale else [])
+                + [P(), P(), P()]
                 + [P(AXIS)] * 8
             )
-            out_specs = (P(AXIS), P(AXIS), P(AXIS), P(), P())
+            out_specs = (
+                (P(AXIS), P(AXIS), P(AXIS), bel_spec)
+                + ((P(AXIS),) if stale else ())
+                + (P(),)
+            )
         else:
             # no activation: the whole cycle state is (r_u, beliefs) —
             # the committed q is recomputed inside the launch, so the
             # scan carries no dead [S, D, N] arrays (code-review r5)
-            def cycle_fn(ru, bel_g, key, unary_p, vmask, invd, cost,
-                         c1, c2, c3, c4, c5, *extra):
+            def cycle_fn(ru, bel_g, *a):
+                pend = None
+                if stale:
+                    pend, a = a[0][0], a[1:]
+                key = a[0]
+                rest, tail = split_tail(a[1:])
+                unary_p, vmask, invd, cost = rest[:4]
+                c1, c2, c3, c4, c5 = rest[4:9]
+                extra = rest[9:]
                 consts = (c1[0], c2[0], c3[0], c4[0], c5[0])
                 r_new, bel = packed_shard_fused_ba(
-                    pg, bel_g, ru[0], None, None, None, cost[0],
-                    vmask[0], invd[0], consts, damping,
-                    mixed=_mixed_bundle(sp, extra),
+                    pg, bel_g[0] if compact else bel_g, ru[0], None,
+                    None, None, cost[0], vmask[0], invd[0], consts,
+                    damping, mixed=_mixed_bundle(sp, extra),
                 )
-                # the ONE collective: columns align across shards
-                beliefs_p = unary_p + jax.lax.psum(bel, AXIS)
-                return r_new[None], beliefs_p
+                bel, pend2 = combine(bel, tail, pend)
+                beliefs_p = unary_p + bel
+                out = (r_new[None],
+                       beliefs_p[None] if compact else beliefs_p)
+                if stale:
+                    out += (pend2[None],)
+                return out
 
-            in_specs = [P(AXIS), P(), P(), P()] + [P(AXIS)] * 8
-            out_specs = (P(AXIS), P())
+            bel_spec = P(AXIS) if compact else P()
+            in_specs = (
+                [P(AXIS), bel_spec]
+                + ([P(AXIS)] if stale else [])
+                + [P(), P()]
+                + [P(AXIS)] * 8
+            )
+            out_specs = (
+                (P(AXIS), bel_spec) + ((P(AXIS),) if stale else ())
+            )
         extra_args, extra_specs = _mixed_operands(sp, self.mesh)
-        in_specs += extra_specs
+        in_specs += extra_specs + comm_specs
         sharded = shard_map(
             cycle_fn,
             mesh=self.mesh,
@@ -441,28 +1057,89 @@ class ShardedMaxSum:
         # mask_p rides _run_args too: jit ARGUMENTS, not closure
         # constants — multi-process meshes reject closing over arrays
         # with non-addressable shards
-        self._run_args = (
-            jax.device_put(pg.mask_p, repl),
+        base_args = (
             jax.device_put(sp.unary_p, repl),
             *(jax.device_put(a, shard0) for a in (
                 sp.vmask, sp.inv_dcount, sp.cost_rows, *sp.consts,
             )),
             *extra_args,
+            *comm_args,
         )
         # run() maps packed column values back to variable order
         self._values_map = np.asarray(pg.var_order)
         bel_idx = 3 if activation is not None else 1
+        self._bel_idx = bel_idx
 
-        def run_n(state, keys, mask_p, *args):
-            def body(carry, k):
-                carry = sharded(*carry, k, *args)
-                return carry, None
+        if compact:
+            # stale's pending halo slab is a scan carry INTERNAL to
+            # run_n (zeros each run — a 1-cycle boundary re-warm per
+            # chunk), keeping the public continuation state identical
+            # across exact and stale
+            Bp = int(comm.bnd.shape[0]) if comm.bnd is not None else 0
+            has_act = activation is not None
 
-            state, _ = jax.lax.scan(body, state, keys)
-            values_p = jnp.argmin(
-                jnp.where(mask_p > 0, state[bel_idx], PAD_COST), axis=0
-            ).astype(jnp.int32)
-            return state, values_p
+            def run_n(state, keys, *args):
+                carry0 = state
+                if stale:
+                    pend0 = jnp.zeros(
+                        (self.n_shards, pg.D, Bp), jnp.float32
+                    )
+                    carry0 = (
+                        state[:4] + (pend0,) + state[4:] if has_act
+                        else state + (pend0,)
+                    )
+
+                def body(carry, k):
+                    carry = sharded(*carry, k, *args)
+                    return carry, None
+
+                carry, _ = jax.lax.scan(body, carry0, keys)
+                if stale:
+                    carry = (
+                        carry[:4] + carry[5:] if has_act
+                        else carry[:2]
+                    )
+                return carry
+
+            self._run_args = base_args
+            self._fin_args = (
+                jax.device_put(pg.mask_p, repl),
+                jax.device_put(sp.own_rows, shard0),
+            )
+
+            def fin(belv, mask_p, own):
+                vals = jnp.argmin(
+                    jnp.where(mask_p > 0, belv[0], PAD_COST), axis=0
+                ).astype(jnp.int32)
+                # owner-masked reconcile: one [Vp] int psum PER RUN —
+                # each column's value is read from the shard that owns
+                # its variable (boundary views agree; interior views
+                # are only correct on the owner)
+                return jax.lax.psum(
+                    jnp.where(own[0, 0] > 0, vals, 0), AXIS
+                )
+
+            self._finalize = jax.jit(shard_map(
+                fin, mesh=self.mesh,
+                in_specs=(P(AXIS), P(), P(AXIS)), out_specs=P(),
+                check_vma=False,
+            ))
+        else:
+            self._run_args = (
+                jax.device_put(pg.mask_p, repl), *base_args
+            )
+
+            def run_n(state, keys, mask_p, *args):
+                def body(carry, k):
+                    carry = sharded(*carry, k, *args)
+                    return carry, None
+
+                state, _ = jax.lax.scan(body, state, keys)
+                values_p = jnp.argmin(
+                    jnp.where(mask_p > 0, state[bel_idx], PAD_COST),
+                    axis=0,
+                ).astype(jnp.int32)
+                return state, values_p
 
         # donate the scan-state pytree (chunked/resumed runs feed the
         # previous chunk's output straight back in) — no-op'd on CPU
@@ -496,6 +1173,7 @@ class ShardedMaxSum:
         # state arguments, and XLA rejects the same buffer donated twice
         # (e.g. a shared zeros array for q and r, or the packed engine's
         # three message carries)
+        compact = self.comm.compact
         if self.packs is not None:
             sp = self.packs
             sharding = NamedSharding(self.mesh, P(AXIS, None, None))
@@ -508,9 +1186,16 @@ class ShardedMaxSum:
                     sharding,
                 )
 
-            bel0 = jax.device_put(
-                jnp.zeros((sp.D, sp.Vp), dtype=jnp.float32), repl
-            )
+            if compact:
+                # beliefs carried as per-shard VIEWS (ISSUE 5)
+                bel0 = jax.device_put(
+                    jnp.zeros((sp.n_shards, sp.D, sp.Vp),
+                              dtype=jnp.float32), sharding
+                )
+            else:
+                bel0 = jax.device_put(
+                    jnp.zeros((sp.D, sp.Vp), dtype=jnp.float32), repl
+                )
             if self.activation is None:
                 state = (z(), bel0)
                 return state, state
@@ -530,12 +1215,23 @@ class ShardedMaxSum:
 
         return z(), z()
 
+    @property
+    def _tuple_state(self) -> bool:
+        """True when the continuation state is a tuple pytree (the
+        packed engines; the generic engine carries plain message
+        arrays in every overlap mode — its beliefs view and halo
+        buffers live inside run_n)."""
+        return self.packs is not None
+
     def _state_leaf_shapes(self):
         """Expected continuation-state leaf shapes (one (q, r) half)."""
         if self.packs is not None:
             sp = self.packs
             z = (sp.n_shards, sp.D, sp.N)
-            bel = (sp.D, sp.Vp)
+            bel = (
+                (sp.n_shards, sp.D, sp.Vp) if self.comm.compact
+                else (sp.D, sp.Vp)
+            )
             if self.activation is None:
                 return [z, bel]
             return [z, z, z, bel, (2,)]  # + pending PRNG key
@@ -547,18 +1243,18 @@ class ShardedMaxSum:
         different engine/problem must fail loudly here, not be silently
         dropped (packed run() ignores ``r``) or crash deep in a kernel."""
         want = self._state_leaf_shapes()
+        tuple_state = self._tuple_state
         for name, s in (("q", q), ("r", r)):
             leaves = list(s) if isinstance(s, tuple) else [s]
             got = [tuple(jnp.shape(l)) for l in leaves]
-            if isinstance(s, tuple) == (self.packs is None):
+            if isinstance(s, tuple) != tuple_state:
                 raise ValueError(
                     f"continuation state mismatch: {name} is "
                     f"{'a tuple' if isinstance(s, tuple) else 'an array'}"
-                    f" but this solver's "
-                    f"{'packed' if self.packs is not None else 'generic'}"
-                    f" engine carries "
-                    f"{'a state tuple' if self.packs is not None else 'a message array'}"
-                    f" — was it produced by a different engine?"
+                    f" but this solver's engine carries "
+                    f"{'a state tuple' if tuple_state else 'a message array'}"
+                    f" — was it produced by a different engine or "
+                    f"overlap mode?"
                 )
             if got != [tuple(w) for w in want]:
                 raise ValueError(
@@ -652,12 +1348,22 @@ class ShardedMaxSum:
             jax.random.fold_in(jax.random.PRNGKey(seed), epoch), cycles
         )
         if self.packs is not None:
-            state, values = self._run_n(q, keys, *self._run_args)
+            if self.comm.compact:
+                state = self._run_n(q, keys, *self._run_args)
+                values = self._finalize(
+                    state[self._bel_idx], *self._fin_args
+                )
+            else:
+                state, values = self._run_n(q, keys, *self._run_args)
             values = (
                 np.asarray(values)[self._values_map] if host_values
                 else values[jnp.asarray(self._values_map)]
             )
             return values, state, state
+        if self.comm.compact:
+            q, r, belv = self._run_n(q, r, keys, *self._run_args)
+            values = self._finalize(belv, *self._fin_args)
+            return (np.asarray(values) if host_values else values), q, r
         q, r, values = self._run_n(q, r, keys, *self._run_args)
         return (np.asarray(values) if host_values else values), q, r
 
@@ -670,7 +1376,147 @@ def pairs(flat):
     return [tuple(flat[i : i + 2]) for i in range(0, len(flat), 2)]
 
 
-class ShardedLocalSearch:
+#: per-shard slot-table fan-in bound for the local-row gather reduce —
+#: above this the padded table wastes more than the scatter costs and
+#: the compact cycle keeps the global-row segment_sum
+_LOCAL_ROW_MAX_DEG = 64
+
+
+def _local_row_layout(st: ShardedFactorGraph, bnd_rows: np.ndarray):
+    """Shard-LOCAL row layout for the compact generic MaxSum cycle
+    (ISSUE 5 tentpole, "combine locally"): each shard reduces its
+    factor→var messages into a compact local row space with a padded
+    slot-table GATHER + ordered fold instead of a scatter-add over the
+    whole [V+1, D] variable space — on CPU meshes the XLA scatter is
+    the dominant cycle cost (~8x the vector work), and interior rows
+    never needed global alignment in the first place; only the [Bp, D]
+    boundary slab does, gathered per shard from its local rows.
+
+    The fold adds each variable's slots in ascending slot order — the
+    same order the scatter-add applies them — so the local partials
+    (and therefore the whole compact cycle) stay bit-identical to the
+    dense engine.  Returns None when a shard's max fan-in exceeds
+    :data:`_LOCAL_ROW_MAX_DEG` (callers keep the global-row path).
+
+    Arrays (stacked per shard, ready for ``P(AXIS)``):
+      gather_tbl [S, (L+1)*deg] — slot ids into [0, Es]; Es = zero pad
+      edge_loc   [S, Es]        — local row per slot (dummy row L)
+      unary_loc  [S, L+1, D]    — unary costs in local rows (dummy 0)
+      dmask_loc  [S, L+1, D]    — domain mask in local rows (dummy 0)
+      slab_loc   [S, Bp]        — local row of each boundary column
+                                  (dummy row L where untouched)
+      glob_loc   [S, L+1]       — local → global var id (dummy → V)
+    """
+    S, V = st.n_shards, st.n_vars
+    Es = st.edges_per_shard
+    if Es == 0:
+        return None
+    ev = np.asarray(st.edge_var).reshape(S, Es)
+    unary = np.asarray(st.unary)
+    dmask = np.asarray(st.base.domain_mask)
+    D = st.max_domain_size
+    locs, slots_per = [], []
+    deg_max = 0
+    for s in range(S):
+        real = np.flatnonzero(ev[s] < V)
+        gvars = np.unique(ev[s][real])
+        slots = {g: [] for g in gvars}
+        for e in real:
+            slots[int(ev[s][e])].append(int(e))
+        locs.append(gvars)
+        slots_per.append(slots)
+        if slots:
+            deg_max = max(deg_max, max(len(v) for v in slots.values()))
+    if deg_max == 0 or deg_max > _LOCAL_ROW_MAX_DEG:
+        return None
+    L = max(len(g) for g in locs)
+    gather_tbl = np.full((S, (L + 1) * deg_max), Es, dtype=np.int32)
+    edge_loc = np.full((S, Es), L, dtype=np.int32)
+    unary_loc = np.zeros((S, L + 1, D), dtype=np.float32)
+    dmask_loc = np.zeros((S, L + 1, D), dtype=np.float32)
+    glob_loc = np.full((S, L + 1), V, dtype=np.int32)
+    slab_loc = np.full((S, bnd_rows.shape[0]), L, dtype=np.int32)
+    for s in range(S):
+        loc_of = {int(g): i for i, g in enumerate(locs[s])}
+        for g, i in loc_of.items():
+            sl = slots_per[s][g]
+            gather_tbl[s, i * deg_max: i * deg_max + len(sl)] = sl
+            unary_loc[s, i] = unary[g]
+            dmask_loc[s, i] = dmask[g]
+            glob_loc[s, i] = g
+        for e in range(Es):
+            g = int(ev[s][e])
+            if g < V:
+                edge_loc[s, e] = loc_of[g]
+        for j, g in enumerate(np.asarray(bnd_rows).tolist()):
+            slab_loc[s, j] = loc_of.get(int(g), L)
+    return {
+        "deg": deg_max, "rows": L + 1,
+        "gather_tbl": jnp.asarray(gather_tbl),
+        "edge_loc": jnp.asarray(edge_loc),
+        "unary_loc": jnp.asarray(unary_loc),
+        "dmask_loc": jnp.asarray(dmask_loc),
+        "slab_loc": jnp.asarray(slab_loc),
+        "glob_loc": jnp.asarray(glob_loc),
+    }
+
+
+def _exchange_to_local(st: ShardedFactorGraph, lr: dict, comm: CommPlan):
+    """Translate the neighbor-exchange schedule's variable ids into
+    each shard's local rows (the local-row cycle exchanges slabs of its
+    compact row space).  Sent/received columns are always touched by
+    the shard in question, so the map is total; padding positions keep
+    pointing at a real (first shared) column and are masked by the
+    schedule's valid bits."""
+    glob = np.asarray(lr["glob_loc"])            # [S, rows]
+    S, rows = glob.shape
+    V = st.n_vars
+    loc_of = np.full((S, V + 1), rows - 1, dtype=np.int32)
+    for s in range(S):
+        loc_of[s, glob[s]] = np.arange(rows, dtype=np.int32)
+    send = np.asarray(comm.exch[0])
+    recv = np.asarray(comm.exch[1])
+    send_l = np.take_along_axis(
+        loc_of, send.reshape(S, -1), axis=1).reshape(send.shape)
+    recv_l = np.take_along_axis(
+        loc_of, recv.reshape(S, -1), axis=1).reshape(recv.shape)
+    return (jnp.asarray(send_l.astype(np.int32)),
+            jnp.asarray(recv_l.astype(np.int32)), comm.exch[2])
+
+
+def _neighbor_pair_blocks(st: ShardedFactorGraph):
+    """Per-shard directed neighbor pairs (src, dst) as shard-major
+    ``[S*P]`` arrays, from the sharded factor blocks themselves — the
+    operand of the boundary-compacted MGM-family arbitration.  A pair
+    (i, j) lives on every shard holding a factor that scopes both, so
+    per-shard ``segment_max`` partials over these pairs cover exactly
+    the neighbor gains that shard can see; dummy factors contribute
+    (V, V) pairs that land on the ignored phantom row.  Duplicated
+    pairs (multi-factor neighbors) are harmless under max/min."""
+    S, V = st.n_shards, st.n_vars
+    src_per = [[] for _ in range(S)]
+    dst_per = [[] for _ in range(S)]
+    for sb in st.buckets:
+        vi = np.asarray(sb.var_idx)
+        Fs, a = sb.factors_per_shard, sb.arity
+        for s in range(S):
+            blk = vi[s * Fs: (s + 1) * Fs]
+            for p in range(a):
+                for q in range(a):
+                    if p != q:
+                        src_per[s].append(blk[:, p])
+                        dst_per[s].append(blk[:, q])
+    if not src_per[0]:
+        z = np.zeros((0,), dtype=np.int32)
+        src = np.stack([z] * S) if S else z.reshape(0, 0)
+        return src.reshape(-1), src.reshape(-1)
+    src = np.stack([np.concatenate(x) for x in src_per])
+    dst = np.stack([np.concatenate(x) for x in dst_per])
+    return (src.reshape(-1).astype(np.int32),
+            dst.reshape(-1).astype(np.int32))
+
+
+class ShardedLocalSearch(_CommPlanMixin):
     """Local-search family over a device mesh (MGM / DSA / ADSA / DBA /
     GDBA move rules).
 
@@ -701,7 +1547,10 @@ class ShardedLocalSearch:
     def __init__(self, tensors, mesh: Optional[Mesh] = None,
                  rule: str = "mgm", probability: float = 0.7,
                  algo_params: Optional[dict] = None,
-                 use_packed: Optional[bool] = None):
+                 use_packed: Optional[bool] = None,
+                 overlap: Optional[str] = None,
+                 boundary_threshold: float = 0.5,
+                 exchange: Optional[bool] = None):
         from pydcop_tpu.ops.compile import ConstraintGraphTensors
 
         assert isinstance(tensors, ConstraintGraphTensors), (
@@ -735,7 +1584,21 @@ class ShardedLocalSearch:
             shard_factor_graph(tensors, self.n_shards)
             if self.packs is None else None
         )
+        # MGM-family arbitration exchanges 1-row partials: the packed
+        # engine's pmax/pmin pair exists in dense mode too; the generic
+        # engine arbitrates replicated in dense mode (0 collectives)
+        # and gains the compact pair only when compacted
+        arb = 2 if rule in ("mgm", "dba", "gdba") else 0
+        self.comm = self._make_comm_plan(
+            overlap, boundary_threshold, exchange,
+            extra_dense=(arb if self.packs is not None else 0),
+            extra_compact=arb,
+        )
+        _announce_comm(self.comm, self.n_shards,
+                       engine=f"local_search:{rule}",
+                       packed=self.packs is not None)
         self._run_n = None
+        self._finalize = None
 
     def _tables_block(self, x, bucket_blocks, tensor_blocks=None,
                       weight_blocks=None):
@@ -883,13 +1746,18 @@ class ShardedLocalSearch:
         base = self.base
         sp = self.packs
         V = base.n_vars
+        comm = self.comm
+        compact, stale = comm.compact, comm.mode == "stale"
         # sharded operands must be explicit jit arguments with committed
         # shardings (multi-process meshes reject closure constants
         # spanning non-addressable devices) — same rule as ShardedMaxSum
         shard0 = NamedSharding(self.mesh, P(AXIS))
         repl = NamedSharding(self.mesh, P())
         bucket_args = []
-        in_specs = [P(), P(), P(AXIS)]  # x, key, aux (pytree prefix)
+        # x (a per-shard VIEW row in the compact modes), key, aux
+        in_specs = [P(AXIS) if compact else P(), P(), P(AXIS)]
+        if stale:
+            in_specs.append(P(AXIS))  # pending boundary slab
         if sp is not None:
             # lane-packed per-shard tables (ops/pallas_sharded):
             # cost arrays + 5 plan const arrays (+ mixed-arity extras).
@@ -945,10 +1813,81 @@ class ShardedLocalSearch:
             ]
             in_specs.extend([P(AXIS)] * len(extras))
             n_buckets = len(st.buckets)
+        # boundary-compaction operands ride LAST (ISSUE 5): the generic
+        # MGM-family arbitration needs per-shard directed neighbor-pair
+        # blocks (its partial neighborhood max/tie-break replaces the
+        # replicated neighborhood_winner, combined by ONE compact
+        # pmax/pmin pair), and every compact mode needs either the
+        # boundary index vector or the neighbor-exchange schedule
+        pair_args = []
+        if compact and sp is None and self.rule in ("mgm", "dba",
+                                                    "gdba"):
+            src_p, dst_p = _neighbor_pair_blocks(st)
+            pair_args = [
+                jax.device_put(jnp.asarray(src_p), shard0),
+                jax.device_put(jnp.asarray(dst_p), shard0),
+            ]
+            in_specs.extend([P(AXIS), P(AXIS)])
+        comm_args = []
+        if compact and comm.collective == "ppermute":
+            comm_args = [jax.device_put(a, shard0) for a in comm.exch]
+            in_specs.extend([P(AXIS)] * 3)
+        elif compact and comm.collective != "none":
+            comm_args = [jax.device_put(comm.bnd, repl)]
+            in_specs.append(P())
+        n_pair, n_comm = len(pair_args), len(comm_args)
         self._bucket_args = bucket_args
-        self._extra_args = extras
+        self._extra_args = extras + pair_args + comm_args
 
-        def packed_cycle_fn(x, key, aux, *rest):
+        def _split_tail(rest):
+            """(main operands, comm tail) — comm operands ride last."""
+            if not n_comm:
+                return rest, ()
+            return rest[:len(rest) - n_comm], rest[len(rest) - n_comm:]
+
+        def _exch_blocks(tail):
+            return tuple(t[0] for t in tail)
+
+        def _combine_tables(bel, tail, pend, axis):
+            """(partial tables with cross-shard totals at the boundary,
+            next pending slab) — the ONE collective of a compact cycle
+            (dense keeps the full psum)."""
+            if not compact:
+                return jax.lax.psum(bel, AXIS), None
+            if comm.collective == "none":
+                return bel, None
+            if stale:
+                bnd = tail[0]
+                tot = jax.lax.psum(pend, AXIS)
+                if axis == 1:
+                    return (bel.at[:, bnd].set(tot),
+                            jnp.take(bel, bnd, axis=1))
+                return bel.at[bnd].set(tot), jnp.take(bel, bnd, axis=0)
+            if comm.collective == "ppermute":
+                return _combine_boundary(
+                    bel, comm, None, axis=axis, op="sum",
+                    exch_blocks=_exch_blocks(tail),
+                ), None
+            return _combine_boundary(bel, comm, tail[0], axis=axis), None
+
+        def _combine_arb(part, tail, op, axis):
+            """MGM-family arbitration combine: dense pmax/pmin over the
+            whole row vs boundary-compacted (always synchronous — gains
+            are this cycle's even in stale mode)."""
+            if not compact:
+                return (jax.lax.pmax if op == "max"
+                        else jax.lax.pmin)(part, AXIS)
+            if comm.collective == "none":
+                return part
+            if comm.collective == "ppermute":
+                return _combine_boundary(
+                    part, comm, None, axis=axis, op=op,
+                    exch_blocks=_exch_blocks(tail),
+                )
+            return _combine_boundary(part, comm, tail[0], axis=axis,
+                                     op=op)
+
+        def packed_cycle_fn(x, key, aux, pend, *rest):
             """One lane-packed sharded cycle: ``x`` is the [1, Vp]
             packed assignment row (replicated), and every per-cycle step
             — tables, gains, move coins, MGM arbitration — runs in
@@ -972,6 +1911,9 @@ class ShardedLocalSearch:
                 packed_shard_tables,
             )
 
+            rest, tail = _split_tail(rest)
+            if compact:
+                x = x[0]  # [S, 1, Vp] view block → this shard's row
             pg = sp.pg0
             nc = 1 if sp.mixed else sp.D
             cost = (
@@ -987,10 +1929,10 @@ class ShardedLocalSearch:
             gmask1 = rest[i + 4][0]
             i += 5
             bel = packed_shard_tables(pg, x, cost, consts, mixed=mx)
-            # the ONE psum of the cycle: columns align across shards
-            tables = jnp.where(
-                mask_p > 0, unary_p + jax.lax.psum(bel, AXIS), PAD_COST
-            )
+            # the ONE collective of the cycle: columns align across
+            # shards; compact modes carry only the [D, Bp] boundary slab
+            bel, pend2 = _combine_tables(bel, tail, pend, axis=1)
+            tables = jnp.where(mask_p > 0, unary_p + bel, PAD_COST)
             cur, best_idx, gain = _cur_best_gain(
                 pg, tables, x, self.rule in ("dsa", "adsa")
             )
@@ -1052,22 +1994,37 @@ class ShardedLocalSearch:
                     gn3 = routed[j]
                 # the pmax/pmin PAIR: cross-shard neighborhood max,
                 # then min neighbor index at the max (lexic tie-break)
+                # — compacted to the boundary columns with the tables
                 neigh_max = jnp.maximum(
-                    jax.lax.pmax(nm_part, AXIS), 0.0
+                    _combine_arb(nm_part, tail, "max", axis=1), 0.0
                 )
                 nm_exp = _bucket_expand(pg, neigh_max, 1)
                 idx_part = _tiebreak_idx_partial(
                     pg, nm_exp, gn, mate, gn2, mate2, gn3, mate3
                 )
-                idx_at_max = jax.lax.pmin(idx_part, AXIS)
+                idx_at_max = _combine_arb(idx_part, tail, "min", axis=1)
                 move = _mgm_decision(gain, idx_row, neigh_max,
                                      idx_at_max)
             x2 = jnp.where(move & (colmask > 0), best_idx, x)
-            return x2, aux
+            if compact:
+                out = (x2[None], aux)
+            else:
+                out = (x2, aux)
+            if stale:
+                out += (pend2[None],)
+            return out
 
         def cycle_fn(x, key, aux, *rest):
+            pend = None
+            if stale:
+                pend, rest = rest[0][0], rest[1:]
             if sp is not None:
-                return packed_cycle_fn(x, key, aux, *rest)
+                return packed_cycle_fn(x, key, aux, pend, *rest)
+            rest, tail = _split_tail(rest)
+            pair_blk = rest[len(rest) - n_pair:] if n_pair else ()
+            rest = rest[:len(rest) - n_pair] if n_pair else rest
+            if compact:
+                x = x[0]  # [S, V] view block → this shard's assignment
             include_unary = True
             bucket_blocks = pairs(rest[: 2 * n_buckets])
             extra_blocks = rest[2 * n_buckets:]
@@ -1082,7 +2039,9 @@ class ShardedLocalSearch:
             partial = self._tables_block(
                 x, bucket_blocks, tensor_blocks, weight_blocks
             )
-            total = jax.lax.psum(partial, AXIS)[:V]
+            total_ext, pend2 = _combine_tables(partial, tail, pend,
+                                               axis=0)
+            total = total_ext[:V]
             unary = base.unary_costs if include_unary else 0.0
             tables = jnp.where(
                 base.domain_mask > 0,
@@ -1123,35 +2082,112 @@ class ShardedLocalSearch:
                 else:
                     want = improving | lateral
                 move = want & activate & awake
-            else:  # mgm-style arbitration (also dba/gdba)
+            elif not compact:  # mgm-style arbitration (also dba/gdba)
                 move = neighborhood_winner(base, gain)
+            else:
+                # boundary-compacted arbitration: per-shard partial
+                # neighborhood max / tie-break index over THIS shard's
+                # directed factor pairs, combined by one compact
+                # pmax/pmin pair — neighborhood_winner semantics
+                # exactly (interior variables' partials are already
+                # complete on their owner; only boundary rows cross)
+                from pydcop_tpu.ops.segments import (
+                    segment_max,
+                    segment_min,
+                )
+
+                src_blk, dst_blk = pair_blk
+                gain_ext = jnp.concatenate(
+                    [gain, jnp.zeros(1, gain.dtype)]
+                )
+                nm_part = segment_max(
+                    gain_ext[src_blk], dst_blk, V + 1
+                )
+                neigh_max = jnp.maximum(
+                    _combine_arb(nm_part, tail, "max", axis=0)[:V], 0.0
+                )
+                nm_ext = jnp.concatenate(
+                    [neigh_max, jnp.zeros(1, neigh_max.dtype)]
+                )
+                at_max = gain_ext[src_blk] >= nm_ext[dst_blk] - 1e-9
+                idx_part = segment_min(
+                    jnp.where(at_max, src_blk, V), dst_blk, V + 1
+                )
+                idx_at_max = _combine_arb(
+                    idx_part, tail, "min", axis=0
+                )[:V]
+                me = jnp.arange(V)
+                move = (gain > 0) & (
+                    (gain > neigh_max + 1e-9)
+                    | ((jnp.abs(gain - neigh_max) <= 1e-9)
+                       & (me < idx_at_max))
+                )
             x2 = jnp.where(move, best_val, x).astype(jnp.int32)
             if self.rule == "dba":
-                qlm = self._quasi_local_minimum(gain) & (cur > 1e-9)
+                if compact:
+                    qlm = (jnp.maximum(gain, neigh_max) <= 1e-9) & (
+                        cur > 1e-9
+                    )
+                else:
+                    qlm = self._quasi_local_minimum(gain) & (cur > 1e-9)
                 aux = self._dba_update(x, qlm, aux, bucket_blocks,
                                        extra_blocks)
             elif self.rule == "gdba":
-                stuck = self._quasi_local_minimum(gain)
+                stuck = (
+                    jnp.maximum(gain, neigh_max) <= 1e-9 if compact
+                    else self._quasi_local_minimum(gain)
+                )
                 aux = self._gdba_update(x, stuck, aux, bucket_blocks,
                                         extra_blocks)
-            return x2, aux
+            out = (x2[None], aux) if compact else (x2, aux)
+            if stale:
+                out += (pend2[None],)
+            return out
 
+        x_spec = P(AXIS) if compact else P()
+        out_specs = (x_spec, P(AXIS))
+        if stale:
+            out_specs += (P(AXIS),)
         sharded = shard_map(
             cycle_fn,
             mesh=self.mesh,
             in_specs=tuple(in_specs),
-            out_specs=(P(), P(AXIS)),
+            out_specs=out_specs,
             check_vma=False,
         )
 
-        def run_n(x, keys, aux, *rest):
-            def body(carry, k):
-                x, aux = carry
-                x2, aux2 = sharded(x, k, aux, *rest)
-                return (x2, aux2), ()
+        if stale:
+            # the pending boundary slab starts at zero each run (LS
+            # runs are never continued mid-stream), so cycle 1's
+            # boundary tables see unary-only halos — the documented
+            # staleness-1 start-up transient
+            if sp is not None:
+                pend_shape = (self.n_shards, sp.D,
+                              int(comm.bnd.shape[0]))
+            else:
+                pend_shape = (self.n_shards, int(comm.bnd.shape[0]),
+                              st.max_domain_size)
 
-            (x, aux), _ = jax.lax.scan(body, (x, aux), keys)
-            return x, aux
+            def run_n(x, keys, aux, *rest):
+                def body(carry, k):
+                    x, aux, pend = carry
+                    x2, aux2, pend2 = sharded(x, k, aux, pend, *rest)
+                    return (x2, aux2, pend2), ()
+
+                pend0 = jnp.zeros(pend_shape, jnp.float32)
+                (x, aux, _p), _ = jax.lax.scan(
+                    body, (x, aux, pend0), keys
+                )
+                return x, aux
+        else:
+            def run_n(x, keys, aux, *rest):
+                def body(carry, k):
+                    x, aux = carry
+                    x2, aux2 = sharded(x, k, aux, *rest)
+                    return (x2, aux2), ()
+
+                (x, aux), _ = jax.lax.scan(body, (x, aux), keys)
+                return x, aux
 
         # donate the assignment row and the breakout weight state (the
         # bulky gdba per-entry tensors in particular) — no-op'd on CPU
@@ -1159,6 +2195,23 @@ class ShardedLocalSearch:
             run_n,
             donate_argnums=(0, 2) if donation_supported() else (),
         )
+        if compact:
+            own_src = sp.own_rows if sp is not None else st.own_rows
+            self._own_arg = jax.device_put(own_src, shard0)
+            zero = jnp.float32(0.0) if sp is not None else jnp.int32(0)
+
+            def fin(xv, own):
+                # owner-masked reconcile of the per-shard assignment
+                # views: ONE small psum per run, not per cycle
+                return jax.lax.psum(
+                    jnp.where(own[0] > 0, xv[0], zero), AXIS
+                )
+
+            self._finalize = jax.jit(shard_map(
+                fin, mesh=self.mesh,
+                in_specs=(P(AXIS), P(AXIS)), out_specs=P(),
+                check_vma=False,
+            ))
 
     def run(self, cycles: int = 20, seed: int = 0):
         """Returns the final value indices [V].
@@ -1173,6 +2226,7 @@ class ShardedLocalSearch:
 
         x0 = random_valid_values(self.base, jax.random.PRNGKey(seed + 17))
         keys = jax.random.split(jax.random.PRNGKey(seed), cycles)
+        compact = self.comm.compact
         if self.packs is not None:
             sp = self.packs
             vorder = np.asarray(sp.pg0.var_order)
@@ -1180,11 +2234,29 @@ class ShardedLocalSearch:
                 jnp.zeros((1, sp.Vp), jnp.float32)
                 .at[0, vorder].set(x0.astype(jnp.float32))
             )
+            if compact:
+                # compact modes carry the assignment as per-shard VIEWS
+                x_row = jax.device_put(
+                    jnp.broadcast_to(x_row, (self.n_shards, 1, sp.Vp)),
+                    NamedSharding(self.mesh, P(AXIS, None, None)),
+                )
             x_row, _aux = self._run_n(
                 x_row, keys, self.initial_aux(), *self._bucket_args,
                 *self._extra_args,
             )
+            if compact:
+                x_row = self._finalize(x_row, self._own_arg)
             return np.asarray(x_row)[0, vorder].astype(np.int32)
+        if compact:
+            xv = jax.device_put(
+                jnp.broadcast_to(x0, (self.n_shards, x0.shape[0])),
+                NamedSharding(self.mesh, P(AXIS, None)),
+            )
+            xv, _aux = self._run_n(
+                xv, keys, self.initial_aux(), *self._bucket_args,
+                *self._extra_args,
+            )
+            return np.asarray(self._finalize(xv, self._own_arg))
         x, _aux = self._run_n(
             x0, keys, self.initial_aux(), *self._bucket_args,
             *self._extra_args,

@@ -78,13 +78,19 @@ def run_multihost_maxsum(dcop, cycles: int = 15, damping: float = 0.5,
                          activation: Optional[float] = None,
                          seed: int = 0,
                          use_packed: Optional[bool] = None,
+                         overlap: Optional[str] = None,
+                         boundary_threshold: float = 0.5,
                          info: Optional[dict] = None):
     """Solve `dcop` with MaxSum sharded over the global multi-process
     mesh.  Returns (values, n_global_devices, tensors).  Every process
     must call this with an identical dcop (SPMD).  ``activation`` < 1
     runs the amaxsum emulation (per-edge activation masks,
     ShardedMaxSum); ``seed`` drives its activation PRNG and must be
-    identical on all ranks."""
+    identical on all ranks.  ``overlap`` mirrors ``use_packed``
+    plumbing for the boundary-compacted collective path (off / exact /
+    stale; default auto by cut fraction vs ``boundary_threshold``) —
+    identical on all ranks, the plan is derived deterministically from
+    the shared partition."""
     from pydcop_tpu.ops.compile import compile_factor_graph
     from pydcop_tpu.parallel.mesh import ShardedMaxSum
 
@@ -92,11 +98,15 @@ def run_multihost_maxsum(dcop, cycles: int = 15, damping: float = 0.5,
     mesh = global_mesh()
     sharded = ShardedMaxSum(tensors, mesh, damping=damping,
                             activation=activation,
-                            use_packed=use_packed)
+                            use_packed=use_packed,
+                            overlap=overlap,
+                            boundary_threshold=boundary_threshold)
     if info is not None:
         # which engine actually ran: use_packed=True is a REQUEST — the
-        # packer can decline (scope/VMEM) and fall back to generic
+        # packer can decline (scope/VMEM) and fall back to generic;
+        # likewise the overlap auto-policy may keep the dense psum
         info["packed"] = sharded.packs is not None
+        info["shard"] = sharded.comm_stats()
     values, _q, _r = sharded.run(cycles=cycles, seed=seed)
     return values, mesh.devices.size, tensors
 
@@ -108,11 +118,14 @@ def run_multihost_maxsum_resumable(
     activation: Optional[float] = None,
     seed: int = 0,
     use_packed: Optional[bool] = None,
+    overlap: Optional[str] = None,
+    boundary_threshold: float = 0.5,
     chunk: int = 5,
     start_cycle: int = 0,
     state=None,
     epoch: int = 0,
     on_chunk=None,
+    info: Optional[dict] = None,
 ):
     """Crash-resilient variant of :func:`run_multihost_maxsum`: the
     solve advances in ``chunk``-cycle pieces, calling
@@ -133,7 +146,12 @@ def run_multihost_maxsum_resumable(
     mesh = global_mesh()
     sharded = ShardedMaxSum(tensors, mesh, damping=damping,
                             activation=activation,
-                            use_packed=use_packed)
+                            use_packed=use_packed,
+                            overlap=overlap,
+                            boundary_threshold=boundary_threshold)
+    if info is not None:
+        info["packed"] = sharded.packs is not None
+        info["shard"] = sharded.comm_stats()
     q = r = None
     done = 0
     if state is not None:
@@ -164,6 +182,8 @@ def run_multihost_local_search(dcop, rule: str = "mgm", cycles: int = 15,
                                seed: int = 0,
                                algo_params: Optional[dict] = None,
                                use_packed: Optional[bool] = None,
+                               overlap: Optional[str] = None,
+                               boundary_threshold: float = 0.5,
                                info: Optional[dict] = None):
     """Solve `dcop` with a local-search rule (mgm / dsa / adsa / dba /
     gdba) sharded over the global multi-process mesh.  Returns
@@ -187,9 +207,12 @@ def run_multihost_local_search(dcop, rule: str = "mgm", cycles: int = 15,
         probability=float(params.get("probability", 0.7)),
         algo_params=params,
         use_packed=use_packed,
+        overlap=overlap,
+        boundary_threshold=boundary_threshold,
     )
     if info is not None:
         info["packed"] = sharded.packs is not None
+        info["shard"] = sharded.comm_stats()
     values = sharded.run(cycles=cycles, seed=seed)
     return values, mesh.devices.size, tensors
 
@@ -217,6 +240,13 @@ def main(argv=None) -> int:
                     "(maxsum/amaxsum and the mgm/dsa/adsa move rules; "
                     "default: platform auto — packed on TPU shards, "
                     "generic elsewhere)")
+    ap.add_argument("--shard-overlap",
+                    choices=["off", "exact", "stale"], default=None,
+                    help="boundary-compacted collective path (must be "
+                    "identical on all ranks); default: auto by cut "
+                    "fraction")
+    ap.add_argument("--shard-boundary-threshold", type=float,
+                    default=0.5)
     args = ap.parse_args(argv)
 
     init_multihost(
@@ -242,12 +272,18 @@ def main(argv=None) -> int:
         info: dict = {}
         values, n_devices, _tensors = run_multihost_maxsum(
             dcop, cycles=args.cycles, activation=activation,
-            use_packed=True if args.packed else None, info=info)
+            use_packed=True if args.packed else None,
+            overlap=args.shard_overlap,
+            boundary_threshold=args.shard_boundary_threshold,
+            info=info)
     else:
         info = {}
         values, n_devices, _tensors = run_multihost_local_search(
             dcop, rule=args.algo, cycles=args.cycles,
-            use_packed=True if args.packed else None, info=info)
+            use_packed=True if args.packed else None,
+            overlap=args.shard_overlap,
+            boundary_threshold=args.shard_boundary_threshold,
+            info=info)
     import numpy as np
 
     out = {
@@ -257,6 +293,10 @@ def main(argv=None) -> int:
         "n_values": int(len(values)),
     }
     out["packed"] = bool(info.get("packed", False))
+    shard = info.get("shard")
+    if shard:
+        out["shard_comm_mode"] = shard["mode"]
+        out["shard_collective"] = shard["collective"]
     print(json.dumps(out), flush=True)
     return 0
 

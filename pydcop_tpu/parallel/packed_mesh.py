@@ -50,6 +50,12 @@ from pydcop_tpu.ops.pallas_maxsum import (
     pack_mixed_for_pallas,
 )
 from pydcop_tpu.ops.pallas_permute import _plan_consts
+from pydcop_tpu.parallel.boundary import (
+    BoundaryInfo,
+    analyze_boundary,
+    build_exchange_plan,
+    padded_boundary_idx,
+)
 from pydcop_tpu.parallel.partition import partition_factors
 
 
@@ -99,6 +105,24 @@ class StackedShardPack:
     gmask1: Optional[jnp.ndarray] = None       # [S, 1, N]
     mate2_idx: Optional[jnp.ndarray] = None    # [S, 1, N] (plan2 only)
     mate3_idx: Optional[jnp.ndarray] = None    # [S, 1, N] (plan3 only)
+    # --- boundary-compacted collective data (ISSUE 5 tentpole): built
+    # from the SAME partition analysis that partition_stats reports, so
+    # the compact slab and the observability numbers cannot drift.
+    # ``bnd_cols`` are the packed COLUMN ids of the boundary variables
+    # (padded to a lane multiple with repeats — duplicate scatter
+    # positions all carry the identical combined value); ``own_rows``
+    # marks, per shard, the columns whose variable it OWNS (covers every
+    # real column exactly once) — the owner-masked reconcile of per-
+    # shard belief views.  The exch_* arrays are the column-space
+    # neighbor-exchange schedule when the cut is pairwise (see
+    # parallel/boundary.build_exchange_plan), else None.
+    boundary: Optional[BoundaryInfo] = None
+    bnd_cols: Optional[jnp.ndarray] = None     # [Bp] int32 column ids
+    own_rows: Optional[jnp.ndarray] = None     # [S, 1, Vp] float32
+    exch_send: Optional[jnp.ndarray] = None    # [S, R, Bpair] int32 cols
+    exch_recv: Optional[jnp.ndarray] = None    # [S, R, Bpair] int32 cols
+    exch_valid: Optional[jnp.ndarray] = None   # [S, R, Bpair] float32
+    exch_rounds: Optional[list] = None         # static ppermute perms
 
     @property
     def D(self) -> int:
@@ -211,8 +235,49 @@ def build_shard_packs(
         consts=[
             jnp.stack([cp[i] for cp in consts_per]) for i in range(5)
         ],
+        **_boundary_fields([vi], [assign], V, n_shards, var_pcol, Vp),
         **_stacked_move_extras(packs),
     )
+
+
+def _boundary_fields(
+    var_idx_per_bucket: List[np.ndarray],
+    assigns: List[np.ndarray],
+    n_vars: int,
+    n_shards: int,
+    var_pcol: np.ndarray,
+    Vp: int,
+) -> dict:
+    """Boundary-compacted collective data in packed COLUMN space, from
+    the shared partition analysis (parallel/boundary) — the StackedShard
+    Pack fields the compact sharded engines consume."""
+    info = analyze_boundary(
+        var_idx_per_bucket, assigns, n_vars, n_shards
+    )
+    own = np.zeros((n_shards, 1, Vp), dtype=np.float32)
+    cols_of = np.asarray(var_pcol, dtype=np.int64)
+    own[info.owner, 0, cols_of[np.arange(n_vars)]] = 1.0
+    bnd_vars = padded_boundary_idx(info, quantum=_LANES)
+    out = {
+        "boundary": info,
+        "bnd_cols": jnp.asarray(
+            cols_of[bnd_vars].astype(np.int32)
+        ) if bnd_vars.size else jnp.zeros(0, jnp.int32),
+        "own_rows": jnp.asarray(own),
+    }
+    plan = build_exchange_plan(
+        info, var_idx_per_bucket, assigns
+    )
+    if plan is not None:
+        out.update(
+            exch_send=jnp.asarray(
+                cols_of[plan.send_idx].astype(np.int32)),
+            exch_recv=jnp.asarray(
+                cols_of[plan.recv_idx].astype(np.int32)),
+            exch_valid=jnp.asarray(plan.recv_valid),
+            exch_rounds=plan.rounds,
+        )
+    return out
 
 
 def _stacked_move_extras(packs: List[PackedMaxSumGraph]) -> dict:
@@ -382,6 +447,10 @@ def _build_mixed_shard_packs(
         consts3=(
             [jnp.stack([cp[i] for cp in consts3_per]) for i in range(5)]
             if consts3_per is not None else None
+        ),
+        **_boundary_fields(
+            [np.asarray(b.var_idx) for b in buckets], assigns, V,
+            n_shards, layout.var_pcol, pg0.Vp,
         ),
         **_stacked_move_extras(packs),
     )

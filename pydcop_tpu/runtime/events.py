@@ -71,6 +71,21 @@ def send_batch(event: str, payload) -> None:
     event_bus.send(BATCH_TOPIC_PREFIX + event, payload)
 
 
+#: sharded-collective topic prefix (parallel/mesh).  Topics:
+#: ``shard.comm.selected`` (mode, collective, cut_fraction,
+#: boundary_columns, bytes_per_cycle_dense/compact, exchange_rounds —
+#: the engine's chosen collective path, emitted once at build time) —
+#: subscribe with ``shard.*`` (the UI server pushes them to ws/SSE
+#: clients alongside ``harness.*``/``batch.*``).
+SHARD_TOPIC_PREFIX = "shard."
+
+
+def send_shard(event: str, payload) -> None:
+    """Publish a sharded-engine collective/partition event on the
+    global bus (no-op unless observability is enabled)."""
+    event_bus.send(SHARD_TOPIC_PREFIX + event, payload)
+
+
 #: solve-harness topic prefix (algorithms/base).  Topics:
 #: ``harness.run.done`` (algo, status, cycle + the HarnessCounters
 #: scorecard: host_sync_count, dispatch_wait_s, donated_chunks,

@@ -41,8 +41,20 @@ def solve_result(
     checkpoint_every: Optional[int] = None,
     resume: bool = False,
     pipeline: bool = False,
+    shard_overlap: Optional[str] = None,
+    shard_boundary_threshold: float = 0.5,
 ) -> SolveResult:
     """Solve a DCOP and return the full result + metrics.
+
+    ``shard_overlap`` selects the sharded engines' collective path on
+    the placement-driven (multi-device) path: ``off`` keeps the dense
+    whole-space psum, ``exact`` compacts the collective to the
+    partition's boundary columns (bit-identical), ``stale``
+    double-buffers the boundary exchange (staleness-1 halo); the
+    default auto-policy compacts when the partition's cut fraction is
+    under ``shard_boundary_threshold`` (docs/performance.rst,
+    "Boundary-compacted sharding").  The chosen path is recorded in
+    ``metrics()['shard']``.
 
     ``pipeline=True`` enables the harness's pipelined chunk dispatch
     for converging (open-ended) runs: the next chunk launches before
@@ -80,7 +92,9 @@ def solve_result(
         # placement-driven path compiles straight from the dcop; don't
         # build the computation graph it would never read
         return _solve_under_placement(
-            dcop, algo_def, distribution, cycles, timeout, collect_cycles
+            dcop, algo_def, distribution, cycles, timeout,
+            collect_cycles, shard_overlap=shard_overlap,
+            shard_boundary_threshold=shard_boundary_threshold,
         )
 
     graph_type = graph or algo_module.GRAPH_TYPE
@@ -188,6 +202,8 @@ def _solve_under_placement(
     cycles: Optional[int],
     timeout: Optional[float],
     collect_cycles: bool = False,
+    shard_overlap: Optional[str] = None,
+    shard_boundary_threshold: float = 0.5,
 ) -> SolveResult:
     """Run a solve whose device sharding is driven by an explicit
     placement (Distribution object).  Supported for the factor-graph BP
@@ -232,7 +248,9 @@ def _solve_under_placement(
             algo_def.params.get("activation", DEFAULT_ACTIVATION)
         )
     sharded = ShardedMaxSum(tensors, mesh, damping=damping,
-                            assigns=assigns, activation=activation)
+                            assigns=assigns, activation=activation,
+                            overlap=shard_overlap,
+                            boundary_threshold=shard_boundary_threshold)
     n_cycles = cycles or 30
     status = "FINISHED"
     history = []
@@ -282,6 +300,7 @@ def _solve_under_placement(
         ),
         time=perf_counter() - t0,
         history=history or None,
+        shard=sharded.comm_stats(),
     )
 
 

@@ -11,6 +11,7 @@ per-variable updates of a cycle are concurrent on device.
 from __future__ import annotations
 
 import csv
+import dataclasses
 from typing import List, Optional
 
 #: matches the reference's column set (stats.py:50-66)
@@ -152,6 +153,62 @@ class HarnessCounters:
         out = dict(self.counts)
         out["dispatch_wait_s"] = round(out["dispatch_wait_s"], 6)
         return out
+
+
+#: field names surfaced under ``SolveResult.metrics()["shard"]`` and the
+#: ``shard.comm.selected`` event by the sharded engines (parallel/mesh
+#: CommPlan.counters) — the partition-quality + collective-path
+#: scorecard of a multi-device solve
+SHARD_COMM_FIELDS = (
+    "mode",                      # dense | compact-exact | compact-stale
+    "collective",                # psum | ppermute | none
+    "n_shards",
+    "boundary_columns",          # compact slab width (real boundary)
+    "total_columns",             # dense collective width
+    "cut_fraction",              # boundary / factor-touched variables
+    "boundary_fraction",         # boundary / all variables
+    "bytes_per_cycle_dense",     # per-shard collective payload, dense
+    "bytes_per_cycle_compact",   # per-shard payload on the chosen path
+    "exchange_rounds",           # ppermute rounds (0 unless ppermute)
+    "threshold",                 # auto-policy cut-fraction threshold
+)
+
+
+@dataclasses.dataclass
+class ShardCommCounters:
+    """Partition quality + per-cycle collective cost of a sharded
+    engine (ISSUE 5): which collective path the boundary-compaction
+    auto-policy chose and what it pays per cycle vs the dense psum.
+    Built by parallel/mesh.CommPlan.counters; surfaced as
+    ``SolveResult.metrics()['shard']`` and the ``shard.comm.selected``
+    event."""
+
+    mode: str
+    collective: str
+    n_shards: int
+    boundary_columns: int
+    total_columns: int
+    cut_fraction: float
+    boundary_fraction: float
+    bytes_per_cycle_dense: int
+    bytes_per_cycle_compact: int
+    exchange_rounds: int = 0
+    threshold: float = 0.5
+
+    def as_dict(self) -> dict:
+        out = dataclasses.asdict(self)
+        out["cut_fraction"] = round(out["cut_fraction"], 6)
+        out["boundary_fraction"] = round(out["boundary_fraction"], 6)
+        return out
+
+    @property
+    def compact_savings(self) -> float:
+        """Fraction of dense collective bytes the chosen path avoids."""
+        if not self.bytes_per_cycle_dense:
+            return 0.0
+        return 1.0 - (
+            self.bytes_per_cycle_compact / self.bytes_per_cycle_dense
+        )
 
 
 class StatsLogger:

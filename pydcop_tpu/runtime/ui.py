@@ -52,6 +52,7 @@ class UiServer:
         event_bus.subscribe("faults.*", self._cb_fault)
         event_bus.subscribe("batch.*", self._cb_batch)
         event_bus.subscribe("harness.*", self._cb_harness)
+        event_bus.subscribe("shard.*", self._cb_shard)
 
     # -- event plumbing -----------------------------------------------------
 
@@ -195,6 +196,20 @@ class UiServer:
         if self._ws is not None:
             self._ws.send_all(json.dumps(
                 {"evt": "harness",
+                 "kind": topic.split(".", 1)[-1],
+                 "data": evt if isinstance(evt, (dict, list, str, int,
+                                                 float, bool, type(None)))
+                 else repr(evt)}))
+
+    def _cb_shard(self, topic: str, evt) -> None:
+        """Sharded-engine collective/partition lifecycle
+        (shard.comm.selected with the ShardCommCounters partition-
+        quality scorecard) pushed to GUI clients; the SSE /events
+        stream gets them through the wildcard subscription like every
+        topic."""
+        if self._ws is not None:
+            self._ws.send_all(json.dumps(
+                {"evt": "shard",
                  "kind": topic.split(".", 1)[-1],
                  "data": evt if isinstance(evt, (dict, list, str, int,
                                                  float, bool, type(None)))

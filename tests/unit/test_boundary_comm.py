@@ -1,0 +1,516 @@
+"""Boundary-compacted collectives + comm/compute overlap (ISSUE 5).
+
+The sharded engines' per-cycle collective must carry only the
+partition's BOUNDARY columns — interior variables (all incident
+factors on one shard) combine locally — and the compact-exact mode
+must be BIT-IDENTICAL to the dense whole-space psum for every sharded
+engine, on partitioned and adversarial cuts, for the psum slab AND the
+edge-colored ppermute neighbor-exchange path.  ``stale`` (the
+opt-in staleness-1 halo) is held to statistical equivalence plus a
+guarded golden pin, like PR 2's coin-stream break.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.generators import generate_graph_coloring
+from pydcop_tpu.ops.compile import (
+    compile_binary_from_arrays,
+    compile_constraint_graph,
+    compile_factor_graph,
+    total_cost,
+)
+from pydcop_tpu.parallel.boundary import (
+    analyze_boundary,
+    build_exchange_plan,
+    padded_boundary_idx,
+)
+from pydcop_tpu.parallel.mesh import (
+    ShardedLocalSearch,
+    ShardedMaxSum,
+    build_mesh,
+)
+
+
+def ring_factor_tensors(V=64, C=3, seed=0):
+    """Ring-lattice coloring factor graph — the partition-friendly
+    instance (contiguous BFS regions cut only the seams)."""
+    rng = np.random.default_rng(seed)
+    idx = np.arange(V)
+    ei = np.concatenate([idx, idx])
+    ej = np.concatenate([(idx + 1) % V, (idx + 2) % V])
+    mats = rng.uniform(0, 1, (2 * V, C, C)).astype(np.float32)
+    mats += np.eye(C, dtype=np.float32) * 5
+    return compile_binary_from_arrays(
+        ei, ej, mats, V,
+        unary=rng.uniform(0, 0.01, (V, C)).astype(np.float32),
+    )
+
+
+def ring_dcop(V=48, C=3, seed=0):
+    """Same locality profile as a constraint-graph DCOP (for the
+    local-search engines)."""
+    rng = np.random.default_rng(seed)
+    d = DCOP("ring", "min")
+    dom = Domain("colors", "color", list(range(C)))
+    vs = [Variable(f"v{i:03d}", dom) for i in range(V)]
+    for v in vs:
+        d.add_variable(v)
+    k = 0
+    for i in range(V):
+        for off in (1, 2):
+            m = rng.uniform(0, 1, (C, C)) + np.eye(C) * 5
+            d.add_constraint(NAryMatrixRelation(
+                [vs[i], vs[(i + off) % V]], m, name=f"c{k}"))
+            k += 1
+    d.add_agents([AgentDef(f"a{i}") for i in range(4)])
+    return d
+
+
+def random_instance(n_vars=60, n_edges=120, seed=1):
+    return generate_graph_coloring(
+        n_variables=n_vars, n_colors=3, n_edges=n_edges, soft=True,
+        n_agents=1, seed=seed,
+    )
+
+
+def collect_collectives(jaxpr, out=None):
+    """(primitive name, first-operand shape) for every collective in a
+    (recursively traversed) jaxpr."""
+    out = [] if out is None else out
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in ("psum", "pmax", "pmin", "ppermute",
+                                  "psum2", "all_reduce", "pmax2",
+                                  "pmin2"):
+            out.append((eqn.primitive.name, eqn.invars[0].aval.shape))
+        for v in eqn.params.values():
+            for j in jax.tree_util.tree_leaves(
+                    v, is_leaf=lambda x: hasattr(x, "eqns")):
+                if hasattr(j, "eqns"):
+                    collect_collectives(j, out)
+                elif hasattr(j, "jaxpr"):
+                    collect_collectives(j.jaxpr, out)
+    return out
+
+
+class TestBoundaryAnalysis:
+    def test_ring_partition_is_pairwise(self):
+        V = 16
+        vi = np.stack([np.arange(V), (np.arange(V) + 1) % V],
+                      axis=1).astype(np.int32)
+        asg = (np.arange(V) // 4).astype(np.int32)
+        info = analyze_boundary([vi], [asg], V, 4)
+        assert info.n_boundary == 4 and info.pairwise
+        # owner covers every variable exactly once
+        assert info.owner.shape == (V,)
+        assert set(info.owner.tolist()) <= {0, 1, 2, 3}
+        idx = padded_boundary_idx(info, quantum=8)
+        assert idx.shape[0] % 8 == 0
+        assert set(info.boundary_vars.tolist()) <= set(idx.tolist())
+
+    def test_star_cut_is_not_pairwise(self):
+        vi = np.stack([np.zeros(8), np.arange(1, 9)],
+                      axis=1).astype(np.int32)
+        asg = (np.arange(8) // 2).astype(np.int32)
+        info = analyze_boundary([vi], [asg], 9, 4)
+        assert not info.pairwise
+        assert build_exchange_plan(info, [vi], [asg]) is None
+
+    def test_exchange_rounds_are_partial_permutations(self):
+        V = 16
+        vi = np.stack([np.arange(V), (np.arange(V) + 1) % V],
+                      axis=1).astype(np.int32)
+        asg = (np.arange(V) // 4).astype(np.int32)
+        info = analyze_boundary([vi], [asg], V, 4)
+        plan = build_exchange_plan(info, [vi], [asg])
+        assert plan is not None
+        for perm in plan.rounds:
+            srcs = [a for a, _ in perm]
+            dsts = [b for _, b in perm]
+            assert len(srcs) == len(set(srcs))  # each sends at most once
+            assert len(dsts) == len(set(dsts))  # each receives at most once
+        # every pair exchanged in both directions exactly once
+        directed = [e for perm in plan.rounds for e in perm]
+        assert len(directed) == len(set(directed))
+
+    def test_partition_stats_shares_the_analysis(self):
+        from pydcop_tpu.parallel.partition import partition_stats
+
+        V = 16
+        vi = np.stack([np.arange(V), (np.arange(V) + 1) % V],
+                      axis=1).astype(np.int32)
+        asg = (np.arange(V) // 4).astype(np.int32)
+        stats = partition_stats([vi], [asg], 4)
+        info = analyze_boundary([vi], [asg], V, 4)
+        assert stats["n_boundary"] == info.n_boundary
+        assert stats["cut_fraction"] == pytest.approx(info.cut_fraction)
+        assert stats["pairwise_cut"] == info.pairwise
+
+
+class TestCompactExactMaxSum:
+    """compact-exact must be BIT-IDENTICAL to the dense psum —
+    assignments and continuation trajectories."""
+
+    @pytest.mark.parametrize("use_packed", [False, True])
+    @pytest.mark.parametrize("exchange", [False, True])
+    def test_partitioned_bitmatch(self, use_packed, exchange):
+        t = ring_factor_tensors()
+        mesh = build_mesh(8)
+        dense = ShardedMaxSum(t, mesh, damping=0.5,
+                              use_packed=use_packed, overlap="off")
+        vd, _, _ = dense.run(cycles=8)
+        comp = ShardedMaxSum(t, mesh, damping=0.5,
+                             use_packed=use_packed, overlap="exact",
+                             exchange=exchange)
+        assert comp.comm.mode == "exact"
+        vc, q, r = comp.run(cycles=8)
+        np.testing.assert_array_equal(vc, vd)
+        # chunked continuation lands on the same trajectory
+        v1, q1, r1 = comp.run(cycles=4)
+        v2, _, _ = comp.run(cycles=4, q=q1, r=r1)
+        np.testing.assert_array_equal(v2, vd)
+
+    @pytest.mark.parametrize("use_packed", [False, True])
+    def test_adversarial_all_boundary_bitmatch(self, use_packed):
+        """Forced exact on an adversarial (near-all-boundary) cut is
+        still bit-identical; the auto-policy refuses to compact it."""
+        t = compile_factor_graph(random_instance())
+        rng = np.random.default_rng(3)
+        assigns = [rng.integers(0, 8, t.n_factors).astype(np.int32)]
+        mesh = build_mesh(8)
+        dense = ShardedMaxSum(t, mesh, damping=0.5, assigns=assigns,
+                              use_packed=use_packed, overlap="off")
+        comp = ShardedMaxSum(t, mesh, damping=0.5, assigns=assigns,
+                             use_packed=use_packed, overlap="exact")
+        assert comp.comm.info.cut_fraction > 0.5
+        vd, _, _ = dense.run(cycles=8)
+        vc, _, _ = comp.run(cycles=8)
+        np.testing.assert_array_equal(vc, vd)
+        auto = ShardedMaxSum(t, mesh, damping=0.5, assigns=assigns,
+                             use_packed=use_packed)
+        assert auto.comm.mode == "dense"
+        va, _, _ = auto.run(cycles=8)
+        np.testing.assert_array_equal(va, vd)
+
+    def test_mixed_arity_packed_bitmatch(self):
+        from pydcop_tpu.generators.secp import generate_secp
+
+        t = compile_factor_graph(generate_secp(
+            n_lights=30, n_models=10, n_rules=6, max_model_size=2,
+            seed=3))
+        mesh = build_mesh(4)
+        dense = ShardedMaxSum(t, mesh, damping=0.5, use_packed=True,
+                              overlap="off")
+        assert dense.packs is not None and dense.packs.mixed
+        comp = ShardedMaxSum(t, mesh, damping=0.5, use_packed=True,
+                             overlap="exact")
+        vd, _, _ = dense.run(cycles=8)
+        vc, _, _ = comp.run(cycles=8)
+        np.testing.assert_array_equal(vc, vd)
+
+    def test_activation_bitmatch(self):
+        t = ring_factor_tensors()
+        mesh = build_mesh(8)
+        vd, _, _ = ShardedMaxSum(t, mesh, damping=0.5, activation=0.6,
+                                 overlap="off").run(cycles=6, seed=3)
+        vc, _, _ = ShardedMaxSum(t, mesh, damping=0.5, activation=0.6,
+                                 overlap="exact").run(cycles=6, seed=3)
+        np.testing.assert_array_equal(vc, vd)
+
+    def test_exchange_on_non_pairwise_cut_fails_loudly(self):
+        t = compile_factor_graph(random_instance())
+        rng = np.random.default_rng(3)
+        assigns = [rng.integers(0, 8, t.n_factors).astype(np.int32)]
+        with pytest.raises(ValueError, match="pairwise"):
+            ShardedMaxSum(t, build_mesh(8), damping=0.5,
+                          assigns=assigns, overlap="exact",
+                          exchange=True)
+
+
+class TestCompactExactLocalSearch:
+    @pytest.mark.parametrize("rule,params", [
+        ("mgm", {}),
+        ("dsa", {}),
+        ("adsa", {"activation": 0.7, "variant": "B"}),
+        ("dba", {}),
+        ("gdba", {}),
+    ])
+    @pytest.mark.parametrize("exchange", [False, True])
+    def test_generic_bitmatch(self, rule, params, exchange):
+        t = compile_constraint_graph(ring_dcop())
+        mesh = build_mesh(8)
+        vd = ShardedLocalSearch(
+            t, mesh, rule=rule, algo_params=params, overlap="off"
+        ).run(cycles=8, seed=3)
+        comp = ShardedLocalSearch(
+            t, mesh, rule=rule, algo_params=params, overlap="exact",
+            exchange=exchange,
+        )
+        assert comp.comm.mode == "exact"
+        np.testing.assert_array_equal(comp.run(cycles=8, seed=3), vd)
+
+    @pytest.mark.parametrize("rule", ["mgm", "dsa", "adsa"])
+    def test_packed_bitmatch(self, rule):
+        t = compile_constraint_graph(ring_dcop())
+        mesh = build_mesh(8)
+        params = (
+            {"activation": 0.7, "variant": "B"} if rule == "adsa" else {}
+        )
+        dense = ShardedLocalSearch(t, mesh, rule=rule,
+                                   algo_params=params, use_packed=True,
+                                   overlap="off")
+        assert dense.packs is not None
+        vd = dense.run(cycles=8, seed=3)
+        comp = ShardedLocalSearch(t, mesh, rule=rule,
+                                  algo_params=params, use_packed=True,
+                                  overlap="exact")
+        np.testing.assert_array_equal(comp.run(cycles=8, seed=3), vd)
+
+    def test_generic_mgm_adversarial_forced_exact(self):
+        """The compact partial-arbitration (pair-block pmax/pmin)
+        mirrors neighborhood_winner exactly even when every variable
+        is boundary."""
+        t = compile_constraint_graph(random_instance(seed=2))
+        mesh = build_mesh(8)
+        vd = ShardedLocalSearch(t, mesh, rule="mgm",
+                                overlap="off").run(cycles=8, seed=3)
+        comp = ShardedLocalSearch(t, mesh, rule="mgm", overlap="exact")
+        assert comp.comm.info.cut_fraction > 0.5  # adversarial indeed
+        np.testing.assert_array_equal(comp.run(cycles=8, seed=3), vd)
+
+
+class TestCollectiveBudgetPins:
+    """jaxpr pins: the per-cycle collective operand is the COMPACT
+    boundary slab, not the whole variable space (extends PR 2's
+    collective-budget test)."""
+
+    def test_packed_maxsum_compact_operand_is_boundary_slab(self):
+        t = ring_factor_tensors()
+        mesh = build_mesh(8)
+        comp = ShardedMaxSum(t, mesh, damping=0.5, use_packed=True,
+                             overlap="exact", exchange=False)
+        comp._build()
+        state, _ = comp.init_messages()
+        keys = jax.random.split(jax.random.PRNGKey(0), 1)
+        cj = jax.make_jaxpr(comp._run_n)(state, keys, *comp._run_args)
+        cols = collect_collectives(cj.jaxpr)
+        psums = [s for n, s in cols if n == "psum"]
+        assert len(psums) == 1
+        D, Vp = comp.packs.D, comp.packs.Vp
+        Bp = int(comp.comm.bnd.shape[0])
+        assert psums[0] == (D, Bp)
+        assert Bp < Vp
+        assert all(s != (D, Vp) for s in psums)
+
+    def test_generic_maxsum_compact_has_no_dense_psum(self):
+        t = ring_factor_tensors()
+        mesh = build_mesh(8)
+        comp = ShardedMaxSum(t, mesh, damping=0.5, overlap="exact",
+                             exchange=False)
+        comp._build()
+        q, r = comp.init_messages()
+        keys = jax.random.split(jax.random.PRNGKey(0), 1)
+        cj = jax.make_jaxpr(comp._run_n)(q, r, keys, *comp._run_args)
+        cols = collect_collectives(cj.jaxpr)
+        psums = [s for n, s in cols if n == "psum"]
+        V, D = t.n_vars, t.max_domain_size
+        assert len(psums) == 1
+        assert psums[0][0] < V + 1  # boundary slab, not [V+1, D]
+        assert all(s != (V + 1, D) for s in psums)
+
+    def test_exchange_mode_uses_ppermute_not_psum(self):
+        t = ring_factor_tensors()
+        mesh = build_mesh(8)
+        comp = ShardedMaxSum(t, mesh, damping=0.5, overlap="exact",
+                             exchange=True)
+        comp._build()
+        q, r = comp.init_messages()
+        keys = jax.random.split(jax.random.PRNGKey(0), 1)
+        cj = jax.make_jaxpr(comp._run_n)(q, r, keys, *comp._run_args)
+        cols = collect_collectives(cj.jaxpr)
+        assert not any(n == "psum" for n, _ in cols)
+        assert any(n == "ppermute" for n, _ in cols)
+
+    def test_packed_mgm_compact_budget(self):
+        """One compact psum + one compact pmax/pmin pair per cycle —
+        same budget as dense, smaller operands."""
+        t = compile_constraint_graph(ring_dcop())
+        mesh = build_mesh(8)
+        s = ShardedLocalSearch(t, mesh, rule="mgm", use_packed=True,
+                               overlap="exact", exchange=False)
+        s._build()
+        x_row = jnp.zeros((8, 1, s.packs.Vp), jnp.float32)
+        keys = jax.random.split(jax.random.PRNGKey(0), 1)
+        cj = jax.make_jaxpr(s._run_n)(
+            x_row, keys, (), *s._bucket_args, *s._extra_args)
+        cols = collect_collectives(cj.jaxpr)
+        names = [n for n, _ in cols]
+        assert names.count("psum") == 1
+        assert names.count("pmax") == 1
+        assert names.count("pmin") == 1
+        Bp = int(s.comm.bnd.shape[0])
+        for n, shape in cols:
+            assert shape[-1] == Bp, (n, shape)
+
+
+class TestStaleOverlap:
+    """overlap='stale' (staleness-1 boundary halo) is opt-in and held
+    to statistical equivalence, like PR 2's coin-stream break."""
+
+    def test_maxsum_stale_reaches_dense_quality(self):
+        """Mean solution cost over several instances stays in a band
+        of the dense engine's (single trajectories legitimately differ
+        — BP oscillates on the frustrated ring, and a 1-cycle boundary
+        halo shifts which crest it lands on)."""
+        mesh = build_mesh(8)
+        costs_s, costs_d = [], []
+        for seed in range(4):
+            t = ring_factor_tensors(seed=seed)
+            vd, _, _ = ShardedMaxSum(t, mesh, damping=0.9,
+                                     overlap="off").run(cycles=60)
+            vs, _, _ = ShardedMaxSum(t, mesh, damping=0.9,
+                                     overlap="stale").run(cycles=60)
+            costs_d.append(float(total_cost(t, jnp.asarray(vd))))
+            costs_s.append(float(total_cost(t, jnp.asarray(vs))))
+        assert np.mean(costs_s) <= np.mean(costs_d) * 1.15 + 1.0, (
+            costs_s, costs_d)
+
+    def test_dsa_stale_statistical_equivalence(self):
+        t = compile_constraint_graph(ring_dcop())
+        mesh = build_mesh(8)
+        costs_s, costs_d = [], []
+        for s in range(4):
+            vs = ShardedLocalSearch(t, mesh, rule="dsa",
+                                    overlap="stale").run(cycles=25,
+                                                         seed=s)
+            vd = ShardedLocalSearch(t, mesh, rule="dsa",
+                                    overlap="off").run(cycles=25,
+                                                       seed=s)
+            costs_s.append(float(total_cost(t, jnp.asarray(vs))))
+            costs_d.append(float(total_cost(t, jnp.asarray(vd))))
+        assert np.mean(costs_s) <= np.mean(costs_d) * 1.15 + 1.0, (
+            costs_s, costs_d)
+
+    def test_stale_golden_stream(self):
+        """Guarded golden (minted on the CPU interpret / experimental
+        shard_map stack, like the PR 2 coin-stream pins): the stale
+        halo schedule is part of the mode's contract — an edit that
+        changes WHICH cycle's slab merges where must break this pin,
+        not pass silently.  Semantic assertions run everywhere."""
+        t = ring_factor_tensors(V=24, seed=7)
+        mesh = build_mesh(4)
+        vs, _, _ = ShardedMaxSum(t, mesh, damping=0.5,
+                                 overlap="stale").run(cycles=6, seed=11)
+        vd, _, _ = ShardedMaxSum(t, mesh, damping=0.5,
+                                 overlap="off").run(cycles=6, seed=11)
+        assert vs.shape == vd.shape
+        if (jax.devices()[0].platform == "cpu"
+                and not hasattr(jax, "shard_map")):
+            np.testing.assert_array_equal(vs, GOLDEN_STALE_24)
+
+    def test_stale_downgrades_to_exact_without_boundary(self):
+        """A 1-shard mesh has no boundary: stale has nothing to
+        double-buffer and must degrade to the (exact) no-collective
+        path, bit-identical to dense."""
+        t = ring_factor_tensors()
+        mesh = build_mesh(1)
+        stale = ShardedMaxSum(t, mesh, damping=0.5, overlap="stale")
+        assert stale.comm.collective == "none"
+        vd, _, _ = ShardedMaxSum(t, mesh, damping=0.5,
+                                 overlap="off").run(cycles=8)
+        vs, _, _ = stale.run(cycles=8)
+        np.testing.assert_array_equal(vs, vd)
+
+
+#: minted by test_stale_golden_stream on the stack described there
+GOLDEN_STALE_24 = [2, 0, 1, 2, 0, 1, 1, 0, 1, 2, 0, 1, 0, 2, 2, 0, 2,
+                   2, 0, 1, 2, 0, 1, 1]
+
+
+class TestObservability:
+    def test_comm_stats_schema(self):
+        from pydcop_tpu.runtime.stats import SHARD_COMM_FIELDS
+
+        t = ring_factor_tensors()
+        s = ShardedMaxSum(t, build_mesh(8), damping=0.5,
+                          overlap="exact")
+        stats = s.comm_stats()
+        assert set(SHARD_COMM_FIELDS) <= set(stats)
+        assert stats["mode"] == "compact-exact"
+        assert 0 < stats["boundary_columns"] < stats["total_columns"]
+        assert (stats["bytes_per_cycle_compact"]
+                < stats["bytes_per_cycle_dense"])
+
+    def test_comm_selected_event_emitted(self):
+        from pydcop_tpu.runtime.events import event_bus
+
+        got = []
+        event_bus.enabled = True
+        event_bus.subscribe("shard.*", lambda t_, e: got.append((t_, e)))
+        try:
+            t = ring_factor_tensors()
+            ShardedMaxSum(t, build_mesh(4), damping=0.5,
+                          overlap="exact")
+        finally:
+            event_bus.enabled = False
+            event_bus._subs = [
+                (p, cb) for p, cb in event_bus._subs
+                if not p.startswith("shard.")
+            ]
+        assert any(t_ == "shard.comm.selected" for t_, _ in got)
+        payload = got[0][1]
+        assert payload["mode"] == "compact-exact"
+        assert payload["engine"] == "maxsum"
+
+    def test_solve_result_metrics_carry_shard(self):
+        from pydcop_tpu.algorithms.base import SolveResult
+
+        res = SolveResult(
+            status="FINISHED", assignment={}, cost=0.0, violation=0,
+            cycle=1, msg_count=0, msg_size=0.0, time=0.0,
+            shard={"mode": "compact-exact"},
+        )
+        assert res.metrics()["shard"]["mode"] == "compact-exact"
+
+
+class TestMultihostPlumbing:
+    """overlap plumbing mirrors use_packed: the in-process 8-device
+    mesh IS the global mesh of a single-process run."""
+
+    def test_maxsum_overlap_plumbing(self):
+        from pydcop_tpu.parallel.multihost import run_multihost_maxsum
+
+        t_dcop = ring_dcop()
+        info = {}
+        values, n_dev, _t = run_multihost_maxsum(
+            t_dcop, cycles=8, overlap="exact", info=info)
+        assert n_dev == 8
+        assert info["shard"]["mode"] == "compact-exact"
+        info_d = {}
+        vd, _, _t2 = run_multihost_maxsum(
+            t_dcop, cycles=8, overlap="off", info=info_d)
+        assert info_d["shard"]["mode"] == "dense"
+        np.testing.assert_array_equal(values, vd)
+
+    def test_local_search_overlap_plumbing(self):
+        from pydcop_tpu.parallel.multihost import (
+            run_multihost_local_search,
+        )
+
+        t_dcop = ring_dcop()
+        info = {}
+        values, n_dev, _t = run_multihost_local_search(
+            t_dcop, rule="mgm", cycles=8, seed=0, overlap="exact",
+            info=info)
+        assert n_dev == 8
+        assert info["shard"]["mode"] == "compact-exact"
+        info_d = {}
+        vd, _, _t2 = run_multihost_local_search(
+            t_dcop, rule="mgm", cycles=8, seed=0, overlap="off",
+            info=info_d)
+        np.testing.assert_array_equal(values, vd)

@@ -426,7 +426,10 @@ class TestPackedShardedLocalSearch:
         of partial tables — plus, for MGM only, exactly one pmax/pmin
         pair for the cross-shard neighborhood arbitration.  Counted in
         the traced jaxpr of a 1-cycle run so a regression that adds a
-        gather-backed collective (or a second psum) fails loudly."""
+        gather-backed collective (or a second psum) fails loudly.
+        Pinned on the DENSE path (overlap='off'); the boundary-
+        compacted budget — same counts, [*, Bp] operands — is pinned
+        in tests/unit/test_boundary_comm.py."""
         import re
 
         import jax.numpy as jnp
@@ -435,7 +438,8 @@ class TestPackedShardedLocalSearch:
         mesh = build_mesh(8)
         expected = {"mgm": (1, 1, 1), "dsa": (1, 0, 0)}
         for rule, (n_psum, n_pmax, n_pmin) in expected.items():
-            s = ShardedLocalSearch(t, mesh, rule=rule, use_packed=True)
+            s = ShardedLocalSearch(t, mesh, rule=rule, use_packed=True,
+                                   overlap="off")
             s._build()
             x_row = jnp.zeros((1, s.packs.Vp), jnp.float32)
             keys = jax.random.split(jax.random.PRNGKey(0), 1)
