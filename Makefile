@@ -1,7 +1,8 @@
 # Test/check targets (reference twin: pyDcop Makefile:1-21)
 
 .PHONY: test unit api cli doctest all-tests bench bench-probe faults \
-	bench-batch batch-smoke bench-harness bench-sharded
+	bench-batch batch-smoke bench-harness bench-sharded bench-serve \
+	serve-smoke
 
 test: all-tests
 
@@ -53,6 +54,21 @@ bench-harness:
 batch-smoke:
 	JAX_PLATFORMS=cpu python -m pytest \
 		tests/cli/test_batch_cli.py -q -m 'not slow'
+
+# continuous-batching serve throughput: seeded Poisson arrivals over a
+# mixed-shape family — the streaming service vs the naive
+# sequential-per-job baseline, with p50/p99 latency and the arrival
+# trace in the JSON (docs/serving.rst, BENCHREF.md "Serve throughput")
+bench-serve:
+	python bench.py --only serve
+
+# short Poisson burst through the in-process solve service on the CPU
+# backend: every job must complete with the standalone solve's exact
+# cost (the tier-1 serve CLI scenario, runnable standalone); the
+# long service soak/crash tests are slow-marked
+serve-smoke:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/cli/test_serve_cli.py -q -m 'not slow'
 
 # fault-tolerance suite only (docs/resilience.rst); tier-1 subset —
 # the multi-process crash tests beyond ~30s are marked slow

@@ -1207,6 +1207,167 @@ def bench_harness(args, probe=None):
     return out
 
 
+def bench_serve(args, probe=None):
+    """Continuous-batching serve throughput (the serve/ subsystem):
+    seeded Poisson arrivals over a mixed-shape graph-coloring family,
+    solved run-to-convergence, three ways on the SAME arrival trace:
+
+    * ``serve_*`` — the streaming service: warm compile-cache pools
+      (prewarmed before arrivals open), arrivals folded into running
+      buckets at chunk boundaries, freed lanes reused;
+    * ``serve_seq_*`` — the NAIVE sequential-per-job baseline: each
+      arrival handled the way every pre-serve entry point handles a
+      job, with a fresh solver paying its own instance compilation and
+      jit trace+XLA compile (that cold cost IS the point of the warm
+      pools — BENCHREF.md);
+    * ``serve_seqwarm_*`` — an idealized clairvoyant baseline with
+      every per-job solver pre-compiled before the trace starts
+      (unrealizable for streaming traffic, reported for honesty: on a
+      single-core CPU host batching is roughly compute-neutral and the
+      service's win over THIS baseline comes only from queueing/
+      dispatch effects; on parallel backends the vmapped lanes win
+      outright).
+
+    Reports solves/s and p50/p99 latency for all three, the seeded
+    arrival trace (recorded so a round is reproducible), the
+    compile-cache hit counts, and a bit-match flag (per-job serve
+    results must equal the standalone solves — the determinism
+    contract, cheap to re-assert here).  Drift-normalized like the
+    primary."""
+    from pydcop_tpu.batch.cache import CompileCache
+    from pydcop_tpu.batch.engine import BatchItem, adapter_for
+    from pydcop_tpu.generators import generate_graph_coloring
+    from pydcop_tpu.serve import SolveService
+
+    n_jobs = args.serve_jobs
+    rate = args.serve_rate
+    max_cycles = 200
+    sizes = (args.serve_vars, args.serve_vars // 2)
+    dcops = []
+    for i in range(n_jobs):
+        V = sizes[i % len(sizes)]
+        dcops.append(generate_graph_coloring(
+            n_variables=V, n_colors=args.colors, n_edges=V * 3,
+            soft=True, n_agents=1, seed=300 + i,
+        ))
+    rng = np.random.default_rng(args.serve_seed)
+    inter = rng.exponential(1.0 / rate, n_jobs)
+    inter[0] = 0.0
+    offsets = np.cumsum(inter)
+    trace = [round(float(o), 6) for o in offsets]
+    adapter = adapter_for("mgm")
+
+    def replay_sequential(run_job):
+        """FIFO worker on the arrival trace: each job's latency
+        includes its queue wait behind earlier jobs."""
+        t0 = time.perf_counter()
+        lat, results = [], []
+        for i in range(n_jobs):
+            now = time.perf_counter() - t0
+            if now < offsets[i]:
+                time.sleep(offsets[i] - now)
+            results.append(run_job(i))
+            lat.append((time.perf_counter() - t0) - offsets[i])
+        return lat, results, time.perf_counter() - t0
+
+    # -- naive sequential-per-job: fresh solver per arrival (cold)
+    seq_lat, seq_results, seq_wall = replay_sequential(
+        lambda i: adapter.build_spec(
+            BatchItem(dcops[i], "mgm", seed=i)
+        ).solver.run(max_cycles=max_cycles)
+    )
+
+    # -- idealized warm sequential: per-job solvers pre-compiled ahead
+    warm_specs = [
+        adapter.build_spec(BatchItem(d, "mgm", seed=i))
+        for i, d in enumerate(dcops)
+    ]
+    for spec in warm_specs:
+        spec.solver.run(max_cycles=7)
+    warm_lat, _warm_results, warm_wall = replay_sequential(
+        lambda i: warm_specs[i].solver.run(max_cycles=max_cycles)
+    )
+
+    # -- the continuous-batching service: runners prewarmed before
+    # arrivals open; per-job instance compilation happens on the
+    # service's own prep pipeline, inside the measurement
+    cache = CompileCache()
+    service = SolveService(
+        lanes=args.serve_lanes, cache=cache, max_cycles=max_cycles,
+    )
+    service.prewarm([(d, "mgm") for d in dcops], block=True)
+    service.start()
+    t0 = time.perf_counter()
+    jids = []
+    for i, d in enumerate(dcops):
+        now = time.perf_counter() - t0
+        if now < offsets[i]:
+            time.sleep(offsets[i] - now)
+        jids.append((service.submit(d, "mgm", seed=i),
+                     time.perf_counter() - t0))
+    serve_lat, serve_results = [], []
+    for i, (jid, submitted) in enumerate(jids):
+        res = service.result(jid, timeout=300)
+        serve_results.append(res)
+        # latency vs the SCHEDULED arrival, like the baselines
+        serve_lat.append((submitted + res.time) - offsets[i])
+    serve_wall = max(
+        s + r.time for (_j, s), r in zip(jids, serve_results)
+    )
+    service.stop(drain=False)
+
+    bitmatch = all(
+        r.cost == s.cost and r.cycle == s.cycle
+        and r.assignment == s.assignment
+        for r, s in zip(serve_results, seq_results)
+    )
+
+    def pcts(lat, prefix):
+        return {
+            f"{prefix}_p50_ms": round(
+                float(np.percentile(lat, 50)) * 1e3, 1),
+            f"{prefix}_p99_ms": round(
+                float(np.percentile(lat, 99)) * 1e3, 1),
+        }
+
+    out = {
+        "serve_throughput_solves_per_sec": round(n_jobs / serve_wall, 2),
+        "serve_seq_solves_per_sec": round(n_jobs / seq_wall, 2),
+        "serve_seqwarm_solves_per_sec": round(n_jobs / warm_wall, 2),
+        "serve_speedup": round(seq_wall / serve_wall, 2),
+        "serve_speedup_vs_warm": round(warm_wall / serve_wall, 2),
+        **pcts(serve_lat, "serve"),
+        **pcts(seq_lat, "serve_seq"),
+        **pcts(warm_lat, "serve_seqwarm"),
+        "serve_bitmatch": bitmatch,
+        "serve_jobs": n_jobs,
+        "serve_rate_jobs_per_sec": rate,
+        "serve_arrival_seed": args.serve_seed,
+        "serve_arrival_trace": trace,
+        "serve_compile_cache": cache.stats(),
+        "serve_counters": {
+            k: v for k, v in service.counters.as_dict().items()
+            if k in ("jobs_admitted", "lanes_reused",
+                     "midflight_admissions", "buckets_opened",
+                     "buckets_merged", "prewarmed_runners")
+        },
+    }
+    out["serve_p99_ratio"] = round(
+        out["serve_seq_p99_ms"] / max(out["serve_p99_ms"], 1e-9), 2)
+    # > 1.0 on BOTH means continuous batching is strictly better than
+    # the sequential-per-job baseline on throughput AND tail latency —
+    # the acceptance headline
+    out["serve_strictly_better"] = (
+        out["serve_speedup"] > 1.0 and out["serve_p99_ratio"] > 1.0
+    )
+    if probe is not None:
+        pr = probe()
+        if pr:
+            out["serve_throughput_normalized"] = round(
+                out["serve_throughput_solves_per_sec"] / pr, 6)
+    return out
+
+
 def bench_sharded_subprocess(args):
     """ShardedMaxSum on a virtual 8-device CPU mesh, in a subprocess so
     the forced-CPU platform doesn't poison this process's TPU backend."""
@@ -1508,6 +1669,28 @@ def main():
         "enough that per-instance device work is real",
     )
     ap.add_argument(
+        "--serve-jobs", type=int, default=24,
+        help="jobs in the serve-throughput bench's Poisson burst",
+    )
+    ap.add_argument(
+        "--serve-vars", type=int, default=120,
+        help="variables of the LARGER shape in the serve bench's "
+        "mixed-shape family (the smaller is half; edges = 3x)",
+    )
+    ap.add_argument(
+        "--serve-rate", type=float, default=20.0,
+        help="Poisson arrival rate of the serve bench, jobs/sec",
+    )
+    ap.add_argument(
+        "--serve-seed", type=int, default=11,
+        help="seed of the serve bench's arrival process (the trace is "
+        "recorded in the JSON)",
+    )
+    ap.add_argument(
+        "--serve-lanes", type=int, default=8,
+        help="lanes per service bucket in the serve bench",
+    )
+    ap.add_argument(
         "--stretch", action="store_true",
         help="compat: run ONLY the 100k stretch instance as primary",
     )
@@ -1519,7 +1702,7 @@ def main():
         "--only",
         choices=["all", "maxsum", "dpop", "convergence", "convergence2",
                  "local", "scalefree", "mixed", "sharded",
-                 "sharded-inner", "probe", "batch", "harness"],
+                 "sharded-inner", "probe", "batch", "harness", "serve"],
         default="all",
     )
     # watchdog covers the FULL run: the wholesweep DPOP kernel compile
@@ -1611,7 +1794,8 @@ def main():
     # once up front; each burst then times it ADJACENT to the primary
     # measurement so both see the same tunnel state
     probe = None
-    if args.only in ("all", "maxsum", "probe", "batch", "harness"):
+    if args.only in ("all", "maxsum", "probe", "batch", "harness",
+                     "serve"):
         try:
             probe = make_drift_probe(repeat=args.repeat)
         except Exception as e:
@@ -1734,6 +1918,12 @@ def main():
         except Exception as e:
             extra["harness_error"] = repr(e)
 
+    if args.only in ("all", "serve"):
+        try:
+            extra.update(bench_serve(args, probe=probe))
+        except Exception as e:
+            extra["serve_error"] = repr(e)
+
     def run_with_transient_retry(fn, err_key):
         # the tunneled remote-compile service occasionally drops a
         # response mid-read; one retry keeps such a transient from
@@ -1801,12 +1991,12 @@ def main():
 
     if args.only in ("dpop", "local", "convergence", "convergence2",
                      "scalefree", "mixed", "sharded", "probe", "batch",
-                     "harness") \
+                     "harness", "serve") \
             and not value:
         # single-part run: promote the part's headline measurement (not
         # config constants like stretch_vars) to the primary slot
         headline = ("_per_sec", "_wall_s", "_cycles_per", "probe_rate",
-                    "batch_throughput")
+                    "batch_throughput", "serve_throughput")
         k = next(
             (k for k in extra if any(h in k for h in headline)),
             next((k for k in extra if not k.endswith("_error")), None),

@@ -79,9 +79,16 @@ class BucketPlan:
     def signature(self) -> Tuple:
         """Hashable bucket signature — the shape part of the compile
         cache key (pydcop_tpu.batch.cache)."""
-        t = self.target
-        return (t.graph_type, t.D, t.arities, t.V, t.F, t.M,
-                self.batch_size)
+        return bucket_signature(self.target, self.batch_size)
+
+
+def bucket_signature(target: InstanceDims, batch_size: int) -> Tuple:
+    """Hashable (padded shape, lane count) signature of one bucket —
+    the shape part of the compile-cache key, shared by
+    :meth:`BucketPlan.signature` and the serve scheduler's workers so
+    both resolve to the SAME cached runner."""
+    return (target.graph_type, target.D, target.arities, target.V,
+            target.F, target.M, batch_size)
 
 
 def dims_of(tensors, graph_type: str) -> InstanceDims:

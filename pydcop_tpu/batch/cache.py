@@ -19,45 +19,95 @@ read them.
 from __future__ import annotations
 
 import logging
-from typing import Any, Callable, Dict, Optional, Tuple
+import threading
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 log = logging.getLogger(__name__)
 
 
 class CompileCache:
-    """In-memory level of the two-level compile cache."""
+    """In-memory level of the two-level compile cache.
+
+    Safely shareable across threads: the solve service's scheduler
+    thread, its prewarm thread and direct callers all funnel through
+    :meth:`get_or_build`, which holds a lock around the whole
+    get-or-compile — two threads racing on the same key can neither
+    duplicate a compile nor observe a half-built entry.  The lock is
+    re-entrant so a builder that itself consults the cache does not
+    deadlock."""
 
     def __init__(self):
         self._fns: Dict[Tuple, Any] = {}
         self.hits = 0
         self.misses = 0
+        self.prewarmed = 0
+        self._lock = threading.RLock()
 
-    def get_or_build(self, key: Tuple, builder: Callable[[], Any]
-                     ) -> Tuple[Any, bool]:
+    def get_or_build(self, key: Tuple, builder: Callable[[], Any],
+                     prewarm: bool = False) -> Tuple[Any, bool]:
         """(runner, was_hit) for ``key``; ``builder`` runs on a miss."""
         from pydcop_tpu.runtime.events import send_batch
 
-        if key in self._fns:
-            self.hits += 1
-            send_batch("compile.hit", {"key": _printable(key)})
-            return self._fns[key], True
-        self.misses += 1
-        send_batch("compile.miss", {"key": _printable(key)})
-        fn = builder()
-        self._fns[key] = fn
-        return fn, False
+        with self._lock:
+            if key in self._fns:
+                self.hits += 1
+                send_batch("compile.hit", {"key": _printable(key)})
+                return self._fns[key], True
+            self.misses += 1
+            if prewarm:
+                self.prewarmed += 1
+            send_batch(
+                "compile.prewarm" if prewarm else "compile.miss",
+                {"key": _printable(key)},
+            )
+            fn = builder()
+            self._fns[key] = fn
+            return fn, False
+
+    def prewarm(self, entries: Iterable[Tuple[Tuple, Callable[[], Any]]],
+                block: bool = False) -> threading.Thread:
+        """Compile bucket runners AHEAD of arrival, off the hot path.
+
+        ``entries`` is a sequence of ``(key, builder)`` pairs — the
+        same pairs :meth:`get_or_build` takes; builders that should
+        truly pay the XLA compile here (not just build a lazy
+        ``jax.jit`` wrapper) must execute their runner once at the real
+        shapes, like serve's ``warm_bucket_runner``.  Runs on a daemon
+        thread (``block=True`` joins it — tests and warm-before-open
+        services); already-cached keys count as hits, fresh ones as
+        prewarmed misses in :meth:`stats`.  A failing builder is logged
+        and skipped, never fatal: prewarming is an optimization."""
+        entries = list(entries)
+
+        def work():
+            for key, builder in entries:
+                try:
+                    self.get_or_build(key, builder, prewarm=True)
+                except Exception as e:  # optimization, never fatal
+                    log.warning("prewarm failed for %s: %s",
+                                _printable(key), e)
+
+        t = threading.Thread(target=work, name="compile-prewarm",
+                             daemon=True)
+        t.start()
+        if block:
+            t.join()
+        return t
 
     def stats(self) -> Dict[str, int]:
         return {
             "hits": self.hits,
             "misses": self.misses,
             "entries": len(self._fns),
+            "prewarmed": self.prewarmed,
         }
 
     def clear(self) -> None:
-        self._fns.clear()
-        self.hits = 0
-        self.misses = 0
+        with self._lock:
+            self._fns.clear()
+            self.hits = 0
+            self.misses = 0
+            self.prewarmed = 0
 
 
 #: process-wide default cache: engines share it unless given their own,

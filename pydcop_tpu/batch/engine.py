@@ -38,7 +38,7 @@ from __future__ import annotations
 import dataclasses
 from functools import partial
 from time import perf_counter
-from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -308,6 +308,18 @@ class _AdapterBase:
         """(advanced keys, stacked per-cycle scan inputs or None)."""
         return keys, None
 
+    def chunk_xs_per_lane(self, keys: List[Any], ns: Sequence[int],
+                          specs: Sequence[Optional[_Spec]],
+                          target: InstanceDims, chunk: int):
+        """Per-lane variant of :meth:`chunk_xs` for the continuous-
+        batching scheduler (pydcop_tpu.serve): lane ``i`` draws its
+        ``ns[i]`` cycles of randomness from ITS OWN key at ITS true
+        shape, padded to the fixed ``chunk`` scan length.  Idle lanes
+        (``specs[i] is None`` or ``ns[i] <= 0``) keep their key
+        untouched — their stream must not advance while no job occupies
+        the lane — and contribute inert all-ones rows."""
+        return list(keys), None
+
     def values_np(self, state) -> np.ndarray:
         """[B, Vp] value indices from a batched state."""
         return np.asarray(state[0])
@@ -389,6 +401,43 @@ class _LocalSearchAdapter(_AdapterBase):
             parts.append(u)
         if self.algo == "dsa":
             xs = jnp.stack(parts)  # [B, n, Vp]
+        else:
+            xs = (jnp.stack([p[0] for p in parts]),
+                  jnp.stack([p[1] for p in parts]))
+        return new_keys, xs
+
+    def chunk_xs_per_lane(self, keys, ns, specs, target, chunk):
+        if not self.uses_keys:
+            return list(keys), None
+        draw = (_dsa_chunk_uniforms if self.algo == "dsa"
+                else _adsa_chunk_uniforms)
+        Vp = target.V
+        idle = jnp.ones((chunk, Vp), jnp.float32)
+
+        def pad_rows(u, n):
+            # same "never activate" 1.0 padding as _pad_xs, along the
+            # lane's own cycle axis
+            if n == chunk:
+                return u
+            return jnp.concatenate(
+                [u, jnp.ones((chunk - n, Vp), jnp.float32)]
+            )
+
+        new_keys, parts = [], []
+        for key, n, spec in zip(keys, ns, specs):
+            n = int(n)
+            if spec is None or n <= 0:
+                new_keys.append(key)
+                parts.append(idle if self.algo == "dsa" else (idle, idle))
+                continue
+            key2, u = draw(key, n=n, V=spec.dims.V, Vp=Vp)
+            new_keys.append(key2)
+            if self.algo == "dsa":
+                parts.append(pad_rows(u, n))
+            else:
+                parts.append((pad_rows(u[0], n), pad_rows(u[1], n)))
+        if self.algo == "dsa":
+            xs = jnp.stack(parts)
         else:
             xs = (jnp.stack([p[0] for p in parts]),
                   jnp.stack([p[1] for p in parts]))
@@ -515,7 +564,10 @@ class _MaxSumAdapter(_AdapterBase):
         return conv
 
 
-def _adapter_for(algo: str) -> _AdapterBase:
+def adapter_for(algo: str) -> _AdapterBase:
+    """Batching adapter for one algorithm family — shared by the
+    engine's static ``solve`` path and the continuous-batching
+    scheduler (pydcop_tpu.serve)."""
     if algo in ("mgm", "dsa", "adsa"):
         return _LocalSearchAdapter(algo)
     if algo == "gdba":
@@ -525,6 +577,10 @@ def _adapter_for(algo: str) -> _AdapterBase:
     raise KeyError(algo)
 
 
+#: back-compat private alias
+_adapter_for = adapter_for
+
+
 # ---------------------------------------------------------------------------
 # the engine
 # ---------------------------------------------------------------------------
@@ -532,6 +588,54 @@ def _adapter_for(algo: str) -> _AdapterBase:
 
 def _params_key(params: Dict[str, Any]) -> Tuple:
     return tuple(sorted((k, str(v)) for k, v in (params or {}).items()))
+
+
+def runner_cache_key(algo: str, pkey: Tuple, signature: Tuple,
+                     chunk: int) -> Tuple:
+    """Compile-cache key of one bucket runner.  ``signature`` is the
+    bucket's shape signature (BucketPlan.signature /
+    bucketing.bucket_signature) — the serve scheduler builds the SAME
+    key for its workers, so a prewarmed runner is a cache hit at
+    admission time."""
+    return (algo, pkey) + tuple(signature) + ("chunk", chunk)
+
+
+def build_bucket_runner(adapter: _AdapterBase, meta: BucketMeta,
+                        params: Dict[str, Any], chunk: int):
+    """ONE fixed-shape runner per bucket signature: always scans
+    ``chunk`` cycles, freezing each lane's cycles past its OWN dynamic
+    ``n_active[i]`` (remainder chunks — and, in the serve scheduler,
+    lanes at different ages or under deadline pressure — reuse the same
+    XLA executable instead of compiling their own shape) and
+    already-converged instances per ``done_mask`` — both through the
+    harness's shared :func:`algorithms.base.select_frozen` helper.
+    Also computes the per-instance device convergence vector, so the
+    host's per-chunk read is [B] bools, not two state pytrees.  State
+    buffers are donated where the backend aliases them."""
+    cycle = adapter.make_cycle(params)
+    conv_fn = adapter.make_converged(params)
+
+    def run_chunk(arrays, state, xs, n_active, done_mask):
+        def one(arr_i, st_i, xs_i, n_i):
+            t = rebuild_tensors(meta, arr_i)
+            active = jnp.arange(chunk) < n_i
+
+            def body(st, sc):
+                a, x_in = sc
+                st2 = cycle(t, arr_i, st, x_in)
+                return select_frozen(~a, st, st2), None
+
+            st, _ = jax.lax.scan(
+                body, st_i, (active, xs_i), length=chunk
+            )
+            return st, conv_fn(t, st_i, st)
+
+        new_state, conv = jax.vmap(one)(arrays, state, xs, n_active)
+        new_state = select_frozen(done_mask, state, new_state)
+        return new_state, conv
+
+    donate = (1,) if donation_supported() else ()
+    return jax.jit(run_chunk, donate_argnums=donate)
 
 
 def _pad_xs(xs, chunk: int):
@@ -596,6 +700,7 @@ class BatchEngine:
         cycles: Optional[int] = None,
         timeout: Optional[float] = None,
         max_cycles: int = DEFAULT_MAX_CYCLES,
+        on_lane_release: Optional[Callable[[int, int, Any], None]] = None,
     ) -> List[SolveResult]:
         """Solve every item; results align with ``items`` by index.
 
@@ -604,6 +709,14 @@ class BatchEngine:
         results stay bit-identical to ``solver.run(cycles=n)``).
         ``cycles=None`` → run-to-convergence with per-instance freeze
         masks and early bucket exit.
+
+        ``on_lane_release(lane, stop_cycle, final_state)`` fires the
+        moment one instance of a bucket converges and stops advancing —
+        the per-lane slot-release hook the continuous-batching
+        scheduler (pydcop_tpu.serve) consumes, instead of only the
+        bucket-level ``[B]`` mask.  ``final_state`` is the lane's state
+        pytree sliced on device (no host pull unless the callback reads
+        it).
         """
         t0 = perf_counter()
         self.counters.inc("instances_enqueued", len(items))
@@ -646,7 +759,7 @@ class BatchEngine:
                 bucket_specs = [specs[j] for j in plan.indices]
                 bucket_results = self._solve_bucket(
                     adapter, bucket_specs, plan, cycles, timeout,
-                    max_cycles,
+                    max_cycles, on_lane_release,
                 )
                 for j, res in zip(plan.indices, bucket_results):
                     results[idxs[j]] = res
@@ -676,43 +789,12 @@ class BatchEngine:
 
     def _runner_key(self, adapter, plan: BucketPlan, pkey: Tuple,
                     chunk: int) -> Tuple:
-        return (adapter.algo, pkey) + plan.signature() + ("chunk", chunk)
+        return runner_cache_key(adapter.algo, pkey, plan.signature(),
+                                chunk)
 
     def _build_runner(self, adapter: _AdapterBase, meta: BucketMeta,
                       params: Dict[str, Any], chunk: int):
-        """ONE fixed-shape runner per bucket: always scans ``chunk``
-        cycles, freezing cycles past the dynamic ``n_active`` (remainder
-        chunks reuse the same XLA executable instead of compiling their
-        own shape) and already-converged instances per ``done_mask`` —
-        both through the harness's shared :func:`select_frozen` helper.
-        Also computes the per-instance device convergence vector, so
-        the host's per-chunk read is [B] bools, not two state pytrees.
-        State buffers are donated where the backend aliases them."""
-        cycle = adapter.make_cycle(params)
-        conv_fn = adapter.make_converged(params)
-
-        def run_chunk(arrays, state, xs, n_active, done_mask):
-            active = jnp.arange(chunk) < n_active
-
-            def one(arr_i, st_i, xs_i):
-                t = rebuild_tensors(meta, arr_i)
-
-                def body(st, sc):
-                    a, x_in = sc
-                    st2 = cycle(t, arr_i, st, x_in)
-                    return select_frozen(~a, st, st2), None
-
-                st, _ = jax.lax.scan(
-                    body, st_i, (active, xs_i), length=chunk
-                )
-                return st, conv_fn(t, st_i, st)
-
-            new_state, conv = jax.vmap(one)(arrays, state, xs)
-            new_state = select_frozen(done_mask, state, new_state)
-            return new_state, conv
-
-        donate = (1,) if donation_supported() else ()
-        return jax.jit(run_chunk, donate_argnums=donate)
+        return build_bucket_runner(adapter, meta, params, chunk)
 
     def _solve_bucket(
         self,
@@ -722,6 +804,7 @@ class BatchEngine:
         cycles: Optional[int],
         timeout: Optional[float],
         max_cycles: int,
+        on_lane_release: Optional[Callable] = None,
     ) -> List[SolveResult]:
         t0 = perf_counter()
         B = len(specs)
@@ -768,7 +851,8 @@ class BatchEngine:
             n = min(chunk, limit - done)
             keys, xs = adapter.chunk_xs(keys, n, specs, target)
             state, conv = runner(
-                arrays, state, _pad_xs(xs, chunk), n,
+                arrays, state, _pad_xs(xs, chunk),
+                jnp.full((B,), n, jnp.int32),
                 jnp.asarray(done_mask),
             )
             done += n
@@ -792,6 +876,13 @@ class BatchEngine:
                                 "label": specs[i].item.label or i,
                                 "cycle": int(stop_cycle[i]),
                             })
+                            if on_lane_release is not None:
+                                on_lane_release(
+                                    i, int(stop_cycle[i]),
+                                    jax.tree_util.tree_map(
+                                        lambda l, j=i: l[j], state
+                                    ),
+                                )
                 if done_mask.all():
                     break
             first_chunk = False

@@ -4,7 +4,8 @@ Equivalent capability to the reference's pydcop/dcop_cli.py (:62-207):
 global options (-v verbosity, --timeout with a forced-exit slack timer,
 --output, --version, --log) and the subcommand tree (solve, run,
 orchestrator, agent, distribute, graph, generate, batch, replica_dist,
-consolidate).
+consolidate) — plus ``serve``, the continuous-batching solve service
+(no reference twin; docs/serving.rst).
 """
 from __future__ import annotations
 
@@ -52,11 +53,12 @@ def make_parser() -> argparse.ArgumentParser:
         orchestrator,
         replica_dist,
         run,
+        serve,
         solve,
     )
 
     for module in (solve, run, orchestrator, agent, distribute, graph,
-                   generate, batch, replica_dist, consolidate):
+                   generate, batch, replica_dist, consolidate, serve):
         module.set_parser(subparsers)
     return parser
 

@@ -1,0 +1,213 @@
+"""`pydcop_tpu serve` — the continuous-batching solve service's CLI
+front door.
+
+Feeds a stream of jobs drawn from the given DCOP files through an
+in-process :class:`~pydcop_tpu.serve.SolveService` and prints one JSON
+object with per-job metrics, the serve counters, the compile-cache
+scorecard and the (seeded, reproducible) arrival trace.
+
+Arrival models:
+
+* ``--arrival immediate`` (default): all jobs submitted up front —
+  a burst, the serving twin of ``solve --batch``;
+* ``--arrival poisson --rate R``: seeded Poisson arrivals at ``R``
+  jobs/sec (``--arrival-seed``); the exact arrival offsets land in the
+  output JSON as ``arrival_trace`` so a run can be replayed.
+
+``--jobs N`` cycles through the files round-robin with seeds
+0..N-1; the default is one job per file.  ``--journal-dir`` makes the
+session crash-safe (submissions journaled, per-lane chunk-boundary
+checkpoints, ``JID:`` completion lines); ``--resume`` re-queues the
+journal's unfinished jobs first, re-seated at their last checkpointed
+chunk boundary.  ``--uiport`` serves the GUI websocket protocol +
+HTTP /state + SSE /events with the ``serve.*`` lifecycle topics
+forwarded.
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+from pydcop_tpu.commands._utils import output_metrics, parse_algo_params
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "serve", help="continuous-batching solve service"
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument("dcop_files", nargs="*",
+                        help="DCOP YAML file(s) — the job pool")
+    parser.add_argument("-a", "--algo", required=True,
+                        help="algorithm name")
+    parser.add_argument(
+        "-p", "--algo_params", action="append",
+        help="algorithm parameter as name:value, repeatable",
+    )
+    parser.add_argument("--jobs", type=int, default=None,
+                        help="total jobs to submit (default: one per "
+                        "file); files are cycled round-robin, seeds "
+                        "run 0..N-1")
+    parser.add_argument("--arrival", choices=["immediate", "poisson"],
+                        default="immediate",
+                        help="arrival process for the submitted jobs")
+    parser.add_argument("--rate", type=float, default=10.0,
+                        help="poisson arrival rate, jobs/sec")
+    parser.add_argument("--arrival-seed", type=int, default=0,
+                        help="seed of the Poisson arrival process "
+                        "(the trace is recorded in the output JSON)")
+    parser.add_argument("--lanes", type=int, default=4,
+                        help="lane (slot) count of each service bucket")
+    parser.add_argument("--deadline", type=float, default=None,
+                        help="per-job deadline in seconds (deadline-"
+                        "pressured lanes shrink their chunks; expired "
+                        "jobs complete as TIMEOUT and are counted "
+                        "preempted)")
+    parser.add_argument("--priority", type=int, default=0,
+                        help="priority of every submitted job (higher "
+                        "admits first)")
+    parser.add_argument("--max-cycles", type=int, default=2000,
+                        help="per-job cycle ceiling for "
+                        "run-to-convergence")
+    parser.add_argument("--prewarm", action="store_true",
+                        help="compile bucket runners for the file "
+                        "pool's shapes BEFORE starting arrivals, so "
+                        "no admission pays a cold XLA compile")
+    parser.add_argument("--journal-dir", default=None,
+                        help="crash-safe session journal + per-lane "
+                        "chunk-boundary checkpoints")
+    parser.add_argument("--resume", action="store_true",
+                        help="re-queue the journal's unfinished jobs "
+                        "(resumed from their last chunk boundary) "
+                        "before submitting new ones")
+    parser.add_argument("--uiport", type=int, default=None,
+                        help="serve the GUI websocket protocol + HTTP "
+                        "/state + SSE /events on this port (ws on "
+                        "port+1), with serve.* events forwarded")
+    return parser
+
+
+def run_cmd(args):
+    import numpy as np
+
+    from pydcop_tpu.dcop import load_dcop_from_file
+    from pydcop_tpu.serve import SolveService
+
+    if args.resume and not args.journal_dir:
+        output_metrics(
+            {"status": "ERROR",
+             "error": "--resume requires --journal-dir"},
+            args.output,
+        )
+        return 1
+    algo_params = parse_algo_params(args.algo_params)
+
+    pool, errors = [], {}
+    for fn in args.dcop_files:
+        try:
+            pool.append((fn, load_dcop_from_file([fn])))
+        except Exception as e:
+            errors[fn] = {"status": "ERROR", "error": str(e)}
+    if errors and not pool:
+        output_metrics(
+            {"status": "ERROR", "results": errors}, args.output
+        )
+        return 1
+
+    ui = None
+    if args.uiport:
+        from pydcop_tpu.runtime.events import event_bus
+        from pydcop_tpu.runtime.ui import UiServer
+
+        event_bus.enabled = True
+        ui = UiServer(port=args.uiport)
+        ui.start()
+
+    service = SolveService(
+        lanes=args.lanes,
+        max_cycles=args.max_cycles,
+        journal_dir=args.journal_dir,
+    )
+    n_resumed = 0
+    if args.resume:
+        n_resumed = service.resume()
+    if args.prewarm and pool:
+        service.prewarm(
+            [(dcop, args.algo, algo_params) for _fn, dcop in pool],
+            block=True,
+        )
+    service.start()
+
+    # arrival schedule (recorded for reproducibility)
+    n_jobs = args.jobs if args.jobs is not None else len(pool)
+    offsets = [0.0] * n_jobs
+    if args.arrival == "poisson" and n_jobs:
+        rng = np.random.default_rng(args.arrival_seed)
+        inter = rng.exponential(1.0 / max(args.rate, 1e-9), n_jobs)
+        inter[0] = 0.0
+        offsets = [float(x) for x in np.cumsum(inter)]
+    trace = [round(o, 6) for o in offsets]
+
+    jids = []
+    t0 = time.monotonic()
+    for i in range(n_jobs):
+        fn, dcop = pool[i % len(pool)] if pool else (None, None)
+        if dcop is None:
+            break
+        wait = offsets[i] - (time.monotonic() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        jids.append(service.submit(
+            dcop, args.algo, algo_params=algo_params, seed=i,
+            priority=args.priority, deadline_s=args.deadline,
+            label=f"{fn}:{i}", source_file=fn,
+        ))
+
+    # resumed jobs are part of the session too
+    all_jids = sorted(
+        set(jids) | {j for j in service._jobs if args.resume}
+    )
+    per_job = dict(errors)
+    ok = True
+    try:
+        for jid in all_jids:
+            try:
+                res = service.result(jid, timeout=args.timeout)
+            except TimeoutError:
+                per_job[jid] = {"status": "TIMEOUT",
+                                "error": "service timeout"}
+                ok = False
+                continue
+            job = service._jobs[jid]
+            m = res.metrics()
+            m["tenant"] = job.tenant
+            m["label"] = job.label
+            m["resumed"] = job.resumed
+            per_job[jid] = m
+            if res.status not in ("FINISHED", "TIMEOUT"):
+                ok = False
+    finally:
+        service.stop(drain=False)
+        if ui is not None:
+            ui.stop()
+
+    output_metrics(
+        {
+            "status": "FINISHED" if ok and not errors else "ERROR",
+            "results": per_job,
+            "serve": service.metrics(),
+            "arrival": {
+                "model": args.arrival,
+                "rate": args.rate,
+                "seed": args.arrival_seed,
+                "trace": trace,
+            },
+            "resumed_jobs": n_resumed,
+        },
+        args.output,
+    )
+    return 0 if ok and not errors else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(run_cmd(None))

@@ -71,6 +71,26 @@ def send_batch(event: str, payload) -> None:
     event_bus.send(BATCH_TOPIC_PREFIX + event, payload)
 
 
+#: solve-service topic prefix (pydcop_tpu.serve).  Topics:
+#: ``serve.job.submitted`` (jid, tenant, priority, algo),
+#: ``serve.job.admitted`` (jid, signature, lane, midflight),
+#: ``serve.job.progress`` (jid, cycle, cost — the anytime assignment
+#: stream at chunk boundaries),
+#: ``serve.job.done`` (jid, status, cycle, cost, latency),
+#: ``serve.bucket.opened`` / ``serve.bucket.merged`` /
+#: ``serve.bucket.closed`` (signature, lanes),
+#: ``serve.prewarm.scheduled`` (runners) and ``serve.resume.done``
+#: (jobs) — subscribe with ``serve.*`` (the UI server pushes them to
+#: ws/SSE clients alongside ``batch.*``/``harness.*``).
+SERVE_TOPIC_PREFIX = "serve."
+
+
+def send_serve(event: str, payload) -> None:
+    """Publish a solve-service lifecycle event on the global bus
+    (no-op unless observability is enabled)."""
+    event_bus.send(SERVE_TOPIC_PREFIX + event, payload)
+
+
 #: sharded-collective topic prefix (parallel/mesh).  Topics:
 #: ``shard.comm.selected`` (mode, collective, cut_fraction,
 #: boundary_columns, bytes_per_cycle_dense/compact, exchange_rounds —

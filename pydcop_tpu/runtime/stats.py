@@ -114,6 +114,48 @@ class BatchCounters:
         return self.counts["padded_cells"] / total if total else 0.0
 
 
+#: counter names surfaced under ``metrics()["serve"]`` by the
+#: continuous-batching solve service (pydcop_tpu.serve.SolveService) —
+#: the admission/slot-reuse scorecard of a serving session, alongside
+#: the per-sweep BatchCounters
+SERVE_COUNTERS = (
+    "jobs_submitted",         # jobs accepted by SolveService.submit
+    "jobs_admitted",          # jobs placed into a bucket lane
+    "jobs_completed",
+    "jobs_preempted",         # deadline-expired jobs evicted from lanes
+    "jobs_resumed",           # jobs restored from a journal checkpoint
+    "jobs_fallback",          # algos outside the vmap set, solved 1-by-1
+    "lanes_reused",           # admissions into a lane a prior job freed
+    "midflight_admissions",   # admissions into an already-running bucket
+    "buckets_opened",
+    "buckets_merged",         # under-filled same-signature buckets folded
+    "buckets_closed",
+    "deadline_shrunk_lanes",  # lane-chunks clamped for deadline pressure
+    "prewarmed_runners",      # runners scheduled for ahead-of-arrival compile
+    "checkpoints_saved",      # per-lane chunk-boundary snapshots written
+)
+
+
+class ServeCounters:
+    """Continuous-batching service counters collected by the
+    SolveService scheduler and merged into its run summary
+    (``SolveService.metrics()['serve']``)."""
+
+    def __init__(self):
+        self.counts = {k: 0 for k in SERVE_COUNTERS}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        if name not in self.counts:
+            raise KeyError(
+                f"unknown serve counter {name!r}; add it to "
+                f"SERVE_COUNTERS"
+            )
+        self.counts[name] += n
+
+    def as_dict(self) -> dict:
+        return dict(self.counts)
+
+
 #: counter names surfaced under ``SolveResult.metrics()["harness"]`` by
 #: the chunked solve harness (algorithms/base.SynchronousTensorSolver.run)
 #: — the device-residency scorecard of a solve: how often the host

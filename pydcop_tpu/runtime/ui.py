@@ -53,6 +53,7 @@ class UiServer:
         event_bus.subscribe("batch.*", self._cb_batch)
         event_bus.subscribe("harness.*", self._cb_harness)
         event_bus.subscribe("shard.*", self._cb_shard)
+        event_bus.subscribe("serve.*", self._cb_serve)
 
     # -- event plumbing -----------------------------------------------------
 
@@ -201,6 +202,22 @@ class UiServer:
                                                  float, bool, type(None)))
                  else repr(evt)}))
 
+    def _cb_serve(self, topic: str, evt) -> None:
+        """Solve-service lifecycle (serve.job.submitted|admitted|
+        progress|done, serve.bucket.opened|merged|closed,
+        serve.prewarm.scheduled, serve.resume.done) pushed to GUI
+        clients — the streaming front door's anytime assignments and
+        continuous-batching events ride the same channel as
+        ``batch.*``; the SSE /events stream gets them through the
+        wildcard subscription like every topic."""
+        if self._ws is not None:
+            self._ws.send_all(json.dumps(
+                {"evt": "serve",
+                 "kind": topic.split(".", 1)[-1],
+                 "data": evt if isinstance(evt, (dict, list, str, int,
+                                                 float, bool, type(None)))
+                 else repr(evt)}))
+
     def _cb_shard(self, topic: str, evt) -> None:
         """Sharded-engine collective/partition lifecycle
         (shard.comm.selected with the ShardCommCounters partition-
@@ -273,7 +290,9 @@ class UiServer:
 
     def stop(self) -> None:
         for cb in (self._on_event, self._cb_cycle, self._cb_value,
-                   self._cb_add_comp, self._cb_rem_comp, self._cb_fault):
+                   self._cb_add_comp, self._cb_rem_comp, self._cb_fault,
+                   self._cb_batch, self._cb_harness, self._cb_shard,
+                   self._cb_serve):
             event_bus.unsubscribe(cb)
         if self._server is not None:
             self._server.shutdown()

@@ -1,0 +1,33 @@
+"""Continuous-batching solve service — a streaming front door over the
+batch engine.
+
+The static entry points (``solve``, ``solve --batch``, the in-process
+``batch`` runner) consume a list of instances known up front; this
+package serves a *stream*: jobs are submitted with a tenant, a
+priority and an optional deadline, folded into already-running shape
+buckets at chunk boundaries (lane reuse when an instance converges —
+continuous batching), and their results stream back as blocking
+futures, per-job anytime-assignment iterators, and ``serve.*`` events
+on the ws/SSE channel.  See docs/serving.rst.
+"""
+from pydcop_tpu.serve.scheduler import (  # noqa: F401
+    BucketWorker,
+    dummy_bucket_inputs,
+    fits,
+    serve_target,
+    warm_bucket_runner,
+)
+from pydcop_tpu.serve.service import (  # noqa: F401
+    ServeJob,
+    SolveService,
+)
+
+__all__ = [
+    "BucketWorker",
+    "ServeJob",
+    "SolveService",
+    "dummy_bucket_inputs",
+    "fits",
+    "serve_target",
+    "warm_bucket_runner",
+]

@@ -1,0 +1,496 @@
+"""Slot scheduler — continuous batching over the batch engine's buckets.
+
+The batch engine (pydcop_tpu.batch) solves a *static* list of
+instances: every bucket is formed once, runs to completion, and the
+lanes of converged instances sit frozen until the slowest lane
+finishes.  A serving workload is the opposite shape — jobs arrive as a
+stream — so this module keeps each bucket *open*:
+
+* a :class:`BucketWorker` owns ONE compiled fixed-shape runner (the
+  engine's own, via the shared compile cache) and ``B`` lanes;
+* each lane independently carries one job at its own age: the runner's
+  per-lane ``n_active`` vector lets lane *i* advance ``n_i`` cycles
+  per step while its neighbors advance a different count;
+* when a lane's job converges (or its deadline expires) the lane is
+  released at the chunk boundary and the next queued job is written
+  into the freed slot — the LLM-serving trick called continuous
+  batching;
+* deadline-pressured lanes shrink their own per-step cycle count via
+  the harness's :func:`algorithms.base.clamp_chunk_to_deadline`, so a
+  tenant's job never overruns its budget by a whole chunk.
+
+Bit-identity is the load-bearing contract: a lane's PRNG stream is its
+OWN key advanced by the harness's exact per-chunk policy at the job's
+TRUE shape, its convergence accounting (first-chunk skip, two stable
+chunks) is the harness's own, and padding is inert by routing — so a
+job admitted into a running bucket, a job that joins a freed lane, and
+a job migrated between same-signature buckets all produce the SAME
+bits as a standalone ``solver.run``.  (The one documented exception:
+deadline-shrunk lanes change their own chunk boundaries — and with
+them their own stream — exactly like a standalone solve under a
+``timeout``; other lanes are unaffected.)
+"""
+from __future__ import annotations
+
+import dataclasses
+from time import monotonic, perf_counter
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pydcop_tpu.algorithms import DEFAULT_INFINITY
+from pydcop_tpu.algorithms.base import (
+    SolveResult,
+    clamp_chunk_to_deadline,
+    default_chunk,
+)
+from pydcop_tpu.batch.bucketing import InstanceDims, bucket_signature
+from pydcop_tpu.batch.engine import (
+    DEFAULT_MAX_CYCLES,
+    BucketMeta,
+    _params_key,
+    adapter_for,
+    build_bucket_runner,
+    pad_instance,
+    runner_cache_key,
+)
+from pydcop_tpu.runtime.stats import ServeCounters
+
+#: key object for idle lanes — never advanced, never drawn from
+_IDLE_KEY_SEED = 0
+
+
+def serve_target(members: Sequence[InstanceDims]) -> InstanceDims:
+    """Element-wise max of the members' dims with the dummy-variable
+    slot ALWAYS reserved: a serving bucket outlives its founding jobs,
+    and any later arrival smaller than the target needs the dummy to
+    route its factor/pair padding to (engine.pad_instance)."""
+    first = members[0]
+    return InstanceDims(
+        graph_type=first.graph_type,
+        D=max(m.D for m in members),
+        arities=first.arities,
+        V=max(m.V for m in members) + 1,
+        F=tuple(
+            max(m.F[i] for m in members)
+            for i in range(len(first.arities))
+        ),
+        M=max(m.M for m in members),
+    )
+
+
+def fits(dims: InstanceDims, target: InstanceDims) -> bool:
+    """Can an instance with ``dims`` be padded into ``target``?  The
+    arity set must match exactly (a missing arity bucket cannot be
+    padded in); everything else pads up, with one variable slot held
+    back for the dummy."""
+    return (
+        dims.graph_type == target.graph_type
+        and dims.arities == target.arities
+        and dims.D <= target.D
+        and dims.V <= target.V - 1
+        and all(f <= tf for f, tf in zip(dims.F, target.F))
+        and dims.M <= target.M
+    )
+
+
+def dummy_bucket_inputs(algo: str, target: InstanceDims, B: int,
+                        chunk: int):
+    """(arrays, state, xs) filler for a worker's idle lanes, at the
+    exact shapes the compiled runner expects.  Values are inert-by-
+    construction (mask selects one valid value per variable, zero cost
+    tables): idle lanes are additionally frozen by the done mask, this
+    just keeps the vmapped math NaN-free and gives prewarming concrete
+    buffers to compile against."""
+    Vp, Dp = target.V, target.D
+    mask = np.zeros((B, Vp, Dp), np.float32)
+    mask[:, :, 0] = 1.0
+    arrays: Dict[str, jnp.ndarray] = {
+        "mask": jnp.asarray(mask),
+        "unary": jnp.zeros((B, Vp, Dp), jnp.float32),
+    }
+    edges = 0
+    for i, (a, f) in enumerate(zip(target.arities, target.F)):
+        arrays[f"bt{i}"] = jnp.zeros((B, f) + (Dp,) * a, jnp.float32)
+        arrays[f"bv{i}"] = jnp.zeros((B, f, a), jnp.int32)
+        edges += f * a
+    arrays["edge_var"] = jnp.zeros((B, edges), jnp.int32)
+    if target.graph_type == "constraints_hypergraph":
+        arrays["nsrc"] = jnp.zeros((B, target.M), jnp.int32)
+        arrays["ndst"] = jnp.zeros((B, target.M), jnp.int32)
+    if algo == "gdba":
+        for i, f in enumerate(target.F):
+            arrays[f"fmin{i}"] = jnp.zeros((B, f), jnp.float32)
+            arrays[f"fmax{i}"] = jnp.zeros((B, f), jnp.float32)
+
+    x0 = jnp.zeros((B, Vp), jnp.int32)
+    if algo == "gdba":
+        ws = tuple(
+            jnp.zeros((B, f) + (Dp,) * a, jnp.float32)
+            for a, f in zip(target.arities, target.F)
+        )
+        state: Any = (x0, ws)
+    elif algo == "maxsum":
+        zq = jnp.zeros((B, edges, Dp), jnp.float32)
+        state = (zq, zq, x0)
+    else:  # mgm / dsa / adsa
+        state = (x0,)
+
+    if algo == "dsa":
+        xs: Any = jnp.ones((B, chunk, Vp), jnp.float32)
+    elif algo == "adsa":
+        ones = jnp.ones((B, chunk, Vp), jnp.float32)
+        xs = (ones, ones)
+    else:
+        xs = None
+    return arrays, state, xs
+
+
+def warm_bucket_runner(adapter, target: InstanceDims,
+                       params: Dict[str, Any], B: int, chunk: int):
+    """Build AND compile one bucket runner.  ``jax.jit`` alone defers
+    tracing and XLA compilation to the first call, so a prewarm that
+    stopped at the wrapper would still pay the cold compile at
+    admission time — this executes the runner once at the real shapes
+    (all lanes idle: ``n_active=0``, all done) so the executable is
+    resident before the first job arrives."""
+    runner = build_bucket_runner(
+        adapter, BucketMeta.of(target), params, chunk
+    )
+    arrays, state, xs = dummy_bucket_inputs(adapter.algo, target, B, chunk)
+    out = runner(
+        arrays, state, xs,
+        jnp.zeros((B,), jnp.int32), jnp.ones((B,), bool),
+    )
+    jax.block_until_ready(out)
+    return runner
+
+
+@dataclasses.dataclass
+class _Lane:
+    """One occupied lane: a job plus its private harness accounting."""
+
+    job: Any  # serve.service.ServeJob
+    spec: Any  # engine._Spec
+    key: Any  # per-lane PRNG key (the standalone harness's own stream)
+    age: int = 0  # cycles this job has run (its stop_cycle when done)
+    stable: int = 0  # consecutive stable chunks (2 → converged)
+    first_chunk: bool = True  # harness parity: skip the first conv flag
+    converged: bool = False
+
+
+class BucketWorker:
+    """One continuously-batched bucket: ``B`` lanes stepping through
+    one compiled fixed-shape runner, chunk by chunk.
+
+    The worker itself is single-threaded by contract (the service's
+    scheduler thread is its only caller); cross-thread safety lives in
+    the compile cache and the service's queues."""
+
+    def __init__(
+        self,
+        algo: str,
+        params: Optional[Dict[str, Any]],
+        target: InstanceDims,
+        lanes: int,
+        cache,
+        counters: Optional[ServeCounters] = None,
+        limit: int = DEFAULT_MAX_CYCLES,
+        chunk: Optional[int] = None,
+    ):
+        self.algo = algo
+        self.params = dict(params or {})
+        self.adapter = adapter_for(algo)
+        self.target = target
+        self.meta = BucketMeta.of(target)
+        self.B = int(lanes)
+        self.limit = int(limit)
+        # the harness's exact chunk policy: the per-chunk PRNG stream
+        # depends on it, so serve may not choose its own
+        self.chunk = (
+            chunk if chunk is not None
+            else default_chunk(None, False, False, None, self.limit)
+        )
+        self.counters = counters if counters is not None else ServeCounters()
+        self.pkey = _params_key(self.params)
+        self.signature = bucket_signature(target, self.B)
+        key = runner_cache_key(algo, self.pkey, self.signature, self.chunk)
+        self.runner, self.runner_was_warm = cache.get_or_build(
+            key,
+            lambda: warm_bucket_runner(
+                self.adapter, target, self.params, self.B, self.chunk
+            ),
+        )
+        self.arrays, self.state, _ = dummy_bucket_inputs(
+            algo, target, self.B, self.chunk
+        )
+        self.lanes: List[Optional[_Lane]] = [None] * self.B
+        self._used = [False] * self.B  # slot hosted a previous job
+        self._idle_key = jax.random.PRNGKey(_IDLE_KEY_SEED)
+        self.steps = 0  # chunk boundaries crossed
+        self.rate: Optional[float] = None  # measured cycles/sec (EMA)
+
+    # -- occupancy ----------------------------------------------------------
+
+    @property
+    def occupied(self) -> int:
+        return sum(1 for ln in self.lanes if ln is not None)
+
+    @property
+    def free(self) -> int:
+        return self.B - self.occupied
+
+    def matches(self, algo: str, pkey: Tuple) -> bool:
+        return self.algo == algo and self.pkey == pkey
+
+    # -- admission / release ------------------------------------------------
+
+    def admit(self, job, spec, restore: Optional[Tuple] = None) -> int:
+        """Fold one job into a free lane at the current chunk boundary.
+
+        ``restore = (state, key, age, stable, first_chunk)`` re-seats a
+        journal-checkpointed job exactly where it stopped; otherwise
+        the lane starts the job's own fresh harness stream
+        (``PRNGKey(seed)``, cycle 0)."""
+        i = self.lanes.index(None)
+        arrs = {
+            **pad_instance(spec.tensors, self.target),
+            **self.adapter.extra_arrays(spec, self.target),
+        }
+        for k, v in arrs.items():
+            self.arrays[k] = self.arrays[k].at[i].set(jnp.asarray(v))
+        if restore is not None:
+            st, key, age, stable, first = restore
+        else:
+            st = self.adapter.initial_state(spec, self.target)
+            key = jax.random.PRNGKey(job.seed)
+            age, stable, first = 0, 0, True
+        self.state = jax.tree_util.tree_map(
+            lambda L, s: L.at[i].set(jnp.asarray(s)), self.state, st
+        )
+        self.lanes[i] = _Lane(job=job, spec=spec, key=key, age=age,
+                              stable=stable, first_chunk=first)
+        self.counters.inc("jobs_admitted")
+        if self._used[i]:
+            self.counters.inc("lanes_reused")
+        self._used[i] = True
+        if self.steps > 0:
+            self.counters.inc("midflight_admissions")
+        return i
+
+    def release(self, i: int) -> None:
+        self.lanes[i] = None
+
+    def migrate_from(self, other: "BucketWorker") -> int:
+        """Fold ``other``'s occupied lanes into this worker's free
+        lanes — the under-filled-bucket merge.  Only legal between
+        workers of the SAME signature (identical padded shapes): state
+        rows then copy verbatim and every lane's stream continues
+        bit-identically."""
+        assert other.signature == self.signature
+        assert other.matches(self.algo, self.pkey)
+        moved = 0
+        for j, lane in enumerate(other.lanes):
+            if lane is None:
+                continue
+            try:
+                i = self.lanes.index(None)
+            except ValueError:
+                break
+            for k in self.arrays:
+                self.arrays[k] = self.arrays[k].at[i].set(
+                    other.arrays[k][j]
+                )
+            self.state = jax.tree_util.tree_map(
+                lambda L, S: L.at[i].set(S[j]), self.state, other.state
+            )
+            self.lanes[i] = lane
+            if self._used[i]:
+                self.counters.inc("lanes_reused")
+            self._used[i] = True
+            other.lanes[j] = None
+            moved += 1
+        return moved
+
+    # -- the chunk step -----------------------------------------------------
+
+    def step(self) -> List[Tuple[int, _Lane, str]]:
+        """Advance every occupied lane one chunk; returns the lanes
+        that finished this boundary as ``(index, lane, status)``.  The
+        caller reads results / releases lanes / admits replacements —
+        all at this boundary, which is what makes the batching
+        continuous."""
+        t0 = perf_counter()
+        now = monotonic()
+        ns: List[int] = []
+        keys: List[Any] = []
+        specs: List[Optional[Any]] = []
+        for lane in self.lanes:
+            if lane is None or lane.converged:
+                ns.append(0)
+                keys.append(lane.key if lane else self._idle_key)
+                specs.append(None)
+                continue
+            n = min(self.chunk, self.limit - lane.age)
+            if lane.job.deadline_at is not None:
+                n2 = clamp_chunk_to_deadline(
+                    n, self.rate, lane.job.deadline_at - now
+                )
+                if n2 < n:
+                    self.counters.inc("deadline_shrunk_lanes")
+                n = n2
+            ns.append(n)
+            keys.append(lane.key)
+            specs.append(lane.spec)
+        new_keys, xs = self.adapter.chunk_xs_per_lane(
+            keys, ns, specs, self.target, self.chunk
+        )
+        done_mask = np.array(
+            [ln is None or ln.converged for ln in self.lanes], bool
+        )
+        self.state, conv = self.runner(
+            self.arrays, self.state, xs,
+            jnp.asarray(np.asarray(ns, np.int32)),
+            jnp.asarray(done_mask),
+        )
+        conv_np = np.asarray(conv)  # the step's ONE device→host read
+        wall = perf_counter() - t0
+        self.steps += 1
+        advanced = max(ns) if ns else 0
+        if wall > 0 and advanced:
+            inst = advanced / wall
+            self.rate = (
+                inst if self.rate is None else 0.5 * self.rate + 0.5 * inst
+            )
+
+        finished: List[Tuple[int, _Lane, str]] = []
+        deadline_now = monotonic()
+        for i, lane in enumerate(self.lanes):
+            if lane is None or lane.converged:
+                continue
+            lane.key = new_keys[i]
+            lane.age += int(ns[i])
+            status = None
+            if lane.first_chunk:
+                # harness parity: the first chunk's flag compares
+                # against the initial state and is skipped
+                lane.first_chunk = False
+            else:
+                lane.stable = lane.stable + 1 if conv_np[i] else 0
+                if lane.stable >= 2:
+                    status = "FINISHED"
+                    lane.converged = True
+            if status is None and lane.age >= self.limit:
+                status = "FINISHED"
+            if (
+                status is None
+                and lane.job.deadline_at is not None
+                and deadline_now >= lane.job.deadline_at
+            ):
+                status = "TIMEOUT"
+            if status is not None:
+                finished.append((i, lane, status))
+        return finished
+
+    # -- results / inspection ----------------------------------------------
+
+    def lane_values(self, i: int, lane: _Lane) -> np.ndarray:
+        """Host copy of lane ``i``'s TRUE-shape value indices."""
+        lane_state = jax.tree_util.tree_map(lambda L: L[i], self.state)
+        vals = np.asarray(self.adapter.values_np(lane_state))
+        return vals[: lane.spec.dims.V]
+
+    def lane_result(self, i: int, lane: _Lane, status: str) -> SolveResult:
+        assignment = lane.spec.tensors.assignment_from_indices(
+            self.lane_values(i, lane)
+        )
+        violation, cost = lane.job.dcop.solution_cost(
+            assignment, DEFAULT_INFINITY
+        )
+        solver = lane.spec.solver
+        n_cyc = int(lane.age)
+        return SolveResult(
+            status=status,
+            assignment=assignment,
+            cost=cost,
+            violation=violation,
+            cycle=n_cyc,
+            msg_count=solver.msgs_per_cycle * n_cyc,
+            msg_size=(solver.msgs_per_cycle * n_cyc
+                      * solver.msg_size_per_msg),
+            time=monotonic() - lane.job.submitted_at,
+        )
+
+    def lane_cost(self, i: int, lane: _Lane) -> Tuple[float, int]:
+        """(cost, cycle) of the lane's current anytime assignment —
+        the per-boundary progress stream."""
+        assignment = lane.spec.tensors.assignment_from_indices(
+            self.lane_values(i, lane)
+        )
+        _violation, cost = lane.job.dcop.solution_cost(
+            assignment, DEFAULT_INFINITY
+        )
+        return cost, int(lane.age)
+
+    # -- checkpointing ------------------------------------------------------
+
+    def lane_checkpoint(self, i: int, lane: _Lane):
+        """(arrays, meta) snapshot of one lane at the current chunk
+        boundary, for runtime/checkpoint.write_state_npz.  The graph
+        arrays are NOT stored — they recompile deterministically from
+        the job's source file + seed; only the lane's state leaves,
+        key and harness accounting are."""
+        lane_state = jax.tree_util.tree_map(
+            lambda L: np.asarray(L[i]), self.state
+        )
+        leaves, _treedef = jax.tree_util.tree_flatten(lane_state)
+        arrays = {f"leaf_{j}": np.asarray(l) for j, l in enumerate(leaves)}
+        arrays["prng_key"] = np.asarray(lane.key)
+        meta = {
+            "jid": lane.job.jid,
+            "algo": self.algo,
+            "age": int(lane.age),
+            "stable": int(lane.stable),
+            "first_chunk": bool(lane.first_chunk),
+            "n_leaves": len(leaves),
+            "target": dataclasses.asdict(self.target),
+        }
+        return arrays, meta
+
+
+def restore_lane_state(adapter, spec, target: InstanceDims,
+                       arrays: Dict[str, np.ndarray], meta: Dict) -> Tuple:
+    """Rebuild a lane's ``(state, key, age, stable, first_chunk)``
+    restore tuple from a checkpoint container.  The leaf order/shapes
+    come from the adapter's own initial-state structure at the SAME
+    target the checkpoint was taken at (the caller guarantees the
+    match), so a schema drift fails loudly instead of mis-seating."""
+    ref = adapter.initial_state(spec, target)
+    ref_leaves, treedef = jax.tree_util.tree_flatten(ref)
+    n = int(meta["n_leaves"])
+    if n != len(ref_leaves):
+        raise ValueError(
+            f"checkpoint for {meta.get('jid')!r} has {n} state leaves, "
+            f"solver expects {len(ref_leaves)}"
+        )
+    leaves = []
+    for j, ref_leaf in enumerate(ref_leaves):
+        leaf = np.asarray(arrays[f"leaf_{j}"])
+        if leaf.shape != np.asarray(ref_leaf).shape:
+            raise ValueError(
+                f"checkpoint leaf {j} shape {leaf.shape} does not match "
+                f"solver state shape {np.asarray(ref_leaf).shape}"
+            )
+        leaves.append(leaf)
+    state = jax.tree_util.tree_unflatten(treedef, leaves)
+    key = jnp.asarray(arrays["prng_key"])
+    return (
+        state,
+        key,
+        int(meta["age"]),
+        int(meta["stable"]),
+        bool(meta["first_chunk"]),
+    )

@@ -1,0 +1,811 @@
+"""SolveService — the streaming front door over the batch engine.
+
+A persistent in-process solve service: callers :meth:`submit` jobs
+(instance + tenant + priority + optional deadline) from any thread, a
+single scheduler thread routes them by shape signature into
+continuously-batched :class:`~pydcop_tpu.serve.scheduler.BucketWorker`
+buckets, and results stream back three ways — :meth:`result` (blocking
+future), :meth:`stream` (per-job anytime-assignment iterator) and the
+``serve.*`` topics on the process event bus (forwarded to ws/SSE GUI
+clients by runtime/ui.py).
+
+Scheduling policy, in the order the tick applies it:
+
+1. **admission** — pending jobs (highest priority first, FIFO within a
+   priority) fold into the free lanes of a running bucket whose target
+   shape fits them; what remains opens new buckets, preferring
+   prewarmed signatures so admission never pays a cold XLA compile on
+   the hot path;
+2. **stepping** — every occupied bucket advances one chunk; lanes that
+   converge (or expire their deadline — counted as preempted) complete
+   their jobs and free their slots at that same boundary;
+3. **maintenance** — empty buckets close, and two under-filled buckets
+   of the same signature merge (lane states copy verbatim, streams
+   continue bit-identically).
+
+Crash safety rides the PR 1 checkpoint/JID layer: with a
+``journal_dir`` every submission is journaled (``jobs.jsonl``), every
+completion registers a ``JID:`` line (the batch command's resume
+protocol), and every occupied lane snapshots its state at periodic
+chunk boundaries (atomic + CRC, runtime/checkpoint).  A restarted
+service :meth:`resume`-s: completed jobs are skipped, in-flight jobs
+re-seat at their last checkpointed chunk boundary and continue the
+SAME stream — their results stay bit-identical to an uninterrupted
+solve.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import queue
+import threading
+from collections import deque
+from time import monotonic
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from pydcop_tpu.algorithms.base import SolveResult
+from pydcop_tpu.batch.bucketing import InstanceDims, bucket_signature
+from pydcop_tpu.batch.cache import CompileCache, global_compile_cache
+from pydcop_tpu.batch.engine import (
+    DEFAULT_MAX_CYCLES,
+    SUPPORTED_ALGOS,
+    BatchItem,
+    BucketMeta,
+    _params_key,
+    adapter_for,
+    runner_cache_key,
+)
+from pydcop_tpu.runtime.events import event_bus, send_serve
+from pydcop_tpu.runtime.stats import ServeCounters
+from pydcop_tpu.serve.scheduler import (
+    BucketWorker,
+    fits,
+    restore_lane_state,
+    serve_target,
+    warm_bucket_runner,
+)
+
+#: journal file names inside ``journal_dir``
+JOBS_JOURNAL = "jobs.jsonl"
+PROGRESS_FILE = "progress_serve"
+CKPT_SUBDIR = "ckpt"
+
+
+@dataclasses.dataclass
+class ServeJob:
+    """One submitted job and its runtime bookkeeping."""
+
+    jid: str
+    dcop: Any
+    algo: str
+    algo_params: Dict[str, Any]
+    seed: int
+    tenant: str
+    priority: int
+    deadline_s: Optional[float]
+    deadline_at: Optional[float]  # monotonic absolute deadline
+    label: Optional[str]
+    source_file: Optional[str]
+    stream: bool
+    submitted_at: float
+    seq: int
+    # scheduler-side state
+    spec: Any = None
+    spec_future: Any = None  # in-flight background spec build
+    restore: Optional[Tuple] = None  # checkpointed lane restore tuple
+    resumed: bool = False
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event
+    )
+    result: Optional[SolveResult] = None
+    events: "queue.Queue" = dataclasses.field(
+        default_factory=lambda: queue.Queue(maxsize=1024)
+    )
+
+    def restore_target(self) -> InstanceDims:
+        """The exact padded target a checkpointed job must re-seat at
+        (its state leaves are target-shaped)."""
+        assert self.restore is not None
+        t = dict(self.restore[0]["target"])
+        t["arities"] = tuple(t["arities"])
+        t["F"] = tuple(t["F"])
+        return InstanceDims(**t)
+
+    def emit(self, event: str, payload: Dict[str, Any]) -> None:
+        send_serve(event, payload)
+        if self.stream:
+            try:
+                self.events.put_nowait({"event": event, **payload})
+            except queue.Full:  # slow consumer: drop, never block solve
+                pass
+
+
+class SolveService:
+    """Continuous-batching solve service over the batch engine.
+
+    >>> # sketch:
+    >>> # svc = SolveService(lanes=8)
+    >>> # svc.start()
+    >>> # jid = svc.submit(dcop, "mgm", tenant="t1", priority=1)
+    >>> # res = svc.result(jid, timeout=30)
+    >>> # svc.stop()
+
+    ``lanes`` is the slot count of each bucket the service opens.
+    ``cache=None`` shares the process-wide compile cache (so a restart
+    in the same process reuses every compiled runner); pass a fresh
+    :class:`CompileCache` to isolate (the tests do).  With
+    ``journal_dir`` the service is crash-safe — see the module
+    docstring.  ``start()`` spawns the scheduler thread; tests may
+    instead drive :meth:`tick` synchronously for deterministic
+    schedules.
+    """
+
+    def __init__(
+        self,
+        lanes: int = 4,
+        cache: Optional[CompileCache] = None,
+        counters: Optional[ServeCounters] = None,
+        max_cycles: int = DEFAULT_MAX_CYCLES,
+        journal_dir: Optional[str] = None,
+        checkpoint_every: int = 4,
+        merge_below: float = 0.5,
+        tick_interval: float = 0.02,
+        max_buckets: Optional[int] = None,
+    ):
+        self.lanes = int(lanes)
+        self.max_buckets = max_buckets
+        self.cache = cache if cache is not None else global_compile_cache()
+        self.counters = counters if counters is not None else ServeCounters()
+        self.max_cycles = int(max_cycles)
+        self.journal_dir = journal_dir
+        self.checkpoint_every = int(checkpoint_every)
+        self.merge_below = float(merge_below)
+        self.tick_interval = float(tick_interval)
+
+        self._jobs: Dict[str, ServeJob] = {}
+        self._pending: "deque[ServeJob]" = deque()
+        self._workers: List[BucketWorker] = []
+        self._prewarmed: Dict[Tuple[str, Tuple], List[InstanceDims]] = {}
+        self._lock = threading.RLock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._prep_pool = None  # spec-build executor (started threads)
+        self._seq = 0
+        self._done_jids: set = set()
+        if journal_dir:
+            os.makedirs(os.path.join(journal_dir, CKPT_SUBDIR),
+                        exist_ok=True)
+            self._done_jids = self._load_done_jids()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._stop = False
+        # instance compilation (spec building) runs OFF the scheduler
+        # thread so admission prep overlaps bucket stepping; manual
+        # tick() driving (tests) stays synchronous — no pool, specs
+        # build inline, schedules are deterministic
+        self._prep_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="serve-prep"
+        )
+        self._thread = threading.Thread(
+            target=self._loop, name="solve-service", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None
+             ) -> None:
+        """Stop the scheduler thread.  ``drain=True`` waits until every
+        submitted job completed (bounded by ``timeout``);
+        ``drain=False`` abandons in-flight work where it stands — with
+        a journal this is the crash-with-checkpoints path a later
+        :meth:`resume` recovers from."""
+        if drain:
+            self.wait_all(timeout=timeout)
+        self._stop = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._prep_pool is not None:
+            self._prep_pool.shutdown(wait=False)
+            self._prep_pool = None
+
+    def __enter__(self) -> "SolveService":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop(drain=not any(exc))
+
+    def wait_all(self, timeout: Optional[float] = None) -> bool:
+        """Block until every submitted job is done; False on timeout."""
+        deadline = None if timeout is None else monotonic() + timeout
+        for job in list(self._jobs.values()):
+            remain = (
+                None if deadline is None else max(0.0, deadline - monotonic())
+            )
+            if not job.done.wait(remain):
+                return False
+        return True
+
+    # -- front door ---------------------------------------------------------
+
+    def submit(
+        self,
+        dcop,
+        algo: str,
+        algo_params: Optional[Dict[str, Any]] = None,
+        seed: int = 0,
+        tenant: str = "default",
+        priority: int = 0,
+        deadline_s: Optional[float] = None,
+        label: Optional[str] = None,
+        source_file: Optional[str] = None,
+        stream: bool = False,
+        spec: Any = None,
+        _jid: Optional[str] = None,
+        _journal: bool = True,
+    ) -> str:
+        """Enqueue one solve job; returns its job id immediately.
+
+        ``priority`` orders admission (higher first, FIFO within a
+        level); ``deadline_s`` is a per-tenant latency budget in
+        seconds from now — the scheduler shrinks the job's chunks as
+        the budget tightens and completes it as ``TIMEOUT`` (counted
+        preempted) when it expires.  ``source_file`` makes the job
+        crash-resumable when the service has a journal.  ``spec``
+        optionally hands over an already-compiled instance (the batch
+        engine's adapter spec) — callers that prepare instances
+        themselves skip the service's prep stage entirely."""
+        with self._lock:
+            self._seq += 1
+            if _jid is not None:
+                # a resumed job keeps its journaled id; advance the
+                # sequence past it so fresh submissions cannot collide
+                tail = _jid.rsplit("-", 1)[-1]
+                if tail.isdigit():
+                    self._seq = max(self._seq, int(tail))
+            jid = _jid or f"job-{self._seq:06d}"
+            job = ServeJob(
+                jid=jid,
+                dcop=dcop,
+                algo=algo,
+                algo_params=dict(algo_params or {}),
+                seed=int(seed),
+                tenant=tenant,
+                priority=int(priority),
+                deadline_s=deadline_s,
+                deadline_at=(
+                    monotonic() + deadline_s
+                    if deadline_s is not None else None
+                ),
+                label=label,
+                source_file=source_file,
+                stream=stream,
+                submitted_at=monotonic(),
+                seq=self._seq,
+            )
+            job.spec = spec
+            self._jobs[jid] = job
+            self._pending.append(job)
+        if (
+            job.spec is None
+            and self._prep_pool is not None
+            and algo in SUPPORTED_ALGOS
+        ):
+            job.spec_future = self._prep_pool.submit(
+                self._build_spec, job
+            )
+        self.counters.inc("jobs_submitted")
+        if _journal:
+            self._journal_submit(job)
+        job.emit("job.submitted", {
+            "jid": jid, "tenant": tenant, "priority": job.priority,
+            "algo": algo,
+        })
+        self._wake.set()
+        return jid
+
+    def result(self, jid: str, timeout: Optional[float] = None
+               ) -> SolveResult:
+        """Block until job ``jid`` completes and return its result."""
+        job = self._jobs[jid]
+        if not job.done.wait(timeout):
+            raise TimeoutError(f"job {jid} not done within {timeout}s")
+        assert job.result is not None
+        return job.result
+
+    def stream(self, jid: str, timeout: float = 60.0
+               ) -> Iterator[Dict[str, Any]]:
+        """Iterate job ``jid``'s lifecycle events — admission, anytime
+        assignments at chunk boundaries (``job.progress``: cycle +
+        current cost), completion — until the job is done.  The job
+        must have been submitted with ``stream=True``."""
+        job = self._jobs[jid]
+        while True:
+            try:
+                evt = job.events.get(timeout=timeout)
+            except queue.Empty:
+                return
+            yield evt
+            if evt.get("event") == "job.done":
+                return
+
+    def metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            workers = [
+                {"algo": w.algo, "signature": list(map(str, w.signature)),
+                 "occupied": w.occupied, "lanes": w.B, "steps": w.steps}
+                for w in self._workers
+            ]
+            pending = len(self._pending)
+        return {
+            "serve": self.counters.as_dict(),
+            "cache": self.cache.stats(),
+            "workers": workers,
+            "pending": pending,
+        }
+
+    # -- prewarm ------------------------------------------------------------
+
+    def prewarm(
+        self,
+        items: Sequence[Tuple],
+        lanes: Optional[int] = None,
+        block: bool = False,
+    ) -> None:
+        """Compile bucket runners for expected traffic ahead of
+        arrival.  ``items`` is a sequence of ``(dcop, algo)`` or
+        ``(dcop, algo, algo_params)`` tuples describing the shapes the
+        service expects; one runner compiles per (algo, params, shape
+        family) at the pooled serve target, on the compile cache's
+        background thread (``block=True`` joins — tests and
+        warm-before-open services).  Buckets opened later for fitting
+        traffic resolve to the SAME cache key, so their admission is a
+        hit, not a cold compile."""
+        lanes = int(lanes or self.lanes)
+        groups: Dict[Tuple, Dict[str, Any]] = {}
+        for it in items:
+            dcop, algo = it[0], it[1]
+            params = dict(it[2]) if len(it) > 2 and it[2] else {}
+            if algo not in SUPPORTED_ALGOS:
+                continue
+            adapter = adapter_for(algo)
+            spec = adapter.build_spec(
+                BatchItem(dcop, algo, algo_params=params)
+            )
+            g = groups.setdefault(
+                (algo, _params_key(params), spec.dims.family_key),
+                {"adapter": adapter, "params": params, "dims": []},
+            )
+            g["dims"].append(spec.dims)
+        from pydcop_tpu.algorithms.base import default_chunk
+
+        entries = []
+        for (algo, pkey, _fam), g in sorted(
+            groups.items(), key=lambda kv: str(kv[0])
+        ):
+            target = serve_target(g["dims"])
+            self._prewarmed.setdefault((algo, pkey), []).append(target)
+            # the worker's own chunk policy (the PRNG stream depends on
+            # it, so the prewarmed key must use the same)
+            chunk = default_chunk(None, False, False, None,
+                                  self.max_cycles)
+            key = runner_cache_key(
+                algo, pkey, bucket_signature(target, lanes), chunk
+            )
+            adapter, params = g["adapter"], g["params"]
+            entries.append((
+                key,
+                lambda a=adapter, t=target, p=params, b=lanes, c=chunk:
+                warm_bucket_runner(a, t, p, b, c),
+            ))
+        self.counters.inc("prewarmed_runners", len(entries))
+        send_serve("prewarm.scheduled", {"runners": len(entries)})
+        if entries:
+            self.cache.prewarm(entries, block=block)
+
+    # -- scheduler ----------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop:
+            busy = self.tick()
+            if not busy:
+                self._wake.wait(self.tick_interval)
+                self._wake.clear()
+
+    def tick(self) -> bool:
+        """One synchronous scheduler pass: admissions, one chunk step
+        per occupied bucket (completions + slot reuse at each
+        boundary), then maintenance.  Returns True while work remains.
+        The background thread just calls this in a loop; tests call it
+        directly for deterministic schedules."""
+        self._admit_pending()
+        for w in list(self._workers):
+            if w.occupied == 0:
+                continue
+            finished = w.step()
+            for i, lane, status in finished:
+                res = w.lane_result(i, lane, status)
+                w.release(i)
+                self._complete(lane.job, res)
+            self._progress_events(w)
+            self._checkpoint_worker(w)
+        # boundary admissions into lanes just freed — this is the
+        # continuous part of the batching
+        self._admit_pending()
+        self._maintain_workers()
+        with self._lock:
+            return bool(self._pending) or any(
+                w.occupied for w in self._workers
+            )
+
+    def _admit_pending(self) -> None:
+        with self._lock:
+            pending = sorted(
+                self._pending, key=lambda j: (-j.priority, j.seq)
+            )
+            self._pending.clear()
+        leftover: List[ServeJob] = []
+        not_ready: List[ServeJob] = []
+        for job in pending:
+            ready = self._prepare(job)
+            if ready is False:
+                continue
+            if ready is None:  # spec still building in the background
+                not_ready.append(job)
+                continue
+            if job.algo not in SUPPORTED_ALGOS:
+                self._solve_fallback(job)
+                continue
+            if not self._try_admit(job):
+                leftover.append(job)
+        if not_ready:
+            with self._lock:
+                self._pending.extend(not_ready)
+        # open new buckets for whatever could not fold in — bounded by
+        # ``max_buckets``: beyond it jobs queue for the next freed lane
+        # instead of growing the working set without limit
+        while leftover:
+            if (
+                self.max_buckets is not None
+                and len(self._workers) >= self.max_buckets
+            ):
+                with self._lock:
+                    self._pending.extend(leftover)
+                break
+            leftover = self._open_worker_for(leftover)
+        return
+
+    @staticmethod
+    def _build_spec(job: ServeJob):
+        return adapter_for(job.algo).build_spec(BatchItem(
+            job.dcop, job.algo, algo_params=job.algo_params,
+            seed=job.seed, label=job.label,
+        ))
+
+    def _prepare(self, job: ServeJob) -> Optional[bool]:
+        """Resolve the job's compiled spec.  True → ready; None → a
+        background build is still in flight (the job stays pending,
+        nothing blocks); False → the build failed and the job completed
+        as ERROR instead of poisoning the scheduler."""
+        if job.spec is not None or job.algo not in SUPPORTED_ALGOS:
+            return True
+        try:
+            if job.spec_future is not None:
+                if not job.spec_future.done():
+                    return None
+                job.spec = job.spec_future.result()
+                job.spec_future = None
+            else:
+                job.spec = self._build_spec(job)
+            return True
+        except Exception as e:
+            self._complete(job, SolveResult(
+                status="ERROR", assignment={}, cost=None, violation=None,
+                cycle=0, msg_count=0, msg_size=0.0,
+                time=monotonic() - job.submitted_at,
+            ), error=str(e))
+            return False
+
+    def _try_admit(self, job: ServeJob) -> bool:
+        pkey = _params_key(job.algo_params)
+        for w in self._workers:
+            if not (w.matches(job.algo, pkey) and w.free > 0):
+                continue
+            if job.restore is not None:
+                # a checkpointed job must re-seat at the exact target
+                # it was padded at — state shapes are target-shaped
+                if w.target != job.restore_target():
+                    continue
+            elif not fits(job.spec.dims, w.target):
+                continue
+            self._admit_into(w, job)
+            return True
+        return False
+
+    def _admit_into(self, w: BucketWorker, job: ServeJob) -> None:
+        midflight = w.steps > 0
+        restore = None
+        if job.restore is not None:
+            restore = restore_lane_state(
+                w.adapter, job.spec, w.target,
+                job.restore[1], job.restore[0],
+            )
+            job.restore = None
+            job.resumed = True
+            self.counters.inc("jobs_resumed")
+        lane = w.admit(job, job.spec, restore=restore)
+        job.emit("job.admitted", {
+            "jid": job.jid, "lane": lane, "midflight": midflight,
+            "resumed": job.resumed,
+            "signature": [str(s) for s in w.signature],
+        })
+
+    def _open_worker_for(self, jobs: List[ServeJob]) -> List[ServeJob]:
+        """Open ONE bucket for the head job's group; admit every
+        group-mate that fits; return the jobs still waiting (the
+        caller loops)."""
+        head = jobs[0]
+        pkey = _params_key(head.algo_params)
+        if head.restore is not None:
+            target = head.restore_target()
+        else:
+            group_dims = [
+                j.spec.dims for j in jobs
+                if j.algo == head.algo
+                and _params_key(j.algo_params) == pkey
+                and j.restore is None
+                and j.spec.dims.family_key == head.spec.dims.family_key
+            ]
+            target = self._pick_target(head.algo, pkey, group_dims)
+        w = BucketWorker(
+            head.algo, head.algo_params, target, self.lanes,
+            self.cache, counters=self.counters, limit=self.max_cycles,
+        )
+        self._workers.append(w)
+        self.counters.inc("buckets_opened")
+        send_serve("bucket.opened", {
+            "algo": w.algo, "lanes": w.B, "warm": w.runner_was_warm,
+            "signature": [str(s) for s in w.signature],
+        })
+        leftover = []
+        for job in jobs:
+            if (
+                w.free > 0
+                and w.matches(job.algo, _params_key(job.algo_params))
+                and (
+                    (job.restore is not None
+                     and w.target == job.restore_target())
+                    or (job.restore is None
+                        and fits(job.spec.dims, w.target))
+                )
+            ):
+                self._admit_into(w, job)
+            else:
+                leftover.append(job)
+        return leftover
+
+    def _pick_target(self, algo: str, pkey: Tuple,
+                     dims: List[InstanceDims]) -> InstanceDims:
+        """Prefer a prewarmed or already-compiled signature that fits
+        the whole group — admission then hits the warm runner — else
+        the group's own pooled target."""
+        candidates = list(self._prewarmed.get((algo, pkey), []))
+        candidates += [
+            w.target for w in self._workers if w.matches(algo, pkey)
+        ]
+        for t in candidates:
+            if all(fits(d, t) for d in dims):
+                return t
+        return serve_target(dims)
+
+    def _maintain_workers(self) -> None:
+        # merge under-filled same-signature buckets (smaller → larger)
+        by_sig: Dict[Tuple, List[BucketWorker]] = {}
+        for w in self._workers:
+            if 0 < w.occupied <= max(1, int(w.B * self.merge_below)):
+                by_sig.setdefault(
+                    (w.algo, w.pkey) + w.signature, []
+                ).append(w)
+        for _sig, ws in by_sig.items():
+            if len(ws) < 2:
+                continue
+            ws.sort(key=lambda w: -w.occupied)
+            dst = ws[0]
+            for src in ws[1:]:
+                if dst.free < src.occupied:
+                    continue
+                moved = dst.migrate_from(src)
+                if moved:
+                    self.counters.inc("buckets_merged")
+                    send_serve("bucket.merged", {
+                        "algo": dst.algo, "moved": moved,
+                        "signature": [str(s) for s in dst.signature],
+                    })
+        # close drained buckets (their compiled runner stays cached)
+        for w in list(self._workers):
+            if w.occupied == 0 and w.steps > 0:
+                self._workers.remove(w)
+                self.counters.inc("buckets_closed")
+                send_serve("bucket.closed", {
+                    "algo": w.algo,
+                    "signature": [str(s) for s in w.signature],
+                })
+
+    def _progress_events(self, w: BucketWorker) -> None:
+        """Anytime assignments at the chunk boundary, for jobs that
+        asked to stream (or any bus subscriber).  Gated so a service
+        with nobody listening pays zero extra host pulls."""
+        for i, lane in enumerate(w.lanes):
+            if lane is None:
+                continue
+            if not (lane.job.stream or event_bus.enabled):
+                continue
+            cost, cycle = w.lane_cost(i, lane)
+            lane.job.emit("job.progress", {
+                "jid": lane.job.jid, "cycle": cycle, "cost": cost,
+            })
+
+    def _solve_fallback(self, job: ServeJob) -> None:
+        """Algorithms outside the batched set solve sequentially on
+        the scheduler thread — counted, never silently dropped."""
+        from pydcop_tpu.runtime.run import solve_result
+
+        self.counters.inc("jobs_fallback")
+        try:
+            res = solve_result(
+                job.dcop, job.algo, algo_params=job.algo_params,
+                seed=job.seed,
+            )
+        except Exception as e:
+            self._complete(job, SolveResult(
+                status="ERROR", assignment={}, cost=None, violation=None,
+                cycle=0, msg_count=0, msg_size=0.0,
+                time=monotonic() - job.submitted_at,
+            ), error=str(e))
+            return
+        res.time = monotonic() - job.submitted_at
+        self._complete(job, res)
+
+    def _complete(self, job: ServeJob, res: SolveResult,
+                  error: Optional[str] = None) -> None:
+        job.result = res
+        self.counters.inc("jobs_completed")
+        if res.status == "TIMEOUT" and job.deadline_at is not None:
+            self.counters.inc("jobs_preempted")
+        self._journal_done(job.jid)
+        self._drop_checkpoint(job.jid)
+        payload = {
+            "jid": job.jid, "status": res.status, "cycle": res.cycle,
+            "cost": res.cost, "latency": round(res.time, 4),
+        }
+        if error:
+            payload["error"] = error
+        job.emit("job.done", payload)
+        job.done.set()
+
+    # -- journal / crash resume --------------------------------------------
+
+    def _journal_submit(self, job: ServeJob) -> None:
+        if not self.journal_dir:
+            return
+        rec = {
+            "jid": job.jid, "file": job.source_file, "algo": job.algo,
+            "algo_params": job.algo_params, "seed": job.seed,
+            "tenant": job.tenant, "priority": job.priority,
+            "deadline_s": job.deadline_s, "label": job.label,
+        }
+        path = os.path.join(self.journal_dir, JOBS_JOURNAL)
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def _journal_done(self, jid: str) -> None:
+        self._done_jids.add(jid)
+        if not self.journal_dir:
+            return
+        # the batch command's JID resume protocol: append + fsync per
+        # job, so a kill -9 loses at most the in-flight work
+        path = os.path.join(self.journal_dir, PROGRESS_FILE)
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(f"JID: {jid}\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def _load_done_jids(self) -> set:
+        path = os.path.join(self.journal_dir, PROGRESS_FILE)
+        if not os.path.exists(path):
+            return set()
+        with open(path, encoding="utf-8") as f:
+            return {
+                line[5:].strip() for line in f if line.startswith("JID: ")
+            }
+
+    def _ckpt_path(self, jid: str) -> str:
+        return os.path.join(self.journal_dir, CKPT_SUBDIR, f"{jid}.npz")
+
+    def _checkpoint_worker(self, w: BucketWorker) -> None:
+        if not self.journal_dir or self.checkpoint_every <= 0:
+            return
+        if w.steps % self.checkpoint_every != 0:
+            return
+        from pydcop_tpu.runtime.checkpoint import write_state_npz
+
+        for i, lane in enumerate(w.lanes):
+            if lane is None or lane.job.source_file is None:
+                continue
+            arrays, meta = w.lane_checkpoint(i, lane)
+            write_state_npz(self._ckpt_path(lane.job.jid), arrays, meta)
+            self.counters.inc("checkpoints_saved")
+
+    def _drop_checkpoint(self, jid: str) -> None:
+        if not self.journal_dir:
+            return
+        try:
+            os.unlink(self._ckpt_path(jid))
+        except OSError:
+            pass
+
+    def resume(self) -> int:
+        """Re-submit every journaled job that never registered its
+        ``JID:`` completion line.  Jobs with a valid per-lane
+        checkpoint re-seat at their last chunk boundary (their PRNG
+        key, age and stability counters restored — the continuation is
+        bit-identical to an uninterrupted run); jobs without one
+        restart from cycle 0.  Returns the number of jobs re-queued."""
+        if not self.journal_dir:
+            return 0
+        from pydcop_tpu.dcop import load_dcop_from_file
+        from pydcop_tpu.runtime.checkpoint import read_state_npz
+
+        path = os.path.join(self.journal_dir, JOBS_JOURNAL)
+        if not os.path.exists(path):
+            return 0
+        n = 0
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                jid = rec["jid"]
+                if jid in self._done_jids or jid in self._jobs:
+                    continue
+                if not rec.get("file"):
+                    continue  # not resumable without a source
+                try:
+                    dcop = load_dcop_from_file([rec["file"]])
+                except Exception:
+                    continue
+                self.submit(
+                    dcop, rec["algo"],
+                    algo_params=rec.get("algo_params") or {},
+                    seed=int(rec.get("seed", 0)),
+                    tenant=rec.get("tenant", "default"),
+                    priority=int(rec.get("priority", 0)),
+                    deadline_s=rec.get("deadline_s"),
+                    label=rec.get("label"),
+                    source_file=rec["file"],
+                    _jid=jid, _journal=False,
+                )
+                job = self._jobs[jid]
+                ck = self._ckpt_path(jid)
+                if os.path.exists(ck):
+                    try:
+                        meta, arrays = read_state_npz(ck)
+                        job.restore = (meta, arrays)
+                    except ValueError:
+                        job.restore = None  # corrupt: restart from 0
+                n += 1
+        send_serve("resume.done", {"jobs": n})
+        self._wake.set()
+        return n

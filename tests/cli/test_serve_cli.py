@@ -1,0 +1,146 @@
+"""CLI surface of the continuous-batching solve service: ``pydcop_tpu
+serve`` (the `make serve-smoke` scenario: a short Poisson burst through
+the in-process service on the CPU backend, every job completing with
+the standalone solve's exact cost).
+
+The kill-9 crash/resume integration test is ``slow``-marked: it runs a
+real service subprocess, SIGKILLs it mid-stream and verifies the
+restarted service resumes the in-flight jobs via the JID protocol.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+TUTO = os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+CSP = os.path.join(INSTANCES, "coloring_csp.yaml")
+
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "PYTHONPATH": REPO,
+}
+
+
+def run_cli(*args, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu", *args],
+        capture_output=True, text=True, timeout=timeout, env=ENV,
+        cwd=REPO,
+    )
+
+
+class TestServeSmoke:
+    def test_poisson_burst_all_jobs_complete_with_correct_costs(self):
+        """`make serve-smoke`: a seeded Poisson burst of 6 jobs over
+        two instance shapes; every job must FINISH with exactly the
+        cost AND stop cycle of the standalone solve of its
+        (file, seed) — the bit-identity contract, asserted end to end
+        through the CLI."""
+        from pydcop_tpu.dcop import load_dcop_from_file
+        from pydcop_tpu.runtime.run import solve_result
+
+        proc = run_cli(
+            "serve", "-a", "mgm", "--jobs", "6",
+            "--arrival", "poisson", "--rate", "50",
+            "--arrival-seed", "7", "--lanes", "2",
+            "--max-cycles", "2000", "--prewarm", TUTO, CSP,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout)
+        assert out["status"] == "FINISHED"
+        assert len(out["results"]) == 6
+        dcops = {f: load_dcop_from_file([f]) for f in (TUTO, CSP)}
+        for jid, m in out["results"].items():
+            assert m["status"] == "FINISHED", (jid, m)
+            fn, seed = m["label"].rsplit(":", 1)
+            seq = solve_result(dcops[fn], "mgm", seed=int(seed))
+            assert m["cost"] == seq.cost, (jid, m)
+            assert m["cycle"] == seq.cycle, (jid, m)
+            assert m["assignment"] == seq.assignment, (jid, m)
+        serve = out["serve"]["serve"]
+        assert serve["jobs_completed"] == 6
+        assert serve["prewarmed_runners"] >= 1
+        # the seeded trace is recorded and reproducible in length
+        assert len(out["arrival"]["trace"]) == 6
+        assert out["arrival"]["seed"] == 7
+
+    def test_arrival_trace_is_reproducible(self):
+        """Two runs with the same arrival seed record the same trace."""
+        traces = []
+        for _ in range(2):
+            proc = run_cli(
+                "serve", "-a", "mgm", "--jobs", "3",
+                "--arrival", "poisson", "--rate", "100",
+                "--arrival-seed", "13", "--lanes", "2", TUTO,
+            )
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            traces.append(json.loads(proc.stdout)["arrival"]["trace"])
+        assert traces[0] == traces[1]
+
+    def test_resume_requires_journal(self):
+        proc = run_cli("serve", "-a", "mgm", "--resume", TUTO)
+        assert proc.returncode == 1
+        assert "journal" in json.loads(proc.stdout)["error"]
+
+
+@pytest.mark.slow
+class TestServeCrashResume:
+    def test_kill9_midstream_then_resume_completes_all(self, tmp_path):
+        """Acceptance pin: kill the service mid-stream (SIGKILL, no
+        cleanup); a restarted service with --resume completes every
+        journaled job, the previously in-flight ones restored from
+        their last chunk-boundary checkpoints."""
+        journal = str(tmp_path / "journal")
+        # a big enough burst that jobs are still in flight when the
+        # kill lands; checkpoints are written every chunk boundary
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "pydcop_tpu", "serve", "-a", "dsa",
+             "--jobs", "8", "--arrival", "poisson", "--rate", "20",
+             "--arrival-seed", "3", "--lanes", "2",
+             "--max-cycles", "2000", "--journal-dir", journal,
+             TUTO, CSP],
+            env=ENV, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        # wait for the journal to show submissions, then kill -9
+        jobs_file = os.path.join(journal, "jobs.jsonl")
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if os.path.exists(jobs_file) and os.path.getsize(jobs_file):
+                break
+            time.sleep(0.05)
+        else:
+            proc.kill()
+            raise AssertionError("service never journaled a job")
+        time.sleep(0.3)  # let some jobs get in flight / checkpoint
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+
+        with open(jobs_file, encoding="utf-8") as f:
+            journaled = [json.loads(line)["jid"] for line in f if
+                         line.strip()]
+        assert journaled
+
+        # restart with --resume and no new jobs
+        proc2 = run_cli(
+            "serve", "-a", "dsa", "--jobs", "0",
+            "--journal-dir", journal, "--resume", TUTO,
+        )
+        assert proc2.returncode == 0, proc2.stderr[-2000:]
+        out = json.loads(proc2.stdout)
+        # every journaled job either completed before the kill (its
+        # JID: line survived) or was resumed and completed now
+        progress = os.path.join(journal, "progress_serve")
+        with open(progress, encoding="utf-8") as f:
+            done = {line[5:].strip() for line in f
+                    if line.startswith("JID: ")}
+        assert set(journaled) <= done
+        for jid, m in out["results"].items():
+            assert m["status"] == "FINISHED", (jid, m)

@@ -261,6 +261,36 @@ class TestEventsAndCounters:
         assert seen and seen[0][1]["label"] == "tuto"
         assert engine.metrics()["cache"]["misses"] >= 1
 
+    def test_on_lane_release_hook(self):
+        """The per-lane slot-release hook (the serve scheduler's feed):
+        fires once per converging instance with the lane index, the
+        stop cycle the [B] mask would only reveal in aggregate, and the
+        lane's final state (device-sliced, values readable)."""
+        import numpy as np
+
+        dcops = [_load(f) for f in FILES[:2]]
+        released = []
+
+        def hook(lane, stop_cycle, final_state):
+            released.append(
+                (lane, stop_cycle, np.asarray(final_state[0]))
+            )
+
+        engine = BatchEngine(cache=CompileCache(), max_padding_waste=0.9)
+        results = engine.solve(
+            [BatchItem(d, "mgm", seed=0) for d in dcops],
+            on_lane_release=hook,
+        )
+        assert len(released) == len(dcops)
+        # lanes are bucket-internal (size-sorted) indices, one each
+        assert sorted(lane for lane, _c, _s in released) == [0, 1]
+        # each release reports a stop cycle matching some result's, and
+        # the lane's final state row (device-sliced, values readable)
+        assert (sorted(c for _l, c, _s in released)
+                == sorted(r.cycle for r in results))
+        for _lane, _c, state in released:
+            assert state.ndim == 1
+
     def test_fallback_sequential_counted(self):
         engine = BatchEngine(cache=CompileCache())
         res = engine.solve(

@@ -136,6 +136,114 @@ def test_events_are_pushed(served_orchestrator):
         c.close()
 
 
+@pytest.mark.parametrize("topic,evt_name,payload", [
+    ("serve.job.submitted", "serve",
+     {"jid": "job-000001", "tenant": "t1", "priority": 2,
+      "algo": "mgm"}),
+    ("serve.job.admitted", "serve",
+     {"jid": "job-000001", "lane": 1, "midflight": True,
+      "resumed": False}),
+    ("serve.job.progress", "serve",
+     {"jid": "job-000001", "cycle": 14, "cost": 3.0}),
+    ("serve.job.done", "serve",
+     {"jid": "job-000001", "status": "FINISHED", "cycle": 21,
+      "cost": 12.0, "latency": 0.4}),
+    ("serve.bucket.opened", "serve",
+     {"algo": "mgm", "lanes": 4, "warm": True}),
+    ("batch.bucket.formed", "batch", {"algo": "mgm", "size": 3}),
+    ("harness.run.done", "harness", {"algo": "mgm", "cycle": 21}),
+])
+def test_lifecycle_topics_forwarded(served_orchestrator, topic,
+                                    evt_name, payload):
+    """The serve.* lifecycle topics — the streaming front door's
+    events — must reach ws clients in the same envelope shape as the
+    established batch.*/harness.* forwarding (pinned here alongside
+    them): {"evt": <family>, "kind": <topic tail>, "data": payload}."""
+    _, ui = served_orchestrator
+    c = WsClient(ui.ws_port)
+    try:
+        _wait_clients(ui, 1)
+        was_enabled = event_bus.enabled
+        event_bus.enabled = True
+        try:
+            event_bus.send(topic, payload)
+        finally:
+            event_bus.enabled = was_enabled
+        msg = c.recv_json()
+        assert msg == {
+            "evt": evt_name,
+            "kind": topic.split(".", 1)[-1],
+            "data": payload,
+        }
+    finally:
+        c.close()
+
+
+def test_serve_events_forwarded_from_real_service(served_orchestrator):
+    """End to end: an actual SolveService run pushes its serve.*
+    lifecycle over the websocket — submitted, admitted, done."""
+    from pydcop_tpu.batch.cache import CompileCache
+    from pydcop_tpu.dcop import load_dcop_from_file
+    from pydcop_tpu.serve import SolveService
+
+    _, ui = served_orchestrator
+    dcop = load_dcop_from_file(
+        os.path.join(INSTANCES, "graph_coloring_tuto.yaml"))
+    c = WsClient(ui.ws_port)
+    try:
+        _wait_clients(ui, 1)
+        was_enabled = event_bus.enabled
+        event_bus.enabled = True
+        try:
+            svc = SolveService(lanes=1, cache=CompileCache(),
+                               max_cycles=63)
+            jid = svc.submit(dcop, "mgm", seed=0)
+            for _ in range(60):
+                if not svc.tick():
+                    break
+            assert svc.result(jid, timeout=1).status == "FINISHED"
+        finally:
+            event_bus.enabled = was_enabled
+        kinds = []
+        while "job.done" not in kinds:
+            msg = c.recv_json()
+            # the service's compile-cache activity rides batch.* on
+            # the same channel; only the serve.* envelope is under test
+            if msg.get("evt") != "serve":
+                continue
+            kinds.append(msg["kind"])
+        assert "job.submitted" in kinds
+        assert "job.admitted" in kinds
+        assert "bucket.opened" in kinds
+    finally:
+        c.close()
+
+
+def test_serve_events_on_sse_stream(served_orchestrator):
+    """The HTTP /events SSE endpoint carries serve.* topics through
+    the wildcard subscription (no websocket client needed)."""
+    import http.client
+
+    _, ui = served_orchestrator
+    conn = http.client.HTTPConnection("127.0.0.1", ui.port, timeout=5)
+    conn.request("GET", "/events")
+    resp = conn.getresponse()
+    assert resp.status == 200
+    time.sleep(0.1)  # subscriber registration
+    was_enabled = event_bus.enabled
+    event_bus.enabled = True
+    try:
+        event_bus.send("serve.job.done", {"jid": "j1",
+                                          "status": "FINISHED"})
+    finally:
+        event_bus.enabled = was_enabled
+    line = resp.fp.readline().decode()
+    assert line.startswith("data: ")
+    body = json.loads(line[6:])
+    assert body["topic"] == "serve.job.done"
+    conn.close()
+
+
 def test_close_message_on_stop(served_orchestrator):
     _, ui = served_orchestrator
     c = WsClient(ui.ws_port)
