@@ -2,7 +2,7 @@
 
 .PHONY: test unit api cli doctest all-tests bench bench-probe faults \
 	bench-batch batch-smoke bench-harness bench-sharded bench-serve \
-	serve-smoke
+	serve-smoke chaos-smoke
 
 test: all-tests
 
@@ -65,10 +65,21 @@ bench-serve:
 # short Poisson burst through the in-process solve service on the CPU
 # backend: every job must complete with the standalone solve's exact
 # cost (the tier-1 serve CLI scenario, runnable standalone); the
-# long service soak/crash tests are slow-marked
+# long service soak/crash tests are slow-marked — see also
+# chaos-smoke below for the fault-injected twin
 serve-smoke:
 	JAX_PLATFORMS=cpu python -m pytest \
 		tests/cli/test_serve_cli.py -q -m 'not slow'
+
+# the seeded serve fault plan driven end-to-end through a real service
+# process: raise_in_step / nan_lane / torn_journal_write / stall_tick,
+# each exercising the supervised-scheduler + poison-quarantine
+# machinery (docs/serving.rst "Failure model and overload behavior");
+# slow-marked, so it does NOT run in tier-1 — run it next to
+# serve-smoke whenever touching the serving layer
+chaos-smoke:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/cli/test_serve_cli.py -q -m slow -k chaos
 
 # fault-tolerance suite only (docs/resilience.rst); tier-1 subset —
 # the multi-process crash tests beyond ~30s are marked slow

@@ -609,8 +609,16 @@ def build_bucket_runner(adapter: _AdapterBase, meta: BucketMeta,
     XLA executable instead of compiling their own shape) and
     already-converged instances per ``done_mask`` — both through the
     harness's shared :func:`algorithms.base.select_frozen` helper.
-    Also computes the per-instance device convergence vector, so the
-    host's per-chunk read is [B] bools, not two state pytrees.  State
+
+    Returns ``(new_state, flags)`` where ``flags`` is a ``[2, B]`` bool
+    matrix read in ONE device→host pull per chunk: ``flags[0]`` is the
+    per-instance convergence vector and ``flags[1]`` a per-lane
+    finiteness flag over the state's float leaves — the cheap
+    device-side NaN/Inf check that lets the serve quarantine isolate a
+    poisoned lane at the chunk boundary it goes bad, instead of
+    shipping garbage assignments or crashing a whole bucket.  (Pure
+    integer states — mgm/dsa/adsa — are trivially finite; their
+    poison detection happens host-side on the final cost.)  State
     buffers are donated where the backend aliases them."""
     cycle = adapter.make_cycle(params)
     conv_fn = adapter.make_converged(params)
@@ -628,11 +636,19 @@ def build_bucket_runner(adapter: _AdapterBase, meta: BucketMeta,
             st, _ = jax.lax.scan(
                 body, st_i, (active, xs_i), length=chunk
             )
-            return st, conv_fn(t, st_i, st)
+            fin = jnp.asarray(True)
+            for leaf in jax.tree_util.tree_leaves(st):
+                if jnp.issubdtype(leaf.dtype, jnp.floating):
+                    fin = fin & jnp.all(jnp.isfinite(leaf))
+            return st, conv_fn(t, st_i, st), fin
 
-        new_state, conv = jax.vmap(one)(arrays, state, xs, n_active)
+        new_state, conv, finite = jax.vmap(one)(
+            arrays, state, xs, n_active
+        )
         new_state = select_frozen(done_mask, state, new_state)
-        return new_state, conv
+        # frozen lanes hold their (already vetted) state
+        finite = jnp.where(done_mask, True, finite)
+        return new_state, jnp.stack([conv, finite])
 
     donate = (1,) if donation_supported() else ()
     return jax.jit(run_chunk, donate_argnums=donate)
@@ -714,9 +730,11 @@ class BatchEngine:
         moment one instance of a bucket converges and stops advancing —
         the per-lane slot-release hook the continuous-batching
         scheduler (pydcop_tpu.serve) consumes, instead of only the
-        bucket-level ``[B]`` mask.  ``final_state`` is the lane's state
-        pytree sliced on device (no host pull unless the callback reads
-        it).
+        bucket-level ``[B]`` mask.  It also fires for a lane frozen
+        ``ERROR`` by the chunk-boundary NaN/Inf check (counted
+        ``lanes_nonfinite`` — the corresponding result's status tells
+        the two apart).  ``final_state`` is the lane's state pytree
+        sliced on device (no host pull unless the callback reads it).
         """
         t0 = perf_counter()
         self.counters.inc("instances_enqueued", len(items))
@@ -850,7 +868,7 @@ class BatchEngine:
         while done < limit:
             n = min(chunk, limit - done)
             keys, xs = adapter.chunk_xs(keys, n, specs, target)
-            state, conv = runner(
+            state, flags = runner(
                 arrays, state, _pad_xs(xs, chunk),
                 jnp.full((B,), n, jnp.int32),
                 jnp.asarray(done_mask),
@@ -859,11 +877,34 @@ class BatchEngine:
             stop_cycle[~done_mask] = done
 
             if target_cycles is None:
-                # per-instance convergence rides the runner's [B] bool
-                # vector — the only device→host read of the chunk; the
-                # first chunk's flags (vs the initial state) are
-                # skipped, mirroring the sequential harness
-                conv_np = np.asarray(conv)
+                # per-instance convergence + finiteness ride the
+                # runner's [2, B] bool matrix — the only device→host
+                # read of the chunk; the first chunk's convergence
+                # flags (vs the initial state) are skipped, mirroring
+                # the sequential harness
+                flags_np = np.asarray(flags)
+                conv_np, finite_np = flags_np[0], flags_np[1]
+                for i in range(B):
+                    # a lane whose float state went NaN/Inf is frozen
+                    # ERROR at this boundary: one bad instance never
+                    # poisons its bucket-mates' cycles
+                    if done_mask[i] or finite_np[i]:
+                        continue
+                    done_mask[i] = True
+                    statuses[i] = "ERROR"
+                    self.counters.inc("lanes_nonfinite")
+                    send_batch("lane.nonfinite", {
+                        "label": specs[i].item.label or i,
+                        "lane": i,
+                        "cycle": int(stop_cycle[i]),
+                    })
+                    if on_lane_release is not None:
+                        on_lane_release(
+                            i, int(stop_cycle[i]),
+                            jax.tree_util.tree_map(
+                                lambda l, j=i: l[j], state
+                            ),
+                        )
                 if not first_chunk:
                     for i in range(B):
                         if done_mask[i]:

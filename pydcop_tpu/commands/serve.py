@@ -22,6 +22,14 @@ journal's unfinished jobs first, re-seated at their last checkpointed
 chunk boundary.  ``--uiport`` serves the GUI websocket protocol +
 HTTP /state + SSE /events with the ``serve.*`` lifecycle topics
 forwarded.
+
+Overload + chaos (docs/serving.rst "Failure model and overload
+behavior"): ``--max-pending`` / ``--tenant-quota`` turn on admission
+control — rejected submits land in the output JSON's ``rejected`` list
+with their retry-after hints, never dropped silently — and
+``--fault-plan plan.yaml`` arms the seeded serve fault injector
+(``make chaos-smoke`` drives the whole quarantine/supervision
+machinery through it).
 """
 from __future__ import annotations
 
@@ -73,6 +81,21 @@ def set_parser(subparsers):
                         help="compile bucket runners for the file "
                         "pool's shapes BEFORE starting arrivals, so "
                         "no admission pays a cold XLA compile")
+    parser.add_argument("--max-pending", type=int, default=None,
+                        help="bound on the not-yet-admitted queue: "
+                        "submits beyond it are shed with a structured "
+                        "overload error (a lower-priority queued job "
+                        "is displaced instead when the arrival "
+                        "outranks it)")
+    parser.add_argument("--tenant-quota", type=int, default=None,
+                        help="max open (unfinished) jobs per tenant; "
+                        "submits over quota are rejected with a "
+                        "retry-after hint")
+    parser.add_argument("--fault-plan", default=None,
+                        help="seeded serve fault plan YAML (chaos "
+                        "injection: raise_in_step / nan_lane / "
+                        "torn_journal_write / stall_tick — "
+                        "docs/serving.rst 'Failure model')")
     parser.add_argument("--journal-dir", default=None,
                         help="crash-safe session journal + per-lane "
                         "chunk-boundary checkpoints")
@@ -123,10 +146,27 @@ def run_cmd(args):
         ui = UiServer(port=args.uiport)
         ui.start()
 
+    fault_plan = None
+    if args.fault_plan:
+        from pydcop_tpu.runtime.faults import FaultPlan
+
+        try:
+            fault_plan = FaultPlan.from_yaml(args.fault_plan)
+        except (OSError, ValueError) as e:
+            output_metrics(
+                {"status": "ERROR",
+                 "error": f"bad fault plan: {e}"},
+                args.output,
+            )
+            return 1
+
     service = SolveService(
         lanes=args.lanes,
         max_cycles=args.max_cycles,
         journal_dir=args.journal_dir,
+        max_pending=args.max_pending,
+        tenant_quota=args.tenant_quota,
+        fault_plan=fault_plan,
     )
     n_resumed = 0
     if args.resume:
@@ -148,7 +188,9 @@ def run_cmd(args):
         offsets = [float(x) for x in np.cumsum(inter)]
     trace = [round(o, 6) for o in offsets]
 
-    jids = []
+    from pydcop_tpu.serve import ServeError, ServiceOverloaded
+
+    jids, rejected = [], []
     t0 = time.monotonic()
     for i in range(n_jobs):
         fn, dcop = pool[i % len(pool)] if pool else (None, None)
@@ -157,11 +199,19 @@ def run_cmd(args):
         wait = offsets[i] - (time.monotonic() - t0)
         if wait > 0:
             time.sleep(wait)
-        jids.append(service.submit(
-            dcop, args.algo, algo_params=algo_params, seed=i,
-            priority=args.priority, deadline_s=args.deadline,
-            label=f"{fn}:{i}", source_file=fn,
-        ))
+        try:
+            jids.append(service.submit(
+                dcop, args.algo, algo_params=algo_params, seed=i,
+                priority=args.priority, deadline_s=args.deadline,
+                label=f"{fn}:{i}", source_file=fn,
+            ))
+        except ServeError as e:
+            # admission control said no: a structured, recorded
+            # rejection — never a silent drop
+            rej = {"label": f"{fn}:{i}", "error": str(e)}
+            if isinstance(e, ServiceOverloaded):
+                rej.update(e.to_dict())
+            rejected.append(rej)
 
     # resumed jobs are part of the session too
     all_jids = sorted(
@@ -176,6 +226,10 @@ def run_cmd(args):
             except TimeoutError:
                 per_job[jid] = {"status": "TIMEOUT",
                                 "error": "service timeout"}
+                ok = False
+                continue
+            except ServeError as e:
+                per_job[jid] = {"status": "ERROR", "error": str(e)}
                 ok = False
                 continue
             job = service._jobs[jid]
@@ -202,6 +256,7 @@ def run_cmd(args):
                 "seed": args.arrival_seed,
                 "trace": trace,
             },
+            "rejected": rejected,
             "resumed_jobs": n_resumed,
         },
         args.output,

@@ -80,8 +80,19 @@ def send_batch(event: str, payload) -> None:
 #: ``serve.bucket.opened`` / ``serve.bucket.merged`` /
 #: ``serve.bucket.closed`` (signature, lanes),
 #: ``serve.prewarm.scheduled`` (runners) and ``serve.resume.done``
-#: (jobs) — subscribe with ``serve.*`` (the UI server pushes them to
-#: ws/SSE clients alongside ``batch.*``/``harness.*``).
+#: (jobs) — plus the fault-isolation/overload surface (ISSUE 7):
+#: ``serve.fault.injected`` (a fault-plan serve fault fired),
+#: ``serve.fault.bucket_failed`` / ``serve.fault.bisect`` (a bucket
+#: step threw; its jobs split into isolated suspect groups),
+#: ``serve.fault.nan_lane`` (non-finite lane state/cost detected),
+#: ``serve.fault.retry`` / ``serve.fault.quarantined`` (the poison-job
+#: escalation ladder), ``serve.fault.scheduler_restart`` /
+#: ``serve.fault.scheduler_dead`` (the supervisor), ``serve.job.shed``
+#: / ``serve.job.rejected`` (admission control), ``serve.stream.lossy``
+#: (a slow stream consumer started dropping progress events) and
+#: ``serve.journal.torn`` / ``serve.journal.compacted`` — subscribe
+#: with ``serve.*`` (the UI server pushes them to ws/SSE clients
+#: alongside ``batch.*``/``harness.*``).
 SERVE_TOPIC_PREFIX = "serve."
 
 

@@ -49,8 +49,16 @@ ENV_FAULT_PLAN = "PYDCOP_TPU_FAULT_PLAN"
 #: +1 per watchdog relaunch) so faults can target one attempt only
 ENV_FAULT_ATTEMPT = "PYDCOP_TPU_FAULT_ATTEMPT"
 
+#: serve-layer fault kinds (consumed by ServeFaultInjector /
+#: pydcop_tpu.serve.SolveService) — ``raise_in_step`` throws inside a
+#: bucket's chunk step, ``nan_lane`` poisons one lane's float state,
+#: ``torn_journal_write`` cuts a journal append short mid-line, and
+#: ``stall_tick`` wedges one scheduler tick for ``duration`` seconds
+SERVE_KINDS = ("raise_in_step", "nan_lane", "torn_journal_write",
+               "stall_tick")
+
 KINDS = ("kill_rank", "stall_rank", "kill_agent", "corrupt_checkpoint",
-         "truncate_checkpoint")
+         "truncate_checkpoint") + SERVE_KINDS
 
 
 @dataclasses.dataclass
@@ -63,11 +71,16 @@ class Fault:
 
     kind: str
     rank: Optional[int] = None  # kill_rank / stall_rank
-    cycle: int = 0
-    duration: float = 0.0  # stall_rank: seconds stopped
+    cycle: int = 0  # rank faults: cycle-chunk boundary; serve: tick
+    duration: float = 0.0  # stall_rank / stall_tick: seconds stopped
     agent: Optional[str] = None  # kill_agent
     path: Optional[str] = None  # checkpoint faults: explicit file
     attempt: Optional[int] = 0
+    #: serve faults: target job id.  A serve fault WITHOUT a jid fires
+    #: once (a transient glitch the service must absorb); WITH a jid it
+    #: keeps firing for that job (a poison job the quarantine must
+    #: escalate to a terminal ERROR).
+    jid: Optional[str] = None
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -77,8 +90,8 @@ class Fault:
             )
         if self.kind in ("kill_rank", "stall_rank") and self.rank is None:
             raise ValueError(f"{self.kind} fault needs a 'rank'")
-        if self.kind == "stall_rank" and self.duration <= 0:
-            raise ValueError("stall_rank fault needs a 'duration' > 0")
+        if self.kind in ("stall_rank", "stall_tick") and self.duration <= 0:
+            raise ValueError(f"{self.kind} fault needs a 'duration' > 0")
         if self.kind == "kill_agent" and not self.agent:
             raise ValueError("kill_agent fault needs an 'agent'")
 
@@ -111,6 +124,14 @@ class FaultPlan:
           - kind: corrupt_checkpoint   # or truncate_checkpoint
             attempt: 1        # mangle the latest snapshot before
                               # relaunch attempt 1 resumes from it
+          - kind: raise_in_step        # serve: throw in a bucket step
+            jid: job-000002   # poison job (persists until ERROR);
+            cycle: 2          # first scheduler tick >= 2
+          - kind: nan_lane             # serve: NaN a lane's state
+            jid: job-000002
+          - kind: torn_journal_write   # serve: cut an append mid-line
+          - kind: stall_tick           # serve: wedge one tick
+            duration: 0.5
     """
 
     faults: List[Fault] = dataclasses.field(default_factory=list)
@@ -177,10 +198,77 @@ class FaultPlan:
                    if f.attempt is None or f.attempt == attempt]
         return out
 
+    def serve_faults(self) -> List[Fault]:
+        return [f for f in self.faults if f.kind in SERVE_KINDS]
+
     @property
     def has_rank_faults(self) -> bool:
         return any(f.kind in ("kill_rank", "stall_rank")
                    for f in self.faults)
+
+
+# --------------------------------------------------------------------------
+# serve-side injection (pydcop_tpu.serve.SolveService)
+# --------------------------------------------------------------------------
+
+class InjectedFault(RuntimeError):
+    """Raised by :class:`ServeFaultInjector` to simulate a component
+    failure (``raise_in_step``) — handled by the same isolation
+    machinery (bucket quarantine, supervisor backoff) as a real
+    exception, which is the point."""
+
+
+class ServeFaultInjector:
+    """Consulted by the solve service's scheduler at tick boundaries.
+
+    ``due(kind, tick, ...)`` returns the first pending fault of that
+    kind whose ``cycle`` (tick threshold) has been reached and whose
+    target matches.  One-shot vs persistent semantics follow the
+    fault's ``jid``:
+
+    * ``jid=None`` — a *transient* fault: consumed on first fire.  The
+      service should absorb it (quarantine retry, supervisor restart)
+      and every job should still complete correctly.
+    * ``jid`` set — a *poison job*: the fault keeps firing whenever
+      that job is in the blast radius, so the retry →
+      sequential-fallback escalation must end the job in a terminal
+      ``ERROR`` — never take down its bucket-mates, let alone the
+      service.  :meth:`poisoned` lets the fallback path honor the
+      persistence too.
+    """
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._pending: List[Fault] = list(plan.serve_faults())
+        self.fired: List[Fault] = []
+
+    def due(self, kind: str, tick: int,
+            jid: Optional[str] = None,
+            jids: Optional[Sequence[str]] = None) -> Optional[Fault]:
+        for f in list(self._pending):
+            if f.kind != kind or f.cycle > tick:
+                continue
+            if f.jid is not None:
+                if jids is not None:
+                    if f.jid not in jids:
+                        continue
+                elif jid is not None:
+                    if f.jid != jid:
+                        continue
+                else:
+                    continue  # targeted fault, no target in scope
+                # persistent: a poison job stays poisoned
+            else:
+                self._pending.remove(f)
+            self.fired.append(f)
+            return f
+        return None
+
+    def poisoned(self, jid: str) -> bool:
+        """True while a persistent (jid-targeted) fault still targets
+        ``jid`` — the sequential-fallback escalation checks this so an
+        injected poison job cannot 'recover' by falling back."""
+        return any(f.jid == jid for f in self._pending)
 
 
 # --------------------------------------------------------------------------

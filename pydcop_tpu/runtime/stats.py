@@ -87,6 +87,8 @@ BATCH_COUNTERS = (
     "fallback_sequential",    # algos outside the vmap set, solved 1-by-1
     "padded_cells",           # stacked array cells holding padding
     "stacked_cells",          # total stacked array cells
+    "lanes_nonfinite",        # lanes frozen ERROR on the device-side
+                              # NaN/Inf check at a chunk boundary
 )
 
 
@@ -133,6 +135,20 @@ SERVE_COUNTERS = (
     "deadline_shrunk_lanes",  # lane-chunks clamped for deadline pressure
     "prewarmed_runners",      # runners scheduled for ahead-of-arrival compile
     "checkpoints_saved",      # per-lane chunk-boundary snapshots written
+    # -- fault isolation / overload (ISSUE 7): the alerting surface of
+    # a production service — docs/serving.rst "Failure model"
+    "scheduler_restarts",     # supervisor relaunches of the tick loop
+    "buckets_failed",         # bucket workers torn down by a step exception
+    "jobs_retried",           # quarantine retry re-admissions (with backoff)
+    "jobs_quarantined",       # poison jobs escalated to sequential fallback
+    "lanes_nan",              # non-finite lane detections (state or cost)
+    "jobs_shed",              # overload rejections + displaced pending jobs
+    "quota_rejections",       # submits rejected by the per-tenant quota
+    "ticks_stalled",          # injected stall_tick faults absorbed
+    "faults_injected",        # serve fault-plan faults fired (any kind)
+    "events_dropped",         # per-job stream events dropped (slow consumer)
+    "torn_journal_lines",     # journal lines skipped as torn on resume
+    "journal_compactions",    # jobs.jsonl compaction rewrites
 )
 
 

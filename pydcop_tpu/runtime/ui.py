@@ -205,11 +205,15 @@ class UiServer:
     def _cb_serve(self, topic: str, evt) -> None:
         """Solve-service lifecycle (serve.job.submitted|admitted|
         progress|done, serve.bucket.opened|merged|closed,
-        serve.prewarm.scheduled, serve.resume.done) pushed to GUI
-        clients — the streaming front door's anytime assignments and
-        continuous-batching events ride the same channel as
-        ``batch.*``; the SSE /events stream gets them through the
-        wildcard subscription like every topic."""
+        serve.prewarm.scheduled, serve.resume.done) plus the
+        fault-isolation surface (serve.fault.injected|bucket_failed|
+        bisect|nan_lane|retry|quarantined|scheduler_restart|
+        scheduler_dead, serve.job.shed|rejected, serve.stream.lossy,
+        serve.journal.torn|compacted) pushed to GUI clients — the
+        streaming front door's anytime assignments, continuous-
+        batching events and chaos/overload alerts ride the same
+        channel as ``batch.*``; the SSE /events stream gets them
+        through the wildcard subscription like every topic."""
         if self._ws is not None:
             self._ws.send_all(json.dumps(
                 {"evt": "serve",

@@ -10,6 +10,12 @@ continuous batching), and their results stream back as blocking
 futures, per-job anytime-assignment iterators, and ``serve.*`` events
 on the ws/SSE channel.  See docs/serving.rst.
 """
+from pydcop_tpu.serve.errors import (  # noqa: F401
+    DeadlineInfeasible,
+    ServeError,
+    ServiceOverloaded,
+    ServiceStopped,
+)
 from pydcop_tpu.serve.scheduler import (  # noqa: F401
     BucketWorker,
     dummy_bucket_inputs,
@@ -24,7 +30,11 @@ from pydcop_tpu.serve.service import (  # noqa: F401
 
 __all__ = [
     "BucketWorker",
+    "DeadlineInfeasible",
+    "ServeError",
     "ServeJob",
+    "ServiceOverloaded",
+    "ServiceStopped",
     "SolveService",
     "dummy_bucket_inputs",
     "fits",

@@ -232,6 +232,14 @@ class BucketWorker:
         self._idle_key = jax.random.PRNGKey(_IDLE_KEY_SEED)
         self.steps = 0  # chunk boundaries crossed
         self.rate: Optional[float] = None  # measured cycles/sec (EMA)
+        #: lanes whose float state went NaN/Inf on the LAST step —
+        #: refreshed per step from the runner's finiteness flags; the
+        #: service quarantines them before reading results
+        self.nonfinite: List[int] = []
+        #: quarantine isolation tag: a worker only admits jobs carrying
+        #: the SAME tag (None = regular traffic), so bisected suspect
+        #: groups cannot re-contaminate healthy buckets
+        self.isolate_key: Optional[str] = None
 
     # -- occupancy ----------------------------------------------------------
 
@@ -283,6 +291,28 @@ class BucketWorker:
 
     def release(self, i: int) -> None:
         self.lanes[i] = None
+
+    def poison_lane(self, i: int) -> bool:
+        """Overwrite lane ``i``'s float state leaves with NaN — the
+        chaos-injection hook behind runtime/faults ``nan_lane``, so the
+        device-side finiteness check (and everything downstream of it:
+        quarantine, retry escalation, counters) is exercised exactly as
+        a real numerical blow-up would.  Returns False when the state
+        has no float leaf (the pure-integer local-search families) —
+        the caller then quarantines the lane directly instead."""
+        hit = False
+
+        def poison(L):
+            nonlocal hit
+            if jnp.issubdtype(L.dtype, jnp.floating):
+                hit = True
+                return L.at[i].set(jnp.nan)
+            return L
+
+        poisoned = jax.tree_util.tree_map(poison, self.state)
+        if hit:
+            self.state = poisoned
+        return hit
 
     def migrate_from(self, other: "BucketWorker") -> int:
         """Fold ``other``'s occupied lanes into this worker's free
@@ -351,12 +381,18 @@ class BucketWorker:
         done_mask = np.array(
             [ln is None or ln.converged for ln in self.lanes], bool
         )
-        self.state, conv = self.runner(
+        self.state, flags = self.runner(
             self.arrays, self.state, xs,
             jnp.asarray(np.asarray(ns, np.int32)),
             jnp.asarray(done_mask),
         )
-        conv_np = np.asarray(conv)  # the step's ONE device→host read
+        flags_np = np.asarray(flags)  # the step's ONE device→host read
+        conv_np, finite_np = flags_np[0], flags_np[1]
+        self.nonfinite = [
+            i for i, ln in enumerate(self.lanes)
+            if ln is not None and not ln.converged and ns[i] > 0
+            and not finite_np[i]
+        ]
         wall = perf_counter() - t0
         self.steps += 1
         advanced = max(ns) if ns else 0

@@ -31,17 +31,48 @@ chunk boundaries (atomic + CRC, runtime/checkpoint).  A restarted
 service :meth:`resume`-s: completed jobs are skipped, in-flight jobs
 re-seat at their last checkpointed chunk boundary and continue the
 SAME stream — their results stay bit-identical to an uninterrupted
-solve.
+solve.  Journal lines cut short by a crash mid-append are skipped and
+counted, never fatal, and done-job records are compacted away (atomic
+rewrite) so a long-running service's journal stays bounded.
+
+Fault isolation (ISSUE 7) is layered the way an OS supervises
+processes:
+
+* a **bucket step** that throws (XLA error, injected fault) tears down
+  only that bucket: its jobs are bisected into isolated suspect groups
+  and re-run from cycle 0, so the poison job is cornered while its
+  healthy bucket-mates complete bit-identically (a fresh lane IS the
+  standalone stream);
+* a cornered **poison job** climbs a bounded ladder — retry with
+  exponential backoff, then a sequential-fallback solve, then a
+  terminal ``ERROR`` — and a lane whose float state goes NaN/Inf
+  (device-side check at every chunk boundary) enters the same ladder;
+* the **scheduler loop** itself is supervised: a tick that throws is
+  relaunched with exponential backoff (the PR 1 watchdog's policy); if
+  the restart budget is exhausted every pending job fails with
+  :class:`~pydcop_tpu.serve.errors.ServiceStopped` — ``result()``
+  raises, it never hangs;
+* **admission control** keeps overload a designed-for state: a bounded
+  pending queue with priority-aware shedding, per-tenant quotas and
+  deadline-infeasibility rejection, all surfaced as structured
+  :class:`~pydcop_tpu.serve.errors.ServiceOverloaded` errors with a
+  retry-after hint.
+
+All of it is observable (``serve.fault.*`` events +
+:class:`~pydcop_tpu.runtime.stats.ServeCounters`) and deterministically
+testable through the seedable serve faults in runtime/faults.py.
 """
 from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import os
 import queue
+import tempfile
 import threading
 from collections import deque
-from time import monotonic
+from time import monotonic, sleep
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from pydcop_tpu.algorithms.base import SolveResult
@@ -57,7 +88,17 @@ from pydcop_tpu.batch.engine import (
     runner_cache_key,
 )
 from pydcop_tpu.runtime.events import event_bus, send_serve
+from pydcop_tpu.runtime.faults import (
+    FaultPlan,
+    InjectedFault,
+    ServeFaultInjector,
+)
 from pydcop_tpu.runtime.stats import ServeCounters
+from pydcop_tpu.serve.errors import (
+    DeadlineInfeasible,
+    ServiceOverloaded,
+    ServiceStopped,
+)
 from pydcop_tpu.serve.scheduler import (
     BucketWorker,
     fits,
@@ -102,6 +143,14 @@ class ServeJob:
     events: "queue.Queue" = dataclasses.field(
         default_factory=lambda: queue.Queue(maxsize=1024)
     )
+    # fault-isolation / admission bookkeeping
+    counters: Optional[ServeCounters] = None
+    in_backlog: bool = False  # counted against the bounded queue
+    retries: int = 0  # quarantine re-admissions consumed
+    not_before: float = 0.0  # monotonic backoff gate on re-admission
+    isolate_key: Optional[str] = None  # quarantine group tag
+    lossy_notified: bool = False  # one serve.stream.lossy per job
+    service_stopped: bool = False  # failed by a dead scheduler
 
     def restore_target(self) -> InstanceDims:
         """The exact padded target a checkpointed job must re-seat at
@@ -117,8 +166,15 @@ class ServeJob:
         if self.stream:
             try:
                 self.events.put_nowait({"event": event, **payload})
-            except queue.Full:  # slow consumer: drop, never block solve
-                pass
+            except queue.Full:
+                # slow consumer: drop, never block solve — but COUNT
+                # the drop and tell the stream once that it is lossy,
+                # so a starved consumer is an alert, not a mystery
+                if self.counters is not None:
+                    self.counters.inc("events_dropped")
+                if not self.lossy_notified:
+                    self.lossy_notified = True
+                    send_serve("stream.lossy", {"jid": self.jid})
 
 
 class SolveService:
@@ -139,6 +195,19 @@ class SolveService:
     docstring.  ``start()`` spawns the scheduler thread; tests may
     instead drive :meth:`tick` synchronously for deterministic
     schedules.
+
+    Overload knobs: ``max_pending`` bounds the not-yet-admitted queue
+    (a submit beyond it sheds — the lowest-priority queued job if the
+    arrival outranks it, else the arrival itself, as
+    :class:`ServiceOverloaded`); ``tenant_quota`` caps one tenant's
+    open (submitted-but-unfinished) jobs.  Fault knobs:
+    ``max_job_retries`` bounds the quarantine retry ladder before the
+    sequential-fallback escalation, ``max_scheduler_restarts`` bounds
+    the supervisor's tick-loop relaunches, and
+    ``backoff_base``/``backoff_max`` shape both exponential backoffs
+    (the PR 1 watchdog's policy, runtime/process.py).  ``fault_plan``
+    arms the seedable serve-fault injector (runtime/faults.py) for
+    deterministic chaos testing.
     """
 
     def __init__(
@@ -152,6 +221,14 @@ class SolveService:
         merge_below: float = 0.5,
         tick_interval: float = 0.02,
         max_buckets: Optional[int] = None,
+        max_pending: Optional[int] = None,
+        tenant_quota: Optional[int] = None,
+        max_job_retries: int = 1,
+        max_scheduler_restarts: int = 5,
+        backoff_base: float = 0.05,
+        backoff_max: float = 2.0,
+        journal_compact_bytes: int = 1 << 20,
+        fault_plan: Optional[FaultPlan] = None,
     ):
         self.lanes = int(lanes)
         self.max_buckets = max_buckets
@@ -162,17 +239,37 @@ class SolveService:
         self.checkpoint_every = int(checkpoint_every)
         self.merge_below = float(merge_below)
         self.tick_interval = float(tick_interval)
+        self.max_pending = max_pending
+        self.tenant_quota = tenant_quota
+        self.max_job_retries = int(max_job_retries)
+        self.max_scheduler_restarts = int(max_scheduler_restarts)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.journal_compact_bytes = int(journal_compact_bytes)
 
         self._jobs: Dict[str, ServeJob] = {}
         self._pending: "deque[ServeJob]" = deque()
         self._workers: List[BucketWorker] = []
         self._prewarmed: Dict[Tuple[str, Tuple], List[InstanceDims]] = {}
         self._lock = threading.RLock()
+        self._journal_lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
         self._thread: Optional[threading.Thread] = None
+        self._thread_started = False
+        self._failure: Optional[BaseException] = None
         self._prep_pool = None  # spec-build executor (started threads)
         self._seq = 0
+        self._qseq = 0  # quarantine isolation-group counter
+        self._ticks = 0  # scheduler passes (the serve faults' clock)
+        self._backlog = 0  # submitted-but-unadmitted jobs
+        self._tenant_open: Dict[str, int] = {}
+        self._done_rate: Optional[float] = None  # completions/sec EMA
+        self._last_done_t: Optional[float] = None
+        self._injector = (
+            ServeFaultInjector(fault_plan) if fault_plan is not None
+            and fault_plan.serve_faults() else None
+        )
         self._done_jids: set = set()
         if journal_dir:
             os.makedirs(os.path.join(journal_dir, CKPT_SUBDIR),
@@ -187,6 +284,7 @@ class SolveService:
         from concurrent.futures import ThreadPoolExecutor
 
         self._stop = False
+        self._thread_started = True
         # instance compilation (spec building) runs OFF the scheduler
         # thread so admission prep overlaps bucket stepping; manual
         # tick() driving (tests) stays synchronous — no pool, specs
@@ -207,7 +305,10 @@ class SolveService:
         a journal this is the crash-with-checkpoints path a later
         :meth:`resume` recovers from."""
         if drain:
-            self.wait_all(timeout=timeout)
+            try:
+                self.wait_all(timeout=timeout)
+            except ServiceStopped:
+                pass  # nothing left to drain: the scheduler is dead
         self._stop = True
         self._wake.set()
         if self._thread is not None:
@@ -224,15 +325,42 @@ class SolveService:
     def __exit__(self, *exc) -> None:
         self.stop(drain=not any(exc))
 
+    def _raise_if_dead(self) -> None:
+        """The liveness gate behind every blocking wait: a scheduler
+        that died (supervisor exhausted, thread killed) or was stopped
+        with work in flight will never complete anything again —
+        callers get :class:`ServiceStopped`, not a silent hang."""
+        if self._failure is not None:
+            raise ServiceStopped(
+                f"scheduler thread died: {self._failure!r}"
+            )
+        if not self._thread_started:
+            return  # synchronous tick() driving: no thread to die
+        t = self._thread
+        if t is not None and not t.is_alive() and not self._stop:
+            raise ServiceStopped(
+                "scheduler thread is dead (exited without recording a "
+                "failure)"
+            )
+        if t is None and self._stop:
+            raise ServiceStopped("service was stopped")
+
     def wait_all(self, timeout: Optional[float] = None) -> bool:
-        """Block until every submitted job is done; False on timeout."""
+        """Block until every submitted job is done; False on timeout.
+        Raises :class:`ServiceStopped` instead of blocking forever when
+        the scheduler thread is dead."""
         deadline = None if timeout is None else monotonic() + timeout
         for job in list(self._jobs.values()):
-            remain = (
-                None if deadline is None else max(0.0, deadline - monotonic())
-            )
-            if not job.done.wait(remain):
-                return False
+            while not job.done.is_set():
+                self._raise_if_dead()
+                remain = (
+                    None if deadline is None else deadline - monotonic()
+                )
+                if remain is not None and remain <= 0:
+                    return False
+                job.done.wait(
+                    0.1 if remain is None else min(0.1, remain)
+                )
         return True
 
     # -- front door ---------------------------------------------------------
@@ -263,7 +391,81 @@ class SolveService:
         crash-resumable when the service has a journal.  ``spec``
         optionally hands over an already-compiled instance (the batch
         engine's adapter spec) — callers that prepare instances
-        themselves skip the service's prep stage entirely."""
+        themselves skip the service's prep stage entirely.
+
+        Admission control (raises instead of queueing unboundedly):
+        :class:`DeadlineInfeasible` for a deadline that is already
+        unmeetable, :class:`ServiceOverloaded` when the tenant is over
+        quota or the bounded pending queue is full and the arrival
+        does not outrank any queued job (a lower-priority queued job
+        is shed in its favor otherwise, completed ``ERROR`` and
+        counted ``jobs_shed``).  :class:`ServiceStopped` if the
+        scheduler thread is already dead.  Resumed jobs bypass the
+        checks — they were admitted before the crash."""
+        self._raise_if_dead()
+        victim: Optional[ServeJob] = None
+        if _jid is None:
+            if deadline_s is not None and deadline_s <= 0:
+                self.counters.inc("jobs_shed")
+                send_serve("job.rejected", {
+                    "tenant": tenant, "reason": "deadline infeasible",
+                    "deadline_s": deadline_s,
+                })
+                raise DeadlineInfeasible(
+                    f"deadline_s={deadline_s} is already expired at "
+                    f"submit time"
+                )
+            with self._lock:
+                if (
+                    self.tenant_quota is not None
+                    and self._tenant_open.get(tenant, 0)
+                    >= self.tenant_quota
+                ):
+                    self.counters.inc("quota_rejections")
+                    send_serve("job.rejected", {
+                        "tenant": tenant, "reason": "tenant quota",
+                        "quota": self.tenant_quota,
+                    })
+                    raise ServiceOverloaded(
+                        f"tenant {tenant!r} at quota "
+                        f"({self.tenant_quota} open jobs)",
+                        retry_after=self._retry_after(),
+                        tenant=tenant,
+                    )
+                if (
+                    self.max_pending is not None
+                    and self._backlog >= self.max_pending
+                ):
+                    victim = self._shed_candidate(int(priority))
+                    if victim is None:
+                        self.counters.inc("jobs_shed")
+                        send_serve("job.rejected", {
+                            "tenant": tenant, "reason": "queue full",
+                            "max_pending": self.max_pending,
+                        })
+                        raise ServiceOverloaded(
+                            f"pending queue full "
+                            f"({self.max_pending} jobs)",
+                            retry_after=self._retry_after(),
+                            tenant=tenant,
+                        )
+                    self._pending.remove(victim)
+        if victim is not None:
+            # priority-aware shedding: the lowest-priority queued job
+            # makes room for the higher-priority arrival — completed
+            # as a structured ERROR, never dropped silently
+            self.counters.inc("jobs_shed")
+            victim.emit("job.shed", {
+                "jid": victim.jid, "tenant": victim.tenant,
+                "priority": victim.priority,
+                "displaced_by_priority": int(priority),
+            })
+            self._complete(victim, SolveResult(
+                status="ERROR", assignment={}, cost=None,
+                violation=None, cycle=0, msg_count=0, msg_size=0.0,
+                time=monotonic() - victim.submitted_at,
+            ), error="shed: displaced by a higher-priority arrival "
+                     "while the pending queue was full")
         with self._lock:
             self._seq += 1
             if _jid is not None:
@@ -291,10 +493,16 @@ class SolveService:
                 stream=stream,
                 submitted_at=monotonic(),
                 seq=self._seq,
+                counters=self.counters,
             )
             job.spec = spec
             self._jobs[jid] = job
             self._pending.append(job)
+            job.in_backlog = True
+            self._backlog += 1
+            self._tenant_open[tenant] = (
+                self._tenant_open.get(tenant, 0) + 1
+            )
         if (
             job.spec is None
             and self._prep_pool is not None
@@ -313,12 +521,51 @@ class SolveService:
         self._wake.set()
         return jid
 
+    def _shed_candidate(self, priority: int) -> Optional[ServeJob]:
+        """The queued job a higher-priority arrival may displace: the
+        lowest-priority pending job strictly below ``priority`` (the
+        newest among equals — FIFO fairness for the older ones).
+        Caller holds the lock."""
+        victim = None
+        for j in self._pending:
+            if j.priority >= priority:
+                continue
+            if victim is None or (j.priority, -j.seq) < (
+                victim.priority, -victim.seq
+            ):
+                victim = j
+        return victim
+
+    def _retry_after(self) -> float:
+        """Back-off hint for rejected submits: the backlog drained at
+        the observed completion rate, clamped to [tick, 30s]."""
+        rate = self._done_rate
+        if not rate or rate <= 0:
+            return 1.0
+        est = self._backlog / rate
+        return round(min(30.0, max(self.tick_interval, est)), 3)
+
     def result(self, jid: str, timeout: Optional[float] = None
                ) -> SolveResult:
-        """Block until job ``jid`` completes and return its result."""
+        """Block until job ``jid`` completes and return its result.
+        Raises :class:`ServiceStopped` — instead of blocking forever —
+        when the scheduler thread died or the service was stopped with
+        the job still in flight."""
         job = self._jobs[jid]
-        if not job.done.wait(timeout):
-            raise TimeoutError(f"job {jid} not done within {timeout}s")
+        deadline = None if timeout is None else monotonic() + timeout
+        while not job.done.is_set():
+            self._raise_if_dead()
+            remain = None if deadline is None else deadline - monotonic()
+            if remain is not None and remain <= 0:
+                raise TimeoutError(
+                    f"job {jid} not done within {timeout}s"
+                )
+            job.done.wait(0.1 if remain is None else min(0.1, remain))
+        if job.service_stopped:
+            raise ServiceStopped(
+                f"job {jid} failed: scheduler thread died "
+                f"({self._failure!r})"
+            )
         assert job.result is not None
         return job.result
 
@@ -327,16 +574,25 @@ class SolveService:
         """Iterate job ``jid``'s lifecycle events — admission, anytime
         assignments at chunk boundaries (``job.progress``: cycle +
         current cost), completion — until the job is done.  The job
-        must have been submitted with ``stream=True``."""
+        must have been submitted with ``stream=True``.  ``timeout``
+        bounds the gap between consecutive events; a dead scheduler
+        raises :class:`ServiceStopped` instead of a silent stall."""
         job = self._jobs[jid]
+        deadline = monotonic() + timeout
         while True:
-            try:
-                evt = job.events.get(timeout=timeout)
-            except queue.Empty:
+            remain = deadline - monotonic()
+            if remain <= 0:
                 return
+            try:
+                evt = job.events.get(timeout=min(0.1, remain))
+            except queue.Empty:
+                if job.events.empty():
+                    self._raise_if_dead()
+                continue
             yield evt
             if evt.get("event") == "job.done":
                 return
+            deadline = monotonic() + timeout
 
     def metrics(self) -> Dict[str, Any]:
         with self._lock:
@@ -415,39 +671,283 @@ class SolveService:
     # -- scheduler ----------------------------------------------------------
 
     def _loop(self) -> None:
+        """The supervised scheduler loop.  A tick that throws — an
+        exception the per-bucket isolation inside :meth:`tick` could
+        not contain (admission logic, journal I/O, a backend falling
+        over) — is relaunched with exponential backoff, reusing the
+        PR 1 watchdog's policy (runtime/process.py).  When the restart
+        budget is exhausted the scheduler is declared dead: every
+        unfinished job fails with a ``ServiceStopped``-marked ERROR so
+        blocked ``result()`` calls raise instead of hanging."""
+        failures = 0
         while not self._stop:
-            busy = self.tick()
+            try:
+                busy = self.tick()
+            except Exception as e:
+                failures += 1
+                if failures > self.max_scheduler_restarts:
+                    self._scheduler_died(e)
+                    return
+                delay = min(self.backoff_max,
+                            self.backoff_base * (2 ** (failures - 1)))
+                self.counters.inc("scheduler_restarts")
+                send_serve("fault.scheduler_restart", {
+                    "attempt": failures, "backoff": round(delay, 4),
+                    "error": str(e),
+                })
+                if delay > 0:
+                    self._wake.wait(delay)
+                    self._wake.clear()
+                continue
+            failures = 0  # a clean tick refills the restart budget
             if not busy:
                 self._wake.wait(self.tick_interval)
                 self._wake.clear()
+
+    def _scheduler_died(self, exc: BaseException) -> None:
+        self._failure = exc
+        send_serve("fault.scheduler_dead", {
+            "error": str(exc),
+            "restarts": self.max_scheduler_restarts,
+        })
+        for job in list(self._jobs.values()):
+            if job.done.is_set():
+                continue
+            job.service_stopped = True
+            try:
+                self._complete(job, SolveResult(
+                    status="ERROR", assignment={}, cost=None,
+                    violation=None, cycle=0, msg_count=0, msg_size=0.0,
+                    time=monotonic() - job.submitted_at,
+                ), error=f"scheduler died: {exc}")
+            except Exception:  # the done flag must be set, no matter what
+                job.done.set()
 
     def tick(self) -> bool:
         """One synchronous scheduler pass: admissions, one chunk step
         per occupied bucket (completions + slot reuse at each
         boundary), then maintenance.  Returns True while work remains.
         The background thread just calls this in a loop; tests call it
-        directly for deterministic schedules."""
+        directly for deterministic schedules.
+
+        A bucket whose step throws is quarantined on the spot
+        (:meth:`_quarantine_worker`) — the failure never escapes to
+        the other buckets or, in thread mode, past the supervisor."""
+        self._ticks += 1
+        inj = self._injector
+        if inj is not None:
+            f = inj.due("stall_tick", self._ticks)
+            if f is not None:
+                self.counters.inc("faults_injected")
+                self.counters.inc("ticks_stalled")
+                send_serve("fault.injected", {
+                    "kind": "stall_tick", "tick": self._ticks,
+                    "duration": f.duration,
+                })
+                sleep(f.duration)
         self._admit_pending()
         for w in list(self._workers):
             if w.occupied == 0:
                 continue
-            finished = w.step()
-            for i, lane, status in finished:
-                res = w.lane_result(i, lane, status)
-                w.release(i)
-                self._complete(lane.job, res)
-            self._progress_events(w)
-            self._checkpoint_worker(w)
+            try:
+                self._step_worker(w)
+            except Exception as e:
+                self._quarantine_worker(w, e)
         # boundary admissions into lanes just freed — this is the
         # continuous part of the batching
         self._admit_pending()
         self._maintain_workers()
         with self._lock:
-            return bool(self._pending) or any(
-                w.occupied for w in self._workers
+            now = monotonic()
+            return any(w.occupied for w in self._workers) or any(
+                j.not_before <= now for j in self._pending
             )
 
+    def _step_worker(self, w: BucketWorker) -> None:
+        """Advance one bucket a chunk and settle its boundary:
+        non-finite lanes are quarantined, finished lanes complete
+        (with a host-side finiteness check on the final cost — the
+        int-state families have no float leaf for the device check),
+        progress streams and checkpoints follow."""
+        inj = self._injector
+        if inj is not None:
+            jids = {ln.job.jid for ln in w.lanes if ln is not None}
+            f = inj.due("raise_in_step", self._ticks, jids=jids)
+            if f is not None:
+                self.counters.inc("faults_injected")
+                send_serve("fault.injected", {
+                    "kind": "raise_in_step", "tick": self._ticks,
+                    "jid": f.jid,
+                })
+                raise InjectedFault(
+                    f"raise_in_step (fault plan, tick {self._ticks})"
+                )
+        forced: List[int] = []
+        if inj is not None:
+            for i, ln in enumerate(w.lanes):
+                if ln is None or ln.converged:
+                    continue
+                f = inj.due("nan_lane", self._ticks, jid=ln.job.jid)
+                if f is None:
+                    continue
+                self.counters.inc("faults_injected")
+                send_serve("fault.injected", {
+                    "kind": "nan_lane", "tick": self._ticks,
+                    "jid": ln.job.jid, "lane": i,
+                })
+                if not w.poison_lane(i):
+                    forced.append(i)  # int-state family: no float leaf
+        finished = w.step()
+        bad = set(w.nonfinite) | set(forced)
+        for i in sorted(bad):
+            lane = w.lanes[i]
+            if lane is None:
+                continue
+            self.counters.inc("lanes_nan")
+            send_serve("fault.nan_lane", {
+                "jid": lane.job.jid, "lane": i,
+                "cycle": int(lane.age),
+            })
+            w.release(i)
+            self._requeue_or_escalate(
+                lane.job,
+                f"non-finite lane state at cycle {lane.age}",
+            )
+        for i, lane, status in finished:
+            if i in bad or w.lanes[i] is None:
+                continue  # already quarantined this boundary
+            res = w.lane_result(i, lane, status)
+            w.release(i)
+            if res.cost is not None and not math.isfinite(float(res.cost)):
+                self.counters.inc("lanes_nan")
+                send_serve("fault.nan_lane", {
+                    "jid": lane.job.jid, "lane": i, "cycle": res.cycle,
+                })
+                self._requeue_or_escalate(
+                    lane.job, "non-finite final cost"
+                )
+                continue
+            self._complete(lane.job, res)
+        self._progress_events(w)
+        self._checkpoint_worker(w)
+
+    # -- quarantine ---------------------------------------------------------
+
+    def _quarantine_worker(self, w: BucketWorker,
+                           exc: BaseException) -> None:
+        """A bucket step threw.  The failing step cannot identify the
+        poison lane, so the bucket is torn down and its jobs bisected
+        into two ISOLATED suspect groups, each re-run from cycle 0 in
+        its own bucket: the group holding the poison fails again and
+        splits further until the poison job is cornered as a
+        singleton (and climbs the retry → sequential-fallback →
+        ERROR ladder), while every healthy group completes — a fresh
+        lane replays the standalone stream, so healthy results stay
+        bit-identical to a fault-free run."""
+        jobs = [ln.job for ln in w.lanes if ln is not None]
+        if w in self._workers:
+            self._workers.remove(w)
+        self.counters.inc("buckets_failed")
+        send_serve("fault.bucket_failed", {
+            "algo": w.algo, "error": str(exc),
+            "jobs": [j.jid for j in jobs],
+            "signature": [str(s) for s in w.signature],
+        })
+        if len(jobs) <= 1:
+            for job in jobs:
+                self._requeue_or_escalate(
+                    job, f"bucket step failed: {exc}"
+                )
+            return
+        mid = (len(jobs) + 1) // 2
+        for group in (jobs[:mid], jobs[mid:]):
+            if not group:
+                continue
+            self._qseq += 1
+            key = f"quarantine-{self._qseq}"
+            for job in group:
+                job.isolate_key = key
+                job.restore = None
+                self._requeue(job)
+        send_serve("fault.bisect", {
+            "jobs": len(jobs), "groups": 2,
+        })
+
+    def _requeue(self, job: ServeJob) -> None:
+        with self._lock:
+            if not job.in_backlog:
+                job.in_backlog = True
+                self._backlog += 1
+            self._pending.append(job)
+        self._wake.set()
+
+    def _requeue_or_escalate(self, job: ServeJob, reason: str) -> None:
+        """The poison-candidate ladder: bounded retry with exponential
+        backoff in an isolated bucket, then the sequential-fallback
+        escalation, then a terminal ERROR — a bad job always ends in a
+        terminal status, never a hang, and never takes anyone down
+        with it."""
+        job.restore = None
+        if job.isolate_key is None:
+            self._qseq += 1
+            job.isolate_key = f"quarantine-{self._qseq}"
+        job.retries += 1
+        if job.retries <= self.max_job_retries:
+            delay = min(self.backoff_max,
+                        self.backoff_base * (2 ** (job.retries - 1)))
+            job.not_before = monotonic() + delay
+            self.counters.inc("jobs_retried")
+            send_serve("fault.retry", {
+                "jid": job.jid, "attempt": job.retries,
+                "backoff": round(delay, 4), "reason": reason,
+            })
+            self._requeue(job)
+            return
+        self._escalate_sequential(job, reason)
+
+    def _escalate_sequential(self, job: ServeJob, reason: str) -> None:
+        """Last rung before ERROR: solve the cornered job alone on the
+        scheduler thread, outside every bucket (an XLA/vmap problem
+        cannot follow it there).  A still-poisoned job — the fallback
+        throws, its cost is non-finite, or a persistent injected fault
+        targets it — completes as a terminal ERROR."""
+        from pydcop_tpu.runtime.run import solve_result
+
+        self.counters.inc("jobs_quarantined")
+        send_serve("fault.quarantined", {
+            "jid": job.jid, "reason": reason,
+            "retries": job.retries,
+        })
+        inj = self._injector
+        err: Optional[str] = None
+        res: Optional[SolveResult] = None
+        if inj is not None and inj.poisoned(job.jid):
+            err = "injected poison persists (fault plan)"
+        else:
+            try:
+                res = solve_result(
+                    job.dcop, job.algo, algo_params=job.algo_params,
+                    seed=job.seed,
+                )
+            except Exception as e:
+                err = str(e)
+            else:
+                if res.cost is not None and not math.isfinite(
+                    float(res.cost)
+                ):
+                    err = "non-finite cost from sequential fallback"
+        if err is not None:
+            self._complete(job, SolveResult(
+                status="ERROR", assignment={}, cost=None,
+                violation=None, cycle=0, msg_count=0, msg_size=0.0,
+                time=monotonic() - job.submitted_at,
+            ), error=f"quarantined: {reason}; {err}")
+            return
+        res.time = monotonic() - job.submitted_at
+        self._complete(job, res)
+
     def _admit_pending(self) -> None:
+        now = monotonic()
         with self._lock:
             pending = sorted(
                 self._pending, key=lambda j: (-j.priority, j.seq)
@@ -456,6 +956,9 @@ class SolveService:
         leftover: List[ServeJob] = []
         not_ready: List[ServeJob] = []
         for job in pending:
+            if job.not_before > now:  # quarantine backoff gate
+                not_ready.append(job)
+                continue
             ready = self._prepare(job)
             if ready is False:
                 continue
@@ -518,6 +1021,8 @@ class SolveService:
     def _try_admit(self, job: ServeJob) -> bool:
         pkey = _params_key(job.algo_params)
         for w in self._workers:
+            if w.isolate_key != job.isolate_key:
+                continue  # quarantine groups never mix
             if not (w.matches(job.algo, pkey) and w.free > 0):
                 continue
             if job.restore is not None:
@@ -532,6 +1037,10 @@ class SolveService:
         return False
 
     def _admit_into(self, w: BucketWorker, job: ServeJob) -> None:
+        with self._lock:
+            if job.in_backlog:
+                job.in_backlog = False
+                self._backlog -= 1
         midflight = w.steps > 0
         restore = None
         if job.restore is not None:
@@ -563,13 +1072,25 @@ class SolveService:
                 if j.algo == head.algo
                 and _params_key(j.algo_params) == pkey
                 and j.restore is None
+                and j.isolate_key == head.isolate_key
                 and j.spec.dims.family_key == head.spec.dims.family_key
             ]
             target = self._pick_target(head.algo, pkey, group_dims)
-        w = BucketWorker(
-            head.algo, head.algo_params, target, self.lanes,
-            self.cache, counters=self.counters, limit=self.max_cycles,
-        )
+        try:
+            w = BucketWorker(
+                head.algo, head.algo_params, target, self.lanes,
+                self.cache, counters=self.counters,
+                limit=self.max_cycles,
+            )
+        except Exception as e:
+            # a bucket that cannot even build (compile failure) must
+            # not wedge admission: the head job climbs the quarantine
+            # ladder, the rest re-group behind the next head
+            self._requeue_or_escalate(
+                head, f"bucket worker build failed: {e}"
+            )
+            return jobs[1:]
+        w.isolate_key = head.isolate_key
         self._workers.append(w)
         self.counters.inc("buckets_opened")
         send_serve("bucket.opened", {
@@ -581,6 +1102,7 @@ class SolveService:
             if (
                 w.free > 0
                 and w.matches(job.algo, _params_key(job.algo_params))
+                and job.isolate_key == w.isolate_key
                 and (
                     (job.restore is not None
                      and w.target == job.restore_target())
@@ -613,7 +1135,7 @@ class SolveService:
         for w in self._workers:
             if 0 < w.occupied <= max(1, int(w.B * self.merge_below)):
                 by_sig.setdefault(
-                    (w.algo, w.pkey) + w.signature, []
+                    (w.algo, w.pkey, w.isolate_key) + w.signature, []
                 ).append(w)
         for _sig, ws in by_sig.items():
             if len(ws) < 2:
@@ -677,7 +1199,27 @@ class SolveService:
 
     def _complete(self, job: ServeJob, res: SolveResult,
                   error: Optional[str] = None) -> None:
+        if job.done.is_set():
+            return  # already terminal (defensive: double release)
         job.result = res
+        now = monotonic()
+        with self._lock:
+            if job.in_backlog:
+                job.in_backlog = False
+                self._backlog -= 1
+            n = self._tenant_open.get(job.tenant, 0)
+            if n > 0:
+                self._tenant_open[job.tenant] = n - 1
+            # completion-rate EMA → the retry-after hint on rejects
+            if self._last_done_t is not None:
+                dt = now - self._last_done_t
+                if dt > 0:
+                    inst = 1.0 / dt
+                    self._done_rate = (
+                        inst if self._done_rate is None
+                        else 0.5 * self._done_rate + 0.5 * inst
+                    )
+            self._last_done_t = now
         self.counters.inc("jobs_completed")
         if res.status == "TIMEOUT" and job.deadline_at is not None:
             self.counters.inc("jobs_preempted")
@@ -691,6 +1233,7 @@ class SolveService:
             payload["error"] = error
         job.emit("job.done", payload)
         job.done.set()
+        self._maybe_compact_journal()
 
     # -- journal / crash resume --------------------------------------------
 
@@ -703,11 +1246,25 @@ class SolveService:
             "tenant": job.tenant, "priority": job.priority,
             "deadline_s": job.deadline_s, "label": job.label,
         }
+        line = json.dumps(rec, sort_keys=True) + "\n"
+        inj = self._injector
+        if inj is not None:
+            f_t = inj.due("torn_journal_write", self._ticks,
+                          jid=job.jid)
+            if f_t is not None:
+                self.counters.inc("faults_injected")
+                send_serve("fault.injected", {
+                    "kind": "torn_journal_write", "jid": job.jid,
+                })
+                # a crash mid-append: a prefix of the record, no
+                # newline — exactly what resume must skip and count
+                line = line[: max(1, len(line) // 2)]
         path = os.path.join(self.journal_dir, JOBS_JOURNAL)
-        with open(path, "a", encoding="utf-8") as f:
-            f.write(json.dumps(rec, sort_keys=True) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
+        with self._journal_lock:
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(line)
+                f.flush()
+                os.fsync(f.fileno())
 
     def _journal_done(self, jid: str) -> None:
         self._done_jids.add(jid)
@@ -716,19 +1273,125 @@ class SolveService:
         # the batch command's JID resume protocol: append + fsync per
         # job, so a kill -9 loses at most the in-flight work
         path = os.path.join(self.journal_dir, PROGRESS_FILE)
-        with open(path, "a", encoding="utf-8") as f:
-            f.write(f"JID: {jid}\n")
-            f.flush()
-            os.fsync(f.fileno())
+        with self._journal_lock:
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(f"JID: {jid}\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+    @staticmethod
+    def _complete_lines(path: str) -> Tuple[List[str], int]:
+        """(complete lines, torn count).  Every journal append is
+        newline-terminated, so a final fragment without a newline is a
+        write cut short by a crash (or the injected
+        ``torn_journal_write``) — skipped and counted, never fatal."""
+        with open(path, encoding="utf-8") as f:
+            raw = f.read()
+        if not raw:
+            return [], 0
+        lines = raw.split("\n")
+        if lines[-1] == "":
+            lines.pop()
+            return lines, 0
+        lines.pop()  # unterminated tail: torn
+        return lines, 1
 
     def _load_done_jids(self) -> set:
         path = os.path.join(self.journal_dir, PROGRESS_FILE)
         if not os.path.exists(path):
             return set()
-        with open(path, encoding="utf-8") as f:
-            return {
-                line[5:].strip() for line in f if line.startswith("JID: ")
-            }
+        lines, torn = self._complete_lines(path)
+        out = set()
+        for line in lines:
+            if line.startswith("JID: ") and line[5:].strip():
+                out.add(line[5:].strip())
+            elif line.strip():
+                torn += 1  # half-written completion line: not trusted
+        if torn:
+            self.counters.inc("torn_journal_lines", torn)
+            send_serve("journal.torn", {
+                "file": PROGRESS_FILE, "lines": torn,
+            })
+        return out
+
+    def _maybe_compact_journal(self) -> None:
+        if not self.journal_dir:
+            return
+        path = os.path.join(self.journal_dir, JOBS_JOURNAL)
+        try:
+            if os.path.getsize(path) < self.journal_compact_bytes:
+                return
+        except OSError:
+            return
+        self.compact_journal()
+
+    def compact_journal(self) -> int:
+        """Drop done-job records from ``jobs.jsonl`` — in a
+        long-running service the journal otherwise grows without
+        bound.  Both files rewrite through the checkpoint writer's
+        discipline (same-directory temp file + fsync + atomic rename),
+        and the rewrite order is crash-safe: ``jobs.jsonl`` first, so
+        a crash between the two renames leaves only harmless stale
+        ``JID:`` lines.  Runs on :meth:`resume` and automatically at
+        the ``journal_compact_bytes`` size threshold.  Returns the
+        number of records kept."""
+        if not self.journal_dir:
+            return 0
+        path = os.path.join(self.journal_dir, JOBS_JOURNAL)
+        if not os.path.exists(path):
+            return 0
+        with self._journal_lock:
+            lines, _torn = self._complete_lines(path)
+            keep: List[Dict[str, Any]] = []
+            for line in lines:
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn interleave: already counted on read
+                if rec.get("jid") not in self._done_jids:
+                    keep.append(rec)
+            d = self.journal_dir
+            fd, tmp = tempfile.mkstemp(dir=d, prefix=".jobs_tmp_",
+                                       suffix=".jsonl")
+            try:
+                with os.fdopen(fd, "w", encoding="utf-8") as f:
+                    for rec in keep:
+                        f.write(json.dumps(rec, sort_keys=True) + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            # every record left is NOT done, so the completion file's
+            # done-lines are all redundant now — truncate it the same
+            # atomic way
+            ppath = os.path.join(self.journal_dir, PROGRESS_FILE)
+            keep_jids = {rec["jid"] for rec in keep}
+            fd, tmp = tempfile.mkstemp(dir=d, prefix=".prog_tmp_")
+            try:
+                with os.fdopen(fd, "w", encoding="utf-8") as f:
+                    for jid in sorted(self._done_jids & keep_jids):
+                        f.write(f"JID: {jid}\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, ppath)
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        self.counters.inc("journal_compactions")
+        send_serve("journal.compacted", {
+            "kept": len(keep), "dropped": len(lines) - len(keep),
+        })
+        return len(keep)
 
     def _ckpt_path(self, jid: str) -> str:
         return os.path.join(self.journal_dir, CKPT_SUBDIR, f"{jid}.npz")
@@ -761,7 +1424,10 @@ class SolveService:
         checkpoint re-seat at their last chunk boundary (their PRNG
         key, age and stability counters restored — the continuation is
         bit-identical to an uninterrupted run); jobs without one
-        restart from cycle 0.  Returns the number of jobs re-queued."""
+        restart from cycle 0.  Torn journal lines (an append cut short
+        by the crash) are skipped and counted, never fatal, and the
+        journal is compacted afterwards.  Returns the number of jobs
+        re-queued."""
         if not self.journal_dir:
             return 0
         from pydcop_tpu.dcop import load_dcop_from_file
@@ -771,41 +1437,54 @@ class SolveService:
         if not os.path.exists(path):
             return 0
         n = 0
-        with open(path, encoding="utf-8") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
+        lines, torn = self._complete_lines(path)
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
                 rec = json.loads(line)
                 jid = rec["jid"]
-                if jid in self._done_jids or jid in self._jobs:
-                    continue
-                if not rec.get("file"):
-                    continue  # not resumable without a source
+            except (ValueError, KeyError, TypeError):
+                # a torn fragment glued to the next append: the merged
+                # line parses as neither record — skip it, count it,
+                # keep resuming
+                torn += 1
+                continue
+            if jid in self._done_jids or jid in self._jobs:
+                continue
+            if not rec.get("file"):
+                continue  # not resumable without a source
+            try:
+                dcop = load_dcop_from_file([rec["file"]])
+            except Exception:
+                continue
+            self.submit(
+                dcop, rec["algo"],
+                algo_params=rec.get("algo_params") or {},
+                seed=int(rec.get("seed", 0)),
+                tenant=rec.get("tenant", "default"),
+                priority=int(rec.get("priority", 0)),
+                deadline_s=rec.get("deadline_s"),
+                label=rec.get("label"),
+                source_file=rec["file"],
+                _jid=jid, _journal=False,
+            )
+            job = self._jobs[jid]
+            ck = self._ckpt_path(jid)
+            if os.path.exists(ck):
                 try:
-                    dcop = load_dcop_from_file([rec["file"]])
-                except Exception:
-                    continue
-                self.submit(
-                    dcop, rec["algo"],
-                    algo_params=rec.get("algo_params") or {},
-                    seed=int(rec.get("seed", 0)),
-                    tenant=rec.get("tenant", "default"),
-                    priority=int(rec.get("priority", 0)),
-                    deadline_s=rec.get("deadline_s"),
-                    label=rec.get("label"),
-                    source_file=rec["file"],
-                    _jid=jid, _journal=False,
-                )
-                job = self._jobs[jid]
-                ck = self._ckpt_path(jid)
-                if os.path.exists(ck):
-                    try:
-                        meta, arrays = read_state_npz(ck)
-                        job.restore = (meta, arrays)
-                    except ValueError:
-                        job.restore = None  # corrupt: restart from 0
-                n += 1
-        send_serve("resume.done", {"jobs": n})
+                    meta, arrays = read_state_npz(ck)
+                    job.restore = (meta, arrays)
+                except ValueError:
+                    job.restore = None  # corrupt: restart from 0
+            n += 1
+        if torn:
+            self.counters.inc("torn_journal_lines", torn)
+            send_serve("journal.torn", {
+                "file": JOBS_JOURNAL, "lines": torn,
+            })
+        self.compact_journal()
+        send_serve("resume.done", {"jobs": n, "torn": torn})
         self._wake.set()
         return n

@@ -91,6 +91,75 @@ class TestServeSmoke:
 
 
 @pytest.mark.slow
+class TestServeChaosSmoke:
+    """`make chaos-smoke`: the seeded serve fault plan driven through
+    a real service process — the poison job must end terminal ERROR,
+    every healthy job must match its standalone solve exactly, and the
+    quarantine counters must show the machinery actually fired."""
+
+    def test_fault_plan_quarantines_poison_completes_healthy(
+        self, tmp_path
+    ):
+        from pydcop_tpu.dcop import load_dcop_from_file
+        from pydcop_tpu.runtime.run import solve_result
+
+        plan = tmp_path / "plan.yaml"
+        plan.write_text(
+            "seed: 7\n"
+            "faults:\n"
+            "  - kind: raise_in_step\n"
+            "    jid: job-000002\n"   # the second submitted job
+            "    cycle: 2\n"
+            "  - kind: stall_tick\n"
+            "    duration: 0.05\n"
+            "    cycle: 1\n"
+        )
+        proc = run_cli(
+            "serve", "-a", "mgm", "--jobs", "4", "--lanes", "2",
+            "--max-cycles", "2000", "--fault-plan", str(plan), TUTO,
+        )
+        # the poison job ends ERROR, so the CLI exits nonzero — but
+        # with a full JSON report, not a crash
+        assert proc.returncode == 1, proc.stderr[-2000:]
+        out = json.loads(proc.stdout)
+        assert len(out["results"]) == 4
+        dcop = load_dcop_from_file([TUTO])
+        for jid, m in out["results"].items():
+            if jid == "job-000002":
+                assert m["status"] == "ERROR", (jid, m)
+                continue
+            assert m["status"] == "FINISHED", (jid, m)
+            _fn, seed = m["label"].rsplit(":", 1)
+            seq = solve_result(dcop, "mgm", seed=int(seed))
+            assert m["cost"] == seq.cost, (jid, m)
+            assert m["cycle"] == seq.cycle, (jid, m)
+            assert m["assignment"] == seq.assignment, (jid, m)
+        serve = out["serve"]["serve"]
+        assert serve["faults_injected"] >= 2
+        assert serve["ticks_stalled"] == 1
+        assert serve["buckets_failed"] >= 1
+        assert serve["jobs_quarantined"] == 1
+
+    def test_overload_rejections_recorded(self):
+        """Admission control through the CLI: a saturating burst with
+        a tiny pending bound sheds with structured rejections in the
+        output JSON."""
+        proc = run_cli(
+            "serve", "-a", "mgm", "--jobs", "8", "--lanes", "1",
+            "--max-pending", "1", TUTO,
+        )
+        out = json.loads(proc.stdout)
+        shed = out["serve"]["serve"]["jobs_shed"]
+        assert shed == len(out["rejected"])
+        for rej in out["rejected"]:
+            assert "overloaded" in rej["error"]
+            assert rej["retry_after"] > 0
+        # every ADMITTED job still finished correctly
+        for jid, m in out["results"].items():
+            assert m["status"] == "FINISHED", (jid, m)
+
+
+@pytest.mark.slow
 class TestServeCrashResume:
     def test_kill9_midstream_then_resume_completes_all(self, tmp_path):
         """Acceptance pin: kill the service mid-stream (SIGKILL, no

@@ -1,0 +1,662 @@
+"""Fault isolation + overload control of the solve service (ISSUE 7).
+
+Contracts pinned here:
+
+* **chaos matrix** (acceptance pin): for each serve fault kind
+  (``raise_in_step``, ``nan_lane``, ``torn_journal_write``,
+  ``stall_tick``) injected via a seeded FaultPlan, the service
+  completes every healthy job bit-identically to a fault-free run, the
+  poison job ends in a terminal ``ERROR`` (never a hang), and the
+  matching quarantine/shed/restart counters are nonzero;
+* **quarantine**: a bucket whose step throws is bisected into isolated
+  suspect groups; a transient fault (no target jid) is absorbed with
+  every job still completing correctly;
+* **supervisor**: a tick-loop failure is relaunched with backoff
+  (``scheduler_restarts``); a dead scheduler fails pending ``result()``
+  / ``wait_all()`` calls with :class:`ServiceStopped` — never a hang;
+* **admission control**: bounded pending queue (priority-aware
+  shedding with a structured, retry-after-carrying rejection),
+  per-tenant quotas, deadline-infeasibility rejection at submit;
+* **journal hygiene**: done-job compaction (atomic rewrite, on resume
+  and at a size threshold) and torn-line tolerance (truncated final
+  ``jobs.jsonl`` line, half-written ``JID:`` line — skipped + counted,
+  not a crash);
+* **lossy streams**: slow-consumer event drops are counted with one
+  ``serve.stream.lossy`` notice per job.
+
+Like test_serve_service.py, tests drive :meth:`SolveService.tick`
+synchronously where determinism matters; supervisor tests run the real
+scheduler thread.
+"""
+import json
+import os
+import queue
+
+import pytest
+
+from pydcop_tpu.batch.cache import CompileCache
+from pydcop_tpu.batch.engine import BatchItem, adapter_for
+from pydcop_tpu.dcop import load_dcop_from_file
+from pydcop_tpu.runtime.faults import (
+    Fault,
+    FaultPlan,
+    ServeFaultInjector,
+)
+from pydcop_tpu.runtime.stats import ServeCounters
+from pydcop_tpu.serve import (
+    DeadlineInfeasible,
+    ServeJob,
+    ServiceOverloaded,
+    ServiceStopped,
+    SolveService,
+)
+
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+TUTO = os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+
+LIMIT = 63  # multiple of the harness chunk (7), see test_serve_service
+
+
+def _load():
+    return load_dcop_from_file([TUTO])
+
+
+def _standalone(dcop, algo, seed, params=None):
+    spec = adapter_for(algo).build_spec(
+        BatchItem(dcop, algo, algo_params=params, seed=seed)
+    )
+    return spec.solver.run(max_cycles=LIMIT)
+
+
+def _drain(svc, max_ticks=300):
+    for _ in range(max_ticks):
+        if not svc.tick() and all(
+            j.done.is_set() for j in svc._jobs.values()
+        ):
+            return
+    raise AssertionError("service did not drain")
+
+
+def _svc(**kw):
+    """A deterministic sync-driven service: zero quarantine backoff so
+    tick-driven tests never wait on wall-clock gates."""
+    kw.setdefault("lanes", 2)
+    kw.setdefault("cache", CompileCache())
+    kw.setdefault("max_cycles", LIMIT)
+    kw.setdefault("backoff_base", 0.0)
+    return SolveService(**kw)
+
+
+# ---------------------------------------------------------------------------
+# the chaos matrix (acceptance pin)
+# ---------------------------------------------------------------------------
+
+#: per-kind scenario: the algorithm, the fault spec (jid-targeted =
+#: persistent poison), and the counter that must be nonzero afterwards
+MATRIX = {
+    "raise_in_step": dict(
+        algo="mgm",
+        fault=dict(kind="raise_in_step", jid="job-000002", cycle=2),
+        counter="jobs_quarantined",
+        poison="job-000002",
+    ),
+    "nan_lane": dict(
+        algo="maxsum",  # float state: the device-side finiteness check
+        fault=dict(kind="nan_lane", jid="job-000002", cycle=2),
+        counter="lanes_nan",
+        poison="job-000002",
+    ),
+    "torn_journal_write": dict(
+        algo="mgm",
+        fault=dict(kind="torn_journal_write", jid="job-000002"),
+        counter="faults_injected",
+        poison=None,  # journal damage, not a poison job
+    ),
+    "stall_tick": dict(
+        algo="mgm",
+        fault=dict(kind="stall_tick", duration=0.02, cycle=1),
+        counter="ticks_stalled",
+        poison=None,
+    ),
+}
+
+
+class TestChaosMatrix:
+    @pytest.mark.parametrize("kind", sorted(MATRIX))
+    def test_injected_fault_is_contained(self, kind, tmp_path):
+        cfg = MATRIX[kind]
+        plan = FaultPlan(faults=[Fault(**cfg["fault"])], seed=7)
+        needs_journal = kind == "torn_journal_write"
+        jd = str(tmp_path / "journal") if needs_journal else None
+        svc = _svc(fault_plan=plan, journal_dir=jd)
+        dcop = _load()
+        a = svc.submit(dcop, cfg["algo"], seed=0,
+                       source_file=TUTO if needs_journal else None)
+        b = svc.submit(dcop, cfg["algo"], seed=1,
+                       source_file=TUTO if needs_journal else None)
+        assert (a, b) == ("job-000001", "job-000002")
+        _drain(svc)  # bounded: a hang fails here, never blocks CI
+
+        poison = cfg["poison"]
+        for jid, seed in ((a, 0), (b, 1)):
+            res = svc.result(jid, timeout=1)
+            if jid == poison:
+                # the poison job ends terminal, isolated to itself
+                assert res.status == "ERROR", (kind, res.status)
+                continue
+            # every healthy job is bit-identical to a fault-free run
+            seq = _standalone(dcop, cfg["algo"], seed)
+            assert res.status == seq.status, (kind, jid)
+            assert res.assignment == seq.assignment, (kind, jid)
+            assert res.cycle == seq.cycle, (kind, jid)
+            assert res.cost == seq.cost, (kind, jid)
+        assert svc.counters.counts[cfg["counter"]] > 0, kind
+        assert svc.counters.counts["faults_injected"] > 0, kind
+
+    def test_torn_write_is_skipped_and_counted_on_resume(self, tmp_path):
+        """The torn_journal_write leg's second half: the journal the
+        fault damaged must resume cleanly — the torn record skipped and
+        counted, the rest of the session intact."""
+        cfg = MATRIX["torn_journal_write"]
+        plan = FaultPlan(faults=[Fault(**cfg["fault"])], seed=7)
+        jd = str(tmp_path / "journal")
+        svc = _svc(fault_plan=plan, journal_dir=jd,
+                   journal_compact_bytes=1 << 30)  # keep records
+        dcop = _load()
+        svc.submit(dcop, "mgm", seed=0, source_file=TUTO)
+        svc.submit(dcop, "mgm", seed=1, source_file=TUTO)
+        svc.tick()  # both journaled (B's record torn), work started
+        del svc  # crash
+
+        svc2 = _svc(journal_dir=jd)
+        n = svc2.resume()
+        # A's complete record resumes; B's torn record is skipped
+        assert n == 1
+        assert svc2.counters.counts["torn_journal_lines"] >= 1
+        _drain(svc2)
+        res = svc2.result("job-000001", timeout=1)
+        seq = _standalone(dcop, "mgm", 0)
+        assert res.assignment == seq.assignment
+
+
+# ---------------------------------------------------------------------------
+# quarantine mechanics
+# ---------------------------------------------------------------------------
+
+class TestQuarantine:
+    def test_transient_step_failure_absorbed(self):
+        """A raise_in_step WITHOUT a jid is a one-shot glitch: the
+        bucket is bisected, every job re-runs in isolation and
+        completes bit-identically — nothing ends in ERROR."""
+        plan = FaultPlan(
+            faults=[Fault(kind="raise_in_step", cycle=2)], seed=3
+        )
+        svc = _svc(fault_plan=plan)
+        dcop = _load()
+        jids = [svc.submit(dcop, "mgm", seed=s) for s in range(2)]
+        _drain(svc)
+        assert svc.counters.counts["buckets_failed"] >= 1
+        for jid, seed in zip(jids, range(2)):
+            res = svc.result(jid, timeout=1)
+            seq = _standalone(dcop, "mgm", seed)
+            assert res.status == "FINISHED"
+            assert res.assignment == seq.assignment
+            assert res.cycle == seq.cycle
+
+    def test_bisect_isolates_suspect_groups(self):
+        """After a bucket failure the requeued jobs carry isolation
+        tags, so suspects re-run in their own buckets instead of
+        re-contaminating shared ones."""
+        plan = FaultPlan(
+            faults=[Fault(kind="raise_in_step", cycle=2)], seed=3
+        )
+        svc = _svc(fault_plan=plan)
+        dcop = _load()
+        jids = [svc.submit(dcop, "mgm", seed=s) for s in range(2)]
+        svc.tick()  # admit into ONE shared bucket
+        assert svc.counters.counts["buckets_opened"] == 1
+        svc.tick()  # the step throws: bisect
+        keys = {svc._jobs[j].isolate_key for j in jids}
+        assert None not in keys
+        assert len(keys) == 2  # two distinct isolation groups
+        _drain(svc)
+        # each group opened its own bucket afterwards
+        assert svc.counters.counts["buckets_opened"] >= 3
+
+    def test_poison_ladder_retries_then_escalates(self):
+        """The cornered singleton consumes its retry budget with
+        backoff, then the sequential-fallback escalation ends it in a
+        terminal ERROR (the injected poison is persistent)."""
+        plan = FaultPlan(
+            faults=[Fault(kind="raise_in_step", jid="job-000001")],
+            seed=3,
+        )
+        svc = _svc(lanes=1, fault_plan=plan, max_job_retries=2)
+        jid = svc.submit(_load(), "mgm", seed=0)
+        _drain(svc)
+        res = svc.result(jid, timeout=1)
+        assert res.status == "ERROR"
+        assert svc.counters.counts["jobs_retried"] == 2
+        assert svc.counters.counts["jobs_quarantined"] == 1
+        assert svc.counters.counts["buckets_failed"] >= 3
+
+    def test_engine_freezes_nonfinite_lane(self):
+        """The batch engine twin of the lane check: a NaN-poisoned
+        instance is frozen ERROR at the chunk boundary (and released
+        through the on_lane_release hook) while its bucket-mate solves
+        to the standalone result."""
+        import numpy as np
+
+        import pydcop_tpu.batch.engine as eng_mod
+        from pydcop_tpu.batch.engine import BatchEngine
+
+        dcop = _load()
+        engine = BatchEngine(cache=CompileCache())
+        released = []
+
+        # poison instance 1's initial maxsum messages so its float
+        # state is non-finite from the first chunk; instance 0 healthy
+        orig = eng_mod._adapter_for
+
+        def fake_adapter(algo):
+            a = orig(algo)
+            real_init = a.initial_state
+            calls = {"n": 0}
+
+            def init(spec, target):
+                st = real_init(spec, target)
+                calls["n"] += 1
+                if calls["n"] == 2:  # the second instance of the bucket
+                    q, r, v = st
+                    st = (np.full_like(q, np.nan), r, v)
+                return st
+
+            a.initial_state = init
+            return a
+
+        eng_mod._adapter_for = fake_adapter
+        try:
+            results = engine.solve(
+                [BatchItem(dcop, "maxsum", seed=0),
+                 BatchItem(dcop, "maxsum", seed=1)],
+                max_cycles=LIMIT,
+                on_lane_release=lambda i, c, s: released.append(i),
+            )
+        finally:
+            eng_mod._adapter_for = orig
+        assert engine.counters.counts["lanes_nonfinite"] == 1
+        assert results[1].status == "ERROR"
+        assert 1 in released  # the poisoned lane was released too
+        seq = _standalone(dcop, "maxsum", 0)
+        assert results[0].status == seq.status
+        assert results[0].assignment == seq.assignment
+        assert results[0].cycle == seq.cycle
+
+
+# ---------------------------------------------------------------------------
+# the supervisor
+# ---------------------------------------------------------------------------
+
+class TestSupervisor:
+    def test_transient_tick_failure_restarts_with_backoff(self):
+        svc = _svc()
+        calls = {"n": 0}
+        orig = svc.tick
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise RuntimeError("transient scheduler glitch")
+            return orig()
+
+        svc.tick = flaky
+        svc.start()
+        try:
+            jid = svc.submit(_load(), "mgm", seed=0)
+            res = svc.result(jid, timeout=60)
+        finally:
+            svc.stop(drain=False)
+        assert res.status == "FINISHED"
+        assert svc.counters.counts["scheduler_restarts"] == 2
+
+    def test_dead_scheduler_raises_service_stopped(self):
+        svc = _svc(max_scheduler_restarts=1)
+
+        def always_raise():
+            raise RuntimeError("scheduler is toast")
+
+        svc.tick = always_raise
+        jid = svc.submit(_load(), "mgm", seed=0)
+        svc.start()
+        try:
+            with pytest.raises(ServiceStopped):
+                svc.result(jid, timeout=30)
+            # the job was failed terminally, not left hanging — so
+            # wait_all returns instead of blocking forever
+            assert svc._jobs[jid].done.is_set()
+            assert svc.wait_all(timeout=10) is True
+            with pytest.raises(ServiceStopped):
+                svc.submit(_load(), "mgm", seed=1)
+        finally:
+            svc.stop(drain=False)
+        assert svc.counters.counts["scheduler_restarts"] == 1
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+    )
+    def test_silently_dead_thread_detected(self):
+        """A thread that dies without the supervisor recording a
+        failure (SystemExit kills it outright) is still detected by
+        result()'s liveness polling — never a hang."""
+        svc = _svc()
+
+        def die():
+            raise SystemExit
+
+        svc.tick = die
+        jid = svc.submit(_load(), "mgm", seed=0)
+        svc.start()
+        with pytest.raises(ServiceStopped):
+            svc.result(jid, timeout=30)
+
+    def test_result_after_abandoning_stop_raises(self):
+        svc = _svc()
+        svc.tick = lambda: False  # a scheduler that never does work
+        jid = svc.submit(_load(), "mgm", seed=0)
+        svc.start()
+        svc.stop(drain=False)
+        with pytest.raises(ServiceStopped):
+            svc.result(jid, timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+class TestAdmissionControl:
+    def test_max_pending_rejects_with_retry_after(self):
+        svc = _svc(lanes=1, max_pending=1)
+        dcop = _load()
+        svc.submit(dcop, "mgm", seed=0)
+        with pytest.raises(ServiceOverloaded) as ei:
+            svc.submit(dcop, "mgm", seed=1)
+        assert ei.value.retry_after > 0
+        d = ei.value.to_dict()
+        assert d["error"] == "overloaded"
+        assert "queue" in d["reason"]
+        assert svc.counters.counts["jobs_shed"] == 1
+        _drain(svc)  # the accepted job is unaffected
+
+    def test_higher_priority_arrival_sheds_lowest_pending(self):
+        svc = _svc(lanes=1, max_pending=1)
+        dcop = _load()
+        lo = svc.submit(dcop, "mgm", seed=0, priority=0)
+        hi = svc.submit(dcop, "mgm", seed=1, priority=5)
+        # the low-priority job was displaced: already terminal, ERROR
+        res_lo = svc.result(lo, timeout=1)
+        assert res_lo.status == "ERROR"
+        assert svc.counters.counts["jobs_shed"] == 1
+        _drain(svc)
+        res_hi = svc.result(hi, timeout=1)
+        seq = _standalone(dcop, "mgm", 1)
+        assert res_hi.status == "FINISHED"
+        assert res_hi.assignment == seq.assignment
+
+    def test_tenant_quota_rejections(self):
+        svc = _svc(tenant_quota=1)
+        dcop = _load()
+        a = svc.submit(dcop, "mgm", seed=0, tenant="t1")
+        with pytest.raises(ServiceOverloaded) as ei:
+            svc.submit(dcop, "mgm", seed=1, tenant="t1")
+        assert ei.value.tenant == "t1"
+        assert svc.counters.counts["quota_rejections"] == 1
+        # another tenant is unaffected
+        b = svc.submit(dcop, "mgm", seed=2, tenant="t2")
+        _drain(svc)
+        assert svc.result(a, timeout=1).status == "FINISHED"
+        assert svc.result(b, timeout=1).status == "FINISHED"
+        # quota releases with completion
+        c = svc.submit(dcop, "mgm", seed=3, tenant="t1")
+        _drain(svc)
+        assert svc.result(c, timeout=1).status == "FINISHED"
+
+    def test_infeasible_deadline_rejected_at_submit(self):
+        svc = _svc()
+        dcop = _load()
+        for bad in (0, -1.5):
+            with pytest.raises(DeadlineInfeasible):
+                svc.submit(dcop, "mgm", seed=0, deadline_s=bad)
+        assert svc.counters.counts["jobs_shed"] == 2
+        assert not svc._jobs  # nothing was queued
+
+    def test_resumed_jobs_bypass_admission_control(self, tmp_path):
+        """Jobs re-queued by resume() were admitted before the crash:
+        the bounded queue must not reject them."""
+        jd = str(tmp_path / "journal")
+        svc1 = _svc(journal_dir=jd, checkpoint_every=1)
+        dcop = _load()
+        for s in range(3):
+            svc1.submit(dcop, "dsa", seed=s, source_file=TUTO)
+        svc1.tick()
+        del svc1  # crash mid-flight
+
+        svc2 = _svc(journal_dir=jd, max_pending=1)
+        assert svc2.resume() == 3  # > max_pending, still accepted
+        _drain(svc2)
+        for jid in list(svc2._jobs):
+            assert svc2.result(jid, timeout=1).status == "FINISHED"
+
+
+# ---------------------------------------------------------------------------
+# journal hygiene
+# ---------------------------------------------------------------------------
+
+class TestJournalCompaction:
+    def test_compaction_drops_done_records(self, tmp_path):
+        jd = str(tmp_path / "journal")
+        svc = _svc(journal_dir=jd, journal_compact_bytes=1 << 30)
+        dcop = _load()
+        for s in range(3):
+            svc.submit(dcop, "mgm", seed=s, source_file=TUTO)
+        _drain(svc)
+        path = os.path.join(jd, "jobs.jsonl")
+        assert len(open(path).read().splitlines()) == 3
+        kept = svc.compact_journal()
+        assert kept == 0
+        assert open(path).read() == ""
+        assert open(os.path.join(jd, "progress_serve")).read() == ""
+        assert svc.counters.counts["journal_compactions"] == 1
+        # a fresh service sees a clean, resumable-from journal
+        svc2 = _svc(journal_dir=jd)
+        assert svc2.resume() == 0
+
+    def test_compaction_keeps_inflight_records(self, tmp_path):
+        jd = str(tmp_path / "journal")
+        svc = _svc(journal_dir=jd, journal_compact_bytes=1 << 30)
+        dcop = _load()
+        a = svc.submit(dcop, "mgm", seed=0, source_file=TUTO)
+        _drain(svc)
+        assert svc.result(a, timeout=1).status == "FINISHED"
+        b = svc.submit(dcop, "dsa", seed=1, source_file=TUTO)
+        svc.tick()  # b in flight, not done
+        kept = svc.compact_journal()
+        assert kept == 1
+        recs = [json.loads(ln) for ln in open(
+            os.path.join(jd, "jobs.jsonl")
+        ).read().splitlines()]
+        assert [r["jid"] for r in recs] == [b]
+        # the in-flight record still resumes after a crash
+        del svc
+        svc2 = _svc(journal_dir=jd)
+        assert svc2.resume() == 1
+        _drain(svc2)
+        assert svc2.result(b, timeout=1).status == "FINISHED"
+
+    def test_size_threshold_triggers_compaction(self, tmp_path):
+        jd = str(tmp_path / "journal")
+        # 1-byte threshold: every completion compacts
+        svc = _svc(journal_dir=jd, journal_compact_bytes=1)
+        svc.submit(_load(), "mgm", seed=0, source_file=TUTO)
+        _drain(svc)
+        assert svc.counters.counts["journal_compactions"] >= 1
+        assert open(os.path.join(jd, "jobs.jsonl")).read() == ""
+
+
+def _write_journal(jd, records, torn_fragment=None, progress=()):
+    os.makedirs(os.path.join(jd, "ckpt"), exist_ok=True)
+    with open(os.path.join(jd, "jobs.jsonl"), "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+        if torn_fragment is not None:
+            f.write(torn_fragment)  # no newline: a torn append
+    with open(os.path.join(jd, "progress_serve"), "w") as f:
+        for line in progress:
+            f.write(line)
+
+
+def _rec(jid, seed=0, algo="mgm"):
+    return {"jid": jid, "file": TUTO, "algo": algo, "seed": seed}
+
+
+class TestTornJournal:
+    def test_truncated_final_jobs_line_resumes_cleanly(self, tmp_path):
+        jd = str(tmp_path / "journal")
+        _write_journal(
+            jd, [_rec("job-000001")],
+            torn_fragment='{"jid": "job-0000',
+        )
+        svc = _svc(journal_dir=jd)
+        assert svc.resume() == 1  # the complete record
+        assert svc.counters.counts["torn_journal_lines"] == 1
+        _drain(svc)
+        res = svc.result("job-000001", timeout=1)
+        seq = _standalone(_load(), "mgm", 0)
+        assert res.assignment == seq.assignment
+        assert res.cycle == seq.cycle
+
+    def test_glued_torn_fragment_skipped(self, tmp_path):
+        """A fragment the next append glued onto parses as neither
+        record: skipped + counted, the neighbors resume."""
+        jd = str(tmp_path / "journal")
+        os.makedirs(os.path.join(jd, "ckpt"), exist_ok=True)
+        with open(os.path.join(jd, "jobs.jsonl"), "w") as f:
+            f.write(json.dumps(_rec("job-000001")) + "\n")
+            f.write('{"jid": "job-0000' + json.dumps(_rec(
+                "job-000002", seed=1)) + "\n")
+            f.write(json.dumps(_rec("job-000003", seed=2)) + "\n")
+        svc = _svc(journal_dir=jd)
+        assert svc.resume() == 2  # 1 and 3; the glued line is torn
+        assert svc.counters.counts["torn_journal_lines"] == 1
+
+    def test_half_written_jid_line_skipped_and_counted(self, tmp_path):
+        jd = str(tmp_path / "journal")
+        _write_journal(
+            jd,
+            [_rec("job-000001"), _rec("job-000002", seed=1)],
+            progress=["JID: job-000001\n", "JID: job-0000"],  # torn
+        )
+        svc = _svc(journal_dir=jd)
+        assert svc.counters.counts["torn_journal_lines"] == 1
+        # job 1's completion is trusted; the torn line is not, so job
+        # 2 re-runs (idempotent) instead of being wrongly skipped
+        assert svc.resume() == 1
+        _drain(svc)
+        assert svc.result("job-000002", timeout=1).status == "FINISHED"
+
+    def test_corrupt_checkpoint_still_restarts_from_zero(self, tmp_path):
+        """The pre-existing corrupt-checkpoint path coexists with torn
+        tolerance: CRC rejection restarts the job from cycle 0."""
+        jd = str(tmp_path / "journal")
+        svc1 = _svc(lanes=1, journal_dir=jd, checkpoint_every=1)
+        a = svc1.submit(_load(), "mgm", seed=0, source_file=TUTO)
+        svc1.tick()
+        ck = svc1._ckpt_path(a)
+        assert os.path.exists(ck)
+        with open(ck, "r+b") as f:
+            f.seek(30)
+            f.write(b"\xde\xad\xbe\xef")
+        del svc1
+        svc2 = _svc(journal_dir=jd)
+        assert svc2.resume() == 1
+        _drain(svc2)
+        res = svc2.result(a, timeout=1)
+        seq = _standalone(_load(), "mgm", 0)
+        assert res.assignment == seq.assignment
+        assert svc2.counters.counts["jobs_resumed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# lossy streams, injector semantics, plan parsing
+# ---------------------------------------------------------------------------
+
+class TestLossyStream:
+    def test_drops_counted_with_one_notice_per_job(self):
+        from pydcop_tpu.runtime.events import event_bus
+
+        counters = ServeCounters()
+        job = ServeJob(
+            jid="j1", dcop=None, algo="mgm", algo_params={}, seed=0,
+            tenant="t", priority=0, deadline_s=None, deadline_at=None,
+            label=None, source_file=None, stream=True,
+            submitted_at=0.0, seq=1, counters=counters,
+        )
+        job.events = queue.Queue(maxsize=1)
+        seen = []
+        cb = lambda t, e: seen.append((t, e))  # noqa: E731
+        event_bus.enabled = True
+        event_bus.subscribe("serve.stream.lossy", cb)
+        try:
+            job.emit("job.progress", {"cycle": 1})  # fills the queue
+            job.emit("job.progress", {"cycle": 2})  # dropped + notice
+            job.emit("job.progress", {"cycle": 3})  # dropped, silent
+        finally:
+            event_bus.unsubscribe(cb)
+            event_bus.enabled = False
+        assert counters.counts["events_dropped"] == 2
+        assert len(seen) == 1
+        assert seen[0][1] == {"jid": "j1"}
+
+
+class TestInjectorSemantics:
+    def test_one_shot_vs_persistent(self):
+        plan = FaultPlan(faults=[
+            Fault(kind="raise_in_step", cycle=1),  # transient
+            Fault(kind="nan_lane", jid="jA", cycle=1),  # poison
+        ])
+        inj = ServeFaultInjector(plan)
+        # not due before its tick threshold
+        assert inj.due("raise_in_step", 0, jids={"jX"}) is None
+        assert inj.due("raise_in_step", 1, jids={"jX"}) is not None
+        assert inj.due("raise_in_step", 2, jids={"jX"}) is None  # spent
+        # the targeted fault only fires in its jid's scope, forever
+        assert inj.due("nan_lane", 1, jid="jB") is None
+        assert inj.due("nan_lane", 1) is None  # no scope, no fire
+        for _ in range(3):
+            assert inj.due("nan_lane", 1, jid="jA") is not None
+        assert inj.poisoned("jA")
+        assert not inj.poisoned("jB")
+
+    def test_plan_yaml_roundtrip(self, tmp_path):
+        p = tmp_path / "plan.yaml"
+        p.write_text(
+            "seed: 7\n"
+            "faults:\n"
+            "  - kind: raise_in_step\n"
+            "    jid: job-000002\n"
+            "    cycle: 2\n"
+            "  - kind: nan_lane\n"
+            "    jid: job-000003\n"
+            "  - kind: torn_journal_write\n"
+            "  - kind: stall_tick\n"
+            "    duration: 0.5\n"
+        )
+        plan = FaultPlan.from_yaml(str(p))
+        assert len(plan.serve_faults()) == 4
+        assert plan.serve_faults()[0].jid == "job-000002"
+        # jid survives the env-channel JSON roundtrip
+        again = FaultPlan.from_json(plan.to_json())
+        assert again.serve_faults()[0].jid == "job-000002"
+
+    def test_stall_tick_requires_duration(self):
+        with pytest.raises(ValueError):
+            Fault(kind="stall_tick")
