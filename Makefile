@@ -2,7 +2,7 @@
 
 .PHONY: test unit api cli doctest all-tests bench bench-probe faults \
 	bench-batch batch-smoke bench-harness bench-sharded bench-serve \
-	serve-smoke chaos-smoke
+	serve-smoke chaos-smoke bench-churn churn-smoke
 
 test: all-tests
 
@@ -87,3 +87,20 @@ faults:
 	JAX_PLATFORMS=cpu python -m pytest \
 		tests/unit/test_faults.py tests/api/test_api_process_faults.py \
 		-q -m 'not slow'
+
+# warm-repair churn recovery: the seeded 50-mutation stream against a
+# live 100k-var instance — warm in-place mutation (repair retraces MUST
+# be 0) vs the cold repack + recompile baseline, time-to-recover-cost
+# per mutation (docs/resilience.rst "Warm repair and agent churn",
+# BENCHREF.md "Churn recovery")
+bench-churn:
+	python bench.py --only churn
+
+# the seeded churn fault plan driven end-to-end through `run
+# --warm-repair`: edit_factor / remove_agent_burst / add_agent_burst at
+# phase boundaries, kill-9 mid-churn + --resume included; slow-marked,
+# so it does NOT run in tier-1 — run it next to faults/chaos-smoke
+# whenever touching the repair layer
+churn-smoke:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/cli/test_churn_cli.py -q

@@ -1409,6 +1409,179 @@ def bench_serve(args, probe=None):
     return out
 
 
+def bench_churn(args, probe=None):
+    """Warm-repair churn recovery (ISSUE 8): a seeded sustained
+    mutation stream against a LIVE instance — time-to-recover-cost per
+    mutation and the repair retrace count (MUST be 0), warm vs cold.
+
+    Two sub-legs:
+
+    * ``maxsum`` at ``--churn-vars`` (default 100k) variables: the
+      kernel-level warm layout (ops/headroom operand pytree riding the
+      jitted chunk as an ARGUMENT).  A mutation is one ``.at[].set``
+      write on the factor slab; time-to-recover-cost is the wall time
+      of a fixed 3-chunk (30-cycle) re-convergence window after the
+      mutation — the same window for warm and cold, so the comparison
+      isolates exactly the mutation overhead (zero for warm, repack +
+      XLA recompile for cold).  The COLD baseline replays the same
+      stream through a fresh jit closure per mutation (tables baked as
+      constants — exactly what the cold engines do), state carried,
+      recompile included; it runs a capped number of mutations
+      (compile-bound) and reports the per-mutation mean.
+    * ``mgm`` solver-level at 2000 vars through
+      algorithms/warm.build_warm_solver + apply_mutations — the
+      local-search engine of the acceptance criterion, with
+      ``trace_count()`` pinned at its post-warmup value.
+
+    ``churn_speedup`` (cold mean / warm mean) is a same-process ratio,
+    so tunnel/host drift cancels (BENCHREF.md "Churn recovery"); the
+    absolute recover times are additionally probe-normalized like every
+    other leg.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pydcop_tpu.ops.compile import compile_binary_from_arrays
+    from pydcop_tpu.ops.headroom import (
+        make_operands, operand_view, reserve_headroom,
+    )
+    from pydcop_tpu.ops.maxsum_kernels import maxsum_cycle
+    from pydcop_tpu.ops.compile import total_cost
+
+    V = args.churn_vars
+    D = 4
+    n_mut = args.churn_mutations
+    rng = np.random.default_rng(77)
+    # ring lattice: every var constrained to its 2 successors
+    ei = np.concatenate([np.arange(V), np.arange(V)])
+    ej = np.concatenate([(np.arange(V) + 1) % V, (np.arange(V) + 2) % V])
+    mats = rng.uniform(0.0, 5.0, (ei.size, D, D)).astype(np.float32)
+    base = compile_binary_from_arrays(ei, ej, mats, V)
+    cap, layout = reserve_headroom(
+        None, graph="factor", headroom=0.1, tensors=base,
+    )
+    ops0 = make_operands(cap)
+    chunk = 10
+    traces = {"n": 0}
+
+    @jax.jit
+    def run_chunk(q, r, ops):
+        traces["n"] += 1
+        view = operand_view(cap, ops)
+
+        def body(carry, _):
+            q, r = carry
+            q2, r2, _, vals = maxsum_cycle(view, q, r, damping=0.7)
+            return (q2, r2), vals
+
+        (q, r), vals = jax.lax.scan(body, (q, r), None, length=chunk)
+        return q, r, vals[-1], total_cost(view, vals[-1])
+
+    E = int(cap.n_edges)
+    z = jnp.zeros((E, cap.max_domain_size), dtype=jnp.float32)
+
+    def solve_to_target(q, r, ops, target, max_chunks=60):
+        cost = None
+        for _ in range(max_chunks):
+            q, r, vals, cost = run_chunk(q, r, ops)
+            if target is not None and float(cost) <= target:
+                break
+        return q, r, float(cost)
+
+    # converge the base instance (includes the ONE compile)
+    t0 = time.perf_counter()
+    q, r, base_cost = solve_to_target(z, z, ops0, None)
+    out = {"churn_vars": V, "churn_mutations": n_mut,
+           "churn_base_solve_s": round(time.perf_counter() - t0, 3)}
+    traces_after_warmup = traces["n"]
+
+    mut_rows = rng.integers(0, ei.size, size=n_mut)
+    mut_tabs = rng.uniform(0.0, 5.0, (n_mut, D, D)).astype(np.float32)
+
+    # -- warm stream: in-place slab writes, shared compiled chunk ------
+    ops = ops0
+    recover = []
+    for m in range(n_mut):
+        t1 = time.perf_counter()
+        tl = list(ops["tensors"])
+        tl[0] = tl[0].at[int(mut_rows[m])].set(jnp.asarray(mut_tabs[m]))
+        ops = dict(ops, tensors=tuple(tl))
+        q, r, cost = solve_to_target(q, r, ops, target=None,
+                                     max_chunks=3)
+        recover.append(time.perf_counter() - t1)
+    out["churn_warm_recover_s_mean"] = round(
+        float(np.mean(recover)), 5)
+    out["churn_warm_recover_s_p99"] = round(
+        float(np.percentile(recover, 99)), 5)
+    out["churn_warm_retraces"] = traces["n"] - traces_after_warmup
+    out["churn_warm_cost_final"] = round(cost, 2)
+
+    # -- cold baseline: fresh jit closure per mutation (tables baked
+    # as constants, the cold engines' shape), state carried -----------
+    n_cold = min(args.churn_cold_mutations, n_mut)
+    mats_cold = mats.copy()
+    cold_q = jnp.zeros((2 * ei.size, D), dtype=jnp.float32)
+    cold_r = cold_q
+    cold = []
+    for m in range(n_cold):
+        t1 = time.perf_counter()
+        mats_cold[int(mut_rows[m]) % ei.size] = mut_tabs[m]
+        t_cold = compile_binary_from_arrays(ei, ej, mats_cold, V)
+
+        @jax.jit
+        def run_cold(q, r, _t=t_cold):
+            def body(carry, _):
+                q, r = carry
+                q2, r2, _, vals = maxsum_cycle(_t, q, r, damping=0.7)
+                return (q2, r2), vals
+
+            (q, r), vals = jax.lax.scan(
+                body, (q, r), None, length=chunk)
+            return q, r, total_cost(_t, vals[-1])
+
+        for _ in range(3):
+            cold_q, cold_r, c = run_cold(cold_q, cold_r)
+        jax.block_until_ready(c)
+        cold.append(time.perf_counter() - t1)
+    out["churn_cold_mutations"] = n_cold
+    out["churn_cold_recover_s_mean"] = round(float(np.mean(cold)), 5)
+    if out["churn_warm_recover_s_mean"] > 0:
+        out["churn_speedup"] = round(
+            out["churn_cold_recover_s_mean"]
+            / out["churn_warm_recover_s_mean"], 2)
+        out["churn_warm_5x_better"] = (
+            out.get("churn_speedup", 0.0) >= 5.0)
+
+    # -- local-search sub-leg: warm MGM solver, retraces pinned --------
+    from pydcop_tpu.algorithms.warm import build_warm_solver
+    from pydcop_tpu.generators import generate_graph_coloring
+    from pydcop_tpu.runtime.repair import perturbed_constraint
+
+    dcop = generate_graph_coloring(
+        n_variables=2000, n_colors=3, n_edges=6000, soft=True,
+        n_agents=1, seed=5,
+    )
+    solver = build_warm_solver(dcop, algo="mgm", seed=5, headroom=0.1)
+    solver.run(chunk=16)
+    t_base = solver.trace_count()
+    names = sorted(dcop.constraints)
+    rng2 = np.random.default_rng(99)
+    t1 = time.perf_counter()
+    for m in range(min(n_mut, 50)):
+        name = names[int(rng2.integers(len(names)))]
+        new_c = perturbed_constraint(dcop.constraints[name], seed=m)
+        solver.change_factor_function(new_c)
+        solver.run(resume=True, cycles=16, chunk=16)
+    out["churn_mgm_stream_s"] = round(time.perf_counter() - t1, 3)
+    out["churn_mgm_retraces"] = solver.trace_count() - t_base
+    if probe is not None:
+        pr = probe()
+        if pr:
+            out["churn_warm_recover_normalized"] = round(
+                out["churn_warm_recover_s_mean"] * pr, 6)
+    return out
+
+
 def bench_sharded_subprocess(args):
     """ShardedMaxSum on a virtual 8-device CPU mesh, in a subprocess so
     the forced-CPU platform doesn't poison this process's TPU backend."""
@@ -1674,6 +1847,15 @@ def regression_check(value: float, extra: dict, here: str,
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--vars", type=int, default=10_000)
+    # warm-repair churn leg (ISSUE 8; BENCHREF.md "Churn recovery")
+    ap.add_argument("--churn-vars", type=int, default=100_000,
+                    help="live-instance size of the churn leg")
+    ap.add_argument("--churn-mutations", type=int, default=50,
+                    help="seeded mutation-stream length (warm path)")
+    ap.add_argument("--churn-cold-mutations", type=int, default=8,
+                    help="cold-baseline mutations (each pays a full "
+                    "repack + XLA recompile, so the baseline is capped "
+                    "and reported as a per-mutation mean)")
     ap.add_argument("--edges", type=int, default=30_000)
     ap.add_argument("--colors", type=int, default=3)
     ap.add_argument(
@@ -1743,7 +1925,8 @@ def main():
         "--only",
         choices=["all", "maxsum", "dpop", "convergence", "convergence2",
                  "local", "scalefree", "mixed", "sharded",
-                 "sharded-inner", "probe", "batch", "harness", "serve"],
+                 "sharded-inner", "probe", "batch", "harness", "serve",
+                 "churn"],
         default="all",
     )
     # watchdog covers the FULL run: the wholesweep DPOP kernel compile
@@ -1836,7 +2019,7 @@ def main():
     # measurement so both see the same tunnel state
     probe = None
     if args.only in ("all", "maxsum", "probe", "batch", "harness",
-                     "serve"):
+                     "serve", "churn"):
         try:
             probe = make_drift_probe(repeat=args.repeat)
         except Exception as e:
@@ -1965,6 +2148,12 @@ def main():
         except Exception as e:
             extra["serve_error"] = repr(e)
 
+    if args.only in ("all", "churn"):
+        try:
+            extra.update(bench_churn(args, probe=probe))
+        except Exception as e:
+            extra["churn_error"] = repr(e)
+
     def run_with_transient_retry(fn, err_key):
         # the tunneled remote-compile service occasionally drops a
         # response mid-read; one retry keeps such a transient from
@@ -2032,12 +2221,13 @@ def main():
 
     if args.only in ("dpop", "local", "convergence", "convergence2",
                      "scalefree", "mixed", "sharded", "probe", "batch",
-                     "harness", "serve") \
+                     "harness", "serve", "churn") \
             and not value:
         # single-part run: promote the part's headline measurement (not
         # config constants like stretch_vars) to the primary slot
         headline = ("_per_sec", "_wall_s", "_cycles_per", "probe_rate",
-                    "batch_throughput", "serve_throughput")
+                    "batch_throughput", "serve_throughput",
+                    "churn_speedup")
         k = next(
             (k for k in extra if any(h in k for h in headline)),
             next((k for k in extra if not k.endswith("_error")), None),

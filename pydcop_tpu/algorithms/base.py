@@ -67,6 +67,10 @@ class SolveResult:
     #: chosen overlap path, cut fraction, per-cycle collective bytes),
     #: None for single-device solves
     shard: Optional[Dict[str, Any]] = None
+    #: warm-repair scorecard (runtime/stats.RepairCounters: mutations
+    #: applied, headroom claims, retraces, time-to-recover), None
+    #: unless the solve ran through a warm-repair engine
+    repair: Optional[Dict[str, Any]] = None
 
     def metrics(self) -> Dict[str, Any]:
         out = {
@@ -83,6 +87,8 @@ class SolveResult:
             out["harness"] = dict(self.harness)
         if self.shard is not None:
             out["shard"] = dict(self.shard)
+        if self.repair is not None:
+            out["repair"] = dict(self.repair)
         return out
 
 
@@ -242,6 +248,20 @@ class SynchronousTensorSolver:
         """Current value indices [V] for a state."""
         raise NotImplementedError
 
+    def chunk_cost(self, state: Any) -> jnp.ndarray:
+        """Per-cycle collected cost of a state (sign-unadjusted scalar),
+        traced inside the chunk runners for the metrics history.  Warm
+        solvers (algorithms/warm.py) override it to evaluate the cost
+        tables from their state-carried operands — the baked
+        ``self.tensors`` constants would go stale across mutations."""
+        return total_cost(self.tensors, self.values_of(state))
+
+    def trace_count(self) -> int:
+        """Cumulative traces of the fixed-shape masked chunk runners —
+        the repair layer's retrace metric: a warm in-place mutation must
+        add ZERO (pinned in tests/unit/test_warm_repair.py)."""
+        return sum(self._masked_trace_counts.values())
+
     # -- convergence --------------------------------------------------------
 
     def _values_host(self, state: Any) -> np.ndarray:
@@ -327,11 +347,10 @@ class SynchronousTensorSolver:
                 st2 = self.cycle(st, k)
                 if not collect:
                     return st2, None
-                vals = self.values_of(st2)
                 # only the cost is consumed host-side (metrics history);
                 # returning per-cycle values too would ship [n, V] ints
                 # nobody reads
-                return st2, total_cost(self.tensors, vals)
+                return st2, self.chunk_cost(st2)
 
             @jax.jit
             def run_chunk(state, keys):
@@ -366,10 +385,7 @@ class SynchronousTensorSolver:
 
                     def live(s):
                         s2 = self.cycle(s, k)
-                        out = (
-                            total_cost(self.tensors, self.values_of(s2))
-                            if collect else None
-                        )
+                        out = self.chunk_cost(s2) if collect else None
                         return s2, out
 
                     def frozen(s):

@@ -38,12 +38,17 @@ class DynamicMaxSumSolver(MaxSumSolver):
     """MaxSum whose factor tensors can be swapped between (chunks of)
     cycles."""
 
-    def __init__(self, dcop, tensors, algo_def, seed=0):
-        # use_packed=False: _swap_tensor mutates bucket tensors in place,
-        # which the packed engine's pre-baked cost_rows would not see.
-        # (The swap keeps the graph structure, so a future optimization can
-        # rewrite pg.cost_rows in place instead of re-routing.)
-        super().__init__(dcop, tensors, algo_def, seed, use_packed=False)
+    def __init__(self, dcop, tensors, algo_def, seed=0, use_packed=None):
+        # the packed engine is allowed: _swap_tensor rewrites the two
+        # affected cost_rows COLUMNS in place at the layout's fixed
+        # shape (ops.pallas_maxsum.packed_swap_factor — the rewrite
+        # this slot's earlier comment planned); mixed-arity packs are
+        # re-packed.  Compiled chunks are still flushed (the pg is a
+        # closure constant of the single-chip runners) — the ZERO-
+        # retrace path is the warm engine (algorithms/warm,
+        # `--warm-repair`), which carries its operands in state.
+        super().__init__(dcop, tensors, algo_def, seed,
+                         use_packed=use_packed)
 
     def change_factor_function(self, new_constraint: Constraint):
         """Replace the cost function of an existing factor (same name, same
@@ -115,16 +120,43 @@ class DynamicMaxSumSolver(MaxSumSolver):
             self.tensors.buckets[bi] = dataclasses.replace(
                 b, tensors=new_tensors
             )
-            # drop compiled chunks: bucket tensors are captured as constants
+            if self.packed is not None:
+                from pydcop_tpu.ops.pallas_maxsum import (
+                    packed_swap_factor,
+                    try_pack_for_pallas,
+                )
+
+                if not self.packed.mixed \
+                        and self.packed.slot_of_edge is not None:
+                    self.packed = packed_swap_factor(
+                        self.packed, k, padded
+                    )
+                else:  # mixed-arity layout: re-pack (host-side only)
+                    self.packed = try_pack_for_pallas(self.tensors)
+            # drop compiled chunks: the tensor graph rides them as
+            # closure constants on this (cold) solver
             self._compiled_chunks.clear()
             return
         raise ValueError(f"Factor index {gi} not found in any bucket")
 
 
-def build_solver(dcop: DCOP, computation_graph=None, algo_def=None, seed=0):
+def build_solver(dcop: DCOP, computation_graph=None, algo_def=None, seed=0,
+                 headroom=None):
+    """``headroom`` (a float fraction, e.g. 0.25) builds the WARM
+    engine instead (algorithms/warm): the dynamic-DCOP path and the
+    agent-churn repair path become one zero-retrace mechanism
+    (ISSUE 8) — the cold solver below keeps hot-swap semantics but
+    pays a compiled-chunk flush per swap."""
     algo_def = algo_def or AlgorithmDef.build_with_default_params(
         "maxsum_dynamic", parameters_definitions=algo_params
     )
+    if headroom is not None:
+        from pydcop_tpu.algorithms.warm import build_warm_solver
+
+        return build_warm_solver(
+            dcop, algo="maxsum_dynamic", algo_def=algo_def, seed=seed,
+            headroom=headroom,
+        )
     tensors = compile_factor_graph(dcop)
     return DynamicMaxSumSolver(dcop, tensors, algo_def, seed)
 

@@ -1,0 +1,404 @@
+"""Warm-repair solvers: survive live mutations without a cold restart.
+
+ISSUE 8 tentpole.  The cold engines bake the compiled problem's arrays
+into their jitted chunk runners as closure CONSTANTS, so any mutation
+(scenario event, agent-churn repair, dynamic factor swap) forces a full
+repack + XLA recompile.  The warm solvers here instead carry every
+mutable array — cost tables, scope indices, domain masks, unary costs,
+the edge→variable map — INSIDE the solver state pytree, built at a
+fixed **capacity** shape with seeded inert headroom
+(pydcop_tpu.ops.headroom).  The chunk runners trace those arrays as
+arguments, so:
+
+* a mutation is a handful of ``.at[].set`` buffer writes
+  (:meth:`_WarmMixin.apply_mutations`) — ZERO retraces, pinned by
+  trace-count test;
+* solver state (beliefs/messages/assignment/PRNG stream) carries
+  across the mutation for every untouched variable; only the dirtied
+  neighborhood's messages are re-initialized;
+* when headroom runs out, :func:`repack_solver` rebuilds ONCE at a
+  fresh capacity, carrying all per-entity state by name — exactly one
+  retrace, counted and evented by the repair controller
+  (runtime/repair.py).
+
+Supported rules: maxsum (generic kernels) and the mgm/dsa/adsa move
+rules.  The weighted breakout variants (dba/gdba) and the fused
+pallas/edge-slab engines keep the cold path — out of scope here, the
+repack fallback covers them.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pydcop_tpu.algorithms import AlgorithmDef
+from pydcop_tpu.algorithms._local_search import LocalSearchSolver
+from pydcop_tpu.algorithms.adsa import adsa_cycle
+from pydcop_tpu.algorithms.dsa import dsa_cycle
+from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+from pydcop_tpu.algorithms.mgm import mgm_cycle
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.ops.compile import local_cost_tables, total_cost
+from pydcop_tpu.ops.headroom import (
+    Dirty,
+    EditFactor,
+    HeadroomLayout,
+    apply_mutation,
+    make_operands,
+    operand_view,
+    reserve_headroom,
+)
+from pydcop_tpu.ops.maxsum_kernels import init_messages, maxsum_cycle
+from pydcop_tpu.ops.segments import masked_argmin
+
+#: algorithms the warm layer can host at a fixed shape; anything else
+#: falls back to the cold repack path in the orchestrator
+WARM_ALGOS = ("maxsum", "maxsum_dynamic", "mgm", "dsa", "adsa")
+
+
+class _WarmMixin:
+    """Shared warm plumbing: operands-in-state, fixed-shape mutations,
+    host-mirror sync, metrics attachment."""
+
+    #: set by the repair controller; attached to every SolveResult
+    repair_counters = None
+
+    def _init_warm(self, layout: HeadroomLayout) -> None:
+        self.layout = layout
+        self.operands = make_operands(self.tensors)
+
+    def _view(self, ops: Dict):
+        return operand_view(self.tensors, ops)
+
+    def _current_ops(self) -> Dict:
+        state = getattr(self, "_last_state", None)
+        return state[-1] if state is not None else self.operands
+
+    def _sync_host(self, ops: Dict) -> None:
+        """Mirror the operand leaves back onto ``self.tensors`` so host
+        consumers (checkpoint shape checks, metrics, cold comparisons)
+        see the mutated arrays."""
+        t = self.tensors
+        t.domain_mask = ops["mask"]
+        t.unary_costs = ops["unary"]
+        t.edge_var = ops["edge_var"]
+        for b, tt in zip(t.buckets, ops["tensors"]):
+            b.tensors = tt
+
+    def _fresh_row_values(self, ops: Dict, slots: Sequence[int],
+                          values: jnp.ndarray) -> jnp.ndarray:
+        """Re-initialize the dirtied slots' value entries: keep the
+        current value when still valid, else the slot's masked-argmin
+        greedy value (new variables, shrunk domains)."""
+        if not slots:
+            return values
+        idx = jnp.asarray(np.asarray(slots, dtype=np.int32))
+        greedy = masked_argmin(ops["unary"][idx], ops["mask"][idx])
+        cur = values[idx]
+        valid = jnp.take_along_axis(
+            ops["mask"][idx], cur[:, None], axis=1
+        )[:, 0] > 0
+        return values.at[idx].set(
+            jnp.where(valid, cur, greedy).astype(values.dtype)
+        )
+
+    def apply_mutations(self, muts: Sequence) -> List[Dirty]:
+        """Apply mutations as fixed-shape buffer writes; warm-carry all
+        untouched state.  Raises HeadroomExhausted (caller repacks) or
+        ValueError (invalid mutation) with nothing half-applied for the
+        failing mutation."""
+        ops = self._current_ops()
+        dirties: List[Dirty] = []
+        for m in muts:
+            ops, d = apply_mutation(self.tensors, self.layout, ops, m)
+            dirties.append(d)
+        self.operands = ops
+        self._sync_host(ops)
+        state = getattr(self, "_last_state", None)
+        if state is not None:
+            self._last_state = self._dirty_reset(state, ops, dirties)
+        self._vals_cache = None
+        return dirties
+
+    def _dirty_reset(self, state, ops: Dict, dirties: Sequence[Dirty]):
+        raise NotImplementedError
+
+    def restore_headroom_meta(self, hmeta: Dict) -> None:
+        """Re-adopt a checkpoint's headroom layout (schema v3,
+        runtime/checkpoint.py): the mutated ARRAYS were restored with
+        the state leaves; this restores the claimed/free slot maps and
+        the capacity host metadata so they are addressable by name —
+        a ``--resume`` lands on the mutated problem at its exact
+        padded shape."""
+        self.layout = HeadroomLayout.from_meta(hmeta["layout"])
+        t = self.tensors
+        t.layout = self.layout
+        t.var_names = list(hmeta["var_names"])
+        t.domain_values = [tuple(v) for v in hmeta["domain_values"]]
+        t.domain_sizes = np.array(
+            [len(d) for d in t.domain_values], dtype=np.int32
+        )
+        t.factor_names = list(hmeta["factor_names"])
+        state = getattr(self, "_last_state", None)
+        if state is not None:
+            ops = state[-1]
+            self.operands = ops
+            self._sync_host(ops)
+            for b, vi in zip(t.buckets, ops["var_idx"]):
+                b.var_idx = np.asarray(vi)
+
+    # -- maxsum_dynamic compatibility (one mechanism, ISSUE 8): the
+    # orchestrator's change_factor / set_external actions land here as
+    # fixed-shape edits instead of a compiled-chunk flush ------------------
+
+    def change_factor_function(self, new_constraint) -> None:
+        ext = {
+            ev.name: ev.value
+            for ev in self.dcop.external_variables.values()
+        }
+        sliced = (
+            new_constraint.slice(ext)
+            if any(n in ext for n in new_constraint.scope_names)
+            else new_constraint
+        )
+        self.apply_mutations([EditFactor(sliced)])
+        self.dcop.constraints[new_constraint.name] = new_constraint
+
+    def on_external_change(self, ext_name: str, value) -> None:
+        self.dcop.external_variables[ext_name].value = value
+        ext = {
+            ev.name: ev.value
+            for ev in self.dcop.external_variables.values()
+        }
+        muts = []
+        for name, c in self.dcop.constraints.items():
+            if ext_name in c.scope_names and self.layout.has_factor(name):
+                muts.append(EditFactor(c.slice(ext)))
+        if muts:
+            self.apply_mutations(muts)
+
+
+class WarmMaxSumSolver(_WarmMixin, MaxSumSolver):
+    """MaxSum at capacity: state = (q, r, values, operands)."""
+
+    def __init__(self, dcop, cap_tensors, layout, algo_def, seed=0):
+        super().__init__(dcop, cap_tensors, algo_def, seed,
+                         use_packed=False)
+        # the edge-slab megascale engine bakes its slabs per compile;
+        # the warm layer's whole point is operand-carried tables
+        self.eslabs = None
+        self._init_warm(layout)
+
+    def initial_state(self):
+        q, r = init_messages(self.tensors)
+        values = masked_argmin(self.operands["unary"],
+                               self.operands["mask"])
+        return q, r, values, self.operands
+
+    def cycle(self, state, key):
+        q, r, _, ops = state
+        q2, r2, _beliefs, values = maxsum_cycle(
+            self._view(ops), q, r, damping=self.damping
+        )
+        return q2, r2, values, ops
+
+    def values_of(self, state):
+        return state[2]
+
+    def chunk_cost(self, state):
+        return total_cost(self._view(state[3]), state[2])
+
+    def _dirty_reset(self, state, ops, dirties):
+        q, r, values, _ = state
+        slots: List[int] = []
+        for d in dirties:
+            if d.edge_hi > d.edge_lo:
+                q = q.at[d.edge_lo:d.edge_hi].set(0.0)
+                r = r.at[d.edge_lo:d.edge_hi].set(0.0)
+            slots.extend(d.var_slots)
+        values = self._fresh_row_values(ops, slots, values)
+        return q, r, values, ops
+
+    def run(self, *args, **kwargs):
+        res = super().run(*args, **kwargs)
+        if self.repair_counters is not None:
+            res.repair = self.repair_counters.as_dict()
+        return res
+
+
+class WarmLocalSearchSolver(_WarmMixin, LocalSearchSolver):
+    """mgm / dsa / adsa at capacity: state = (x, operands).
+
+    The neighbor arbitration pairs are DERIVED from the var_idx
+    operands inside the cycle (pydcop_tpu.ops.headroom.derived_pairs),
+    so adding or removing a factor rewires the MGM neighborhood without
+    touching any static index list.
+    """
+
+    RULES = ("mgm", "dsa", "adsa")
+
+    def __init__(self, dcop, cap_tensors, layout, algo_def, seed=0):
+        super().__init__(dcop, cap_tensors, algo_def, seed,
+                         use_packed=False)
+        rule = algo_def.algo
+        if rule not in self.RULES:
+            raise ValueError(
+                f"warm local search supports {self.RULES}, not {rule!r}"
+            )
+        self.rule = rule
+        self.probability = float(self.params.get("probability", 0.7))
+        self.variant = self.params.get("variant", "B")
+        self.activation = float(self.params.get("activation", 0.5))
+        self._init_warm(layout)
+
+    def initial_state(self):
+        x = self.initial_values(jax.random.PRNGKey(self.seed + 17))
+        return x, self.operands
+
+    def cycle(self, state, key):
+        x, ops = state
+        view = self._view(ops)
+        tables = local_cost_tables(view, x)
+        V = self.tensors.n_vars
+        if self.rule == "mgm":
+            x2 = mgm_cycle(view, x, tables=tables)
+        elif self.rule == "dsa":
+            u = jax.random.uniform(key, (V,))
+            x2 = dsa_cycle(view, x, u, self.probability, self.variant,
+                           tables=tables)
+        else:  # adsa
+            k_wake, k_move = jax.random.split(key)
+            x2 = adsa_cycle(
+                view, x,
+                jax.random.uniform(k_wake, (V,)),
+                jax.random.uniform(k_move, (V,)),
+                self.probability, self.variant, self.activation,
+                tables=tables,
+            )
+        return x2, ops
+
+    def values_of(self, state):
+        return state[0]
+
+    def chunk_cost(self, state):
+        return total_cost(self._view(state[1]), state[0])
+
+    def _dirty_reset(self, state, ops, dirties):
+        x, _ = state
+        slots: List[int] = []
+        for d in dirties:
+            slots.extend(d.var_slots)
+        return self._fresh_row_values(ops, slots, x), ops
+
+    def run(self, *args, **kwargs):
+        res = super().run(*args, **kwargs)
+        if self.repair_counters is not None:
+            res.repair = self.repair_counters.as_dict()
+        return res
+
+
+# ---------------------------------------------------------------------------
+# builders
+# ---------------------------------------------------------------------------
+
+
+def _graph_for(algo: str) -> str:
+    return "factor" if algo in ("maxsum", "maxsum_dynamic") else "constraint"
+
+
+def build_warm_solver(
+    dcop: DCOP,
+    algo: str = "maxsum",
+    algo_def: Optional[AlgorithmDef] = None,
+    seed: int = 0,
+    headroom: float = 0.25,
+    min_free: int = 4,
+    tensors=None,
+):
+    """Build a warm-repair solver at capacity for a supported algo."""
+    if algo not in WARM_ALGOS:
+        raise ValueError(
+            f"algorithm {algo!r} has no warm engine; supported: "
+            f"{WARM_ALGOS}"
+        )
+    if algo_def is None:
+        algo_def = AlgorithmDef.build_with_default_params(
+            algo, mode=dcop.objective,
+        )
+    graph = _graph_for(algo)
+    cap, layout = reserve_headroom(
+        dcop, graph=graph, headroom=headroom, min_free=min_free,
+        tensors=tensors,
+    )
+    if graph == "factor":
+        return WarmMaxSumSolver(dcop, cap, layout, algo_def, seed=seed)
+    return WarmLocalSearchSolver(dcop, cap, layout, algo_def, seed=seed)
+
+
+def repack_solver(old, headroom: Optional[float] = None,
+                  min_free: int = 4):
+    """ONE cold repack that re-reserves headroom: rebuild the capacity
+    layout from the (mutated) DCOP and carry every claimed entity's
+    state — assignment/values and per-edge messages by NAME, unary
+    rows (including the symmetry-breaking noise) by slot — so the new
+    solver continues from exactly where the old one stood.  Costs
+    exactly one retrace on its next chunk (pinned in
+    tests/unit/test_warm_repair.py)."""
+    algo = old.algo_def.algo
+    new = build_warm_solver(
+        old.dcop, algo=algo, algo_def=old.algo_def, seed=old.seed,
+        headroom=old.layout.headroom if headroom is None else headroom,
+        min_free=min_free,
+    )
+    old_ops = old._current_ops()
+    old_lay, new_lay = old.layout, new.layout
+
+    state = new.initial_state()
+    ops = dict(state[-1])
+    mask = np.asarray(ops["mask"]).copy()
+    unary = np.asarray(ops["unary"]).copy()
+    old_mask = np.asarray(old_ops["mask"])
+    old_unary = np.asarray(old_ops["unary"])
+    old_vals = np.asarray(old.values_of(old._last_state)) \
+        if getattr(old, "_last_state", None) is not None else None
+    vals = np.asarray(new.values_of(state)).copy()
+    for name in old_lay.claimed_vars:
+        os_, ns_ = old_lay.var_slot(name), new_lay.var_slot(name)
+        mask[ns_] = old_mask[os_]
+        unary[ns_] = old_unary[os_]
+        if old_vals is not None:
+            vals[ns_] = old_vals[os_]
+    ops["mask"] = jnp.asarray(mask)
+    ops["unary"] = jnp.asarray(unary)
+
+    if isinstance(new, WarmMaxSumSolver):
+        q, r, _, _ = state
+        q, r = np.asarray(q).copy(), np.asarray(r).copy()
+        if getattr(old, "_last_state", None) is not None:
+            oq, orr = (np.asarray(old._last_state[0]),
+                       np.asarray(old._last_state[1]))
+            for b, names in enumerate(old_lay.fac_names):
+                for k, fname in enumerate(names):
+                    if fname is None or not new_lay.has_factor(fname):
+                        continue
+                    nb, nk = new_lay.factor_slot(fname)
+                    a = old_lay.arities[b]
+                    olo = old.tensors.buckets[b].edge_offset + k * a
+                    nlo = new.tensors.buckets[nb].edge_offset + nk * a
+                    q[nlo:nlo + a] = oq[olo:olo + a]
+                    r[nlo:nlo + a] = orr[olo:olo + a]
+        new_state = (jnp.asarray(q), jnp.asarray(r),
+                     jnp.asarray(vals), ops)
+    else:
+        new_state = (jnp.asarray(vals).astype(jnp.int32), ops)
+    new.operands = ops
+    new._sync_host(ops)
+    new._last_state = new_state
+    key = getattr(old, "_last_key", None)
+    if key is not None:
+        new._last_key = key
+    new.repair_counters = old.repair_counters
+    return new

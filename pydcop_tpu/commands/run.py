@@ -55,6 +55,18 @@ def set_parser(subparsers):
     parser.add_argument("--resume", action="store_true",
                         help="warm-start from the newest valid snapshot "
                         "in --checkpoint (corrupt files are skipped)")
+    # warm repair (docs/resilience.rst "Warm repair and agent churn")
+    parser.add_argument("--warm-repair", action="store_true",
+                        help="route scenario mutations and agent churn "
+                        "through the warm-repair layer: in-place "
+                        "fixed-shape buffer writes at reserved headroom "
+                        "(zero retraces; one counted repack when "
+                        "exhausted) instead of cold restarts "
+                        "(maxsum/maxsum_dynamic/mgm/dsa/adsa)")
+    parser.add_argument("--headroom", type=float, default=0.25,
+                        help="with --warm-repair: reserved inert slot "
+                        "fraction of the compiled capacity (default "
+                        "0.25)")
     return parser
 
 
@@ -94,6 +106,8 @@ def run_cmd(args):
         checkpoint_dir=args.checkpoint,
         checkpoint_every=args.checkpoint_every,
         auto_resume=args.resume,
+        warm_repair=args.warm_repair,
+        headroom=args.headroom,
     )
     orch.deploy_computations()
     if args.replica_dist:

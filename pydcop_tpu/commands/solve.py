@@ -84,6 +84,13 @@ def set_parser(subparsers):
                         default=0.5,
                         help="auto-policy cut-fraction threshold above "
                         "which the dense psum is kept (default 0.5)")
+    # warm repair (docs/resilience.rst "Warm repair and agent churn")
+    parser.add_argument("--headroom", type=float, default=None,
+                        help="build the WARM-repair engine with this "
+                        "reserved headroom fraction (e.g. 0.25): live "
+                        "mutations become fixed-shape buffer writes "
+                        "with zero retraces; repair counters land in "
+                        "metrics['repair'] (maxsum/mgm/dsa/adsa)")
     # crash resilience (docs/resilience.rst)
     parser.add_argument("--checkpoint", default=None,
                         help="rotating snapshot directory: solver state "
@@ -156,6 +163,7 @@ def run_cmd(args):
             resume=args.resume,
             shard_overlap=args.shard_overlap,
             shard_boundary_threshold=args.shard_boundary_threshold,
+            headroom=args.headroom,
         )
     except Exception as e:
         output_metrics({"status": "ERROR", "error": str(e)}, args.output)

@@ -80,6 +80,57 @@ class DcopEvent(SimpleRepr):
         return f"DcopEvent({self._event_id!r}, {self._actions})"
 
 
+def churn_scenario(
+    dcop,
+    n_events: int,
+    seed: int = 0,
+    delay: float = 0.2,
+    kinds: Optional[Iterable[str]] = None,
+) -> "Scenario":
+    """A seeded churn stream over a live DCOP (ISSUE 8): ``n_events``
+    mutation events separated by ``delay`` solving phases, each a
+    seeded choice among ``kinds`` (default: factor edits + agent
+    remove/add — the sustained-mutation workload of the warm-repair
+    bench leg and ``make churn-smoke``).  Same (dcop, seed) → same
+    stream, so a killed run can replay it deterministically.
+
+    ``change_factor`` events perturb a seeded constraint's table
+    through the same :func:`pydcop_tpu.runtime.repair.
+    perturbed_constraint` jitter the ``edit_factor`` fault kind uses
+    (routed here as an expression-less action the orchestrator resolves
+    at apply time via the ``seed`` parameter).
+    """
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    kinds = tuple(kinds) if kinds else (
+        "change_factor", "change_factor", "remove_agent", "add_agent",
+    )
+    events: List[DcopEvent] = []
+    alive = sorted(dcop.agents)
+    added = 0
+    constraints = sorted(dcop.constraints)
+    for i in range(n_events):
+        events.append(DcopEvent(f"churn_d{i}", delay=delay))
+        kind = kinds[int(rng.integers(len(kinds)))]
+        if kind == "remove_agent" and len(alive) > 1:
+            a = alive.pop(int(rng.integers(len(alive))))
+            act = EventAction("remove_agent", agent=a)
+        elif kind == "add_agent":
+            added += 1
+            name = f"churn_agent_{added:03d}"
+            alive.append(name)
+            act = EventAction("add_agent", agent=name)
+        else:
+            c = constraints[int(rng.integers(len(constraints)))]
+            act = EventAction(
+                "change_factor", constraint=c, seed=int(seed) + i,
+            )
+        events.append(DcopEvent(f"churn_e{i}", actions=[act]))
+    events.append(DcopEvent("churn_final", delay=delay))
+    return Scenario(events)
+
+
 class Scenario(SimpleRepr):
     """An ordered stream of events applied to a running dynamic DCOP."""
 

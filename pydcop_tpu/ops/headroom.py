@@ -1,0 +1,665 @@
+"""Seeded headroom layouts: mutate a compiled problem at a FIXED shape.
+
+ISSUE 8 tentpole.  Every dynamic-DCOP mutation used to be a cold
+restart: ``dcop/scenario.py`` events and the ``reparation/`` repair path
+triggered a full repack + XLA recompile + from-scratch solve, so a
+single departed agent cost seconds of recompile on a problem that was
+milliseconds from converged.  This module is the fixed-shape discipline
+(PGMax, arXiv:2202.04110) that makes mutation the fast path:
+
+* :func:`reserve_headroom` compiles a DCOP's tensor graph at
+  **capacity**: the real variables/factors plus a seeded reserve of
+  *inert* slots — free variable slots with a single valid value and
+  zero cost, and free factor slots holding all-zero tables wired to a
+  dedicated **parking variable** (the batch engine's dummy-variable
+  routing trick: a zero table attached to parking generates exactly
+  zero messages/contributions, and parking's single-valued domain
+  forces its outgoing messages to zero after mean-normalization).
+* :class:`HeadroomLayout` is the claimed/free slot bookkeeping: a
+  mutation *claims* a slot (add variable / add factor) or *releases*
+  one (remove) — never changes an array shape.
+* :func:`make_operands` extracts the MUTABLE arrays (cost tables,
+  scope indices, masks, unary costs, edge→var map) as one pytree that
+  warm solvers carry INSIDE their jitted state, so the chunk runners
+  trace them as arguments; :func:`apply_mutation` then turns every
+  add/remove/edit into masked ``.at[].set`` buffer writes — zero
+  retraces, pinned by trace-count tests (tests/unit/test_warm_repair).
+
+Shapes are static; only data moves.  When the reserve runs out the
+caller repacks ONCE at a fresh capacity (see runtime/repair.py) — a
+counted, evented, single-retrace event, never a mid-run exception.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from pydcop_tpu.dcop.relations import Constraint
+from pydcop_tpu.ops.compile import (
+    ConstraintGraphTensors,
+    FactorBucket,
+    FactorGraphTensors,
+    PAD_COST,
+)
+
+#: host-side placeholder name of an unclaimed slot (never a real name:
+#: YAML identifiers cannot start with ``__``)
+FREE = None
+
+
+class HeadroomExhausted(RuntimeError):
+    """A mutation needed a slot kind the layout has no free slot for.
+    The repair controller catches this and performs ONE counted repack
+    (``repair.repack`` event) — callers never see it mid-run."""
+
+
+@dataclasses.dataclass
+class HeadroomLayout:
+    """Claimed/free slot maps of a capacity layout.
+
+    ``var_names[i]`` is the DCOP variable claimed at slot ``i`` (or
+    None when free); the last slot is the parking variable and is never
+    claimable.  ``fac_names[b][k]`` likewise per arity bucket.  The
+    maps are json-serializable (:meth:`to_meta`) so checkpoints (schema
+    v3, runtime/checkpoint.py) can restore a mutated problem at its
+    exact padded shape.
+    """
+
+    n_vars_cap: int
+    parking: int
+    headroom: float
+    var_names: List[Optional[str]]
+    arities: Tuple[int, ...]
+    fac_names: List[List[Optional[str]]]
+
+    # -- queries ------------------------------------------------------------
+
+    @property
+    def claimed_vars(self) -> List[str]:
+        return [n for i, n in enumerate(self.var_names)
+                if n is not FREE and i != self.parking]
+
+    def free_var_slots(self) -> List[int]:
+        return [
+            i for i, n in enumerate(self.var_names)
+            if n is FREE and i != self.parking
+        ]
+
+    def free_factor_slots(self, arity: int) -> List[int]:
+        for b, a in enumerate(self.arities):
+            if a == arity:
+                return [
+                    k for k, n in enumerate(self.fac_names[b]) if n is FREE
+                ]
+        return []
+
+    def var_slot(self, name: str) -> int:
+        try:
+            return self.var_names.index(name)
+        except ValueError:
+            raise KeyError(f"unknown variable {name!r}") from None
+
+    def factor_slot(self, name: str) -> Tuple[int, int]:
+        for b, names in enumerate(self.fac_names):
+            if name in names:
+                return b, names.index(name)
+        raise KeyError(f"unknown factor {name!r}")
+
+    def has_factor(self, name: str) -> bool:
+        return any(name in names for names in self.fac_names)
+
+    def bucket_for_arity(self, arity: int) -> Optional[int]:
+        for b, a in enumerate(self.arities):
+            if a == arity:
+                return b
+        return None
+
+    # -- claims -------------------------------------------------------------
+
+    def claim_var(self, name: str) -> int:
+        free = self.free_var_slots()
+        if not free:
+            raise HeadroomExhausted(
+                f"no free variable slot for {name!r} "
+                f"({self.n_vars_cap} capacity, all claimed)"
+            )
+        slot = free[0]
+        self.var_names[slot] = name
+        return slot
+
+    def release_var(self, name: str) -> int:
+        slot = self.var_slot(name)
+        self.var_names[slot] = FREE
+        return slot
+
+    def claim_factor(self, name: str, arity: int) -> Tuple[int, int]:
+        b = self.bucket_for_arity(arity)
+        if b is None:
+            raise HeadroomExhausted(
+                f"no arity-{arity} bucket in the capacity layout for "
+                f"factor {name!r}"
+            )
+        free = [k for k, n in enumerate(self.fac_names[b]) if n is FREE]
+        if not free:
+            raise HeadroomExhausted(
+                f"no free arity-{arity} factor slot for {name!r}"
+            )
+        k = free[0]
+        self.fac_names[b][k] = name
+        return b, k
+
+    def release_factor(self, name: str) -> Tuple[int, int]:
+        b, k = self.factor_slot(name)
+        self.fac_names[b][k] = FREE
+        return b, k
+
+    # -- checkpoint schema v3 ------------------------------------------------
+
+    def to_meta(self) -> Dict:
+        """JSON-able claimed/free slot maps (checkpoint schema v3)."""
+        return {
+            "n_vars_cap": self.n_vars_cap,
+            "parking": self.parking,
+            "headroom": self.headroom,
+            "var_names": list(self.var_names),
+            "arities": list(self.arities),
+            "fac_names": [list(ns) for ns in self.fac_names],
+        }
+
+    @classmethod
+    def from_meta(cls, meta: Dict) -> "HeadroomLayout":
+        return cls(
+            n_vars_cap=int(meta["n_vars_cap"]),
+            parking=int(meta["parking"]),
+            headroom=float(meta["headroom"]),
+            var_names=list(meta["var_names"]),
+            arities=tuple(int(a) for a in meta["arities"]),
+            fac_names=[list(ns) for ns in meta["fac_names"]],
+        )
+
+
+@dataclasses.dataclass
+class HeadroomFactorTensors(FactorGraphTensors):
+    """Capacity factor-graph tensors: free/parking slots are invisible
+    to the host assignment (claimed variables only)."""
+
+    layout: Optional[HeadroomLayout] = None
+
+    def assignment_from_indices(self, x: np.ndarray) -> Dict[str, object]:
+        lay = self.layout
+        return {
+            n: self.domain_values[i][int(x[i])]
+            for i, n in enumerate(self.var_names)
+            if lay.var_names[i] is not FREE and i != lay.parking
+        }
+
+
+@dataclasses.dataclass
+class HeadroomConstraintTensors(ConstraintGraphTensors):
+    """Capacity constraints-hypergraph tensors (local-search family)."""
+
+    layout: Optional[HeadroomLayout] = None
+
+    def assignment_from_indices(self, x: np.ndarray) -> Dict[str, object]:
+        lay = self.layout
+        return {
+            n: self.domain_values[i][int(x[i])]
+            for i, n in enumerate(self.var_names)
+            if lay.var_names[i] is not FREE and i != lay.parking
+        }
+
+
+def _slots_for(n: int, headroom: float, min_free: int) -> int:
+    return max(int(min_free), int(math.ceil(n * float(headroom))))
+
+
+def reserve_headroom(
+    dcop,
+    graph: str = "factor",
+    headroom: float = 0.25,
+    min_free: int = 4,
+    ensure_arities: Sequence[int] = (2,),
+    tensors=None,
+):
+    """Compile ``dcop`` at capacity: real slots + seeded inert headroom.
+
+    Returns ``(cap_tensors, layout)`` where ``cap_tensors`` is a
+    :class:`HeadroomFactorTensors` / :class:`HeadroomConstraintTensors`
+    whose free slots are inert (see module docstring) and ``layout`` is
+    the claim bookkeeping.  ``tensors`` substitutes a pre-compiled base
+    graph (the bench's array-built instances); otherwise the base is
+    compiled from the DCOP exactly as the cold engines do.
+    ``ensure_arities`` guarantees a factor bucket exists for those
+    arities even when the seed problem has none (so a mutation can add
+    the first binary factor without a repack).
+    """
+    from pydcop_tpu.ops.compile import (
+        compile_constraint_graph,
+        compile_factor_graph,
+    )
+
+    if tensors is None:
+        tensors = (
+            compile_factor_graph(dcop) if graph == "factor"
+            else compile_constraint_graph(dcop)
+        )
+    V, D = tensors.n_vars, tensors.max_domain_size
+    n_free_v = _slots_for(V, headroom, min_free)
+    Vc = V + n_free_v + 1  # +1 parking
+    parking = Vc - 1
+
+    # -- variable-side arrays at capacity ----------------------------------
+    mask = np.zeros((Vc, D), dtype=np.float32)
+    unary = np.full((Vc, D), PAD_COST, dtype=np.float32)
+    mask[:V] = np.asarray(tensors.domain_mask)
+    unary[:V] = np.asarray(tensors.unary_costs)
+    # inert slots: one valid value, zero cost
+    mask[V:, 0] = 1.0
+    unary[V:, 0] = 0.0
+    domain_values = list(tensors.domain_values) + [(0,)] * (Vc - V)
+    domain_sizes = np.concatenate(
+        [np.asarray(tensors.domain_sizes, dtype=np.int32),
+         np.ones(Vc - V, dtype=np.int32)]
+    )
+    var_names = list(tensors.var_names) + [
+        f"__free_{i:04d}" for i in range(n_free_v)
+    ] + ["__parking"]
+    init = np.concatenate(
+        [np.asarray(tensors.initial_values, dtype=np.int32),
+         np.zeros(Vc - V, dtype=np.int32)]
+    )
+    has_init = np.concatenate(
+        [np.asarray(tensors.has_initial, dtype=bool),
+         # inert slots hold their single value: mark as pinned so the
+         # local-search random init cannot wiggle them
+         np.ones(Vc - V, dtype=bool)]
+    )
+
+    # -- factor buckets at capacity ----------------------------------------
+    arities = sorted(
+        {b.arity for b in tensors.buckets} | set(ensure_arities)
+    )
+    buckets: List[FactorBucket] = []
+    fac_names: List[List[Optional[str]]] = []
+    edge_var_parts: List[np.ndarray] = []
+    offset = 0
+    gid = 0
+    factor_names_cap: List[str] = []
+    by_arity = {b.arity: b for b in tensors.buckets}
+    for a in arities:
+        b = by_arity.get(a)
+        F = b.n_factors if b is not None else 0
+        Fc = F + _slots_for(F, headroom, min_free)
+        t_cap = np.zeros((Fc,) + (D,) * a, dtype=np.float32)
+        vi_cap = np.full((Fc, a), parking, dtype=np.int32)
+        names: List[Optional[str]] = [FREE] * Fc
+        if b is not None:
+            t_cap[:F] = np.asarray(b.tensors)
+            vi_cap[:F] = np.asarray(b.var_idx)
+            for k, fid in enumerate(np.asarray(b.factor_ids)):
+                names[k] = tensors.factor_names[int(fid)]
+        buckets.append(
+            FactorBucket(
+                arity=a,
+                tensors=jnp.asarray(t_cap),
+                var_idx=vi_cap,
+                factor_ids=np.arange(gid, gid + Fc, dtype=np.int32),
+                edge_offset=offset,
+            )
+        )
+        fac_names.append(names)
+        factor_names_cap.extend(
+            n if n is not FREE else f"__slot_{a}_{k:04d}"
+            for k, n in enumerate(names)
+        )
+        edge_var_parts.append(vi_cap.reshape(-1))
+        offset += Fc * a
+        gid += Fc
+    edge_var = (
+        np.concatenate(edge_var_parts)
+        if edge_var_parts else np.zeros(0, dtype=np.int32)
+    )
+
+    layout = HeadroomLayout(
+        n_vars_cap=Vc,
+        parking=parking,
+        headroom=float(headroom),
+        var_names=list(tensors.var_names) + [FREE] * n_free_v + ["__parking"],
+        arities=tuple(arities),
+        fac_names=fac_names,
+    )
+    # parking is "claimed" by the sentinel name so claim_var never
+    # hands it out (free_var_slots also excludes it by index)
+    common = dict(
+        var_names=var_names,
+        domain_values=domain_values,
+        domain_sizes=domain_sizes,
+        domain_mask=jnp.asarray(mask),
+        unary_costs=jnp.asarray(unary),
+        buckets=buckets,
+        edge_var=jnp.asarray(edge_var, dtype=jnp.int32),
+        factor_names=factor_names_cap,
+        sign=tensors.sign,
+        initial_values=init,
+        has_initial=has_init,
+        layout=layout,
+    )
+    if graph == "factor":
+        cap = HeadroomFactorTensors(**common)
+    else:
+        # neighbor pairs are DERIVED per-cycle from the var_idx operands
+        # (duplicates across factors are harmless to the segment-max
+        # arbitration); the static arrays here only back host metrics
+        src, dst = derived_pairs_host(buckets)
+        cap = HeadroomConstraintTensors(
+            **common,
+            neighbor_src=jnp.asarray(src),
+            neighbor_dst=jnp.asarray(dst),
+        )
+    return cap, layout
+
+
+# ---------------------------------------------------------------------------
+# mutable operands: the pytree warm solvers carry inside their state
+# ---------------------------------------------------------------------------
+
+
+def make_operands(cap) -> Dict:
+    """Extract the mutable arrays of a capacity graph as one pytree.
+
+    Everything a mutation can touch rides here — carried inside the
+    solver state so the jitted chunk runners receive it as a traced
+    ARGUMENT (never a baked constant): that is what makes an in-place
+    mutation retrace-free.
+    """
+    return {
+        "mask": jnp.asarray(cap.domain_mask),
+        "unary": jnp.asarray(cap.unary_costs),
+        "tensors": tuple(jnp.asarray(b.tensors) for b in cap.buckets),
+        "var_idx": tuple(
+            jnp.asarray(b.var_idx, dtype=jnp.int32) for b in cap.buckets
+        ),
+        "edge_var": jnp.asarray(cap.edge_var, dtype=jnp.int32),
+    }
+
+
+def operand_view(cap, ops: Dict):
+    """A tensors VIEW whose mutable arrays are the (possibly traced)
+    operand leaves — every existing kernel (maxsum_cycle,
+    local_cost_tables, total_cost, the move rules) runs on it
+    unchanged."""
+    buckets = [
+        dataclasses.replace(b, tensors=t, var_idx=vi)
+        for b, t, vi in zip(cap.buckets, ops["tensors"], ops["var_idx"])
+    ]
+    kw = dict(
+        domain_mask=ops["mask"],
+        unary_costs=ops["unary"],
+        buckets=buckets,
+        edge_var=ops["edge_var"],
+    )
+    if isinstance(cap, HeadroomConstraintTensors):
+        src, dst = derived_pairs(ops["var_idx"], cap.buckets)
+        kw.update(neighbor_src=src, neighbor_dst=dst)
+    return dataclasses.replace(cap, **kw)
+
+
+def derived_pairs(var_idx_leaves, buckets):
+    """Directed neighbor pairs derived from the var_idx operands — one
+    ordered pair per (factor slot, position pair), fixed shape.
+
+    Unlike compile_constraint_graph's deduplicated pair list this keeps
+    duplicates (two factors over the same scope yield the pair twice)
+    and parking self-pairs from free slots — both are no-ops to the
+    segment-max/min arbitration of ``neighborhood_winner`` (max and min
+    are idempotent; parking's gain is always 0).
+    """
+    src_parts, dst_parts = [], []
+    for vi, b in zip(var_idx_leaves, buckets):
+        a = b.arity
+        for p in range(a):
+            for q in range(a):
+                if p != q:
+                    src_parts.append(vi[:, p])
+                    dst_parts.append(vi[:, q])
+    if not src_parts:
+        z = jnp.zeros(0, dtype=jnp.int32)
+        return z, z
+    return (
+        jnp.concatenate(src_parts).astype(jnp.int32),
+        jnp.concatenate(dst_parts).astype(jnp.int32),
+    )
+
+
+def derived_pairs_host(buckets) -> Tuple[np.ndarray, np.ndarray]:
+    src, dst = derived_pairs(
+        tuple(np.asarray(b.var_idx) for b in buckets), buckets
+    )
+    return np.asarray(src), np.asarray(dst)
+
+
+# ---------------------------------------------------------------------------
+# mutations
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class EditFactor:
+    """Replace the cost function of an existing factor (same scope)."""
+
+    constraint: Constraint
+
+
+@dataclasses.dataclass
+class AddFactor:
+    """Claim a free slot of the constraint's arity and wire it in."""
+
+    constraint: Constraint
+
+
+@dataclasses.dataclass
+class RemoveFactor:
+    name: str
+
+
+@dataclasses.dataclass
+class AddVariable:
+    """Claim a free variable slot.  ``variable`` is a dcop Variable;
+    factors over it are added separately (AddFactor)."""
+
+    variable: object
+    unary_noise: Optional[np.ndarray] = None  # [D] noise row (maxsum)
+
+
+@dataclasses.dataclass
+class RemoveVariable:
+    """Release a variable slot.  All its claimed factors must have been
+    removed first (enforced)."""
+
+    name: str
+
+
+@dataclasses.dataclass
+class Dirty:
+    """What a mutation touched — drives the warm-start partial re-init
+    (only the dirtied neighborhood's messages reset; everything else
+    carries across the mutation)."""
+
+    var_slots: List[int] = dataclasses.field(default_factory=list)
+    edge_lo: int = 0
+    edge_hi: int = 0  # [lo, hi) edge range of the touched factor slot
+
+
+def _aligned_table(cap, constraint: Constraint, slot_names: List[str],
+                   sign: float) -> np.ndarray:
+    """The constraint's (sign-adjusted, PAD-padded) table with axes in
+    ``slot_names`` order (the slot's existing scope order for edits —
+    same realignment as maxsum_dynamic._swap_tensor)."""
+    new_names = [d.name for d in constraint.dimensions]
+    if set(new_names) != set(slot_names):
+        raise ValueError(
+            f"factor {constraint.name!r} covers {new_names}, slot "
+            f"expects {slot_names} — mutations must keep the scope"
+        )
+    t = sign * constraint.to_tensor()
+    if new_names != slot_names:
+        t = np.transpose(t, [new_names.index(n) for n in slot_names])
+    D = cap.max_domain_size
+    padded = np.full((D,) * constraint.arity, PAD_COST, dtype=np.float32)
+    padded[tuple(slice(0, s) for s in t.shape)] = t
+    return padded
+
+
+def apply_mutation(cap, layout: HeadroomLayout, ops: Dict, mut) -> Tuple[
+        Dict, Dirty]:
+    """Apply one mutation as fixed-shape buffer writes.
+
+    Returns ``(new_operands, dirty)``.  Raises
+    :class:`HeadroomExhausted` when no free slot of the needed kind
+    remains (the caller repacks), ``ValueError`` on invalid mutations
+    (unknown names, scope mismatches) — and in both cases the layout,
+    operands and host metadata are left untouched.
+    """
+    if isinstance(mut, EditFactor):
+        c = mut.constraint
+        b, k = layout.factor_slot(c.name)
+        bko = cap.buckets[b]
+        slot_names = [cap.var_names[int(v)] for v in bko.var_idx[k]]
+        if c.arity != layout.arities[b]:
+            raise ValueError(
+                f"factor {c.name!r} has arity {c.arity}, slot expects "
+                f"{layout.arities[b]} — mutations must keep the scope"
+            )
+        table = _aligned_table(cap, c, slot_names, cap.sign)
+        ops = dict(ops)
+        tl = list(ops["tensors"])
+        tl[b] = tl[b].at[k].set(jnp.asarray(table))
+        ops["tensors"] = tuple(tl)
+        return ops, _factor_dirty(cap, layout, b, k, bko.var_idx[k])
+
+    if isinstance(mut, AddFactor):
+        c = mut.constraint
+        if layout.has_factor(c.name):
+            raise ValueError(f"factor {c.name!r} already exists")
+        slots = [layout.var_slot(d.name) for d in c.dimensions]
+        b, k = layout.claim_factor(c.name, c.arity)
+        try:
+            table = _aligned_table(
+                cap, c, [d.name for d in c.dimensions], cap.sign
+            )
+        except ValueError:
+            layout.release_factor(c.name)
+            raise
+        bko = cap.buckets[b]
+        vi_row = np.asarray(slots, dtype=np.int32)
+        ops = dict(ops)
+        tl, vl = list(ops["tensors"]), list(ops["var_idx"])
+        tl[b] = tl[b].at[k].set(jnp.asarray(table))
+        vl[b] = vl[b].at[k].set(jnp.asarray(vi_row))
+        eo = bko.edge_offset + k * bko.arity
+        ops["edge_var"] = ops["edge_var"].at[
+            eo:eo + bko.arity].set(jnp.asarray(vi_row))
+        ops["tensors"], ops["var_idx"] = tuple(tl), tuple(vl)
+        # host mirror: the slot's scope (assignment extraction, edits)
+        bko.var_idx[k] = vi_row
+        cap.factor_names[int(bko.factor_ids[k])] = c.name
+        return ops, _factor_dirty(cap, layout, b, k, vi_row)
+
+    if isinstance(mut, RemoveFactor):
+        b, k = layout.factor_slot(mut.name)
+        bko = cap.buckets[b]
+        old_row = np.array(bko.var_idx[k])
+        layout.release_factor(mut.name)
+        a = bko.arity
+        D = cap.max_domain_size
+        park = np.full(a, layout.parking, dtype=np.int32)
+        ops = dict(ops)
+        tl, vl = list(ops["tensors"]), list(ops["var_idx"])
+        tl[b] = tl[b].at[k].set(jnp.zeros((D,) * a, dtype=jnp.float32))
+        vl[b] = vl[b].at[k].set(jnp.asarray(park))
+        eo = bko.edge_offset + k * a
+        ops["edge_var"] = ops["edge_var"].at[eo:eo + a].set(
+            jnp.asarray(park))
+        ops["tensors"], ops["var_idx"] = tuple(tl), tuple(vl)
+        bko.var_idx[k] = park
+        cap.factor_names[int(bko.factor_ids[k])] = f"__slot_{a}_{k:04d}"
+        dirty = _factor_dirty(cap, layout, b, k, old_row)
+        return ops, dirty
+
+    if isinstance(mut, AddVariable):
+        v = mut.variable
+        if v.name in layout.var_names:
+            raise ValueError(f"variable {v.name!r} already exists")
+        D = cap.max_domain_size
+        n = len(v.domain)
+        if n > D:
+            raise ValueError(
+                f"variable {v.name!r} has domain size {n} > compiled "
+                f"max {D} — repack required"
+            )
+        slot = layout.claim_var(v.name)
+        mrow = np.zeros(D, dtype=np.float32)
+        mrow[:n] = 1.0
+        urow = np.full(D, PAD_COST, dtype=np.float32)
+        urow[:n] = cap.sign * np.asarray(v.cost_vector(), dtype=np.float32)
+        if mut.unary_noise is not None:
+            urow[:n] = urow[:n] + np.asarray(
+                mut.unary_noise, dtype=np.float32)[:n]
+        ops = dict(ops)
+        ops["mask"] = ops["mask"].at[slot].set(jnp.asarray(mrow))
+        ops["unary"] = ops["unary"].at[slot].set(jnp.asarray(urow))
+        # host mirror
+        cap.var_names[slot] = v.name
+        cap.domain_values[slot] = tuple(v.domain.values)
+        cap.domain_sizes[slot] = n
+        if v.initial_value is not None:
+            cap.initial_values[slot] = v.domain.index(v.initial_value)
+            cap.has_initial[slot] = True
+        else:
+            cap.initial_values[slot] = 0
+            cap.has_initial[slot] = True  # pinned until a factor moves it
+        return ops, Dirty(var_slots=[slot])
+
+    if isinstance(mut, RemoveVariable):
+        slot = layout.var_slot(mut.name)
+        for b, names in enumerate(layout.fac_names):
+            for k, nm in enumerate(names):
+                if nm is not FREE and slot in np.asarray(
+                        cap.buckets[b].var_idx[k]):
+                    raise ValueError(
+                        f"variable {mut.name!r} still has factor "
+                        f"{nm!r}; remove its factors first"
+                    )
+        layout.release_var(mut.name)
+        D = cap.max_domain_size
+        mrow = np.zeros(D, dtype=np.float32)
+        mrow[0] = 1.0
+        urow = np.full(D, PAD_COST, dtype=np.float32)
+        urow[0] = 0.0
+        ops = dict(ops)
+        ops["mask"] = ops["mask"].at[slot].set(jnp.asarray(mrow))
+        ops["unary"] = ops["unary"].at[slot].set(jnp.asarray(urow))
+        cap.var_names[slot] = f"__free_{slot:04d}"
+        cap.domain_values[slot] = (0,)
+        cap.domain_sizes[slot] = 1
+        cap.initial_values[slot] = 0
+        cap.has_initial[slot] = True
+        return ops, Dirty(var_slots=[slot])
+
+    raise TypeError(f"unknown mutation {type(mut).__name__}")
+
+
+def _factor_dirty(cap, layout: HeadroomLayout, b: int, k: int,
+                  vi_row: np.ndarray) -> Dirty:
+    bko = cap.buckets[b]
+    lo = bko.edge_offset + k * bko.arity
+    return Dirty(
+        var_slots=[int(v) for v in np.asarray(vi_row)
+                   if int(v) != layout.parking],
+        edge_lo=lo,
+        edge_hi=lo + bko.arity,
+    )

@@ -465,6 +465,51 @@ def pack_for_pallas(
     return pg
 
 
+def packed_swap_factor(pg: PackedMaxSumGraph, k: int,
+                       table) -> PackedMaxSumGraph:
+    """Hot-swap ONE binary factor's cost table at the packed layout's
+    fixed shape (ISSUE 8 / the in-place rewrite maxsum_dynamic's
+    layout comment planned for): writes the two slot COLUMNS of
+    ``cost_rows`` that belong to factor ``k`` (bucket row order) —
+    no re-routing, no re-packing, O(D²) instead of O(F·D²) host work.
+
+    ``table`` is the factor's full padded sign-adjusted [D, D] tensor
+    in the bucket slot's axis order.  Returns a layout sharing every
+    static structure (plan, masks, slots) with ``pg`` — only
+    ``cost_rows`` is replaced, so consumers that stage ``cost_rows``
+    as a runtime argument (parallel/packed_mesh stacked packs,
+    parallel/mesh ``_run_args``) pay zero retraces; the single-chip
+    solver still flushes its compiled chunks (the pg rides them as a
+    closure constant there).
+    """
+    import dataclasses as _dc
+
+    if pg.mixed or pg.slot_of_edge is None:
+        raise NotImplementedError(
+            "packed_swap_factor supports the all-binary packed layout; "
+            "mixed-arity packs are rebuilt by the repack path"
+        )
+    D = pg.D
+    t = np.asarray(table, dtype=np.float32)
+    if t.shape != (D, D):
+        raise ValueError(
+            f"swap table shape {t.shape} != ({D}, {D}) — the factor's "
+            f"scope must be unchanged"
+        )
+    F = pg.slot_of_edge.shape[0] // 2
+    if not (0 <= k < F):
+        raise ValueError(f"factor index {k} out of range [0, {F})")
+    # cost_rows is OTHER-value-major (row j*D+i = cost(d_oth=j,
+    # d_tgt=i)): the p=0 slot sees the table as [tgt, oth] → column is
+    # t.T flattened; the p=1 slot sees [oth, tgt] → t flattened
+    s0 = int(pg.slot_of_edge[k])
+    s1 = int(pg.slot_of_edge[F + k])
+    col0 = jnp.asarray(np.ascontiguousarray(t.T).reshape(-1))
+    col1 = jnp.asarray(t.reshape(-1))
+    cost_rows = pg.cost_rows.at[:, s0].set(col0).at[:, s1].set(col1)
+    return _dc.replace(pg, cost_rows=cost_rows)
+
+
 #: distinct-class cap ABOVE which merging is not attempted: the greedy
 #: pair scan is O(C^2) per merge, so a pathologically heterogeneous
 #: graph (up to 14^3 distinct quantized triples) must fall to the

@@ -59,6 +59,12 @@ class BoundaryInfo:
     n_touched: int             # variables incident to >= 1 factor
     cut_fraction: float        # n_boundary / n_touched (0 if untouched)
     boundary_fraction: float   # n_boundary / n_vars
+    #: [S, V] per-(shard, variable) incident-FACTOR-ENDPOINT counts,
+    #: kept only under ``analyze_boundary(..., keep_touch=True)`` — the
+    #: state :func:`patch_boundary` needs to update the cut structure
+    #: incrementally when a mutation adds/removes single factors
+    #: (ISSUE 8: a mutation dirties only its own cut edges)
+    touch: Optional[np.ndarray] = None
 
     @property
     def boundary_vars(self) -> np.ndarray:
@@ -79,19 +85,30 @@ def analyze_boundary(
     assign_per_bucket: List[np.ndarray],
     n_vars: int,
     n_shards: int,
+    keep_touch: bool = False,
 ) -> BoundaryInfo:
     """Classify variables as interior/boundary under an assignment.
 
     The per-bucket inputs are exactly what the partitioner produced
-    (``partition_factors``) — dummy-free, original factor order."""
-    touch = np.zeros((max(1, n_shards), n_vars), dtype=bool)
+    (``partition_factors``) — dummy-free, original factor order.
+    ``keep_touch`` retains the per-(shard, variable) endpoint COUNT
+    matrix so later single-factor mutations can patch the analysis
+    (:func:`patch_boundary`) instead of recomputing it."""
+    counts = np.zeros((max(1, n_shards), n_vars), dtype=np.int32)
     for var_idx, assign in zip(var_idx_per_bucket, assign_per_bucket):
         vi = np.asarray(var_idx)
         asg = np.asarray(assign)
         if vi.shape[0] == 0:
             continue
         for p in range(vi.shape[1]):
-            touch[asg, vi[:, p]] = True
+            np.add.at(counts, (asg, vi[:, p]), 1)
+    return _info_from_counts(counts, n_vars, n_shards,
+                             keep_touch=keep_touch)
+
+
+def _info_from_counts(counts: np.ndarray, n_vars: int, n_shards: int,
+                      keep_touch: bool) -> BoundaryInfo:
+    touch = counts > 0
     touch_count = touch.sum(axis=0).astype(np.int32)
     boundary = touch_count > 1
     # owner: first touching shard (argmax of the boolean column), 0 for
@@ -109,6 +126,68 @@ def analyze_boundary(
         n_touched=n_touched,
         cut_fraction=(n_boundary / n_touched) if n_touched else 0.0,
         boundary_fraction=(n_boundary / n_vars) if n_vars else 0.0,
+        touch=counts if keep_touch else None,
+    )
+
+
+def patch_boundary(
+    info: BoundaryInfo,
+    removed: List[Tuple[np.ndarray, int]] = (),
+    added: List[Tuple[np.ndarray, int]] = (),
+) -> BoundaryInfo:
+    """Incrementally update a ``keep_touch=True`` analysis for a set of
+    single-factor mutations (ISSUE 8): each entry is ``(var_idx_row,
+    shard)``.  Only the mutated factors' own variables are re-
+    classified — O(mutation scope), not O(V·F) — and the result is
+    IDENTICAL to a fresh :func:`analyze_boundary` of the mutated
+    assignment (pinned in tests/unit/test_boundary_patch.py)."""
+    if info.touch is None:
+        raise ValueError(
+            "patch_boundary needs an analysis built with "
+            "keep_touch=True"
+        )
+    counts = info.touch.copy()
+    dirty: List[int] = []
+    for row, shard in removed:
+        for v in np.asarray(row).reshape(-1):
+            counts[int(shard), int(v)] -= 1
+            dirty.append(int(v))
+    for row, shard in added:
+        for v in np.asarray(row).reshape(-1):
+            counts[int(shard), int(v)] += 1
+            dirty.append(int(v))
+    if np.min(counts, initial=0) < 0:
+        raise ValueError(
+            "patch_boundary: removed a factor endpoint that was never "
+            "counted — stale BoundaryInfo?"
+        )
+    if not dirty:
+        return dataclasses.replace(info, touch=counts)
+    dv = np.unique(np.asarray(dirty, dtype=np.int64))
+    touch_d = counts[:, dv] > 0
+    tc_d = touch_d.sum(axis=0).astype(np.int32)
+    owner = info.owner.copy()
+    boundary = info.boundary_mask.copy()
+    touch_count = info.touch_count.copy()
+    # aggregate deltas from the dirtied columns only
+    was_b = int(boundary[dv].sum())
+    was_t = int((touch_count[dv] > 0).sum())
+    owner[dv] = np.argmax(touch_d, axis=0).astype(np.int32)
+    boundary[dv] = tc_d > 1
+    touch_count[dv] = tc_d
+    n_boundary = info.n_boundary - was_b + int((tc_d > 1).sum())
+    n_touched = info.n_touched - was_t + int((tc_d > 0).sum())
+    return dataclasses.replace(
+        info,
+        owner=owner,
+        boundary_mask=boundary,
+        touch_count=touch_count,
+        n_boundary=n_boundary,
+        n_touched=n_touched,
+        cut_fraction=(n_boundary / n_touched) if n_touched else 0.0,
+        boundary_fraction=(
+            n_boundary / info.n_vars) if info.n_vars else 0.0,
+        touch=counts,
     )
 
 
@@ -168,6 +247,16 @@ def build_exchange_plan(
             continue
         for p in range(vi.shape[1]):
             touch[asg, vi[:, p]] = True
+    pair_cols = _pairs_from_touch(info, touch)
+    return _plan_from_pairs(info, pair_cols)
+
+
+def _pairs_from_touch(
+    info: BoundaryInfo, touch: np.ndarray
+) -> Dict[Tuple[int, int], List[int]]:
+    """(lo, hi) shard pair → sorted shared boundary columns, from a
+    boolean touch matrix."""
+    S = info.n_shards
     bvars = info.boundary_vars
     lo = np.argmax(touch[:, bvars], axis=0)
     hi = S - 1 - np.argmax(touch[::-1, bvars], axis=0)
@@ -176,7 +265,13 @@ def build_exchange_plan(
         pair_cols.setdefault((int(a), int(b)), []).append(int(v))
     for cols in pair_cols.values():
         cols.sort()
+    return pair_cols
 
+
+def _plan_from_pairs(
+    info: BoundaryInfo, pair_cols: Dict[Tuple[int, int], List[int]]
+) -> ExchangePlan:
+    S = info.n_shards
     # directed exchange multigraph: both directions of every pair, then
     # self-loops padding every shard to a power-of-two regular degree
     # (edge_color's Euler splitting needs it)
@@ -224,6 +319,56 @@ def build_exchange_plan(
         recv_idx=recv_idx,
         recv_valid=recv_valid,
     )
+
+
+def patch_exchange_plan(
+    plan: Optional[ExchangePlan],
+    info: BoundaryInfo,
+) -> Tuple[Optional[ExchangePlan], bool]:
+    """Patch an exchange plan after an incremental boundary update
+    (ISSUE 8): a mutation dirties only its own cut edges, so when the
+    shard-PAIR structure is unchanged (same pairs, widths still fit the
+    padded segment) only the affected pairs' send/recv index rows are
+    rewritten — the edge-colored round schedule is reused as-is.
+    Returns ``(plan, patched)``; ``patched=False`` means the cut shape
+    changed (new pair, width overflow, no longer pairwise) and the plan
+    was REBUILT from the patched analysis instead.
+
+    ``info`` must carry the ``keep_touch=True`` counts (it does after
+    :func:`patch_boundary`)."""
+    if info.touch is None:
+        raise ValueError(
+            "patch_exchange_plan needs an analysis with keep_touch=True"
+        )
+    if not info.pairwise:
+        return None, False
+    pair_cols = _pairs_from_touch(info, info.touch > 0)
+    if plan is None:
+        return _plan_from_pairs(info, pair_cols), False
+    width = max(len(c) for c in pair_cols.values())
+    old_pairs = set()
+    for r in plan.rounds:
+        for (a, b) in r:
+            old_pairs.add((min(a, b), max(a, b)))
+    if set(pair_cols) != old_pairs or width > plan.bpair:
+        return _plan_from_pairs(info, pair_cols), False
+    send_idx = plan.send_idx.copy()
+    recv_idx = plan.recv_idx.copy()
+    recv_valid = plan.recv_valid.copy()
+    for r, perms in enumerate(plan.rounds):
+        for (a, b) in perms:
+            cols = pair_cols[(a, b) if (a, b) in pair_cols else (b, a)]
+            k = len(cols)
+            send_idx[a, r, :k] = cols
+            send_idx[a, r, k:] = cols[0]
+            recv_idx[b, r, :k] = cols
+            recv_idx[b, r, k:] = cols[0]
+            recv_valid[b, r, :k] = 1.0
+            recv_valid[b, r, k:] = 0.0
+    return dataclasses.replace(
+        plan, send_idx=send_idx, recv_idx=recv_idx,
+        recv_valid=recv_valid,
+    ), True
 
 
 def padded_boundary_idx(

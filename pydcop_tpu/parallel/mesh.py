@@ -344,6 +344,13 @@ class ShardedFactorGraph:
     exch_recv: Optional[jnp.ndarray] = None    # [S, R, Bpair] int32 ids
     exch_valid: Optional[jnp.ndarray] = None   # [S, R, Bpair] float32
     exch_rounds: Optional[list] = None         # static ppermute perms
+    # --- warm repair (ISSUE 8): per-bucket original-factor → stacked
+    # row map + the factor→shard assignment, so a live factor edit can
+    # rewrite ONE stacked slab row in place (ShardedMaxSum.edit_factor)
+    # and boundary patches know each factor's shard.  The boundary
+    # analysis above is built with keep_touch=True for the same reason.
+    assigns: Optional[List[np.ndarray]] = None
+    factor_rows: Optional[List[np.ndarray]] = None
 
     @property
     def n_vars(self) -> int:
@@ -371,6 +378,7 @@ def shard_factor_graph(
             [b.var_idx for b in tensors.buckets], V, n_shards
         )
     sharded_buckets: List[ShardedBucket] = []
+    factor_rows: List[np.ndarray] = []
     edge_var_shards: List[List[np.ndarray]] = [[] for _ in range(n_shards)]
     for b, assign in zip(tensors.buckets, assigns):
         a = b.arity
@@ -382,13 +390,16 @@ def shard_factor_graph(
         shape_tail = t_np.shape[1:]
         new_t = np.zeros((n_shards * Fs,) + shape_tail, dtype=t_np.dtype)
         new_vi = np.full((n_shards * Fs, a), V, dtype=np.int32)
+        rows = np.full(b.n_factors, -1, dtype=np.int64)
         for s in range(n_shards):
             idx = np.flatnonzero(assign == s)
             new_t[s * Fs : s * Fs + idx.size] = t_np[idx]
             new_vi[s * Fs : s * Fs + idx.size] = b.var_idx[idx]
+            rows[idx] = s * Fs + np.arange(idx.size)
             edge_var_shards[s].append(
                 new_vi[s * Fs : (s + 1) * Fs].reshape(-1)
             )
+        factor_rows.append(rows)
         sharded_buckets.append(
             ShardedBucket(
                 arity=a,
@@ -416,7 +427,10 @@ def shard_factor_graph(
     )
 
     var_idx_per_bucket = [np.asarray(b.var_idx) for b in tensors.buckets]
-    info = analyze_boundary(var_idx_per_bucket, assigns, V, n_shards)
+    # keep_touch: the warm-repair layer patches this analysis factor-
+    # by-factor (parallel/boundary.patch_boundary) instead of redoing it
+    info = analyze_boundary(var_idx_per_bucket, assigns, V, n_shards,
+                            keep_touch=True)
     own = np.zeros((n_shards, V), dtype=np.float32)
     own[info.owner, np.arange(V)] = 1.0
     plan = build_exchange_plan(info, var_idx_per_bucket, assigns)
@@ -438,6 +452,8 @@ def shard_factor_graph(
         exch_valid=(jnp.asarray(plan.recv_valid)
                     if plan is not None else None),
         exch_rounds=(plan.rounds if plan is not None else None),
+        assigns=[np.asarray(a) for a in assigns],
+        factor_rows=factor_rows,
     )
 
 
@@ -1314,6 +1330,52 @@ class ShardedMaxSum(_CommPlanMixin):
             leaves.append(jax.device_put(
                 jnp.asarray(h, dtype=ref.dtype), ref.sharding))
         return jax.tree.unflatten(treedef, leaves)
+
+    def edit_factor(self, bucket_i: int, factor_i: int, table) -> None:
+        """Warm in-place factor edit (ISSUE 8): rewrite ONE stacked
+        slab row of the generic engine at a fixed shape.
+
+        The bucket tensors already ride the compiled runner as jit
+        ARGUMENTS (``_run_args``), so swapping the row and re-staging
+        the operand costs zero retraces — the next ``run()`` chunk uses
+        the same executable with the new table.  Same-scope edits only
+        (the factor's variables are unchanged, so the boundary analysis
+        and the local-row layout stay valid by construction).
+
+        ``factor_i`` indexes the ORIGINAL (pre-sharding) factor order
+        of bucket ``bucket_i``; ``table`` is the full padded
+        sign-adjusted cost tensor of that arity.
+        """
+        if self.packs is not None:
+            raise NotImplementedError(
+                "edit_factor patches the generic sharded engine; the "
+                "uniform packed layout is rebuilt by the repack path "
+                "(construct ShardedMaxSum with use_packed=False for "
+                "warm sharded edits)"
+            )
+        st = self.st
+        sb = st.buckets[bucket_i]
+        row = int(st.factor_rows[bucket_i][factor_i])
+        if row < 0:
+            raise ValueError(
+                f"factor {factor_i} of bucket {bucket_i} was never "
+                f"placed on a shard"
+            )
+        t = jnp.asarray(table, dtype=jnp.float32)
+        if t.shape != tuple(sb.tensors.shape[1:]):
+            raise ValueError(
+                f"edit_factor table shape {t.shape} != slab row shape "
+                f"{tuple(sb.tensors.shape[1:])} — edits must keep the "
+                f"scope"
+            )
+        sb.tensors = sb.tensors.at[row].set(t)
+        if self._run_n is not None:
+            # re-stage the ONE mutated operand; the compiled runner and
+            # every other staged argument are untouched
+            shard0 = NamedSharding(self.mesh, P(AXIS))
+            args = list(self._run_args)
+            args[1 + 2 * bucket_i] = jax.device_put(sb.tensors, shard0)
+            self._run_args = tuple(args)
 
     def run(self, cycles: int = 20, q=None, r=None, seed: int = 0,
             host_values: bool = True):

@@ -123,6 +123,46 @@ class StackedShardPack:
     exch_recv: Optional[jnp.ndarray] = None    # [S, R, Bpair] int32 cols
     exch_valid: Optional[jnp.ndarray] = None   # [S, R, Bpair] float32
     exch_rounds: Optional[list] = None         # static ppermute perms
+    # --- warm repair (ISSUE 8): factor → (shard, local index, slot
+    # columns) maps so a live same-scope factor edit rewrites the TWO
+    # affected stacked cost_rows columns in place (:meth:`swap_factor`)
+    # instead of re-packing every shard.  Binary layout only.
+    assign: Optional[np.ndarray] = None        # [F] factor → shard
+    local_of: Optional[np.ndarray] = None      # [F] index within shard
+    slot_maps: Optional[List[np.ndarray]] = None  # per-shard slot_of_edge
+
+    def swap_factor(self, gi: int, table) -> None:
+        """Hot-swap ONE binary factor's cost table at the stacked
+        layout's fixed shape: writes two columns of the owning shard's
+        ``cost_rows`` slab (same column math as ops.pallas_maxsum.
+        packed_swap_factor, applied to the stacked [S, D*D, N] array).
+        ``table`` is the padded sign-adjusted [D, D] tensor in the
+        bucket slot's axis order.  Static structure (plans, masks,
+        slots) is untouched, so engines that stage ``cost_rows`` as a
+        runtime argument keep their compiled runner."""
+        if self.mixed or self.slot_maps is None or self.assign is None:
+            raise NotImplementedError(
+                "swap_factor supports the all-binary stacked layout; "
+                "mixed-arity packs are rebuilt by the repack path"
+            )
+        D = self.D
+        t = np.asarray(table, dtype=np.float32)
+        if t.shape != (D, D):
+            raise ValueError(
+                f"swap table shape {t.shape} != ({D}, {D}) — the "
+                f"factor's scope must be unchanged"
+            )
+        s = int(self.assign[gi])
+        k = int(self.local_of[gi])
+        soe = self.slot_maps[s]
+        F_s = soe.shape[0] // 2
+        s0, s1 = int(soe[k]), int(soe[F_s + k])
+        col0 = jnp.asarray(np.ascontiguousarray(t.T).reshape(-1))
+        col1 = jnp.asarray(t.reshape(-1))
+        self.cost_rows = (
+            self.cost_rows.at[s, :, s0].set(col0)
+            .at[s, :, s1].set(col1)
+        )
 
     @property
     def D(self) -> int:
@@ -225,6 +265,10 @@ def build_shard_packs(
     )
 
     consts_per = [_plan_consts(pg.plan) for pg in packs]
+    local_of = np.full(F, -1, dtype=np.int64)
+    for s in range(n_shards):
+        idx = np.flatnonzero(assign == s)
+        local_of[idx] = np.arange(idx.size)
     return StackedShardPack(
         pg0=pg0,
         n_shards=n_shards,
@@ -235,6 +279,9 @@ def build_shard_packs(
         consts=[
             jnp.stack([cp[i] for cp in consts_per]) for i in range(5)
         ],
+        assign=assign,
+        local_of=local_of,
+        slot_maps=[np.asarray(pg.slot_of_edge) for pg in packs],
         **_boundary_fields([vi], [assign], V, n_shards, var_pcol, Vp),
         **_stacked_move_extras(packs),
     )

@@ -31,8 +31,11 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 #: current checkpoint schema version.  v1 = the original unversioned,
-#: unchecksummed format (still readable); v2 adds per-array CRC32.
-CHECKPOINT_VERSION = 2
+#: unchecksummed format (still readable); v2 adds per-array CRC32;
+#: v3 adds the warm-repair headroom layout (claimed/free slot maps +
+#: capacity host metadata) so ``--resume`` restores a MUTATED problem
+#: at its exact padded shape (ISSUE 8).  v1/v2 files remain readable.
+CHECKPOINT_VERSION = 3
 
 
 # --------------------------------------------------------------------------
@@ -143,6 +146,28 @@ def save_checkpoint(path: str, solver, extra: Optional[Dict] = None,
     }
     if cycle is not None:
         meta["cycle"] = int(cycle)
+    # schema v3: warm-repair solvers persist their headroom layout so a
+    # resume restores a mutated problem at its exact padded shape (the
+    # mutated ARRAYS already ride in the state leaves — the layout's
+    # claimed/free slot maps + host metadata make them addressable)
+    layout = getattr(solver, "layout", None)
+    if layout is not None and hasattr(layout, "to_meta"):
+        t = solver.tensors
+        hmeta = {
+            "layout": layout.to_meta(),
+            "var_names": list(t.var_names),
+            "domain_values": [list(d) for d in t.domain_values],
+            "factor_names": list(t.factor_names),
+        }
+        try:
+            json.dumps(hmeta)
+        except (TypeError, ValueError):
+            logger.warning(
+                "headroom metadata is not JSON-serializable (exotic "
+                "domain values?); checkpoint saved without it"
+            )
+        else:
+            meta["headroom"] = hmeta
     arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
     # the PRNG key travels with the state: a warm run after restore must
     # CONTINUE the random stream, not replay it from the seed
@@ -189,6 +214,11 @@ def load_checkpoint(path: str, solver) -> Dict[str, Any]:
         import jax.numpy as jnp
 
         solver._last_key = jnp.asarray(key)
+    hmeta = meta.get("headroom")
+    if hmeta and hasattr(solver, "restore_headroom_meta"):
+        # v3: re-adopt the claimed/free slot maps so the restored
+        # (possibly mutated) arrays are addressable by name again
+        solver.restore_headroom_meta(hmeta)
     return meta
 
 

@@ -117,6 +117,24 @@ def send_shard(event: str, payload) -> None:
     event_bus.send(SHARD_TOPIC_PREFIX + event, payload)
 
 
+#: warm-repair topic prefix (runtime/repair).  Topics:
+#: ``repair.mutation.applied`` (kind, target, dirty variables),
+#: ``repair.headroom.claimed`` / ``repair.headroom.released`` (slot
+#: kind, remaining free slots),
+#: ``repair.repack`` (reason, retraces — fired exactly once per
+#: headroom exhaustion, never an exception mid-run),
+#: ``repair.recovered`` (time_to_recover_s, cycles, cost after a
+#: mutation re-converged) — subscribe with ``repair.*`` (the UI server
+#: pushes them to ws/SSE clients alongside ``faults.*``).
+REPAIR_TOPIC_PREFIX = "repair."
+
+
+def send_repair(event: str, payload) -> None:
+    """Publish a warm-repair lifecycle event on the global bus (no-op
+    unless observability is enabled)."""
+    event_bus.send(REPAIR_TOPIC_PREFIX + event, payload)
+
+
 #: solve-harness topic prefix (algorithms/base).  Topics:
 #: ``harness.run.done`` (algo, status, cycle + the HarnessCounters
 #: scorecard: host_sync_count, dispatch_wait_s, donated_chunks,

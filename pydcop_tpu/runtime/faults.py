@@ -57,8 +57,18 @@ ENV_FAULT_ATTEMPT = "PYDCOP_TPU_FAULT_ATTEMPT"
 SERVE_KINDS = ("raise_in_step", "nan_lane", "torn_journal_write",
                "stall_tick")
 
+#: agent-churn / live-mutation fault kinds (consumed by the
+#: orchestrator's warm-repair path, runtime/repair.py) —
+#: ``remove_agent_burst`` removes ``count`` seeded-chosen agents at one
+#: phase boundary (each routed through the replica-repair handshake),
+#: ``add_agent_burst`` adds ``count`` fresh agents, and ``edit_factor``
+#: hot-swaps a (seeded-chosen or named) constraint's cost table with a
+#: seeded perturbation — the live-mutation twin of kill_agent, and the
+#: driver of the sustained-churn bench leg (bench.py churn_recover)
+CHURN_KINDS = ("remove_agent_burst", "add_agent_burst", "edit_factor")
+
 KINDS = ("kill_rank", "stall_rank", "kill_agent", "corrupt_checkpoint",
-         "truncate_checkpoint") + SERVE_KINDS
+         "truncate_checkpoint") + SERVE_KINDS + CHURN_KINDS
 
 
 @dataclasses.dataclass
@@ -81,6 +91,10 @@ class Fault:
     #: keeps firing for that job (a poison job the quarantine must
     #: escalate to a terminal ERROR).
     jid: Optional[str] = None
+    #: churn bursts: how many agents the burst removes/adds (default 1)
+    count: Optional[int] = None
+    #: edit_factor: the constraint to hot-swap (None = seeded choice)
+    constraint: Optional[str] = None
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -94,6 +108,9 @@ class Fault:
             raise ValueError(f"{self.kind} fault needs a 'duration' > 0")
         if self.kind == "kill_agent" and not self.agent:
             raise ValueError("kill_agent fault needs an 'agent'")
+        if self.kind in ("remove_agent_burst", "add_agent_burst") \
+                and self.count is not None and self.count < 1:
+            raise ValueError(f"{self.kind} fault needs a 'count' >= 1")
 
     def to_dict(self) -> Dict:
         # 'attempt' must survive even as None (None = every attempt —
@@ -132,6 +149,16 @@ class FaultPlan:
           - kind: torn_journal_write   # serve: cut an append mid-line
           - kind: stall_tick           # serve: wedge one tick
             duration: 0.5
+          - kind: edit_factor          # churn: hot-swap a constraint's
+            cycle: 10                  # table (seeded perturbation);
+            constraint: c12            # omit 'constraint' for a seeded
+                                       # choice
+          - kind: remove_agent_burst   # churn: remove `count` seeded-
+            cycle: 20                  # chosen agents at one phase
+            count: 3                   # boundary (replica repair x3)
+          - kind: add_agent_burst      # churn: add fresh agents
+            cycle: 30
+            count: 2
     """
 
     faults: List[Fault] = dataclasses.field(default_factory=list)
@@ -200,6 +227,14 @@ class FaultPlan:
 
     def serve_faults(self) -> List[Fault]:
         return [f for f in self.faults if f.kind in SERVE_KINDS]
+
+    def churn_faults(self) -> List[Fault]:
+        """Agent-churn / live-mutation faults (kill_agent + the burst
+        and edit kinds), ordered by cycle — the seeded churn stream the
+        orchestrator replays at phase boundaries."""
+        out = [f for f in self.faults
+               if f.kind == "kill_agent" or f.kind in CHURN_KINDS]
+        return sorted(out, key=lambda f: f.cycle)
 
     @property
     def has_rank_faults(self) -> bool:

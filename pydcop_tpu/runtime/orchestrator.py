@@ -47,6 +47,8 @@ class VirtualOrchestrator:
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 10,
         auto_resume: bool = False,
+        warm_repair: bool = False,
+        headroom: float = 0.25,
     ):
         self.dcop = dcop
         self.algo_def = (
@@ -73,9 +75,22 @@ class VirtualOrchestrator:
                 communication_load=self.algo_module.communication_load,
             )
 
-        self.solver = self.algo_module.build_solver(
-            dcop, self.cg, self.algo_def, seed=seed
-        )
+        # warm repair (ISSUE 8): scenario mutations and agent churn
+        # become fixed-shape buffer writes on a headroom-padded solver
+        # instead of cold restarts — runtime/repair.WarmRepairController
+        self.warm = None
+        if warm_repair:
+            from pydcop_tpu.runtime.repair import WarmRepairController
+
+            self.warm = WarmRepairController(
+                dcop, self.algo_def.algo, algo_def=self.algo_def,
+                seed=seed, headroom=headroom,
+            )
+            self.solver = self.warm.solver
+        else:
+            self.solver = self.algo_module.build_solver(
+                dcop, self.cg, self.algo_def, seed=seed
+            )
         self.replicas: Optional[ReplicaDistribution] = None
         self.seed = seed
         self.status = "INITIAL"
@@ -94,8 +109,11 @@ class VirtualOrchestrator:
         # -- resilience: fault injection + checkpoint/auto-resume ----------
         self.fault_plan = fault_plan
         self.fault_counters = FaultCounters()
+        # kill_agent + the seeded churn kinds (remove/add_agent_burst,
+        # edit_factor) all fire at phase boundaries through one pending
+        # list — the churn stream and the fault story share a path
         self._pending_agent_kills = list(
-            fault_plan.agent_kills()) if fault_plan else []
+            fault_plan.churn_faults()) if fault_plan else []
         self.checkpoint_every = max(1, checkpoint_every)
         self.auto_resume = auto_resume
         self._ckpt_mgr = None
@@ -173,13 +191,20 @@ class VirtualOrchestrator:
     def _run_phase(
         self, cycles: Optional[int], timeout: Optional[float], resume: bool
     ) -> SolveResult:
+        if self.warm is not None:
+            # a repack may have swapped the solver; one PINNED chunk
+            # size so every phase reuses the same compiled runner
+            self.solver = self.warm.solver
         res = self.solver.run(
             cycles=cycles,
             timeout=timeout,
             collect_cycles=self.collect_on == "cycle_change"
             or self.collector is not None,
             resume=resume,
+            chunk=self.warm.chunk if self.warm is not None else None,
         )
+        if self.warm is not None:
+            self.warm.phase_done(res)
         self._cycles_done += res.cycle
         self._last_result = res
         if self.collector is not None and res.history:
@@ -195,9 +220,11 @@ class VirtualOrchestrator:
     # -- resilience hooks (phase boundaries) --------------------------------
 
     def _fire_due_agent_kills(self) -> None:
-        """Fault-plan agent kills fire at the first phase boundary past
-        their cycle — the fault-injection twin of a scenario's
-        remove_agent event, routed through the same replica-repair
+        """Fault-plan churn faults fire at the first phase boundary past
+        their cycle — kill_agent (the fault-injection twin of a
+        scenario's remove_agent event) plus the seeded churn kinds
+        (remove_agent_burst / add_agent_burst / edit_factor), all
+        routed through the same replica-repair / warm-repair
         handshake."""
         due = [f for f in self._pending_agent_kills
                if f.cycle <= self._cycles_done]
@@ -206,17 +233,97 @@ class VirtualOrchestrator:
             if f.cycle > self._cycles_done
         ]
         for f in due:
+            self._fire_churn_fault(f)
+
+    def _fire_churn_fault(self, f) -> None:
+        seed = self.fault_plan.seed if self.fault_plan else 0
+        if f.kind == "kill_agent":
             if f.agent not in self.dcop.agents:
-                continue  # already removed (scenario or earlier fault)
+                return  # already removed (scenario or earlier fault)
+            targets = [f.agent]
+        elif f.kind == "remove_agent_burst":
+            import numpy as _np
+
+            alive = sorted(self.dcop.agents)
+            rng = _np.random.default_rng(
+                (int(seed) * 6151 + int(f.cycle)) % (2 ** 32))
+            n = min(f.count or 1, max(0, len(alive) - 1))
+            if n <= 0:
+                return
+            targets = sorted(
+                rng.choice(len(alive), size=n, replace=False).tolist()
+            )
+            targets = [alive[i] for i in targets]
+        elif f.kind == "add_agent_burst":
+            from pydcop_tpu.dcop.objects import AgentDef
+
+            for i in range(f.count or 1):
+                name = f"churn_a{f.cycle}_{i}"
+                if name not in self.dcop.agents:
+                    self.dcop.agents[name] = AgentDef(name)
+                    self.distribution.host_on_agent(name, [])
             self.fault_counters.inc("faults_injected")
-            send_fault("injected.kill_agent", {
-                "agent": f.agent, "cycle": self._cycles_done,
+            send_fault("injected.add_agent_burst", {
+                "count": f.count or 1, "cycle": self._cycles_done,
             })
-            self._agents_removal([f.agent])
             self.events_log.append(
-                {"fault": "kill_agent", "agent": f.agent,
+                {"fault": "add_agent_burst", "count": f.count or 1,
                  "cycle": self._cycles_done}
             )
+            return
+        elif f.kind == "edit_factor":
+            name = self._edit_factor_fault(f, seed)
+            self.fault_counters.inc("faults_injected")
+            send_fault("injected.edit_factor", {
+                "constraint": name, "cycle": self._cycles_done,
+            })
+            self.events_log.append(
+                {"fault": "edit_factor", "constraint": name,
+                 "cycle": self._cycles_done}
+            )
+            return
+        else:  # pragma: no cover - churn_faults() filters the kinds
+            return
+        self.fault_counters.inc("faults_injected")
+        send_fault(f"injected.{f.kind}", {
+            "agents": targets, "cycle": self._cycles_done,
+        })
+        self._agents_removal(targets)
+        self.events_log.append(
+            {"fault": f.kind, "agents": targets,
+             "cycle": self._cycles_done}
+        )
+
+    def _edit_factor_fault(self, f, seed: int) -> str:
+        """An edit_factor churn fault: warm path mutates in place; the
+        cold path requires a hot-swap capable solver (maxsum_dynamic)
+        and pays its compiled-chunk flush — exactly the gap the warm
+        layer closes."""
+        if self.warm is not None:
+            return self.warm.edit_factor_fault(f, seed)
+        from pydcop_tpu.runtime.repair import perturbed_constraint
+
+        if not hasattr(self.solver, "change_factor_function"):
+            raise ValueError(
+                f"algorithm {self.algo_def.algo!r} cannot hot-swap "
+                "factors; use --warm-repair (or maxsum_dynamic) for "
+                "edit_factor fault plans"
+            )
+        names = sorted(self.dcop.constraints)
+        name = f.constraint
+        if name is None:
+            import numpy as _np
+
+            rng = _np.random.default_rng(
+                (int(seed) * 7919 + int(f.cycle)) % (2 ** 32))
+            name = names[int(rng.integers(len(names)))]
+        elif name not in self.dcop.constraints:
+            raise ValueError(
+                f"edit_factor fault: unknown constraint {name!r}")
+        new_c = perturbed_constraint(
+            self.dcop.constraints[name], seed=seed + f.cycle)
+        self.solver.change_factor_function(new_c)
+        return name
 
     def _maybe_checkpoint(self) -> None:
         if self._ckpt_mgr is None:
@@ -450,38 +557,95 @@ class VirtualOrchestrator:
                 self.dcop.agents[name] = AgentDef(name)
             self.distribution.host_on_agent(name, [])
         elif action.type == "set_external":
+            if self.warm is not None:
+                self.warm.external_change(
+                    action.parameters["variable"],
+                    action.parameters["value"],
+                )
+                return
             ev = self.dcop.external_variables[
                 action.parameters["variable"]
             ]
             ev.value = action.parameters["value"]
             if hasattr(self.solver, "on_external_change"):
                 self.solver.on_external_change(ev.name, ev.value)
+        elif action.type in ("add_constraint", "remove_constraint",
+                             "add_variable", "remove_variable"):
+            # structural mutations (ISSUE 8): only the warm-repair
+            # layer can rewire a compiled problem at a fixed shape
+            if self.warm is None:
+                raise ValueError(
+                    f"scenario action {action.type!r} needs the "
+                    "warm-repair layer; run with warm_repair=True "
+                    "(CLI: --warm-repair)"
+                )
+            self._apply_structural(action)
         elif action.type == "change_factor":
             # factor hot-swap mid-scenario (∅→+ over the reference's
             # add/remove_agent events; pairs with maxsum_dynamic's
             # change_factor_function, ref maxsum_dynamic.py:188)
             from pydcop_tpu.dcop.relations import constraint_from_str
 
-            if not hasattr(self.solver, "change_factor_function"):
+            if self.warm is None and not hasattr(
+                    self.solver, "change_factor_function"):
                 raise ValueError(
                     f"algorithm {self.algo_def.algo!r} cannot hot-swap "
-                    "factors; use maxsum_dynamic for change_factor "
-                    "scenarios"
+                    "factors; use maxsum_dynamic (or --warm-repair) "
+                    "for change_factor scenarios"
                 )
             name = action.parameters["constraint"]
             if name not in self.dcop.constraints:
                 raise ValueError(
                     f"change_factor: unknown constraint {name!r}"
                 )
-            expr = action.parameters["expression"]
             old = self.dcop.constraints[name]
-            scope = list(old.dimensions) + [
-                ev for ev in self.dcop.external_variables.values()
-            ]
-            new_c = constraint_from_str(name, expr, scope)
-            self.solver.change_factor_function(new_c)
+            expr = action.parameters.get("expression")
+            if expr is None:
+                # seeded-perturbation form (dcop/scenario.churn_scenario
+                # and the edit_factor fault kind share the jitter)
+                from pydcop_tpu.runtime.repair import (
+                    perturbed_constraint,
+                )
+
+                new_c = perturbed_constraint(
+                    old, seed=int(action.parameters.get("seed", 0))
+                )
+            else:
+                scope = list(old.dimensions) + [
+                    ev for ev in self.dcop.external_variables.values()
+                ]
+                new_c = constraint_from_str(name, expr, scope)
+            if self.warm is not None:
+                self.warm.edit_factor(new_c)
+            else:
+                self.solver.change_factor_function(new_c)
         else:
             raise ValueError(f"Unknown scenario action {action.type!r}")
+
+    def _apply_structural(self, action) -> None:
+        """Warm-only structural scenario actions: grow/shrink the live
+        problem inside the reserved headroom (zero retraces; one
+        counted repack when exhausted)."""
+        from pydcop_tpu.dcop.relations import constraint_from_str
+
+        p = action.parameters
+        if action.type == "add_constraint":
+            scope = [self.dcop.variables[n] for n in p["scope"]] + [
+                ev for ev in self.dcop.external_variables.values()
+            ]
+            new_c = constraint_from_str(
+                p["constraint"], p["expression"], scope
+            )
+            self.warm.add_constraint(new_c)
+        elif action.type == "remove_constraint":
+            self.warm.remove_constraint(p["constraint"])
+        elif action.type == "add_variable":
+            from pydcop_tpu.dcop.objects import Variable
+
+            domain = self.dcop.domains[p["domain"]]
+            self.warm.add_variable(Variable(p["variable"], domain))
+        else:  # remove_variable
+            self.warm.remove_variable(p["variable"])
 
     def _agents_removal(self, removed: List[str]) -> None:
         """Orphaned computations are re-hosted on their replicas via a
@@ -523,6 +687,10 @@ class VirtualOrchestrator:
         placement = solve_repair_dcop(repair, vars_by_comp, seed=self.seed)
         for comp, agent in placement.items():
             self.distribution.host_on_agent(agent, [comp])
+        if self.warm is not None:
+            # warm re-seat: reparation picked the hosts; the solver
+            # keeps its device state and only re-converges — time it
+            self.warm.mark_recovery()
         self.events_log.append({"repaired": placement})
         self.fault_counters.inc("repairs")
         send_fault("recovered.repair", {
@@ -541,4 +709,6 @@ class VirtualOrchestrator:
             m["replicas"] = self.replicas.mapping()
         m["events"] = self.events_log
         m["resilience"] = self.fault_counters.as_dict()
+        if self.warm is not None:
+            m["repair"] = self.warm.counters.as_dict()
         return m

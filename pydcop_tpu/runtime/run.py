@@ -43,6 +43,7 @@ def solve_result(
     pipeline: bool = False,
     shard_overlap: Optional[str] = None,
     shard_boundary_threshold: float = 0.5,
+    headroom: Optional[float] = None,
 ) -> SolveResult:
     """Solve a DCOP and return the full result + metrics.
 
@@ -70,6 +71,13 @@ def solve_result(
     actually drives execution — factors are sharded onto the device mesh
     by their host agents (reference parity: pydcop/commands/solve.py
     :483-507 runs under the given placement).
+
+    ``headroom`` (a float fraction, e.g. 0.25) builds the WARM-repair
+    engine at a padded capacity (algorithms/warm + ops/headroom,
+    docs/resilience.rst "Warm repair and agent churn"): live mutations
+    become fixed-shape buffer writes with zero retraces.  Supported
+    for the warm algo set (maxsum/maxsum_dynamic/mgm/dsa/adsa), single
+    device path only.
 
     ``checkpoint_dir`` + ``checkpoint_every`` persist rotating state
     snapshots every *k* cycles (runtime/checkpoint.CheckpointManager);
@@ -114,7 +122,19 @@ def solve_result(
             communication_load=algo_module.communication_load,
         )
 
-    solver = algo_module.build_solver(dcop, cg, algo_def, seed=seed)
+    if headroom is not None:
+        from pydcop_tpu.algorithms.warm import build_warm_solver
+        from pydcop_tpu.runtime.stats import RepairCounters
+
+        solver = build_warm_solver(
+            dcop, algo=algo_def.algo, algo_def=algo_def, seed=seed,
+            headroom=headroom,
+        )
+        # standalone solves get the scorecard too: metrics()["repair"]
+        # pins that the warm engine (not the cold one) actually ran
+        solver.repair_counters = RepairCounters()
+    else:
+        solver = algo_module.build_solver(dcop, cg, algo_def, seed=seed)
     stop_cycle = (
         cycles
         if cycles is not None

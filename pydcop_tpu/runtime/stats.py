@@ -116,6 +116,47 @@ class BatchCounters:
         return self.counts["padded_cells"] / total if total else 0.0
 
 
+#: counter names surfaced under ``SolveResult.metrics()["repair"]`` by
+#: the warm-repair layer (runtime/repair.WarmRepairController +
+#: algorithms/warm) — the fixed-shape mutation scorecard of a live run
+REPAIR_COUNTERS = (
+    "mutations_applied",          # fixed-shape buffer-write mutations
+    "headroom_claimed",           # slots claimed (add variable/factor)
+    "headroom_released",          # slots released (remove)
+    "headroom_exhausted_repacks",  # ONE counted repack per exhaustion
+    "repair_retraces",            # chunk-runner traces caused by
+                                  # repairs (0 while headroom holds)
+    "time_to_recover_s",          # wall seconds from mutation to the
+                                  # re-converged fixed point (float sum)
+)
+
+
+class RepairCounters:
+    """Warm-repair counters collected by the repair controller and
+    attached to every ``SolveResult`` of a warm engine
+    (``metrics()['repair']``).  ``time_to_recover_s`` accumulates float
+    seconds; everything else is an integer count."""
+
+    def __init__(self):
+        self.counts = {
+            k: (0.0 if k == "time_to_recover_s" else 0)
+            for k in REPAIR_COUNTERS
+        }
+
+    def inc(self, name: str, n=1) -> None:
+        if name not in self.counts:
+            raise KeyError(
+                f"unknown repair counter {name!r}; add it to "
+                f"REPAIR_COUNTERS"
+            )
+        self.counts[name] += n
+
+    def as_dict(self) -> dict:
+        out = dict(self.counts)
+        out["time_to_recover_s"] = round(out["time_to_recover_s"], 6)
+        return out
+
+
 #: counter names surfaced under ``metrics()["serve"]`` by the
 #: continuous-batching solve service (pydcop_tpu.serve.SolveService) —
 #: the admission/slot-reuse scorecard of a serving session, alongside

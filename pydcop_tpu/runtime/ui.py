@@ -50,6 +50,7 @@ class UiServer:
         event_bus.subscribe("agents.add_computation.*", self._cb_add_comp)
         event_bus.subscribe("agents.rem_computation.*", self._cb_rem_comp)
         event_bus.subscribe("faults.*", self._cb_fault)
+        event_bus.subscribe("repair.*", self._cb_repair)
         event_bus.subscribe("batch.*", self._cb_batch)
         event_bus.subscribe("harness.*", self._cb_harness)
         event_bus.subscribe("shard.*", self._cb_shard)
@@ -176,6 +177,21 @@ class UiServer:
                                                  float, bool, type(None)))
                  else repr(evt)}))
 
+    def _cb_repair(self, topic: str, evt) -> None:
+        """Warm-repair lifecycle (repair.mutation.applied,
+        repair.headroom.claimed|released, repair.repack,
+        repair.recovered) pushed to GUI clients in the same envelope
+        shape as the batch/harness families; the SSE /events stream
+        gets them through the wildcard subscription like every
+        topic."""
+        if self._ws is not None:
+            self._ws.send_all(json.dumps(
+                {"evt": "repair",
+                 "kind": topic.split(".", 1)[-1],
+                 "data": evt if isinstance(evt, (dict, list, str, int,
+                                                 float, bool, type(None)))
+                 else repr(evt)}))
+
     def _cb_batch(self, topic: str, evt) -> None:
         """Batched-solve lifecycle (batch.bucket.formed,
         batch.compile.hit|miss, batch.instance.converged,
@@ -296,7 +312,7 @@ class UiServer:
         for cb in (self._on_event, self._cb_cycle, self._cb_value,
                    self._cb_add_comp, self._cb_rem_comp, self._cb_fault,
                    self._cb_batch, self._cb_harness, self._cb_shard,
-                   self._cb_serve):
+                   self._cb_serve, self._cb_repair):
             event_bus.unsubscribe(cb)
         if self._server is not None:
             self._server.shutdown()

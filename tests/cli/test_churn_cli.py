@@ -1,0 +1,186 @@
+"""`pydcop_tpu run --warm-repair` end to end: the seeded churn
+FaultPlan replayed through the CLI (the `make churn-smoke` scenario).
+
+The kill-9 mid-churn + `--resume` integration test is ``slow``-marked:
+it SIGKILLs a real run between phases and verifies the restarted run
+resumes from the rotating checkpoint (schema v3 carries the warm
+layout) and still finishes the churn stream.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+ENV = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+
+DCOP_YAML = textwrap.dedent("""
+    name: churn
+    objective: min
+    domains:
+      d: {values: [0, 1, 2]}
+    variables:
+      v1: {domain: d}
+      v2: {domain: d}
+      v3: {domain: d}
+      v4: {domain: d}
+    constraints:
+      c12: {type: intention, function: "0 if v1 == v2 else 5"}
+      c23: {type: intention, function: "0 if v2 != v3 else 3"}
+      c34: {type: intention, function: "abs(v3 - v4)"}
+    agents: [a1, a2, a3, a4, a5, a6, a7, a8]
+""")
+
+PLAN_YAML = textwrap.dedent("""
+    seed: 11
+    faults:
+      - kind: edit_factor
+        cycle: 10
+      - kind: remove_agent_burst
+        cycle: 30
+        count: 2
+      - kind: add_agent_burst
+        cycle: 50
+        count: 1
+      - kind: edit_factor
+        cycle: 70
+        constraint: c23
+""")
+
+
+def write_inputs(tmp_path, delays):
+    (tmp_path / "prob.yaml").write_text(DCOP_YAML)
+    (tmp_path / "plan.yaml").write_text(PLAN_YAML)
+    events = "".join(
+        f"  - id: d{i}\n    delay: {d}\n" for i, d in enumerate(delays)
+    )
+    (tmp_path / "scen.yaml").write_text("events:\n" + events)
+
+
+def cli(*args):
+    return [sys.executable, "-m", "pydcop_tpu", *args]
+
+
+def test_warm_churn_plan_zero_retraces(tmp_path):
+    """The seeded churn plan through `run --warm-repair`: every fault
+    fires, zero repair retraces, clean exit."""
+    write_inputs(tmp_path, delays=[0.4, 0.4, 0.4])
+    out = subprocess.run(
+        cli("--timeout", "120", "run", "--algo", "maxsum",
+            "--warm-repair", "--headroom", "0.3",
+            "-s", "scen.yaml", "--fault-plan", "plan.yaml",
+            "--ktarget", "2", "prob.yaml"),
+        capture_output=True, text=True, timeout=300, env=ENV,
+        cwd=tmp_path,
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    m = json.loads(out.stdout)
+    assert m["status"] in ("FINISHED", "TIMEOUT")
+    assert m["repair"]["repair_retraces"] == 0, m["repair"]
+    assert m["repair"]["mutations_applied"] >= 2
+    assert m["resilience"]["faults_injected"] == 4
+    kinds = [e.get("fault") for e in m["events"] if "fault" in e]
+    assert kinds.count("edit_factor") == 2
+    assert "remove_agent_burst" in kinds and "add_agent_burst" in kinds
+
+
+def test_structural_scenario_via_cli(tmp_path):
+    """Warm-only structural events (grow + shrink the live problem)
+    through the CLI."""
+    (tmp_path / "prob.yaml").write_text(DCOP_YAML)
+    (tmp_path / "scen.yaml").write_text(textwrap.dedent("""
+        events:
+          - id: d0
+            delay: 0.3
+          - id: grow
+            actions:
+              - type: add_variable
+                variable: z9
+                domain: d
+              - type: add_constraint
+                constraint: cz
+                expression: "0 if z9 == v4 else 7"
+                scope: [z9, v4]
+          - id: d1
+            delay: 0.3
+    """))
+    out = subprocess.run(
+        cli("--timeout", "120", "run", "--algo", "mgm",
+            "--warm-repair", "-s", "scen.yaml", "prob.yaml"),
+        capture_output=True, text=True, timeout=300, env=ENV,
+        cwd=tmp_path,
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    m = json.loads(out.stdout)
+    assert m["assignment"]["z9"] == m["assignment"]["v4"]
+    assert m["repair"]["headroom_claimed"] == 2
+    assert m["repair"]["repair_retraces"] == 0
+
+
+def test_solve_headroom_flag(tmp_path):
+    """`solve --headroom` builds the warm engine and surfaces the
+    repair scorecard in the metrics JSON."""
+    (tmp_path / "prob.yaml").write_text(DCOP_YAML)
+    out = subprocess.run(
+        cli("--timeout", "90", "solve", "-a", "mgm",
+            "--headroom", "0.25", "--cycles", "30", "prob.yaml"),
+        capture_output=True, text=True, timeout=240, env=ENV,
+        cwd=tmp_path,
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    m = json.loads(out.stdout)
+    assert m["status"] == "FINISHED"
+    assert "repair" in m, sorted(m)
+    assert m["repair"]["repair_retraces"] == 0
+
+
+@pytest.mark.slow
+def test_kill9_mid_churn_then_resume(tmp_path):
+    """Acceptance pin for `make churn-smoke`: SIGKILL the churn run
+    between phases (no shutdown path at all), then rerun with
+    `--resume` — the restarted run warm-starts from the newest v3
+    snapshot and completes the stream."""
+    write_inputs(tmp_path, delays=[1.0] * 8)
+    ckpt = str(tmp_path / "ckpt")
+    args = cli(
+        "--timeout", "120", "run", "--algo", "maxsum",
+        "--warm-repair", "-s", "scen.yaml", "--fault-plan", "plan.yaml",
+        "--checkpoint", ckpt, "--checkpoint-every", "10",
+        "--ktarget", "2", "prob.yaml",
+    )
+    proc = subprocess.Popen(
+        args, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, env=ENV, cwd=tmp_path,
+    )
+    # let it converge a few phases and write snapshots, then kill -9
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        time.sleep(0.5)
+        if os.path.isdir(ckpt) and any(
+                n.startswith("ck_") for n in os.listdir(ckpt)):
+            break
+    assert proc.poll() is None, (
+        "run finished before the kill; lengthen the scenario\n"
+        + proc.communicate()[1][-1000:]
+    )
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=30)
+    assert any(n.startswith("ck_") for n in os.listdir(ckpt)), \
+        "no snapshot was written before the kill"
+
+    out = subprocess.run(
+        args + ["--resume"],
+        capture_output=True, text=True, timeout=300, env=ENV,
+        cwd=tmp_path,
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    m = json.loads(out.stdout)
+    assert m["status"] in ("FINISHED", "TIMEOUT")
+    assert m["resilience"]["resumes"] == 1, m["resilience"]
+    assert m["repair"]["repair_retraces"] == 0, m["repair"]
+    assert m["resilience"]["faults_injected"] >= 1

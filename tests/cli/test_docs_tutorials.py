@@ -163,6 +163,44 @@ events:
     assert "a2" not in out["distribution"]
 
 
+def test_dynamic_dcops_warm_repair_flow(tmp_path):
+    """docs/tutorials/dynamic_dcops.rst, "Warm repair" section —
+    command and structural scenario verbatim."""
+    (tmp_path / "graph_coloring.yaml").write_text(GETTING_STARTED_YAML)
+    (tmp_path / "scenario.yaml").write_text(
+        """
+events:
+  - delay: 1
+  - id: grow
+    actions:
+      - type: add_variable
+        variable: v9
+        domain: colors
+      - type: add_constraint
+        constraint: c9
+        expression: "0 if v9 != v1 else 10"
+        scope: [v9, v1]
+  - delay: 1
+  - id: shrink
+    actions:
+      - type: remove_variable
+        variable: v9
+"""
+    )
+    proc = run(["--timeout", "60", "run", "--algo", "maxsum",
+                "--warm-repair", "--headroom", "0.25",
+                "--distribution", "adhoc",
+                "--scenario", "scenario.yaml", "--ktarget", "2",
+                "graph_coloring.yaml"],
+               cwd=tmp_path, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    out = json.loads(proc.stdout)
+    assert out["status"] in ("FINISHED", "TIMEOUT")
+    assert "v9" not in out["assignment"]  # grown, then shrunk away
+    assert out["repair"]["mutations_applied"] >= 4
+    assert out["repair"]["repair_retraces"] == 0
+
+
 def test_batch_and_consolidate_flow(tmp_path):
     """docs/tutorials/analysing_results.rst batch/consolidate section."""
     (tmp_path / "graph_coloring.yaml").write_text(GETTING_STARTED_YAML)

@@ -1,0 +1,216 @@
+"""Incremental boundary re-analysis (ISSUE 8): a mutation dirties only
+its own cut edges, so ``parallel/boundary`` patches the existing
+analysis/exchange plan instead of recomputing it — and the patched
+result must be IDENTICAL to a fresh analysis of the mutated
+assignment.  Plus the sharded engines' warm factor-edit hook
+(ShardedMaxSum.edit_factor: one stacked slab row rewritten, operands
+re-staged, compiled runner untouched).
+"""
+import numpy as np
+import pytest
+
+from pydcop_tpu.ops.compile import compile_binary_from_arrays
+from pydcop_tpu.parallel.boundary import (
+    analyze_boundary,
+    build_exchange_plan,
+    patch_boundary,
+    patch_exchange_plan,
+)
+
+
+def ring_instance(V=20, F=30, D=3, seed=1):
+    rng = np.random.default_rng(seed)
+    ei = rng.integers(0, V, F)
+    ej = (ei + 1 + rng.integers(0, V - 1, F)) % V
+    mats = rng.uniform(0, 5, (F, D, D)).astype(np.float32)
+    return ei, ej, mats, compile_binary_from_arrays(ei, ej, mats, V)
+
+
+class TestPatchBoundary:
+    def _base(self):
+        rng = np.random.default_rng(0)
+        V, F = 24, 40
+        vi = np.stack([rng.integers(0, V, F),
+                       rng.integers(0, V, F)], 1).astype(np.int32)
+        assign = (np.arange(F) % 3).astype(np.int64)
+        return V, vi, assign
+
+    def test_patch_equals_fresh_analysis(self):
+        V, vi, assign = self._base()
+        info = analyze_boundary([vi], [assign], V, 3, keep_touch=True)
+        # move factor 5 to shard 2 with a different scope
+        new_row = np.array([0, 13], np.int32)
+        info2 = patch_boundary(
+            info,
+            removed=[(vi[5], int(assign[5]))],
+            added=[(new_row, 2)],
+        )
+        vi2 = vi.copy()
+        vi2[5] = new_row
+        assign2 = assign.copy()
+        assign2[5] = 2
+        fresh = analyze_boundary([vi2], [assign2], V, 3,
+                                 keep_touch=True)
+        for f in ("owner", "boundary_mask", "touch_count", "touch"):
+            assert np.array_equal(getattr(info2, f), getattr(fresh, f)), f
+        assert info2.n_boundary == fresh.n_boundary
+        assert info2.n_touched == fresh.n_touched
+        assert info2.cut_fraction == pytest.approx(fresh.cut_fraction)
+        # the original analysis is untouched (pure patch)
+        assert info.n_boundary == analyze_boundary(
+            [vi], [assign], V, 3).n_boundary
+
+    def test_patch_requires_keep_touch(self):
+        V, vi, assign = self._base()
+        info = analyze_boundary([vi], [assign], V, 3)
+        with pytest.raises(ValueError, match="keep_touch"):
+            patch_boundary(info, removed=[(vi[0], int(assign[0]))])
+
+    def test_stale_removal_detected(self):
+        V, vi, assign = self._base()
+        info = analyze_boundary([vi], [assign], V, 3, keep_touch=True)
+        ghost = np.array([vi[0, 0], vi[0, 1]], np.int32)
+        wrong_shard = (int(assign[0]) + 1) % 3
+        # removing from a shard that never counted those endpoints
+        # (enough times) must be caught, not silently go negative
+        info2 = info
+        with pytest.raises(ValueError, match="stale"):
+            for _ in range(5):
+                info2 = patch_boundary(
+                    info2, removed=[(ghost, wrong_shard)])
+
+    def test_add_remove_roundtrip_is_identity(self):
+        V, vi, assign = self._base()
+        info = analyze_boundary([vi], [assign], V, 3, keep_touch=True)
+        row = np.array([3, 17], np.int32)
+        info2 = patch_boundary(info, added=[(row, 1)])
+        info3 = patch_boundary(info2, removed=[(row, 1)])
+        for f in ("owner", "boundary_mask", "touch_count", "touch"):
+            assert np.array_equal(getattr(info3, f), getattr(info, f)), f
+
+
+class TestPatchExchangePlan:
+    def _pairwise(self):
+        V = 12
+        vi = np.array([[0, 1], [1, 2], [2, 3], [3, 4], [4, 5], [5, 6],
+                       [6, 7], [7, 8]], np.int32)
+        assign = np.array([0, 0, 0, 1, 1, 1, 2, 2], np.int64)
+        return V, vi, assign
+
+    @staticmethod
+    def _pair_payloads(plan):
+        out = {}
+        for r, perms in enumerate(plan.rounds):
+            for (a, b) in perms:
+                k = int(plan.recv_valid[b, r].sum())
+                out[(a, b)] = list(plan.send_idx[a, r, :k])
+        return out
+
+    def test_same_pair_structure_is_patched_in_place(self):
+        V, vi, assign = self._pairwise()
+        info = analyze_boundary([vi], [assign], V, 3, keep_touch=True)
+        plan = build_exchange_plan(info, [vi], [assign])
+        assert plan is not None
+        # move one cut column: pair set unchanged, columns change
+        new_row = np.array([2, 4], np.int32)
+        info2 = patch_boundary(info, removed=[(vi[2], 0)],
+                               added=[(new_row, 0)])
+        plan2, patched = patch_exchange_plan(plan, info2)
+        assert patched, "same pair structure must patch, not rebuild"
+        assert plan2.rounds == plan.rounds  # schedule reused verbatim
+        vi2 = vi.copy()
+        vi2[2] = new_row
+        fresh = build_exchange_plan(
+            analyze_boundary([vi2], [assign], V, 3), [vi2], [assign])
+        assert self._pair_payloads(plan2) == self._pair_payloads(fresh)
+
+    def test_new_pair_rebuilds(self):
+        V, vi, assign = self._pairwise()
+        info = analyze_boundary([vi], [assign], V, 3, keep_touch=True)
+        plan = build_exchange_plan(info, [vi], [assign])
+        # a factor bridging shards 0 and 2: a pair the plan never had
+        row = np.array([0, 8], np.int32)
+        info2 = patch_boundary(info, added=[(row, 0)])
+        plan2, patched = patch_exchange_plan(plan, info2)
+        assert not patched
+        assert plan2 is not None
+        pairs = set(self._pair_payloads(plan2))
+        assert (0, 2) in pairs or (2, 0) in pairs
+
+    def test_non_pairwise_returns_none(self):
+        V = 8
+        # one variable shared by all three shards
+        vi = np.array([[0, 1], [0, 2], [0, 3]], np.int32)
+        assign = np.array([0, 1, 2], np.int64)
+        info = analyze_boundary([vi], [assign], V, 3, keep_touch=True)
+        plan2, patched = patch_exchange_plan(None, info)
+        assert plan2 is None and not patched
+
+
+class TestShardedWarmEdit:
+    def test_edit_factor_matches_fresh_engine(self):
+        from pydcop_tpu.parallel import ShardedMaxSum, build_mesh
+
+        ei, ej, mats, t = ring_instance()
+        eng = ShardedMaxSum(t, build_mesh(2), damping=0.5,
+                            use_packed=False)
+        v1, q, r = eng.run(cycles=8)
+        rng = np.random.default_rng(9)
+        new_tab = rng.uniform(0, 5, mats.shape[1:]).astype(np.float32)
+        eng.edit_factor(0, 7, new_tab)
+        v2, _, _ = eng.run(cycles=8, q=q, r=r)
+
+        mats2 = mats.copy()
+        mats2[7] = new_tab
+        fresh = ShardedMaxSum(
+            compile_binary_from_arrays(ei, ej, mats2, t.n_vars),
+            build_mesh(2), damping=0.5, use_packed=False)
+        vf, qf, rf = fresh.run(cycles=8)
+        vf2, _, _ = fresh.run(cycles=8, q=qf, r=rf)
+        assert np.array_equal(np.asarray(v2), np.asarray(vf2))
+
+    def test_edit_factor_compact_mode(self):
+        from pydcop_tpu.parallel import ShardedMaxSum, build_mesh
+
+        ei, ej, mats, t = ring_instance(seed=4)
+        rng = np.random.default_rng(10)
+        new_tab = rng.uniform(0, 5, mats.shape[1:]).astype(np.float32)
+
+        eng = ShardedMaxSum(t, build_mesh(2), damping=0.5,
+                            use_packed=False, overlap="exact")
+        v1, q, r = eng.run(cycles=8)
+        eng.edit_factor(0, 7, new_tab)
+        v2, _, _ = eng.run(cycles=8, q=q, r=r)
+
+        mats2 = mats.copy()
+        mats2[7] = new_tab
+        dense = ShardedMaxSum(
+            compile_binary_from_arrays(ei, ej, mats2, t.n_vars),
+            build_mesh(2), damping=0.5, use_packed=False)
+        vf, qf, rf = dense.run(cycles=8)
+        vf2, _, _ = dense.run(cycles=8, q=qf, r=rf)
+        assert np.array_equal(np.asarray(v2), np.asarray(vf2))
+
+    def test_edit_factor_validates(self):
+        from pydcop_tpu.parallel import ShardedMaxSum, build_mesh
+
+        _ei, _ej, mats, t = ring_instance()
+        eng = ShardedMaxSum(t, build_mesh(2), damping=0.5,
+                            use_packed=False)
+        with pytest.raises(ValueError, match="scope"):
+            eng.edit_factor(0, 7, np.zeros((2, 2), np.float32))
+
+    def test_sharded_graph_keeps_factor_rows_and_touch(self):
+        from pydcop_tpu.parallel.mesh import shard_factor_graph
+
+        _ei, _ej, _mats, t = ring_instance()
+        st = shard_factor_graph(t, 2)
+        rows = st.factor_rows[0]
+        assert rows.shape[0] == t.buckets[0].n_factors
+        assert (rows >= 0).all()
+        # rows index the stacked slab: round-trip the tables
+        stacked = np.asarray(st.buckets[0].tensors)
+        orig = np.asarray(t.buckets[0].tensors)
+        assert np.allclose(stacked[rows], orig)
+        # boundary analysis retained its patchable counts
+        assert st.boundary.touch is not None

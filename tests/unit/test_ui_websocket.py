@@ -152,6 +152,13 @@ def test_events_are_pushed(served_orchestrator):
      {"algo": "mgm", "lanes": 4, "warm": True}),
     ("batch.bucket.formed", "batch", {"algo": "mgm", "size": 3}),
     ("harness.run.done", "harness", {"algo": "mgm", "cycle": 21}),
+    ("repair.mutation.applied", "repair",
+     {"kind": "edit_factor", "target": "c12", "mutations": 1,
+      "free_var_slots": 3}),
+    ("repair.repack", "repair",
+     {"reason": "no free variable slot", "capacity_vars": 12}),
+    ("repair.recovered", "repair",
+     {"time_to_recover_s": 0.04, "cycle": 21, "cost": 3.0}),
 ])
 def test_lifecycle_topics_forwarded(served_orchestrator, topic,
                                     evt_name, payload):

@@ -1,0 +1,696 @@
+"""Warm repair (ISSUE 8): survive agent churn and live mutations
+without a cold restart.
+
+Pins the tentpole guarantees:
+
+* a seeded 50-mutation churn stream on a headroom-packed maxsum AND a
+  local-search engine completes with ZERO chunk-runner retraces
+  (the acceptance criterion's trace-count pin);
+* warm-repair vs cold-repack equivalence — after any single mutation
+  the warm-started solve reaches the same fixed point as a cold
+  repack that carries the same state (bit-identical for coin-free
+  MGM and deterministic maxsum, statistical for dsa/adsa);
+* graceful degradation: headroom exhaustion triggers exactly ONE
+  counted repack (one retrace, one ``repair.repack`` event, never an
+  exception mid-run);
+* checkpoint schema v3 restores a MUTATED problem at its exact padded
+  shape; corrupt/newer files keep the existing ValueError path.
+"""
+import textwrap
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms import AlgorithmDef
+from pydcop_tpu.algorithms.warm import (
+    WarmLocalSearchSolver,
+    WarmMaxSumSolver,
+    build_warm_solver,
+    repack_solver,
+)
+from pydcop_tpu.dcop import load_dcop
+from pydcop_tpu.dcop.objects import Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.ops.headroom import (
+    AddFactor,
+    AddVariable,
+    EditFactor,
+    HeadroomExhausted,
+    HeadroomLayout,
+    RemoveFactor,
+    RemoveVariable,
+    reserve_headroom,
+)
+from pydcop_tpu.runtime.events import event_bus
+from pydcop_tpu.runtime.repair import (
+    WarmRepairController,
+    perturbed_constraint,
+)
+from pydcop_tpu.runtime.stats import RepairCounters
+
+YAML = textwrap.dedent("""
+    name: t
+    objective: min
+    domains:
+      d: {values: [0, 1, 2]}
+    variables:
+      v1: {domain: d}
+      v2: {domain: d}
+      v3: {domain: d}
+      v4: {domain: d}
+    constraints:
+      c12: {type: intention, function: "0 if v1 == v2 else 5"}
+      c23: {type: intention, function: "0 if v2 != v3 else 3"}
+      c34: {type: intention, function: "abs(v3 - v4)"}
+    agents: [a1, a2, a3, a4, a5, a6, a7, a8]
+""")
+
+
+def fresh_dcop():
+    return load_dcop(YAML)
+
+
+def swap_c12(dcop):
+    return constraint_from_str(
+        "c12", "0 if v1 != v2 else 5",
+        list(dcop.constraints["c12"].dimensions),
+    )
+
+
+# ---------------------------------------------------------------------------
+# headroom layout
+# ---------------------------------------------------------------------------
+
+
+class TestHeadroomLayout:
+    def test_reserve_shapes_and_inert_slots(self):
+        dcop = fresh_dcop()
+        cap, layout = reserve_headroom(dcop, graph="factor",
+                                       headroom=0.5, min_free=3)
+        V = 4
+        assert layout.n_vars_cap == cap.n_vars
+        assert cap.n_vars > V  # headroom + parking
+        assert layout.parking == cap.n_vars - 1
+        # inert slots: single valid value, zero cost
+        mask = np.asarray(cap.domain_mask)
+        assert (mask[V:, 0] == 1).all() and (mask[V:, 1:] == 0).all()
+        # free factor slots wired to parking
+        b = cap.buckets[0]
+        free = layout.free_factor_slots(2)
+        assert free, "headroom must reserve free factor slots"
+        for k in free:
+            assert (np.asarray(b.var_idx[k]) == layout.parking).all()
+
+    def test_claim_release_cycle(self):
+        dcop = fresh_dcop()
+        _cap, layout = reserve_headroom(dcop, headroom=0.5, min_free=2)
+        s = layout.claim_var("z1")
+        assert layout.var_slot("z1") == s
+        assert layout.release_var("z1") == s
+        with pytest.raises(KeyError):
+            layout.var_slot("z1")
+        b, k = layout.claim_factor("fz", 2)
+        assert layout.factor_slot("fz") == (b, k)
+        layout.release_factor("fz")
+        assert not layout.has_factor("fz")
+
+    def test_meta_roundtrip(self):
+        dcop = fresh_dcop()
+        _cap, layout = reserve_headroom(dcop, headroom=0.25)
+        layout.claim_var("zz")
+        layout.claim_factor("fzz", 2)
+        back = HeadroomLayout.from_meta(layout.to_meta())
+        assert back.var_names == layout.var_names
+        assert back.fac_names == layout.fac_names
+        assert back.parking == layout.parking
+
+    def test_exhaustion_is_typed(self):
+        dcop = fresh_dcop()
+        _cap, layout = reserve_headroom(dcop, headroom=0.0, min_free=1)
+        layout.claim_var("z1")
+        with pytest.raises(HeadroomExhausted):
+            layout.claim_var("z2")
+        with pytest.raises(HeadroomExhausted):
+            layout.claim_factor("f9", 9)  # no arity-9 bucket
+
+    def test_assignment_hides_free_and_parking_slots(self):
+        dcop = fresh_dcop()
+        s = build_warm_solver(dcop, algo="mgm", seed=1, headroom=0.5)
+        res = s.run(cycles=10, chunk=8)
+        assert sorted(res.assignment) == ["v1", "v2", "v3", "v4"]
+
+
+# ---------------------------------------------------------------------------
+# warm solvers: solve quality + zero-retrace mutation
+# ---------------------------------------------------------------------------
+
+
+class TestWarmSolvers:
+    @pytest.mark.parametrize("algo", ["maxsum", "mgm", "dsa", "adsa"])
+    def test_warm_solver_solves_correctly(self, algo):
+        dcop = fresh_dcop()
+        s = build_warm_solver(dcop, algo=algo, seed=3, headroom=0.4)
+        res = s.run(chunk=8)
+        assert res.status == "FINISHED"
+        # easy instance: the optimum (v1==v2, v2!=v3, v3==v4) is 0
+        assert res.violation == 0
+        assert res.cost is not None
+
+    @pytest.mark.parametrize("algo", ["maxsum", "mgm", "dsa", "adsa"])
+    def test_edit_factor_zero_retrace_and_solution_follows(self, algo):
+        dcop = fresh_dcop()
+        s = build_warm_solver(dcop, algo=algo, seed=3, headroom=0.4)
+        s.run(chunk=8)
+        t0 = s.trace_count()
+        s.apply_mutations([EditFactor(swap_c12(dcop))])
+        dcop.constraints["c12"] = swap_c12(dcop)
+        res = s.run(resume=True, chunk=8)
+        assert s.trace_count() == t0, "a warm mutation must not retrace"
+        assert res.assignment["v1"] != res.assignment["v2"]
+
+    def test_add_variable_and_factor_then_remove(self):
+        dcop = fresh_dcop()
+        s = build_warm_solver(dcop, algo="mgm", seed=3, headroom=0.5)
+        s.run(chunk=8)
+        t0 = s.trace_count()
+        d = dcop.domains["d"]
+        z = Variable("z9", d)  # sorts after v*: index order preserved
+        dcop.add_variable(z)
+        s.apply_mutations([AddVariable(z)])
+        c = constraint_from_str(
+            "cz", "0 if z9 == v4 else 7", [z, dcop.variables["v4"]]
+        )
+        dcop.add_constraint(c)
+        s.apply_mutations([AddFactor(c)])
+        res = s.run(resume=True, chunk=8)
+        assert res.assignment["z9"] == res.assignment["v4"]
+        assert s.trace_count() == t0
+        # and back out again
+        del dcop.constraints["cz"]
+        s.apply_mutations([RemoveFactor("cz")])
+        del dcop.variables["z9"]
+        s.apply_mutations([RemoveVariable("z9")])
+        res2 = s.run(resume=True, chunk=8)
+        assert "z9" not in res2.assignment
+        assert s.trace_count() == t0
+
+    def test_remove_variable_with_live_factor_rejected(self):
+        dcop = fresh_dcop()
+        s = build_warm_solver(dcop, algo="mgm", seed=0, headroom=0.4)
+        with pytest.raises(ValueError, match="factor"):
+            s.apply_mutations([RemoveVariable("v1")])
+
+    def test_scope_mismatch_rejected_and_state_untouched(self):
+        dcop = fresh_dcop()
+        s = build_warm_solver(dcop, algo="maxsum", seed=0, headroom=0.4)
+        s.run(cycles=5, chunk=8)
+        bad = constraint_from_str(
+            "c12", "v1 + v3", [dcop.variables["v1"], dcop.variables["v3"]]
+        )
+        with pytest.raises(ValueError, match="scope"):
+            s.apply_mutations([EditFactor(bad)])
+        # the factor table is unchanged: re-running converges as before
+        res = s.run(resume=True, chunk=8)
+        assert res.assignment["v1"] == res.assignment["v2"]
+
+    def test_oversized_domain_rejected(self):
+        from pydcop_tpu.dcop.objects import Domain
+
+        dcop = fresh_dcop()
+        s = build_warm_solver(dcop, algo="mgm", seed=0, headroom=0.4)
+        big = Variable("zb", Domain("big", "v", list(range(9))))
+        with pytest.raises(ValueError, match="domain size"):
+            s.apply_mutations([AddVariable(big)])
+
+    def test_external_change_routes_as_edit(self):
+        yaml_str = textwrap.dedent("""
+            name: ext
+            objective: min
+            domains:
+              d: {values: [0, 1]}
+            variables:
+              v1: {domain: d}
+            external_variables:
+              sensor: {domain: d, initial_value: 0}
+            constraints:
+              follow: {type: intention,
+                       function: "0 if v1 == sensor else 5"}
+            agents: [a1, a2]
+        """)
+        dcop = load_dcop(yaml_str)
+        s = build_warm_solver(dcop, algo="maxsum", seed=0, headroom=0.3)
+        s.run(chunk=8)
+        t0 = s.trace_count()
+        s.on_external_change("sensor", 1)
+        res = s.run(resume=True, chunk=8)
+        assert res.assignment["v1"] == 1
+        assert s.trace_count() == t0
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pin: seeded 50-mutation churn stream, zero retraces
+# ---------------------------------------------------------------------------
+
+
+class TestChurnStream:
+    @pytest.mark.parametrize("algo", ["maxsum", "mgm"])
+    def test_50_mutation_stream_zero_retraces(self, algo):
+        dcop = fresh_dcop()
+        ctl = WarmRepairController(
+            dcop, algo, seed=7, headroom=1.0, min_free=8, chunk=8,
+        )
+        res = ctl.solver.run(chunk=ctl.chunk)
+        ctl.phase_done(res)
+        rng = np.random.default_rng(42)
+        names = sorted(dcop.constraints)
+        added = []
+        for m in range(50):
+            roll = rng.integers(4)
+            if roll == 0 and len(added) < 4:
+                z = Variable(f"z{m:02d}", dcop.domains["d"])
+                ctl.add_variable(z)
+                c = constraint_from_str(
+                    f"cz{m:02d}", f"0 if z{m:02d} == v1 else 2",
+                    [z, dcop.variables["v1"]],
+                )
+                ctl.add_constraint(c)
+                added.append((z.name, c.name))
+            elif roll == 1 and added:
+                vn, cn = added.pop()
+                ctl.remove_constraint(cn)
+                ctl.remove_variable(vn)
+            else:
+                name = names[int(rng.integers(len(names)))]
+                ctl.edit_factor(
+                    perturbed_constraint(dcop.constraints[name], seed=m)
+                )
+            res = ctl.solver.run(resume=True, chunk=ctl.chunk)
+            ctl.phase_done(res)
+        c = ctl.counters.as_dict()
+        assert c["repair_retraces"] == 0, c
+        assert c["headroom_exhausted_repacks"] == 0, c
+        assert c["mutations_applied"] >= 50
+        assert c["time_to_recover_s"] > 0
+
+    def test_headroom_exhaustion_exactly_one_repack_one_retrace(self):
+        dcop = fresh_dcop()
+        ctl = WarmRepairController(
+            dcop, "mgm", seed=7, headroom=0.0, min_free=1, chunk=8,
+        )
+        events = []
+        was = event_bus.enabled
+        event_bus.enabled = True
+        event_bus.subscribe("repair.*", lambda t, e: events.append(t))
+        try:
+            res = ctl.solver.run(chunk=ctl.chunk)
+            ctl.phase_done(res)
+            # 1 free slot: the second add must repack, not raise
+            for i in range(2):
+                ctl.add_variable(Variable(f"z{i}", dcop.domains["d"]))
+                res = ctl.solver.run(resume=True, chunk=ctl.chunk)
+                ctl.phase_done(res)
+        finally:
+            event_bus.enabled = was
+        c = ctl.counters.as_dict()
+        assert c["headroom_exhausted_repacks"] == 1, c
+        assert c["repair_retraces"] == 1, c  # exactly the repack's one
+        assert events.count("repair.repack") == 1
+        assert "z0" in res.assignment and "z1" in res.assignment
+
+    def test_counters_schema_is_closed(self):
+        rc = RepairCounters()
+        with pytest.raises(KeyError):
+            rc.inc("nope")
+
+
+# ---------------------------------------------------------------------------
+# parity guard: warm repair vs cold repack (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+def _mutated_pair(algo, seed):
+    """Two identical warm solvers, converged, then the same mutation:
+    A continues warm; B is cold-repacked (fresh capacity, state
+    carried by name)."""
+    da, db = fresh_dcop(), fresh_dcop()
+    A = build_warm_solver(da, algo=algo, seed=seed, headroom=0.5)
+    B = build_warm_solver(db, algo=algo, seed=seed, headroom=0.5)
+    A.run(chunk=8)
+    B.run(chunk=8)
+    A.apply_mutations([EditFactor(swap_c12(da))])
+    da.constraints["c12"] = swap_c12(da)
+    B.apply_mutations([EditFactor(swap_c12(db))])
+    db.constraints["c12"] = swap_c12(db)
+    B2 = repack_solver(B, headroom=0.0, min_free=2)
+    return A, B2
+
+
+class TestWarmColdParity:
+    def test_mgm_bit_identical_fixed_point(self):
+        A, B = _mutated_pair("mgm", seed=5)
+        ra = A.run(resume=True, chunk=8)
+        rb = B.run(resume=True, chunk=8)
+        assert ra.assignment == rb.assignment
+        assert ra.cycle == rb.cycle  # same stop cycle, bit-identical
+
+    def test_maxsum_bit_identical_fixed_point(self):
+        A, B = _mutated_pair("maxsum", seed=5)
+        ra = A.run(resume=True, chunk=8)
+        rb = B.run(resume=True, chunk=8)
+        assert ra.assignment == rb.assignment
+        assert ra.cost == rb.cost
+
+    @pytest.mark.parametrize("algo", ["dsa", "adsa"])
+    def test_stochastic_rules_statistically_equivalent(self, algo):
+        # coins are drawn at the capacity shape, so warm (headroom) and
+        # cold-repacked (minimal) streams differ; equivalence is
+        # distributional: same mean cost over seeds at the fixed point
+        costs_a, costs_b = [], []
+        for seed in range(6):
+            A, B = _mutated_pair(algo, seed=seed)
+            costs_a.append(A.run(resume=True, cycles=40, chunk=8).cost)
+            costs_b.append(B.run(resume=True, cycles=40, chunk=8).cost)
+        assert np.mean(costs_a) == pytest.approx(
+            np.mean(costs_b), abs=2.0
+        )
+
+    def test_repack_preserves_claims_and_key(self):
+        dcop = fresh_dcop()
+        A = build_warm_solver(dcop, algo="mgm", seed=5, headroom=0.5)
+        A.run(chunk=8)
+        z = Variable("z9", dcop.domains["d"])
+        dcop.add_variable(z)
+        A.apply_mutations([AddVariable(z)])
+        B = repack_solver(A)
+        assert sorted(B.layout.claimed_vars) == sorted(
+            A.layout.claimed_vars)
+        assert np.array_equal(np.asarray(B._last_key),
+                              np.asarray(A._last_key))
+
+
+# ---------------------------------------------------------------------------
+# checkpoint schema v3 (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointV3:
+    def test_mutated_solver_roundtrip(self, tmp_path):
+        from pydcop_tpu.runtime.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        dcop = fresh_dcop()
+        s = build_warm_solver(dcop, algo="mgm", seed=3, headroom=0.5)
+        s.run(chunk=8)
+        z = Variable("z9", dcop.domains["d"])
+        dcop.add_variable(z)
+        s.apply_mutations([AddVariable(z)])
+        c = constraint_from_str(
+            "cz", "0 if z9 == v4 else 7", [z, dcop.variables["v4"]]
+        )
+        dcop.add_constraint(c)
+        s.apply_mutations([AddFactor(c)])
+        s.run(resume=True, chunk=8)
+        path = str(tmp_path / "ck.npz")
+        save_checkpoint(path, s, cycle=30)
+
+        # a FRESH solver built from the pre-mutation problem restores
+        # the mutated padded shape + slot maps from the snapshot
+        s2 = build_warm_solver(
+            fresh_dcop(), algo="mgm", seed=3, headroom=0.5)
+        meta = load_checkpoint(path, s2)
+        assert meta["version"] == 3
+        assert s2.layout.has_factor("cz")
+        assert "z9" in s2.layout.claimed_vars
+        vals = s2.tensors.assignment_from_indices(
+            np.asarray(s2.values_of(s2._last_state)))
+        assert vals["z9"] == vals["v4"]
+
+    def test_corrupt_and_future_versions_still_rejected(self, tmp_path):
+        from pydcop_tpu.runtime.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+            read_state_npz,
+            write_state_npz,
+        )
+        from pydcop_tpu.runtime.faults import corrupt_checkpoint
+
+        dcop = fresh_dcop()
+        s = build_warm_solver(dcop, algo="mgm", seed=3, headroom=0.3)
+        s.run(cycles=5, chunk=8)
+        path = str(tmp_path / "ck.npz")
+        save_checkpoint(path, s, cycle=5)
+        corrupt_checkpoint(path, seed=1)
+        with pytest.raises(ValueError):
+            load_checkpoint(path, s)
+        # future schema: refused to guess
+        import json as _json
+
+        p4 = str(tmp_path / "v99.npz")
+        np.savez(p4,
+                 __meta__=_json.dumps({"version": 99, "kind": "solver"}),
+                 leaf_0=np.zeros(3))
+        with pytest.raises(ValueError, match="schema version"):
+            read_state_npz(p4)
+        _ = write_state_npz  # imported for symmetry with the writer
+
+    def test_v2_solver_checkpoints_unaffected(self, tmp_path):
+        """Cold solvers (no layout attr) still roundtrip — v3 is
+        additive."""
+        from pydcop_tpu.runtime.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+        from pydcop_tpu.runtime.run import solve_result  # noqa: F401
+        from pydcop_tpu.algorithms.mgm import build_solver
+
+        dcop = fresh_dcop()
+        s = build_solver(dcop, None, None, seed=1)
+        s.run(cycles=5)
+        path = str(tmp_path / "ck.npz")
+        save_checkpoint(path, s, cycle=5)
+        s2 = build_solver(fresh_dcop(), None, None, seed=1)
+        meta = load_checkpoint(path, s2)
+        assert "headroom" not in meta
+
+
+# ---------------------------------------------------------------------------
+# orchestrator integration
+# ---------------------------------------------------------------------------
+
+
+def orch_for(dcop, algo="maxsum_dynamic", warm=True, fault_plan=None):
+    from pydcop_tpu.runtime.orchestrator import VirtualOrchestrator
+
+    algo_def = AlgorithmDef.build_with_default_params(
+        algo, {}, mode=dcop.objective)
+    orch = VirtualOrchestrator(
+        dcop, algo_def, warm_repair=warm, fault_plan=fault_plan)
+    orch.deploy_computations()
+    return orch
+
+
+class TestOrchestratorWarm:
+    def test_structural_scenario_end_to_end(self):
+        from pydcop_tpu.dcop.scenario import (
+            DcopEvent,
+            EventAction,
+            Scenario,
+        )
+
+        dcop = fresh_dcop()
+        orch = orch_for(dcop)
+        orch.start_replication(2)
+        scenario = Scenario([
+            DcopEvent("d1", delay=0.2),
+            DcopEvent("e1", actions=[EventAction(
+                "change_factor", constraint="c12",
+                expression="0 if v1 != v2 else 5")]),
+            DcopEvent("e2", actions=[
+                EventAction("add_variable", variable="z9", domain="d"),
+                EventAction("add_constraint", constraint="cz",
+                            expression="0 if z9 == v4 else 7",
+                            scope=["z9", "v4"]),
+            ]),
+            DcopEvent("e3", actions=[EventAction(
+                "remove_agent", agent="a2")]),
+            DcopEvent("d2", delay=0.2),
+        ])
+        res = orch.run(scenario, cycles=20)
+        m = orch.end_metrics()
+        assert res.assignment["v1"] != res.assignment["v2"]
+        assert res.assignment["z9"] == res.assignment["v4"]
+        assert m["repair"]["repair_retraces"] == 0
+        assert m["repair"]["mutations_applied"] >= 3
+        assert m["resilience"]["repairs"] == 1
+        # the solver result itself carries the scorecard too
+        assert res.metrics()["repair"] == m["repair"]
+
+    def test_structural_actions_need_warm_repair(self):
+        from pydcop_tpu.dcop.scenario import (
+            DcopEvent,
+            EventAction,
+            Scenario,
+        )
+
+        dcop = fresh_dcop()
+        orch = orch_for(dcop, algo="maxsum", warm=False)
+        scenario = Scenario([
+            DcopEvent("e1", actions=[EventAction(
+                "add_variable", variable="z9", domain="d")]),
+        ])
+        with pytest.raises(ValueError, match="warm-repair"):
+            orch.run(scenario, cycles=5)
+
+    def test_warm_repair_rejects_unsupported_algo(self):
+        with pytest.raises(ValueError, match="warm"):
+            orch_for(fresh_dcop(), algo="gdba")
+
+    def test_churn_fault_kinds_fire_and_stay_warm(self):
+        from pydcop_tpu.runtime.faults import Fault, FaultPlan
+
+        plan = FaultPlan(seed=11, faults=[
+            Fault(kind="edit_factor", cycle=4),
+            Fault(kind="edit_factor", cycle=8, constraint="c23"),
+            Fault(kind="remove_agent_burst", cycle=12, count=2),
+            Fault(kind="add_agent_burst", cycle=16, count=2),
+        ])
+        dcop = fresh_dcop()
+        orch = orch_for(dcop, algo="mgm", fault_plan=plan)
+        orch.run(cycles=40)
+        m = orch.end_metrics()
+        assert m["resilience"]["faults_injected"] == 4
+        assert m["repair"]["repair_retraces"] == 0
+        assert m["repair"]["mutations_applied"] >= 2
+        assert len(dcop.agents) == 8  # -2 burst, +2 burst
+        kinds = [e.get("fault") for e in m["events"] if "fault" in e]
+        assert kinds.count("edit_factor") == 2
+        assert "remove_agent_burst" in kinds
+        assert "add_agent_burst" in kinds
+
+    def test_churn_bursts_are_seed_deterministic(self):
+        from pydcop_tpu.runtime.faults import Fault, FaultPlan
+
+        removed = []
+        for _ in range(2):
+            plan = FaultPlan(seed=3, faults=[
+                Fault(kind="remove_agent_burst", cycle=4, count=2),
+            ])
+            dcop = fresh_dcop()
+            orch = orch_for(dcop, algo="mgm", fault_plan=plan)
+            orch.run(cycles=10)
+            removed.append(tuple(sorted(
+                set("a1 a2 a3 a4 a5 a6 a7 a8".split())
+                - set(dcop.agents))))
+        assert removed[0] == removed[1]
+
+    def test_edit_factor_fault_cold_dynamic_works_cold_mgm_raises(self):
+        from pydcop_tpu.runtime.faults import Fault, FaultPlan
+
+        plan = FaultPlan(seed=5, faults=[
+            Fault(kind="edit_factor", cycle=2)])
+        orch = orch_for(fresh_dcop(), algo="maxsum_dynamic", warm=False,
+                        fault_plan=plan)
+        orch.run(cycles=10)
+        assert orch.fault_counters.counts["faults_injected"] == 1
+
+        orch2 = orch_for(fresh_dcop(), algo="mgm", warm=False,
+                         fault_plan=plan)
+        with pytest.raises(ValueError, match="warm-repair"):
+            orch2.run(cycles=10)
+
+    def test_dynamic_scenario_still_works_warm(self):
+        """The historical dynamic-DCOP scenario runs unchanged through
+        the warm layer — one mechanism (ISSUE 8 tentpole wiring)."""
+        from pydcop_tpu.dcop.scenario import (
+            DcopEvent,
+            EventAction,
+            Scenario,
+        )
+
+        dcop = fresh_dcop()
+        orch = orch_for(dcop, algo="maxsum")
+        scenario = Scenario([
+            DcopEvent("d1", delay=0.2),
+            DcopEvent("e1", actions=[EventAction(
+                "change_factor", constraint="c12", seed=4)]),
+            DcopEvent("d2", delay=0.2),
+        ])
+        res = orch.run(scenario, cycles=15)
+        assert res.status == "FINISHED"
+        assert orch.end_metrics()["repair"]["mutations_applied"] == 1
+
+
+class TestChurnScenario:
+    def test_seeded_stream_is_deterministic_and_runs(self):
+        from pydcop_tpu.dcop.scenario import churn_scenario
+
+        d1, d2 = fresh_dcop(), fresh_dcop()
+        s1 = churn_scenario(d1, n_events=6, seed=9, delay=0.05)
+        s2 = churn_scenario(d2, n_events=6, seed=9, delay=0.05)
+        acts1 = [(a.type, sorted(a.parameters.items()))
+                 for e in s1 for a in e.actions]
+        acts2 = [(a.type, sorted(a.parameters.items()))
+                 for e in s2 for a in e.actions]
+        assert acts1 == acts2 and len(acts1) == 6
+        orch = orch_for(d1, algo="mgm")
+        res = orch.run(s1, cycles=20)
+        assert res.status == "FINISHED"
+        assert orch.end_metrics()["repair"]["repair_retraces"] == 0
+
+
+# ---------------------------------------------------------------------------
+# packed-layout hot swap (ops/pallas_maxsum + maxsum_dynamic wiring)
+# ---------------------------------------------------------------------------
+
+
+class TestPackedSwap:
+    def test_packed_swap_matches_fresh_pack(self):
+        from pydcop_tpu.ops.compile import compile_binary_from_arrays
+        from pydcop_tpu.ops.pallas_maxsum import (
+            pack_for_pallas,
+            packed_swap_factor,
+        )
+
+        rng = np.random.default_rng(0)
+        V, F, D = 24, 40, 3
+        ei = rng.integers(0, V, F)
+        ej = (ei + 1 + rng.integers(0, V - 1, F)) % V
+        mats = rng.uniform(0, 5, (F, D, D)).astype(np.float32)
+        pg = pack_for_pallas(compile_binary_from_arrays(ei, ej, mats, V))
+        new_tab = rng.uniform(0, 5, (D, D)).astype(np.float32)
+        pg2 = packed_swap_factor(pg, 7, new_tab)
+        mats2 = mats.copy()
+        mats2[7] = new_tab
+        fresh = pack_for_pallas(
+            compile_binary_from_arrays(ei, ej, mats2, V))
+        assert np.allclose(np.asarray(pg2.cost_rows),
+                           np.asarray(fresh.cost_rows))
+        # static structure shared, wrong shapes rejected
+        assert pg2.plan is pg.plan
+        with pytest.raises(ValueError, match="scope"):
+            packed_swap_factor(pg, 7, np.zeros((D, D + 1)))
+        with pytest.raises(ValueError, match="range"):
+            packed_swap_factor(pg, F, new_tab)
+
+    def test_stacked_swap_matches_fresh_stacked_pack(self):
+        from pydcop_tpu.ops.compile import compile_binary_from_arrays
+        from pydcop_tpu.parallel.packed_mesh import build_shard_packs
+
+        rng = np.random.default_rng(1)
+        V, F, D = 24, 40, 3
+        ei = rng.integers(0, V, F)
+        ej = (ei + 1 + rng.integers(0, V - 1, F)) % V
+        mats = rng.uniform(0, 5, (F, D, D)).astype(np.float32)
+        sp = build_shard_packs(
+            compile_binary_from_arrays(ei, ej, mats, V), 2)
+        assert sp is not None
+        new_tab = rng.uniform(0, 5, (D, D)).astype(np.float32)
+        sp.swap_factor(11, new_tab)
+        mats2 = mats.copy()
+        mats2[11] = new_tab
+        fresh = build_shard_packs(
+            compile_binary_from_arrays(ei, ej, mats2, V), 2)
+        assert np.allclose(np.asarray(sp.cost_rows),
+                           np.asarray(fresh.cost_rows))
