@@ -2,7 +2,8 @@
 
 .PHONY: test unit api cli doctest all-tests bench bench-probe faults \
 	bench-batch batch-smoke bench-harness bench-sharded bench-serve \
-	serve-smoke chaos-smoke bench-churn churn-smoke
+	serve-smoke chaos-smoke bench-churn churn-smoke bench-dpop \
+	dpop-smoke
 
 test: all-tests
 
@@ -42,6 +43,22 @@ bench-batch:
 # docs/performance.rst "Boundary-compacted sharding"
 bench-sharded:
 	python bench.py --only sharded
+
+# sharded exact DPOP (ISSUE 9): the separator-tiled sweep on the
+# 8-device CPU mesh against an instance whose largest joint util table
+# exceeds the simulated per-device budget — bitmatch flag, bytes
+# shipped and pruning counters in the JSON (docs/performance.rst
+# "Sharded exact inference", BENCHREF.md "Sharded exact DPOP")
+bench-dpop:
+	python bench.py --only dpop-sharded
+
+# fast sharded-DPOP smoke: the tiled-vs-single-device parity matrix,
+# pruning property and mini-bucket bound-sandwich tests on the CPU
+# backend — run it whenever touching the exact-inference engines
+dpop-smoke:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/unit/test_dpop_shard.py tests/unit/test_dpop_mesh.py \
+		-q -m 'not slow'
 
 # harness sync-overhead spot check: blocking vs pipelined chunk
 # dispatch on a convergence-bound solve (docs/performance.rst

@@ -1582,6 +1582,176 @@ def bench_churn(args, probe=None):
     return out
 
 
+def bench_dpop_sharded_subprocess(args):
+    """Sharded exact DPOP on a virtual 8-device CPU mesh, in a
+    subprocess so the forced-CPU platform doesn't poison this process's
+    TPU backend (same pattern as the maxsum sharded leg)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    cmd = [sys.executable, os.path.abspath(__file__), "--only",
+           "dpop-sharded-inner",
+           "--dpop-sharded-clique", str(args.dpop_sharded_clique),
+           "--dpop-sharded-branches", str(args.dpop_sharded_branches),
+           "--repeat", str(args.repeat), "--watchdog", "0"]
+    out = subprocess.run(
+        cmd,
+        capture_output=True, text=True, timeout=900, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+    )
+    lines = out.stdout.strip().splitlines()
+    if not lines:
+        raise RuntimeError(
+            f"dpop-sharded subprocess produced no output "
+            f"(rc={out.returncode}): " + out.stderr.strip()[-400:]
+        )
+    return json.loads(lines[-1])
+
+
+def build_dpop_sharded_dcop(args):
+    """The high-width exact-inference instance (BENCHREF.md "Sharded
+    exact DPOP"): ``branches`` disjoint cliques of ``clique`` variables
+    at domain 4 — every clique node's separator is its full ancestor
+    set, so the deepest joint util table holds ``4^clique`` entries
+    (~4 MiB at the default clique=9) and ALONE exceeds the simulated
+    per-device budget, while the 8-way separator tiles fit.  Integer
+    costs: exactly representable, so sharded-vs-single must match bit
+    for bit."""
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    K, R, D = args.dpop_sharded_clique, args.dpop_sharded_branches, 4
+    rng = np.random.default_rng(3)
+    dcop = DCOP("dpop_sharded_bench", objective="min")
+    dom = Domain("d", "vals", list(range(D)))
+    k = 0
+    for r in range(R):
+        vs = [Variable(f"b{r}v{i:02d}", dom) for i in range(K)]
+        for v in vs:
+            dcop.add_variable(v)
+        for i in range(K):
+            for j in range(i + 1, K):
+                m = rng.integers(0, 10, (D, D)).astype(float)
+                dcop.add_constraint(
+                    NAryMatrixRelation([vs[i], vs[j]], m, name=f"c{k}")
+                )
+                k += 1
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
+def bench_dpop_sharded_inner(args):
+    """Runs inside the CPU-mesh subprocess: the sharded exact sweep on
+    an instance whose LARGEST JOINT UTIL TABLE alone exceeds the
+    simulated per-device budget (the acceptance scenario of ISSUE 9),
+    vs the single-device per-level sweep (bitmatch + wall pair), with
+    bytes-shipped and pruning counters from the plan.  Drift-
+    normalized: the calibration probe runs adjacent to the walls and
+    the headline is additionally reported per unit of probe rate."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from pydcop_tpu.algorithms.dpop import DpopSolver
+    from pydcop_tpu.graph import pseudotree
+    from pydcop_tpu.ops.dpop_shard import (
+        estimate_sweep_bytes, plan_tiled_sweep,
+    )
+    from pydcop_tpu.ops.dpop_sweep import (
+        compile_sweep_perlevel, run_sweep_perlevel,
+    )
+    from pydcop_tpu.parallel.dpop_mesh import ShardedSepDpop
+
+    dcop = build_dpop_sharded_dcop(args)
+    tree = pseudotree.build_computation_graph(dcop)
+    est = estimate_sweep_bytes(tree)
+    largest_table_bytes = est["max_node_entries"] * 4
+
+    # pre-plan unbudgeted to learn the true per-device need, then pin
+    # the simulated budget BETWEEN it and the largest single table:
+    # the budget admits the 8-way tiles but NOT one whole table —
+    # i.e. no single device could even hold the widest joint table
+    probe_plan = plan_tiled_sweep(tree, dcop, "min", n_shards=8)
+    per_dev = probe_plan.bytes_per_device
+    assert per_dev < largest_table_bytes, (per_dev, largest_table_bytes)
+    budget_bytes = (per_dev + largest_table_bytes) // 2
+
+    try:
+        probe = make_drift_probe(repeat=max(2, args.repeat))
+    except Exception:
+        probe = None
+
+    # routing check once through the solver front door (engine="auto"
+    # + budget -> sharded), then engine-level timing so the jitted
+    # per-level steps are reused across repeats like every other leg
+    solver = DpopSolver(dcop)
+    solver.budget_bytes = budget_bytes
+    sh_res = solver.run()
+    assert solver.last_engine == "sharded", solver.last_engine
+
+    plan = plan_tiled_sweep(tree, dcop, "min", n_shards=8,
+                            budget_bytes=budget_bytes)
+    engine = ShardedSepDpop(plan)
+    sh_assign = engine.run()  # warmup / compile
+    times = []
+    for _ in range(max(2, args.repeat)):
+        t0 = time.perf_counter()
+        sh_assign = engine.run()
+        times.append(time.perf_counter() - t0)
+    sh_wall = robust_best(times)
+
+    base = compile_sweep_perlevel(tree, dcop, "min")
+    if base is not None:
+        sg_assign, _ = run_sweep_perlevel(base)  # warmup / compile
+        stimes = []
+        for _ in range(max(2, args.repeat)):
+            t0 = time.perf_counter()
+            sg_assign, _ = run_sweep_perlevel(base)
+            stimes.append(time.perf_counter() - t0)
+        sg_wall = robust_best(stimes)
+        bitmatch = bool(np.array_equal(sh_assign, sg_assign))
+    else:  # clique too wide even for the per-level single-device tier
+        sg_res = DpopSolver(dcop, tree)._run_pernode()
+        sg_wall = sg_res.time
+        bitmatch = bool(sh_res.assignment == sg_res.assignment)
+
+    dpop_m = sh_res.metrics()["dpop"]
+    shard_m = sh_res.metrics()["shard"]
+    out = {
+        "metric": (f"dpop_sharded_sweep_wall_s_8dev_"
+                   f"k{args.dpop_sharded_clique}x"
+                   f"{args.dpop_sharded_branches}"),
+        "value": round(sh_wall, 4), "unit": "s",
+        "n_devices": len(jax.devices()),
+        "dpop_sharded_single_device_wall_s": round(sg_wall, 4),
+        "dpop_sharded_bitmatch": bitmatch,
+        "dpop_sharded_budget_bytes": budget_bytes,
+        "dpop_sharded_largest_table_bytes": largest_table_bytes,
+        "dpop_sharded_table_over_budget": bool(
+            largest_table_bytes > budget_bytes
+        ),
+        "dpop_sharded_est_single_bytes": est["bytes"],
+        "dpop_sharded_bytes_per_device": dpop_m["bytes_per_device"],
+        "dpop_sharded_wire_bytes_pruned": dpop_m["wire_bytes_pruned"],
+        "dpop_sharded_wire_bytes_dense": dpop_m["wire_bytes_dense"],
+        "dpop_sharded_pruned_fraction": dpop_m["pruned_fraction"],
+        "dpop_sharded_shard_comm": shard_m,
+        "dpop_sharded_cost": sh_res.cost,
+    }
+    if probe is not None:
+        pr = probe()
+        out["dpop_sharded_probe_rate"] = round(pr, 1)
+        if pr:
+            # wall x probe-rate is dimensionless: cancels host drift
+            out["dpop_sharded_wall_probe_normalized"] = round(
+                sh_wall * pr, 2
+            )
+    print(json.dumps(out), flush=True)
+    return out
+
+
 def bench_sharded_subprocess(args):
     """ShardedMaxSum on a virtual 8-device CPU mesh, in a subprocess so
     the forced-CPU platform doesn't poison this process's TPU backend."""
@@ -1881,6 +2051,16 @@ def main():
     )
     ap.add_argument("--sharded-vars", type=int, default=2_000)
     ap.add_argument(
+        "--dpop-sharded-clique", type=int, default=9,
+        help="clique size of the sharded exact-DPOP leg: the deepest "
+        "joint util table holds 4^clique entries (~4MiB at 9) and "
+        "alone exceeds the simulated per-device budget",
+    )
+    ap.add_argument(
+        "--dpop-sharded-branches", type=int, default=2,
+        help="disjoint cliques in the sharded exact-DPOP leg",
+    )
+    ap.add_argument(
         "--harness-vars", type=int, default=2000,
         help="variables in the harness sync-overhead bench's "
         "convergence-bound MGM instance (edges = 3x)",
@@ -1925,8 +2105,8 @@ def main():
         "--only",
         choices=["all", "maxsum", "dpop", "convergence", "convergence2",
                  "local", "scalefree", "mixed", "sharded",
-                 "sharded-inner", "probe", "batch", "harness", "serve",
-                 "churn"],
+                 "sharded-inner", "dpop-sharded", "dpop-sharded-inner",
+                 "probe", "batch", "harness", "serve", "churn"],
         default="all",
     )
     # watchdog covers the FULL run: the wholesweep DPOP kernel compile
@@ -1940,6 +2120,10 @@ def main():
 
     if args.only == "sharded-inner":
         bench_sharded_inner(args)
+        return
+
+    if args.only == "dpop-sharded-inner":
+        bench_dpop_sharded_inner(args)
         return
 
     if args.stretch:
@@ -2219,9 +2403,23 @@ def main():
         except Exception as e:
             extra["sharded_error"] = repr(e)
 
+    if args.only in ("all", "dpop-sharded"):
+        # sharded exact DPOP (ISSUE 9): util tables tiled over the
+        # 8-device CPU mesh; the headline is the sweep wall on an
+        # instance whose largest joint table exceeds the simulated
+        # per-device budget, with the bitmatch flag and bytes-shipped
+        # scorecard riding along (BENCHREF.md "Sharded exact DPOP")
+        try:
+            sh = bench_dpop_sharded_subprocess(args)
+            extra[sh["metric"]] = sh["value"]
+            extra.update({k: v for k, v in sh.items()
+                          if k.startswith("dpop_sharded_")})
+        except Exception as e:
+            extra["dpop_sharded_error"] = repr(e)
+
     if args.only in ("dpop", "local", "convergence", "convergence2",
-                     "scalefree", "mixed", "sharded", "probe", "batch",
-                     "harness", "serve", "churn") \
+                     "scalefree", "mixed", "sharded", "dpop-sharded",
+                     "probe", "batch", "harness", "serve", "churn") \
             and not value:
         # single-part run: promote the part's headline measurement (not
         # config constants like stretch_vars) to the primary slot

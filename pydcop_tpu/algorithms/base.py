@@ -71,6 +71,12 @@ class SolveResult:
     #: applied, headroom claims, retraces, time-to-recover), None
     #: unless the solve ran through a warm-repair engine
     repair: Optional[Dict[str, Any]] = None
+    #: exact-inference engine scorecard (ops/dpop_shard): for the
+    #: separator-sharded sweep the tiling layout + pruned wire bytes,
+    #: for the mini-bucket fallback the i-bound and the
+    #: lower/upper-bound sandwich around the (unreached) optimum; None
+    #: for every other solver
+    dpop: Optional[Dict[str, Any]] = None
 
     def metrics(self) -> Dict[str, Any]:
         out = {
@@ -89,6 +95,8 @@ class SolveResult:
             out["shard"] = dict(self.shard)
         if self.repair is not None:
             out["repair"] = dict(self.repair)
+        if self.dpop is not None:
+            out["dpop"] = dict(self.dpop)
         return out
 
 

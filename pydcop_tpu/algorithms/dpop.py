@@ -45,10 +45,22 @@ GRAPH_TYPE = "pseudotree"
 # addition: "auto" picks the level-scan sweep (compiles in seconds);
 # "wholesweep" forces the single-launch pallas kernel (~50x faster per
 # sweep on width-1 trees but minutes of one-time Mosaic compile — worth
-# it for repeated same-topology solves, see ops/pallas_dpop.py).
+# it for repeated same-topology solves, see ops/pallas_dpop.py);
+# "sharded" forces the separator-tiled mesh sweep (util tables split
+# over the devices — docs/performance.rst "Sharded exact inference");
+# "minibucket" the bounded approximation.  `budget_mb` is the
+# PER-DEVICE table budget the auto tier routes on (0 = engine caps),
+# `i_bound` the mini-bucket width bound (0 = off), `prune` toggles the
+# cross-edge-consistency wire pruning, `shards` caps the mesh width
+# (0 = all local devices).
 algo_params = [
-    AlgoParameterDef("engine", "str", ["auto", "sweep", "wholesweep"],
-                     "auto"),
+    AlgoParameterDef("engine", "str",
+                     ["auto", "sweep", "wholesweep", "sharded",
+                      "minibucket"], "auto"),
+    AlgoParameterDef("budget_mb", "float", None, 0.0),
+    AlgoParameterDef("i_bound", "int", None, 0),
+    AlgoParameterDef("prune", "bool", None, True),
+    AlgoParameterDef("shards", "int", None, 0),
 ]
 
 
@@ -57,7 +69,9 @@ class DpopSolver:
 
     #: refuse UTIL tables beyond this many entries: DPOP is exponential in
     #: the pseudo-tree's induced width, and a clear error beats an
-    #: out-of-memory hang on high-width graphs (use local search there)
+    #: out-of-memory hang on high-width graphs.  The refusal is typed
+    #: (ops/dpop_shard.UtilTableTooLarge) and only fires after the
+    #: sharded/mini-bucket routes are exhausted (engine="auto")
     max_table_entries: int = 100_000_000
 
     def __init__(self, dcop: DCOP, tree: Optional[ComputationPseudoTree] =
@@ -68,10 +82,19 @@ class DpopSolver:
         self.infinity = DEFAULT_INFINITY
         self.msg_count = 0
         self.msg_size = 0
-        self.engine = (
-            algo_def.params.get("engine", "auto")
-            if algo_def is not None and algo_def.params else "auto"
+        params = (
+            algo_def.params
+            if algo_def is not None and algo_def.params else {}
         )
+        self.engine = params.get("engine", "auto")
+        budget_mb = float(params.get("budget_mb") or 0.0)
+        #: per-DEVICE byte budget for util tables (None = engine caps)
+        self.budget_bytes = (
+            int(budget_mb * 2**20) if budget_mb > 0 else None
+        )
+        self.i_bound = int(params.get("i_bound") or 0)
+        self.prune = bool(params.get("prune", True))
+        self.shards = int(params.get("shards") or 0)
 
     def _node_constraint_table(self, node: PseudoTreeNode):
         """Join the node's own constraints + its variable costs into one
@@ -101,19 +124,44 @@ class DpopSolver:
 
     def run(self, cycles=None, timeout=None, collect_cycles=False,
             **_kwargs) -> SolveResult:
-        # three engine tiers: (1) global batched sweep — one lax.scan
-        # per phase, everything padded to the tree-wide max separator
+        # engine tiers: (1) global batched sweep — one lax.scan per
+        # phase, everything padded to the tree-wide max separator
         # width; (2) per-level sweep — each level padded to ITS OWN
         # width, one jitted batched step per level (survives a single
-        # wide hub); (3) per-node hybrid loop (anything else)
+        # wide hub); (3) per-node hybrid loop; and, when the tables
+        # exceed one device (planner byte estimate vs budget_mb or the
+        # engine caps), (4) the separator-SHARDED mesh sweep and (5)
+        # the bounded mini-bucket fallback (i_bound > 0) — a typed
+        # UtilTableTooLarge only after all of those are exhausted
         import logging
 
+        from pydcop_tpu.ops.dpop_shard import (
+            UtilTableTooLarge,
+            estimate_sweep_bytes,
+        )
         from pydcop_tpu.ops.dpop_sweep import (
             compile_sweep,
             compile_sweep_perlevel,
         )
 
         log = logging.getLogger("pydcop_tpu.dpop")
+        if self.engine == "minibucket":
+            return self._run_minibucket()
+        if self.engine == "sharded":
+            return self._run_sharded()
+        if self.engine == "auto" and self.budget_bytes is not None:
+            est = estimate_sweep_bytes(self.tree)
+            if est["bytes"] > self.budget_bytes:
+                # the single-device sweep would blow the per-device
+                # budget: tile it over the mesh; degrade to mini-bucket
+                # bounds when even a tile is too big and an i_bound
+                # permits it
+                try:
+                    return self._run_sharded()
+                except UtilTableTooLarge:
+                    if self.i_bound > 0:
+                        return self._run_minibucket()
+                    raise
         try:
             plan = compile_sweep(self.tree, self.dcop, self.mode)
             perlevel = False
@@ -135,6 +183,17 @@ class DpopSolver:
                     "batched sweep EXECUTION failed; re-solving with "
                     "the per-node path"
                 )
+        if self.engine == "auto":
+            est = estimate_sweep_bytes(self.tree)
+            if est["max_node_entries"] > self.max_table_entries:
+                # both batched tiers refused AND the per-node path
+                # would blow its table cap: route instead of refusing
+                try:
+                    return self._run_sharded()
+                except UtilTableTooLarge:
+                    if self.i_bound > 0:
+                        return self._run_minibucket()
+                    raise
         return self._run_pernode()
 
     def _run_sweep(self, plan, perlevel: bool = False) -> SolveResult:
@@ -204,12 +263,24 @@ class DpopSolver:
             assign_idx, _ = (
                 run_sweep_perlevel(plan) if perlevel else run_sweep(plan)
             )
+        return self._finish_sweep_result(
+            assign_idx, plan.gid_to_name, plan.sep_size, t0
+        )
+
+    def _finish_sweep_result(self, assign_idx, gid_to_name, sep_size,
+                             t0, shard=None, dpop=None) -> SolveResult:
+        """Shared tail of every batched engine (single-device sweeps
+        AND the separator-sharded mesh sweep): assignment from the gid
+        vector, min-cost fill for variables absent from a partial
+        tree, and the UTIL/VALUE message metrics (parity with
+        DpopMessage.size, ref dpop.py:98-104): one UTIL message per
+        non-root node, sized by its true (unpadded) separator domains;
+        VALUE messages as in the per-node path."""
+        tree = self.tree
         assignment = {}
-        for gidx, name in enumerate(plan.gid_to_name):
+        for gidx, name in enumerate(gid_to_name):
             v = tree.computation(name).variable
             assignment[name] = v.domain[int(assign_idx[gidx])]
-        # variables absent from the (possibly partial) tree: min-cost
-        # value, as in the per-node path
         for name, v in self.dcop.variables.items():
             if name not in assignment:
                 costs = v.cost_vector()
@@ -218,9 +289,6 @@ class DpopSolver:
                     np.argmax(costs)
                 )
                 assignment[name] = v.domain[idx]
-        # message metrics (parity with DpopMessage.size, ref dpop.py:98-104):
-        # one UTIL message per non-root node, sized by its true (unpadded)
-        # separator domains; VALUE messages as in the per-node path
         self.msg_count = 0
         self.msg_size = 0
         n_assigned = 0
@@ -229,7 +297,7 @@ class DpopSolver:
                 n_assigned += 1
                 if node.parent is not None:
                     self.msg_count += 1
-                    self.msg_size += plan.sep_size[node.name]
+                    self.msg_size += sep_size[node.name]
                 self.msg_count += len(node.children)
                 self.msg_size += len(node.children) * max(1, n_assigned)
         violation, cost = self.dcop.solution_cost(assignment, self.infinity)
@@ -242,6 +310,112 @@ class DpopSolver:
             msg_count=self.msg_count,
             msg_size=float(self.msg_size),
             time=perf_counter() - t0,
+            shard=shard,
+            dpop=dpop,
+        )
+
+    def _run_sharded(self) -> SolveResult:
+        """Separator-sharded exact sweep: util tables tiled over the
+        mesh along separator dimensions, CEC-pruned wire exchange
+        (docs/performance.rst "Sharded exact inference")."""
+        import jax
+
+        from pydcop_tpu.ops.dpop_shard import plan_tiled_sweep
+        from pydcop_tpu.parallel.dpop_mesh import ShardedSepDpop
+        from pydcop_tpu.runtime.events import send_dpop
+
+        t0 = perf_counter()
+        n = self.shards or len(jax.devices())
+        plan = plan_tiled_sweep(
+            self.tree, self.dcop, self.mode, n_shards=n,
+            budget_bytes=self.budget_bytes, prune=self.prune,
+        )
+        dpop_info = plan.info()
+        send_dpop("shard.plan", dpop_info)
+        engine = ShardedSepDpop(plan)
+        assign_idx = engine.run()
+        self.last_engine = "sharded"
+        shard = engine.comm_stats()
+        res = self._finish_sweep_result(
+            assign_idx, plan.base.gid_to_name, plan.base.sep_size, t0,
+            shard=shard, dpop=dpop_info,
+        )
+        send_dpop("shard.sweep.done", {
+            "time": res.time,
+            "n_shards": plan.n_shards,
+            "wire_bytes_pruned": dpop_info["wire_bytes_pruned"],
+            "wire_bytes_dense": dpop_info["wire_bytes_dense"],
+            "cost": res.cost,
+        })
+        return res
+
+    def _run_minibucket(self) -> SolveResult:
+        """Bounded mini-bucket fallback: buckets split at ``i_bound``,
+        result carries the lower ≤ optimum ≤ upper sandwich in
+        metrics()["dpop"] instead of refusing the instance."""
+        from pydcop_tpu.ops.dpop_shard import (
+            minibucket_solve,
+            suggest_i_bound,
+        )
+        from pydcop_tpu.runtime.events import send_dpop
+
+        t0 = perf_counter()
+        i_bound = self.i_bound
+        if i_bound <= 0:
+            # engine forced without an explicit bound: pick the widest
+            # bucket the budget (or engine cap) fits
+            Dmax = max(
+                (len(v.domain) for v in self.dcop.variables.values()),
+                default=2,
+            )
+            i_bound = suggest_i_bound(Dmax, self.budget_bytes)
+        assignment_idx, relax, info = minibucket_solve(
+            self.tree, self.dcop, self.mode, i_bound
+        )
+        self.last_engine = "minibucket"
+        assignment = {
+            name: self.tree.computation(name).variable.domain[idx]
+            for name, idx in assignment_idx.items()
+        }
+        for name, v in self.dcop.variables.items():
+            if name not in assignment:
+                costs = v.cost_vector()
+                idx = int(
+                    np.argmin(costs) if self.mode == "min" else
+                    np.argmax(costs)
+                )
+                assignment[name] = v.domain[idx]
+        violation, cost = self.dcop.solution_cost(
+            assignment, self.infinity
+        )
+        # the relaxation bounds the optimum from below (min) / above
+        # (max); the decoded assignment's true cost from the other side
+        lower = relax if self.mode == "min" else cost
+        upper = cost if self.mode == "min" else relax
+        dpop_info = dict(
+            info,
+            lower_bound=lower,
+            upper_bound=upper,
+            gap=max(0.0, upper - lower),
+        )
+        send_dpop("minibucket.bounds", {
+            "i_bound": i_bound,
+            "lower_bound": lower,
+            "upper_bound": upper,
+            "gap": dpop_info["gap"],
+        })
+        self.msg_count = info["msg_count"]
+        self.msg_size = float(info["msg_entries"])
+        return SolveResult(
+            status="FINISHED",
+            assignment=assignment,
+            cost=cost,
+            violation=violation,
+            cycle=self.tree.height + 1,
+            msg_count=self.msg_count,
+            msg_size=self.msg_size,
+            time=perf_counter() - t0,
+            dpop=dpop_info,
         )
 
     def _run_pernode(self) -> SolveResult:
@@ -264,12 +438,24 @@ class DpopSolver:
                     out_dims = dims + [d for d in cdims if d[0] not in have]
                     est = table_size(out_dims)
                     if est > self.max_table_entries:
-                        raise MemoryError(
-                            f"DPOP UTIL table at {node.name} would need "
-                            f"{est:.2e} entries (separator too wide — "
-                            f"induced width of this graph is too high for "
-                            f"exact inference; use a local-search or B&B "
-                            f"algorithm)"
+                        from pydcop_tpu.ops.dpop_shard import (
+                            UtilTableTooLarge,
+                            suggest_i_bound,
+                        )
+
+                        Dmax = max(sz for _, sz in out_dims)
+                        raise UtilTableTooLarge(
+                            estimated_bytes=est * 4,
+                            budget_bytes=self.budget_bytes,
+                            n_shards=1,
+                            suggested_i_bound=suggest_i_bound(
+                                Dmax, self.budget_bytes
+                            ),
+                            detail=(
+                                f"UTIL table at {node.name} needs "
+                                f"{est:.2e} entries in the per-node "
+                                f"path (induced width too high)"
+                            ),
                         )
                     t, dims = join_t(t, dims, ct, cdims)
                 joined[node.name] = (t, dims)

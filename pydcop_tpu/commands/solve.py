@@ -84,6 +84,24 @@ def set_parser(subparsers):
                         default=0.5,
                         help="auto-policy cut-fraction threshold above "
                         "which the dense psum is kept (default 0.5)")
+    # sharded exact inference (docs/performance.rst "Sharded exact
+    # inference") — DPOP only; shorthand for the matching -p algo params
+    parser.add_argument("--dpop-budget-mb", type=float, default=None,
+                        help="per-DEVICE byte budget for DPOP util "
+                        "tables: instances whose tables exceed it are "
+                        "tiled over the mesh along separator dimensions "
+                        "(engine auto), and a typed UtilTableTooLarge "
+                        "with a suggested --i-bound/shard count is "
+                        "raised when even a tile is too big")
+    parser.add_argument("--i-bound", type=int, default=None,
+                        help="mini-bucket width bound for DPOP: when "
+                        "exact inference is out of budget, buckets are "
+                        "split at this many separator variables and "
+                        "metrics['dpop'] reports the lower/upper bound "
+                        "sandwich instead of refusing")
+    parser.add_argument("--dpop-no-prune", action="store_true",
+                        help="disable the cross-edge-consistency wire "
+                        "pruning of the sharded DPOP sweep")
     # warm repair (docs/resilience.rst "Warm repair and agent churn")
     parser.add_argument("--headroom", type=float, default=None,
                         help="build the WARM-repair engine with this "
@@ -116,6 +134,23 @@ def run_cmd(args):
         output_metrics({"status": "ERROR", "error": str(e)}, args.output)
         return 1
     algo_params = parse_algo_params(args.algo_params)
+    if args.algo == "dpop":
+        # flag shorthands for the sharded/mini-bucket engine params
+        if args.dpop_budget_mb is not None:
+            algo_params.setdefault("budget_mb", args.dpop_budget_mb)
+        if args.i_bound is not None:
+            algo_params.setdefault("i_bound", args.i_bound)
+        if args.dpop_no_prune:
+            algo_params["prune"] = False
+    elif (args.dpop_budget_mb is not None or args.i_bound is not None
+          or args.dpop_no_prune):
+        output_metrics(
+            {"status": "ERROR",
+             "error": "--dpop-budget-mb/--i-bound/--dpop-no-prune only "
+             "apply to -a dpop"},
+            args.output,
+        )
+        return 1
 
     # no silent no-op: a reference user benchmarking thread vs process
     # would otherwise get identical numbers unexplained

@@ -127,6 +127,38 @@ class ComputationPseudoTree(ComputationGraph):
             levels[self._depths[n.name]].append(n)
         return levels
 
+    def separators(self) -> Dict[str, Set[str]]:
+        """Bottom-up separator sets: ``sep(n) = (scope of n's own
+        constraints ∪ children's separators) - {n}``; every member is an
+        ancestor of ``n``.  This is the shape oracle of the whole DPOP
+        engine family — ``|sep(n)|`` is the UTIL-table width at ``n``,
+        and the sweep compilers (ops/dpop_sweep), the separator-tiling
+        planner (ops/dpop_shard) and the byte estimators all derive
+        their layouts from it."""
+        sep: Dict[str, Set[str]] = {}
+        for lv in reversed(self.nodes_by_depth()):
+            for node in lv:
+                s: Set[str] = set()
+                for c in node.constraints:
+                    s.update(
+                        v.name for v in c.dimensions
+                        if v.name in self._depths
+                    )
+                for ch in node.children:
+                    s.update(sep[ch])
+                s.discard(node.name)
+                sep[node.name] = s
+        return sep
+
+    @property
+    def induced_width(self) -> int:
+        """Max separator size over the tree — DPOP's table exponent
+        (tables hold ``D^(induced_width+1)`` entries at the widest
+        node)."""
+        return max(
+            (len(s) for s in self.separators().values()), default=0
+        )
+
 
 def _adjacency(
     variables: List[Variable], constraints: List[Constraint]

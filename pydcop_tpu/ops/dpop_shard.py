@@ -1,0 +1,600 @@
+"""Separator-tiling planner for the sharded exact DPOP sweep (ISSUE 9).
+
+DPOP's UTIL tables grow as ``D^(w+1)`` with separator width ``w`` — the
+one axis the node-row sharding of ``parallel.dpop_mesh.ShardedDpopSweep``
+never touches, which is why the framework's strongest exact engine was
+still hard-capped by the largest joint table fitting ONE device.  This
+module is the host-side half of the fix:
+
+* :func:`plan_tiled_sweep` compiles a pseudo-tree + DCOP into a
+  :class:`DpopShardPlan`: the per-level sweep plan of
+  ``ops.dpop_sweep.compile_sweep_perlevel`` (budgets relaxed ``n``-fold,
+  because a table split ``n`` ways may be ``n`` times the single-device
+  cap) plus, per level, a **tiling** of the flat separator space — each
+  device owns a contiguous block of ``Smp/n`` separator slots, i.e. the
+  split dimensions are the level's leading canonical separator digits
+  (the same tiling discipline GPU bucket elimination uses to fit
+  partition tables in device memory, arXiv:1608.05288).  Every node's
+  table lives as a ``[B, D, Smp/n]`` tile per device; nothing holds a
+  whole table anywhere.
+* Before a UTIL message ships, a **cross-edge-consistency pass**
+  (arXiv:1909.06537) prunes separator rows that back-edge constraints
+  make infeasible: a host-side boolean sweep mirrors the UTIL recursion
+  on feasibility masks (an entry is feasible iff its local table slot is
+  finite AND every child's aligned message entry is), and the wire
+  carries only the feasible entries — the receiver statically re-fills
+  pruned slots with the ``±BIG`` sentinel.  Pruning is sound (and the
+  sharded sweep stays bit-identical to the single-device one on every
+  separator context that admits a feasible assignment) when hard
+  violations share the objective's sign and finite costs cannot
+  accumulate anywhere near ``BIG`` — :func:`prune_preconditions` checks
+  both and the planner silently disables pruning otherwise.
+* When even the sharded tile exceeds the per-device budget,
+  :func:`minibucket_solve` degrades gracefully instead of refusing:
+  buckets wider than a user-set ``i_bound`` are split mini-bucket style
+  (each part projected separately), yielding a relaxation bound, a
+  greedy assignment and therefore a bound *sandwich*
+  ``lower ≤ optimum ≤ upper`` reported in
+  ``SolveResult.metrics()["dpop"]``.
+* :exc:`UtilTableTooLarge` is the typed refusal that replaces the old
+  bare ``MemoryError``: it carries the planner's byte estimate and a
+  suggested ``--i-bound`` / shard count so the caller can act on it.
+
+The device-side executor lives in ``parallel.dpop_mesh.ShardedSepDpop``.
+Pure numpy here; consumed host-side at plan time.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pydcop_tpu.ops.dpop_sweep import (
+    BIG,
+    DpopPerLevelPlan,
+    MAX_PLAN_ENTRIES,
+    MAX_TABLE_ENTRIES_PER_NODE,
+    compile_sweep_perlevel,
+)
+
+#: wire-width quantum: packed feasible-entry vectors are padded to a
+#: multiple of this so near-identical prune counts reuse compiled steps
+WIRE_QUANTUM = 8
+
+#: |value| below this is classified "feasible" by the static pruning
+#: sweep; everything the sweep prunes is provably >= this (see
+#: prune_preconditions)
+FEAS_THRESHOLD = BIG / 4.0
+
+
+class UtilTableTooLarge(MemoryError):
+    """A DPOP UTIL table exceeds every engine's memory budget.
+
+    Replaces the blunt ``max_table_entries`` ValueError/MemoryError:
+    carries the planner's byte estimate plus actionable suggestions —
+    how many shards would fit the sharded sweep, and an ``i_bound``
+    under which the mini-bucket fallback fits — so callers (and error
+    messages) can route instead of just refusing.
+    """
+
+    def __init__(self, estimated_bytes: int,
+                 budget_bytes: Optional[int] = None,
+                 n_shards: int = 1,
+                 suggested_shards: int = 0,
+                 suggested_i_bound: int = 0,
+                 detail: str = ""):
+        self.estimated_bytes = int(estimated_bytes)
+        self.budget_bytes = budget_bytes
+        self.n_shards = n_shards
+        self.suggested_shards = int(suggested_shards)
+        self.suggested_i_bound = int(suggested_i_bound)
+        budget = (
+            f"{budget_bytes / 2**20:.1f} MiB/device budget"
+            if budget_bytes else "the engine caps"
+        )
+        hints = []
+        if suggested_shards > n_shards:
+            hints.append(f"~{suggested_shards} shards would fit the "
+                         f"tiled sweep")
+        if suggested_i_bound:
+            hints.append(f"--i-bound {suggested_i_bound} fits the "
+                         f"mini-bucket fallback (bounds, not exact)")
+        hint = ("; ".join(hints)) or "use a local-search algorithm"
+        super().__init__(
+            f"DPOP util tables need ~{estimated_bytes / 2**20:.1f} MiB "
+            f"against {budget} on {n_shards} shard(s){': ' + detail if detail else ''} — {hint}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# byte estimation (planner-driven: from separators only, no tables built)
+# ---------------------------------------------------------------------------
+
+
+def _level_shapes(tree) -> Tuple[List[int], List[int], int, int]:
+    """(B_l, W_l, Dmax, max_true_entries) per level from the tree's
+    separator sets — the cheap shape pass every routing decision uses
+    before any table is materialized."""
+    levels = tree.nodes_by_depth()
+    if not levels or not levels[0]:
+        return [], [], 1, 0
+    nodes_flat = [n for lv in levels for n in lv]
+    Dmax = max(len(n.variable.domain) for n in nodes_flat)
+    sep = tree.separators()
+    by_name = {n.name: n for n in nodes_flat}
+    W_l = [
+        max(max((len(sep[n.name]) for n in lv), default=0), 1)
+        for lv in levels
+    ]
+    B_l = [len(lv) for lv in levels]
+    max_true = 0
+    for name, s in sep.items():
+        e = len(by_name[name].variable.domain)
+        for m in s:
+            e *= len(by_name[m].variable.domain)
+        max_true = max(max_true, e)
+    return B_l, W_l, Dmax, max_true
+
+
+def estimate_sweep_bytes(tree) -> Dict[str, int]:
+    """Planner-driven single-device byte estimate of the per-level
+    sweep: stored padded tables + the align/aligned intermediates, f32.
+    ``max_node_entries`` is the TRUE (unpadded) largest joint table —
+    the number the old ``max_table_entries`` refusal compared."""
+    B_l, W_l, Dmax, max_true = _level_shapes(tree)
+    S_l = [Dmax ** (w + 1) for w in W_l]
+    entries = sum(b * s for b, s in zip(B_l, S_l))
+    entries += sum(B_l[i] * S_l[i - 1] for i in range(1, len(B_l)))
+    return {
+        "bytes": entries * 4,
+        "entries": entries,
+        "max_node_entries": max_true,
+        "max_level_table_entries": max(S_l, default=0),
+        "Dmax": Dmax,
+    }
+
+
+def suggest_i_bound(Dmax: int, budget_bytes: Optional[int]) -> int:
+    """Largest ``i`` such that one mini-bucket table
+    (``Dmax^(i+1)`` f32 entries) fits the budget (or the single-device
+    engine cap when unbudgeted); at least 1."""
+    cap_entries = (
+        budget_bytes // 4 if budget_bytes else MAX_TABLE_ENTRIES_PER_NODE
+    )
+    i = 1
+    d = max(2, Dmax)
+    while d ** (i + 2) <= max(cap_entries, d * d):
+        i += 1
+    return i
+
+
+# ---------------------------------------------------------------------------
+# cross-edge-consistency pruning (static feasibility sweep)
+# ---------------------------------------------------------------------------
+
+
+def prune_preconditions(dcop) -> Tuple[bool, str]:
+    """Check the soundness preconditions of the static pruning sweep:
+
+    * hard-violation costs share the objective's sign (min: no entry
+      ``<= -BIG/2``; max: none ``>= +BIG/2``) — otherwise a "big"
+      addend could cancel instead of dominate;
+    * the sum of every table's largest finite magnitude stays far from
+      the feasibility threshold — otherwise legitimately-expensive
+      contexts would be misclassified as infeasible.
+
+    Returns ``(ok, reason)``; the planner disables pruning (it never
+    fails the solve) when ``ok`` is False.
+    """
+    sign = 1.0 if dcop.objective == "min" else -1.0
+    bound = 0.0
+    ext = {ev.name: ev.value for ev in dcop.external_variables.values()}
+    for v in dcop.variables.values():
+        cv = np.asarray(v.cost_vector(), dtype=np.float64)
+        if cv.size:
+            wrong = cv * sign <= -BIG / 2
+            if bool(wrong.any()):
+                return False, "unary cost with a wrong-signed hard value"
+            finite = cv[np.abs(cv) < BIG / 2]
+            bound += float(np.abs(finite).max()) if finite.size else 0.0
+    for c in dcop.constraints.values():
+        if any(n in ext for n in c.scope_names):
+            c = c.slice(ext)
+        t = np.asarray(c.to_tensor(), dtype=np.float64)
+        wrong = t * sign <= -BIG / 2
+        if bool(wrong.any()):
+            return False, (
+                f"constraint {c.name!r} has a wrong-signed hard value"
+            )
+        finite = t[np.abs(t) < BIG / 2]
+        bound += float(np.abs(finite).max()) if finite.size else 0.0
+    if bound >= BIG / 8:
+        return False, (
+            f"finite costs can accumulate to {bound:.3g} — too close to "
+            f"the BIG sentinel for a sound feasibility classification"
+        )
+    return True, ""
+
+
+def _feasibility_masks(base: DpopPerLevelPlan) -> List[np.ndarray]:
+    """Per-level UTIL-message feasibility masks ``mfeas[li] [B_li,
+    Sm_li]`` from a bottom-up boolean sweep mirroring the UTIL
+    recursion: a table slot is feasible iff its local entry is finite
+    AND every child's aligned message entry is; a message entry is
+    feasible iff SOME own-variable value is.  Exactly the cross-edge
+    consistency of arXiv:1909.06537 — a back-edge (pseudo-parent)
+    constraint's hard entries land in the deepest node's local table
+    and propagate up as infeasible separator rows."""
+    sign = 1.0 if base.mode == "min" else -1.0
+    L = len(base.levels)
+    Dmax = base.Dmax
+    mfeas: List[Optional[np.ndarray]] = [None] * L
+    for li in range(L - 1, -1, -1):
+        lv = base.levels[li]
+        B, S = lv.local.shape
+        tfeas = (lv.local * sign) < FEAS_THRESHOLD
+        if li < L - 1:
+            child = base.levels[li + 1]
+            mf_child = mfeas[li + 1]
+            rows = np.arange(child.align_idx.shape[0])[:, None]
+            aligned = mf_child[rows, child.align_idx]  # [B_child, S]
+            acc = np.ones((B, S), dtype=np.uint8)
+            np.minimum.at(
+                acc, child.parent_slot, aligned.astype(np.uint8)
+            )
+            tfeas &= acc.astype(bool)
+        mfeas[li] = tfeas.reshape(B, Dmax, S // Dmax).any(axis=1)
+    return mfeas  # type: ignore[return-value]
+
+
+# ---------------------------------------------------------------------------
+# the tiling plan
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LevelTiling:
+    """One level's separator-space tiling + its UTIL-message wire.
+
+    The level's flat separator space ``Sm = D**W`` is padded to ``Smp``
+    (a multiple of ``n_shards``) and split into contiguous blocks of
+    ``Smb = Smp / n`` — device ``d`` owns separator slots
+    ``[d*Smb, (d+1)*Smb)``, i.e. the split dimensions are the
+    ``split_digits`` leading canonical separator digits.  The wire
+    arrays compile the pruned message exchange: entry ``k`` of the wire
+    is message slot ``(b, j)``; exactly one device (``j // Smb``) has a
+    valid contribution, so one masked-gather + ``psum`` reconstructs
+    the packed wire bit-exactly, and ``unpack_idx`` scatters it into a
+    sentinel-filled full-message buffer on every device.
+    """
+
+    W: int
+    Sm: int            # true flat separator entries (D**W)
+    Smp: int           # padded to a multiple of n_shards
+    Smb: int           # per-device block width
+    split_digits: int  # leading separator digits consumed by the split
+    wire_k: int        # padded wire width (multiple of WIRE_QUANTUM)
+    n_feasible: int    # true (unpruned) entries on the wire
+    n_total: int       # B * Sm — what an unpruned wire would carry
+    # stacked per-shard statics (leading axis = shard, rides P(AXIS)):
+    gather_idx: Optional[np.ndarray] = None    # [n, wire_k] i32
+    gather_valid: Optional[np.ndarray] = None  # [n, wire_k] f32 0/1
+    unpack_idx: Optional[np.ndarray] = None    # [wire_k] i32
+
+
+@dataclasses.dataclass
+class DpopShardPlan:
+    """Host-compiled schedule for the separator-sharded UTIL/VALUE
+    sweep (executed by ``parallel.dpop_mesh.ShardedSepDpop``)."""
+
+    base: DpopPerLevelPlan
+    n_shards: int
+    tilings: List[LevelTiling]     # per level, top-down like base.levels
+    prune: bool
+    prune_disabled_reason: str
+    bytes_per_device: int          # stored tiles + align + peak transient
+    wire_entries_pruned: int       # per-sweep wire payload (entries)
+    wire_entries_dense: int        # what an unpruned wire would be
+    budget_bytes: Optional[int]
+
+    @property
+    def pruned_fraction(self) -> float:
+        if not self.wire_entries_dense:
+            return 0.0
+        return 1.0 - self.wire_entries_pruned / self.wire_entries_dense
+
+    def info(self) -> Dict[str, object]:
+        """The ``metrics()["dpop"]`` payload of a sharded solve."""
+        return {
+            "engine": "sharded",
+            "n_shards": self.n_shards,
+            "levels": len(self.tilings),
+            "split_digits": [t.split_digits for t in self.tilings],
+            "bytes_per_device": self.bytes_per_device,
+            "budget_bytes": self.budget_bytes,
+            "wire_bytes_pruned": self.wire_entries_pruned * 4,
+            "wire_bytes_dense": self.wire_entries_dense * 4,
+            "pruned_fraction": round(self.pruned_fraction, 6),
+            "prune": self.prune,
+        }
+
+
+def _pad_to(x: int, q: int) -> int:
+    return -(-x // q) * q
+
+
+def _level_tiling(Dmax: int, W: int, n: int) -> Tuple[int, int, int, int]:
+    """(Sm, Smp, Smb, split_digits) for one level."""
+    Sm = Dmax ** W
+    Smp = _pad_to(Sm, n)
+    Smb = Smp // n
+    # how many leading canonical separator digits the block split
+    # consumes: blocks of width Smb fix the digits above stride Smb
+    split_digits = 0
+    stride = Sm
+    while split_digits < W and stride > Smb:
+        stride //= Dmax
+        split_digits += 1
+    return Sm, Smp, Smb, split_digits
+
+
+def plan_tiled_sweep(
+    tree,
+    dcop,
+    mode: str = "min",
+    n_shards: int = 1,
+    budget_bytes: Optional[int] = None,
+    prune: bool = True,
+) -> DpopShardPlan:
+    """Compile the separator-sharded sweep plan, or raise
+    :exc:`UtilTableTooLarge` when even the ``n_shards``-way tiling
+    exceeds ``budget_bytes`` per device (or the n-fold-relaxed engine
+    caps when unbudgeted).  The shape check runs BEFORE any table is
+    built, so refusing is cheap."""
+    n = max(1, int(n_shards))
+    B_l, W_l, Dmax, _ = _level_shapes(tree)
+    if not B_l:
+        raise ValueError("empty pseudo-tree")
+    L = len(B_l)
+    S_l = [Dmax ** (w + 1) for w in W_l]
+
+    # ---- shape pass: per-device bytes from separators alone
+    stored = 0   # table tiles, f32
+    align = 0    # align-index tiles, i32
+    transient = 0
+    for li in range(L):
+        _Sm, _Smp, Smb, _sd = _level_tiling(Dmax, W_l[li], n)
+        stored += B_l[li] * Dmax * Smb * 4
+        if li > 0:
+            _, _, Smb_p, _ = _level_tiling(Dmax, W_l[li - 1], n)
+            align += B_l[li] * Dmax * Smb_p * 4
+            # peak transient: the reconstructed child message + its
+            # aligned block while combining into the parent level
+            tr = (B_l[li] * _pad_to(Dmax ** W_l[li], n) * 4
+                  + B_l[li] * Dmax * Smb_p * 4)
+            transient = max(transient, tr)
+    est_per_device = stored + align + transient
+
+    cap = budget_bytes if budget_bytes else (
+        min(n * MAX_PLAN_ENTRIES, 4 * MAX_PLAN_ENTRIES) * 4
+    )
+    single = estimate_sweep_bytes(tree)
+    if est_per_device > cap:
+        raise UtilTableTooLarge(
+            estimated_bytes=single["bytes"],
+            budget_bytes=budget_bytes,
+            n_shards=n,
+            suggested_shards=(
+                math.ceil(single["bytes"] / budget_bytes)
+                if budget_bytes else 0
+            ),
+            suggested_i_bound=suggest_i_bound(Dmax, budget_bytes),
+            detail=(f"~{est_per_device / 2**20:.1f} MiB/device even "
+                    f"tiled {n}-way"),
+        )
+    # per-node table cap relaxed n-fold: one node's table is split n ways
+    base = compile_sweep_perlevel(
+        tree, dcop, mode,
+        max_table_entries=n * MAX_TABLE_ENTRIES_PER_NODE,
+        max_plan_entries=max(
+            n * MAX_PLAN_ENTRIES,
+            sum(b * s for b, s in zip(B_l, S_l))
+            + sum(B_l[i] * S_l[i - 1] for i in range(1, L)),
+        ),
+    )
+    if base is None:
+        raise UtilTableTooLarge(
+            estimated_bytes=single["bytes"],
+            budget_bytes=budget_bytes,
+            n_shards=n,
+            suggested_i_bound=suggest_i_bound(Dmax, budget_bytes),
+            detail="per-level compile refused the tiled form",
+        )
+
+    # ---- pruning feasibility sweep (host, boolean)
+    reason = ""
+    if prune:
+        ok, reason = prune_preconditions(dcop)
+        prune = ok
+    mfeas = _feasibility_masks(base) if prune else None
+
+    tilings: List[LevelTiling] = []
+    wire_pruned = wire_dense = 0
+    for li, lv in enumerate(base.levels):
+        Sm, Smp, Smb, sd = _level_tiling(Dmax, lv.W, n)
+        t = LevelTiling(
+            W=lv.W, Sm=Sm, Smp=Smp, Smb=Smb, split_digits=sd,
+            wire_k=0, n_feasible=0, n_total=lv.B * Sm,
+        )
+        if li > 0:  # roots send no UTIL message
+            if mfeas is not None:
+                rows, cols = np.nonzero(mfeas[li])
+            else:
+                rows, cols = np.nonzero(
+                    np.ones((lv.B, Sm), dtype=bool)
+                )
+            k_true = rows.size
+            Kw = max(WIRE_QUANTUM, _pad_to(k_true, WIRE_QUANTUM))
+            owner = cols // Smb
+            gi = np.zeros((n, Kw), dtype=np.int32)
+            gv = np.zeros((n, Kw), dtype=np.float32)
+            local_pos = rows * Smb + (cols - owner * Smb)
+            for d in range(n):
+                mine = owner == d
+                gi[d, :k_true][mine] = local_pos[mine]
+                gv[d, :k_true][mine] = 1.0
+            ui = np.full((Kw,), lv.B * Smp, dtype=np.int32)  # dump slot
+            ui[:k_true] = rows * Smp + cols
+            t.gather_idx, t.gather_valid, t.unpack_idx = gi, gv, ui
+            t.wire_k, t.n_feasible = Kw, int(k_true)
+            wire_pruned += int(k_true)
+            wire_dense += t.n_total
+        tilings.append(t)
+
+    return DpopShardPlan(
+        base=base, n_shards=n, tilings=tilings, prune=prune,
+        prune_disabled_reason=reason,
+        bytes_per_device=est_per_device,
+        wire_entries_pruned=wire_pruned,
+        wire_entries_dense=wire_dense,
+        budget_bytes=budget_bytes,
+    )
+
+
+# ---------------------------------------------------------------------------
+# mini-bucket fallback (bounded approximation; host-driven)
+# ---------------------------------------------------------------------------
+
+
+def minibucket_solve(tree, dcop, mode: str = "min", i_bound: int = 2):
+    """Mini-bucket elimination over the pseudo-tree (Dechter & Rish):
+    each node's items (unary + own constraints + child messages) are
+    partitioned into mini-buckets whose separator scope has at most
+    ``i_bound`` variables; each mini-bucket is joined and projected
+    SEPARATELY, so no table ever exceeds ``D^(i_bound+1)`` entries.
+
+    Returns ``(assignment_idx, relax_bound, info)``:
+
+    * ``relax_bound`` — the relaxation value (a LOWER bound of the
+      optimum for min mode, an UPPER bound for max);
+    * ``assignment_idx`` — the greedy top-down decoding (any concrete
+      assignment's true cost bounds the optimum from the other side);
+    * ``info`` — bucket/message accounting (splits, widest kept scope,
+      message counts) for ``metrics()["dpop"]``.
+
+    A single constraint or child message wider than ``i_bound`` forms
+    its own mini-bucket (a table that already exists cannot be split) —
+    the bound degrades gracefully rather than failing.
+    """
+    from pydcop_tpu.ops.dpop_kernels import join_t, slice_t, table_size
+
+    i_bound = max(1, int(i_bound))
+    levels = tree.nodes_by_depth()
+    ext = {ev.name: ev.value for ev in dcop.external_variables.values()}
+
+    incoming: Dict[str, List[tuple]] = {}   # node -> [(table, dims)]
+    buckets_of: Dict[str, List[tuple]] = {}  # node -> joined (t, dims)
+    relax = 0.0
+    n_splits = 0
+    n_msgs = 0
+    msg_entries = 0
+    widest = 0
+
+    for lv in reversed(levels):
+        for node in lv:
+            v = node.variable
+            items: List[tuple] = [(
+                np.asarray(v.cost_vector(), dtype=np.float32),
+                [(v.name, len(v.domain))],
+            )]
+            for c in node.constraints:
+                if any(nm in ext for nm in c.scope_names):
+                    c = c.slice(ext)
+                items.append((
+                    np.asarray(c.to_tensor(), dtype=np.float32),
+                    [(d.name, len(d.domain)) for d in c.dimensions],
+                ))
+            passthrough: List[tuple] = []
+            for t, dims in incoming.pop(node.name, []):
+                if any(nm == v.name for nm, _ in dims):
+                    items.append((t, dims))
+                else:  # scope is strictly above this node: hoist it
+                    passthrough.append((t, dims))
+
+            # greedy first-fit-decreasing on separator scope
+            items.sort(
+                key=lambda it: -len([d for d in it[1]
+                                     if d[0] != v.name])
+            )
+            buckets: List[Tuple[set, List[tuple]]] = []
+            for t, dims in items:
+                sep_scope = {nm for nm, _ in dims if nm != v.name}
+                placed = False
+                for scope, members in buckets:
+                    if len(scope | sep_scope) <= i_bound:
+                        scope |= sep_scope
+                        members.append((t, dims))
+                        placed = True
+                        break
+                if not placed:
+                    buckets.append((set(sep_scope), [(t, dims)]))
+            n_splits += max(0, len(buckets) - 1)
+
+            joined: List[tuple] = []
+            for scope, members in buckets:
+                t, dims = members[0]
+                for t2, dims2 in members[1:]:
+                    t, dims = join_t(t, dims, t2, dims2)
+                widest = max(widest, len(dims))
+                joined.append((np.asarray(t), dims))
+            buckets_of[node.name] = joined
+
+            out: List[tuple] = list(passthrough)
+            for t, dims in joined:
+                axis = [nm for nm, _ in dims].index(v.name)
+                proj = (np.min if mode == "min" else np.max)(t, axis=axis)
+                pdims = [d for d in dims if d[0] != v.name]
+                out.append((proj, pdims))
+            if node.parent is None:
+                for t, dims in out:
+                    # at a root every remaining scope has eliminated
+                    # out: accumulate the relaxation value
+                    relax += float(np.asarray(t).reshape(-1).sum()
+                                   if table_size(dims) == 1
+                                   else (np.min if mode == "min"
+                                         else np.max)(t))
+            else:
+                dest = incoming.setdefault(node.parent, [])
+                for t, dims in out:
+                    dest.append((t, dims))
+                    n_msgs += 1
+                    msg_entries += table_size(dims)
+
+    # ---- greedy top-down decoding
+    assignment_idx: Dict[str, int] = {}
+    for lv in levels:
+        for node in lv:
+            v = node.variable
+            cand = np.zeros(len(v.domain), dtype=np.float64)
+            for t, dims in buckets_of[node.name]:
+                fixed = {nm: assignment_idx[nm] for nm, _ in dims
+                         if nm in assignment_idx}
+                st, sdims = slice_t(np.asarray(t), dims, fixed)
+                assert len(sdims) == 1 and sdims[0][0] == v.name, sdims
+                cand += np.asarray(st, dtype=np.float64)
+            assignment_idx[v.name] = int(
+                np.argmin(cand) if mode == "min" else np.argmax(cand)
+            )
+
+    info = {
+        "engine": "minibucket",
+        "i_bound": i_bound,
+        "bucket_splits": n_splits,
+        "widest_scope": widest,
+        "msg_count": n_msgs,
+        "msg_entries": msg_entries,
+        "exact": n_splits == 0,
+    }
+    return assignment_idx, float(relax), info

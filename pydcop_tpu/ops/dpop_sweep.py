@@ -112,21 +112,12 @@ def _true_sep_sizes(sep, by_name):
 
 
 def _compute_separators(tree, levels):
-    """Bottom-up separator sets: sep(n) = (scope of own constraints ∪
-    children's separators) - {n}; members are ancestors of n."""
-    nodes_flat = [n for lv in levels for n in lv]
-    by_name = {n.name: n for n in nodes_flat}
-    sep: Dict[str, set] = {}
-    for lv in reversed(levels):
-        for node in lv:
-            s = set()
-            for c in node.constraints:
-                s.update(v.name for v in c.dimensions if v.name in by_name)
-            for ch in node.children:
-                s.update(sep[ch])
-            s.discard(node.name)
-            sep[node.name] = s
-    return sep, by_name
+    """Separator sets + node map (the set computation itself lives on
+    the pseudo-tree — graph/pseudotree.separators — so the sweep
+    compilers, the tiling planner and the byte estimators share one
+    definition)."""
+    by_name = {n.name: n for lv in levels for n in lv}
+    return tree.separators(), by_name
 
 
 def _digits_table(S: int, W: int, Dmax: int) -> np.ndarray:
@@ -478,10 +469,21 @@ class DpopPerLevelPlan:
         return sum(lv.B * lv.S for lv in self.levels)
 
 
-def compile_sweep_perlevel(tree, dcop,
-                           mode: str = "min") -> Optional[DpopPerLevelPlan]:
+def compile_sweep_perlevel(
+    tree, dcop, mode: str = "min",
+    max_table_entries: Optional[int] = None,
+    max_plan_entries: Optional[int] = None,
+) -> Optional[DpopPerLevelPlan]:
     """Compile with per-level width padding.  Returns None when even the
-    per-level form blows the budgets (fallback: per-node path)."""
+    per-level form blows the budgets (fallback: per-node path).
+
+    The budget overrides exist for the separator-tiling planner
+    (ops/dpop_shard): a table that is split ``n`` ways across the mesh
+    may legitimately be ``n`` times the single-device cap."""
+    if max_table_entries is None:
+        max_table_entries = MAX_TABLE_ENTRIES_PER_NODE
+    if max_plan_entries is None:
+        max_plan_entries = MAX_PLAN_ENTRIES
     levels = tree.nodes_by_depth()
     if not levels or not levels[0]:
         return None
@@ -497,7 +499,7 @@ def compile_sweep_perlevel(tree, dcop,
         for lv in levels
     ]
     S_l = [Dmax ** (w + 1) for w in W_l]
-    if any(s > MAX_TABLE_ENTRIES_PER_NODE for s in S_l):
+    if any(s > max_table_entries for s in S_l):
         return None
     # budget covers local tables AND the align_idx / aligned
     # intermediates, which are [B_child, S_parent]-shaped — in the
@@ -507,7 +509,7 @@ def compile_sweep_perlevel(tree, dcop,
     entries += sum(
         len(levels[li]) * S_l[li - 1] for li in range(1, len(levels))
     )
-    if entries > MAX_PLAN_ENTRIES:
+    if entries > max_plan_entries:
         return None
 
     gid, gid_to_name, slot = _global_ids(levels)

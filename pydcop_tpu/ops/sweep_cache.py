@@ -63,8 +63,16 @@ def _kernel_fingerprint() -> str:
     return h.hexdigest()[:16]
 
 
-def sweep_cache_key(ps) -> str:
-    """Stable digest of everything that shapes the lowered program."""
+def sweep_cache_key(ps, variant: Optional[tuple] = None) -> str:
+    """Stable digest of everything that shapes the lowered program.
+
+    ``variant`` distinguishes engine configurations that lower DIFFERENT
+    programs over the same packed tree shape — the separator-sharded
+    sweep's tiling (shard count, per-device budget) and the mini-bucket
+    mode's i-bound (ISSUE 9).  ``None`` is the single-device whole-sweep
+    default; any tiled/i-bounded executable MUST pass its variant tuple
+    or it would collide with (and be served) the single-device entry
+    (pinned in tests/unit/test_dpop_shard.py)."""
     import jax
     import jaxlib
 
@@ -79,34 +87,36 @@ def sweep_cache_key(ps) -> str:
         device_kind,
         ps.D, ps.n_nodes, ps.Vp, ps.N, ps.L, ps.mode, ps.buckets,
         ps.plan.A, ps.plan.B, ps.plan.L,
+        variant,
     )).encode()
     return hashlib.sha256(payload).hexdigest()[:32]
 
 
-def _sweep_cache_path(ps) -> Optional[str]:
+def _sweep_cache_path(ps, variant: Optional[tuple] = None
+                      ) -> Optional[str]:
     d = cache_dir()
     if d is None:
         return None
-    return os.path.join(d, f"sweep-{sweep_cache_key(ps)}.bin")
+    return os.path.join(d, f"sweep-{sweep_cache_key(ps, variant)}.bin")
 
 
-def has_cached_sweep(ps) -> bool:
+def has_cached_sweep(ps, variant: Optional[tuple] = None) -> bool:
     """True when a persisted executable exists for this plan shape —
     the DPOP auto tier's probe.  Never raises."""
     try:
-        path = _sweep_cache_path(ps)
+        path = _sweep_cache_path(ps, variant)
         return path is not None and os.path.exists(path)
     except Exception:  # noqa: BLE001 — probing must be free
         return False
 
 
-def load_sweep_executable(ps):
+def load_sweep_executable(ps, variant: Optional[tuple] = None):
     """Deserialize a cached executable for this plan shape, or None.
     Best-effort: any failure (including key computation) degrades to a
     fresh compile, never to a crash."""
     path = None
     try:
-        path = _sweep_cache_path(ps)
+        path = _sweep_cache_path(ps, variant)
         if path is None or not os.path.exists(path):
             return None
         from jax.experimental.serialize_executable import (
@@ -129,10 +139,11 @@ def load_sweep_executable(ps):
         return None
 
 
-def save_sweep_executable(ps, compiled) -> None:
+def save_sweep_executable(ps, compiled,
+                          variant: Optional[tuple] = None) -> None:
     """Serialize a compiled sweep executable for future processes."""
     try:
-        path = _sweep_cache_path(ps)
+        path = _sweep_cache_path(ps, variant)
         if path is None:
             return
         from jax.experimental.serialize_executable import serialize

@@ -6,7 +6,7 @@ computation graph is partitioned into edge shards laid out over a
 ``jax.sharding.Mesh``; neighborhood aggregations become ``psum`` collectives
 riding ICI/DCN instead of HTTP messages (SURVEY.md §2.8 mapping).
 """
-from pydcop_tpu.parallel.dpop_mesh import ShardedDpopSweep
+from pydcop_tpu.parallel.dpop_mesh import ShardedDpopSweep, ShardedSepDpop
 from pydcop_tpu.parallel.mesh import (
     ShardedLocalSearch,
     ShardedMaxSum,
@@ -17,6 +17,7 @@ from pydcop_tpu.parallel.partition import partition_factors
 
 __all__ = [
     "ShardedDpopSweep",
+    "ShardedSepDpop",
     "ShardedLocalSearch",
     "ShardedMaxSum",
     "build_mesh",

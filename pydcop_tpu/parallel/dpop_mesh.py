@@ -168,3 +168,252 @@ class ShardedDpopSweep:
         if self._fn is None:
             self._build()
         return np.asarray(jax.device_get(self._fn(*self._dev_args)))
+
+
+# ---------------------------------------------------------------------------
+# Separator-sharded sweep (ISSUE 9 tentpole): tile the TABLE axis, not
+# just the node-batch axis.
+#
+# ShardedDpopSweep above spreads node ROWS over the mesh — every table is
+# still whole per device, so the widest separator still caps the engine.
+# ShardedSepDpop executes an ops.dpop_shard.DpopShardPlan instead: every
+# level's flat separator space is cut into contiguous per-device blocks
+# (the split dimensions are the level's leading canonical separator
+# digits), so each device holds a [B, D, Smp/n] TILE of every table and
+# no device ever materializes a whole one.  Per UTIL level the only
+# cross-device traffic is the child message — Dmax-fold smaller than the
+# tables — packed down to its statically-feasible entries (cross-edge-
+# consistency pruning, arXiv:1909.06537) and reconstructed with ONE
+# masked-gather + psum (each wire entry has exactly one valid
+# contributor, so the f32 sum is exact and the sweep stays bit-identical
+# to the single-device per-level engine); the VALUE pass broadcasts each
+# level's argmin column with one psum of a [B, D] slab.  Same virtual-
+# mesh / real-mesh duality as ShardedDpopSweep.
+# ---------------------------------------------------------------------------
+
+
+class ShardedSepDpop:
+    """Run a compiled DpopShardPlan with separator-tiled tables."""
+
+    def __init__(self, plan, mesh: Optional[Mesh] = None):
+        self.plan = plan
+        self.mesh = mesh or build_mesh(plan.n_shards)
+        if int(self.mesh.devices.size) != plan.n_shards:
+            raise ValueError(
+                f"plan tiled for {plan.n_shards} shards but the mesh "
+                f"has {int(self.mesh.devices.size)} devices"
+            )
+        base = plan.base
+        self.sign = 1.0 if base.mode == "min" else -1.0
+        self._fill = np.float32(self.sign * 1e9)
+        self._steps_built = False
+
+    # ---- host-side slicing ------------------------------------------------
+
+    def _split_cols(self, arr: np.ndarray, Smp: int, fill) -> np.ndarray:
+        """[B, S] (own-major) -> [n, B, Dmax, Smb] contiguous column
+        blocks of the padded separator space."""
+        n = self.plan.n_shards
+        Dmax = self.plan.base.Dmax
+        B, S = arr.shape
+        Sm = S // Dmax
+        a = arr.reshape(B, Dmax, Sm)
+        if Smp > Sm:
+            a = np.pad(a, [(0, 0), (0, 0), (0, Smp - Sm)],
+                       constant_values=fill)
+        return np.stack(np.split(a, n, axis=2))
+
+    def _build(self):
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+
+        plan, mesh = self.plan, self.mesh
+        base = plan.base
+        n, Dmax, N = plan.n_shards, base.Dmax, base.n_nodes
+        L = len(base.levels)
+        argred = jnp.argmin if base.mode == "min" else jnp.argmax
+        reduce_own = jnp.min if base.mode == "min" else jnp.max
+        fill = self._fill
+
+        sh_blk = NamedSharding(mesh, P(AXIS))
+        sh_rep = NamedSharding(mesh, P())
+
+        def put_blk(a):
+            return jax.device_put(jnp.asarray(a), sh_blk)
+
+        def put_rep(a):
+            return jax.device_put(jnp.asarray(a), sh_rep)
+
+        self._local = []      # [n, B, Dmax, Smb] per level
+        self._align = [None]  # level li's align into level li-1
+        self._pslot = [None]
+        self._wire = [None]   # (g_idx, g_valid, unpack)
+        self._sep = []        # (sep_ids, node_ids, strides)
+        for li, lv in enumerate(base.levels):
+            t = plan.tilings[li]
+            self._local.append(put_blk(
+                self._split_cols(lv.local, t.Smp, fill)
+            ))
+            strides = np.array(
+                [Dmax ** (lv.W - 1 - k) for k in range(lv.W)],
+                dtype=np.int32,
+            )
+            self._sep.append((
+                put_rep(lv.sep_ids.astype(np.int32)),
+                put_rep(lv.node_ids.astype(np.int32)),
+                put_rep(strides),
+            ))
+            if li > 0:
+                tp = plan.tilings[li - 1]
+                self._align.append(put_blk(self._split_cols(
+                    lv.align_idx.astype(np.int32), tp.Smp, 0
+                )))
+                self._pslot.append(put_rep(
+                    lv.parent_slot.astype(np.int32)
+                ))
+                self._wire.append((
+                    put_blk(t.gather_idx), put_blk(t.gather_valid),
+                    put_rep(t.unpack_idx),
+                ))
+
+        # ---- per-level traced steps (shapes differ per level; jit
+        # caches by shape so repeated runs reuse the executables)
+        def leaf_step(local_b):
+            table = local_b[0]
+            return table[None], reduce_own(table, axis=1)[None]
+
+        def make_util_step(li):
+            lv, lv_c = base.levels[li], base.levels[li + 1]
+            t, t_c = plan.tilings[li], plan.tilings[li + 1]
+            B, B_c, Smb = lv.B, lv_c.B, t.Smb
+
+            def util_step(local_b, msg_c_b, aidx_b, pslot,
+                          g_idx, g_valid, unpack):
+                # reconstruct the child message from the pruned wire:
+                # one masked gather + psum (each wire entry has exactly
+                # one valid contributor -> exact), then a scatter into
+                # the sentinel-filled full-message buffer
+                flat = msg_c_b[0].reshape(-1)
+                contrib = jnp.take(flat, g_idx[0]) * g_valid[0]
+                wire = jax.lax.psum(contrib, AXIS)
+                full = jnp.full(
+                    (B_c * t_c.Smp + 1,), fill, dtype=jnp.float32
+                ).at[unpack].set(wire)[:B_c * t_c.Smp]
+                msg_full = full.reshape(B_c, t_c.Smp)
+                aligned = jnp.take_along_axis(
+                    msg_full, aidx_b[0].reshape(B_c, Dmax * Smb), axis=1
+                )
+                combined = jax.ops.segment_sum(
+                    aligned, pslot, num_segments=B
+                )
+                table = (
+                    local_b[0].reshape(B, Dmax * Smb) + combined
+                ).reshape(B, Dmax, Smb)
+                return table[None], reduce_own(table, axis=1)[None]
+
+            return util_step
+
+        def make_value_step(li):
+            lv = base.levels[li]
+            Smb = plan.tilings[li].Smb
+
+            def value_step(assign, table_b, sep_ids, node_ids, strides):
+                d = jax.lax.axis_index(AXIS)
+                sep_vals = assign[jnp.clip(sep_ids, 0, N)]
+                sep_pos = jnp.sum(sep_vals * strides[None, :], axis=1)
+                loc = sep_pos - d * Smb
+                inb = (loc >= 0) & (loc < Smb)
+                col = jnp.take_along_axis(
+                    table_b[0],
+                    jnp.clip(loc, 0, Smb - 1)[:, None, None],
+                    axis=2,
+                )[:, :, 0]
+                # exactly one device holds the addressed column; the
+                # others contribute exact zeros
+                col = jax.lax.psum(
+                    jnp.where(inb[:, None], col, 0.0), AXIS
+                )
+                best = argred(col, axis=1).astype(jnp.int32)
+                return assign.at[node_ids].set(
+                    best, mode="promise_in_bounds"
+                )
+
+            return value_step
+
+        blk, rep = P(AXIS), P()
+        self._util_fns = []
+        self._value_fns = []
+        for li in range(L):
+            if li == L - 1:
+                fn = jax.jit(shard_map(
+                    leaf_step, mesh=mesh, in_specs=(blk,),
+                    out_specs=(blk, blk), check_vma=False,
+                ))
+            else:
+                fn = jax.jit(shard_map(
+                    make_util_step(li), mesh=mesh,
+                    in_specs=(blk, blk, blk, rep, blk, blk, rep),
+                    out_specs=(blk, blk), check_vma=False,
+                ))
+            self._util_fns.append(fn)
+            self._value_fns.append(jax.jit(shard_map(
+                make_value_step(li), mesh=mesh,
+                in_specs=(rep, blk, rep, rep, rep),
+                out_specs=rep, check_vma=False,
+            )))
+        self._steps_built = True
+
+    # ---- execution --------------------------------------------------------
+
+    def run(self) -> np.ndarray:
+        """Full tiled UTIL+VALUE sweep → assign_idx [n_nodes]."""
+        import jax.numpy as jnp
+
+        if not self._steps_built:
+            self._build()
+        base = self.plan.base
+        L = len(base.levels)
+        tables = [None] * L
+        msg = None
+        for li in range(L - 1, -1, -1):
+            if li == L - 1:
+                tables[li], msg = self._util_fns[li](self._local[li])
+            else:
+                g_idx, g_valid, unpack = self._wire[li + 1]
+                tables[li], msg = self._util_fns[li](
+                    self._local[li], msg, self._align[li + 1],
+                    self._pslot[li + 1], g_idx, g_valid, unpack,
+                )
+        assign = jnp.zeros((base.n_nodes + 1,), dtype=jnp.int32)
+        for li in range(L):
+            sep_ids, node_ids, strides = self._sep[li]
+            assign = self._value_fns[li](
+                assign, tables[li], sep_ids, node_ids, strides
+            )
+        return np.asarray(jax.device_get(assign[:base.n_nodes]))
+
+    def comm_stats(self) -> dict:
+        """ShardCommCounters-shaped scorecard of the tiled sweep's
+        collective cost (payload bytes per sweep; 'dense' is what an
+        unpruned wire would ship), surfaced as metrics()['shard']."""
+        from pydcop_tpu.runtime.stats import ShardCommCounters
+
+        plan = self.plan
+        value_cols = sum(
+            lv.B * plan.base.Dmax for lv in plan.base.levels
+        )
+        return ShardCommCounters(
+            mode="dpop_sep_tiled",
+            collective="psum_wire",
+            n_shards=plan.n_shards,
+            boundary_columns=plan.wire_entries_pruned,
+            total_columns=plan.wire_entries_dense,
+            cut_fraction=1.0 - plan.pruned_fraction,
+            boundary_fraction=1.0 - plan.pruned_fraction,
+            bytes_per_cycle_dense=(plan.wire_entries_dense
+                                   + value_cols) * 4,
+            bytes_per_cycle_compact=(plan.wire_entries_pruned
+                                     + value_cols) * 4,
+            exchange_rounds=len(plan.base.levels),
+            threshold=0.0,
+        ).as_dict()

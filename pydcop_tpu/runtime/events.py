@@ -117,6 +117,25 @@ def send_shard(event: str, payload) -> None:
     event_bus.send(SHARD_TOPIC_PREFIX + event, payload)
 
 
+#: exact-inference (DPOP) topic prefix (algorithms/dpop +
+#: ops/dpop_shard).  Topics:
+#: ``dpop.shard.plan`` (n_shards, levels, bytes_per_device,
+#: wire_bytes_pruned/dense, pruned_fraction — the separator-tiling
+#: layout chosen for the sweep, emitted once at plan time),
+#: ``dpop.shard.sweep.done`` (time, bytes shipped — after the tiled
+#: UTIL+VALUE sweep),
+#: ``dpop.minibucket.bounds`` (i_bound, lower_bound, upper_bound, gap —
+#: after a bounded mini-bucket solve) — subscribe with ``dpop.*`` (the
+#: UI server pushes them to ws/SSE clients alongside ``shard.*``).
+DPOP_TOPIC_PREFIX = "dpop."
+
+
+def send_dpop(event: str, payload) -> None:
+    """Publish an exact-inference engine event on the global bus
+    (no-op unless observability is enabled)."""
+    event_bus.send(DPOP_TOPIC_PREFIX + event, payload)
+
+
 #: warm-repair topic prefix (runtime/repair).  Topics:
 #: ``repair.mutation.applied`` (kind, target, dirty variables),
 #: ``repair.headroom.claimed`` / ``repair.headroom.released`` (slot

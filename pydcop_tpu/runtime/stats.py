@@ -280,7 +280,13 @@ class ShardCommCounters:
     auto-policy chose and what it pays per cycle vs the dense psum.
     Built by parallel/mesh.CommPlan.counters; surfaced as
     ``SolveResult.metrics()['shard']`` and the ``shard.comm.selected``
-    event."""
+    event.
+
+    The separator-sharded DPOP sweep (ISSUE 9) reuses the same shape:
+    ``mode="dpop_sep_tiled"``, ``collective="psum_wire"``, a "cycle" is
+    one whole UTIL+VALUE sweep, ``boundary_columns``/``total_columns``
+    are the pruned vs dense wire entries and ``exchange_rounds`` the
+    tree levels (parallel/dpop_mesh.ShardedSepDpop.comm_stats)."""
 
     mode: str
     collective: str

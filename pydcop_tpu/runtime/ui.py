@@ -54,6 +54,7 @@ class UiServer:
         event_bus.subscribe("batch.*", self._cb_batch)
         event_bus.subscribe("harness.*", self._cb_harness)
         event_bus.subscribe("shard.*", self._cb_shard)
+        event_bus.subscribe("dpop.*", self._cb_dpop)
         event_bus.subscribe("serve.*", self._cb_serve)
 
     # -- event plumbing -----------------------------------------------------
@@ -252,6 +253,22 @@ class UiServer:
                                                  float, bool, type(None)))
                  else repr(evt)}))
 
+    def _cb_dpop(self, topic: str, evt) -> None:
+        """Exact-inference engine lifecycle (dpop.shard.plan,
+        dpop.shard.sweep.done, dpop.minibucket.bounds — the
+        separator-sharded sweep's tiling/wire scorecards and the
+        mini-bucket fallback's bound sandwich) pushed to GUI clients in
+        the same envelope shape as the shard.* forwarding; the SSE
+        /events stream gets them through the wildcard subscription like
+        every topic."""
+        if self._ws is not None:
+            self._ws.send_all(json.dumps(
+                {"evt": "dpop",
+                 "kind": topic.split(".", 1)[-1],
+                 "data": evt if isinstance(evt, (dict, list, str, int,
+                                                 float, bool, type(None)))
+                 else repr(evt)}))
+
     # -- server -------------------------------------------------------------
 
     def start(self) -> None:
@@ -312,7 +329,7 @@ class UiServer:
         for cb in (self._on_event, self._cb_cycle, self._cb_value,
                    self._cb_add_comp, self._cb_rem_comp, self._cb_fault,
                    self._cb_batch, self._cb_harness, self._cb_shard,
-                   self._cb_serve, self._cb_repair):
+                   self._cb_dpop, self._cb_serve, self._cb_repair):
             event_bus.unsubscribe(cb)
         if self._server is not None:
             self._server.shutdown()

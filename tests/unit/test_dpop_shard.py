@@ -1,0 +1,513 @@
+"""Separator-sharded exact DPOP (ISSUE 9): tiled util tables over the
+virtual 8-mesh, cross-edge-consistency pruning, mini-bucket fallback.
+
+The contract under test:
+
+* the tiled sweep is BIT-IDENTICAL to the single-device per-level sweep
+  on exactly-representable integer costs — pinned over a parity matrix
+  of cut shapes (chain, dense hub, adversarial all-back-edge
+  separators) × shard counts, pruning on and off;
+* pruning never changes the optimum (property test over random
+  hard-constraint instances) and actually shrinks the wire;
+* the mini-bucket mode reports a correct bound sandwich
+  ``lower ≤ exact ≤ upper`` and collapses to exact at a sufficient
+  i-bound;
+* ``engine="auto"`` routes on the planner's byte estimate:
+  over-budget instances go to the sharded sweep, and a typed
+  :class:`UtilTableTooLarge` (with suggested shard count / i-bound)
+  fires only when every route is exhausted;
+* sharded / mini-bucket configurations never collide with
+  single-device entries in the persistent sweep-executable cache.
+"""
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms.dpop import DpopSolver
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.graph import pseudotree
+from pydcop_tpu.ops.dpop_shard import (
+    UtilTableTooLarge,
+    estimate_sweep_bytes,
+    minibucket_solve,
+    plan_tiled_sweep,
+    prune_preconditions,
+    suggest_i_bound,
+)
+from pydcop_tpu.ops.dpop_sweep import (
+    BIG,
+    compile_sweep_perlevel,
+    run_sweep_perlevel,
+)
+from pydcop_tpu.parallel import ShardedSepDpop, build_mesh
+
+from tests.unit.test_dpop_sweep import brute_force_cost, random_dcop
+
+
+# ---------------------------------------------------------------------------
+# instance families (integer costs: exactly representable in f32)
+# ---------------------------------------------------------------------------
+
+
+def chain_dcop(n=24, D=3, seed=0):
+    """Pure chain: width-1 separators at every level (also exercises
+    the Sm < n_shards padding — Sm = D = 3 against an 8-mesh)."""
+    return random_dcop(n, 0, dom_sizes=(D,), seed=seed, tree_only=True)
+
+
+def hub_dcop(seed=0):
+    """Dense hub: a clique near the root widens ONE level's separator
+    while long chains keep the rest narrow (per-level tilings must
+    pick different split widths)."""
+    rng = np.random.default_rng(seed)
+    dcop = DCOP("hub", objective="min")
+    d = Domain("d", "vals", list(range(3)))
+    vs = [Variable(f"v{i:02d}", d) for i in range(18)]
+    for v in vs:
+        dcop.add_variable(v)
+    k = 0
+    for i in range(5):
+        for j in range(i + 1, 5):
+            m = rng.integers(0, 9, (3, 3)).astype(float)
+            dcop.add_constraint(
+                NAryMatrixRelation([vs[i], vs[j]], m, name=f"q{k}")
+            )
+            k += 1
+    for i in range(5, 18):
+        p = vs[i - 1] if i > 5 else vs[4]
+        m = rng.integers(0, 9, (3, 3)).astype(float)
+        dcop.add_constraint(
+            NAryMatrixRelation([p, vs[i]], m, name=f"c{i}")
+        )
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
+def backedge_dcop(n=8, D=2, seed=0):
+    """Adversarial all-back-edge separators: every node constrains ALL
+    its ancestors (a clique), so every level's separator is the full
+    ancestor set — the worst tiling case."""
+    rng = np.random.default_rng(seed)
+    dcop = DCOP("backedge", objective="min")
+    d = Domain("d", "vals", list(range(D)))
+    vs = [Variable(f"v{i}", d) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    k = 0
+    for i in range(n):
+        for j in range(i + 1, n):
+            m = rng.integers(0, 9, (D, D)).astype(float)
+            dcop.add_constraint(
+                NAryMatrixRelation([vs[i], vs[j]], m, name=f"c{k}")
+            )
+            k += 1
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
+def hard_dcop(n_vars=20, n_edges=10, seed=0, frac_hard=0.3):
+    """Random instance with BIG (hard) entries sprinkled in — the food
+    of the cross-edge-consistency pruning — while every pair keeps a
+    feasible entry so the optimum stays finite."""
+    rng = np.random.default_rng(seed)
+    dcop = DCOP("hard", objective="min")
+    d = Domain("d", "vals", [0, 1, 2])
+    vs = [Variable(f"v{i}", d) for i in range(n_vars)]
+    for v in vs:
+        dcop.add_variable(v)
+    edges = set(
+        (int(rng.integers(0, i)), i) for i in range(1, n_vars)
+    )
+    for _ in range(n_edges):
+        i, j = rng.integers(0, n_vars, 2)
+        if i != j:
+            edges.add((min(i, j), max(i, j)))
+    for k, (i, j) in enumerate(sorted(edges)):
+        m = rng.integers(0, 10, (3, 3)).astype(float)
+        hard = rng.random((3, 3)) < frac_hard
+        hard[0, 0] = False  # keep a feasible entry per constraint
+        m[hard] = BIG
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[i], vs[j]], m, name=f"c{k}")
+        )
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
+def _cost_of(dcop, gid_to_name, assign):
+    a = {
+        nm: list(dcop.variables[nm].domain)[int(assign[i])]
+        for i, nm in enumerate(gid_to_name)
+    }
+    return dcop.solution_cost(a, 10_000_000)[1]
+
+
+# ---------------------------------------------------------------------------
+# parity matrix: tiled sweep ≡ single-device per-level sweep, bit-exact
+# ---------------------------------------------------------------------------
+
+
+class TestShardedParity:
+    @pytest.mark.parametrize("family", ["chain", "hub", "backedge"])
+    @pytest.mark.parametrize("n_shards", [2, 8])
+    def test_bitmatches_single_device(self, family, n_shards):
+        dcop = {
+            "chain": chain_dcop, "hub": hub_dcop, "backedge": backedge_dcop,
+        }[family]()
+        tree = pseudotree.build_computation_graph(dcop)
+        base = compile_sweep_perlevel(tree, dcop, "min")
+        assert base is not None
+        single, _ = run_sweep_perlevel(base)
+        for prune in (True, False):
+            plan = plan_tiled_sweep(
+                tree, dcop, "min", n_shards=n_shards, prune=prune
+            )
+            got = ShardedSepDpop(plan, build_mesh(n_shards)).run()
+            np.testing.assert_array_equal(got, single)
+
+    def test_sharded_is_optimal_small(self):
+        dcop = backedge_dcop(n=6, D=2, seed=3)
+        tree = pseudotree.build_computation_graph(dcop)
+        plan = plan_tiled_sweep(tree, dcop, "min", n_shards=4)
+        assign = ShardedSepDpop(plan, build_mesh(4)).run()
+        assert _cost_of(dcop, plan.base.gid_to_name, assign) == (
+            brute_force_cost(dcop)
+        )
+
+    def test_max_mode(self):
+        dcop = random_dcop(16, 7, dom_sizes=(2,), seed=11,
+                           objective="max")
+        tree = pseudotree.build_computation_graph(dcop)
+        base = compile_sweep_perlevel(tree, dcop, "max")
+        single, _ = run_sweep_perlevel(base)
+        plan = plan_tiled_sweep(tree, dcop, "max", n_shards=8)
+        got = ShardedSepDpop(plan, build_mesh(8)).run()
+        np.testing.assert_array_equal(got, single)
+
+    def test_mixed_domains_padding(self):
+        """Ragged domains + Sm not divisible by n_shards exercise both
+        padding paths."""
+        dcop = random_dcop(30, 12, dom_sizes=(2, 3), seed=9)
+        tree = pseudotree.build_computation_graph(dcop)
+        base = compile_sweep_perlevel(tree, dcop, "min")
+        single, _ = run_sweep_perlevel(base)
+        plan = plan_tiled_sweep(tree, dcop, "min", n_shards=8)
+        got = ShardedSepDpop(plan, build_mesh(8)).run()
+        np.testing.assert_array_equal(got, single)
+
+    def test_tiles_are_genuinely_smaller(self):
+        """The per-device byte estimate must shrink with the shard
+        count — the whole point of the tiling."""
+        dcop = backedge_dcop(n=8, D=2)
+        tree = pseudotree.build_computation_graph(dcop)
+        p1 = plan_tiled_sweep(tree, dcop, "min", n_shards=1)
+        p8 = plan_tiled_sweep(tree, dcop, "min", n_shards=8)
+        assert p8.bytes_per_device < p1.bytes_per_device
+        # split digits were actually consumed at the wide levels
+        assert any(t.split_digits > 0 for t in p8.tilings)
+
+
+# ---------------------------------------------------------------------------
+# cross-edge-consistency pruning
+# ---------------------------------------------------------------------------
+
+
+class TestPruning:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_pruning_never_changes_the_optimum(self, seed):
+        """Property: with hard back-edge entries in play, the pruned
+        sweep's solution cost equals both the unpruned sweep's and the
+        single-device engine's."""
+        dcop = hard_dcop(seed=seed)
+        tree = pseudotree.build_computation_graph(dcop)
+        base = compile_sweep_perlevel(tree, dcop, "min")
+        single, _ = run_sweep_perlevel(base)
+        ref_cost = _cost_of(dcop, base.gid_to_name, single)
+        for n_shards in (2, 8):
+            costs = {}
+            for prune in (True, False):
+                plan = plan_tiled_sweep(
+                    tree, dcop, "min", n_shards=n_shards, prune=prune
+                )
+                assign = ShardedSepDpop(plan, build_mesh(n_shards)).run()
+                costs[prune] = _cost_of(
+                    dcop, plan.base.gid_to_name, assign
+                )
+                # on these (feasible-per-context) instances the pruned
+                # sweep is even bit-identical, not just cost-equal
+                np.testing.assert_array_equal(assign, single)
+            assert costs[True] == costs[False] == ref_cost
+
+    def test_pruning_shrinks_the_wire(self):
+        dcop = hard_dcop(seed=1)
+        tree = pseudotree.build_computation_graph(dcop)
+        plan = plan_tiled_sweep(tree, dcop, "min", n_shards=8)
+        assert plan.prune
+        assert plan.wire_entries_pruned < plan.wire_entries_dense
+        assert 0.0 < plan.pruned_fraction < 1.0
+
+    def test_preconditions_disable_pruning(self):
+        """A wrong-signed hard value (a -BIG entry in min mode) makes
+        the feasibility classification unsound — the planner must
+        fall back to the unpruned wire, not produce wrong answers."""
+        dcop = hard_dcop(seed=2)
+        # poison one constraint with a wrong-signed big entry (before
+        # the tree is built: nodes hold constraint references)
+        c = next(iter(dcop.constraints.values()))
+        m = np.asarray(c.to_tensor()).copy()
+        m[1, 1] = -BIG
+        dcop.constraints[c.name] = NAryMatrixRelation(
+            list(c.dimensions), m, name=c.name
+        )
+        tree = pseudotree.build_computation_graph(dcop)
+        ok, reason = prune_preconditions(dcop)
+        assert not ok and "wrong-signed" in reason
+        plan = plan_tiled_sweep(tree, dcop, "min", n_shards=2)
+        assert not plan.prune
+        assert plan.prune_disabled_reason
+        # and the unpruned sharded solve still matches single-device
+        base = compile_sweep_perlevel(tree, dcop, "min")
+        single, _ = run_sweep_perlevel(base)
+        got = ShardedSepDpop(plan, build_mesh(2)).run()
+        np.testing.assert_array_equal(got, single)
+
+    def test_prune_noop_without_hard_entries(self):
+        """Soft-only instances have nothing to prune: the wire is
+        dense and results are (trivially) bit-identical."""
+        dcop = random_dcop(20, 8, dom_sizes=(3,), seed=4)
+        tree = pseudotree.build_computation_graph(dcop)
+        plan = plan_tiled_sweep(tree, dcop, "min", n_shards=4)
+        assert plan.prune
+        assert plan.wire_entries_pruned == plan.wire_entries_dense
+
+
+# ---------------------------------------------------------------------------
+# mini-bucket fallback: bound sandwich
+# ---------------------------------------------------------------------------
+
+
+class TestMiniBucket:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_bound_sandwich(self, seed):
+        dcop = random_dcop(10, 5, seed=seed)
+        tree = pseudotree.build_computation_graph(dcop)
+        exact = brute_force_cost(dcop)
+        for i_bound in (1, 2):
+            aidx, relax, info = minibucket_solve(
+                tree, dcop, "min", i_bound
+            )
+            a = {
+                nm: list(dcop.variables[nm].domain)[i]
+                for nm, i in aidx.items()
+            }
+            ub = dcop.solution_cost(a, 10_000_000)[1]
+            assert relax <= exact + 1e-4
+            assert exact <= ub + 1e-4
+
+    def test_exact_at_sufficient_i_bound(self):
+        dcop = random_dcop(9, 4, seed=7)
+        tree = pseudotree.build_computation_graph(dcop)
+        exact = brute_force_cost(dcop)
+        width = tree.induced_width
+        aidx, relax, info = minibucket_solve(
+            tree, dcop, "min", max(1, width)
+        )
+        assert info["exact"] and info["bucket_splits"] == 0
+        a = {
+            nm: list(dcop.variables[nm].domain)[i]
+            for nm, i in aidx.items()
+        }
+        ub = dcop.solution_cost(a, 10_000_000)[1]
+        assert relax == pytest.approx(exact)
+        assert ub == pytest.approx(exact)
+
+    def test_solver_reports_gap_in_metrics(self):
+        from pydcop_tpu.runtime.run import solve_result
+
+        dcop = random_dcop(12, 6, seed=3)
+        exact = DpopSolver(dcop).run().cost
+        res = solve_result(
+            dcop, "dpop",
+            algo_params={"engine": "minibucket", "i_bound": 1},
+        )
+        m = res.metrics()["dpop"]
+        assert m["engine"] == "minibucket"
+        assert m["i_bound"] == 1
+        assert m["lower_bound"] <= exact + 1e-4 <= (
+            m["upper_bound"] + 2e-4
+        )
+        assert m["gap"] == pytest.approx(
+            m["upper_bound"] - m["lower_bound"]
+        )
+
+    def test_max_mode_bounds_flip(self):
+        dcop = random_dcop(8, 3, seed=5, objective="max")
+        exact = brute_force_cost(dcop)
+        solver = DpopSolver(dcop)
+        solver.engine = "minibucket"
+        solver.i_bound = 1
+        res = solver.run()
+        m = res.dpop
+        assert m["lower_bound"] <= exact + 1e-4 <= m["upper_bound"] + 2e-4
+
+
+# ---------------------------------------------------------------------------
+# engine routing: planner byte estimates drive auto
+# ---------------------------------------------------------------------------
+
+
+class TestRouting:
+    def test_auto_routes_to_sharded_under_budget(self):
+        """An instance whose util tables exceed the per-device budget
+        solves EXACTLY through the tiled sweep (the acceptance
+        scenario), bit-identical to the unbudgeted single-device
+        solve."""
+        dcop = random_dcop(40, 20, dom_sizes=(3,), seed=5)
+        ref = DpopSolver(dcop).run()
+        est = estimate_sweep_bytes(
+            pseudotree.build_computation_graph(dcop)
+        )
+        solver = DpopSolver(dcop)
+        # budget below the single-device need, above one 8-way tile
+        solver.budget_bytes = est["bytes"] // 4
+        res = solver.run()
+        assert solver.last_engine == "sharded"
+        assert res.assignment == ref.assignment
+        assert res.cost == ref.cost
+        assert res.dpop["engine"] == "sharded"
+        assert res.dpop["bytes_per_device"] <= solver.budget_bytes
+        assert res.shard["mode"] == "dpop_sep_tiled"
+        assert res.shard["collective"] == "psum_wire"
+        assert res.shard["bytes_per_cycle_compact"] > 0
+
+    def test_too_large_is_typed_with_suggestions(self):
+        dcop = random_dcop(40, 20, dom_sizes=(3,), seed=5)
+        solver = DpopSolver(dcop)
+        solver.budget_bytes = 64  # absurd: nothing fits
+        with pytest.raises(UtilTableTooLarge) as ei:
+            solver.run()
+        err = ei.value
+        assert isinstance(err, MemoryError)  # back-compat catchability
+        assert err.estimated_bytes > 64
+        assert err.suggested_shards > err.n_shards
+        assert err.suggested_i_bound >= 1
+        assert "i-bound" in str(err)
+
+    def test_too_large_degrades_to_minibucket_with_i_bound(self):
+        dcop = random_dcop(40, 20, dom_sizes=(3,), seed=5)
+        solver = DpopSolver(dcop)
+        solver.budget_bytes = 64
+        solver.i_bound = 2
+        res = solver.run()
+        assert solver.last_engine == "minibucket"
+        assert res.status == "FINISHED"
+        assert res.dpop["lower_bound"] <= res.dpop["upper_bound"]
+
+    def test_pernode_refusal_is_typed(self, monkeypatch):
+        """The per-node path's old bare MemoryError is now the typed
+        UtilTableTooLarge carrying suggestions."""
+        dcop = random_dcop(10, 10, seed=1)
+        tree = pseudotree.build_computation_graph(dcop)
+        solver = DpopSolver(dcop, tree)
+        monkeypatch.setattr(solver, "max_table_entries", 4)
+        with pytest.raises(UtilTableTooLarge) as ei:
+            solver._run_pernode()
+        assert ei.value.suggested_i_bound >= 1
+
+    def test_estimates_and_suggestions(self):
+        dcop = backedge_dcop(n=8, D=2)
+        tree = pseudotree.build_computation_graph(dcop)
+        est = estimate_sweep_bytes(tree)
+        assert est["bytes"] > 0
+        assert est["max_node_entries"] == 2 ** 8  # the root clique table
+        assert tree.induced_width == 7
+        assert suggest_i_bound(2, 4 * 2**10) >= 1
+        # larger budget → larger feasible i-bound
+        assert suggest_i_bound(2, 2**20) > suggest_i_bound(2, 2**8)
+
+
+# ---------------------------------------------------------------------------
+# observability: events + cache keys
+# ---------------------------------------------------------------------------
+
+
+class TestObservability:
+    def test_shard_events_emitted(self):
+        from pydcop_tpu.runtime.events import event_bus
+
+        dcop = random_dcop(20, 8, dom_sizes=(3,), seed=2)
+        solver = DpopSolver(dcop)
+        solver.engine = "sharded"
+        got = []
+
+        def cb(t, e):
+            got.append((t, e))
+
+        event_bus.subscribe("dpop.*", cb)
+        was = event_bus.enabled
+        event_bus.enabled = True
+        try:
+            solver.run()
+        finally:
+            event_bus.enabled = was
+            event_bus.unsubscribe(cb)
+        topics = [t for t, _ in got]
+        assert "dpop.shard.plan" in topics
+        assert "dpop.shard.sweep.done" in topics
+        plan_evt = dict(got[topics.index("dpop.shard.plan")][1])
+        assert plan_evt["engine"] == "sharded"
+        assert plan_evt["wire_bytes_dense"] > 0
+
+    def test_minibucket_events_emitted(self):
+        from pydcop_tpu.runtime.events import event_bus
+
+        dcop = random_dcop(10, 4, seed=6)
+        solver = DpopSolver(dcop)
+        solver.engine = "minibucket"
+        solver.i_bound = 1
+        got = []
+
+        def cb(t, e):
+            got.append((t, e))
+
+        event_bus.subscribe("dpop.*", cb)
+        was = event_bus.enabled
+        event_bus.enabled = True
+        try:
+            solver.run()
+        finally:
+            event_bus.enabled = was
+            event_bus.unsubscribe(cb)
+        topics = [t for t, _ in got]
+        assert "dpop.minibucket.bounds" in topics
+
+    def test_sweep_cache_variant_keys_never_collide(self):
+        """Satellite: sharded / i-bounded plans must hash to DIFFERENT
+        persistent-cache keys than the single-device entry for the
+        same packed tree shape."""
+        from types import SimpleNamespace
+
+        from pydcop_tpu.ops.sweep_cache import sweep_cache_key
+
+        ps = SimpleNamespace(
+            D=4, n_nodes=100, Vp=128, N=16, L=7, mode="min",
+            buckets=((2, 8),),
+            plan=SimpleNamespace(A=8, B=16, L=3),
+        )
+        base = sweep_cache_key(ps)
+        assert base == sweep_cache_key(ps)  # stable
+        tiled = sweep_cache_key(ps, variant=("tiled", 8, 2 ** 20))
+        mb = sweep_cache_key(ps, variant=("minibucket", 4))
+        assert len({base, tiled, mb}) == 3
+        # tiling/i-bound/budget FIELDS are key material, not just the tag
+        assert tiled != sweep_cache_key(ps, variant=("tiled", 4, 2 ** 20))
+        assert tiled != sweep_cache_key(ps, variant=("tiled", 8, 2 ** 21))
+        assert mb != sweep_cache_key(ps, variant=("minibucket", 6))
+        # distinct shapes still get distinct keys under the same variant
+        ps2 = SimpleNamespace(
+            D=4, n_nodes=101, Vp=128, N=16, L=7, mode="min",
+            buckets=((2, 8),),
+            plan=SimpleNamespace(A=8, B=16, L=3),
+        )
+        assert sweep_cache_key(ps2, variant=("tiled", 8, 2 ** 20)) != tiled

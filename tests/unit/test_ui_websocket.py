@@ -152,6 +152,13 @@ def test_events_are_pushed(served_orchestrator):
      {"algo": "mgm", "lanes": 4, "warm": True}),
     ("batch.bucket.formed", "batch", {"algo": "mgm", "size": 3}),
     ("harness.run.done", "harness", {"algo": "mgm", "cycle": 21}),
+    ("dpop.shard.plan", "dpop",
+     {"engine": "sharded", "n_shards": 8, "levels": 5,
+      "bytes_per_device": 4096, "wire_bytes_pruned": 512,
+      "wire_bytes_dense": 640, "pruned_fraction": 0.2}),
+    ("dpop.minibucket.bounds", "dpop",
+     {"i_bound": 3, "lower_bound": 10.0, "upper_bound": 14.0,
+      "gap": 4.0}),
     ("repair.mutation.applied", "repair",
      {"kind": "edit_factor", "target": "c12", "mutations": 1,
       "free_var_slots": 3}),
