@@ -3,7 +3,7 @@
 .PHONY: test unit api cli doctest all-tests bench bench-probe faults \
 	bench-batch batch-smoke bench-harness bench-sharded bench-serve \
 	serve-smoke chaos-smoke bench-churn churn-smoke bench-dpop \
-	dpop-smoke
+	dpop-smoke bench-auto portfolio-smoke
 
 test: all-tests
 
@@ -112,6 +112,22 @@ faults:
 # BENCHREF.md "Churn recovery")
 bench-churn:
 	python bench.py --only churn
+
+# learned-portfolio held-out regret leg (ISSUE 10): train the cost
+# model on seeded training families, then on a HELD-OUT suite compare
+# `solve --auto` against every fixed single-config baseline in the
+# grid — total drift-normalized time-to-target, mean top-1 regret vs
+# the per-instance oracle and the predicted-vs-actual gap audit in
+# the JSON (docs/portfolio.rst, BENCHREF.md "Portfolio auto-selection")
+bench-auto:
+	python bench.py --only auto
+
+# tiny grid -> dataset sweep -> train -> `solve --auto` end to end on
+# the CPU backend in under a minute: the portfolio CLI smoke (tier-1
+# subset; run it whenever touching pydcop_tpu/portfolio/)
+portfolio-smoke:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/cli/test_portfolio_cli.py -q -m 'not slow'
 
 # the seeded churn fault plan driven end-to-end through `run
 # --warm-repair`: edit_factor / remove_agent_burst / add_agent_burst at

@@ -1582,6 +1582,188 @@ def bench_churn(args, probe=None):
     return out
 
 
+def bench_auto(args, probe=None):
+    """Learned-portfolio auto-selection (ISSUE 10): train the cost
+    model on a seeded sweep of TRAINING families, then score a
+    held-out suite (families excluded from training) three ways on the
+    same per-cell measurements:
+
+    * per-instance **oracle** — the best config's drift-normalized
+      time-to-target (the lower bound no selector can beat);
+    * every **fixed single config** of the grid, summed over the
+      suite (a config that misses the target or is feasibility-masked
+      on an instance is charged the dataset harness's miss penalty);
+    * **auto** — the model's per-instance argmin.
+
+    The acceptance headline is ``auto_speedup_vs_best_fixed`` (> 1 =
+    auto's total beats EVERY fixed config) with the mean top-1 regret
+    vs the oracle and the model's ranking report riding along; a real
+    ``solve --auto`` runs per held-out instance too so the
+    predicted-vs-actual gap audit (metrics['portfolio']) lands in the
+    JSON (BENCHREF.md "Portfolio auto-selection")."""
+    import tempfile
+
+    import numpy as np
+
+    from pydcop_tpu.portfolio.dataset import (
+        InstanceSpec,
+        PortfolioDataset,
+        SweepSpec,
+        make_probe,
+        run_cell,
+        run_sweep,
+        split_holdout,
+        training_matrix,
+    )
+    from pydcop_tpu.portfolio.features import featurize_detail
+    from pydcop_tpu.portfolio.model import evaluate, train_model
+    from pydcop_tpu.portfolio.select import (
+        DEFAULT_GRID,
+        feasible_grid,
+        select_config,
+        solve_auto,
+    )
+
+    grid = DEFAULT_GRID
+    cycles, cell_timeout = 120, 25.0
+    # training families span the structural axes the held-out suite
+    # probes — ring-lattice-like (ising torus, grid coloring),
+    # scale-free (gc scalefree ~ iot's preferential attachment) and
+    # width-diverse random graphs — WITHOUT ever containing a
+    # held-out family instance
+    train_instances = [
+        InstanceSpec(f, s, sd)
+        for f, sizes in (("graphcoloring", (8, 14, 20)),
+                         ("ising", (4, 5, 6)),
+                         ("secp", (6, 9)),
+                         ("meetingscheduling", (4, 6)))
+        for s in sizes for sd in (0, 1)
+    ] + [
+        InstanceSpec("graphcoloring", s, sd,
+                     params=(("graph_type", "scalefree"),
+                             ("m_edge", 2), ("n_edges", None)))
+        for s in (10, 18) for sd in (0, 1)
+    ] + [
+        InstanceSpec("graphcoloring", s * s, sd,
+                     params=(("graph_type", "grid"), ("n_edges", None)))
+        for s in (3, 4) for sd in (0, 1)
+    ]
+    # held out: two UNSEEN FAMILIES (smallworld, iot) plus an UNSEEN
+    # SIZE of a training family (ising 7x7, width ~14 — its winner
+    # flips away from the narrow-width choice, so a selector that
+    # cannot generalize loses here) — the "families or sizes excluded
+    # from training" suite of the acceptance criterion
+    held_instances = [
+        InstanceSpec("smallworld", s, 5) for s in (10, 16, 24)
+    ] + [InstanceSpec("iot", s, 5) for s in (12, 20)] + [
+        InstanceSpec("ising", 7, 7),
+    ]
+
+    out = {}
+    tmp = tempfile.mkdtemp(prefix="bench_auto_")
+    pf_probe = make_probe(repeat=max(2, args.repeat))
+    sweep = run_sweep(
+        SweepSpec(train_instances, grid, cycles=cycles,
+                  timeout_s=cell_timeout),
+        tmp, probe=pf_probe,
+    )
+    out["auto_train_cells"] = sweep["cells_run"]
+    out["auto_train_sweep_s"] = sweep["wall_s"]
+    ds = PortfolioDataset(tmp)
+    X, y, gids, _keys = training_matrix(ds.rows())
+    (trX, trY, tr_gids), _ = split_holdout(X, y, gids, [])
+    model, hist = train_model(
+        trX, trY, hidden=(64, 64), epochs=600, lr=2e-3, seed=0,
+        group_ids=tr_gids,
+        meta={"probe_rate": float(np.median([
+            float(r.get("probe_rate") or 0) for r in ds.rows()
+        ]))},
+    )
+    out["auto_train_rows"] = int(trX.shape[0])
+    out["auto_train_loss"] = round(hist["final_loss"], 5)
+    out["auto_train_rank_pairs"] = hist["rank_pairs"]
+
+    # ---- held-out suite: measure every feasible config per instance
+    held_rows = []
+    selections = {}
+    gaps = []
+    for inst in held_instances:
+        dcop = inst.build()
+        features, info = featurize_detail(dcop)
+        feasible, _masked = feasible_grid(grid, info)
+        for cfg in feasible:
+            rate = pf_probe()
+            cell = run_cell(dcop, cfg, cycles, cell_timeout, inst.seed)
+            held_rows.append({
+                "key": f"{inst.key()}::{cfg.key()}",
+                "instance": inst.key(),
+                "config": cfg.as_dict(),
+                "features": [float(v) for v in features],
+                "probe_rate": rate,
+                **cell,
+            })
+        sel = select_config(dcop, grid=grid, model=model,
+                            features=features, info=info)
+        selections[inst.key()] = sel.config.key()
+        # the real front door, for the honesty audit in the JSON
+        res = solve_auto(dcop, model=model, grid=grid, cycles=cycles,
+                         timeout=cell_timeout, seed=inst.seed)
+        pf = res.portfolio or {}
+        if pf.get("gap_ratio") is not None:
+            gaps.append(pf["gap_ratio"])
+
+    hX, hy, hgids, hkeys = training_matrix(held_rows)
+    # label per (instance, config key) in normalized-time units
+    label = {}
+    for k, gid, yy in zip(hkeys, hgids, hy):
+        cfg_key = k.split("::", 1)[1]
+        label[(gid, cfg_key)] = float(np.expm1(yy))
+    insts = sorted(set(hgids))
+    miss_charge = {
+        gid: max(v for (g, _c), v in label.items() if g == gid)
+        for gid in insts
+    }
+    fixed_totals = {}
+    for cfg in grid:
+        fixed_totals[cfg.key()] = round(sum(
+            label.get((gid, cfg.key()), miss_charge[gid])
+            for gid in insts
+        ), 4)
+    oracle_per = {
+        gid: min(v for (g, _c), v in label.items() if g == gid)
+        for gid in insts
+    }
+    auto_per = {
+        gid: label.get((gid, selections[gid]), miss_charge[gid])
+        for gid in insts
+    }
+    auto_total = round(sum(auto_per.values()), 4)
+    oracle_total = round(sum(oracle_per.values()), 4)
+    best_fixed = min(fixed_totals.values())
+    out["auto_total_norm_time"] = auto_total
+    out["auto_oracle_total_norm_time"] = oracle_total
+    out["auto_best_fixed_total_norm_time"] = round(best_fixed, 4)
+    out["auto_fixed_config_totals"] = fixed_totals
+    out["auto_beats_all_fixed"] = bool(auto_total < best_fixed)
+    out["auto_speedup_vs_best_fixed"] = round(
+        best_fixed / auto_total, 4) if auto_total else 0.0
+    out["auto_mean_top1_regret_ratio"] = round(float(np.mean([
+        auto_per[g] / oracle_per[g] if oracle_per[g] > 0 else 1.0
+        for g in insts
+    ])), 4)
+    out["auto_selections"] = selections
+    # ranking report on the held-out groups (not just MSE)
+    groups = []
+    for gid in insts:
+        idx = [i for i, g in enumerate(hgids) if g == gid]
+        groups.append((hX[idx], hy[idx]))
+    out["auto_holdout_eval"] = evaluate(model, groups)
+    if gaps:
+        out["auto_gap_ratio_mean"] = round(float(np.mean(gaps)), 4)
+        out["auto_gap_ratio_worst"] = round(float(np.max(gaps)), 4)
+    return out
+
+
 def bench_dpop_sharded_subprocess(args):
     """Sharded exact DPOP on a virtual 8-device CPU mesh, in a
     subprocess so the forced-CPU platform doesn't poison this process's
@@ -2106,7 +2288,7 @@ def main():
         choices=["all", "maxsum", "dpop", "convergence", "convergence2",
                  "local", "scalefree", "mixed", "sharded",
                  "sharded-inner", "dpop-sharded", "dpop-sharded-inner",
-                 "probe", "batch", "harness", "serve", "churn"],
+                 "probe", "batch", "harness", "serve", "churn", "auto"],
         default="all",
     )
     # watchdog covers the FULL run: the wholesweep DPOP kernel compile
@@ -2403,6 +2585,16 @@ def main():
         except Exception as e:
             extra["sharded_error"] = repr(e)
 
+    if args.only in ("all", "auto"):
+        # learned-portfolio auto-selection (ISSUE 10): train on seeded
+        # families, pick per-instance on a HELD-OUT suite; headline is
+        # auto's total drift-normalized time-to-target vs the best
+        # fixed single config (BENCHREF.md "Portfolio auto-selection")
+        try:
+            extra.update(bench_auto(args, probe=probe))
+        except Exception as e:
+            extra["auto_error"] = repr(e)
+
     if args.only in ("all", "dpop-sharded"):
         # sharded exact DPOP (ISSUE 9): util tables tiled over the
         # 8-device CPU mesh; the headline is the sweep wall on an
@@ -2419,13 +2611,14 @@ def main():
 
     if args.only in ("dpop", "local", "convergence", "convergence2",
                      "scalefree", "mixed", "sharded", "dpop-sharded",
-                     "probe", "batch", "harness", "serve", "churn") \
+                     "probe", "batch", "harness", "serve", "churn",
+                     "auto") \
             and not value:
         # single-part run: promote the part's headline measurement (not
         # config constants like stretch_vars) to the primary slot
         headline = ("_per_sec", "_wall_s", "_cycles_per", "probe_rate",
                     "batch_throughput", "serve_throughput",
-                    "churn_speedup")
+                    "churn_speedup", "auto_speedup")
         k = next(
             (k for k in extra if any(h in k for h in headline)),
             next((k for k in extra if not k.endswith("_error")), None),

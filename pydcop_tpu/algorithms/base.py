@@ -77,6 +77,16 @@ class SolveResult:
     #: lower/upper-bound sandwich around the (unreached) optimum; None
     #: for every other solver
     dpop: Optional[Dict[str, Any]] = None
+    #: canonical fully-resolved executed config
+    #: (runtime/stats.resolved_config: algo, engine, chunk, overlap,
+    #: boundary threshold, dpop budget/i-bound) — ONE stable label
+    #: schema shared by the portfolio dataset harness and the --auto
+    #: gap audit; None only for solvers not yet on the schema
+    config: Optional[Dict[str, Any]] = None
+    #: portfolio auto-selection audit (runtime/stats.PORTFOLIO_FIELDS:
+    #: chosen config, model provenance, predicted vs actual), attached
+    #: by ``solve --auto`` (pydcop_tpu.portfolio.select.solve_auto)
+    portfolio: Optional[Dict[str, Any]] = None
 
     def metrics(self) -> Dict[str, Any]:
         out = {
@@ -97,6 +107,10 @@ class SolveResult:
             out["repair"] = dict(self.repair)
         if self.dpop is not None:
             out["dpop"] = dict(self.dpop)
+        if self.config is not None:
+            out["config"] = dict(self.config)
+        if self.portfolio is not None:
+            out["portfolio"] = dict(self.portfolio)
         return out
 
 
@@ -688,6 +702,8 @@ class SynchronousTensorSolver:
             "cycle": done,
             **counters.as_dict(),
         })
+        from pydcop_tpu.runtime.stats import resolved_config
+
         return SolveResult(
             status=status,
             assignment=assignment,
@@ -699,4 +715,7 @@ class SynchronousTensorSolver:
             time=perf_counter() - t0,
             history=history if collect_cycles else None,
             harness=counters.as_dict(),
+            config=resolved_config(
+                self.algo_def.algo, "harness", chunk=chunk
+            ),
         )

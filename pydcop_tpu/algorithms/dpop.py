@@ -122,6 +122,21 @@ class DpopSolver:
     #: scan) or "pernode" (hybrid host/device loop)
     last_engine: str = ""
 
+    def _resolved_config(self, i_bound: Optional[int] = None):
+        """Canonical executed-config record (metrics()['config']):
+        engine = the tier the auto routing actually landed on, not the
+        requested parameter."""
+        from pydcop_tpu.runtime.stats import resolved_config
+
+        return resolved_config(
+            "dpop",
+            self.last_engine or self.engine,
+            dpop_budget_mb=(
+                self.budget_bytes / 2**20 if self.budget_bytes else 0.0
+            ),
+            i_bound=self.i_bound if i_bound is None else int(i_bound),
+        )
+
     def run(self, cycles=None, timeout=None, collect_cycles=False,
             **_kwargs) -> SolveResult:
         # engine tiers: (1) global batched sweep — one lax.scan per
@@ -312,6 +327,7 @@ class DpopSolver:
             time=perf_counter() - t0,
             shard=shard,
             dpop=dpop,
+            config=self._resolved_config(),
         )
 
     def _run_sharded(self) -> SolveResult:
@@ -416,6 +432,7 @@ class DpopSolver:
             msg_size=self.msg_size,
             time=perf_counter() - t0,
             dpop=dpop_info,
+            config=self._resolved_config(i_bound=i_bound),
         )
 
     def _run_pernode(self) -> SolveResult:
@@ -508,6 +525,7 @@ class DpopSolver:
             msg_count=self.msg_count,
             msg_size=float(self.msg_size),
             time=perf_counter() - t0,
+            config=self._resolved_config(),
         )
 
 

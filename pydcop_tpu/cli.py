@@ -51,6 +51,7 @@ def make_parser() -> argparse.ArgumentParser:
         generate,
         graph,
         orchestrator,
+        portfolio,
         replica_dist,
         run,
         serve,
@@ -58,7 +59,8 @@ def make_parser() -> argparse.ArgumentParser:
     )
 
     for module in (solve, run, orchestrator, agent, distribute, graph,
-                   generate, batch, replica_dist, consolidate, serve):
+                   generate, batch, replica_dist, consolidate, serve,
+                   portfolio):
         module.set_parser(subparsers)
     return parser
 

@@ -1,0 +1,207 @@
+"""`pydcop_tpu portfolio` — the learned cost model's lifecycle.
+
+No reference twin (docs/portfolio.rst): ``dataset`` runs the
+self-labeling sweep (generators x config grid, resumable by cell
+key), ``train`` fits the pure-JAX cost model with a held-out-family
+evaluation report, ``eval`` re-scores an existing model, and
+``select`` dry-runs the ``solve --auto`` policy on concrete YAML
+instances without solving them.
+"""
+from __future__ import annotations
+
+
+def _csv(s):
+    return [p.strip() for p in str(s).split(",") if p.strip()]
+
+
+def _int_csv(s):
+    return [int(p) for p in _csv(s)]
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "portfolio",
+        help="learned portfolio: dataset / train / eval / select",
+    )
+    sub = parser.add_subparsers(dest="portfolio_cmd", required=True)
+
+    p = sub.add_parser("dataset", help="run the self-labeling sweep")
+    p.set_defaults(func=_dataset)
+    p.add_argument("--out", required=True,
+                   help="dataset directory (rows.jsonl + dataset.npz; "
+                   "append-only, resumable by cell key)")
+    p.add_argument("--families", default="graphcoloring,ising,iot",
+                   help="comma list of generator families (see "
+                   "portfolio.dataset.FAMILIES)")
+    p.add_argument("--sizes", default="6,9,12",
+                   help="comma list of family size knobs")
+    p.add_argument("--seeds", default="0,1",
+                   help="comma list of instance seeds")
+    p.add_argument("--grid", default="default",
+                   choices=["default", "tiny"],
+                   help="declared config grid to sweep")
+    p.add_argument("--cycles", type=int, default=200,
+                   help="cycle budget per iterative solve")
+    p.add_argument("--cell-timeout", type=float, default=30.0,
+                   help="wall cap per (instance, config) cell")
+    p.add_argument("--no-resume", action="store_true",
+                   help="re-run cells already present in the dataset")
+
+    p = sub.add_parser("train", help="fit the cost model")
+    p.set_defaults(func=_train)
+    p.add_argument("--data", required=True, help="dataset directory")
+    p.add_argument("--model", required=True,
+                   help="output model file (.npz)")
+    p.add_argument("--holdout", default="",
+                   help="comma list of families excluded from "
+                   "training and used for the ranking report")
+    p.add_argument("--epochs", type=int, default=300)
+    p.add_argument("--hidden", default="48,48",
+                   help="comma list of hidden layer widths")
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--seed", type=int, default=0)
+
+    p = sub.add_parser("eval", help="re-evaluate a trained model")
+    p.set_defaults(func=_eval)
+    p.add_argument("--data", required=True)
+    p.add_argument("--model", required=True)
+    p.add_argument("--holdout", required=True,
+                   help="comma list of families to report on")
+
+    p = sub.add_parser(
+        "select", help="dry-run the --auto policy (no solve)"
+    )
+    p.set_defaults(func=_select)
+    p.add_argument("dcop_files", nargs="+")
+    p.add_argument("--model", default=None,
+                   help="trained model (.npz); omitted = the "
+                   "heuristic fallback policy")
+    p.add_argument("--grid", default="default",
+                   choices=["default", "tiny"])
+    return parser
+
+
+def _grid(name):
+    from pydcop_tpu.portfolio.select import GRIDS
+
+    return GRIDS[name]
+
+
+def _out(args, payload) -> int:
+    from pydcop_tpu.commands._utils import output_metrics
+
+    output_metrics(payload, args.output)
+    return 0 if payload.get("status") != "ERROR" else 1
+
+
+def _dataset(args):
+    from pydcop_tpu.portfolio.dataset import run_sweep, sweep_spec
+
+    spec = sweep_spec(
+        _csv(args.families), _int_csv(args.sizes),
+        _int_csv(args.seeds), _grid(args.grid),
+        cycles=args.cycles, timeout_s=args.cell_timeout,
+    )
+    try:
+        summary = run_sweep(spec, args.out,
+                            resume=not args.no_resume)
+    except Exception as e:
+        return _out(args, {"status": "ERROR", "error": str(e)})
+    return _out(args, {"status": "FINISHED", **summary})
+
+
+def _train(args):
+    import numpy as np
+
+    from pydcop_tpu.portfolio.dataset import (
+        PortfolioDataset,
+        split_holdout,
+        training_matrix,
+    )
+    from pydcop_tpu.portfolio.model import evaluate, train_model
+
+    ds = PortfolioDataset(args.data)
+    rows = ds.rows()
+    X, y, gids, _keys = training_matrix(rows)
+    if X.shape[0] == 0:
+        return _out(args, {"status": "ERROR",
+                           "error": f"no usable rows in {args.data}"})
+    holdout = _csv(args.holdout)
+    (trX, trY, tr_gids), held = split_holdout(X, y, gids, holdout)
+    if trX.shape[0] == 0:
+        return _out(args, {"status": "ERROR",
+                           "error": "holdout excludes every row"})
+    probe_rates = [
+        float(r.get("probe_rate") or 0.0) for r in rows
+        if r.get("probe_rate")
+    ]
+    meta = {
+        "probe_rate": float(np.median(probe_rates)) if probe_rates
+        else 0.0,
+        "trained_rows": int(trX.shape[0]),
+        "holdout": holdout,
+    }
+    model, hist = train_model(
+        trX, trY, hidden=tuple(_int_csv(args.hidden)),
+        epochs=args.epochs, lr=args.lr, seed=args.seed, meta=meta,
+        group_ids=tr_gids,
+    )
+    model.save(args.model)
+    report = {
+        "status": "FINISHED",
+        "model": args.model,
+        "rows_total": int(X.shape[0]),
+        "rows_trained": int(trX.shape[0]),
+        "final_loss": round(hist["final_loss"], 6),
+        "holdout": holdout,
+    }
+    if held:
+        report["holdout_eval"] = evaluate(model, held)
+    return _out(args, report)
+
+
+def _eval(args):
+    from pydcop_tpu.portfolio.dataset import (
+        PortfolioDataset,
+        split_holdout,
+        training_matrix,
+    )
+    from pydcop_tpu.portfolio.model import CostModel, evaluate
+
+    ds = PortfolioDataset(args.data)
+    X, y, gids, _keys = training_matrix(ds.rows())
+    _train, held = split_holdout(X, y, gids, _csv(args.holdout))
+    if not held:
+        return _out(args, {"status": "ERROR",
+                           "error": "no held-out groups matched"})
+    try:
+        model = CostModel.load(args.model)
+    except Exception as e:
+        return _out(args, {"status": "ERROR", "error": str(e)})
+    return _out(args, {"status": "FINISHED",
+                       "holdout_eval": evaluate(model, held)})
+
+
+def _select(args):
+    from pydcop_tpu.dcop import load_dcop_from_file
+    from pydcop_tpu.portfolio.select import load_model, select_config
+
+    model = load_model(args.model)
+    out = {}
+    status = "FINISHED"
+    for fn in args.dcop_files:
+        try:
+            dcop = load_dcop_from_file([fn])
+            sel = select_config(dcop, grid=_grid(args.grid),
+                                model=model)
+            out[fn] = {
+                "config": sel.config.as_dict(),
+                "fallback": sel.fallback,
+                "predicted_norm_time": sel.predicted_norm_time,
+                "scores": sel.scores,
+                "masked": sel.masked,
+            }
+        except Exception as e:
+            out[fn] = {"status": "ERROR", "error": str(e)}
+            status = "ERROR"
+    return _out(args, {"status": status, "selections": out})

@@ -38,8 +38,25 @@ def set_parser(subparsers):
     parser.add_argument(
         "--compile-cache-dir", default=None,
         help="with --batch: persistent XLA compile cache directory")
-    parser.add_argument("-a", "--algo", required=True,
-                        help="algorithm name")
+    parser.add_argument("-a", "--algo", default=None,
+                        help="algorithm name (required unless --auto)")
+    parser.add_argument(
+        "--auto", action="store_true",
+        help="let the learned portfolio pick the (algo, engine, "
+        "chunk, ...) config for this instance: hard feasibility "
+        "masks first, then the trained cost model's argmin "
+        "(--portfolio-model), degrading to the pre-portfolio hand "
+        "heuristics when no model is given; the chosen config and "
+        "the predicted-vs-actual gap land in metrics['portfolio'] "
+        "(docs/portfolio.rst)")
+    parser.add_argument(
+        "--portfolio-model", default=None,
+        help="with --auto: trained cost model (.npz from "
+        "'pydcop_tpu portfolio train'); omitted = heuristic fallback")
+    parser.add_argument(
+        "--portfolio-grid", default="default",
+        choices=["default", "tiny"],
+        help="with --auto: config grid to score")
     parser.add_argument(
         "-p", "--algo_params", action="append",
         help="algorithm parameter as name:value, repeatable",
@@ -125,6 +142,37 @@ def run_cmd(args):
     from pydcop_tpu.dcop import load_dcop_from_file
     from pydcop_tpu.runtime import solve_result
 
+    if args.auto and args.algo:
+        output_metrics(
+            {"status": "ERROR",
+             "error": "--auto and -a/--algo are mutually exclusive: "
+             "--auto picks the algorithm itself"},
+            args.output,
+        )
+        return 1
+    if not args.auto and not args.algo:
+        output_metrics(
+            {"status": "ERROR",
+             "error": "one of -a/--algo or --auto is required"},
+            args.output,
+        )
+        return 1
+    if args.auto:
+        if (args.batch or args.distribution or args.checkpoint
+                or args.resume or args.headroom is not None
+                or args.dpop_budget_mb is not None
+                or args.i_bound is not None or args.dpop_no_prune):
+            output_metrics(
+                {"status": "ERROR",
+                 "error": "--auto does not combine with --batch, "
+                 "--distribution, checkpointing, --headroom or the "
+                 "--dpop-* shorthands; it owns the engine "
+                 "configuration"},
+                args.output,
+            )
+            return 1
+        return _run_auto(args)
+
     if args.batch:
         return _run_batch(args)
 
@@ -209,6 +257,61 @@ def run_cmd(args):
                 ui.update_state(**res.metrics())
             ui.stop()
 
+    metrics = res.metrics()
+    if args.run_metrics and res.history:
+        for h in res.history:
+            add_csvline(
+                args.run_metrics, args.collect_on,
+                {**metrics, **h, "status": "RUNNING"},
+            )
+    if args.end_metrics:
+        add_csvline(args.end_metrics, args.collect_on, metrics)
+    output_metrics(metrics, args.output)
+    return 0 if res.status in ("FINISHED", "TIMEOUT") else 1
+
+
+def _run_auto(args):
+    """``solve --auto``: the learned portfolio picks the config
+    (docs/portfolio.rst).  The chosen config, model provenance and
+    predicted-vs-actual gap ride in metrics['portfolio']; with no
+    --portfolio-model the selection is exactly the pre-portfolio hand
+    heuristics (fallback=true)."""
+    from pydcop_tpu.dcop import load_dcop_from_file
+    from pydcop_tpu.portfolio.select import GRIDS, solve_auto
+
+    try:
+        dcop = load_dcop_from_file(args.dcop_files)
+    except Exception as e:
+        output_metrics({"status": "ERROR", "error": str(e)}, args.output)
+        return 1
+    warn_process_mode(args.mode)
+    ui = None
+    if args.uiport:
+        from pydcop_tpu.runtime.events import event_bus
+        from pydcop_tpu.runtime.ui import UiServer
+
+        event_bus.enabled = True
+        ui = UiServer(port=args.uiport)
+        ui.start()
+    try:
+        res = solve_auto(
+            dcop,
+            model=args.portfolio_model,
+            grid=GRIDS[args.portfolio_grid],
+            seed=args.seed,
+            timeout=args.timeout,
+            cycles=args.cycles,
+            collect_cycles=args.run_metrics is not None
+            or args.collect_on == "cycle_change",
+        )
+    except Exception as e:
+        output_metrics({"status": "ERROR", "error": str(e)}, args.output)
+        return 1
+    finally:
+        if ui is not None:
+            if "res" in locals():
+                ui.update_state(**res.metrics())
+            ui.stop()
     metrics = res.metrics()
     if args.run_metrics and res.history:
         for h in res.history:

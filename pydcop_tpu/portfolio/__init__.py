@@ -1,0 +1,64 @@
+"""Learned algorithm-portfolio layer (ROADMAP item 4, arXiv:2112.04187).
+
+The framework exposes ~8 engines x {single-chip, sharded, batched, warm}
+x {overlap modes, chunk sizes, boundary thresholds, DPOP budgets /
+i-bounds}; every choice used to be a hand-set CLI flag or a hand-tuned
+heuristic.  This package replaces that with a small learned performance
+model and an auto-selection policy behind ``solve --auto``:
+
+* :mod:`pydcop_tpu.portfolio.features` — cheap structural featurizer:
+  one fixed-length vector per instance, computed WITHOUT building any
+  cost/util table (counts, domains, arity histogram, pseudo-tree
+  separator profile, boundary cut fractions, planner byte estimates);
+* :mod:`pydcop_tpu.portfolio.dataset` — seeded self-labeling sweep:
+  ``generators/`` families x a declared config grid, labeled with
+  drift-normalized time-to-target-cost, appended to a versioned
+  resumable on-disk dataset (JSONL + npz);
+* :mod:`pydcop_tpu.portfolio.model` — pure-JAX featurized MLP with a
+  hand-rolled Adam (no new deps), save/load of weights +
+  normalization stats, held-out-family evaluation (rank correlation +
+  top-1 regret, not just MSE);
+* :mod:`pydcop_tpu.portfolio.select` — feasibility-masked grid scoring
+  behind ``solve --auto``: hard masks first (memory estimates, backend
+  capabilities — typed refusals stay typed), model argmin second, the
+  pre-existing hand heuristics third (the no-model fallback), with the
+  predicted-vs-actual gap recorded in
+  ``SolveResult.metrics()["portfolio"]`` so the model's honesty is
+  itself benchmarked.
+
+See docs/portfolio.rst for the dataset format, the feature list, the
+training/eval recipe and the ``--auto`` semantics.
+"""
+from pydcop_tpu.portfolio.features import (
+    FEATURE_NAMES,
+    N_FEATURES,
+    encode_config,
+    featurize,
+    featurize_detail,
+)
+from pydcop_tpu.portfolio.select import (
+    DEFAULT_GRID,
+    TINY_GRID,
+    PortfolioConfig,
+    Selection,
+    feasible_grid,
+    heuristic_config,
+    select_config,
+    solve_auto,
+)
+
+__all__ = [
+    "FEATURE_NAMES",
+    "N_FEATURES",
+    "featurize",
+    "featurize_detail",
+    "encode_config",
+    "PortfolioConfig",
+    "Selection",
+    "DEFAULT_GRID",
+    "TINY_GRID",
+    "feasible_grid",
+    "heuristic_config",
+    "select_config",
+    "solve_auto",
+]

@@ -1,0 +1,527 @@
+"""Self-labeling dataset harness for the portfolio cost model.
+
+Unlike the pretrained DCOP cost model of arXiv:2112.04187, this
+framework can generate labeled training data endlessly for free: the
+``generators/`` families produce seeded instances, the config grid
+enumerates the engine knobs, and every (instance, config) cell is one
+ordinary in-process solve whose anytime cost curve yields the label —
+**drift-normalized time-to-target-cost**, where the target is derived
+from the best final cost any config reached on that instance (the
+same self-relative discipline the bench's convergence legs use) and
+normalization multiplies wall seconds by an adjacent calibration
+probe rate so host/tunnel drift cancels (BENCHREF.md).
+
+On-disk format (versioned, append-only, resumable):
+
+* ``rows.jsonl`` — one JSON object per completed cell: the cell key,
+  instance provenance (family/size/seed/params), the instance feature
+  vector, the config dict, the measured wall/cycles/final-cost, a
+  downsampled monotone best-cost-so-far curve ``[[t, cost], ...]``
+  and the probe rate measured adjacent to the run.  Interrupted
+  sweeps resume by cell key: existing rows are skipped, labels are
+  (re)derived at READ time over each instance's full row group, so a
+  partially-swept instance needs no rewriting;
+* ``dataset.npz`` — the materialized training matrix
+  (:func:`training_matrix`): X = instance features ++ config
+  encoding, y = ``log1p(norm time-to-target)``, plus group ids and
+  keys (written by :meth:`PortfolioDataset.write_npz`);
+* ``meta.json`` — format version, grid, sweep parameters.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from time import perf_counter
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pydcop_tpu.portfolio.features import featurize_detail, pair_vector
+from pydcop_tpu.portfolio.select import (
+    PortfolioConfig,
+    feasible_grid,
+)
+
+DATASET_VERSION = 1
+
+#: label derivation defaults: a config "reaches the target" when its
+#: running best cost enters the best-final + slack*span band; a config
+#: that never reaches it is charged ``penalty`` x the group's slowest
+#: observed time (reached time or full wall — rank-preserving, bounded)
+TARGET_SLACK = 0.05
+MISS_PENALTY = 3.0
+
+
+# ---------------------------------------------------------------------------
+# generator families
+# ---------------------------------------------------------------------------
+
+
+def _gc(size: int, seed: int, **kw) -> Any:
+    from pydcop_tpu.generators import generate_graph_coloring
+
+    kw.setdefault("n_colors", 3)
+    kw.setdefault("n_edges", size * 2)
+    return generate_graph_coloring(
+        n_variables=size, soft=True, n_agents=1, seed=seed, **kw
+    )
+
+
+def _ising(size: int, seed: int, **kw) -> Any:
+    from pydcop_tpu.generators import generate_ising
+
+    dcop, _, _ = generate_ising(rows=max(3, size), seed=seed, **kw)
+    return dcop
+
+
+def _smallworld(size: int, seed: int, **kw) -> Any:
+    from pydcop_tpu.generators import generate_smallworld
+
+    return generate_smallworld(n_variables=size, seed=seed, **kw)
+
+
+def _iot(size: int, seed: int, **kw) -> Any:
+    from pydcop_tpu.generators import generate_iot
+
+    return generate_iot(n_devices=size, seed=seed, **kw)
+
+
+def _secp(size: int, seed: int, **kw) -> Any:
+    from pydcop_tpu.generators import generate_secp
+
+    return generate_secp(n_lights=size, seed=seed, **kw)
+
+
+def _meetings(size: int, seed: int, **kw) -> Any:
+    from pydcop_tpu.generators import generate_meeting_scheduling
+
+    kw.setdefault("n_meetings", max(2, size // 2))
+    return generate_meeting_scheduling(n_agents=size, seed=seed, **kw)
+
+
+#: family name → builder(size, seed, **params); the sweep's single
+#: "size" knob maps to each family's natural scale parameter
+FAMILIES: Dict[str, Callable[..., Any]] = {
+    "graphcoloring": _gc,
+    "ising": _ising,
+    "smallworld": _smallworld,
+    "iot": _iot,
+    "secp": _secp,
+    "meetingscheduling": _meetings,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class InstanceSpec:
+    family: str
+    size: int
+    seed: int
+    params: Tuple[Tuple[str, Any], ...] = ()
+
+    def build(self):
+        if self.family not in FAMILIES:
+            raise ValueError(
+                f"unknown generator family {self.family!r}; known: "
+                f"{sorted(FAMILIES)}"
+            )
+        return FAMILIES[self.family](
+            self.size, self.seed, **dict(self.params)
+        )
+
+    def key(self) -> str:
+        tail = ""
+        if self.params:
+            blob = json.dumps(sorted(self.params), sort_keys=True)
+            tail = "/" + hashlib.sha1(blob.encode()).hexdigest()[:8]
+        return f"{self.family}/s{self.size}/seed{self.seed}{tail}"
+
+
+@dataclasses.dataclass
+class SweepSpec:
+    """One declared sweep: instances x grid, with the per-cell solve
+    budget.  ``cycles`` bounds every iterative solve (DPOP ignores
+    it); ``timeout_s`` is the per-cell wall cap."""
+
+    instances: Sequence[InstanceSpec]
+    grid: Sequence[PortfolioConfig]
+    cycles: int = 200
+    timeout_s: Optional[float] = 30.0
+
+
+def sweep_spec(
+    families: Sequence[str],
+    sizes: Sequence[int],
+    seeds: Sequence[int],
+    grid: Sequence[PortfolioConfig],
+    cycles: int = 200,
+    timeout_s: Optional[float] = 30.0,
+) -> SweepSpec:
+    """Cartesian helper: every family x size x seed."""
+    instances = [
+        InstanceSpec(f, s, sd)
+        for f in families for s in sizes for sd in seeds
+    ]
+    return SweepSpec(instances, grid, cycles=cycles,
+                     timeout_s=timeout_s)
+
+
+def cell_key(inst: InstanceSpec, cfg: PortfolioConfig) -> str:
+    return f"{inst.key()}::{cfg.key()}"
+
+
+# ---------------------------------------------------------------------------
+# calibration probe (local twin of bench.make_drift_probe — the bench
+# script is not an importable package module)
+# ---------------------------------------------------------------------------
+
+
+def make_probe(dim: int = 256, chain: int = 40, repeat: int = 2):
+    """Small fixed matmul chain timed on the default backend; returns
+    a ``probe() -> rate`` callable (chain steps per second).  Wall
+    seconds x this rate is dimensionless and cancels host drift —
+    the same normalization discipline as the bench's primary."""
+    import jax
+    import jax.numpy as jnp
+
+    x0 = jnp.eye(dim, dtype=jnp.float32) * 0.5 + 0.01
+
+    @jax.jit
+    def run(x):
+        def body(c, _):
+            c = c @ x0
+            c = c / (1.0 + jnp.max(jnp.abs(c)))
+            return c, ()
+
+        c, _ = jax.lax.scan(body, x, None, length=chain)
+        return c
+
+    jax.block_until_ready(run(x0))  # pay the compile outside timing
+
+    def probe() -> float:
+        best = float("inf")
+        for _ in range(max(1, repeat)):
+            t0 = perf_counter()
+            jax.block_until_ready(run(x0))
+            best = min(best, perf_counter() - t0)
+        return chain / best if best > 0 else 0.0
+
+    return probe
+
+
+# ---------------------------------------------------------------------------
+# on-disk dataset
+# ---------------------------------------------------------------------------
+
+
+class PortfolioDataset:
+    """Append-only JSONL + npz dataset directory."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.rows_path = os.path.join(path, "rows.jsonl")
+        self.meta_path = os.path.join(path, "meta.json")
+        self.npz_path = os.path.join(path, "dataset.npz")
+
+    def write_meta(self, extra: Optional[Dict[str, Any]] = None) -> None:
+        meta = {"version": DATASET_VERSION}
+        meta.update(extra or {})
+        with open(self.meta_path, "w", encoding="utf-8") as f:
+            json.dump(meta, f, indent=2, sort_keys=True)
+
+    def read_meta(self) -> Dict[str, Any]:
+        if not os.path.exists(self.meta_path):
+            return {}
+        with open(self.meta_path, encoding="utf-8") as f:
+            return json.load(f)
+
+    def append(self, row: Dict[str, Any]) -> None:
+        with open(self.rows_path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(row, sort_keys=True) + "\n")
+
+    def rows(self) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        if not os.path.exists(self.rows_path):
+            return out
+        with open(self.rows_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    # torn tail line of an interrupted sweep: the cell
+                    # will re-run on resume, skipping is safe
+                    continue
+        return out
+
+    def existing_keys(self) -> set:
+        return {r["key"] for r in self.rows() if "key" in r}
+
+    def write_npz(self, slack: float = TARGET_SLACK,
+                  penalty: float = MISS_PENALTY) -> Dict[str, Any]:
+        X, y, group_ids, keys = training_matrix(
+            self.rows(), slack=slack, penalty=penalty
+        )
+        np.savez(
+            self.npz_path,
+            X=X, y=y,
+            group_ids=np.asarray(group_ids),
+            keys=np.asarray(keys),
+        )
+        return {"rows": int(X.shape[0]),
+                "groups": len(set(group_ids))}
+
+
+# ---------------------------------------------------------------------------
+# labels
+# ---------------------------------------------------------------------------
+
+
+def _sign(objective: str) -> float:
+    return -1.0 if objective == "max" else 1.0
+
+
+def _downsample_curve(history, sign: float,
+                      max_points: int = 64) -> List[List[float]]:
+    """Monotone best-cost-so-far envelope of a metrics history, kept
+    only where the best improves (plus the final point), capped."""
+    curve: List[List[float]] = []
+    best = float("inf")
+    for h in history or []:
+        c = sign * float(h["cost"])
+        if c < best:
+            best = c
+            curve.append([round(float(h["time"]), 6), best])
+    if len(curve) > max_points:
+        idx = np.linspace(0, len(curve) - 1, max_points).astype(int)
+        curve = [curve[i] for i in idx]
+    return curve
+
+
+def time_to_target(row: Dict[str, Any], target: float) -> Optional[float]:
+    """Earliest wall second the row's running best cost entered the
+    target band, None if it never did.  Costs in the curve are already
+    sign-adjusted (minimization convention)."""
+    for t, c in row.get("curve") or []:
+        if c <= target:
+            return float(t)
+    final = row.get("final_cost_signed")
+    if final is not None and float(final) <= target:
+        return float(row["wall_s"])
+    return None
+
+
+def training_matrix(
+    rows: Iterable[Dict[str, Any]],
+    slack: float = TARGET_SLACK,
+    penalty: float = MISS_PENALTY,
+) -> Tuple[np.ndarray, np.ndarray, List[str], List[str]]:
+    """Derive (X, y, group ids, cell keys) from raw rows.
+
+    Labels are group-relative (the target is defined by the best final
+    cost ANY config reached on that instance), so they are computed
+    here at read time — a resumed sweep that adds rows to an instance
+    group changes every sibling's label consistently without
+    rewriting the JSONL."""
+    by_group: Dict[str, List[Dict[str, Any]]] = {}
+    for r in rows:
+        if r.get("status") not in ("FINISHED", "TIMEOUT"):
+            continue
+        by_group.setdefault(r["instance"], []).append(r)
+
+    X_rows: List[np.ndarray] = []
+    y_rows: List[float] = []
+    group_ids: List[str] = []
+    keys: List[str] = []
+    for gid in sorted(by_group):
+        group = by_group[gid]
+        finals = np.asarray(
+            [float(r["final_cost_signed"]) for r in group]
+        )
+        best = float(finals.min())
+        span = float(finals.max()) - best
+        target = best + slack * span + 1e-9
+        hits = [time_to_target(r, target) for r in group]
+        reach_base = max(
+            (h if h is not None else float(r["wall_s"]))
+            for h, r in zip(hits, group)
+        )
+        for r, hit in zip(group, hits):
+            t = hit if hit is not None else penalty * reach_base
+            norm = t * float(r.get("probe_rate") or 1.0)
+            cfg = PortfolioConfig.from_dict(r["config"])
+            X_rows.append(pair_vector(
+                np.asarray(r["features"], dtype=np.float32), cfg
+            ))
+            y_rows.append(float(np.log1p(max(0.0, norm))))
+            group_ids.append(gid)
+            keys.append(r["key"])
+    if not X_rows:
+        return (np.zeros((0, 1), np.float32),
+                np.zeros((0,), np.float32), [], [])
+    return (np.stack(X_rows).astype(np.float32),
+            np.asarray(y_rows, dtype=np.float32), group_ids, keys)
+
+
+def split_holdout(
+    X: np.ndarray, y: np.ndarray, group_ids: List[str],
+    holdout: Sequence[str],
+) -> Tuple[Tuple[np.ndarray, np.ndarray, List[str]],
+           List[Tuple[np.ndarray, np.ndarray]]]:
+    """((train X, train y, train group ids), held-out per-instance
+    groups).  ``holdout`` names generator families (matched against
+    the group id's family prefix) — held-out families never
+    contribute a training row.  The train group ids feed the ranking
+    loss of :func:`portfolio.model.train_model`."""
+    hold = set(holdout)
+    train_idx = []
+    held: Dict[str, List[int]] = {}
+    for i, gid in enumerate(group_ids):
+        fam = gid.split("/", 1)[0]
+        if fam in hold:
+            held.setdefault(gid, []).append(i)
+        else:
+            train_idx.append(i)
+    groups = [
+        (X[idx], y[idx]) for _, idx in sorted(held.items())
+    ]
+    ti = np.asarray(train_idx, dtype=int)
+    return (X[ti], y[ti], [group_ids[i] for i in train_idx]), groups
+
+
+# ---------------------------------------------------------------------------
+# the sweep
+# ---------------------------------------------------------------------------
+
+
+def run_cell(
+    dcop,
+    cfg: PortfolioConfig,
+    cycles: int,
+    timeout_s: Optional[float],
+    seed: int,
+) -> Dict[str, Any]:
+    """One labeled solve: run ``cfg`` on ``dcop`` with the metrics
+    history collected, return the raw measurement fields of a row."""
+    from pydcop_tpu.runtime.run import solve_result
+
+    sign = _sign(dcop.objective)
+    t0 = perf_counter()
+    try:
+        res = solve_result(
+            dcop,
+            cfg.algo,
+            cycles=cycles if cfg.algo != "dpop" else None,
+            timeout=timeout_s,
+            algo_params=cfg.algo_params(),
+            seed=seed,
+            collect_cycles=True,
+            **cfg.solve_kwargs(),
+        )
+        wall = perf_counter() - t0
+        return {
+            "status": res.status,
+            "wall_s": round(wall, 6),
+            "cycles": res.cycle,
+            "final_cost": res.cost,
+            "final_cost_signed": (
+                sign * float(res.cost) if res.cost is not None
+                else None
+            ),
+            "curve": _downsample_curve(res.history, sign) or (
+                [[round(wall, 6), sign * float(res.cost)]]
+                if res.cost is not None else []
+            ),
+        }
+    except Exception as e:
+        return {
+            "status": "ERROR",
+            "error": f"{type(e).__name__}: {e}",
+            "wall_s": round(perf_counter() - t0, 6),
+            "cycles": 0,
+            "final_cost": None,
+            "final_cost_signed": None,
+            "curve": [],
+        }
+
+
+def run_sweep(
+    spec: SweepSpec,
+    out_dir: str,
+    probe=None,
+    resume: bool = True,
+) -> Dict[str, Any]:
+    """Execute (or resume) a sweep into ``out_dir``.
+
+    Every completed cell appends one JSONL row immediately, so an
+    interrupted sweep loses at most the in-flight cell; with
+    ``resume=True`` (default) existing cell keys are skipped.  Emits
+    ``portfolio.dataset.progress`` per cell and a final
+    ``portfolio.dataset.done``; returns the summary dict."""
+    from pydcop_tpu.runtime.events import send_portfolio
+
+    ds = PortfolioDataset(out_dir)
+    ds.write_meta({
+        "grid": [c.as_dict() for c in spec.grid],
+        "cycles": spec.cycles,
+        "timeout_s": spec.timeout_s,
+        "n_instances": len(list(spec.instances)),
+    })
+    existing = ds.existing_keys() if resume else set()
+    if probe is None:
+        probe = make_probe()
+    done = skipped = errors = 0
+    masked_total = 0
+    t_start = perf_counter()
+    for inst in spec.instances:
+        dcop = inst.build()
+        features, info = featurize_detail(dcop)
+        feasible, masked = feasible_grid(spec.grid, info)
+        masked_total += len(masked)
+        for cfg in feasible:
+            key = cell_key(inst, cfg)
+            if key in existing:
+                skipped += 1
+                continue
+            rate = probe()
+            cell = run_cell(dcop, cfg, spec.cycles, spec.timeout_s,
+                            inst.seed)
+            row = {
+                "v": DATASET_VERSION,
+                "key": key,
+                "instance": inst.key(),
+                "family": inst.family,
+                "size": inst.size,
+                "seed": inst.seed,
+                "objective": dcop.objective,
+                "config": cfg.as_dict(),
+                "features": [round(float(x), 6) for x in features],
+                "probe_rate": round(rate, 3),
+                **cell,
+            }
+            ds.append(row)
+            done += 1
+            if cell["status"] == "ERROR":
+                errors += 1
+            send_portfolio("dataset.progress", {
+                "key": key,
+                "status": cell["status"],
+                "done": done,
+                "skipped": skipped,
+                "wall_s": cell["wall_s"],
+            })
+    summary = {
+        "out_dir": out_dir,
+        "cells_run": done,
+        "cells_skipped": skipped,
+        "cells_error": errors,
+        "cells_masked": masked_total,
+        "wall_s": round(perf_counter() - t_start, 3),
+    }
+    summary.update(ds.write_npz())
+    send_portfolio("dataset.done", summary)
+    return summary

@@ -1,0 +1,234 @@
+"""Structural instance featurizer for the learned portfolio.
+
+One fixed-length feature vector per DCOP instance, computed WITHOUT
+building any cost or util table: everything here is derived from the
+problem's *shape* — variable/factor counts, domain sizes, the arity
+histogram, degree statistics, the pseudo-tree's induced width and
+separator-size profile (:meth:`graph.pseudotree.separators`), the
+reference-partition boundary/cut fractions
+(:func:`parallel.boundary.analyze_boundary` over an 8-shard locality
+partition) and the DPOP planner's byte estimates
+(:func:`ops.dpop_shard.estimate_sweep_bytes`, itself a pure shape
+pass).  That makes featurization cheap enough to run inline in
+``solve --auto`` on a 100k-variable instance (pinned by test) while
+still carrying the signals every routing heuristic in the framework
+has historically keyed on.
+
+Config encoding lives here too (:func:`encode_config`): the model
+scores (instance, config) PAIRS, so a candidate config is embedded as
+a small fixed vector (algo/engine/overlap one-hots + the numeric
+knobs) and concatenated with the instance features.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+#: reference shard count for the boundary/cut features: the partition
+#: quality signal must be comparable across instances, so it is always
+#: measured against the same hypothetical mesh width (the boundary
+#: analysis is a pure host shape pass — no device mesh is built)
+REFERENCE_SHARDS = 8
+
+FEATURE_NAMES: Tuple[str, ...] = (
+    "log1p_n_vars",
+    "log1p_n_factors",
+    "log1p_n_agents",
+    "factor_var_ratio",
+    "dom_min",
+    "dom_mean",
+    "dom_max",
+    "arity1_frac",
+    "arity2_frac",
+    "arity3p_frac",
+    "max_arity",
+    "deg_mean",
+    "log1p_deg_max",
+    "tree_depth_frac",
+    "induced_width",
+    "sep_mean",
+    "sep_p90",
+    "log10_sweep_bytes",
+    "log10_max_node_entries",
+    "cut_fraction_8",
+    "boundary_fraction_8",
+    "objective_is_max",
+)
+
+N_FEATURES = len(FEATURE_NAMES)
+
+#: config-encoding vocabularies (one-hot blocks of
+#: :func:`encode_config`); "harness" is the chunked-scan engine every
+#: round-based solver runs through, the rest are the DPOP engine tiers
+ALGO_CHOICES: Tuple[str, ...] = (
+    "maxsum", "mgm", "dsa", "adsa", "gdba", "dpop",
+)
+ENGINE_CHOICES: Tuple[str, ...] = (
+    "harness", "auto", "minibucket", "sharded",
+)
+OVERLAP_CHOICES: Tuple[str, ...] = ("default", "off", "exact", "stale")
+
+#: length of the config-encoding vector
+CONFIG_ENC_LEN = (
+    len(ALGO_CHOICES) + len(ENGINE_CHOICES) + len(OVERLAP_CHOICES) + 4
+)
+
+CONFIG_ENC_NAMES: Tuple[str, ...] = tuple(
+    [f"algo={a}" for a in ALGO_CHOICES]
+    + [f"engine={e}" for e in ENGINE_CHOICES]
+    + [f"overlap={o}" for o in OVERLAP_CHOICES]
+    + ["log1p_chunk", "boundary_threshold", "i_bound", "log1p_budget_mb"]
+)
+
+
+def structural_buckets(dcop) -> Tuple[List[np.ndarray], int]:
+    """Arity-bucketed factor scopes as variable-index arrays — the
+    SAME shape the partitioner and boundary analysis consume, built
+    straight from the constraint scopes (no table extraction).
+    Returns ``(var_idx_per_bucket, n_vars)``; each bucket is an
+    ``[n_factors, arity]`` int32 array."""
+    var_index = {name: i for i, name in enumerate(dcop.variables)}
+    by_arity: Dict[int, List[List[int]]] = {}
+    for c in dcop.constraints.values():
+        idx = [
+            var_index[v.name] for v in c.dimensions
+            if v.name in var_index
+        ]
+        if idx:
+            by_arity.setdefault(len(idx), []).append(idx)
+    buckets = [
+        np.asarray(rows, dtype=np.int32)
+        for _, rows in sorted(by_arity.items())
+    ]
+    return buckets, len(var_index)
+
+
+def featurize_detail(dcop, n_shards: int = REFERENCE_SHARDS):
+    """Compute the feature vector AND the raw structural numbers the
+    selection policy needs (planner byte estimates, induced width,
+    cut fraction, ...).  Returns ``(vector [N_FEATURES] float32,
+    info dict)``.  Never builds a cost or util table."""
+    from pydcop_tpu.graph import pseudotree as pt
+    from pydcop_tpu.ops.dpop_shard import estimate_sweep_bytes
+    from pydcop_tpu.parallel.boundary import analyze_boundary
+    from pydcop_tpu.parallel.partition import partition_factors
+
+    n_vars = len(dcop.variables)
+    n_factors = len(dcop.constraints)
+    n_agents = len(dcop.agents)
+
+    dom_sizes = np.asarray(
+        [len(v.domain) for v in dcop.variables.values()] or [1],
+        dtype=np.float64,
+    )
+
+    arities = np.zeros(3, dtype=np.float64)  # [1, 2, 3+]
+    max_arity = 0
+    degree = np.zeros(max(1, n_vars), dtype=np.int64)
+    buckets, _nv = structural_buckets(dcop)
+    for b in buckets:
+        a = int(b.shape[1])
+        max_arity = max(max_arity, a)
+        arities[min(a, 3) - 1] += b.shape[0]
+        np.add.at(degree, b.reshape(-1), 1)
+    total_f = max(1.0, float(arities.sum()))
+
+    tree = pt.build_computation_graph(dcop)
+    sep = tree.separators()
+    sep_sizes = np.asarray(
+        [len(s) for s in sep.values()] or [0], dtype=np.float64
+    )
+    induced_width = float(sep_sizes.max())
+    est = estimate_sweep_bytes(tree)
+
+    cut_fraction = 0.0
+    boundary_fraction = 0.0
+    if buckets and n_vars:
+        assigns = partition_factors(buckets, n_vars, n_shards)
+        info_b = analyze_boundary(buckets, assigns, n_vars, n_shards)
+        cut_fraction = float(info_b.cut_fraction)
+        boundary_fraction = float(info_b.boundary_fraction)
+
+    vec = np.asarray([
+        np.log1p(n_vars),
+        np.log1p(n_factors),
+        np.log1p(n_agents),
+        n_factors / max(1, n_vars),
+        float(dom_sizes.min()),
+        float(dom_sizes.mean()),
+        float(dom_sizes.max()),
+        arities[0] / total_f,
+        arities[1] / total_f,
+        arities[2] / total_f,
+        float(max_arity),
+        float(degree.mean()),
+        np.log1p(float(degree.max())),
+        (tree.height + 1) / max(1, n_vars),
+        induced_width,
+        float(sep_sizes.mean()),
+        float(np.percentile(sep_sizes, 90)),
+        np.log10(max(4.0, float(est["bytes"]))),
+        np.log10(max(1.0, float(est["max_node_entries"]))),
+        cut_fraction,
+        boundary_fraction,
+        1.0 if dcop.objective == "max" else 0.0,
+    ], dtype=np.float32)
+    assert vec.shape == (N_FEATURES,)
+
+    info = {
+        "n_vars": n_vars,
+        "n_factors": n_factors,
+        "max_arity": max_arity,
+        "max_domain": int(dom_sizes.max()),
+        "induced_width": int(induced_width),
+        "sweep_bytes": int(est["bytes"]),
+        "max_node_entries": int(est["max_node_entries"]),
+        "cut_fraction": float(cut_fraction),
+        "boundary_fraction": float(boundary_fraction),
+        "objective": dcop.objective,
+    }
+    return vec, info
+
+
+def featurize(dcop, n_shards: int = REFERENCE_SHARDS) -> np.ndarray:
+    """The fixed-length instance feature vector (float32,
+    ``N_FEATURES`` entries, always finite)."""
+    vec, _ = featurize_detail(dcop, n_shards=n_shards)
+    return vec
+
+
+def _one_hot(choices: Tuple[str, ...], value: str) -> List[float]:
+    return [1.0 if value == c else 0.0 for c in choices]
+
+
+def encode_config(cfg: Any) -> np.ndarray:
+    """Fixed-length embedding of a candidate config.
+
+    ``cfg`` is duck-typed (any object with ``algo``, ``engine``,
+    ``chunk``, ``overlap``, ``boundary_threshold``, ``i_bound`` and
+    ``budget_mb`` attributes — :class:`portfolio.select.PortfolioConfig`
+    in practice).  Unknown algos/engines encode as all-zero one-hot
+    blocks, so a grid extension degrades to "some signal" instead of
+    crashing on an old model."""
+    vec = (
+        _one_hot(ALGO_CHOICES, cfg.algo)
+        + _one_hot(ENGINE_CHOICES, cfg.engine)
+        + _one_hot(OVERLAP_CHOICES, cfg.overlap)
+        + [
+            float(np.log1p(max(0, int(cfg.chunk)))),
+            float(cfg.boundary_threshold),
+            float(cfg.i_bound),
+            float(np.log1p(max(0.0, float(cfg.budget_mb)))),
+        ]
+    )
+    out = np.asarray(vec, dtype=np.float32)
+    assert out.shape == (CONFIG_ENC_LEN,)
+    return out
+
+
+def pair_vector(instance_vec: np.ndarray, cfg: Any) -> np.ndarray:
+    """Model input: instance features ++ config encoding."""
+    return np.concatenate(
+        [np.asarray(instance_vec, dtype=np.float32), encode_config(cfg)]
+    )

@@ -1,0 +1,352 @@
+"""Learned cost model: a small pure-JAX MLP over (instance, config)
+feature pairs, trained to predict drift-normalized
+log-time-to-target-cost.
+
+Deliberately dependency-free: the MLP forward pass is a few matmuls,
+Adam is hand-rolled over the parameter pytree (no optax), and the
+whole train step is one jitted function — the model has to load and
+score a ~dozen-config grid in milliseconds inside ``solve --auto``,
+not pull in a training framework.
+
+Evaluation is ranking-first (the selector only ever takes an argmin):
+:func:`evaluate` reports Spearman rank correlation between predicted
+and true labels WITHIN each instance's config group plus the top-1
+regret of the predicted argmin vs the per-instance oracle — MSE rides
+along for debugging but is not the acceptance number.
+
+Persistence: one ``.npz`` holding the layer weights, the
+feature/label normalization statistics and a JSON metadata blob
+(feature names, config vocabularies, calibration probe rate) so a
+loaded model refuses feature vectors of the wrong shape loudly.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MODEL_VERSION = 1
+
+
+def _init_params(n_in: int, hidden: Sequence[int], seed: int):
+    rng = np.random.default_rng(seed)
+    sizes = [n_in] + list(hidden) + [1]
+    params = []
+    for a, b in zip(sizes[:-1], sizes[1:]):
+        scale = np.sqrt(2.0 / a)
+        params.append((
+            (rng.standard_normal((a, b)) * scale).astype(np.float32),
+            np.zeros((b,), dtype=np.float32),
+        ))
+    return params
+
+
+def _forward(params, x):
+    import jax.numpy as jnp
+
+    h = x
+    for W, b in params[:-1]:
+        h = jnp.maximum(h @ W + b, 0.0)
+    W, b = params[-1]
+    return (h @ W + b)[..., 0]
+
+
+class CostModel:
+    """Trained predictor: ``predict(X)`` maps normalized-at-entry raw
+    feature rows to predicted labels in LABEL space (log1p of the
+    drift-normalized time-to-target — see dataset.training_matrix)."""
+
+    def __init__(self, params, x_mean, x_std, y_mean, y_std,
+                 meta: Optional[Dict[str, Any]] = None):
+        self.params = params
+        self.x_mean = np.asarray(x_mean, dtype=np.float32)
+        self.x_std = np.asarray(x_std, dtype=np.float32)
+        self.y_mean = float(y_mean)
+        self.y_std = float(y_std)
+        self.meta = dict(meta or {})
+
+    @property
+    def n_in(self) -> int:
+        return int(self.params[0][0].shape[0])
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        X = np.atleast_2d(np.asarray(X, dtype=np.float32))
+        if X.shape[1] != self.n_in:
+            raise ValueError(
+                f"feature width {X.shape[1]} does not match the "
+                f"model's input width {self.n_in}; the model was "
+                f"trained on a different feature/config schema"
+            )
+        Xn = (X - self.x_mean) / self.x_std
+        y = _forward(self.params, jnp.asarray(Xn))
+        return np.asarray(y) * self.y_std + self.y_mean
+
+    # -- persistence --------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        arrays: Dict[str, np.ndarray] = {
+            "x_mean": self.x_mean,
+            "x_std": self.x_std,
+            "y_stats": np.asarray([self.y_mean, self.y_std],
+                                  dtype=np.float32),
+        }
+        for i, (W, b) in enumerate(self.params):
+            arrays[f"W{i}"] = np.asarray(W)
+            arrays[f"b{i}"] = np.asarray(b)
+        meta = dict(self.meta)
+        meta["version"] = MODEL_VERSION
+        meta["n_layers"] = len(self.params)
+        arrays["meta_json"] = np.frombuffer(
+            json.dumps(meta, sort_keys=True).encode(), dtype=np.uint8
+        )
+        np.savez(path, **arrays)
+
+    @classmethod
+    def load(cls, path: str) -> "CostModel":
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["meta_json"].tobytes()).decode())
+            if meta.get("version") != MODEL_VERSION:
+                raise ValueError(
+                    f"portfolio model {path!r} has version "
+                    f"{meta.get('version')}, this build reads "
+                    f"{MODEL_VERSION}"
+                )
+            params = [
+                (z[f"W{i}"], z[f"b{i}"])
+                for i in range(int(meta["n_layers"]))
+            ]
+            y_mean, y_std = (float(v) for v in z["y_stats"])
+            return cls(params, z["x_mean"], z["x_std"], y_mean, y_std,
+                       meta)
+
+
+def _group_pairs(
+    y: np.ndarray, group_ids: Sequence[str], min_gap: float
+) -> np.ndarray:
+    """Within-group (faster, slower) index pairs whose label gap
+    exceeds ``min_gap`` — the supervision set of the ranking loss.
+    Pairs whose faster side is the group's WINNER are emitted twice:
+    the selector acts on the argmin alone, so getting the winner
+    above everything else matters more than ordering the mid-field."""
+    by_g: Dict[str, List[int]] = {}
+    for i, g in enumerate(group_ids):
+        by_g.setdefault(g, []).append(i)
+    pairs: List[Tuple[int, int]] = []
+    for idx in by_g.values():
+        winner = min(idx, key=lambda i: y[i])
+        for a in idx:
+            for b in idx:
+                if y[a] + min_gap < y[b]:
+                    pairs.append((a, b))
+                    if a == winner:
+                        pairs.append((a, b))
+    return np.asarray(pairs, dtype=np.int32).reshape(-1, 2)
+
+
+def train_model(
+    X: np.ndarray,
+    y: np.ndarray,
+    hidden: Sequence[int] = (48, 48),
+    epochs: int = 300,
+    lr: float = 3e-3,
+    batch: int = 64,
+    l2: float = 1e-4,
+    seed: int = 0,
+    meta: Optional[Dict[str, Any]] = None,
+    group_ids: Optional[Sequence[str]] = None,
+    rank_weight: float = 1.0,
+    rank_margin: float = 0.3,
+) -> Tuple[CostModel, Dict[str, Any]]:
+    """Fit the MLP with hand-rolled Adam.  Inputs are RAW feature rows
+    and RAW labels; normalization statistics are computed here and
+    stored with the model.  Returns ``(model, history)`` where history
+    carries the per-epoch training loss for the eval report.
+
+    With ``group_ids`` (one instance id per row, as produced by
+    ``dataset.training_matrix``) the loss adds a **within-group
+    pairwise ranking hinge**: for every same-instance pair where
+    config *a*'s label beats config *b*'s, the model is pushed to
+    keep ``pred(b) - pred(a)`` above ``rank_margin`` (in normalized
+    label units).  The selector only ever takes a per-instance argmin,
+    so within-group ordering IS the objective — the MSE term alone
+    spends most of its capacity explaining cross-instance scale,
+    which is exactly the variance the argmin never sees.  The MSE
+    term stays in the loss so predictions remain calibrated times for
+    the honesty audit."""
+    import jax
+    import jax.numpy as jnp
+
+    X = np.asarray(X, dtype=np.float32)
+    y = np.asarray(y, dtype=np.float32)
+    if X.ndim != 2 or X.shape[0] != y.shape[0] or X.shape[0] == 0:
+        raise ValueError(
+            f"bad training set: X {X.shape}, y {y.shape}"
+        )
+    x_mean = X.mean(axis=0)
+    x_std = X.std(axis=0)
+    x_std = np.where(x_std < 1e-6, 1.0, x_std).astype(np.float32)
+    y_mean = float(y.mean())
+    y_std = float(y.std()) or 1.0
+    Xn = jnp.asarray((X - x_mean) / x_std)
+    yn = jnp.asarray((y - y_mean) / y_std)
+
+    pairs = np.zeros((0, 2), dtype=np.int32)
+    if group_ids is not None and rank_weight > 0:
+        # min label gap 0.05 in normalized units skips effective ties
+        pairs = _group_pairs(
+            np.asarray((y - y_mean) / y_std), group_ids, 0.05
+        )
+
+    params = [
+        (jnp.asarray(W), jnp.asarray(b))
+        for W, b in _init_params(X.shape[1], hidden, seed)
+    ]
+    m_state = jax.tree_util.tree_map(jnp.zeros_like, params)
+    v_state = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    use_rank = pairs.shape[0] > 0
+
+    def loss_fn(p, xb, yb, pa, pb):
+        pred = _forward(p, xb)
+        mse = jnp.mean((pred - yb) ** 2)
+        reg = sum(jnp.sum(W ** 2) for W, _ in p)
+        loss = mse + l2 * reg
+        if use_rank:
+            sa = _forward(p, pa)
+            sb = _forward(p, pb)
+            loss = loss + rank_weight * jnp.mean(
+                jnp.maximum(0.0, rank_margin - (sb - sa))
+            )
+        return loss
+
+    b1, b2, eps = 0.9, 0.999, 1e-8
+
+    @jax.jit
+    def step(p, m, v, t, xb, yb, pa, pb):
+        loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb, pa, pb)
+        m = jax.tree_util.tree_map(
+            lambda a, g: b1 * a + (1 - b1) * g, m, grads)
+        v = jax.tree_util.tree_map(
+            lambda a, g: b2 * a + (1 - b2) * g * g, v, grads)
+        mh = jax.tree_util.tree_map(lambda a: a / (1 - b1 ** t), m)
+        vh = jax.tree_util.tree_map(lambda a: a / (1 - b2 ** t), v)
+        p = jax.tree_util.tree_map(
+            lambda a, mm, vv: a - lr * mm / (jnp.sqrt(vv) + eps),
+            p, mh, vh)
+        return p, m, v, loss
+
+    rng = np.random.default_rng(seed + 1)
+    n = X.shape[0]
+    bs = min(batch, n)
+    pair_bs = min(256, pairs.shape[0]) if use_rank else 1
+    empty = jnp.zeros((1, X.shape[1]), jnp.float32)
+    losses: List[float] = []
+    t = 0
+    for epoch in range(epochs):
+        order = rng.permutation(n)
+        ep_loss = 0.0
+        n_b = 0
+        for s in range(0, n, bs):
+            idx = jnp.asarray(order[s:s + bs])
+            if use_rank:
+                pi = pairs[rng.integers(0, pairs.shape[0], pair_bs)]
+                pa, pb = Xn[jnp.asarray(pi[:, 0])], Xn[
+                    jnp.asarray(pi[:, 1])]
+            else:
+                pa = pb = empty
+            t += 1
+            params, m_state, v_state, loss = step(
+                params, m_state, v_state, float(t), Xn[idx], yn[idx],
+                pa, pb,
+            )
+            ep_loss += float(loss)
+            n_b += 1
+        losses.append(ep_loss / max(1, n_b))
+    model = CostModel(
+        [(np.asarray(W), np.asarray(b)) for W, b in params],
+        x_mean, x_std, y_mean, y_std, meta,
+    )
+    return model, {"epochs": epochs, "final_loss": losses[-1],
+                   "losses": losses, "rank_pairs": int(pairs.shape[0])}
+
+
+# ---------------------------------------------------------------------------
+# ranking evaluation
+# ---------------------------------------------------------------------------
+
+
+def spearman(a: np.ndarray, b: np.ndarray) -> float:
+    """Spearman rank correlation, numpy-only (no scipy dep)."""
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    if a.size < 2:
+        return 0.0
+
+    def ranks(x):
+        order = np.argsort(x, kind="stable")
+        r = np.empty_like(order, dtype=np.float64)
+        r[order] = np.arange(len(x))
+        # average ties so constant vectors do not fake correlation
+        for val in np.unique(x):
+            sel = x == val
+            if sel.sum() > 1:
+                r[sel] = r[sel].mean()
+        return r
+
+    ra, rb = ranks(a), ranks(b)
+    sa, sb = ra.std(), rb.std()
+    if sa == 0 or sb == 0:
+        return 0.0
+    return float(((ra - ra.mean()) * (rb - rb.mean())).mean() / (sa * sb))
+
+
+def evaluate(
+    model: CostModel,
+    groups: Sequence[Tuple[np.ndarray, np.ndarray]],
+) -> Dict[str, Any]:
+    """Ranking report over held-out instance groups.
+
+    ``groups`` is a sequence of ``(X_group, y_group)`` pairs — one per
+    held-out instance, rows = that instance's grid configs, labels in
+    the same (log) space the model was trained in.  Reports:
+
+    * ``rank_correlation`` — mean within-group Spearman;
+    * ``top1_regret`` — mean of ``expm1(y[argmin pred]) -
+      expm1(y[oracle])`` in normalized-time units (0 = the model's
+      pick IS the oracle pick);
+    * ``top1_regret_ratio`` — mean multiplicative regret
+      ``time(pick)/time(oracle)`` (1.0 = oracle);
+    * ``top1_hits`` — fraction of groups where the pick = oracle;
+    * ``mse`` — plain regression error, for debugging only.
+    """
+    corrs: List[float] = []
+    regrets: List[float] = []
+    ratios: List[float] = []
+    hits = 0
+    sq = 0.0
+    n_rows = 0
+    for Xg, yg in groups:
+        yg = np.asarray(yg, dtype=np.float64)
+        pred = np.asarray(model.predict(Xg), dtype=np.float64)
+        corrs.append(spearman(pred, yg))
+        pick = int(np.argmin(pred))
+        oracle = int(np.argmin(yg))
+        t_pick = float(np.expm1(yg[pick]))
+        t_best = float(np.expm1(yg[oracle]))
+        regrets.append(t_pick - t_best)
+        ratios.append(t_pick / t_best if t_best > 0 else 1.0)
+        hits += 1 if pick == oracle else 0
+        sq += float(((pred - yg) ** 2).sum())
+        n_rows += len(yg)
+    n_g = max(1, len(list(groups)))
+    return {
+        "n_groups": len(corrs),
+        "rank_correlation": round(float(np.mean(corrs or [0.0])), 4),
+        "top1_regret": round(float(np.mean(regrets or [0.0])), 6),
+        "top1_regret_ratio": round(float(np.mean(ratios or [1.0])), 4),
+        "top1_hits": round(hits / n_g, 4),
+        "mse": round(sq / max(1, n_rows), 6),
+    }

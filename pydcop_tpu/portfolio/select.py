@@ -1,0 +1,433 @@
+"""Auto-selection policy behind ``solve --auto``.
+
+The selector scores a declared config grid for an incoming instance in
+three strict stages:
+
+1. **hard feasibility masks** — configs the backend cannot run are
+   removed BEFORE any scoring: DPOP exact tiers whose planner byte
+   estimate (:func:`ops.dpop_shard.estimate_sweep_bytes`, a pure shape
+   pass) exceeds the budget on the available device count, sharded
+   tiers without a mesh to shard over.  Masking is advisory routing
+   only — a user who *forces* an infeasible config still gets the
+   typed refusal (:class:`ops.dpop_shard.UtilTableTooLarge`), never a
+   silent downgrade;
+2. **model argmin** — with a trained :class:`portfolio.model.CostModel`
+   present, every feasible (instance, config) pair is scored and the
+   predicted-fastest config wins;
+3. **heuristic fallback** — with no model, selection degrades to the
+   pre-existing hand heuristics (pinned by test): the PR 9
+   byte-estimate routing decides exact-vs-iterative (DPOP when the
+   planner says the sweep is cheap, MGM otherwise), DPOP's own
+   ``engine="auto"`` tiering keeps routing inside the exact family,
+   and ``overlap="default"`` leaves the sharded engines' PR 5
+   cut-fraction auto-policy in charge of the collective path.
+
+Every auto solve records the chosen config AND the predicted-vs-actual
+gap in ``SolveResult.metrics()["portfolio"]`` so the model's honesty
+is itself benchmarked (the bench's ``auto`` leg aggregates exactly
+this section).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+from time import perf_counter
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from pydcop_tpu.portfolio.features import (
+    featurize_detail,
+    pair_vector,
+)
+
+log = logging.getLogger("pydcop_tpu.portfolio")
+
+#: per-device DPOP table budget the auto grid routes on (MiB) — grid
+#: cells carry their own value; this is the default written into them
+AUTO_DPOP_BUDGET_MB = 64.0
+
+#: no-model fallback: run exact DPOP when the planner's byte estimate
+#: for the whole sweep stays under this (the PR 9 routing signal)
+HEURISTIC_EXACT_BYTES = 16 * 2**20
+#: ... and the per-node refusal cap would not fire either
+HEURISTIC_EXACT_ENTRIES = 10_000_000
+
+
+@dataclasses.dataclass(frozen=True)
+class PortfolioConfig:
+    """One cell of the config grid — the fully-resolved knob set a
+    solve executes under.  The same field schema is recorded by every
+    solver in ``SolveResult.metrics()["config"]``
+    (:func:`runtime.stats.resolved_config`), which is what lets the
+    dataset harness and the gap audit share one label space."""
+
+    algo: str
+    engine: str = "harness"    # harness | auto | minibucket | sharded
+    chunk: int = 0             # 0 = the harness's own chunk policy
+    overlap: str = "default"   # default = PR 5 cut-fraction auto-policy
+    boundary_threshold: float = 0.5
+    budget_mb: float = 0.0     # 0 = engine caps (dpop only)
+    i_bound: int = 0           # 0 = off (dpop only)
+
+    def key(self) -> str:
+        return (
+            f"{self.algo}|{self.engine}|c{self.chunk}|{self.overlap}"
+            f"|t{self.boundary_threshold:g}|b{self.budget_mb:g}"
+            f"|i{self.i_bound}"
+        )
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PortfolioConfig":
+        return cls(**{
+            f.name: d[f.name]
+            for f in dataclasses.fields(cls) if f.name in d
+        })
+
+    # -- execution mapping --------------------------------------------------
+
+    def algo_params(self) -> Dict[str, Any]:
+        """The ``-p``-style algo params this config resolves to."""
+        if self.algo != "dpop":
+            return {}
+        params: Dict[str, Any] = {"engine": self.engine}
+        if self.budget_mb > 0:
+            params["budget_mb"] = float(self.budget_mb)
+        if self.i_bound > 0:
+            params["i_bound"] = int(self.i_bound)
+        return params
+
+    def solve_kwargs(self) -> Dict[str, Any]:
+        """Extra :func:`runtime.run.solve_result` kwargs."""
+        kw: Dict[str, Any] = {}
+        if self.chunk > 0:
+            kw["chunk"] = int(self.chunk)
+        if self.overlap != "default":
+            kw["shard_overlap"] = self.overlap
+            kw["shard_boundary_threshold"] = float(
+                self.boundary_threshold
+            )
+        return kw
+
+
+#: the declared default grid ``solve --auto`` scores: the iterative
+#: engines at both chunk policies (the chunk size changes the PRNG
+#: stream AND the dispatch amortization) plus the exact family's
+#: budgeted auto tier and the bounded mini-bucket fallback
+DEFAULT_GRID: Tuple[PortfolioConfig, ...] = (
+    PortfolioConfig("maxsum"),
+    PortfolioConfig("maxsum", chunk=100),
+    PortfolioConfig("mgm"),
+    PortfolioConfig("mgm", chunk=100),
+    PortfolioConfig("dsa"),
+    PortfolioConfig("dsa", chunk=100),
+    PortfolioConfig("adsa"),
+    PortfolioConfig("gdba"),
+    PortfolioConfig("dpop", engine="auto",
+                    budget_mb=AUTO_DPOP_BUDGET_MB),
+    PortfolioConfig("dpop", engine="minibucket", i_bound=2),
+)
+
+#: 3-cell grid for smokes/tests: one BP engine, one local-search
+#: engine, one exact engine — enough to exercise every selector path
+#: in under a minute on CPU
+TINY_GRID: Tuple[PortfolioConfig, ...] = (
+    PortfolioConfig("mgm"),
+    PortfolioConfig("dsa", chunk=40),
+    PortfolioConfig("dpop", engine="auto",
+                    budget_mb=AUTO_DPOP_BUDGET_MB),
+)
+
+GRIDS: Dict[str, Tuple[PortfolioConfig, ...]] = {
+    "default": DEFAULT_GRID,
+    "tiny": TINY_GRID,
+}
+
+
+def _n_devices() -> int:
+    try:
+        import jax
+
+        return len(jax.devices())
+    except Exception:  # pragma: no cover - backend probing never fatal
+        return 1
+
+
+def feasible_grid(
+    grid: Sequence[PortfolioConfig],
+    info: Dict[str, Any],
+    n_devices: Optional[int] = None,
+) -> Tuple[List[PortfolioConfig], List[Tuple[PortfolioConfig, str]]]:
+    """Split a grid into (feasible, masked-with-reason) for one
+    instance, from the featurizer's raw structural numbers alone.
+
+    The masks mirror the engines' own typed refusals so the selector
+    never *picks* a config that would refuse — but they do not
+    replace those refusals: forcing a masked config still raises the
+    typed error."""
+    n_dev = n_devices if n_devices is not None else _n_devices()
+    feasible: List[PortfolioConfig] = []
+    masked: List[Tuple[PortfolioConfig, str]] = []
+    sweep_bytes = int(info.get("sweep_bytes", 0))
+    max_entries = int(info.get("max_node_entries", 0))
+    for cfg in grid:
+        if cfg.algo != "dpop":
+            feasible.append(cfg)
+            continue
+        if cfg.engine == "sharded" and n_dev < 2:
+            masked.append((cfg, "sharded DPOP needs a multi-device "
+                           "mesh"))
+            continue
+        if cfg.engine in ("auto", "sweep", "sharded"):
+            budget = (
+                int(cfg.budget_mb * 2**20) if cfg.budget_mb > 0
+                else None
+            )
+            cap = (budget or 400 * 2**20) * max(1, n_dev)
+            if sweep_bytes > cap and cfg.i_bound <= 0:
+                masked.append((cfg, (
+                    f"util tables ~{sweep_bytes / 2**20:.0f} MiB "
+                    f"exceed the budget on {n_dev} device(s)"
+                )))
+                continue
+            if max_entries > 100_000_000 * max(1, n_dev):
+                masked.append((cfg, "widest joint table exceeds the "
+                               "per-node entry cap"))
+                continue
+        feasible.append(cfg)
+    return feasible, masked
+
+
+def heuristic_config(info: Dict[str, Any]) -> PortfolioConfig:
+    """The no-model fallback policy — the pre-portfolio hand
+    heuristics, unchanged: exact DPOP when the PR 9 planner estimate
+    says the whole sweep is cheap (its ``engine="auto"`` tiering keeps
+    routing from there), the monotone MGM harness otherwise; in both
+    cases ``overlap="default"`` leaves the PR 5 cut-fraction
+    auto-policy in charge of any sharded collective."""
+    if (info.get("sweep_bytes", 0) <= HEURISTIC_EXACT_BYTES
+            and info.get("max_node_entries", 0)
+            <= HEURISTIC_EXACT_ENTRIES):
+        return PortfolioConfig("dpop", engine="auto",
+                               budget_mb=AUTO_DPOP_BUDGET_MB)
+    return PortfolioConfig("mgm")
+
+
+@dataclasses.dataclass
+class Selection:
+    """Outcome of one grid scoring."""
+
+    config: PortfolioConfig
+    fallback: bool
+    predicted_label: Optional[float]      # model output (log space)
+    predicted_norm_time: Optional[float]  # expm1(label), probe units
+    predicted_s: Optional[float]          # / calibration probe rate
+    scores: Dict[str, float]
+    masked: List[Tuple[str, str]]
+    features: np.ndarray
+    info: Dict[str, Any]
+
+    def as_event(self) -> Dict[str, Any]:
+        return {
+            "config": self.config.as_dict(),
+            "fallback": self.fallback,
+            "predicted_norm_time": self.predicted_norm_time,
+            "n_feasible": len(self.scores) or None,
+            "n_masked": len(self.masked),
+        }
+
+
+def select_config(
+    dcop,
+    grid: Optional[Sequence[PortfolioConfig]] = None,
+    model=None,
+    features: Optional[np.ndarray] = None,
+    info: Optional[Dict[str, Any]] = None,
+    n_devices: Optional[int] = None,
+) -> Selection:
+    """Score the feasible grid for one instance and pick the argmin.
+
+    ``model`` is a loaded :class:`portfolio.model.CostModel` or None
+    (→ heuristic fallback).  ``features``/``info`` can be passed when
+    the caller already featurized (the dataset harness and the serve
+    prewarm path reuse one featurization across calls)."""
+    from pydcop_tpu.runtime.events import send_portfolio
+
+    grid = tuple(grid) if grid is not None else DEFAULT_GRID
+    if features is None or info is None:
+        features, info = featurize_detail(dcop)
+    feasible, masked = feasible_grid(grid, info, n_devices=n_devices)
+    masked_keys = [(c.key(), reason) for c, reason in masked]
+    if not feasible:
+        # every cell masked: fall back to the heuristic pick rather
+        # than refusing a solvable instance (MGM is always runnable)
+        cfg = heuristic_config(info)
+        if cfg.algo == "dpop":
+            cfg = PortfolioConfig("mgm")
+        sel = Selection(cfg, True, None, None, None, {}, masked_keys,
+                        features, info)
+        send_portfolio("config.selected", sel.as_event())
+        return sel
+
+    scores: Dict[str, float] = {}
+    if model is not None:
+        X = np.stack([pair_vector(features, c) for c in feasible])
+        pred = np.asarray(model.predict(X), dtype=np.float64)
+        scores = {
+            c.key(): round(float(p), 6)
+            for c, p in zip(feasible, pred)
+        }
+        best = int(np.argmin(pred))
+        label = float(pred[best])
+        norm_time = float(np.expm1(label))
+        probe_rate = float(model.meta.get("probe_rate") or 0.0)
+        sel = Selection(
+            feasible[best], False, label, norm_time,
+            (norm_time / probe_rate) if probe_rate > 0 else None,
+            scores, masked_keys, features, info,
+        )
+    else:
+        cfg = heuristic_config(info)
+        if cfg not in feasible:
+            cfg = next(
+                (c for c in feasible if c.algo != "dpop"), feasible[0]
+            )
+        sel = Selection(cfg, True, None, None, None, {}, masked_keys,
+                        features, info)
+    send_portfolio("config.selected", sel.as_event())
+    return sel
+
+
+def load_model(model: Union[None, str, Any]):
+    """Normalize the ``model`` argument: None, a path (loaded, with a
+    ``portfolio.model.loaded`` event), or an already-loaded
+    :class:`CostModel` (returned as-is).  A path that fails to load
+    degrades to the heuristic fallback with a warning — an auto solve
+    must never die on a stale model file."""
+    from pydcop_tpu.portfolio.model import CostModel
+    from pydcop_tpu.runtime.events import send_portfolio
+
+    if model is None or isinstance(model, CostModel):
+        return model
+    try:
+        loaded = CostModel.load(model)
+        send_portfolio("model.loaded", {
+            "path": str(model),
+            "n_in": loaded.n_in,
+            "meta": {k: v for k, v in loaded.meta.items()
+                     if k in ("version", "probe_rate", "trained_rows",
+                              "holdout")},
+        })
+        return loaded
+    except Exception as e:
+        log.warning(
+            "portfolio model %r failed to load (%s); degrading to the "
+            "heuristic fallback", model, e,
+        )
+        return None
+
+
+def solve_auto(
+    dcop,
+    model: Union[None, str, Any] = None,
+    grid: Optional[Sequence[PortfolioConfig]] = None,
+    seed: int = 0,
+    timeout: Optional[float] = None,
+    cycles: Optional[int] = None,
+    collect_cycles: bool = False,
+    n_devices: Optional[int] = None,
+):
+    """``solve --auto``: featurize → mask → score → run the winner.
+
+    Returns the winner's :class:`SolveResult` with
+    ``metrics()["portfolio"]`` carrying the chosen config, the model
+    provenance and the predicted-vs-actual audit: ``predicted_*`` is
+    the model's drift-normalized time-to-target estimate,
+    ``actual_solve_s`` the measured wall of this solve (normalized
+    with the model's calibration probe rate when available), and the
+    gap between them is the honesty number the bench tracks.  With no
+    model the prediction fields are None and ``fallback`` is True —
+    the selection is exactly the pre-portfolio heuristics."""
+    from pydcop_tpu.runtime.events import send_portfolio
+    from pydcop_tpu.runtime.run import solve_result
+
+    model_path = model if isinstance(model, str) else None
+    loaded = load_model(model)
+    sel = select_config(dcop, grid=grid, model=loaded,
+                        n_devices=n_devices)
+    cfg = sel.config
+    t0 = perf_counter()
+    res = solve_result(
+        dcop,
+        cfg.algo,
+        timeout=timeout,
+        cycles=cycles,
+        algo_params=cfg.algo_params(),
+        seed=seed,
+        collect_cycles=collect_cycles,
+        **cfg.solve_kwargs(),
+    )
+    wall = perf_counter() - t0
+    probe_rate = (
+        float(loaded.meta.get("probe_rate") or 0.0) if loaded else 0.0
+    )
+    portfolio: Dict[str, Any] = {
+        "config": cfg.as_dict(),
+        "fallback": sel.fallback,
+        "model": model_path or ("<in-memory>" if loaded else None),
+        "predicted_norm_time": sel.predicted_norm_time,
+        "predicted_time_to_target_s": sel.predicted_s,
+        "actual_solve_s": round(wall, 6),
+        "actual_norm_time": (
+            round(wall * probe_rate, 6) if probe_rate > 0 else None
+        ),
+        "n_feasible": len(sel.scores) if sel.scores else None,
+        "n_masked": len(sel.masked),
+        "masked": sel.masked[:8],
+    }
+    if sel.predicted_s is not None:
+        portfolio["gap_s"] = round(wall - sel.predicted_s, 6)
+        if sel.predicted_s > 0:
+            portfolio["gap_ratio"] = round(wall / sel.predicted_s, 4)
+    res.portfolio = portfolio
+    send_portfolio("solve.done", {
+        "config": cfg.as_dict(),
+        "fallback": sel.fallback,
+        "status": res.status,
+        "actual_solve_s": portfolio["actual_solve_s"],
+        "predicted_time_to_target_s": sel.predicted_s,
+    })
+    return res
+
+
+def prewarm_predicted(
+    service,
+    dcops: Sequence[Any],
+    model: Union[None, str, Any] = None,
+    grid: Optional[Sequence[PortfolioConfig]] = None,
+    block: bool = False,
+) -> List[PortfolioConfig]:
+    """Serve-layer hook: pick the predicted config for each expected
+    instance and prewarm the service's bucket runners for the
+    batch-eligible ones — the compile the admission path would
+    otherwise pay cold happens ahead of arrival, keyed by the SAME
+    bucket signatures the scheduler derives later.  Returns the chosen
+    configs (one per dcop, order preserved)."""
+    from pydcop_tpu.batch.engine import SUPPORTED_ALGOS
+
+    loaded = load_model(model)
+    chosen: List[PortfolioConfig] = []
+    items = []
+    for dcop in dcops:
+        sel = select_config(dcop, grid=grid, model=loaded)
+        chosen.append(sel.config)
+        if sel.config.algo in SUPPORTED_ALGOS:
+            items.append(
+                (dcop, sel.config.algo, sel.config.algo_params())
+            )
+    if items:
+        service.prewarm(items, block=block)
+    return chosen

@@ -154,6 +154,27 @@ def send_repair(event: str, payload) -> None:
     event_bus.send(REPAIR_TOPIC_PREFIX + event, payload)
 
 
+#: portfolio topic prefix (pydcop_tpu.portfolio).  Topics:
+#: ``portfolio.dataset.progress`` (cell key, status, done/skipped
+#: counts — one per labeled sweep cell) and ``portfolio.dataset.done``
+#: (summary) from the self-labeling harness,
+#: ``portfolio.model.loaded`` (path, input width, meta) when an auto
+#: solve loads a trained cost model,
+#: ``portfolio.config.selected`` (chosen config, fallback flag,
+#: predicted normalized time, feasible/masked counts) at selection
+#: time, and ``portfolio.solve.done`` (config, status, predicted vs
+#: actual seconds — the honesty audit) after the winner ran —
+#: subscribe with ``portfolio.*`` (the UI server pushes them to
+#: ws/SSE clients alongside ``batch.*``/``serve.*``).
+PORTFOLIO_TOPIC_PREFIX = "portfolio."
+
+
+def send_portfolio(event: str, payload) -> None:
+    """Publish a portfolio auto-selection/dataset event on the global
+    bus (no-op unless observability is enabled)."""
+    event_bus.send(PORTFOLIO_TOPIC_PREFIX + event, payload)
+
+
 #: solve-harness topic prefix (algorithms/base).  Topics:
 #: ``harness.run.done`` (algo, status, cycle + the HarnessCounters
 #: scorecard: host_sync_count, dispatch_wait_s, donated_chunks,

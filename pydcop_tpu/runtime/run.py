@@ -41,6 +41,7 @@ def solve_result(
     checkpoint_every: Optional[int] = None,
     resume: bool = False,
     pipeline: bool = False,
+    chunk: Optional[int] = None,
     shard_overlap: Optional[str] = None,
     shard_boundary_threshold: float = 0.5,
     headroom: Optional[float] = None,
@@ -56,6 +57,12 @@ def solve_result(
     under ``shard_boundary_threshold`` (docs/performance.rst,
     "Boundary-compacted sharding").  The chosen path is recorded in
     ``metrics()['shard']``.
+
+    ``chunk`` overrides the harness's chunk-size policy
+    (algorithms/base.default_chunk) for round-based solvers — the
+    portfolio grid sweeps it as a first-class config knob (the
+    per-chunk PRNG stream depends on it); solvers without a chunk
+    loop (dpop, syncbb) ignore it.
 
     ``pipeline=True`` enables the harness's pipelined chunk dispatch
     for converging (open-ended) runs: the next chunk launches before
@@ -148,6 +155,7 @@ def solve_result(
     return solver.run(
         cycles=stop_cycle, timeout=timeout, collect_cycles=collect_cycles,
         pipeline=pipeline,
+        **({"chunk": chunk} if chunk is not None else {}),
     )
 
 
@@ -308,6 +316,13 @@ def _solve_under_placement(
     assignment = tensors.assignment_from_indices(np.asarray(values))
     violation, cost = dcop.solution_cost(assignment, DEFAULT_INFINITY)
     edges = int(tensors.edge_var.shape[0])
+    from pydcop_tpu.runtime.stats import resolved_config
+
+    config = resolved_config(
+        algo_def.algo, "sharded_mesh",
+        overlap=shard_overlap or "default",
+        boundary_threshold=shard_boundary_threshold,
+    )
     return SolveResult(
         status=status,
         assignment=assignment,
@@ -321,6 +336,7 @@ def _solve_under_placement(
         time=perf_counter() - t0,
         history=history or None,
         shard=sharded.comm_stats(),
+        config=config,
     )
 
 

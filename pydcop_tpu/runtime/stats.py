@@ -316,6 +316,66 @@ class ShardCommCounters:
         )
 
 
+#: the canonical executed-config schema surfaced as
+#: ``SolveResult.metrics()["config"]`` — ONE stable label space for
+#: the portfolio dataset harness, the ``--auto`` gap audit and log
+#: collectors, replacing the per-engine scatter (shard/dpop/harness
+#: sections) these knobs used to hide in.  Every solve path fills
+#: every key; ``None``/0 mean "not applicable on this path" (e.g.
+#: ``i_bound`` outside dpop) and ``"default"`` overlap means the PR 5
+#: cut-fraction auto-policy stayed in charge
+CONFIG_FIELDS = (
+    "algo",                # algorithm name actually executed
+    "engine",              # harness | sweep* | pernode | wholesweep |
+                           # sharded | minibucket | sharded_mesh
+    "chunk",               # harness chunk size (0 = single-shot path)
+    "overlap",             # default | off | exact | stale
+    "boundary_threshold",  # PR 5 auto-policy threshold in force
+    "dpop_budget_mb",      # per-device util-table budget (0 = caps)
+    "i_bound",             # mini-bucket width bound (0 = off)
+)
+
+
+def resolved_config(
+    algo: str,
+    engine: str,
+    chunk: int = 0,
+    overlap: str = "default",
+    boundary_threshold: float = 0.5,
+    dpop_budget_mb: float = 0.0,
+    i_bound: int = 0,
+) -> dict:
+    """Build the canonical config dict (all CONFIG_FIELDS, typed)."""
+    return {
+        "algo": str(algo),
+        "engine": str(engine),
+        "chunk": int(chunk),
+        "overlap": str(overlap),
+        "boundary_threshold": float(boundary_threshold),
+        "dpop_budget_mb": float(dpop_budget_mb),
+        "i_bound": int(i_bound),
+    }
+
+
+#: field names surfaced under ``SolveResult.metrics()["portfolio"]``
+#: by ``solve --auto`` (pydcop_tpu.portfolio.select.solve_auto) — the
+#: chosen config plus the predicted-vs-actual honesty audit
+PORTFOLIO_FIELDS = (
+    "config",                       # chosen PortfolioConfig dict
+    "fallback",                     # True = no model, hand heuristics
+    "model",                        # model path / provenance, or None
+    "predicted_norm_time",          # model's drift-normalized estimate
+    "predicted_time_to_target_s",   # ... / calibration probe rate
+    "actual_solve_s",               # measured wall of this solve
+    "actual_norm_time",             # wall x calibration probe rate
+    "gap_s",                        # actual - predicted (model only)
+    "gap_ratio",                    # actual / predicted (model only)
+    "n_feasible",                   # grid cells scored
+    "n_masked",                     # grid cells feasibility-masked
+    "masked",                       # first few (cell key, reason)
+)
+
+
 class StatsLogger:
     """Accumulate per-cycle rows and dump them as CSV (reference:
     trace_computation, stats.py:81)."""

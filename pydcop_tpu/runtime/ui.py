@@ -56,6 +56,7 @@ class UiServer:
         event_bus.subscribe("shard.*", self._cb_shard)
         event_bus.subscribe("dpop.*", self._cb_dpop)
         event_bus.subscribe("serve.*", self._cb_serve)
+        event_bus.subscribe("portfolio.*", self._cb_portfolio)
 
     # -- event plumbing -----------------------------------------------------
 
@@ -269,6 +270,23 @@ class UiServer:
                                                  float, bool, type(None)))
                  else repr(evt)}))
 
+    def _cb_portfolio(self, topic: str, evt) -> None:
+        """Portfolio auto-selection lifecycle
+        (portfolio.dataset.progress|done, portfolio.model.loaded,
+        portfolio.config.selected, portfolio.solve.done — the learned
+        cost model's dataset sweeps, selections and predicted-vs-
+        actual audits) pushed to GUI clients in the same envelope
+        shape as the shard/dpop forwarding; the SSE /events stream
+        gets them through the wildcard subscription like every
+        topic."""
+        if self._ws is not None:
+            self._ws.send_all(json.dumps(
+                {"evt": "portfolio",
+                 "kind": topic.split(".", 1)[-1],
+                 "data": evt if isinstance(evt, (dict, list, str, int,
+                                                 float, bool, type(None)))
+                 else repr(evt)}))
+
     # -- server -------------------------------------------------------------
 
     def start(self) -> None:
@@ -329,7 +347,8 @@ class UiServer:
         for cb in (self._on_event, self._cb_cycle, self._cb_value,
                    self._cb_add_comp, self._cb_rem_comp, self._cb_fault,
                    self._cb_batch, self._cb_harness, self._cb_shard,
-                   self._cb_dpop, self._cb_serve, self._cb_repair):
+                   self._cb_dpop, self._cb_serve, self._cb_repair,
+                   self._cb_portfolio):
             event_bus.unsubscribe(cb)
         if self._server is not None:
             self._server.shutdown()

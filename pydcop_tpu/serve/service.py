@@ -668,6 +668,27 @@ class SolveService:
         if entries:
             self.cache.prewarm(entries, block=block)
 
+    def prewarm_predicted(
+        self,
+        dcops: Sequence[Any],
+        model=None,
+        grid=None,
+        block: bool = False,
+    ):
+        """Portfolio-informed prewarm: let the learned cost model (or
+        its heuristic fallback) pick the expected config for each
+        anticipated instance, then compile bucket runners for the
+        batch-eligible picks ahead of arrival — the predicted configs
+        decide WHICH (algo, params, shape-family) signatures are worth
+        paying for, instead of the caller hand-listing them
+        (docs/portfolio.rst).  ``model`` is a CostModel, a path, or
+        None (fallback policy).  Returns the chosen configs, one per
+        dcop."""
+        from pydcop_tpu.portfolio.select import prewarm_predicted
+
+        return prewarm_predicted(self, dcops, model=model, grid=grid,
+                                 block=block)
+
     # -- scheduler ----------------------------------------------------------
 
     def _loop(self) -> None:

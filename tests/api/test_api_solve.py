@@ -40,10 +40,13 @@ class TestMaxsum:
         m = res.metrics()
         assert set(m) == {
             "status", "assignment", "cost", "violation", "cycle",
-            "msg_count", "msg_size", "time", "harness",
+            "msg_count", "msg_size", "time", "harness", "config",
         }
         # the harness scorecard rides along for chunked tensor solves
         assert m["harness"]["chunks_dispatched"] > 0
+        # ... as does the canonical executed-config record (ISSUE 10)
+        assert m["config"]["algo"] == "maxsum"
+        assert m["config"]["engine"] == "harness"
 
     def test_csp(self, csp_dcop):
         res = solve_result(csp_dcop, "maxsum", timeout=10)

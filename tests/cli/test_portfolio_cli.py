@@ -1,0 +1,133 @@
+"""CLI surface of the learned portfolio (the `make portfolio-smoke`
+scenario): tiny grid -> dataset sweep -> train -> ``solve --auto``
+end to end on the CPU backend, in under a minute — plus the --auto
+flag validation and the pinned no-model heuristic fallback.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+TUTO = os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "PYTHONPATH": REPO,
+}
+
+
+def run_cli(*args, timeout=180):
+    return subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu", *args],
+        capture_output=True, text=True, timeout=timeout, env=ENV,
+        cwd=REPO,
+    )
+
+
+class TestSolveAutoFallback:
+    def test_auto_without_model_uses_heuristics(self):
+        proc = run_cli("solve", "--auto", "--portfolio-grid", "tiny",
+                       "--cycles", "20", TUTO)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout)
+        assert out["status"] == "FINISHED"
+        pf = out["portfolio"]
+        assert pf["fallback"] is True and pf["model"] is None
+        # the tuto instance is tiny: the PR 9 byte-estimate heuristic
+        # picks exact DPOP, whose optimum on this instance is cost 12
+        assert pf["config"]["algo"] == "dpop"
+        assert out["cost"] == 12
+        # the canonical executed-config section rides along
+        assert out["config"]["algo"] == "dpop"
+
+    def test_auto_rejects_explicit_algo(self):
+        proc = run_cli("solve", "--auto", "-a", "mgm", TUTO)
+        assert proc.returncode != 0
+        assert "mutually exclusive" in json.loads(proc.stdout)["error"]
+
+    def test_algo_or_auto_required(self):
+        proc = run_cli("solve", TUTO)
+        assert proc.returncode != 0
+        assert "--auto" in json.loads(proc.stdout)["error"]
+
+    def test_auto_rejects_batch(self):
+        proc = run_cli("solve", "--auto", "--batch", TUTO)
+        assert proc.returncode != 0
+        assert "--auto" in json.loads(proc.stdout)["error"]
+
+
+class TestPortfolioSmoke:
+    """dataset -> train -> select -> solve --auto, all through the
+    CLI, on a tiny grid and tiny instances (the `make portfolio-smoke`
+    budget: under a minute on the CPU backend)."""
+
+    @pytest.fixture(scope="class")
+    def workdir(self, tmp_path_factory):
+        return tmp_path_factory.mktemp("portfolio")
+
+    def test_end_to_end(self, workdir):
+        ds = str(workdir / "ds")
+        model = str(workdir / "model.npz")
+
+        proc = run_cli(
+            "portfolio", "dataset", "--out", ds,
+            "--families", "graphcoloring,ising",
+            "--sizes", "6", "--seeds", "0,1", "--grid", "tiny",
+            "--cycles", "25", "--cell-timeout", "20",
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout)
+        assert out["status"] == "FINISHED" and out["cells_run"] > 0
+        assert out["cells_error"] == 0
+        assert os.path.exists(os.path.join(ds, "rows.jsonl"))
+        assert os.path.exists(os.path.join(ds, "dataset.npz"))
+
+        # resumable by cell key: a second sweep runs nothing
+        proc = run_cli(
+            "portfolio", "dataset", "--out", ds,
+            "--families", "graphcoloring,ising",
+            "--sizes", "6", "--seeds", "0,1", "--grid", "tiny",
+            "--cycles", "25", "--cell-timeout", "20",
+        )
+        out = json.loads(proc.stdout)
+        assert out["cells_run"] == 0 and out["cells_skipped"] > 0
+
+        proc = run_cli(
+            "portfolio", "train", "--data", ds, "--model", model,
+            "--holdout", "ising", "--epochs", "80",
+            "--hidden", "16,16",
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout)
+        assert out["status"] == "FINISHED"
+        assert os.path.exists(model)
+        ev = out["holdout_eval"]
+        for k in ("rank_correlation", "top1_regret",
+                  "top1_regret_ratio", "top1_hits"):
+            assert k in ev
+
+        proc = run_cli(
+            "portfolio", "select", "--model", model, "--grid", "tiny",
+            TUTO,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        sel = json.loads(proc.stdout)["selections"][TUTO]
+        assert sel["fallback"] is False and sel["scores"]
+
+        proc = run_cli(
+            "solve", "--auto", "--portfolio-model", model,
+            "--portfolio-grid", "tiny", "--cycles", "25", TUTO,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout)
+        assert out["status"] == "FINISHED"
+        pf = out["portfolio"]
+        assert pf["fallback"] is False
+        assert pf["model"].endswith("model.npz")
+        assert pf["predicted_time_to_target_s"] is not None
+        assert "gap_s" in pf and pf["actual_solve_s"] > 0

@@ -1,0 +1,476 @@
+"""Portfolio subsystem (ISSUE 10): featurizer, self-labeling dataset,
+pure-JAX cost model, feasibility-masked auto-selection and the
+canonical config/portfolio metrics sections.
+
+Pins the acceptance properties: fixed-length finite seed-stable
+feature vectors on every generator family (100k-var extraction under
+a wall budget, no util table), dataset resumability by cell key,
+ranking-quality model evaluation, typed refusals staying typed, and
+``--auto`` degrading to the pre-portfolio hand heuristics when no
+model is present.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.portfolio.features import (
+    CONFIG_ENC_LEN,
+    N_FEATURES,
+    encode_config,
+    featurize,
+    featurize_detail,
+    pair_vector,
+)
+from pydcop_tpu.portfolio.select import (
+    DEFAULT_GRID,
+    TINY_GRID,
+    PortfolioConfig,
+    feasible_grid,
+    heuristic_config,
+    select_config,
+    solve_auto,
+)
+
+
+def _gc(n=10, seed=0, edges=None):
+    from pydcop_tpu.generators import generate_graph_coloring
+
+    return generate_graph_coloring(
+        n_variables=n, n_colors=3, n_edges=edges or 2 * n, soft=True,
+        n_agents=1, seed=seed,
+    )
+
+
+# ---------------------------------------------------------------------------
+# features (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+FAMILY_BUILDERS = {
+    "graphcoloring": lambda seed: _gc(10, seed),
+    "ising": lambda seed: __import__(
+        "pydcop_tpu.generators", fromlist=["generate_ising"]
+    ).generate_ising(rows=4, seed=seed)[0],
+    "smallworld": lambda seed: __import__(
+        "pydcop_tpu.generators", fromlist=["generate_smallworld"]
+    ).generate_smallworld(n_variables=12, seed=seed),
+    "iot": lambda seed: __import__(
+        "pydcop_tpu.generators", fromlist=["generate_iot"]
+    ).generate_iot(n_devices=10, seed=seed),
+    "secp": lambda seed: __import__(
+        "pydcop_tpu.generators", fromlist=["generate_secp"]
+    ).generate_secp(n_lights=6, seed=seed),
+    "meetingscheduling": lambda seed: __import__(
+        "pydcop_tpu.generators", fromlist=["generate_meeting_scheduling"]
+    ).generate_meeting_scheduling(n_agents=4, n_meetings=3, seed=seed),
+}
+
+
+class TestFeatures:
+    @pytest.mark.parametrize("family", sorted(FAMILY_BUILDERS))
+    def test_fixed_length_finite_seed_stable(self, family):
+        build = FAMILY_BUILDERS[family]
+        v1 = featurize(build(3))
+        v2 = featurize(build(3))
+        assert v1.shape == (N_FEATURES,)
+        assert v1.dtype == np.float32
+        assert np.isfinite(v1).all()
+        # same seed -> byte-identical features (determinism rides on
+        # the generator seed audit, satellite 2)
+        assert np.array_equal(v1, v2)
+
+    def test_different_seed_changes_random_families(self):
+        a = featurize(_gc(10, seed=1))
+        b = featurize(_gc(10, seed=2))
+        assert not np.array_equal(a, b)
+
+    def test_detail_info_keys(self):
+        _vec, info = featurize_detail(_gc(8))
+        for k in ("n_vars", "n_factors", "induced_width",
+                  "sweep_bytes", "max_node_entries", "cut_fraction",
+                  "boundary_fraction", "objective"):
+            assert k in info
+        assert info["n_vars"] == 8
+
+    def test_config_encoding_shape_and_onehots(self):
+        cfg = PortfolioConfig("dsa", chunk=100)
+        enc = encode_config(cfg)
+        assert enc.shape == (CONFIG_ENC_LEN,)
+        # exactly one algo bit, one engine bit, one overlap bit
+        assert enc[:6].sum() == 1.0 and enc[2] == 1.0  # dsa
+        assert enc[6:10].sum() == 1.0  # harness
+        assert enc[10:14].sum() == 1.0  # default overlap
+        assert pair_vector(featurize(_gc(6)), cfg).shape == (
+            N_FEATURES + CONFIG_ENC_LEN,
+        )
+
+    def test_100k_vars_under_wall_budget(self):
+        """The featurizer is a pure shape pass: on a 100k-variable
+        ring lattice it must finish well under the pinned budget —
+        it never builds a cost or util table (a single joint table
+        at this width would be astronomically larger than RAM)."""
+        from pydcop_tpu.dcop.dcop import DCOP
+        from pydcop_tpu.dcop.objects import Domain, Variable
+        from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+        V = 100_000
+        dcop = DCOP("ring100k", "min")
+        dom = Domain("c", "color", [0, 1, 2])
+        vs = [Variable(f"v{i:06d}", dom) for i in range(V)]
+        for v in vs:
+            dcop.add_variable(v)
+        m = (np.eye(3) * 5 + 0.25).astype(np.float32)
+        for i in range(V):
+            dcop.add_constraint(NAryMatrixRelation(
+                [vs[i], vs[(i + 1) % V]], m, f"c{i:06d}"
+            ))
+        t0 = time.perf_counter()
+        vec = featurize(dcop)
+        wall = time.perf_counter() - t0
+        assert np.isfinite(vec).all()
+        assert wall < 20.0, f"featurize took {wall:.1f}s on 100k vars"
+
+
+# ---------------------------------------------------------------------------
+# selection: masks, heuristic fallback, typed refusals
+# ---------------------------------------------------------------------------
+
+
+class TestSelection:
+    def test_feasibility_masks_dpop_over_budget(self):
+        info = {"sweep_bytes": 10**12, "max_node_entries": 10**11}
+        feasible, masked = feasible_grid(DEFAULT_GRID, info,
+                                         n_devices=1)
+        keys = {c.key() for c in feasible}
+        assert not any(k.startswith("dpop|auto") for k in keys)
+        # the bounded mini-bucket tier stays feasible — it degrades,
+        # it does not blow memory
+        assert any(c.algo == "dpop" and c.engine == "minibucket"
+                   for c in feasible)
+        assert all(c.algo != "dpop" or c.engine == "minibucket"
+                   for c in feasible)
+        assert masked and all(reason for _c, reason in masked)
+
+    def test_sharded_masked_without_mesh(self):
+        grid = (PortfolioConfig("dpop", engine="sharded"),)
+        info = {"sweep_bytes": 1000, "max_node_entries": 100}
+        feasible, masked = feasible_grid(grid, info, n_devices=1)
+        assert feasible == [] and len(masked) == 1
+        feasible, masked = feasible_grid(grid, info, n_devices=8)
+        assert len(feasible) == 1 and masked == []
+
+    def test_heuristic_fallback_pinned(self):
+        """No model -> the pre-portfolio hand heuristics, exactly:
+        the PR 9 byte-estimate routing picks exact DPOP when the
+        planner says the sweep is cheap, the MGM harness otherwise,
+        and overlap stays on the PR 5 auto-policy default."""
+        cheap = {"sweep_bytes": 1024, "max_node_entries": 729}
+        cfg = heuristic_config(cheap)
+        assert cfg.algo == "dpop" and cfg.engine == "auto"
+        assert cfg.overlap == "default"
+        big = {"sweep_bytes": 10**12, "max_node_entries": 10**11}
+        cfg = heuristic_config(big)
+        assert cfg == PortfolioConfig("mgm")
+
+    def test_select_without_model_is_fallback(self):
+        sel = select_config(_gc(8), grid=TINY_GRID)
+        assert sel.fallback is True
+        assert sel.predicted_label is None
+        assert sel.config == heuristic_config(sel.info)
+
+    def test_typed_refusal_stays_typed(self):
+        """Masking is advisory: FORCING an over-budget exact config
+        still raises the typed UtilTableTooLarge, never a silent
+        downgrade."""
+        from pydcop_tpu.ops.dpop_shard import UtilTableTooLarge
+        from pydcop_tpu.runtime.run import solve_result
+
+        dcop = _gc(12, seed=0, edges=40)
+        with pytest.raises(UtilTableTooLarge):
+            solve_result(dcop, "dpop",
+                         algo_params={"budget_mb": 1e-6})
+
+
+# ---------------------------------------------------------------------------
+# model: training, persistence, ranking eval
+# ---------------------------------------------------------------------------
+
+
+class TestModel:
+    def _synthetic(self, n=160, d=8, seed=0):
+        rng = np.random.default_rng(seed)
+        X = rng.standard_normal((n, d)).astype(np.float32)
+        w = rng.standard_normal(d).astype(np.float32)
+        y = X @ w + 0.01 * rng.standard_normal(n).astype(np.float32)
+        return X, y
+
+    def test_train_learns_ranking_and_roundtrips(self, tmp_path):
+        from pydcop_tpu.portfolio.model import (
+            CostModel,
+            evaluate,
+            train_model,
+        )
+
+        X, y = self._synthetic()
+        model, hist = train_model(X[:120], y[:120], hidden=(16, 16),
+                                  epochs=150, seed=0)
+        assert hist["final_loss"] < 0.1
+        groups = [(X[120 + 8 * i:120 + 8 * (i + 1)],
+                   y[120 + 8 * i:120 + 8 * (i + 1)]) for i in range(5)]
+        report = evaluate(model, groups)
+        assert report["rank_correlation"] > 0.8
+        assert report["top1_regret_ratio"] >= 1.0 or (
+            report["top1_regret"] <= 0.0
+        )
+        path = os.path.join(tmp_path, "m.npz")
+        model.save(path)
+        loaded = CostModel.load(path)
+        assert np.allclose(loaded.predict(X[:4]), model.predict(X[:4]),
+                           atol=1e-5)
+
+    def test_rank_loss_learns_within_group_order(self):
+        """With per-group scale offsets drowning the config signal,
+        the pairwise ranking hinge still recovers the within-group
+        ordering the argmin selector needs."""
+        from pydcop_tpu.portfolio.model import evaluate, train_model
+
+        rng = np.random.default_rng(2)
+        n_groups, n_cfg = 24, 5
+        cfg_feats = np.eye(n_cfg, dtype=np.float32)
+        cfg_effect = np.asarray([0.0, 0.4, 0.8, 1.2, 1.6], np.float32)
+        X_rows, y_rows, gids = [], [], []
+        for g in range(n_groups):
+            inst = rng.standard_normal(3).astype(np.float32)
+            offset = float(rng.uniform(-8, 8))  # dwarfs cfg_effect
+            for c in range(n_cfg):
+                X_rows.append(np.concatenate([inst, cfg_feats[c]]))
+                y_rows.append(offset + cfg_effect[c])
+                gids.append(f"g{g}")
+        X = np.stack(X_rows)
+        y = np.asarray(y_rows, np.float32)
+        model, hist = train_model(
+            X[:100], y[:100], hidden=(16, 16), epochs=300, seed=0,
+            group_ids=gids[:100], rank_weight=2.0,
+        )
+        assert hist["rank_pairs"] > 0
+        held = [(X[100 + 5 * i:105 + 5 * i], y[100 + 5 * i:105 + 5 * i])
+                for i in range(4)]
+        report = evaluate(model, held)
+        # the argmin is the selector's objective: the model must pick
+        # the per-group winner though the offsets bury the MSE signal
+        assert report["top1_hits"] == 1.0
+        assert report["rank_correlation"] > 0.5
+
+    def test_predict_rejects_wrong_width(self, tmp_path):
+        from pydcop_tpu.portfolio.model import train_model
+
+        X, y = self._synthetic(n=32, d=6)
+        model, _ = train_model(X, y, hidden=(8,), epochs=5)
+        with pytest.raises(ValueError, match="width"):
+            model.predict(np.zeros((2, 9), np.float32))
+
+    def test_spearman(self):
+        from pydcop_tpu.portfolio.model import spearman
+
+        a = np.asarray([1.0, 2.0, 3.0, 4.0])
+        assert spearman(a, a * 10 + 3) == pytest.approx(1.0)
+        assert spearman(a, -a) == pytest.approx(-1.0)
+        assert spearman(a, np.ones(4)) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# dataset: labels, resumability
+# ---------------------------------------------------------------------------
+
+
+class TestDataset:
+    def test_label_math(self):
+        from pydcop_tpu.portfolio.dataset import training_matrix
+
+        feats = [0.0] * N_FEATURES
+        cfg_a = PortfolioConfig("mgm").as_dict()
+        cfg_b = PortfolioConfig("dsa").as_dict()
+        rows = [
+            {  # reaches the target band at t=0.5
+                "key": "i1::a", "instance": "i1", "status": "FINISHED",
+                "config": cfg_a, "features": feats, "probe_rate": 2.0,
+                "wall_s": 1.0, "final_cost_signed": 10.0,
+                "curve": [[0.1, 20.0], [0.5, 10.0]],
+            },
+            {  # never reaches it -> charged penalty x slowest reacher
+                "key": "i1::b", "instance": "i1", "status": "FINISHED",
+                "config": cfg_b, "features": feats, "probe_rate": 2.0,
+                "wall_s": 2.0, "final_cost_signed": 50.0,
+                "curve": [[2.0, 50.0]],
+            },
+        ]
+        X, y, gids, keys = training_matrix(rows)
+        assert X.shape == (2, N_FEATURES + CONFIG_ENC_LEN)
+        t = np.expm1(y)  # back to normalized-time units
+        assert t[0] == pytest.approx(0.5 * 2.0, rel=1e-5)
+        # miss penalty: 3 x the group's slowest observed time (the
+        # miss's own 2.0s wall), normalized by the row's probe rate
+        assert t[1] == pytest.approx(3.0 * 2.0 * 2.0, rel=1e-5)
+        assert gids == ["i1", "i1"] and keys == ["i1::a", "i1::b"]
+
+    def test_sweep_resumes_by_cell_key(self, tmp_path):
+        from pydcop_tpu.portfolio.dataset import (
+            PortfolioDataset,
+            run_sweep,
+            sweep_spec,
+        )
+
+        grid = (PortfolioConfig("mgm"), PortfolioConfig("dsa", chunk=20))
+        spec = sweep_spec(["graphcoloring"], [6], [0], grid,
+                          cycles=15, timeout_s=20)
+        out = str(tmp_path / "ds")
+        probe = lambda: 100.0  # noqa: E731 — fixed rate keeps it fast
+        s1 = run_sweep(spec, out, probe=probe)
+        assert s1["cells_run"] == 2 and s1["cells_error"] == 0
+        s2 = run_sweep(spec, out, probe=probe)
+        assert s2["cells_run"] == 0 and s2["cells_skipped"] == 2
+        ds = PortfolioDataset(out)
+        rows = ds.rows()
+        assert len(rows) == 2
+        assert all(len(r["features"]) == N_FEATURES for r in rows)
+        assert all(r["probe_rate"] == 100.0 for r in rows)
+        assert os.path.exists(ds.npz_path)
+        with np.load(ds.npz_path) as z:
+            assert z["X"].shape[0] == 2
+            assert np.isfinite(z["y"]).all()
+
+    def test_holdout_split_excludes_family(self):
+        from pydcop_tpu.portfolio.dataset import split_holdout
+
+        X = np.zeros((4, 3), np.float32)
+        y = np.arange(4, dtype=np.float32)
+        gids = ["ising/s4/seed0", "ising/s4/seed0",
+                "iot/s5/seed0", "iot/s5/seed0"]
+        (trX, trY, tr_gids), held = split_holdout(X, y, gids, ["iot"])
+        assert trX.shape[0] == 2 and len(held) == 1
+        assert tr_gids == ["ising/s4/seed0", "ising/s4/seed0"]
+        assert held[0][1].tolist() == [2.0, 3.0]
+
+
+# ---------------------------------------------------------------------------
+# canonical config section (satellite 1) + the --auto audit
+# ---------------------------------------------------------------------------
+
+
+class TestConfigSection:
+    def test_harness_config_schema(self):
+        from pydcop_tpu.runtime.run import solve_result
+        from pydcop_tpu.runtime.stats import CONFIG_FIELDS
+
+        res = solve_result(_gc(8), "mgm", cycles=6, chunk=5)
+        cfg = res.metrics()["config"]
+        assert tuple(sorted(cfg)) == tuple(sorted(CONFIG_FIELDS))
+        assert cfg["algo"] == "mgm" and cfg["engine"] == "harness"
+        assert cfg["chunk"] == 5
+        assert cfg["overlap"] == "default"
+
+    def test_harness_config_records_policy_chunk(self):
+        from pydcop_tpu.runtime.run import solve_result
+
+        # fixed-cycle no-metrics run -> the policy raises chunk to 100
+        res = solve_result(_gc(8), "dsa", cycles=120)
+        assert res.metrics()["config"]["chunk"] == 100
+
+    def test_dpop_config_records_executed_engine(self):
+        from pydcop_tpu.runtime.run import solve_result
+
+        res = solve_result(_gc(8), "dpop")
+        cfg = res.metrics()["config"]
+        assert cfg["algo"] == "dpop"
+        assert cfg["engine"] in ("sweep", "sweep_perlevel", "pernode",
+                                 "wholesweep")
+        res = solve_result(_gc(8), "dpop",
+                           algo_params={"engine": "minibucket",
+                                        "i_bound": 2})
+        cfg = res.metrics()["config"]
+        assert cfg["engine"] == "minibucket" and cfg["i_bound"] == 2
+
+
+class TestSolveAuto:
+    def test_no_model_degrades_to_heuristics(self):
+        """Acceptance pin: with no trained model present ``--auto``
+        runs exactly the pre-portfolio heuristic choice and says so
+        in metrics()['portfolio']."""
+        dcop = _gc(8)
+        res = solve_auto(dcop, grid=TINY_GRID, cycles=20)
+        m = res.metrics()
+        pf = m["portfolio"]
+        assert pf["fallback"] is True and pf["model"] is None
+        assert pf["predicted_time_to_target_s"] is None
+        _vec, info = featurize_detail(dcop)
+        assert pf["config"] == heuristic_config(info).as_dict()
+        assert m["status"] == "FINISHED"
+        assert "config" in m  # the executed-config section rides too
+
+    def test_with_model_records_gap_audit(self):
+        from pydcop_tpu.portfolio.model import train_model
+
+        dcop = _gc(8)
+        vec, info = featurize_detail(dcop)
+        feasible, _ = feasible_grid(TINY_GRID, info, n_devices=1)
+        X = np.stack([pair_vector(vec, c) for c in feasible])
+        # labels favor the FIRST grid cell deterministically
+        y = np.asarray([0.1 + i for i in range(len(feasible))],
+                       np.float32)
+        Xt = np.tile(X, (8, 1))
+        yt = np.tile(y, 8)
+        model, _ = train_model(Xt, yt, hidden=(16,), epochs=120,
+                               meta={"probe_rate": 50.0})
+        res = solve_auto(dcop, model=model, grid=TINY_GRID, cycles=20)
+        pf = res.metrics()["portfolio"]
+        assert pf["fallback"] is False
+        assert pf["config"]["algo"] == feasible[0].algo
+        assert pf["predicted_time_to_target_s"] is not None
+        assert pf["actual_solve_s"] > 0
+        assert "gap_s" in pf and "gap_ratio" in pf
+        assert pf["n_feasible"] == len(feasible)
+
+    def test_stale_model_path_degrades(self, tmp_path):
+        bad = str(tmp_path / "nope.npz")
+        res = solve_auto(_gc(8), model=bad, grid=TINY_GRID, cycles=15)
+        pf = res.metrics()["portfolio"]
+        assert pf["fallback"] is True
+
+    def test_prewarm_predicted_compiles_expected_signature(self):
+        """Serve integration: the predicted configs decide which
+        bucket signatures the service prewarms — the batch-eligible
+        pick lands in the compile pool so its later admission is a
+        cache hit."""
+        from pydcop_tpu.batch.cache import CompileCache
+        from pydcop_tpu.serve import SolveService
+
+        svc = SolveService(lanes=2, cache=CompileCache(),
+                           max_cycles=63)
+        grid = (PortfolioConfig("mgm"),)
+        chosen = svc.prewarm_predicted([_gc(8)], grid=grid,
+                                       block=True)
+        assert [c.algo for c in chosen] == ["mgm"]
+        assert svc.counters.counts["prewarmed_runners"] >= 1
+        assert svc.cache.stats()["prewarmed"] >= 1
+
+    def test_selection_event_emitted(self):
+        from pydcop_tpu.runtime.events import event_bus
+
+        seen = []
+        cb = lambda t, e: seen.append((t, e))  # noqa: E731
+        event_bus.subscribe("portfolio.*", cb)
+        was = event_bus.enabled
+        event_bus.enabled = True
+        try:
+            solve_auto(_gc(8), grid=TINY_GRID, cycles=10)
+        finally:
+            event_bus.enabled = was
+            event_bus.unsubscribe(cb)
+        topics = [t for t, _ in seen]
+        assert "portfolio.config.selected" in topics
+        assert "portfolio.solve.done" in topics
+        sel_evt = dict(seen[topics.index("portfolio.config.selected")][1])
+        assert sel_evt["fallback"] is True
+        assert "config" in sel_evt

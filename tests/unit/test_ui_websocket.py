@@ -166,6 +166,22 @@ def test_events_are_pushed(served_orchestrator):
      {"reason": "no free variable slot", "capacity_vars": 12}),
     ("repair.recovered", "repair",
      {"time_to_recover_s": 0.04, "cycle": 21, "cost": 3.0}),
+    ("portfolio.dataset.progress", "portfolio",
+     {"key": "graphcoloring/s6/seed0::mgm|harness|c0|default|t0.5|b0|i0",
+      "status": "FINISHED", "done": 3, "skipped": 1,
+      "wall_s": 0.4}),
+    ("portfolio.model.loaded", "portfolio",
+     {"path": "/tmp/model.npz", "n_in": 39,
+      "meta": {"version": 1, "probe_rate": 120.0}}),
+    ("portfolio.config.selected", "portfolio",
+     {"config": {"algo": "mgm", "engine": "harness", "chunk": 0},
+      "fallback": False, "predicted_norm_time": 12.5,
+      "n_feasible": 9, "n_masked": 1}),
+    ("portfolio.solve.done", "portfolio",
+     {"config": {"algo": "dpop", "engine": "auto"},
+      "fallback": True, "status": "FINISHED",
+      "actual_solve_s": 0.8,
+      "predicted_time_to_target_s": None}),
 ])
 def test_lifecycle_topics_forwarded(served_orchestrator, topic,
                                     evt_name, payload):
