@@ -3,7 +3,7 @@
 .PHONY: test unit api cli doctest all-tests bench bench-probe faults \
 	bench-batch batch-smoke bench-harness bench-sharded bench-serve \
 	serve-smoke chaos-smoke bench-churn churn-smoke bench-dpop \
-	dpop-smoke bench-auto portfolio-smoke
+	dpop-smoke bench-auto portfolio-smoke bench-fleet fleet-smoke
 
 test: all-tests
 
@@ -87,6 +87,25 @@ bench-serve:
 serve-smoke:
 	JAX_PLATFORMS=cpu python -m pytest \
 		tests/cli/test_serve_cli.py -q -m 'not slow'
+
+# replicated solve fleet (ISSUE 11): the PR 6 Poisson trace replayed
+# against 1/2/4 replicas behind the signature router — jobs/s + p99
+# scaling, bit-match vs standalone solves, and the kill_replica chaos
+# pin with its recovery-time objective (docs/serving.rst "Fleet
+# deployment and failover", BENCHREF.md "Fleet serve")
+bench-fleet:
+	python bench.py --only fleet
+
+# the fleet failover scenario end-to-end through the CLI: start a
+# 2-replica fleet, kill one replica mid-trace (fault-plan
+# kill_replica — the thread-hosted kill -9), assert every job
+# completes on the peer bit-identically with a finite RTO;
+# slow-marked, so it does NOT run in tier-1 — run it next to
+# serve-smoke/chaos-smoke whenever touching the fleet layer.  The
+# fast (not-slow) fleet CLI tests ride tier-1 via tests/cli.
+fleet-smoke:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/cli/test_fleet_cli.py -q -m slow
 
 # the seeded serve fault plan driven end-to-end through a real service
 # process: raise_in_step / nan_lane / torn_journal_write / stall_tick,

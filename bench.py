@@ -1409,6 +1409,173 @@ def bench_serve(args, probe=None):
     return out
 
 
+def bench_fleet(args, probe=None):
+    """Replicated solve fleet (ISSUE 11): the PR 6 Poisson trace —
+    same seeded arrival process, same mixed-shape graph-coloring
+    family as the serve leg — replayed against 1, 2 and 4 thread-
+    hosted SolveService replicas behind the signature router, then a
+    2-replica run with ``kill_replica`` injected mid-trace.
+
+    Reported:
+
+    * ``fleet_<n>_jobs_per_sec`` + p50/p99 latency per replica count
+      (latency vs the SCHEDULED arrival, like the serve leg) and the
+      ``fleet_scaling_<n>x`` ratios — the jobs/s + tail-latency
+      scaling curve of the horizontal tier.  On a single-CPU host the
+      replicas share one core so near-flat scaling is expected; the
+      curve's job is to pin the coordination overhead (routing,
+      journal streaming, supervision) stays small, and on parallel
+      backends the same harness measures real scale-out;
+    * ``fleet_bitmatch`` — every job of every leg must equal its
+      standalone solve exactly (the determinism contract survives
+      replication);
+    * the chaos pin: ``fleet_kill_*`` — with a replica killed
+      mid-trace, every in-flight job completes on a peer
+      (``fleet_kill_reseated``), results stay bit-identical to the
+      unfailed run, and ``fleet_rto_s`` is the finite recovery-time
+      objective (kill detection -> last orphaned job completed
+      elsewhere).  Checkpoint re-seats are counted so the journal
+      actually being USED is visible, not assumed.
+    """
+    import shutil
+    import tempfile
+
+    from pydcop_tpu.batch.engine import BatchItem, adapter_for
+    from pydcop_tpu.generators import generate_graph_coloring
+    from pydcop_tpu.runtime.faults import Fault, FaultPlan
+    from pydcop_tpu.serve import SolveFleet
+
+    n_jobs = args.serve_jobs
+    rate = args.serve_rate
+    max_cycles = 200
+    sizes = (args.serve_vars, args.serve_vars // 2)
+    dcops = []
+    for i in range(n_jobs):
+        V = sizes[i % len(sizes)]
+        dcops.append(generate_graph_coloring(
+            n_variables=V, n_colors=args.colors, n_edges=V * 3,
+            soft=True, n_agents=1, seed=300 + i,
+        ))
+    rng = np.random.default_rng(args.serve_seed)
+    inter = rng.exponential(1.0 / rate, n_jobs)
+    inter[0] = 0.0
+    offsets = np.cumsum(inter)
+    adapter = adapter_for("dsa")
+
+    # the unfailed anchor: every fleet result must bit-match the
+    # standalone solve of its (instance, seed)
+    baseline = [
+        adapter.build_spec(BatchItem(d, "dsa", seed=i)).solver.run(
+            max_cycles=max_cycles
+        )
+        for i, d in enumerate(dcops)
+    ]
+
+    def replay(fleet):
+        """Submit the trace, wait for every result; returns
+        (latencies vs scheduled arrival, results, wall)."""
+        t0 = time.perf_counter()
+        jids = []
+        for i, d in enumerate(dcops):
+            now = time.perf_counter() - t0
+            if now < offsets[i]:
+                time.sleep(offsets[i] - now)
+            jids.append((fleet.submit(d, "dsa", seed=i),
+                         time.perf_counter() - t0))
+        lat, results = [], []
+        for i, (jid, submitted) in enumerate(jids):
+            res = fleet.result(jid, timeout=300)
+            results.append(res)
+            lat.append((submitted + res.time) - offsets[i])
+        wall = max(
+            s + r.time for (_j, s), r in zip(jids, results)
+        )
+        return lat, results, wall
+
+    def pcts(lat, prefix):
+        return {
+            f"{prefix}_p50_ms": round(
+                float(np.percentile(lat, 50)) * 1e3, 1),
+            f"{prefix}_p99_ms": round(
+                float(np.percentile(lat, 99)) * 1e3, 1),
+        }
+
+    out = {
+        "fleet_jobs": n_jobs,
+        "fleet_rate_jobs_per_sec": rate,
+        "fleet_arrival_seed": args.serve_seed,
+    }
+    bitmatch = True
+    for n in (1, 2, 4):
+        fleet = SolveFleet(replicas=n, lanes=args.serve_lanes,
+                           max_cycles=max_cycles)
+        fleet.prewarm([(d, "dsa") for d in dcops], block=True)
+        fleet.start()
+        lat, results, wall = replay(fleet)
+        fleet.stop(drain=False)
+        bitmatch = bitmatch and all(
+            r.cost == b.cost and r.cycle == b.cycle
+            and r.assignment == b.assignment
+            for r, b in zip(results, baseline)
+        )
+        out[f"fleet_{n}_jobs_per_sec"] = round(n_jobs / wall, 2)
+        out.update(pcts(lat, f"fleet_{n}"))
+    for n in (2, 4):
+        out[f"fleet_scaling_{n}x"] = round(
+            out[f"fleet_{n}_jobs_per_sec"] / out["fleet_1_jobs_per_sec"],
+            2,
+        )
+    out["fleet_bitmatch"] = bitmatch
+
+    # -- the chaos pin: kill one of two replicas mid-trace; every
+    # in-flight job must complete on the peer, bit-identical, with a
+    # finite recovery-time objective.  Tick-driven (the unit tests'
+    # idiom) so the kill DETERMINISTICALLY lands while the doomed
+    # replica holds checkpointed in-flight work — a wall-clock-timed
+    # kill on a fast host can fire into an already-drained fleet and
+    # measure nothing.
+    jd = tempfile.mkdtemp(prefix="bench_fleet_")
+    try:
+        plan = FaultPlan(faults=[Fault(
+            kind="kill_replica", replica=0, cycle=4,
+        )])
+        fleet = SolveFleet(replicas=2, lanes=args.serve_lanes,
+                           max_cycles=max_cycles, journal_dir=jd,
+                           checkpoint_every=1, fault_plan=plan)
+        fleet.prewarm([(d, "dsa") for d in dcops], block=True)
+        jids = [fleet.submit(d, "dsa", seed=i)
+                for i, d in enumerate(dcops)]
+        for _ in range(2000):
+            if not fleet.tick():
+                break
+        results = [fleet.result(j, timeout=10) for j in jids]
+        m = fleet.metrics()
+        out["fleet_kill_all_completed"] = all(
+            r.status == "FINISHED" for r in results
+        )
+        out["fleet_kill_bitmatch"] = all(
+            r.cost == b.cost and r.cycle == b.cycle
+            and r.assignment == b.assignment
+            for r, b in zip(results, baseline)
+        )
+        out["fleet_kill_reseated"] = m["fleet"]["jobs_reseated"]
+        out["fleet_kill_checkpoint_reseats"] = (
+            m["fleet"]["reseat_checkpoint_hits"]
+        )
+        out["fleet_kill_replicas_down"] = m["fleet"]["replicas_down"]
+        rtos = [r["rto_s"] for r in m["recoveries"]
+                if r.get("rto_s") is not None]
+        out["fleet_rto_s"] = round(max(rtos), 4) if rtos else None
+    finally:
+        shutil.rmtree(jd, ignore_errors=True)
+    if probe is not None:
+        pr = probe()
+        if pr:
+            out["fleet_throughput_normalized"] = round(
+                out["fleet_1_jobs_per_sec"] / pr, 6)
+    return out
+
+
 def bench_churn(args, probe=None):
     """Warm-repair churn recovery (ISSUE 8): a seeded sustained
     mutation stream against a LIVE instance — time-to-recover-cost per
@@ -2288,7 +2455,8 @@ def main():
         choices=["all", "maxsum", "dpop", "convergence", "convergence2",
                  "local", "scalefree", "mixed", "sharded",
                  "sharded-inner", "dpop-sharded", "dpop-sharded-inner",
-                 "probe", "batch", "harness", "serve", "churn", "auto"],
+                 "probe", "batch", "harness", "serve", "fleet", "churn",
+                 "auto"],
         default="all",
     )
     # watchdog covers the FULL run: the wholesweep DPOP kernel compile
@@ -2385,7 +2553,7 @@ def main():
     # measurement so both see the same tunnel state
     probe = None
     if args.only in ("all", "maxsum", "probe", "batch", "harness",
-                     "serve", "churn"):
+                     "serve", "fleet", "churn"):
         try:
             probe = make_drift_probe(repeat=args.repeat)
         except Exception as e:
@@ -2513,6 +2681,12 @@ def main():
             extra.update(bench_serve(args, probe=probe))
         except Exception as e:
             extra["serve_error"] = repr(e)
+
+    if args.only in ("all", "fleet"):
+        try:
+            extra.update(bench_fleet(args, probe=probe))
+        except Exception as e:
+            extra["fleet_error"] = repr(e)
 
     if args.only in ("all", "churn"):
         try:

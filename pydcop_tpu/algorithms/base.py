@@ -87,6 +87,12 @@ class SolveResult:
     #: chosen config, model provenance, predicted vs actual), attached
     #: by ``solve --auto`` (pydcop_tpu.portfolio.select.solve_auto)
     portfolio: Optional[Dict[str, Any]] = None
+    #: serving provenance ({"replica", "jid", "resumed", "reseats"}) —
+    #: which solve-service replica actually served this job and under
+    #: which job id, attached by SolveService/SolveFleet completion so
+    #: failover paths stay auditable post-hoc; None for solves that
+    #: never passed through the serve tier
+    serve: Optional[Dict[str, Any]] = None
 
     def metrics(self) -> Dict[str, Any]:
         out = {
@@ -111,6 +117,8 @@ class SolveResult:
             out["config"] = dict(self.config)
         if self.portfolio is not None:
             out["portfolio"] = dict(self.portfolio)
+        if self.serve is not None:
+            out["serve"] = dict(self.serve)
         return out
 
 

@@ -94,6 +94,15 @@ class CompileCache:
             t.join()
         return t
 
+    def has(self, key: Tuple) -> bool:
+        """True when ``key``'s runner is already resident — the warmth
+        probe behind the fleet router's placement decisions: the SAME
+        compile-cache keys the bucket workers resolve double as routing
+        keys, so 'is this replica warm for this signature' is one dict
+        lookup, not a guess (serve/router.py)."""
+        with self._lock:
+            return key in self._fns
+
     def stats(self) -> Dict[str, int]:
         return {
             "hits": self.hits,

@@ -30,6 +30,15 @@ with their retry-after hints, never dropped silently — and
 ``--fault-plan plan.yaml`` arms the seeded serve fault injector
 (``make chaos-smoke`` drives the whole quarantine/supervision
 machinery through it).
+
+``--replicas N`` (N > 1) serves the same trace through the fleet tier
+(docs/serving.rst "Fleet deployment and failover"): N replicated
+services behind a compile-cache-signature router, per-replica journal
+streaming into ``fleet.jsonl``, and failover re-seating — with a
+``kill_replica`` fault in the plan, every in-flight job of the killed
+replica completes on a peer bit-identically (``make fleet-smoke``),
+and the output JSON's ``fleet`` section records the router state,
+per-replica counters and the recovery-time objective.
 """
 from __future__ import annotations
 
@@ -66,6 +75,15 @@ def set_parser(subparsers):
                         "(the trace is recorded in the output JSON)")
     parser.add_argument("--lanes", type=int, default=4,
                         help="lane (slot) count of each service bucket")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="solve-service replicas; > 1 serves "
+                        "through the fleet tier (SolveFleet): jobs "
+                        "route by compile-cache signature onto warm "
+                        "replicas, a dead replica's in-flight jobs "
+                        "re-seat on peers bit-identically, and the "
+                        "output JSON gains a 'fleet' section "
+                        "(docs/serving.rst 'Fleet deployment and "
+                        "failover')")
     parser.add_argument("--deadline", type=float, default=None,
                         help="per-job deadline in seconds (deadline-"
                         "pressured lanes shrink their chunks; expired "
@@ -114,12 +132,21 @@ def run_cmd(args):
     import numpy as np
 
     from pydcop_tpu.dcop import load_dcop_from_file
-    from pydcop_tpu.serve import SolveService
+    from pydcop_tpu.serve import SolveFleet, SolveService
 
     if args.resume and not args.journal_dir:
         output_metrics(
             {"status": "ERROR",
              "error": "--resume requires --journal-dir"},
+            args.output,
+        )
+        return 1
+    if args.resume and args.replicas > 1:
+        output_metrics(
+            {"status": "ERROR",
+             "error": "--resume is a single-service flag; a fleet "
+                      "re-seats a dead replica's jobs on live peers "
+                      "instead of restarting"},
             args.output,
         )
         return 1
@@ -160,14 +187,30 @@ def run_cmd(args):
             )
             return 1
 
-    service = SolveService(
-        lanes=args.lanes,
-        max_cycles=args.max_cycles,
-        journal_dir=args.journal_dir,
-        max_pending=args.max_pending,
-        tenant_quota=args.tenant_quota,
-        fault_plan=fault_plan,
-    )
+    fleet = None
+    if args.replicas > 1:
+        fleet = SolveFleet(
+            replicas=args.replicas,
+            lanes=args.lanes,
+            max_cycles=args.max_cycles,
+            journal_dir=args.journal_dir,
+            max_pending=args.max_pending,
+            tenant_quota=args.tenant_quota,
+            fault_plan=fault_plan,
+            # the production front door shares the persistent XLA
+            # cache dir across replicas and restarts
+            shared_xla_cache=bool(args.journal_dir),
+        )
+        service = fleet  # same submit/result/stop surface below
+    else:
+        service = SolveService(
+            lanes=args.lanes,
+            max_cycles=args.max_cycles,
+            journal_dir=args.journal_dir,
+            max_pending=args.max_pending,
+            tenant_quota=args.tenant_quota,
+            fault_plan=fault_plan,
+        )
     n_resumed = 0
     if args.resume:
         n_resumed = service.resume()
@@ -236,7 +279,12 @@ def run_cmd(args):
             m = res.metrics()
             m["tenant"] = job.tenant
             m["label"] = job.label
-            m["resumed"] = job.resumed
+            # fleet jobs carry re-seat provenance instead of a resumed
+            # flag; surface both through the same key
+            m["resumed"] = bool(
+                getattr(job, "resumed", False)
+                or (m.get("serve") or {}).get("resumed")
+            )
             per_job[jid] = m
             if res.status not in ("FINISHED", "TIMEOUT"):
                 ok = False
@@ -245,22 +293,23 @@ def run_cmd(args):
         if ui is not None:
             ui.stop()
 
-    output_metrics(
-        {
-            "status": "FINISHED" if ok and not errors else "ERROR",
-            "results": per_job,
-            "serve": service.metrics(),
-            "arrival": {
-                "model": args.arrival,
-                "rate": args.rate,
-                "seed": args.arrival_seed,
-                "trace": trace,
-            },
-            "rejected": rejected,
-            "resumed_jobs": n_resumed,
+    payload = {
+        "status": "FINISHED" if ok and not errors else "ERROR",
+        "results": per_job,
+        "arrival": {
+            "model": args.arrival,
+            "rate": args.rate,
+            "seed": args.arrival_seed,
+            "trace": trace,
         },
-        args.output,
-    )
+        "rejected": rejected,
+        "resumed_jobs": n_resumed,
+    }
+    if fleet is not None:
+        payload["fleet"] = fleet.metrics()
+    else:
+        payload["serve"] = service.metrics()
+    output_metrics(payload, args.output)
     return 0 if ok and not errors else 1
 
 

@@ -102,6 +102,31 @@ def send_serve(event: str, payload) -> None:
     event_bus.send(SERVE_TOPIC_PREFIX + event, payload)
 
 
+#: solve-fleet topic prefix (pydcop_tpu.serve.fleet).  Topics:
+#: ``fleet.replica.up`` / ``fleet.replica.down`` (name, reason — a
+#: replica joined the fleet / was declared dead by the supervisor),
+#: ``fleet.replica.stalled`` / ``fleet.replica.healed`` (stale
+#: heartbeat detected / recovered — routed around, never re-seated),
+#: ``fleet.replica.partitioned`` (unreachable for new placements),
+#: ``fleet.router.placed`` (jid, replica, key, warm — one per routed
+#: job: the compile-cache routing-key decision made for it),
+#: ``fleet.job.reseated`` (jid, from, to, checkpoint — a dead
+#: replica's in-flight job re-seated on a peer via the resume
+#: protocol), ``fleet.job.rejected`` (fleet-level admission control)
+#: and ``fleet.recovery.done`` (replica, jobs, rto_s — every job of a
+#: lost replica completed elsewhere; rto_s is the recovery-time
+#: objective measured from kill detection) — subscribe with
+#: ``fleet.*`` (the UI server pushes them to ws/SSE clients alongside
+#: ``serve.*``).
+FLEET_TOPIC_PREFIX = "fleet."
+
+
+def send_fleet(event: str, payload) -> None:
+    """Publish a solve-fleet lifecycle event on the global bus (no-op
+    unless observability is enabled)."""
+    event_bus.send(FLEET_TOPIC_PREFIX + event, payload)
+
+
 #: sharded-collective topic prefix (parallel/mesh).  Topics:
 #: ``shard.comm.selected`` (mode, collective, cut_fraction,
 #: boundary_columns, bytes_per_cycle_dense/compact, exchange_rounds —

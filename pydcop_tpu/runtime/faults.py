@@ -67,8 +67,21 @@ SERVE_KINDS = ("raise_in_step", "nan_lane", "torn_journal_write",
 #: driver of the sustained-churn bench leg (bench.py churn_recover)
 CHURN_KINDS = ("remove_agent_burst", "add_agent_burst", "edit_factor")
 
+#: fleet-layer fault kinds (consumed by the solve fleet's supervisor,
+#: pydcop_tpu.serve.fleet.SolveFleet, through the same
+#: :class:`ServeFaultInjector`) — ``kill_replica`` hard-stops one
+#: replica's scheduler mid-trace (the thread-hosted twin of kill -9:
+#: in-flight lanes are abandoned where they stand and only the
+#: replica's journal survives), ``stall_replica`` wedges a replica's
+#: scheduler for ``duration`` seconds (its heartbeat goes stale, the
+#: router must route around it WITHOUT re-seating — a stall is not a
+#: death), and ``partition_replica`` makes a replica unreachable for
+#: new placements for ``duration`` seconds (0 = rest of the run) while
+#: its in-flight jobs keep running
+FLEET_KINDS = ("kill_replica", "stall_replica", "partition_replica")
+
 KINDS = ("kill_rank", "stall_rank", "kill_agent", "corrupt_checkpoint",
-         "truncate_checkpoint") + SERVE_KINDS + CHURN_KINDS
+         "truncate_checkpoint") + SERVE_KINDS + CHURN_KINDS + FLEET_KINDS
 
 
 @dataclasses.dataclass
@@ -95,6 +108,9 @@ class Fault:
     count: Optional[int] = None
     #: edit_factor: the constraint to hot-swap (None = seeded choice)
     constraint: Optional[str] = None
+    #: fleet faults: target replica index (kill_replica / stall_replica
+    #: / partition_replica)
+    replica: Optional[int] = None
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -104,8 +120,11 @@ class Fault:
             )
         if self.kind in ("kill_rank", "stall_rank") and self.rank is None:
             raise ValueError(f"{self.kind} fault needs a 'rank'")
-        if self.kind in ("stall_rank", "stall_tick") and self.duration <= 0:
+        if self.kind in ("stall_rank", "stall_tick",
+                         "stall_replica") and self.duration <= 0:
             raise ValueError(f"{self.kind} fault needs a 'duration' > 0")
+        if self.kind in FLEET_KINDS and self.replica is None:
+            raise ValueError(f"{self.kind} fault needs a 'replica'")
         if self.kind == "kill_agent" and not self.agent:
             raise ValueError("kill_agent fault needs an 'agent'")
         if self.kind in ("remove_agent_burst", "add_agent_burst") \
@@ -159,6 +178,17 @@ class FaultPlan:
           - kind: add_agent_burst      # churn: add fresh agents
             cycle: 30
             count: 2
+          - kind: kill_replica         # fleet: hard-stop one replica's
+            replica: 1                 # scheduler mid-trace (thread-
+            cycle: 4                   # hosted kill -9; journal only)
+          - kind: stall_replica        # fleet: wedge a replica; the
+            replica: 0                 # router routes around it, no
+            cycle: 2                   # re-seat (a stall != a death)
+            duration: 0.5
+          - kind: partition_replica    # fleet: unreachable for NEW
+            replica: 1                 # placements for `duration`
+            cycle: 3                   # seconds (0 = rest of run)
+            duration: 1.0
     """
 
     faults: List[Fault] = dataclasses.field(default_factory=list)
@@ -228,6 +258,12 @@ class FaultPlan:
     def serve_faults(self) -> List[Fault]:
         return [f for f in self.faults if f.kind in SERVE_KINDS]
 
+    def fleet_faults(self) -> List[Fault]:
+        """Replica-level faults (kill/stall/partition) consumed by the
+        solve fleet's supervisor (serve/fleet.py) through the same
+        :class:`ServeFaultInjector` consultation protocol."""
+        return [f for f in self.faults if f.kind in FLEET_KINDS]
+
     def churn_faults(self) -> List[Fault]:
         """Agent-churn / live-mutation faults (kill_agent + the burst
         and edit kinds), ordered by cycle — the seeded churn stream the
@@ -272,9 +308,18 @@ class ServeFaultInjector:
       persistence too.
     """
 
-    def __init__(self, plan: FaultPlan):
+    def __init__(self, plan: FaultPlan,
+                 faults: Optional[List[Fault]] = None):
+        """``faults`` overrides the consumed subset: the solve service
+        passes nothing (``plan.serve_faults()``); the solve fleet's
+        supervisor passes ``plan.fleet_faults()`` so replica-level
+        kill/stall/partition kinds flow through the SAME consultation
+        protocol (``due`` at tick boundaries, one-shot unless
+        jid-targeted)."""
         self.plan = plan
-        self._pending: List[Fault] = list(plan.serve_faults())
+        self._pending: List[Fault] = list(
+            plan.serve_faults() if faults is None else faults
+        )
         self.fired: List[Fault] = []
 
     def due(self, kind: str, tick: int,

@@ -196,9 +196,15 @@ SERVE_COUNTERS = (
 class ServeCounters:
     """Continuous-batching service counters collected by the
     SolveService scheduler and merged into its run summary
-    (``SolveService.metrics()['serve']``)."""
+    (``SolveService.metrics()['serve']``).
 
-    def __init__(self):
+    ``replica`` labels which fleet replica this service is (None for a
+    standalone service); it rides the summary so failover paths are
+    auditable post-hoc — every per-job ``metrics()["serve"]`` names the
+    replica that actually served it."""
+
+    def __init__(self, replica: Optional[str] = None):
+        self.replica = replica
         self.counts = {k: 0 for k in SERVE_COUNTERS}
 
     def inc(self, name: str, n: int = 1) -> None:
@@ -206,6 +212,50 @@ class ServeCounters:
             raise KeyError(
                 f"unknown serve counter {name!r}; add it to "
                 f"SERVE_COUNTERS"
+            )
+        self.counts[name] += n
+
+    def as_dict(self) -> dict:
+        out = dict(self.counts)
+        out["replica"] = self.replica
+        return out
+
+
+#: counter names surfaced under ``SolveFleet.metrics()['fleet']`` by
+#: the replicated solve fleet (pydcop_tpu.serve.fleet) — the routing /
+#: failover / recovery scorecard of a fleet session, alongside each
+#: replica's own ServeCounters
+FLEET_COUNTERS = (
+    "jobs_routed",             # jobs placed on a replica by the router
+    "jobs_routed_warm",        # placements onto an already-warm replica
+    "jobs_reseated",           # failover re-seats onto a peer replica
+    "reseat_checkpoint_hits",  # re-seats restored from a lane checkpoint
+    "reseat_cold_restarts",    # re-seats replayed from cycle 0
+    "replicas_up",             # replicas brought up (initial + later)
+    "replicas_down",           # replicas declared dead (kill / crash)
+    "replicas_stalled",        # replicas with a stale heartbeat
+    "replicas_healed",         # stalled/partitioned replicas recovered
+    "replicas_partitioned",    # replicas made unreachable for placement
+    "jobs_shed",               # fleet-level admission rejections
+    "quota_rejections",        # fleet-level per-tenant quota rejections
+    "faults_injected",         # fleet fault-plan faults fired
+    "journal_torn_lines",      # torn fleet-journal lines skipped on load
+    "recoveries_completed",    # replica losses fully recovered (RTO set)
+)
+
+
+class FleetCounters:
+    """Fleet-level counters collected by the SolveFleet supervisor and
+    merged into its run summary (``SolveFleet.metrics()['fleet']``)."""
+
+    def __init__(self):
+        self.counts = {k: 0 for k in FLEET_COUNTERS}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        if name not in self.counts:
+            raise KeyError(
+                f"unknown fleet counter {name!r}; add it to "
+                f"FLEET_COUNTERS"
             )
         self.counts[name] += n
 

@@ -56,6 +56,7 @@ class UiServer:
         event_bus.subscribe("shard.*", self._cb_shard)
         event_bus.subscribe("dpop.*", self._cb_dpop)
         event_bus.subscribe("serve.*", self._cb_serve)
+        event_bus.subscribe("fleet.*", self._cb_fleet)
         event_bus.subscribe("portfolio.*", self._cb_portfolio)
 
     # -- event plumbing -----------------------------------------------------
@@ -240,6 +241,22 @@ class UiServer:
                                                  float, bool, type(None)))
                  else repr(evt)}))
 
+    def _cb_fleet(self, topic: str, evt) -> None:
+        """Solve-fleet lifecycle (fleet.replica.up|down|stalled|
+        healed|partitioned, fleet.router.placed, fleet.job.reseated|
+        rejected, fleet.recovery.done — the replicated front door's
+        routing decisions, failover re-seats and recovery-time
+        records) pushed to GUI clients in the same envelope shape as
+        the serve.* forwarding; the SSE /events stream gets them
+        through the wildcard subscription like every topic."""
+        if self._ws is not None:
+            self._ws.send_all(json.dumps(
+                {"evt": "fleet",
+                 "kind": topic.split(".", 1)[-1],
+                 "data": evt if isinstance(evt, (dict, list, str, int,
+                                                 float, bool, type(None)))
+                 else repr(evt)}))
+
     def _cb_shard(self, topic: str, evt) -> None:
         """Sharded-engine collective/partition lifecycle
         (shard.comm.selected with the ShardCommCounters partition-
@@ -348,7 +365,7 @@ class UiServer:
                    self._cb_add_comp, self._cb_rem_comp, self._cb_fault,
                    self._cb_batch, self._cb_harness, self._cb_shard,
                    self._cb_dpop, self._cb_serve, self._cb_repair,
-                   self._cb_portfolio):
+                   self._cb_fleet, self._cb_portfolio):
             event_bus.unsubscribe(cb)
         if self._server is not None:
             self._server.shutdown()

@@ -8,13 +8,26 @@ priority and an optional deadline, folded into already-running shape
 buckets at chunk boundaries (lane reuse when an instance converges —
 continuous batching), and their results stream back as blocking
 futures, per-job anytime-assignment iterators, and ``serve.*`` events
-on the ws/SSE channel.  See docs/serving.rst.
+on the ws/SSE channel.  :class:`SolveFleet` replicates the service
+horizontally: N replicas behind a compile-cache-keyed router, with
+journal streaming, heartbeat-supervised failover re-seating (results
+bit-identical to an unfailed run) and fleet-level admission control.
+See docs/serving.rst.
 """
 from pydcop_tpu.serve.errors import (  # noqa: F401
     DeadlineInfeasible,
     ServeError,
     ServiceOverloaded,
     ServiceStopped,
+)
+from pydcop_tpu.serve.fleet import (  # noqa: F401
+    FleetJournal,
+    ReplicaHandle,
+    SolveFleet,
+)
+from pydcop_tpu.serve.router import (  # noqa: F401
+    FleetRouter,
+    job_routing_key,
 )
 from pydcop_tpu.serve.scheduler import (  # noqa: F401
     BucketWorker,
@@ -31,13 +44,18 @@ from pydcop_tpu.serve.service import (  # noqa: F401
 __all__ = [
     "BucketWorker",
     "DeadlineInfeasible",
+    "FleetJournal",
+    "FleetRouter",
+    "ReplicaHandle",
     "ServeError",
     "ServeJob",
     "ServiceOverloaded",
     "ServiceStopped",
+    "SolveFleet",
     "SolveService",
     "dummy_bucket_inputs",
     "fits",
+    "job_routing_key",
     "serve_target",
     "warm_bucket_runner",
 ]

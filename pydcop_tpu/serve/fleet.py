@@ -1,0 +1,999 @@
+"""SolveFleet — N replicated solve services behind one front door.
+
+``serve/`` up to PR 7 is a single :class:`SolveService` process: one
+crash loses the front door even though the journal/resume protocol can
+already reconstruct every in-flight job bit-identically.  This module
+is the horizontal tier over those pieces:
+
+* **replicas** — N thread-hosted :class:`SolveService` instances, each
+  with its own scheduler thread, its own in-memory compile cache, its
+  own crash-safe journal directory (``<journal_dir>/replica-<i>/``)
+  and its own heartbeat file touched by the *tick loop* itself (PR 1's
+  :class:`~pydcop_tpu.runtime.faults.HeartbeatWriter` file protocol —
+  a wedged or killed scheduler goes stale, a healthy one cannot);
+* **routing** — jobs place by compile-cache routing key
+  (serve/router.py): the keys ``batch/cache.py`` keys runners by
+  double as placement keys, so same-signature traffic lands on
+  replicas that are already *warm*, not merely alive, and a shared
+  persistent XLA cache dir (level 2) backs every replica's cold path;
+* **journal streaming** — every placement, re-seat and completion
+  streams to a fleet-wide journal (``fleet.jsonl``: fsynced,
+  newline-framed, torn-line-tolerant like the per-replica journals),
+  alongside each replica's own ``jobs.jsonl`` + ``JID:`` completion
+  lines — the post-hoc audit trail of who served what;
+* **failover** — a supervisor detects replica death (halted/killed
+  scheduler, exhausted tick supervisor) and *re-seats* the dead
+  replica's in-flight jobs on peers through the PR 6 resume protocol:
+  a job with a lane checkpoint re-seats at its EXACT padded target
+  (state leaves are target-shaped), a job without one replays from
+  cycle 0 — either way the final result is **bit-identical** to an
+  unfailed run, and the peer's runner is prewarmed at the re-seat
+  signature first so failover pays zero new cache misses;
+* **stall != death** — a replica whose heartbeat goes stale is routed
+  *around* (and healed when the heartbeat resumes), never re-seated:
+  re-seating a stalled-but-alive replica's jobs would race its own
+  completions, the classic false-failover bug.  A ``partition_replica``
+  similarly only bars NEW placements;
+* **admission control** — the per-replica ``max_pending`` bounds
+  aggregate into ONE fleet bound (shrinking as replicas die), with
+  fleet-level per-tenant quotas and a completion-rate-derived
+  ``retry_after`` hint on structured rejections;
+* **chaos** — :class:`~pydcop_tpu.runtime.faults.FaultPlan` gains
+  ``kill_replica`` / ``stall_replica`` / ``partition_replica`` kinds,
+  consumed through the same
+  :class:`~pydcop_tpu.runtime.faults.ServeFaultInjector` tick
+  consultation as the serve-layer kinds, so the whole failover story
+  is deterministically testable (``make fleet-smoke``);
+* **recovery-time objective** — every replica loss opens a recovery
+  record: RTO is the wall time from the injected kill (detection) to
+  the LAST of the dead replica's jobs completing elsewhere, surfaced
+  in :meth:`SolveFleet.metrics` and the ``fleet`` bench leg
+  (``make bench-fleet``).
+
+Lifecycle events ride the bus under ``fleet.*`` (runtime/events.py)
+and reach ws/SSE clients through runtime/ui.py like every family.
+Tests drive :meth:`SolveFleet.tick` synchronously for deterministic
+schedules, exactly like the single-service tests.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from time import monotonic
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from pydcop_tpu.algorithms.base import SolveResult, default_chunk
+from pydcop_tpu.batch.bucketing import InstanceDims, bucket_signature
+from pydcop_tpu.batch.cache import CompileCache, enable_persistent_cache
+from pydcop_tpu.batch.engine import (
+    DEFAULT_MAX_CYCLES,
+    SUPPORTED_ALGOS,
+    _params_key,
+    runner_cache_key,
+)
+from pydcop_tpu.runtime.events import send_fleet
+from pydcop_tpu.runtime.faults import (
+    FaultPlan,
+    ServeFaultInjector,
+    stalled_ranks,
+)
+from pydcop_tpu.runtime.stats import FleetCounters, ServeCounters
+from pydcop_tpu.serve.errors import (
+    DeadlineInfeasible,
+    ServiceOverloaded,
+    ServiceStopped,
+)
+from pydcop_tpu.serve.router import FleetRouter, job_routing_key
+from pydcop_tpu.serve.service import (
+    CKPT_SUBDIR,
+    PROGRESS_FILE,
+    SolveService,
+    restore_target,
+)
+
+#: fleet journal file name inside ``journal_dir``
+FLEET_JOURNAL = "fleet.jsonl"
+#: shared persistent XLA cache subdir (level 2 of the compile cache)
+XLA_CACHE_SUBDIR = "xla-cache"
+
+
+class FleetJournal:
+    """The fleet-wide journal stream (``fleet.jsonl``).
+
+    Every record is one newline-terminated JSON object, appended with
+    flush + fsync (a ``kill -9`` loses at most the in-flight line), and
+    reads are torn-line-tolerant: an unterminated tail or a glued
+    fragment that parses as no record is skipped and *counted*, never
+    fatal — the same discipline as the per-replica journals (PR 7).
+
+    Record kinds: ``{"kind": "job", ...}`` on placement, ``{"kind":
+    "done", "jid", "replica", "status"}`` on completion, ``{"kind":
+    "reseat", "jid", "from", "to", "checkpoint"}`` on failover, and
+    ``{"kind": "replica", "event": "up"|"down", "name"}`` lifecycle
+    markers."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def append(self, rec: Dict[str, Any]) -> None:
+        line = json.dumps(rec, sort_keys=True) + "\n"
+        with self._lock:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(line)
+                f.flush()
+                os.fsync(f.fileno())
+
+    def load(self) -> Tuple[List[Dict[str, Any]], int]:
+        """(records, torn line count) — torn/glued lines are skipped
+        and counted, mirroring the per-replica journal readers."""
+        if not os.path.exists(self.path):
+            return [], 0
+        with open(self.path, encoding="utf-8") as f:
+            raw = f.read()
+        if not raw:
+            return [], 0
+        lines = raw.split("\n")
+        torn = 0
+        if lines and lines[-1] == "":
+            lines.pop()
+        elif lines:
+            lines.pop()  # unterminated tail: a write cut short
+            torn += 1
+        records = []
+        for line in lines:
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                torn += 1  # glued fragment: parses as no record
+                continue
+            if not isinstance(rec, dict) or "kind" not in rec:
+                torn += 1
+                continue
+            records.append(rec)
+        return records, torn
+
+
+@dataclasses.dataclass
+class ReplicaHandle:
+    """One fleet replica: the service plus its supervision state."""
+
+    name: str
+    index: int
+    service: SolveService
+    journal_dir: Optional[str]
+    hb_path: Optional[str]
+    up: bool = True
+    killed: bool = False
+    stalled: bool = False
+    killed_at: Optional[float] = None
+    partition_until: Optional[float] = None
+
+    def kill(self) -> None:
+        """The thread-hosted twin of ``kill -9``: halt the scheduler
+        without draining — in-flight lanes are abandoned, only the
+        replica's journal survives for the supervisor to re-seat
+        from."""
+        self.killed = True
+        self.killed_at = monotonic()
+        self.service.halt()
+
+    @property
+    def dead(self) -> bool:
+        return self.killed or self.service._failure is not None
+
+    def done_jids(self) -> set:
+        """``JID:`` completion lines that reached this replica's disk —
+        the ground truth a re-seat must respect: a job whose completion
+        line survived the crash is DONE, never re-run."""
+        if not self.journal_dir:
+            return set()
+        path = os.path.join(self.journal_dir, PROGRESS_FILE)
+        if not os.path.exists(path):
+            return set()
+        lines, _torn = SolveService._complete_lines(path)
+        return {
+            line[5:].strip() for line in lines
+            if line.startswith("JID: ") and line[5:].strip()
+        }
+
+    def checkpoint_path(self, jid: str) -> Optional[str]:
+        if not self.journal_dir:
+            return None
+        return os.path.join(self.journal_dir, CKPT_SUBDIR, f"{jid}.npz")
+
+
+@dataclasses.dataclass
+class FleetJob:
+    """One fleet-level job and its placement history."""
+
+    jid: str
+    key: Tuple
+    dcop: Any
+    algo: str
+    algo_params: Dict[str, Any]
+    seed: int
+    tenant: str
+    priority: int
+    deadline_s: Optional[float]
+    label: Optional[str]
+    source_file: Optional[str]
+    replica: str
+    submitted_at: float
+    reseats: int = 0
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event
+    )
+    result: Optional[SolveResult] = None
+
+
+class SolveFleet:
+    """N :class:`SolveService` replicas behind a signature router.
+
+    >>> # sketch:
+    >>> # fleet = SolveFleet(replicas=2, lanes=4, journal_dir=jd)
+    >>> # fleet.start()
+    >>> # jid = fleet.submit(dcop, "mgm", tenant="t1")
+    >>> # res = fleet.result(jid, timeout=30)   # res.metrics()["serve"]
+    >>> # fleet.stop()                          # names the replica
+
+    ``max_pending`` is the PER-REPLICA pending bound; the fleet
+    enforces ``max_pending x routable-replica-count`` as ONE aggregate
+    bound (it shrinks as replicas die — a degraded fleet sheds
+    earlier).  ``tenant_quota`` caps one tenant's open jobs across the
+    whole fleet.  ``fault_plan`` arms the replica-level chaos kinds
+    (``kill_replica`` / ``stall_replica`` / ``partition_replica``)
+    through the same seeded injector protocol as the serve kinds;
+    fault ``cycle`` thresholds count supervisor passes.
+
+    ``start()`` spawns one scheduler thread per replica plus the
+    supervisor thread; tests drive :meth:`tick` synchronously instead
+    (one supervisor pass + one tick per live replica) for
+    deterministic schedules.
+    """
+
+    def __init__(
+        self,
+        replicas: int = 2,
+        lanes: int = 4,
+        max_cycles: int = DEFAULT_MAX_CYCLES,
+        journal_dir: Optional[str] = None,
+        checkpoint_every: int = 4,
+        max_pending: Optional[int] = None,
+        tenant_quota: Optional[int] = None,
+        fault_plan: Optional[FaultPlan] = None,
+        heartbeat_timeout: float = 1.0,
+        supervise_interval: float = 0.05,
+        shared_xla_cache: bool = False,
+        counters: Optional[FleetCounters] = None,
+    ):
+        self.lanes = int(lanes)
+        self.max_cycles = int(max_cycles)
+        self.journal_dir = journal_dir
+        self.max_pending = max_pending
+        self.tenant_quota = tenant_quota
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self.supervise_interval = float(supervise_interval)
+        self.counters = counters if counters is not None else FleetCounters()
+        # spill at one bucket's worth of extra queue: warmth decides
+        # placement at the margin, load in the bulk (router docstring)
+        self.router = FleetRouter(spill_load=self.lanes)
+
+        self._jobs: Dict[str, FleetJob] = {}
+        self._handles: Dict[str, ReplicaHandle] = {}
+        self._lock = threading.RLock()
+        self._seq = 0
+        self._ticks = 0  # supervisor passes (the fleet faults' clock)
+        self._started = False
+        self._stopped = False
+        self._supervisor: Optional[threading.Thread] = None
+        self._sup_wake = threading.Event()
+        self._tenant_open: Dict[str, int] = {}
+        self._done_rate: Optional[float] = None
+        self._last_done_t: Optional[float] = None
+        #: open recovery records; each: {replica, t_detect, jobs,
+        #: pending(set), rto_s} — rto_s lands when pending empties
+        self.recoveries: List[Dict[str, Any]] = []
+        self._injector = (
+            ServeFaultInjector(fault_plan,
+                               faults=fault_plan.fleet_faults())
+            if fault_plan is not None and fault_plan.fleet_faults()
+            else None
+        )
+
+        self.journal: Optional[FleetJournal] = None
+        if journal_dir:
+            os.makedirs(journal_dir, exist_ok=True)
+            self.journal = FleetJournal(
+                os.path.join(journal_dir, FLEET_JOURNAL)
+            )
+            if shared_xla_cache:
+                # level 2: one persistent XLA cache dir shared by every
+                # replica (and by restarted fleets on the same dir), so
+                # a cold in-memory cache re-loads executables from disk
+                # instead of recompiling.  Opt-in: it repoints the
+                # PROCESS-global jax cache config, which a short-lived
+                # embedded fleet (tests) must not do — the CLI front
+                # door turns it on.
+                enable_persistent_cache(
+                    os.path.join(journal_dir, XLA_CACHE_SUBDIR)
+                )
+
+        for i in range(int(replicas)):
+            self._add_replica(i, checkpoint_every)
+
+    # -- replicas -----------------------------------------------------------
+
+    def _add_replica(self, index: int,
+                     checkpoint_every: int) -> ReplicaHandle:
+        name = f"replica-{index}"
+        jd = hb = None
+        if self.journal_dir:
+            jd = os.path.join(self.journal_dir, name)
+            os.makedirs(jd, exist_ok=True)
+            hb = os.path.join(self.journal_dir, f"{name}.hb")
+        service = SolveService(
+            lanes=self.lanes,
+            cache=CompileCache(),  # per-replica L1: warmth is local
+            counters=ServeCounters(replica=name),
+            max_cycles=self.max_cycles,
+            journal_dir=jd,
+            checkpoint_every=checkpoint_every,
+            # admission control lives at the FLEET front door; the
+            # replica-side queue stays unbounded so the aggregate bound
+            # is the only one in force
+            max_pending=None,
+            tenant_quota=None,
+            replica=name,
+            heartbeat_path=hb,
+        )
+        handle = ReplicaHandle(
+            name=name, index=index, service=service,
+            journal_dir=jd, hb_path=hb,
+        )
+        service.on_complete = (
+            lambda job, res, h=handle: self._on_replica_complete(
+                h, job, res
+            )
+        )
+        self._handles[name] = handle
+        self.router.add_replica(name, warm_probe=service.cache.has)
+        self.counters.inc("replicas_up")
+        send_fleet("replica.up", {"name": name})
+        if self.journal is not None:
+            self.journal.append(
+                {"kind": "replica", "event": "up", "name": name}
+            )
+        return handle
+
+    def handle(self, name_or_index) -> ReplicaHandle:
+        if isinstance(name_or_index, int):
+            name_or_index = f"replica-{name_or_index}"
+        return self._handles[name_or_index]
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        for h in self._handles.values():
+            h.service.start()
+        self._supervisor = threading.Thread(
+            target=self._supervisor_loop, name="fleet-supervisor",
+            daemon=True,
+        )
+        self._supervisor.start()
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        if drain:
+            try:
+                self.wait_all(timeout=timeout)
+            except ServiceStopped:
+                pass
+        self._stopped = True
+        self._sup_wake.set()
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=10)
+            self._supervisor = None
+        for h in self._handles.values():
+            if not h.killed:
+                h.service.stop(drain=False)
+
+    def __enter__(self) -> "SolveFleet":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop(drain=not any(exc))
+
+    def _supervisor_loop(self) -> None:
+        while not self._stopped:
+            try:
+                self._supervise()
+            except Exception as e:  # supervision must never die silent
+                send_fleet("supervisor.error", {"error": str(e)})
+            self._sup_wake.wait(self.supervise_interval)
+            self._sup_wake.clear()
+
+    def _raise_if_dead(self) -> None:
+        if self._stopped:
+            raise ServiceStopped("fleet was stopped")
+        if not self.router.up():
+            raise ServiceStopped("every fleet replica is down")
+
+    # -- front door ---------------------------------------------------------
+
+    def submit(
+        self,
+        dcop,
+        algo: str,
+        algo_params: Optional[Dict[str, Any]] = None,
+        seed: int = 0,
+        tenant: str = "default",
+        priority: int = 0,
+        deadline_s: Optional[float] = None,
+        label: Optional[str] = None,
+        source_file: Optional[str] = None,
+    ) -> str:
+        """Admit one job at the fleet front door, route it to a warm
+        replica, and return its fleet-wide job id.  Raises the same
+        structured admission errors as a single service —
+        :class:`DeadlineInfeasible`, :class:`ServiceOverloaded` (with
+        the fleet-level completion-rate ``retry_after``),
+        :class:`ServiceStopped` — but evaluated against the AGGREGATE
+        bound and fleet-wide tenant quotas."""
+        self._raise_if_dead()
+        if deadline_s is not None and deadline_s <= 0:
+            self.counters.inc("jobs_shed")
+            send_fleet("job.rejected", {
+                "tenant": tenant, "reason": "deadline infeasible",
+                "deadline_s": deadline_s,
+            })
+            raise DeadlineInfeasible(
+                f"deadline_s={deadline_s} is already expired at "
+                f"submit time"
+            )
+        with self._lock:
+            if (
+                self.tenant_quota is not None
+                and self._tenant_open.get(tenant, 0) >= self.tenant_quota
+            ):
+                self.counters.inc("quota_rejections")
+                send_fleet("job.rejected", {
+                    "tenant": tenant, "reason": "tenant quota",
+                    "quota": self.tenant_quota,
+                })
+                raise ServiceOverloaded(
+                    f"tenant {tenant!r} at fleet quota "
+                    f"({self.tenant_quota} open jobs)",
+                    retry_after=self._retry_after(),
+                    tenant=tenant,
+                )
+            if self.max_pending is not None:
+                # the aggregate bound: per-replica max_pending summed
+                # over the replicas that can actually take traffic — a
+                # degraded fleet sheds earlier, by design
+                routable = self.router.routable()
+                bound = self.max_pending * max(1, len(routable))
+                backlog = sum(
+                    self._handles[n].service._backlog for n in routable
+                )
+                if backlog >= bound:
+                    self.counters.inc("jobs_shed")
+                    send_fleet("job.rejected", {
+                        "tenant": tenant, "reason": "queue full",
+                        "max_pending": bound,
+                    })
+                    raise ServiceOverloaded(
+                        f"fleet pending queue full ({bound} jobs over "
+                        f"{len(routable)} replicas)",
+                        retry_after=self._retry_after(),
+                        tenant=tenant,
+                    )
+            self._seq += 1
+            jid = f"job-{self._seq:06d}"
+            key = job_routing_key(dcop, algo, algo_params)
+            placed = self.router.place(key, jid=jid)
+            if placed is None:
+                raise ServiceStopped("no routable replica")
+            name, warm = placed
+            fj = FleetJob(
+                jid=jid, key=key, dcop=dcop, algo=algo,
+                algo_params=dict(algo_params or {}), seed=int(seed),
+                tenant=tenant, priority=int(priority),
+                deadline_s=deadline_s, label=label,
+                source_file=source_file, replica=name,
+                submitted_at=monotonic(),
+            )
+            self._jobs[jid] = fj
+            self._tenant_open[tenant] = (
+                self._tenant_open.get(tenant, 0) + 1
+            )
+        self.counters.inc("jobs_routed")
+        if warm:
+            self.counters.inc("jobs_routed_warm")
+        if self.journal is not None:
+            self.journal.append({
+                "kind": "job", "jid": jid, "replica": name,
+                "file": source_file, "algo": algo,
+                "algo_params": dict(algo_params or {}),
+                "seed": int(seed), "tenant": tenant,
+                "priority": int(priority), "label": label,
+            })
+        self._place_on(fj, name)
+        return jid
+
+    def _place_on(self, fj: FleetJob, name: str,
+                  restore: Optional[Tuple] = None) -> None:
+        """Hand a fleet job to one replica (placement or re-seat); a
+        replica that dies in the handoff window re-places once on a
+        peer before the supervisor would have to."""
+        last_err: Optional[Exception] = None
+        for _attempt in range(2):
+            h = self._handles[name]
+            try:
+                h.service.submit(
+                    fj.dcop, fj.algo, algo_params=fj.algo_params,
+                    seed=fj.seed, tenant=fj.tenant,
+                    priority=fj.priority, deadline_s=fj.deadline_s,
+                    label=fj.label, source_file=fj.source_file,
+                    _jid=fj.jid, _restore=restore,
+                )
+                return
+            except Exception as e:  # replica died mid-handoff
+                last_err = e
+                self.router.job_finished(name)
+                placed = self.router.place(
+                    fj.key, jid=fj.jid, exclude=name
+                )
+                if placed is None:
+                    break
+                name = placed[0]
+                with self._lock:
+                    fj.replica = name
+        self._fail_job(
+            fj, f"no replica could accept the job: {last_err}"
+        )
+
+    def _fail_job(self, fj: FleetJob, reason: str) -> None:
+        with self._lock:
+            if fj.done.is_set():
+                return
+            fj.result = SolveResult(
+                status="ERROR", assignment={}, cost=None,
+                violation=None, cycle=0, msg_count=0, msg_size=0.0,
+                time=monotonic() - fj.submitted_at,
+            )
+            fj.result.serve = {
+                "replica": None, "jid": fj.jid, "resumed": False,
+                "reseats": fj.reseats, "error": reason,
+            }
+            n = self._tenant_open.get(fj.tenant, 0)
+            if n > 0:
+                self._tenant_open[fj.tenant] = n - 1
+            self._settle_recovery(fj.jid, monotonic())
+            fj.done.set()
+
+    def _settle_recovery(self, jid: str, now: float) -> None:
+        """Caller holds the lock.  Strike ``jid`` off every open
+        recovery record; the record whose pending set empties gets its
+        RTO — wall time from kill detection to the LAST of the dead
+        replica's jobs completing elsewhere."""
+        for rec in self.recoveries:
+            pending = rec.get("pending")
+            if pending and jid in pending:
+                pending.discard(jid)
+                if not pending:
+                    rec["rto_s"] = round(now - rec["t_detect"], 6)
+                    self.counters.inc("recoveries_completed")
+                    send_fleet("recovery.done", {
+                        "replica": rec["replica"],
+                        "jobs": rec["jobs"],
+                        "rto_s": rec["rto_s"],
+                    })
+
+    def _on_replica_complete(self, handle: ReplicaHandle, job,
+                             res: SolveResult) -> None:
+        """The per-replica completion tap: stream the ``JID:`` line to
+        the fleet journal, settle routing load / quotas / the
+        completion-rate EMA, close recovery records, and wake fleet
+        waiters.  First completion wins — a late duplicate (a stalled
+        replica finishing a job that was conservatively never
+        re-seated cannot happen, but a re-placed handoff racing its
+        failed first submit can) is dropped, never double-counted.
+
+        A job failed because its replica's SCHEDULER died
+        (``service_stopped``) is NOT a completion: the supervisor will
+        see the dead replica and re-seat the job on a peer — settling
+        it here would turn a recoverable replica loss into a permanent
+        ERROR."""
+        if getattr(job, "service_stopped", False):
+            return
+        if self.journal is not None:
+            self.journal.append({
+                "kind": "done", "jid": job.jid,
+                "replica": handle.name, "status": res.status,
+            })
+        with self._lock:
+            fj = self._jobs.get(job.jid)
+            if fj is None or fj.done.is_set():
+                return
+            if res.serve is not None:
+                res.serve["reseats"] = fj.reseats
+            fj.result = res
+            self.router.job_finished(handle.name)
+            n = self._tenant_open.get(fj.tenant, 0)
+            if n > 0:
+                self._tenant_open[fj.tenant] = n - 1
+            now = monotonic()
+            if self._last_done_t is not None:
+                dt = now - self._last_done_t
+                if dt > 0:
+                    inst = 1.0 / dt
+                    self._done_rate = (
+                        inst if self._done_rate is None
+                        else 0.5 * self._done_rate + 0.5 * inst
+                    )
+            self._last_done_t = now
+            self._settle_recovery(job.jid, now)
+            fj.done.set()
+
+    def _retry_after(self) -> float:
+        """Fleet-level back-off hint: the aggregate backlog drained at
+        the fleet's observed completion rate, clamped to [20ms, 30s]."""
+        rate = self._done_rate
+        if not rate or rate <= 0:
+            return 1.0
+        backlog = sum(
+            self._handles[n].service._backlog
+            for n in self.router.routable()
+        )
+        return round(min(30.0, max(0.02, backlog / rate)), 3)
+
+    # -- results ------------------------------------------------------------
+
+    def result(self, jid: str,
+               timeout: Optional[float] = None) -> SolveResult:
+        """Block until fleet job ``jid`` completes — on WHICHEVER
+        replica ends up serving it — and return its result; the
+        serving replica is named in ``metrics()["serve"]``.  Raises
+        :class:`ServiceStopped` instead of hanging when every replica
+        is down."""
+        fj = self._jobs[jid]
+        deadline = None if timeout is None else monotonic() + timeout
+        while not fj.done.is_set():
+            self._raise_if_dead()
+            remain = (
+                None if deadline is None else deadline - monotonic()
+            )
+            if remain is not None and remain <= 0:
+                raise TimeoutError(
+                    f"job {jid} not done within {timeout}s"
+                )
+            fj.done.wait(0.1 if remain is None else min(0.1, remain))
+        assert fj.result is not None
+        return fj.result
+
+    def wait_all(self, timeout: Optional[float] = None) -> bool:
+        deadline = None if timeout is None else monotonic() + timeout
+        for fj in list(self._jobs.values()):
+            while not fj.done.is_set():
+                self._raise_if_dead()
+                remain = (
+                    None if deadline is None else deadline - monotonic()
+                )
+                if remain is not None and remain <= 0:
+                    return False
+                fj.done.wait(
+                    0.1 if remain is None else min(0.1, remain)
+                )
+        return True
+
+    # -- prewarm ------------------------------------------------------------
+
+    def prewarm(self, items: Sequence[Tuple],
+                block: bool = False) -> Dict[str, int]:
+        """Distribute expected traffic's compile work across replicas
+        BEFORE arrivals open: items group by routing key, each group is
+        assigned one replica (least-loaded round-robin) and prewarmed
+        there — so when the trace starts, the router finds every family
+        already warm SOMEWHERE and places accordingly.  Returns
+        ``{replica: runners}``."""
+        groups: Dict[Tuple, List[Tuple]] = {}
+        for it in items:
+            dcop, algo = it[0], it[1]
+            params = dict(it[2]) if len(it) > 2 and it[2] else {}
+            groups.setdefault(
+                job_routing_key(dcop, algo, params), []
+            ).append(it)
+        out: Dict[str, int] = {}
+        names = self.router.routable()
+        if not names:
+            return out
+        for i, (key, group) in enumerate(
+            sorted(groups.items(), key=lambda kv: str(kv[0]))
+        ):
+            name = names[i % len(names)]
+            self.router.note_warm(name, key)
+            self._handles[name].service.prewarm(group, block=block)
+            out[name] = out.get(name, 0) + 1
+        return out
+
+    def prewarm_predicted(self, dcops: Sequence[Any], model=None,
+                          grid=None, block: bool = False):
+        """Portfolio-informed fleet prewarm: the learned cost model
+        picks each expected instance's config (PR 10), then the picks
+        prewarm across replicas like :meth:`prewarm`.  Returns the
+        chosen configs, one per dcop."""
+        from pydcop_tpu.portfolio.select import load_model, select_config
+
+        loaded = load_model(model)
+        chosen, items = [], []
+        for dcop in dcops:
+            sel = select_config(dcop, grid=grid, model=loaded)
+            chosen.append(sel.config)
+            if sel.config.algo in SUPPORTED_ALGOS:
+                items.append(
+                    (dcop, sel.config.algo, sel.config.algo_params())
+                )
+        if items:
+            self.prewarm(items, block=block)
+        return chosen
+
+    # -- supervision / failover ---------------------------------------------
+
+    def tick(self) -> bool:
+        """One synchronous fleet pass: supervision (fault injection,
+        death detection, failover re-seating) then one scheduler tick
+        per live replica.  Tests call this directly for deterministic
+        schedules; the threaded mode runs the same supervision on its
+        own interval while replicas tick themselves."""
+        self._supervise()
+        busy = False
+        for h in self._handles.values():
+            if h.up and not h.dead:
+                busy = h.service.tick() or busy
+        undone = any(
+            not fj.done.is_set() for fj in self._jobs.values()
+        )
+        return (busy or undone) and bool(self.router.up())
+
+    def _supervise(self) -> None:
+        self._ticks += 1
+        now = monotonic()
+        inj = self._injector
+        if inj is not None:
+            for kind in ("kill_replica", "stall_replica",
+                         "partition_replica"):
+                while True:
+                    f = inj.due(kind, self._ticks)
+                    if f is None:
+                        break
+                    self._inject(kind, f, now)
+        # liveness: dead schedulers re-seat, stale heartbeats only
+        # route around (stall != death — re-seating a stalled-but-
+        # alive replica's jobs would race its own completions)
+        for h in list(self._handles.values()):
+            if not h.up:
+                continue
+            if h.dead:
+                self._replica_down(
+                    h,
+                    reason=("injected kill" if h.killed
+                            else "scheduler died"),
+                    t_detect=h.killed_at or now,
+                )
+                continue
+            if self._started and h.hb_path and os.path.exists(h.hb_path):
+                stale = bool(stalled_ranks(
+                    {0: h.hb_path}, self.heartbeat_timeout
+                ))
+                if stale and not h.stalled:
+                    h.stalled = True
+                    self.router.set_stalled(h.name, True)
+                    self.counters.inc("replicas_stalled")
+                    send_fleet("replica.stalled", {"name": h.name})
+                elif not stale and h.stalled:
+                    h.stalled = False
+                    self.router.set_stalled(h.name, False)
+                    self.counters.inc("replicas_healed")
+                    send_fleet("replica.healed", {
+                        "name": h.name, "was": "stalled",
+                    })
+            if (
+                h.partition_until is not None
+                and h.partition_until <= now
+            ):
+                h.partition_until = None
+                self.router.set_partitioned(h.name, False)
+                self.counters.inc("replicas_healed")
+                send_fleet("replica.healed", {
+                    "name": h.name, "was": "partitioned",
+                })
+
+    def _inject(self, kind: str, fault, now: float) -> None:
+        h = self.handle(int(fault.replica))
+        self.counters.inc("faults_injected")
+        send_fleet("fault.injected", {
+            "kind": kind, "replica": h.name, "tick": self._ticks,
+        })
+        if kind == "kill_replica":
+            if h.up and not h.killed:
+                h.kill()
+        elif kind == "stall_replica":
+            h.service.stall_for(fault.duration)
+        elif kind == "partition_replica":
+            h.partition_until = (
+                now + fault.duration if fault.duration > 0
+                else float("inf")
+            )
+            self.router.set_partitioned(h.name, True)
+            self.counters.inc("replicas_partitioned")
+            send_fleet("replica.partitioned", {
+                "name": h.name, "duration": fault.duration,
+            })
+
+    def _replica_down(self, h: ReplicaHandle, reason: str,
+                      t_detect: float) -> None:
+        h.up = False
+        self.router.mark_down(h.name)
+        self.counters.inc("replicas_down")
+        send_fleet("replica.down", {"name": h.name, "reason": reason})
+        if self.journal is not None:
+            self.journal.append({
+                "kind": "replica", "event": "down", "name": h.name,
+                "reason": reason,
+            })
+        with self._lock:
+            orphans = [
+                fj for fj in self._jobs.values()
+                if not fj.done.is_set() and fj.replica == h.name
+            ]
+        if orphans:
+            self._reseat(h, orphans, t_detect)
+
+    def _reseat(self, dead: ReplicaHandle, jobs: List[FleetJob],
+                t_detect: float) -> None:
+        """Re-seat a dead replica's in-flight jobs on peers through
+        the PR 6 resume protocol.  Ground rules, in order:
+
+        1. a job whose ``JID:`` completion line reached the dead
+           replica's disk is DONE — it re-runs nowhere (no
+           double-complete; in thread-hosted replicas the completion
+           tap already settled it, so this is belt-and-braces for the
+           process-hosted future);
+        2. a job with a valid lane checkpoint re-seats at its EXACT
+           padded target, PRNG key/age/stability restored — the
+           continuation is bit-identical to an unfailed run;
+        3. a job without one replays from cycle 0 on the peer — the
+           full rerun is bit-identical by the serve determinism
+           contract;
+        4. either way the peer prewarms the re-seat signature FIRST
+           (prewarm_targets / prewarm), so failover admissions pay
+           zero new cache misses.
+
+        Opens a recovery record whose ``rto_s`` lands when the last
+        re-seated job completes — the fleet's recovery-time
+        objective."""
+        from pydcop_tpu.runtime.checkpoint import read_state_npz
+
+        done_on_disk = dead.done_jids()
+        todo = [
+            fj for fj in jobs
+            if not (fj.jid in done_on_disk and fj.done.is_set())
+        ]  # a JID line on disk + a settled fleet job = done, not rerun
+        if not todo:
+            return
+        rec = {
+            "replica": dead.name,
+            "t_detect": t_detect,
+            "detected_at": round(time.time(), 3),
+            "jobs": len(todo),
+            "pending": {fj.jid for fj in todo},
+            "rto_s": None,
+        }
+        with self._lock:
+            # register the record BEFORE any peer gets a job: a fast
+            # completion on a threaded peer must find it to settle it
+            self.recoveries.append(rec)
+        for fj in todo:
+            restore = None
+            ck = dead.checkpoint_path(fj.jid)
+            if ck and os.path.exists(ck):
+                try:
+                    meta, arrays = read_state_npz(ck)
+                    restore = (meta, arrays)
+                except ValueError:
+                    restore = None  # corrupt snapshot: replay from 0
+            with self._lock:
+                placed = self.router.place(
+                    fj.key, jid=fj.jid, exclude=dead.name
+                )
+            if placed is None:
+                self._fail_job(
+                    fj, "replica lost with no routable peer"
+                )
+                continue
+            peer_name, _warm = placed
+            peer = self._handles[peer_name]
+            # warm the re-seat signature FIRST: zero new cache misses
+            # on failover admission (the PR 10 prewarm-hook fix,
+            # pinned in tests/unit/test_fleet.py)
+            if restore is not None:
+                peer.service.prewarm_targets(
+                    [(fj.algo, fj.algo_params,
+                      restore_target(restore[0]))],
+                    block=True,
+                )
+                self.counters.inc("reseat_checkpoint_hits")
+            else:
+                if fj.algo in SUPPORTED_ALGOS:
+                    peer.service.prewarm(
+                        [(fj.dcop, fj.algo, fj.algo_params)],
+                        block=True,
+                    )
+                self.counters.inc("reseat_cold_restarts")
+            with self._lock:
+                fj.replica = peer_name
+                fj.reseats += 1
+            self.counters.inc("jobs_reseated")
+            send_fleet("job.reseated", {
+                "jid": fj.jid, "from": dead.name, "to": peer_name,
+                "checkpoint": restore is not None,
+            })
+            if self.journal is not None:
+                self.journal.append({
+                    "kind": "reseat", "jid": fj.jid,
+                    "from": dead.name, "to": peer_name,
+                    "checkpoint": restore is not None,
+                })
+            self._place_on(fj, peer_name, restore=restore)
+
+    # -- metrics ------------------------------------------------------------
+
+    def metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            recov = [
+                {k: (sorted(v) if isinstance(v, set) else v)
+                 for k, v in rec.items() if k != "t_detect"}
+                for rec in self.recoveries
+            ]
+        return {
+            "fleet": self.counters.as_dict(),
+            "router": self.router.stats(),
+            "replicas": {
+                name: {
+                    "up": h.up,
+                    "stalled": h.stalled,
+                    "partitioned": h.partition_until is not None,
+                    "serve": h.service.counters.as_dict(),
+                    "cache": h.service.cache.stats(),
+                }
+                for name, h in self._handles.items()
+            },
+            "pending": sum(
+                h.service._backlog for h in self._handles.values()
+                if h.up
+            ),
+            "recoveries": recov,
+        }
+
+
+def exact_runner_key(algo: str, algo_params: Optional[Dict[str, Any]],
+                     target: InstanceDims, lanes: int,
+                     max_cycles: int = DEFAULT_MAX_CYCLES) -> Tuple:
+    """The full compile-cache key a checkpointed job's re-seat bucket
+    resolves to — routing ground truth for 'is this replica warm for
+    this exact signature' probes (CompileCache.has)."""
+    chunk = default_chunk(None, False, False, None, int(max_cycles))
+    return runner_cache_key(
+        algo, _params_key(dict(algo_params or {})),
+        bucket_signature(target, int(lanes)), chunk,
+    )

@@ -1,0 +1,203 @@
+"""Signature routing — placing fleet traffic on already-warm replicas.
+
+The expensive artifact a serving fleet must protect is the *warm
+compiled runner*, not the process around it (the PGMax compile-once
+discipline, arXiv:2202.04110): a replica that is merely *alive* still
+costs a cold XLA compile for every shape family it has never seen.  So
+the router keys placement on the SAME identifiers the compile cache
+keys runners by (batch/cache.py, engine.runner_cache_key):
+
+* a job's **routing key** is the leading fields of its runner cache
+  key — ``(algo, params-key) + family`` where the family is the
+  instance's :attr:`~pydcop_tpu.batch.bucketing.InstanceDims.family_key`
+  (graph type + arity set).  It is computed host-side from the DCOP
+  alone (:func:`job_routing_key`), no tensor compilation needed, so the
+  fleet front door stays cheap;
+* a replica is **warm** for a key when the router saw it prewarm or
+  serve that key before, or — ground truth — when the replica's
+  in-memory compile cache holds a runner for it
+  (:meth:`~pydcop_tpu.batch.cache.CompileCache.has`, consulted through
+  the per-replica ``warm_probe``; checkpointed re-seats probe their
+  exact runner cache key).
+
+Placement policy, in order: (1) among routable replicas (up, not
+partitioned, not stalled) that are warm for the key, the least-loaded
+wins; (2) otherwise the least-loaded routable replica wins and the key
+is recorded as warming there — so the NEXT job of that family co-lands
+on the same replica and folds into the same continuously-batched
+bucket instead of paying a second compile elsewhere.  Ties break by
+replica order (deterministic placement for a deterministic trace).
+
+Warm affinity is bounded by **load spill**: when the best warm replica
+is ``spill_load`` open jobs ahead of the emptiest routable peer, the
+job spills to that peer — it pays ONE compile there, after which the
+peer is warm too and the family's traffic splits.  Without spill a
+single hot signature would pin a whole fleet's traffic to one replica
+forever; with it, warmth decides placement at the margin and load
+decides it in the bulk, which is what makes jobs/s scale with replica
+count (the ``fleet`` bench leg).
+
+The router is pure host-side bookkeeping with no locks of its own; the
+fleet serializes access under its lock (serve/fleet.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from pydcop_tpu.batch.engine import _params_key
+from pydcop_tpu.runtime.events import send_fleet
+
+#: algorithm families compiled on the factor-graph path (BP); the rest
+#: of the batch-eligible set compiles constraint hypergraphs — mirrors
+#: the batch adapters' graph types (engine.adapter_for)
+_FACTOR_GRAPH_ALGOS = ("maxsum", "amaxsum")
+
+
+def job_routing_key(dcop, algo: str,
+                    algo_params: Optional[Dict[str, Any]] = None
+                    ) -> Tuple:
+    """The routing key of one job: ``(algo, params-key, graph type,
+    arity set)`` — exactly the leading fields of the compile-cache key
+    its bucket runner will resolve to, computed from the DCOP's host
+    structure alone (no tensor compilation on the front-door path).
+    Two jobs with the same routing key pool into the same padded serve
+    target on a replica, so routing by it is routing to the runner."""
+    arities = tuple(sorted({
+        len(c.dimensions) for c in dcop.constraints.values()
+    }))
+    graph_type = (
+        "factor_graph" if algo in _FACTOR_GRAPH_ALGOS
+        else "constraints_hypergraph"
+    )
+    return (algo, _params_key(algo_params or {}), graph_type, arities)
+
+
+@dataclasses.dataclass
+class _ReplicaState:
+    """Router-side view of one replica."""
+
+    name: str
+    up: bool = True
+    stalled: bool = False
+    partitioned: bool = False
+    load: int = 0  # open (placed-but-unfinished) jobs
+    warm: set = dataclasses.field(default_factory=set)
+    #: ground-truth warmth probe (the replica's CompileCache.has),
+    #: consulted for exact runner cache keys on re-seat placement
+    warm_probe: Optional[Callable[[Tuple], bool]] = None
+
+    @property
+    def routable(self) -> bool:
+        return self.up and not self.stalled and not self.partitioned
+
+    def is_warm(self, key: Tuple) -> bool:
+        if key in self.warm:
+            return True
+        return bool(self.warm_probe is not None and self.warm_probe(key))
+
+
+class FleetRouter:
+    """Places jobs on replicas by compile-cache routing key.
+
+    ``spill_load`` bounds warm affinity: a warm replica that is this
+    many open jobs ahead of the emptiest routable peer loses the
+    placement to that peer (None = never spill).  The fleet passes its
+    per-bucket lane count — spill exactly when the warm replica has a
+    whole bucket's worth of extra queue."""
+
+    def __init__(self, spill_load: Optional[int] = None):
+        self.spill_load = spill_load
+        self._replicas: Dict[str, _ReplicaState] = {}
+
+    # -- membership ---------------------------------------------------------
+
+    def add_replica(self, name: str,
+                    warm_probe: Optional[Callable[[Tuple], bool]] = None
+                    ) -> None:
+        self._replicas[name] = _ReplicaState(
+            name=name, warm_probe=warm_probe
+        )
+
+    def mark_down(self, name: str) -> None:
+        self._replicas[name].up = False
+
+    def mark_up(self, name: str) -> None:
+        r = self._replicas[name]
+        r.up, r.stalled, r.partitioned = True, False, False
+
+    def set_stalled(self, name: str, stalled: bool) -> None:
+        self._replicas[name].stalled = stalled
+
+    def set_partitioned(self, name: str, partitioned: bool) -> None:
+        self._replicas[name].partitioned = partitioned
+
+    # -- load accounting (one open job = one unit) --------------------------
+
+    def job_placed(self, name: str) -> None:
+        self._replicas[name].load += 1
+
+    def job_finished(self, name: str) -> None:
+        r = self._replicas.get(name)
+        if r is not None and r.load > 0:
+            r.load -= 1
+
+    def note_warm(self, name: str, key: Tuple) -> None:
+        """Record that ``name`` holds (or is compiling) a runner for
+        ``key`` — called on prewarm and on every placement."""
+        self._replicas[name].warm.add(key)
+
+    # -- queries ------------------------------------------------------------
+
+    def routable(self) -> List[str]:
+        return [n for n, r in self._replicas.items() if r.routable]
+
+    def up(self) -> List[str]:
+        return [n for n, r in self._replicas.items() if r.up]
+
+    def load(self, name: str) -> int:
+        return self._replicas[name].load
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            n: {
+                "up": r.up, "stalled": r.stalled,
+                "partitioned": r.partitioned, "load": r.load,
+                "warm_keys": len(r.warm),
+            }
+            for n, r in self._replicas.items()
+        }
+
+    # -- placement ----------------------------------------------------------
+
+    def place(self, key: Tuple, jid: Optional[str] = None,
+              exclude: Optional[str] = None
+              ) -> Optional[Tuple[str, bool]]:
+        """Pick the replica for one job and account the placement.
+        Returns ``(name, was_warm)``, or None when no replica is
+        routable (the fleet front door turns that into a structured
+        overload/stopped error).  ``exclude`` bars one replica (the
+        dead one, during re-seat)."""
+        candidates = [
+            r for n, r in self._replicas.items()
+            if r.routable and n != exclude
+        ]
+        if not candidates:
+            return None
+        warm = [r for r in candidates if r.is_warm(key)]
+        pool = warm if warm else candidates
+        best = min(pool, key=lambda r: r.load)
+        if warm and self.spill_load is not None:
+            emptiest = min(candidates, key=lambda r: r.load)
+            if best.load - emptiest.load >= self.spill_load:
+                # warm affinity loses at the margin: spill to the
+                # emptiest peer, which warms up and splits the family
+                best = emptiest
+                warm = [best] if best.is_warm(key) else []
+        best.load += 1
+        best.warm.add(key)
+        send_fleet("router.placed", {
+            "jid": jid, "replica": best.name,
+            "key": [str(k) for k in key], "warm": bool(warm),
+        })
+        return best.name, bool(warm)

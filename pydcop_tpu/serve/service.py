@@ -73,7 +73,16 @@ import tempfile
 import threading
 from collections import deque
 from time import monotonic, sleep
-from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 from pydcop_tpu.algorithms.base import SolveResult
 from pydcop_tpu.batch.bucketing import InstanceDims, bucket_signature
@@ -90,6 +99,7 @@ from pydcop_tpu.batch.engine import (
 from pydcop_tpu.runtime.events import event_bus, send_serve
 from pydcop_tpu.runtime.faults import (
     FaultPlan,
+    HeartbeatWriter,
     InjectedFault,
     ServeFaultInjector,
 )
@@ -111,6 +121,17 @@ from pydcop_tpu.serve.scheduler import (
 JOBS_JOURNAL = "jobs.jsonl"
 PROGRESS_FILE = "progress_serve"
 CKPT_SUBDIR = "ckpt"
+
+
+def restore_target(meta: Dict[str, Any]) -> InstanceDims:
+    """The exact padded target a checkpointed lane must re-seat at —
+    its state leaves are target-shaped, so re-seating anywhere else
+    would fail loudly in restore_lane_state.  Shared by the service's
+    resume path and the fleet's failover re-seat (serve/fleet.py)."""
+    t = dict(meta["target"])
+    t["arities"] = tuple(t["arities"])
+    t["F"] = tuple(t["F"])
+    return InstanceDims(**t)
 
 
 @dataclasses.dataclass
@@ -156,10 +177,7 @@ class ServeJob:
         """The exact padded target a checkpointed job must re-seat at
         (its state leaves are target-shaped)."""
         assert self.restore is not None
-        t = dict(self.restore[0]["target"])
-        t["arities"] = tuple(t["arities"])
-        t["F"] = tuple(t["F"])
-        return InstanceDims(**t)
+        return restore_target(self.restore[0])
 
     def emit(self, event: str, payload: Dict[str, Any]) -> None:
         send_serve(event, payload)
@@ -229,11 +247,27 @@ class SolveService:
         backoff_max: float = 2.0,
         journal_compact_bytes: int = 1 << 20,
         fault_plan: Optional[FaultPlan] = None,
+        replica: Optional[str] = None,
+        heartbeat_path: Optional[str] = None,
+        on_complete: Optional[Callable[["ServeJob", SolveResult],
+                                       None]] = None,
     ):
         self.lanes = int(lanes)
         self.max_buckets = max_buckets
         self.cache = cache if cache is not None else global_compile_cache()
-        self.counters = counters if counters is not None else ServeCounters()
+        #: fleet identity: stamped on every completed job's
+        #: ``metrics()["serve"]`` and the counters summary, so failover
+        #: paths are auditable post-hoc; None for a standalone service
+        self.replica = replica
+        self.counters = (
+            counters if counters is not None
+            else ServeCounters(replica=replica)
+        )
+        if replica is not None and self.counters.replica is None:
+            self.counters.replica = replica
+        #: completion hook (the fleet's journal-streaming tap): called
+        #: after a job turns terminal, on whichever thread completed it
+        self.on_complete = on_complete
         self.max_cycles = int(max_cycles)
         self.journal_dir = journal_dir
         self.checkpoint_every = int(checkpoint_every)
@@ -271,6 +305,14 @@ class SolveService:
             and fault_plan.serve_faults() else None
         )
         self._done_jids: set = set()
+        #: liveness channel to a fleet supervisor (PR 1's heartbeat
+        #: file protocol): the TICK loop touches it, not a side thread,
+        #: so staleness faithfully reflects a wedged/killed scheduler
+        self._hb = (
+            HeartbeatWriter(heartbeat_path)
+            if heartbeat_path is not None else None
+        )
+        self._stall_until = 0.0  # injected stall gate (stall_replica)
         if journal_dir:
             os.makedirs(os.path.join(journal_dir, CKPT_SUBDIR),
                         exist_ok=True)
@@ -317,6 +359,27 @@ class SolveService:
         if self._prep_pool is not None:
             self._prep_pool.shutdown(wait=False)
             self._prep_pool = None
+
+    def halt(self) -> None:
+        """Hard-stop: the thread-hosted twin of ``kill -9``.  The
+        scheduler exits at the next tick boundary WITHOUT draining,
+        completing or journaling anything further; in-flight lanes are
+        abandoned where they stand and only the journal — submissions,
+        ``JID:`` completion lines, lane checkpoints — survives for a
+        peer replica or a restarted service to recover from.  Blocked
+        :meth:`result` callers raise :class:`ServiceStopped` through
+        the liveness gate instead of hanging."""
+        self._failure = RuntimeError("replica halted (injected kill)")
+        self._stop = True
+        self._wake.set()
+
+    def stall_for(self, duration: float) -> None:
+        """Wedge the NEXT scheduler tick for ``duration`` seconds (the
+        fleet's ``stall_replica`` injection): the sleep happens inside
+        :meth:`tick`, on the scheduler thread itself, so the heartbeat
+        file goes genuinely stale — from the supervisor's viewpoint
+        this is indistinguishable from a real wedged collective."""
+        self._stall_until = monotonic() + float(duration)
 
     def __enter__(self) -> "SolveService":
         self.start()
@@ -380,6 +443,7 @@ class SolveService:
         spec: Any = None,
         _jid: Optional[str] = None,
         _journal: bool = True,
+        _restore: Optional[Tuple] = None,
     ) -> str:
         """Enqueue one solve job; returns its job id immediately.
 
@@ -496,6 +560,10 @@ class SolveService:
                 counters=self.counters,
             )
             job.spec = spec
+            # the checkpoint restore tuple rides the SAME lock as the
+            # pending append: a running scheduler can never observe the
+            # job restore-less and re-run it from cycle 0 by accident
+            job.restore = _restore
             self._jobs[jid] = job
             self._pending.append(job)
             job.in_backlog = True
@@ -689,6 +757,51 @@ class SolveService:
         return prewarm_predicted(self, dcops, model=model, grid=grid,
                                  block=block)
 
+    def prewarm_targets(
+        self,
+        items: Sequence[Tuple[str, Optional[Dict[str, Any]],
+                              InstanceDims]],
+        block: bool = False,
+    ) -> int:
+        """Compile bucket runners at EXACT padded targets — the
+        re-seat twin of :meth:`prewarm`.  A journal-checkpointed job
+        must re-seat at the very target its state leaves were padded
+        to (:func:`restore_target`), so admission-time warmth means
+        warming THAT signature, not a freshly pooled one.  ``items``
+        is a sequence of ``(algo, algo_params, target_dims)``;
+        :meth:`resume` and the fleet's failover re-seat
+        (serve/fleet.py) call this so a resumed-on-peer job pays zero
+        new cache misses (pinned in tests/unit/test_fleet.py).
+        Returns the number of runners scheduled."""
+        from pydcop_tpu.algorithms.base import default_chunk
+
+        entries = []
+        for algo, params, target in items:
+            if algo not in SUPPORTED_ALGOS:
+                continue
+            params = dict(params or {})
+            pkey = _params_key(params)
+            chunk = default_chunk(None, False, False, None,
+                                  self.max_cycles)
+            key = runner_cache_key(
+                algo, pkey, bucket_signature(target, self.lanes), chunk
+            )
+            adapter = adapter_for(algo)
+            self._prewarmed.setdefault((algo, pkey), []).append(target)
+            entries.append((
+                key,
+                lambda a=adapter, t=target, p=params, b=self.lanes,
+                c=chunk: warm_bucket_runner(a, t, p, b, c),
+            ))
+        if not entries:
+            return 0
+        self.counters.inc("prewarmed_runners", len(entries))
+        send_serve("prewarm.scheduled", {
+            "runners": len(entries), "exact": True,
+        })
+        self.cache.prewarm(entries, block=block)
+        return len(entries)
+
     # -- scheduler ----------------------------------------------------------
 
     def _loop(self) -> None:
@@ -755,6 +868,16 @@ class SolveService:
         (:meth:`_quarantine_worker`) — the failure never escapes to
         the other buckets or, in thread mode, past the supervisor."""
         self._ticks += 1
+        if self._stall_until:
+            remain = self._stall_until - monotonic()
+            self._stall_until = 0.0
+            if remain > 0:
+                sleep(remain)  # wedged: the heartbeat goes stale too
+        if self._hb is not None:
+            try:
+                self._hb.beat()
+            except OSError:  # heartbeat dir vanished: stay alive
+                pass
         inj = self._injector
         if inj is not None:
             f = inj.due("stall_tick", self._ticks)
@@ -1246,6 +1369,13 @@ class SolveService:
             self.counters.inc("jobs_preempted")
         self._journal_done(job.jid)
         self._drop_checkpoint(job.jid)
+        # serving provenance: which replica/JID actually served the
+        # job — the post-hoc audit trail of every failover re-seat
+        res.serve = {
+            "replica": self.replica,
+            "jid": job.jid,
+            "resumed": job.resumed,
+        }
         payload = {
             "jid": job.jid, "status": res.status, "cycle": res.cycle,
             "cost": res.cost, "latency": round(res.time, 4),
@@ -1254,6 +1384,11 @@ class SolveService:
             payload["error"] = error
         job.emit("job.done", payload)
         job.done.set()
+        if self.on_complete is not None:
+            try:
+                self.on_complete(job, res)
+            except Exception:  # a fleet tap must never wedge a lane
+                pass
         self._maybe_compact_journal()
 
     # -- journal / crash resume --------------------------------------------
@@ -1425,7 +1560,13 @@ class SolveService:
         from pydcop_tpu.runtime.checkpoint import write_state_npz
 
         for i, lane in enumerate(w.lanes):
-            if lane is None or lane.job.source_file is None:
+            if lane is None:
+                continue
+            # a standalone service can only resume jobs it can reload
+            # from a source file; a fleet REPLICA checkpoints every
+            # lane — the fleet holds the dcop in memory, so failover
+            # re-seats need no file to restore from
+            if lane.job.source_file is None and self.replica is None:
                 continue
             arrays, meta = w.lane_checkpoint(i, lane)
             write_state_npz(self._ckpt_path(lane.job.jid), arrays, meta)
@@ -1439,7 +1580,7 @@ class SolveService:
         except OSError:
             pass
 
-    def resume(self) -> int:
+    def resume(self, prewarm: bool = True) -> int:
         """Re-submit every journaled job that never registered its
         ``JID:`` completion line.  Jobs with a valid per-lane
         checkpoint re-seat at their last chunk boundary (their PRNG
@@ -1448,7 +1589,17 @@ class SolveService:
         restart from cycle 0.  Torn journal lines (an append cut short
         by the crash) are skipped and counted, never fatal, and the
         journal is compacted afterwards.  Returns the number of jobs
-        re-queued."""
+        re-queued.
+
+        With ``prewarm`` (the default) the re-seat signatures are
+        compiled BEFORE the jobs enter the pending queue, through the
+        same cache keys the portfolio prewarm hook
+        (:meth:`prewarm_predicted`, docs/portfolio.rst) resolves:
+        checkpointed jobs warm their exact padded re-seat target
+        (:meth:`prewarm_targets`), restart-from-0 jobs warm their
+        pooled signature (:meth:`prewarm`) — so a resumed job never
+        pays a cold XLA compile at admission time (zero new cache
+        misses, pinned in tests/unit/test_fleet.py)."""
         if not self.journal_dir:
             return 0
         from pydcop_tpu.dcop import load_dcop_from_file
@@ -1457,8 +1608,8 @@ class SolveService:
         path = os.path.join(self.journal_dir, JOBS_JOURNAL)
         if not os.path.exists(path):
             return 0
-        n = 0
         lines, torn = self._complete_lines(path)
+        todo: List[Tuple[Dict[str, Any], str, Any, Optional[Tuple]]] = []
         for line in lines:
             line = line.strip()
             if not line:
@@ -1480,6 +1631,31 @@ class SolveService:
                 dcop = load_dcop_from_file([rec["file"]])
             except Exception:
                 continue
+            restore = None
+            ck = self._ckpt_path(jid)
+            if os.path.exists(ck):
+                try:
+                    meta, arrays = read_state_npz(ck)
+                    restore = (meta, arrays)
+                except ValueError:
+                    restore = None  # corrupt: restart from 0
+            todo.append((rec, jid, dcop, restore))
+        if prewarm and todo:
+            self.prewarm_targets(
+                [(rec["algo"], rec.get("algo_params") or {},
+                  restore_target(restore[0]))
+                 for rec, _jid, _dcop, restore in todo
+                 if restore is not None],
+                block=True,
+            )
+            fresh = [
+                (dcop, rec["algo"], rec.get("algo_params") or {})
+                for rec, _jid, dcop, restore in todo if restore is None
+                and rec["algo"] in SUPPORTED_ALGOS
+            ]
+            if fresh:
+                self.prewarm(fresh, block=True)
+        for rec, jid, dcop, restore in todo:
             self.submit(
                 dcop, rec["algo"],
                 algo_params=rec.get("algo_params") or {},
@@ -1489,23 +1665,14 @@ class SolveService:
                 deadline_s=rec.get("deadline_s"),
                 label=rec.get("label"),
                 source_file=rec["file"],
-                _jid=jid, _journal=False,
+                _jid=jid, _journal=False, _restore=restore,
             )
-            job = self._jobs[jid]
-            ck = self._ckpt_path(jid)
-            if os.path.exists(ck):
-                try:
-                    meta, arrays = read_state_npz(ck)
-                    job.restore = (meta, arrays)
-                except ValueError:
-                    job.restore = None  # corrupt: restart from 0
-            n += 1
         if torn:
             self.counters.inc("torn_journal_lines", torn)
             send_serve("journal.torn", {
                 "file": JOBS_JOURNAL, "lines": torn,
             })
         self.compact_journal()
-        send_serve("resume.done", {"jobs": n, "torn": torn})
+        send_serve("resume.done", {"jobs": len(todo), "torn": torn})
         self._wake.set()
-        return n
+        return len(todo)

@@ -1,0 +1,138 @@
+"""CLI surface of the replicated solve fleet: ``pydcop_tpu serve
+--replicas N``.
+
+The fast test is the fleet twin of the serve smoke: a seeded Poisson
+burst through a 2-replica fleet, every job completing with the
+standalone solve's exact cost/cycle/assignment and the output JSON
+carrying the ``fleet`` section (router state, per-replica counters).
+
+The ``make fleet-smoke`` scenario is ``slow``-marked: a 2-replica
+fleet with ``kill_replica`` injected mid-trace (the thread-hosted
+kill -9: the replica's scheduler halts without draining and only its
+journal survives) — every job must still complete bit-identically,
+the orphans re-seated on the peer, with a finite recovery-time
+objective recorded.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+TUTO = os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+CSP = os.path.join(INSTANCES, "coloring_csp.yaml")
+
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "PYTHONPATH": REPO,
+}
+
+
+def run_cli(*args, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu", *args],
+        capture_output=True, text=True, timeout=timeout, env=ENV,
+        cwd=REPO,
+    )
+
+
+class TestFleetSmoke:
+    def test_two_replica_fleet_serves_bit_identical(self):
+        """A seeded Poisson burst through --replicas 2: every job
+        FINISHED with exactly the standalone solve's cost, cycle and
+        assignment, the fleet section reports the routing scorecard,
+        and each per-job result names the replica that served it."""
+        from pydcop_tpu.dcop import load_dcop_from_file
+        from pydcop_tpu.runtime.run import solve_result
+
+        proc = run_cli(
+            "serve", "-a", "mgm", "--jobs", "6", "--replicas", "2",
+            "--arrival", "poisson", "--rate", "50",
+            "--arrival-seed", "7", "--lanes", "2",
+            "--max-cycles", "2000", "--prewarm", TUTO, CSP,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout)
+        assert out["status"] == "FINISHED"
+        assert len(out["results"]) == 6
+        dcops = {f: load_dcop_from_file([f]) for f in (TUTO, CSP)}
+        for jid, m in out["results"].items():
+            assert m["status"] == "FINISHED", (jid, m)
+            fn, seed = m["label"].rsplit(":", 1)
+            seq = solve_result(dcops[fn], "mgm", seed=int(seed))
+            assert m["cost"] == seq.cost, (jid, m)
+            assert m["cycle"] == seq.cycle, (jid, m)
+            assert m["assignment"] == seq.assignment, (jid, m)
+            assert m["serve"]["replica"].startswith("replica-")
+        fleet = out["fleet"]
+        assert fleet["fleet"]["jobs_routed"] == 6
+        assert set(fleet["replicas"]) == {"replica-0", "replica-1"}
+        assert all(r["up"] for r in fleet["replicas"].values())
+
+    def test_resume_rejected_with_replicas(self):
+        proc = run_cli(
+            "serve", "-a", "mgm", "--replicas", "2", "--resume",
+            "--journal-dir", "/tmp/x", TUTO,
+        )
+        assert proc.returncode == 1
+        assert "fleet" in json.loads(proc.stdout)["error"]
+
+
+@pytest.mark.slow
+class TestFleetKillSmoke:
+    """`make fleet-smoke`: the chaos-pin scenario through the CLI."""
+
+    def test_kill_replica_midtrace_all_complete_bit_identical(
+        self, tmp_path
+    ):
+        from pydcop_tpu.dcop import load_dcop_from_file
+        from pydcop_tpu.runtime.run import solve_result
+
+        plan = tmp_path / "plan.yaml"
+        plan.write_text(
+            "seed: 7\n"
+            "faults:\n"
+            "  - kind: kill_replica\n"
+            "    replica: 0\n"
+            "    cycle: 3\n"   # ~0.15s in: the un-prewarmed burst is
+                               # still compiling/solving on replica-0
+        )
+        journal = str(tmp_path / "fleet")
+        proc = run_cli(
+            "serve", "-a", "dsa", "--jobs", "16", "--replicas", "2",
+            "--lanes", "1", "--max-cycles", "2000",
+            "--journal-dir", journal, "--fault-plan", str(plan),
+            TUTO, CSP,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout)
+        assert out["status"] == "FINISHED"
+        assert len(out["results"]) == 16
+        dcops = {f: load_dcop_from_file([f]) for f in (TUTO, CSP)}
+        for jid, m in out["results"].items():
+            assert m["status"] == "FINISHED", (jid, m)
+            fn, seed = m["label"].rsplit(":", 1)
+            seq = solve_result(dcops[fn], "dsa", seed=int(seed))
+            assert m["cost"] == seq.cost, (jid, m)
+            assert m["cycle"] == seq.cycle, (jid, m)
+            assert m["assignment"] == seq.assignment, (jid, m)
+            # the dead replica served nothing to completion
+            assert m["serve"]["replica"] == "replica-1", (jid, m)
+        fleet = out["fleet"]["fleet"]
+        assert fleet["replicas_down"] == 1
+        assert fleet["faults_injected"] == 1
+        assert fleet["jobs_reseated"] >= 1
+        recov = out["fleet"]["recoveries"]
+        assert recov and recov[0]["rto_s"] is not None
+        assert recov[0]["rto_s"] > 0
+        # the fleet journal streamed the whole handoff
+        fj = os.path.join(journal, "fleet.jsonl")
+        with open(fj, encoding="utf-8") as f:
+            kinds = [json.loads(line)["kind"] for line in f
+                     if line.strip()]
+        assert kinds.count("done") == 16
+        assert "reseat" in kinds

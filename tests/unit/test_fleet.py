@@ -1,0 +1,710 @@
+"""Replicated solve fleet (pydcop_tpu.serve.fleet / router).
+
+Contracts pinned here:
+
+* **signature routing**: jobs place by compile-cache routing key —
+  warm replicas win, load spills past one bucket's worth of queue,
+  down/stalled/partitioned replicas are skipped;
+* **failover re-seating** (acceptance pin): with ``kill_replica``
+  injected mid-trace, every in-flight job of the dead replica
+  completes on a peer with results bit-identical to an unfailed
+  standalone solve, the RTO lands finite, and the re-seat admissions
+  pay ZERO new cache misses (the peer prewarms the exact re-seat
+  signature first — the PR 10 prewarm-hook fix);
+* **stall != death**: a stale heartbeat routes traffic around a
+  replica and heals when it resumes — its jobs are never re-seated;
+* **journal handoff edges**: a kill between a lane's checkpoint and
+  its ``JID:`` completion line re-runs the job exactly once (never
+  double-completes), stale ``JID:`` lines left by a mid-compaction
+  crash are harmless, and glued/unterminated lines in the streamed
+  fleet journal are skipped and counted;
+* **provenance**: every result's ``metrics()["serve"]`` names the
+  replica/JID that served it (and survives re-seats), and the
+  ServeCounters summary carries the replica label;
+* **fleet admission control**: the aggregate pending bound and the
+  fleet-wide tenant quota reject with structured, retry-after-carrying
+  errors.
+
+Tests drive :meth:`SolveFleet.tick` synchronously (no threads), so
+kill timing — "the fault lands while the doomed replica holds
+checkpointed in-flight work" — is deterministic.
+"""
+import json
+import os
+
+import pytest
+
+from pydcop_tpu.batch.cache import CompileCache
+from pydcop_tpu.batch.engine import BatchItem, adapter_for
+from pydcop_tpu.dcop import load_dcop_from_file
+from pydcop_tpu.runtime.faults import Fault, FaultPlan
+from pydcop_tpu.serve import (
+    FleetJournal,
+    FleetRouter,
+    ServiceOverloaded,
+    SolveFleet,
+    SolveService,
+    job_routing_key,
+)
+
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+TUTO = os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+
+#: cycle ceiling: a multiple of the harness chunk (7), like the
+#: single-service tests
+LIMIT = 63
+
+
+def _load():
+    return load_dcop_from_file([TUTO])
+
+
+def _standalone(dcop, algo, seed, params=None):
+    spec = adapter_for(algo).build_spec(
+        BatchItem(dcop, algo, algo_params=params, seed=seed)
+    )
+    return spec.solver.run(max_cycles=LIMIT)
+
+
+def _drain(fleet, max_ticks=400):
+    for _ in range(max_ticks):
+        if not fleet.tick():
+            return
+    raise AssertionError("fleet did not drain")
+
+
+class TestRouter:
+    def test_warm_replica_wins_placement(self):
+        r = FleetRouter()
+        r.add_replica("a")
+        r.add_replica("b")
+        r.note_warm("b", ("k",))
+        name, warm = r.place(("k",))
+        assert name == "b" and warm
+
+    def test_cold_key_goes_least_loaded_and_sticks(self):
+        r = FleetRouter()
+        r.add_replica("a")
+        r.add_replica("b")
+        r.job_placed("a")  # a carries existing load
+        name, warm = r.place(("k",))
+        assert name == "b" and not warm
+        # the family now sticks to b (co-located bucketing)
+        name2, warm2 = r.place(("k",))
+        assert name2 == "b" and warm2
+
+    def test_spill_past_one_bucket_of_queue(self):
+        r = FleetRouter(spill_load=2)
+        r.add_replica("a")
+        r.add_replica("b")
+        placements = [r.place(("k",))[0] for _ in range(4)]
+        # a takes the first two (warm affinity), then spills to b
+        assert placements[:2] == ["a", "a"]
+        assert "b" in placements[2:]
+
+    def test_down_stalled_partitioned_skipped(self):
+        r = FleetRouter()
+        for n in ("a", "b", "c", "d"):
+            r.add_replica(n)
+        r.mark_down("a")
+        r.set_stalled("b", True)
+        r.set_partitioned("c", True)
+        assert r.routable() == ["d"]
+        assert r.place(("k",))[0] == "d"
+        r.set_stalled("b", False)
+        assert set(r.routable()) == {"b", "d"}
+        r.mark_down("d")
+        r.mark_down("b")
+        assert r.place(("k",)) is None
+
+    def test_exclude_bars_the_dead_replica(self):
+        r = FleetRouter()
+        r.add_replica("a")
+        r.add_replica("b")
+        r.note_warm("a", ("k",))
+        assert r.place(("k",), exclude="a")[0] == "b"
+
+    def test_routing_key_matches_cache_key_prefix(self):
+        """The routing key is the leading fields of the runner cache
+        key the job's bucket will resolve to — same algo/params-key and
+        the spec's family_key, with NO tensor compilation needed."""
+        from pydcop_tpu.batch.engine import _params_key
+
+        dcop = _load()
+        key = job_routing_key(dcop, "mgm", {})
+        spec = adapter_for("mgm").build_spec(
+            BatchItem(dcop, "mgm", seed=0)
+        )
+        assert key == (
+            ("mgm", _params_key({})) + spec.dims.family_key
+        )
+
+
+class TestFleetEndToEnd:
+    def test_jobs_complete_bit_identical_with_provenance(self):
+        """Two replicas, four jobs: every result equals its standalone
+        solve exactly, and metrics()['serve'] names the replica + JID
+        that served it (satellite: auditable failover paths)."""
+        dcop = _load()
+        fleet = SolveFleet(replicas=2, lanes=2, max_cycles=LIMIT)
+        jids = [fleet.submit(dcop, "mgm", seed=s) for s in range(4)]
+        _drain(fleet)
+        for s, jid in enumerate(jids):
+            res = fleet.result(jid, timeout=1)
+            seq = _standalone(dcop, "mgm", s)
+            assert res.assignment == seq.assignment
+            assert res.cycle == seq.cycle
+            assert res.cost == seq.cost
+            serve = res.metrics()["serve"]
+            assert serve["jid"] == jid
+            assert serve["replica"] in ("replica-0", "replica-1")
+            assert serve["reseats"] == 0
+        m = fleet.metrics()
+        assert m["fleet"]["jobs_routed"] == 4
+        # the replica label rides each replica's counters summary too
+        assert (
+            m["replicas"]["replica-0"]["serve"]["replica"]
+            == "replica-0"
+        )
+
+    def test_standalone_service_metrics_carry_replica_field(self):
+        """The ServeCounters summary always has the replica field —
+        None for a standalone service, the name for a fleet replica."""
+        dcop = _load()
+        svc = SolveService(lanes=1, cache=CompileCache(),
+                           max_cycles=LIMIT)
+        jid = svc.submit(dcop, "mgm", seed=0)
+        for _ in range(80):
+            if not svc.tick():
+                break
+        res = svc.result(jid, timeout=1)
+        assert svc.metrics()["serve"]["replica"] is None
+        assert res.metrics()["serve"]["replica"] is None
+        assert res.metrics()["serve"]["jid"] == jid
+
+    def test_same_family_co_locates(self):
+        """Same-signature traffic lands on the replica that is already
+        warm for it (the routing tentpole) — all four jobs on one
+        replica, three of the four placements warm."""
+        dcop = _load()
+        fleet = SolveFleet(replicas=2, lanes=4, max_cycles=LIMIT)
+        for s in range(4):
+            fleet.submit(dcop, "mgm", seed=s)
+        _drain(fleet)
+        m = fleet.metrics()
+        assert m["fleet"]["jobs_routed_warm"] == 3
+        loads = [
+            r["serve"]["jobs_admitted"]
+            for r in m["replicas"].values()
+        ]
+        assert sorted(loads) == [0, 4]
+
+    def test_prewarm_distributes_families(self):
+        """Fleet prewarm assigns each routing-key group to a replica
+        round-robin; arrivals then route onto their warm replica."""
+        from pydcop_tpu.generators import generate_graph_coloring
+
+        col = _load()  # binary constraints
+        tri = generate_graph_coloring(
+            n_variables=8, n_colors=3, n_edges=16, soft=True,
+            n_agents=1, seed=4,
+        )
+        fleet = SolveFleet(replicas=2, lanes=2, max_cycles=LIMIT)
+        spread = fleet.prewarm(
+            [(col, "mgm"), (tri, "dsa")], block=True
+        )
+        assert sum(spread.values()) == 2  # two families prewarmed
+        a = fleet.submit(col, "mgm", seed=0)
+        b = fleet.submit(tri, "dsa", seed=0)
+        _drain(fleet)
+        assert fleet.metrics()["fleet"]["jobs_routed_warm"] == 2
+        ra, rb = fleet.result(a, timeout=1), fleet.result(b, timeout=1)
+        # the two families ended on the two different replicas
+        assert (
+            ra.metrics()["serve"]["replica"]
+            != rb.metrics()["serve"]["replica"]
+        )
+
+
+class TestFailover:
+    def _run_kill(self, tmp_path, algo="dsa", jobs=4, kill_tick=3):
+        dcop = _load()
+        jd = str(tmp_path / "fleet")
+        plan = FaultPlan(faults=[Fault(
+            kind="kill_replica", replica=0, cycle=kill_tick,
+        )])
+        fleet = SolveFleet(replicas=2, lanes=2, max_cycles=LIMIT,
+                           journal_dir=jd, checkpoint_every=1,
+                           fault_plan=plan)
+        jids = [fleet.submit(dcop, algo, seed=s) for s in range(jobs)]
+        _drain(fleet)
+        return dcop, fleet, jids
+
+    def test_kill_replica_reseats_bit_identical(self, tmp_path):
+        """Acceptance pin: kill one of two replicas while its lanes
+        hold checkpointed mid-flight jobs; every job completes on the
+        peer, bit-identical to an unfailed standalone run, with a
+        finite recovery-time objective and checkpoint re-seats
+        actually used (not cold restarts)."""
+        dcop, fleet, jids = self._run_kill(tmp_path)
+        m = fleet.metrics()
+        assert m["fleet"]["replicas_down"] == 1
+        assert m["fleet"]["faults_injected"] == 1
+        assert m["fleet"]["jobs_reseated"] >= 1
+        assert m["fleet"]["reseat_checkpoint_hits"] >= 1
+        assert m["fleet"]["recoveries_completed"] == 1
+        [rec] = m["recoveries"]
+        assert rec["rto_s"] is not None and rec["rto_s"] > 0
+        assert rec["pending"] == []
+        reseated = 0
+        for s, jid in enumerate(jids):
+            res = fleet.result(jid, timeout=1)
+            seq = _standalone(dcop, "dsa", s)
+            assert res.status == "FINISHED"
+            assert res.assignment == seq.assignment, (jid, s)
+            assert res.cycle == seq.cycle, (jid, s)
+            assert res.cost == seq.cost, (jid, s)
+            serve = res.metrics()["serve"]
+            # everything ends on the survivor: jobs that load-spilled
+            # there before the kill show reseats 0, the orphans 1
+            assert serve["replica"] == "replica-1"
+            reseated += serve["reseats"]
+        assert reseated == m["fleet"]["jobs_reseated"]
+
+    def test_reseat_admission_pays_zero_new_cache_misses(
+        self, tmp_path
+    ):
+        """Satellite pin: the peer prewarms the exact re-seat
+        signature BEFORE the orphaned jobs are re-submitted, so every
+        compile miss on the peer happened at prewarm time — the
+        failover admission path itself is all cache hits.  Two jobs:
+        both co-locate on replica-0 (no load spill), so the peer's
+        cache is UNTOUCHED until the re-seat."""
+        _dcop, fleet, _jids = self._run_kill(tmp_path, jobs=2)
+        peer = fleet.handle(1).service.cache.stats()
+        assert peer["misses"] >= 1
+        assert peer["misses"] == peer["prewarmed"]
+        assert peer["hits"] >= 1
+
+    def test_fleet_journal_streams_the_handoff(self, tmp_path):
+        """The fleet journal records placement, replica lifecycle,
+        re-seat and completion for every job — and exactly ONE done
+        record per jid (no double-complete)."""
+        _dcop, fleet, jids = self._run_kill(tmp_path)
+        records, torn = fleet.journal.load()
+        assert torn == 0
+        kinds = [r["kind"] for r in records]
+        assert kinds.count("job") == len(jids)
+        assert "reseat" in kinds
+        downs = [r for r in records if r["kind"] == "replica"
+                 and r["event"] == "down"]
+        assert [d["name"] for d in downs] == ["replica-0"]
+        for jid in jids:
+            dones = [r for r in records
+                     if r["kind"] == "done" and r["jid"] == jid]
+            assert len(dones) == 1, jid
+            assert dones[0]["replica"] == "replica-1"
+
+    def test_kill_between_checkpoint_and_jid_line_reruns_once(
+        self, tmp_path
+    ):
+        """Satellite pin: a kill landing AFTER a lane checkpointed but
+        BEFORE its JID: completion line means the job must re-run (from
+        the checkpoint) and complete exactly once — re-seated, not
+        double-completed, and not dropped."""
+        dcop = _load()
+        jd = str(tmp_path / "fleet")
+        fleet = SolveFleet(replicas=2, lanes=1, max_cycles=LIMIT,
+                           journal_dir=jd, checkpoint_every=1)
+        jid = fleet.submit(dcop, "dsa", seed=0)
+        fleet.tick()
+        fleet.tick()  # checkpointed at two chunk boundaries, not done
+        h0 = fleet.handle(0)
+        assert os.path.exists(h0.checkpoint_path(jid))
+        assert jid not in h0.done_jids()  # no JID: line yet
+        assert not fleet._jobs[jid].done.is_set()
+        h0.kill()
+        _drain(fleet)
+        res = fleet.result(jid, timeout=1)
+        seq = _standalone(dcop, "dsa", 0)
+        assert res.assignment == seq.assignment
+        assert res.cycle == seq.cycle
+        m = fleet.metrics()
+        assert m["fleet"]["jobs_reseated"] == 1
+        assert m["fleet"]["reseat_checkpoint_hits"] == 1
+        records, _ = fleet.journal.load()
+        dones = [r for r in records if r["kind"] == "done"]
+        assert len(dones) == 1 and dones[0]["jid"] == jid
+
+    def test_job_done_on_disk_is_never_rerun(self, tmp_path):
+        """The other side of the same edge: a job whose JID: line
+        reached the dead replica's disk is DONE — the re-seat pass
+        must skip it even though the replica died."""
+        dcop = _load()
+        jd = str(tmp_path / "fleet")
+        fleet = SolveFleet(replicas=2, lanes=1, max_cycles=LIMIT,
+                           journal_dir=jd, checkpoint_every=1)
+        a = fleet.submit(dcop, "mgm", seed=0)
+        _drain(fleet)  # a completes on replica-0, JID line on disk
+        h0 = fleet.handle(0)
+        assert a in h0.done_jids()
+        b = fleet.submit(dcop, "mgm", seed=1)
+        fleet.tick()  # b mid-flight on the warm replica-0
+        h0.kill()
+        _drain(fleet)
+        m = fleet.metrics()
+        assert m["fleet"]["jobs_reseated"] == 1  # only b
+        assert fleet.result(b, timeout=1).status == "FINISHED"
+        records, _ = fleet.journal.load()
+        assert len([r for r in records if r["kind"] == "done"
+                    and r["jid"] == a]) == 1
+
+    def test_mid_compaction_kill_leaves_harmless_stale_lines(
+        self, tmp_path
+    ):
+        """Satellite pin: a replica killed between compaction's two
+        atomic renames leaves jobs.jsonl compacted but progress_serve
+        still holding JID: lines for records no longer journaled —
+        stale-but-harmless by design.  The fleet re-seat (and a
+        single-service resume) must re-run exactly the truly
+        unfinished jobs and ignore the stale completions."""
+        dcop = _load()
+        jd = str(tmp_path / "fleet")
+        fleet = SolveFleet(replicas=2, lanes=1, max_cycles=LIMIT,
+                           journal_dir=jd, checkpoint_every=1)
+        a = fleet.submit(dcop, "mgm", seed=0)
+        _drain(fleet)
+        assert fleet.result(a, timeout=1).status == "FINISHED"
+        h0 = fleet.handle(0)
+        # replica-0's journal auto-compacted a away on completion?  No:
+        # compaction is size-triggered — force the mid-compaction
+        # crash state by compacting jobs.jsonl and RE-APPENDING the
+        # stale JID line (rename 1 done, rename 2 lost)
+        h0.service.compact_journal()
+        with open(os.path.join(h0.journal_dir, "progress_serve"),
+                  "a", encoding="utf-8") as f:
+            f.write(f"JID: {a}\n")  # the stale completion line
+        b = fleet.submit(dcop, "dsa", seed=1)
+        fleet.tick()
+        fleet.tick()
+        h0.kill()
+        _drain(fleet)
+        res = fleet.result(b, timeout=1)
+        seq = _standalone(dcop, "dsa", 1)
+        assert res.assignment == seq.assignment
+        assert res.cycle == seq.cycle
+        # exactly the one unfinished job re-seated; the stale line
+        # neither resurrected a done job nor blocked the live one
+        assert fleet.metrics()["fleet"]["jobs_reseated"] == 1
+
+    def test_scheduler_death_reseats_instead_of_erroring(self):
+        """A replica whose SCHEDULER dies (tick supervisor exhausted)
+        is a replica loss, not a job failure: the service-side ERROR
+        completions are ignored by the fleet tap and the supervisor
+        re-seats the jobs on a peer, which completes them
+        bit-identically."""
+        dcop = _load()
+        fleet = SolveFleet(replicas=2, lanes=1, max_cycles=LIMIT)
+        jid = fleet.submit(dcop, "mgm", seed=0)
+        fleet.tick()  # mid-flight on replica-0
+        h0 = fleet.handle(0)
+        h0.service._scheduler_died(RuntimeError("tick kept throwing"))
+        _drain(fleet)
+        res = fleet.result(jid, timeout=1)
+        seq = _standalone(dcop, "mgm", 0)
+        assert res.status == "FINISHED"
+        assert res.assignment == seq.assignment
+        assert res.cycle == seq.cycle
+        m = fleet.metrics()
+        assert m["fleet"]["replicas_down"] == 1
+        assert m["fleet"]["jobs_reseated"] == 1
+
+    def test_all_replicas_down_fails_loudly(self, tmp_path):
+        """Losing every replica ends the job in a terminal structured
+        ERROR (the re-seat finds no routable peer) — a caller blocked
+        on result() gets an answer, never a hang."""
+        dcop = _load()
+        fleet = SolveFleet(replicas=2, lanes=1, max_cycles=LIMIT)
+        jid = fleet.submit(dcop, "mgm", seed=0)
+        fleet.handle(0).kill()
+        fleet.handle(1).kill()
+        for _ in range(10):
+            fleet.tick()
+        res = fleet.result(jid, timeout=1)
+        assert res.status == "ERROR"
+        assert res.metrics()["serve"]["error"]  # names the cause
+        # and NEW submissions are refused loudly
+        from pydcop_tpu.serve import ServiceStopped
+
+        with pytest.raises(ServiceStopped):
+            fleet.submit(dcop, "mgm", seed=1)
+
+
+class TestStallAndPartition:
+    def test_stale_heartbeat_routes_around_then_heals(self):
+        """Stall != death: a stale heartbeat makes the replica
+        unroutable (new traffic goes to peers, nothing re-seats); a
+        fresh heartbeat heals it."""
+        import time as _time
+
+        dcop = _load()
+        fleet = SolveFleet(replicas=2, lanes=2, max_cycles=LIMIT,
+                           heartbeat_timeout=1.0)
+        # heartbeats only arm in threaded mode; fake it tick-driven
+        fleet._started = True
+        h0 = fleet.handle(0)
+        h1 = fleet.handle(1)
+        for h in (h0, h1):
+            h.hb_path = str(h.name) + ".hb"
+        try:
+            for h in (h0, h1):
+                with open(h.hb_path, "w"):
+                    pass
+            old = _time.time() - 60
+            os.utime(h0.hb_path, (old, old))  # h0 wedged
+            fleet._supervise()
+            assert h0.stalled
+            assert fleet.router.routable() == ["replica-1"]
+            assert fleet.metrics()["fleet"]["replicas_stalled"] == 1
+            # nothing was re-seated: a stall is not a death
+            assert fleet.metrics()["fleet"]["jobs_reseated"] == 0
+            jid = fleet.submit(dcop, "mgm", seed=0)
+            with open(h0.hb_path, "a"):
+                os.utime(h0.hb_path, None)  # h0 recovers
+            fleet._supervise()
+            assert not h0.stalled
+            assert fleet.metrics()["fleet"]["replicas_healed"] == 1
+            _drain(fleet)
+            res = fleet.result(jid, timeout=1)
+            assert res.metrics()["serve"]["replica"] == "replica-1"
+        finally:
+            for h in (h0, h1):
+                if os.path.exists(h.hb_path):
+                    os.unlink(h.hb_path)
+
+    def test_partition_bars_new_placements_until_heal(self):
+        """partition_replica: the replica takes no NEW jobs while
+        partitioned but its in-flight work keeps running; the
+        partition heals after its duration."""
+        dcop = _load()
+        plan = FaultPlan(faults=[Fault(
+            kind="partition_replica", replica=0, cycle=2,
+            duration=1e-6,  # heals on the next supervisor pass
+        )])
+        fleet = SolveFleet(replicas=2, lanes=2, max_cycles=LIMIT,
+                           fault_plan=plan)
+        a = fleet.submit(dcop, "mgm", seed=0)  # lands on replica-0
+        fleet.tick()  # tick 1: a admitted on replica-0
+        fleet.tick()  # tick 2: partition fires
+        assert fleet.router.routable() == ["replica-1"]
+        b = fleet.submit(dcop, "mgm", seed=1)  # must avoid replica-0
+        _drain(fleet)
+        m = fleet.metrics()
+        assert m["fleet"]["replicas_partitioned"] == 1
+        assert m["fleet"]["replicas_healed"] == 1
+        ra, rb = fleet.result(a, timeout=1), fleet.result(b, timeout=1)
+        assert ra.metrics()["serve"]["replica"] == "replica-0"
+        assert rb.metrics()["serve"]["replica"] == "replica-1"
+        seq = _standalone(dcop, "mgm", 0)
+        assert ra.assignment == seq.assignment
+
+    def test_stall_replica_fault_wedges_one_tick(self):
+        """stall_replica wires through the injector: the target
+        replica's next tick sleeps `duration` (heartbeat stale from
+        outside); jobs still complete correctly afterwards."""
+        from time import monotonic
+
+        dcop = _load()
+        plan = FaultPlan(faults=[Fault(
+            kind="stall_replica", replica=0, cycle=2, duration=0.05,
+        )])
+        fleet = SolveFleet(replicas=2, lanes=2, max_cycles=LIMIT,
+                           fault_plan=plan)
+        jid = fleet.submit(dcop, "mgm", seed=0)
+        t0 = monotonic()
+        _drain(fleet)
+        assert monotonic() - t0 >= 0.05  # the wedge really happened
+        assert fleet.metrics()["fleet"]["faults_injected"] == 1
+        seq = _standalone(dcop, "mgm", 0)
+        res = fleet.result(jid, timeout=1)
+        assert res.assignment == seq.assignment
+        assert res.cycle == seq.cycle
+
+
+class TestFleetAdmission:
+    def test_aggregate_pending_bound(self):
+        """max_pending aggregates across routable replicas into ONE
+        fleet bound; a submit past it sheds with a structured
+        retry-after-carrying overload error."""
+        dcop = _load()
+        fleet = SolveFleet(replicas=2, lanes=1, max_cycles=LIMIT,
+                           max_pending=1)
+        fleet.submit(dcop, "mgm", seed=0)
+        fleet.submit(dcop, "mgm", seed=1)
+        with pytest.raises(ServiceOverloaded) as ei:
+            fleet.submit(dcop, "mgm", seed=2)
+        assert ei.value.retry_after > 0
+        assert fleet.metrics()["fleet"]["jobs_shed"] == 1
+        _drain(fleet)
+
+    def test_bound_shrinks_when_a_replica_dies(self):
+        """A degraded fleet sheds earlier: with one of two replicas
+        down, the aggregate bound halves."""
+        dcop = _load()
+        fleet = SolveFleet(replicas=2, lanes=1, max_cycles=LIMIT,
+                           max_pending=1)
+        fleet.handle(1).kill()
+        fleet.tick()  # supervisor notices the death
+        fleet.submit(dcop, "mgm", seed=0)
+        with pytest.raises(ServiceOverloaded):
+            fleet.submit(dcop, "mgm", seed=1)
+        _drain(fleet)
+
+    def test_fleet_tenant_quota(self):
+        dcop = _load()
+        fleet = SolveFleet(replicas=2, lanes=2, max_cycles=LIMIT,
+                           tenant_quota=1)
+        fleet.submit(dcop, "mgm", seed=0, tenant="t1")
+        with pytest.raises(ServiceOverloaded):
+            fleet.submit(dcop, "mgm", seed=1, tenant="t1")
+        # another tenant is unaffected
+        fleet.submit(dcop, "mgm", seed=2, tenant="t2")
+        assert fleet.metrics()["fleet"]["quota_rejections"] == 1
+        _drain(fleet)
+
+
+class TestFleetJournalEdges:
+    def test_glued_and_unterminated_lines_skipped_and_counted(
+        self, tmp_path
+    ):
+        """Satellite pin: the streamed fleet journal tolerates the
+        same damage the per-replica journals do — a glued double-line
+        fragment and an append cut short are skipped and counted,
+        never fatal."""
+        path = str(tmp_path / "fleet.jsonl")
+        j = FleetJournal(path)
+        j.append({"kind": "job", "jid": "job-000001"})
+        j.append({"kind": "done", "jid": "job-000001"})
+        with open(path, "a", encoding="utf-8") as f:
+            # a torn append glued to the next record: one unparseable
+            # merged line
+            f.write('{"kind": "job", "ji{"kind": "done", "jid": "x"}\n')
+            # and a final append cut short mid-record, no newline
+            f.write('{"kind": "job", "jid": "job-0000')
+        records, torn = j.load()
+        assert [r["kind"] for r in records] == ["job", "done"]
+        assert torn == 2
+
+    def test_load_missing_and_empty(self, tmp_path):
+        j = FleetJournal(str(tmp_path / "nope.jsonl"))
+        assert j.load() == ([], 0)
+        open(j.path, "w").close()
+        assert j.load() == ([], 0)
+
+    def test_non_record_json_counts_torn(self, tmp_path):
+        j = FleetJournal(str(tmp_path / "fleet.jsonl"))
+        with open(j.path, "w", encoding="utf-8") as f:
+            f.write('[1, 2]\n{"no_kind": true}\n')
+        records, torn = j.load()
+        assert records == [] and torn == 2
+
+
+class TestResumePrewarm:
+    def test_resume_prewarms_reseat_signatures(self, tmp_path):
+        """Satellite pin (single service): resume() warms the exact
+        re-seat targets BEFORE re-queueing, so the admission path pays
+        zero new cache misses — every miss on the fresh cache happened
+        inside resume()'s blocking prewarm."""
+        dcop = _load()
+        jd = str(tmp_path / "journal")
+        svc1 = SolveService(lanes=2, cache=CompileCache(),
+                            max_cycles=LIMIT, journal_dir=jd,
+                            checkpoint_every=1)
+        a = svc1.submit(dcop, "dsa", seed=0, source_file=TUTO)
+        b = svc1.submit(dcop, "dsa", seed=1, source_file=TUTO)
+        svc1.tick()
+        svc1.tick()  # checkpointed mid-flight
+        assert not svc1._jobs[a].done.is_set()
+        del svc1  # crash
+
+        cache = CompileCache()
+        svc2 = SolveService(lanes=2, cache=cache, max_cycles=LIMIT,
+                            journal_dir=jd, checkpoint_every=1)
+        assert svc2.resume() == 2
+        misses_at_resume = cache.stats()["misses"]
+        assert misses_at_resume >= 1  # the prewarm compiled something
+        assert cache.stats()["prewarmed"] == misses_at_resume
+        for _ in range(120):
+            if not svc2.tick():
+                break
+        # ZERO new cache misses after resume() returned
+        assert cache.stats()["misses"] == misses_at_resume
+        for jid, seed in ((a, 0), (b, 1)):
+            res = svc2.result(jid, timeout=1)
+            seq = _standalone(dcop, "dsa", seed)
+            assert res.assignment == seq.assignment
+            assert res.cycle == seq.cycle
+
+    def test_resume_prewarm_optional(self, tmp_path):
+        """resume(prewarm=False) keeps the old lazy behavior."""
+        dcop = _load()
+        jd = str(tmp_path / "journal")
+        svc1 = SolveService(lanes=1, cache=CompileCache(),
+                            max_cycles=LIMIT, journal_dir=jd,
+                            checkpoint_every=1)
+        svc1.submit(dcop, "mgm", seed=0, source_file=TUTO)
+        svc1.tick()
+        del svc1
+        cache = CompileCache()
+        svc2 = SolveService(lanes=1, cache=cache, max_cycles=LIMIT,
+                            journal_dir=jd)
+        assert svc2.resume(prewarm=False) == 1
+        assert cache.stats()["misses"] == 0  # nothing compiled yet
+
+
+class TestFleetEvents:
+    def test_fleet_lifecycle_events_emitted(self, tmp_path):
+        from pydcop_tpu.runtime.events import event_bus
+
+        dcop = _load()
+        seen = []
+        cb = lambda topic, evt: seen.append(topic)  # noqa: E731
+        event_bus.enabled = True
+        event_bus.subscribe("fleet.*", cb)
+        try:
+            plan = FaultPlan(faults=[Fault(
+                kind="kill_replica", replica=0, cycle=3,
+            )])
+            fleet = SolveFleet(replicas=2, lanes=2, max_cycles=LIMIT,
+                               journal_dir=str(tmp_path / "f"),
+                               checkpoint_every=1, fault_plan=plan)
+            jid = fleet.submit(dcop, "dsa", seed=0)
+            _drain(fleet)
+            fleet.result(jid, timeout=1)
+        finally:
+            event_bus.unsubscribe(cb)
+            event_bus.enabled = False
+        for expected in ("fleet.replica.up", "fleet.router.placed",
+                         "fleet.fault.injected", "fleet.replica.down",
+                         "fleet.job.reseated", "fleet.recovery.done"):
+            assert expected in seen, (expected, sorted(set(seen)))
+
+    def test_unknown_fleet_counter_rejected(self):
+        from pydcop_tpu.runtime.stats import FleetCounters
+
+        with pytest.raises(KeyError):
+            FleetCounters().inc("nope")
+
+    def test_fleet_fault_kinds_validate(self):
+        with pytest.raises(ValueError, match="needs a 'replica'"):
+            Fault(kind="kill_replica")
+        with pytest.raises(ValueError, match="duration"):
+            Fault(kind="stall_replica", replica=0)
+        f = Fault(kind="partition_replica", replica=1, duration=0.5)
+        rt = Fault(**{k: v for k, v in f.to_dict().items()})
+        assert rt == f
+        plan = FaultPlan(faults=[f])
+        assert plan.fleet_faults() == [f]
+        assert plan.serve_faults() == []
+        # round-trips through the env/json channel like every kind
+        assert FaultPlan.from_json(plan.to_json()).fleet_faults() == [f]

@@ -150,6 +150,21 @@ def test_events_are_pushed(served_orchestrator):
       "cost": 12.0, "latency": 0.4}),
     ("serve.bucket.opened", "serve",
      {"algo": "mgm", "lanes": 4, "warm": True}),
+    ("fleet.replica.up", "fleet", {"name": "replica-0"}),
+    ("fleet.replica.down", "fleet",
+     {"name": "replica-1", "reason": "injected kill"}),
+    ("fleet.replica.stalled", "fleet", {"name": "replica-0"}),
+    ("fleet.replica.healed", "fleet",
+     {"name": "replica-0", "was": "stalled"}),
+    ("fleet.router.placed", "fleet",
+     {"jid": "job-000003", "replica": "replica-1",
+      "key": ["mgm", "()", "constraints_hypergraph", "(2,)"],
+      "warm": True}),
+    ("fleet.job.reseated", "fleet",
+     {"jid": "job-000002", "from": "replica-0", "to": "replica-1",
+      "checkpoint": True}),
+    ("fleet.recovery.done", "fleet",
+     {"replica": "replica-0", "jobs": 3, "rto_s": 0.42}),
     ("batch.bucket.formed", "batch", {"algo": "mgm", "size": 3}),
     ("harness.run.done", "harness", {"algo": "mgm", "cycle": 21}),
     ("dpop.shard.plan", "dpop",
