@@ -3,7 +3,8 @@
 .PHONY: test unit api cli doctest all-tests bench bench-probe faults \
 	bench-batch batch-smoke bench-harness bench-sharded bench-serve \
 	serve-smoke chaos-smoke bench-churn churn-smoke bench-dpop \
-	dpop-smoke bench-auto portfolio-smoke bench-fleet fleet-smoke
+	dpop-smoke bench-auto portfolio-smoke bench-fleet fleet-smoke \
+	bench-twin twin-smoke bench-r06
 
 test: all-tests
 
@@ -147,6 +148,32 @@ bench-auto:
 portfolio-smoke:
 	JAX_PLATFORMS=cpu python -m pytest \
 		tests/cli/test_portfolio_cli.py -q -m 'not slow'
+
+# city-scale digital twin (ISSUE 12): the combined sustained scenario
+# — Poisson deadline-tier traffic through the fleet + warm-repair
+# churn + the combined chaos plan + --auto — scored by SLO attainment,
+# ladder ON vs OFF on the same seeds; the headline is gold-tier
+# attainment holding >= 99% under chaos with the ladder while the
+# ladder-off arm measurably misses it, with bit-identity to standalone
+# solves pinned (docs/scenarios.rst, BENCHREF.md "City twin")
+bench-twin:
+	python bench.py --only twin
+
+# the serve/churn/dpop-sharded/auto/fleet/twin legs in one run with a
+# machine-readable BENCH_r06.json snapshot — the consolidated perf
+# record resuming past r05 (ROADMAP re-anchor note)
+bench-r06:
+	python bench.py --only r06 --snapshot BENCH_r06.json
+
+# the small twin end-to-end through the CLI: 2 replicas, 3 tiers, 10
+# mutations, 1 injected kill — finite RTO, zero gold deadline misses,
+# ladder engaged-and-released; slow-marked, so it does NOT run in
+# tier-1 — run it next to fleet-smoke/chaos-smoke whenever touching
+# the scenario tier.  The fast (not-slow) twin CLI tests ride tier-1
+# via tests/cli.
+twin-smoke:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/cli/test_twin_cli.py -q -m slow
 
 # the seeded churn fault plan driven end-to-end through `run
 # --warm-repair`: edit_factor / remove_agent_burst / add_agent_burst at

@@ -1931,6 +1931,250 @@ def bench_auto(args, probe=None):
     return out
 
 
+def bench_twin(args, probe=None):
+    """City-scale digital twin (ISSUE 12): the combined sustained
+    scenario — seeded Poisson multi-tenant traffic with gold/silver/
+    bronze deadline tiers through a replicated SolveFleet, concurrent
+    warm-repair churn against a live tracking problem, the combined
+    chaos plan (kill_replica + stall_tick + nan_lane +
+    torn_journal_write + edit_factor), and --auto portfolio selection
+    — scored by SLO attainment, twice on the SAME seeds:
+
+    * ladder ON — the guardrail ladder (shed bronze → clamp silver
+      chunks → reroute gold to the emptiest healthy replica) guards
+      the gold floor; acceptance: gold attainment >= 0.99 under the
+      chaos plan;
+    * ladder OFF — identical scenario, ladder never escalates; the
+      pin is that gold attainment measurably misses the floor, i.e.
+      the ladder (not slack capacity) is what holds gold.
+
+    Saturation is real compute contention: bronze jobs are large
+    slow-converging coloring instances that dilute every tick while
+    they run; shedding them is what buys gold its latency back.
+    Bit-identity: every FINISHED job of the chaos run must equal its
+    standalone solve exactly (mgm traffic — chunk-independent streams
+    — so deadline-shrunk chunks cannot perturb results), the serve
+    determinism contract surviving the full combined scenario
+    (BENCHREF.md "City twin")."""
+    import dataclasses as _dc
+
+    from pydcop_tpu.generators import (
+        generate_graph_coloring,
+        generate_routing,
+        generate_tracking,
+        tracking_scenario,
+    )
+    from pydcop_tpu.scenario import (
+        TierSpec,
+        TwinJob,
+        TwinRunner,
+        default_chaos_plan,
+        standalone_results,
+    )
+
+    seed = args.twin_seed
+    n_jobs = args.twin_jobs
+    max_cycles = 300
+    tiers = (
+        TierSpec("gold", priority=2, deadline_s=args.twin_gold_deadline,
+                 floor=0.99, share=0.25),
+        TierSpec("silver", priority=1,
+                 deadline_s=args.twin_silver_deadline, floor=0.90,
+                 share=0.25),
+        TierSpec("bronze", priority=0,
+                 deadline_s=args.twin_bronze_deadline, floor=0.50,
+                 share=0.50),
+    )
+    rng = np.random.default_rng(seed)
+    inter = rng.exponential(args.twin_interarrival, n_jobs)
+    inter[0] = 0.0
+    ticks = np.cumsum(inter).astype(int)
+    # deterministic tier pattern (per 12: silver 4, gold 3, bronze 5):
+    # a bronze-light prefix so the first gold flies nearly clean in
+    # both arms (pre-engagement traffic is identical by construction),
+    # ONE early bronze so the following silvers miss their tight
+    # budget and engage the ladder before the backlog builds, and gold
+    # spread through the trace so late gold rides the regime the
+    # ladder (or its absence) created — the A/B's discriminating
+    # samples
+    pattern = ("silver", "silver", "gold", "bronze",
+               "silver", "bronze", "silver", "bronze",
+               "gold", "bronze", "gold", "bronze")
+    jobs = []
+    for i in range(n_jobs):
+        tier = {t.name: t for t in tiers}[pattern[i % len(pattern)]]
+        if tier.name == "gold":
+            # small, fast — the protected tier; alternate the two new
+            # hard-axis families
+            if i % 2:
+                dcop, fam = generate_routing(12, seed=1000 + i), "routing"
+            else:
+                dcop, fam = (
+                    generate_tracking(16, n_targets=2, seed=1000 + i),
+                    "tracking",
+                )
+        elif tier.name == "silver":
+            V = 150
+            dcop, fam = generate_graph_coloring(
+                n_variables=V, n_colors=args.colors, n_edges=V * 3,
+                soft=True, n_agents=1, seed=2000 + i,
+            ), "coloring"
+        else:
+            V = args.twin_bronze_vars
+            dcop, fam = generate_graph_coloring(
+                n_variables=V, n_colors=args.colors, n_edges=V * 3,
+                soft=True, n_agents=1, seed=3000 + i,
+            ), "coloring"
+        # bronze runs dsa at p=1.0: every improving variable flips
+        # every cycle, so the walk never holds two stable chunks and
+        # runs to the cycle cap — long-lived background load that
+        # genuinely ACCUMULATES in the OFF arm while the ladder arm
+        # sheds it.  Bronze never rides a deadline clamp (60 s
+        # budget), so its chunk stream — and with it bit-identity — is
+        # untouched.
+        algo, params = (
+            ("dsa", {"probability": 1.0})
+            if tier.name == "bronze" else ("mgm", {})
+        )
+        jobs.append(TwinJob(
+            index=i, dcop=dcop, family=fam, tier=tier.name,
+            tenant=tier.name, seed=i, arrival_tick=int(ticks[i]),
+            algo=algo, algo_params=params,
+            label=f"{fam}:{tier.name}:{i}",
+        ))
+
+    # --auto arm: the portfolio selector (heuristic fallback without a
+    # trained model) picks the GOLD tier's configs — the protected
+    # traffic chooses its engine; silver/bronze stay the designed
+    # background load the A/B depends on.  Batch-eligible picks
+    # override the algo, every choice is recorded.
+    auto_configs = []
+    try:
+        from pydcop_tpu.batch.engine import SUPPORTED_ALGOS
+        from pydcop_tpu.portfolio.select import select_config
+
+        for job in jobs:
+            if job.tier != "gold":
+                continue
+            sel = select_config(job.dcop)
+            job.config = sel.config.as_dict()
+            auto_configs.append(
+                {"label": job.label, "config": sel.config.key()}
+            )
+            if sel.config.algo in SUPPORTED_ALGOS:
+                job.algo = sel.config.algo
+                job.algo_params = dict(sel.config.algo_params())
+    except Exception as e:
+        auto_configs = [{"error": repr(e)}]
+
+    side = max(4, int(round(args.twin_live_vars ** 0.5)))
+
+    def one_run(ladder):
+        run_jobs = [
+            _dc.replace(j, jid=None, submitted_at=None, scored=False)
+            for j in jobs
+        ]
+        live = generate_tracking(side * side, n_targets=3,
+                                 seed=seed + 1)
+        scen = tracking_scenario(live, args.twin_mutations)
+        plan = default_chaos_plan(
+            seed=seed, kill_tick=args.twin_kill_tick,
+            stall_tick_at=4, nan_tick=18, churn_edit_ticks=(10, 18),
+        )
+        twin = TwinRunner(
+            run_jobs, tiers, replicas=args.twin_replicas,
+            lanes=args.twin_lanes,
+            max_buckets=args.twin_max_buckets or None,
+            max_cycles=max_cycles,
+            fault_plan=plan, live_dcop=live, live_scenario=scen,
+            ladder=ladder, ladder_min_samples=3, ladder_window=8,
+            # ticks are the hysteresis clock and they are FAST: a
+            # short hold would release mid-pressure and let bronze
+            # leak back in (measured in the r06 shakedown)
+            ladder_hold=30,
+        )
+        t0 = time.perf_counter()
+        card = twin.run()
+        return twin, card, time.perf_counter() - t0
+
+    # throwaway warmup: absorb one-time process costs (imports, jit
+    # warmup, allocator growth) so the FIRST measured arm is not the
+    # one paying them — without this the ON arm (run first) reads
+    # ~0.5 s slower on its early jobs than the identical OFF prefix
+    warm_jobs = [
+        _dc.replace(j, jid=None, submitted_at=None, scored=False)
+        for j in jobs[:4]
+    ]
+    TwinRunner(
+        warm_jobs, tiers, replicas=args.twin_replicas,
+        lanes=args.twin_lanes, max_cycles=40,
+    ).run(max_ticks=400)
+
+    twin_on, card_on, wall_on = one_run(True)
+    twin_off, card_off, wall_off = one_run(False)
+
+    # the unfaulted anchor: FINISHED chaos-run jobs must be
+    # bit-identical to standalone solves of the same (instance, algo,
+    # seed)
+    base = standalone_results(jobs, max_cycles=max_cycles)
+    checked = mismatched = 0
+    for label, res in twin_on.results.items():
+        if res.status != "FINISHED":
+            continue
+        b = base[label]
+        checked += 1
+        if not (res.cost == b.cost and res.assignment == b.assignment):
+            mismatched += 1
+
+    def att(card, tier):
+        return card["tiers"][tier]["attainment"]
+
+    g_on, g_off = att(card_on, "gold"), att(card_off, "gold")
+    out = {
+        "twin_jobs": n_jobs,
+        "twin_seed": seed,
+        "twin_replicas": args.twin_replicas,
+        "twin_live_vars": side * side,
+        "twin_mutations": args.twin_mutations,
+        "twin_wall_s_ladder_on": round(wall_on, 2),
+        "twin_wall_s_ladder_off": round(wall_off, 2),
+        "twin_gold_attainment_ladder_on": g_on,
+        "twin_gold_attainment_ladder_off": g_off,
+        "twin_gold_holds_floor": bool(
+            g_on is not None and g_on >= 0.99
+        ),
+        "twin_ladder_effective": bool(
+            g_on is not None and g_on >= 0.99
+            and (g_off is None or g_off < 0.99)
+        ),
+        "twin_silver_attainment_ladder_on": att(card_on, "silver"),
+        "twin_bronze_shed_ladder_on": card_on["tiers"]["bronze"]["shed"],
+        "twin_shed_rate_ladder_on": card_on["shed_rate"],
+        "twin_shed_rate_ladder_off": card_off["shed_rate"],
+        "twin_gold_p99_ms_ladder_on": card_on["tiers"]["gold"].get(
+            "p99_ms"),
+        "twin_gold_p99_ms_ladder_off": card_off["tiers"]["gold"].get(
+            "p99_ms"),
+        "twin_rto_s": card_on["rto_max_s"],
+        "twin_recover_s_mean": card_on["recover_s_mean"],
+        "twin_churn_retraces": (
+            card_on.get("churn", {}).get("repair_retraces")
+        ),
+        "twin_ladder": card_on["ladder"],
+        "twin_slo_counters": card_on["slo"],
+        "twin_fleet": card_on["fleet"],
+        "twin_bitmatch_checked": checked,
+        "twin_bitmatch": mismatched == 0 and checked > 0,
+        "twin_auto_configs": auto_configs,
+    }
+    if probe is not None:
+        pr = probe()
+        if pr and out["twin_gold_p99_ms_ladder_on"]:
+            out["twin_gold_p99_normalized"] = round(
+                out["twin_gold_p99_ms_ladder_on"] / 1e3 * pr, 4)
+    return out
+
+
 def bench_dpop_sharded_subprocess(args):
     """Sharded exact DPOP on a virtual 8-device CPU mesh, in a
     subprocess so the forced-CPU platform doesn't poison this process's
@@ -2363,6 +2607,27 @@ def regression_check(value: float, extra: dict, here: str,
 
 # --------------------------------------------------------------------------
 
+def _maybe_snapshot(args, out):
+    """Write the run's JSON as a BENCH_r<N>.json snapshot record
+    (ISSUE 12 satellite: the machine-readable perf record resumes past
+    r05).  Shape mirrors the earlier driver-captured snapshots:
+    ``{"n": <round>, "cmd": ..., "rc": 0, "parsed": <the JSON>}``."""
+    import re
+
+    if not getattr(args, "snapshot", None):
+        return
+    m = re.search(r"r(\d+)", os.path.basename(args.snapshot))
+    rec = {
+        "n": int(m.group(1)) if m else 0,
+        "cmd": "python " + " ".join(sys.argv),
+        "rc": 0,
+        "parsed": out,
+    }
+    with open(args.snapshot, "w", encoding="utf-8") as f:
+        json.dump(rec, f)
+        f.write("\n")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--vars", type=int, default=10_000)
@@ -2443,6 +2708,63 @@ def main():
         help="lanes per service bucket in the serve bench",
     )
     ap.add_argument(
+        "--twin-jobs", type=int, default=24,
+        help="tenant jobs in the twin scenario's traffic stream",
+    )
+    ap.add_argument(
+        "--twin-seed", type=int, default=17,
+        help="seeds the twin's traffic, tiers, chaos and churn",
+    )
+    ap.add_argument(
+        "--twin-replicas", type=int, default=2,
+        help="fleet replicas under the twin scenario",
+    )
+    ap.add_argument(
+        "--twin-live-vars", type=int, default=400,
+        help="live tracking problem size (rounded to a square grid); "
+        "scale up toward stretch2 with this flag — per-step mutation "
+        "batches grow with the grid, so the tier deadlines need "
+        "retuning past ~2.5k (BENCHREF.md 'City twin')",
+    )
+    ap.add_argument(
+        "--twin-mutations", type=int, default=8,
+        help="target-walk churn mutations against the live problem",
+    )
+    ap.add_argument(
+        "--twin-kill-tick", type=int, default=14,
+        help="supervisor tick of the injected kill_replica (mid-trace "
+        "— clear of the bronze-light prefix the early gold rides)",
+    )
+    ap.add_argument("--twin-gold-deadline", type=float, default=2.3)
+    ap.add_argument("--twin-silver-deadline", type=float, default=0.8)
+    ap.add_argument("--twin-bronze-deadline", type=float, default=60.0)
+    ap.add_argument(
+        "--twin-interarrival", type=float, default=1.5,
+        help="mean Poisson inter-arrival of twin traffic, in ticks",
+    )
+    ap.add_argument(
+        "--twin-lanes", type=int, default=2,
+        help="lanes per twin service bucket (small: lanes are the "
+        "contended resource the ladder reallocates)",
+    )
+    ap.add_argument(
+        "--twin-max-buckets", type=int, default=0,
+        help="per-replica open-bucket bound under the twin (0 = "
+        "unbounded: saturation is compute contention, every active "
+        "bucket dilutes every tick)",
+    )
+    ap.add_argument(
+        "--twin-bronze-vars", type=int, default=20_000,
+        help="bronze-tier coloring instance size (the compute-"
+        "contention driver the ladder sheds; bronze runs dsa p=1.0 "
+        "to the cycle cap, so unshed bronze accumulates)",
+    )
+    ap.add_argument(
+        "--snapshot", default=None,
+        help="also write the run's JSON as a BENCH_r<N>.json snapshot "
+        "record ({n, cmd, rc, parsed}) to this path",
+    )
+    ap.add_argument(
         "--stretch", action="store_true",
         help="compat: run ONLY the 100k stretch instance as primary",
     )
@@ -2456,7 +2778,7 @@ def main():
                  "local", "scalefree", "mixed", "sharded",
                  "sharded-inner", "dpop-sharded", "dpop-sharded-inner",
                  "probe", "batch", "harness", "serve", "fleet", "churn",
-                 "auto"],
+                 "auto", "twin", "r06"],
         default="all",
     )
     # watchdog covers the FULL run: the wholesweep DPOP kernel compile
@@ -2467,6 +2789,56 @@ def main():
     args = ap.parse_args()
     if args.cycles is None:
         args.cycles = 50 if args.stretch else 2000
+
+    if args.only == "r06":
+        # consolidated r06 record (ISSUE 12 satellite): the serve /
+        # churn / dpop-sharded / auto / fleet / twin legs, EACH in a
+        # fresh subprocess — a single process would distort the
+        # wall-sensitive legs (e.g. the auto sweep turns on the
+        # persistent XLA cache, which makes the churn leg's cold
+        # baseline artificially warm; the twin's deadline attainment
+        # inherits whatever allocator/cache state earlier legs left).
+        # Subprocess-per-leg preserves each leg's standalone
+        # semantics, which is how every historical number was
+        # measured.
+        legs = ("serve", "churn", "dpop-sharded", "auto", "fleet",
+                "twin")
+        fwd = []
+        skip_next = False
+        for a in sys.argv[1:]:
+            if skip_next:
+                skip_next = False
+                continue
+            if a in ("--only", "--snapshot"):
+                skip_next = True
+                continue
+            if a.startswith(("--only=", "--snapshot=")):
+                continue
+            fwd.append(a)
+        extra = {}
+        for leg in legs:
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--only", leg] + fwd
+            try:
+                r = subprocess.run(
+                    cmd, capture_output=True, text=True, timeout=3000,
+                )
+                parsed = json.loads(
+                    r.stdout.strip().splitlines()[-1]
+                )
+                extra.update(parsed.get("extra", {}))
+            except Exception as e:
+                extra[f"{leg}_error"] = repr(e)[:500]
+        out = {
+            "metric": "r06_consolidated",
+            "value": extra.get("twin_gold_attainment_ladder_on", 0.0),
+            "unit": "gold attainment (ladder on)",
+            "vs_baseline": 0.0,
+            "extra": extra,
+        }
+        _maybe_snapshot(args, out)
+        print(json.dumps(out), flush=True)
+        return
 
     if args.only == "sharded-inner":
         bench_sharded_inner(args)
@@ -2553,7 +2925,7 @@ def main():
     # measurement so both see the same tunnel state
     probe = None
     if args.only in ("all", "maxsum", "probe", "batch", "harness",
-                     "serve", "fleet", "churn"):
+                     "serve", "fleet", "churn", "twin"):
         try:
             probe = make_drift_probe(repeat=args.repeat)
         except Exception as e:
@@ -2694,6 +3066,16 @@ def main():
         except Exception as e:
             extra["churn_error"] = repr(e)
 
+    if args.only in ("all", "twin"):
+        # city-scale digital twin (ISSUE 12): the combined sustained
+        # scenario (traffic tiers + churn + chaos + --auto) scored by
+        # SLO attainment, ladder ON vs OFF on the same seeds
+        # (BENCHREF.md "City twin")
+        try:
+            extra.update(bench_twin(args, probe=probe))
+        except Exception as e:
+            extra["twin_error"] = repr(e)
+
     def run_with_transient_retry(fn, err_key):
         # the tunneled remote-compile service occasionally drops a
         # response mid-read; one retry keeps such a transient from
@@ -2786,13 +3168,16 @@ def main():
     if args.only in ("dpop", "local", "convergence", "convergence2",
                      "scalefree", "mixed", "sharded", "dpop-sharded",
                      "probe", "batch", "harness", "serve", "churn",
-                     "auto") \
+                     "auto", "twin") \
             and not value:
         # single-part run: promote the part's headline measurement (not
         # config constants like stretch_vars) to the primary slot
         headline = ("_per_sec", "_wall_s", "_cycles_per", "probe_rate",
                     "batch_throughput", "serve_throughput",
-                    "churn_speedup", "auto_speedup")
+                    "churn_speedup", "auto_speedup",
+                    "twin_gold_attainment_ladder_on")
+        if args.only == "twin":
+            headline = ("twin_gold_attainment_ladder_on",) + headline
         k = next(
             (k for k in extra if any(h in k for h in headline)),
             next((k for k in extra if not k.endswith("_error")), None),
@@ -2801,6 +3186,7 @@ def main():
                "unit": "", "vs_baseline": 0.0, "extra": extra}
         if watchdog:
             watchdog.cancel()
+        _maybe_snapshot(args, out)
         print(json.dumps(out), flush=True)
         return
 
@@ -2850,13 +3236,15 @@ def main():
 
     if watchdog:
         watchdog.cancel()
-    print(json.dumps({
+    out = {
         "metric": metric,
         "value": round(value, 2),
         "unit": "iters/s",
         "vs_baseline": round(vs, 2),
         "extra": extra,
-    }), flush=True)
+    }
+    _maybe_snapshot(args, out)
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

@@ -56,11 +56,12 @@ def make_parser() -> argparse.ArgumentParser:
         run,
         serve,
         solve,
+        twin,
     )
 
     for module in (solve, run, orchestrator, agent, distribute, graph,
                    generate, batch, replica_dist, consolidate, serve,
-                   portfolio):
+                   portfolio, twin):
         module.set_parser(subparsers)
     return parser
 

@@ -115,6 +115,43 @@ def set_parser(subparsers):
     p.add_argument("--colors_count", type=int, default=3)
     p.add_argument("--seed", type=int, default=0)
 
+    # hard-constraint-dense routing/scheduling (ISSUE 12): BIG hard
+    # mutual-exclusion tables on overlapping resource windows — the
+    # CEC-pruning / genuine-infeasibility family (docs/scenarios.rst)
+    p = gen_sub.add_parser("routing")
+    p.set_defaults(func=_routing)
+    p.add_argument("--tasks_count", "-V", type=int, required=True)
+    p.add_argument("--slots_count", type=int, default=4)
+    p.add_argument("--tasks_per_resource", type=int, default=3)
+    p.add_argument("--p_soft", type=float, default=0.15,
+                   help="fraction of tasks given an extra soft "
+                   "cross-resource affinity pair")
+    p.add_argument("--infeasible", action="store_true",
+                   help="over-constrain the first resource window so "
+                   "the instance is pigeonhole-infeasible (optimum "
+                   ">= the hard cost)")
+    p.add_argument("--agents_count", type=int, default=None)
+    p.add_argument("--capacity", type=float, default=100)
+    p.add_argument("--seed", type=int, default=0)
+
+    # moving-target tracking (ISSUE 12): the classic dynamic-DCOP
+    # benchmark; --steps also emits the target walk's change_factor
+    # scenario next to the DCOP (docs/scenarios.rst)
+    p = gen_sub.add_parser("tracking")
+    p.set_defaults(func=_tracking)
+    p.add_argument("--sensors_count", "-V", type=int, required=True,
+                   help="sensor count (must be a square: the grid)")
+    p.add_argument("--targets_count", type=int, default=3)
+    p.add_argument("--radius", type=float, default=2.5)
+    p.add_argument("--weight", type=float, default=10.0)
+    p.add_argument("--steps", type=int, default=0,
+                   help="emit the n-step target-walk churn scenario "
+                   "alongside the DCOP (to <output>_scenario<ext>, or "
+                   "as an extra YAML document on stdout)")
+    p.add_argument("--agents_count", type=int, default=None)
+    p.add_argument("--capacity", type=float, default=100)
+    p.add_argument("--seed", type=int, default=0)
+
     p = gen_sub.add_parser("agents")
     p.set_defaults(func=_agents)
     p.add_argument("--count", type=int, required=True)
@@ -319,6 +356,53 @@ def _smallworld(args):
         seed=args.seed,
     )
     return _write(args, dcop_yaml(dcop))
+
+
+def _routing(args):
+    from pydcop_tpu.dcop import dcop_yaml
+    from pydcop_tpu.generators import generate_routing
+
+    dcop = generate_routing(
+        n_tasks=args.tasks_count,
+        n_slots=args.slots_count,
+        tasks_per_resource=args.tasks_per_resource,
+        p_soft=args.p_soft,
+        infeasible=args.infeasible,
+        n_agents=args.agents_count,
+        capacity=args.capacity,
+        seed=args.seed,
+    )
+    return _write(args, dcop_yaml(dcop))
+
+
+def _tracking(args):
+    from pydcop_tpu.dcop import dcop_yaml
+    from pydcop_tpu.generators import generate_tracking, tracking_scenario
+
+    dcop = generate_tracking(
+        n_sensors=args.sensors_count,
+        n_targets=args.targets_count,
+        radius=args.radius,
+        weight=args.weight,
+        n_agents=args.agents_count,
+        capacity=args.capacity,
+        seed=args.seed,
+    )
+    rc = _write(args, dcop_yaml(dcop))
+    if args.steps:
+        from pydcop_tpu.dcop import yaml_scenario
+
+        text = yaml_scenario(tracking_scenario(dcop, args.steps))
+        if args.output:
+            import os as _os
+
+            path, ext = _os.path.splitext(args.output)
+            with open(f"{path}_scenario{ext}", "w",
+                      encoding="utf-8") as f:
+                f.write(text)
+        else:
+            sys.stdout.write("---\n" + text)
+    return rc
 
 
 def _agents(args):

@@ -1,0 +1,163 @@
+"""`pydcop_tpu twin` — the city-scale digital-twin scenario
+(docs/scenarios.rst).
+
+Runs the combined sustained scenario — seeded Poisson multi-tenant
+traffic with gold/silver/bronze deadline tiers through a replicated
+solve fleet, concurrent warm-repair churn against a live problem, a
+combined chaos plan (fleet + serve + churn fault kinds), optional
+``--auto`` portfolio selection — and prints the SLO scorecard as ONE
+JSON object: per-tier deadline attainment and p99, shed rate,
+time-to-recover-cost per mutation, RTO per injected kill, and the
+degradation ladder's rung audit.
+
+The run is tick-driven and fully seeded: the same flags replay the
+same scenario.  ``--no-ladder`` keeps the identical scenario but never
+escalates the guardrail ladder — the honest A/B arm
+(``make bench-twin`` runs both and pins that the ladder is what holds
+the gold floor).  ``--no-chaos`` / ``--no-churn`` switch pressures off
+individually.
+
+Exit status is 0 when every submitted job reached a terminal state and
+(when chaos injected a kill) every recovery completed with a finite
+RTO.
+"""
+from __future__ import annotations
+
+import sys
+
+from pydcop_tpu.commands._utils import output_metrics
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "twin", help="city-scale digital-twin SLO scenario"
+    )
+    parser.set_defaults(func=run_cmd)
+    parser.add_argument("--jobs", type=int, default=12,
+                        help="tenant jobs in the traffic stream")
+    parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument("--lanes", type=int, default=4,
+                        help="lane (slot) count per service bucket")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="seeds traffic, tiers, chaos and churn")
+    parser.add_argument("-a", "--algo", default="mgm",
+                        help="traffic algorithm (mgm keeps results "
+                        "chunk-independent, the bit-identity anchor)")
+    parser.add_argument("--auto", action="store_true",
+                        help="pick each instance's config through the "
+                        "portfolio selector (heuristic fallback when "
+                        "no model is trained); chosen configs land in "
+                        "the scorecard")
+    parser.add_argument("--max-cycles", type=int, default=200)
+    parser.add_argument("--gold-deadline", type=float, default=30.0)
+    parser.add_argument("--silver-deadline", type=float, default=10.0)
+    parser.add_argument("--bronze-deadline", type=float, default=20.0)
+    parser.add_argument("--mutations", type=int, default=10,
+                        help="live-problem churn events (tracking "
+                        "target-walk steps + jitter edits)")
+    parser.add_argument("--live-vars", type=int, default=100,
+                        help="live problem size (a square sensor-grid "
+                        "count for the tracking twin)")
+    parser.add_argument("--kill-tick", type=int, default=8,
+                        help="supervisor tick of the injected "
+                        "kill_replica (chaos plan)")
+    parser.add_argument("--fault-plan", default=None,
+                        help="explicit chaos plan YAML (default: the "
+                        "built-in combined plan; validated against "
+                        "the fault-kind catalog)")
+    parser.add_argument("--no-chaos", action="store_true")
+    parser.add_argument("--no-churn", action="store_true")
+    parser.add_argument("--no-ladder", action="store_true",
+                        help="score the identical scenario with the "
+                        "guardrail ladder disabled (the A/B arm)")
+    parser.add_argument("--max-ticks", type=int, default=5000)
+    parser.add_argument("--journal-dir", default=None)
+    parser.add_argument("--uiport", type=int, default=None,
+                        help="serve the GUI websocket + SSE with "
+                        "slo.*/fleet.*/serve.* events forwarded")
+    return parser
+
+
+def run_cmd(args):
+    from pydcop_tpu.generators import (
+        generate_tracking,
+        tracking_scenario,
+    )
+    from pydcop_tpu.scenario import (
+        TwinRunner,
+        build_twin_traffic,
+        default_chaos_plan,
+        default_tiers,
+    )
+
+    ui = None
+    if args.uiport:
+        from pydcop_tpu.runtime.events import event_bus
+        from pydcop_tpu.runtime.ui import UiServer
+
+        event_bus.enabled = True
+        ui = UiServer(port=args.uiport)
+        ui.start()
+
+    tiers = default_tiers(
+        gold_deadline=args.gold_deadline,
+        silver_deadline=args.silver_deadline,
+        bronze_deadline=args.bronze_deadline,
+    )
+    jobs = build_twin_traffic(
+        args.jobs, tiers, seed=args.seed, algo=args.algo,
+        auto=args.auto,
+    )
+
+    fault_plan = None
+    if not args.no_chaos:
+        if args.fault_plan:
+            from pydcop_tpu.runtime.faults import FaultPlan
+
+            try:
+                fault_plan = FaultPlan.from_yaml(args.fault_plan)
+            except (OSError, ValueError) as e:
+                output_metrics(
+                    {"status": "ERROR",
+                     "error": f"bad fault plan: {e}"},
+                    args.output,
+                )
+                return 1
+        else:
+            fault_plan = default_chaos_plan(
+                seed=args.seed, kill_tick=args.kill_tick,
+            )
+
+    live = scenario = None
+    if not args.no_churn and args.mutations > 0:
+        side = max(2, int(round(args.live_vars ** 0.5)))
+        live = generate_tracking(side * side, n_targets=2,
+                                 seed=args.seed + 1)
+        scenario = tracking_scenario(live, args.mutations)
+
+    twin = TwinRunner(
+        jobs, tiers,
+        replicas=args.replicas, lanes=args.lanes,
+        max_cycles=args.max_cycles, fault_plan=fault_plan,
+        journal_dir=args.journal_dir, live_dcop=live,
+        live_scenario=scenario, ladder=not args.no_ladder,
+    )
+    try:
+        card = twin.run(max_ticks=args.max_ticks)
+    finally:
+        if ui is not None:
+            ui.stop()
+
+    all_scored = all(j.scored for j in twin.jobs)
+    kills = card["fleet"]["replicas_down"]
+    recovered = kills == 0 or (
+        card["rto_max_s"] is not None or card["fleet"]["jobs_reseated"] == 0
+    )
+    ok = all_scored and recovered
+    card["status"] = "FINISHED" if ok else "ERROR"
+    output_metrics(card, args.output)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(run_cmd(None))

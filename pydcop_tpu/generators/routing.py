@@ -1,0 +1,186 @@
+"""Hard-constraint-dense routing/scheduling DCOP generator (ISSUE 12).
+
+Every pre-existing family is soft-cost dominated: graph coloring's
+hard variant uses a 10^4 penalty that the exact engines treat as just
+another finite cost, so nothing in the generator catalog ever
+exercises the cross-edge-consistency pruning wire (ops/dpop_shard,
+arXiv:1909.06537) or produces *genuinely infeasible* instances.  This
+family does both:
+
+* **tasks on shared resources** — variable ``t<i>`` picks a time slot;
+  tasks sharing a resource are pairwise mutually exclusive through a
+  ``BIG``-valued hard table (the exact engines' infeasibility
+  sentinel, ``ops.dpop_sweep.BIG`` — NOT the soft 10^4 convention), so
+  the static feasibility sweep classifies the conflicting entries
+  infeasible and prunes them off the UTIL wire;
+* **per-task release windows** — task *i* is barred (hard) from one
+  rotating slot, so a resource clique is an all-different system on
+  tight windows: a separator context whose neighbors exhaust a deep
+  task's window leaves it NO feasible slot, and the whole context row
+  prunes off the wire — pairwise difference alone never does this
+  (with any slot slack a child always has a completion), the windows
+  are what make CEC pruning fire on *feasible* instances;
+* **overlapping resource windows** — consecutive resources share one
+  task, so the constraint graph is a chain of cliques: the pseudotree
+  gets real separators AND back edges, which is exactly the shape CEC
+  pruning eats (a back-edge conflict makes whole separator rows
+  infeasible);
+* **genuine infeasibility** — ``infeasible=True`` additionally bars
+  the first resource's tasks from the late slots, leaving k tasks only
+  k-1 allowed slots: by pigeonhole *no* assignment avoids a hard
+  violation and the exact optimum lands ``>= BIG``
+  (:func:`is_infeasible_cost` classifies it), while the local-search
+  engines still run and report the least-violating assignment;
+* **soft scheduling preferences** — seeded per-pair earliness/affinity
+  costs keep the feasible region non-trivial for the iterative
+  engines, well below ``BIG/4`` so the pruning preconditions
+  (``ops.dpop_shard.prune_preconditions``) hold by construction.
+
+All randomness flows from ``np.random.default_rng(seed)`` — same
+(args, seed), byte-identical YAML (pinned in
+tests/unit/test_generators_determinism.py).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+#: hard-violation sentinel — MUST equal ops.dpop_sweep.BIG (the exact
+#: engines' +inf stand-in; pinned by tests/unit/test_twin.py), kept as
+#: a literal so importing the generator does not pull in jax
+HARD_COST = 1e9
+
+
+def is_infeasible_cost(cost: Optional[float]) -> bool:
+    """True when a solution cost implies at least one hard violation —
+    the ``>= BIG/4`` classification the CEC feasibility sweep uses
+    (``ops.dpop_shard.FEAS_THRESHOLD``)."""
+    return cost is not None and cost >= HARD_COST / 4.0
+
+
+def generate_routing(
+    n_tasks: int,
+    n_slots: int = 4,
+    tasks_per_resource: Optional[int] = None,
+    p_soft: float = 0.15,
+    soft_scale: float = 9.0,
+    infeasible: bool = False,
+    n_agents: Optional[int] = None,
+    capacity: float = 100,
+    seed: int = 0,
+) -> DCOP:
+    """Build a routing/scheduling DCOP: ``n_tasks`` tasks each pick one
+    of ``n_slots`` time slots; resources are sliding windows of
+    ``tasks_per_resource`` consecutive tasks (default: ``n_slots``,
+    the tight all-different system; overlapping by one, so the clique
+    chain is connected), and tasks on a common resource may not share
+    a slot (hard, ``HARD_COST``).  Task ``i``'s *release window*
+    additionally bars it (hard) from slot ``i % n_slots`` — rotating
+    exclusions, so every clique of consecutive tasks stays feasible by
+    construction (distinct rotations satisfy Hall's condition) while
+    deep separator contexts that exhaust a task's window prune off the
+    CEC wire.  Soft costs: a seeded earliness preference plus
+    ``p_soft`` random cross-resource affinity pairs.
+
+    ``infeasible=True`` over-constrains the FIRST resource: its tasks
+    are all barred (hard) from the same late slots until only
+    ``tasks_per_resource - 1`` slots remain — pigeonhole-infeasible by
+    construction (every assignment carries >= 1 hard violation; exact
+    solvers report ``violation >= 1`` and a raw solution cost
+    ``>= HARD_COST``, see :func:`is_infeasible_cost`)."""
+    D = int(n_slots)
+    k = int(tasks_per_resource) if tasks_per_resource else D
+    if k < 2 or D < 2:
+        raise ValueError("need tasks_per_resource >= 2 and n_slots >= 2")
+    if n_tasks < k:
+        raise ValueError(
+            f"n_tasks={n_tasks} below tasks_per_resource={k}"
+        )
+    if k > D:
+        raise ValueError(
+            f"tasks_per_resource={k} > n_slots={D}: every resource "
+            f"window would be pigeonhole-infeasible; use "
+            f"infeasible=True for a controlled infeasible instance"
+        )
+    rng = np.random.default_rng(seed)
+    dcop = DCOP(f"routing_{n_tasks}", "min")
+    domain = Domain("slots", "slot", list(range(D)))
+    tasks = [Variable(f"t{i:04d}", domain) for i in range(n_tasks)]
+    for t in tasks:
+        dcop.add_variable(t)
+
+    # resources: sliding windows with one-task overlap → clique chain
+    resources = []
+    start = 0
+    while start < n_tasks - 1:
+        resources.append(list(range(start, min(start + k, n_tasks))))
+        start += k - 1
+
+    # per-task earliness preference (a scheduling cost, folded into the
+    # pairwise tables so every constraint stays binary)
+    pref = rng.uniform(0.0, 1.0, size=(n_tasks, D)).astype(np.float64)
+    pref += np.arange(D, dtype=np.float64) * 0.25  # earlier is cheaper
+
+    def barred_slots(i: int, overconstrained: bool) -> np.ndarray:
+        """Boolean mask of task i's hard-barred slots."""
+        out = np.zeros(D, bool)
+        if overconstrained:
+            out[k - 1:] = True  # k tasks on the same k-1 early slots
+        else:
+            out[i % D] = True  # rotating release window (D-1 allowed)
+        return out
+
+    def exclusion_table(i: int, j: int,
+                        overconstrained: bool) -> np.ndarray:
+        m = np.zeros((D, D), np.float64)
+        m += pref[i][:, None] + pref[j][None, :]
+        m[np.eye(D, dtype=bool)] = HARD_COST  # same slot: hard clash
+        m[barred_slots(i, overconstrained), :] = HARD_COST
+        m[:, barred_slots(j, overconstrained)] = HARD_COST
+        return m
+
+    n_con = 0
+    seen = set()
+    for r, members in enumerate(resources):
+        over = bool(infeasible and r == 0)
+        for a in range(len(members)):
+            for b in range(a + 1, len(members)):
+                i, j = members[a], members[b]
+                if (i, j) in seen:
+                    continue
+                seen.add((i, j))
+                dcop.add_constraint(NAryMatrixRelation(
+                    [tasks[i], tasks[j]],
+                    exclusion_table(i, j, over),
+                    name=f"x{n_con:05d}",
+                ))
+                n_con += 1
+
+    # soft cross-resource affinity pairs (pure preference, no hard
+    # entries — keeps the iterative engines' landscape interesting)
+    n_soft = int(p_soft * n_tasks)
+    for _ in range(n_soft):
+        i, j = int(rng.integers(n_tasks)), int(rng.integers(n_tasks))
+        if i == j:
+            continue
+        i, j = min(i, j), max(i, j)
+        if (i, j) in seen:
+            continue
+        seen.add((i, j))
+        m = rng.uniform(0.0, soft_scale, size=(D, D)).astype(np.float64)
+        dcop.add_constraint(NAryMatrixRelation(
+            [tasks[i], tasks[j]], m, name=f"s{n_con:05d}",
+        ))
+        n_con += 1
+
+    n_agents = n_agents if n_agents is not None else n_tasks
+    dcop.add_agents(
+        [AgentDef(f"a{i:04d}", capacity=capacity)
+         for i in range(n_agents)]
+    )
+    return dcop

@@ -1,0 +1,225 @@
+"""Moving-target tracking DCOP generator (ISSUE 12) — the classic
+dynamic-DCOP benchmark, and a *natural* churn stream for warm repair.
+
+Sensors sit on a fixed √n × √n grid; each sensor variable picks which
+target to track (or idles).  Grid-adjacent sensors coordinate through
+a pairwise table combining
+
+* **coverage gain** — tracking target *t* is worth
+  ``w / (1 + dist(sensor, target)^2)`` (negated: the DCOP minimizes),
+  cut to exactly 0 beyond ``radius`` so far-away targets contribute
+  nothing, and
+* **redundancy penalty** — both neighbors locking the same target
+  forfeits half the pair's gain.
+
+Targets move on a seeded random walk
+(:func:`target_positions` — a pure function of ``(seed, step)``, so
+any step is reproducible without replaying the walk).  One motion step
+changes ONLY the tables of constraints within ``radius`` of a moved
+target's old or new position (:func:`step_mutations`); the cutoff
+makes that locality exact, not approximate.  Each step is therefore a
+small batch of same-shape ``change_factor`` edits — precisely the
+fixed-shape mutation the warm-repair layer applies with ZERO retraces
+(ops/headroom ``EditFactor``; pinned in tests/unit/test_twin.py).
+
+:func:`tracking_scenario` packages the walk as a
+:class:`~pydcop_tpu.dcop.scenario.Scenario` of ``change_factor``
+events whose actions carry ``(constraint, step, seed,
+family="tracking")`` — expression-less, resolved at apply time by
+:func:`moved_constraint` (the twin runner's churn applier does this;
+pydcop_tpu/scenario/twin.py).
+
+All randomness flows from ``np.random.default_rng(seed)``; same
+(args, seed) → byte-identical YAML (tests/unit/
+test_generators_determinism.py).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.dcop.scenario import DcopEvent, EventAction, Scenario
+
+
+def _side(n_sensors: int) -> int:
+    side = int(np.sqrt(n_sensors))
+    if side * side != n_sensors:
+        raise ValueError(
+            f"n_sensors must be a square grid count (got {n_sensors})"
+        )
+    return side
+
+
+def sensor_coords(name: str) -> Tuple[int, int]:
+    """Grid coordinates of sensor ``s<r>_<c>`` (encoded in the name so
+    a mutation resolver needs no side table)."""
+    r, c = name[1:].split("_")
+    return int(r), int(c)
+
+
+def target_positions(n_targets: int, step: int, seed: int,
+                     side: int) -> np.ndarray:
+    """``[n_targets, 2]`` float positions after ``step`` random-walk
+    moves — a pure function of ``(n_targets, step, seed, side)``: the
+    walk is replayed from its seeded start, so any step is
+    reproducible in isolation (the twin's crash-replay contract)."""
+    rng = np.random.default_rng(seed)
+    pos = rng.uniform(0, side - 1, size=(n_targets, 2))
+    for _ in range(int(step)):
+        pos = pos + rng.uniform(-0.75, 0.75, size=pos.shape)
+        pos = np.clip(pos, 0.0, side - 1)
+    return pos
+
+
+def _gain(coord: Tuple[int, int], pos: np.ndarray, weight: float,
+          radius: float) -> np.ndarray:
+    """Per-target coverage gain of one sensor, exact-zero beyond
+    ``radius`` (the locality that keeps per-step mutations small)."""
+    d2 = ((np.asarray(coord, np.float64) - pos) ** 2).sum(axis=1)
+    g = weight / (1.0 + d2)
+    g[d2 > radius * radius] = 0.0
+    return g
+
+
+def _pair_table(ci: Tuple[int, int], cj: Tuple[int, int],
+                pos: np.ndarray, weight: float,
+                radius: float) -> np.ndarray:
+    """The (n_targets+1)² cost table of one sensor pair: negated
+    shared coverage gain (value 0 = idle), redundancy-penalized when
+    both lock the same target."""
+    n_t = pos.shape[0]
+    gi = np.concatenate([[0.0], _gain(ci, pos, weight, radius)])
+    gj = np.concatenate([[0.0], _gain(cj, pos, weight, radius)])
+    m = -(gi[:, None] + gj[None, :]) / 2.0
+    same = np.eye(n_t + 1, dtype=bool)
+    same[0, 0] = False  # both idle is not redundancy
+    m[same] *= 0.5  # duplicated lock forfeits half the pair's gain
+    return m
+
+
+def generate_tracking(
+    n_sensors: int,
+    n_targets: int = 3,
+    weight: float = 10.0,
+    radius: float = 2.5,
+    n_agents: Optional[int] = None,
+    capacity: float = 100,
+    seed: int = 0,
+) -> DCOP:
+    """Build the step-0 tracking DCOP: √n × √n sensor grid, domain
+    ``{0 (idle), 1..n_targets}``, one pairwise table per grid-adjacent
+    sensor pair from the targets' seeded start positions."""
+    side = _side(n_sensors)
+    pos = target_positions(n_targets, 0, seed, side)
+    dcop = DCOP(f"tracking_{n_sensors}", "min")
+    domain = Domain("track", "target", list(range(n_targets + 1)))
+    sensors: Dict[Tuple[int, int], Variable] = {}
+    for r in range(side):
+        for c in range(side):
+            v = Variable(f"s{r:03d}_{c:03d}", domain)
+            sensors[(r, c)] = v
+            dcop.add_variable(v)
+    n_con = 0
+    for r in range(side):
+        for c in range(side):
+            for dr, dc in ((0, 1), (1, 0)):
+                rr, cc = r + dr, c + dc
+                if rr >= side or cc >= side:
+                    continue
+                m = _pair_table((r, c), (rr, cc), pos, weight, radius)
+                dcop.add_constraint(NAryMatrixRelation(
+                    [sensors[(r, c)], sensors[(rr, cc)]], m,
+                    name=f"k{n_con:05d}",
+                ))
+                n_con += 1
+    n_agents = n_agents if n_agents is not None else n_sensors
+    dcop.add_agents(
+        [AgentDef(f"a{i:04d}", capacity=capacity)
+         for i in range(n_agents)]
+    )
+    # walk parameters ride the dcop so mutation resolvers are
+    # self-contained (moved_constraint below)
+    dcop.tracking_meta = {
+        "n_targets": int(n_targets), "seed": int(seed),
+        "side": side, "weight": float(weight), "radius": float(radius),
+    }
+    return dcop
+
+
+def _meta(dcop) -> Dict:
+    meta = getattr(dcop, "tracking_meta", None)
+    if meta is None:
+        raise ValueError(
+            "not a tracking DCOP (no tracking_meta); build it with "
+            "generate_tracking"
+        )
+    return meta
+
+
+def moved_constraint(dcop, name: str, step: int) -> NAryMatrixRelation:
+    """The constraint's table recomputed at the targets' ``step``
+    positions — same scope, same shape, so applying it warm is one
+    fixed-shape ``EditFactor`` buffer write (zero retraces)."""
+    meta = _meta(dcop)
+    c = dcop.constraints[name]
+    pos = target_positions(meta["n_targets"], step, meta["seed"],
+                           meta["side"])
+    ci, cj = (sensor_coords(v.name) for v in c.dimensions)
+    return NAryMatrixRelation(
+        list(c.dimensions),
+        _pair_table(ci, cj, pos, meta["weight"], meta["radius"]),
+        name=name,
+    )
+
+
+def step_mutations(dcop, step: int) -> List[str]:
+    """Names of the constraints whose tables CHANGE when the targets
+    move from ``step - 1`` to ``step`` — only pairs within ``radius``
+    of a moved target's old or new position (exact, thanks to the
+    gain cutoff)."""
+    meta = _meta(dcop)
+    prev = target_positions(meta["n_targets"], step - 1, meta["seed"],
+                            meta["side"])
+    cur = target_positions(meta["n_targets"], step, meta["seed"],
+                           meta["side"])
+    pos = np.concatenate([prev, cur], axis=0)
+    rad = meta["radius"]
+    out = []
+    for name in sorted(dcop.constraints):
+        c = dcop.constraints[name]
+        near = False
+        for v in c.dimensions:
+            d2 = ((np.asarray(sensor_coords(v.name), np.float64)
+                   - pos) ** 2).sum(axis=1)
+            if bool((d2 <= rad * rad).any()):
+                near = True
+                break
+        if near:
+            out.append(name)
+    return out
+
+
+def tracking_scenario(dcop, n_steps: int, delay: float = 0.2
+                      ) -> Scenario:
+    """The target walk as a scenario: one event per motion step whose
+    actions are ``change_factor(constraint, step, seed,
+    family="tracking")`` — resolved at apply time by
+    :func:`moved_constraint`, so the stream is replayable from the
+    YAML-able event list alone."""
+    meta = _meta(dcop)
+    events: List[DcopEvent] = []
+    for s in range(1, int(n_steps) + 1):
+        events.append(DcopEvent(f"track_d{s}", delay=delay))
+        actions = [
+            EventAction("change_factor", constraint=name, step=s,
+                        seed=meta["seed"], family="tracking")
+            for name in step_mutations(dcop, s)
+        ]
+        if actions:
+            events.append(DcopEvent(f"track_e{s}", actions=actions))
+    events.append(DcopEvent("track_final", delay=delay))
+    return Scenario(events)

@@ -200,6 +200,31 @@ def send_portfolio(event: str, payload) -> None:
     event_bus.send(PORTFOLIO_TOPIC_PREFIX + event, payload)
 
 
+#: SLO guardrail topic prefix (pydcop_tpu.scenario — the city-twin
+#: runner's degradation ladder).  Topics:
+#: ``slo.tier.breach`` (tier, attainment, floor — a tier's rolling
+#: deadline attainment fell under its floor),
+#: ``slo.ladder.escalated`` (rung, rung_name, tiers — one
+#: deterministic step up: shed bronze → clamp silver chunks → force
+#: gold onto the emptiest healthy replica),
+#: ``slo.ladder.released`` (rung, rung_name — one hysteresis step
+#: down after `hold` clean evaluations),
+#: ``slo.shed.bronze`` (tier, jid-label — a rung-1 admission refused
+#: at the twin's front door), ``slo.clamp.silver`` (pressure — rung 2
+#: engaged deadline pressure on the fleet), ``slo.reroute.gold``
+#: (label — a rung-3 emptiest-healthy placement) and
+#: ``slo.scorecard`` (the final per-tier attainment/latency summary)
+#: — subscribe with ``slo.*`` (the UI server pushes them to ws/SSE
+#: clients alongside ``serve.*``/``fleet.*``).
+SLO_TOPIC_PREFIX = "slo."
+
+
+def send_slo(event: str, payload) -> None:
+    """Publish an SLO guardrail-ladder event on the global bus (no-op
+    unless observability is enabled)."""
+    event_bus.send(SLO_TOPIC_PREFIX + event, payload)
+
+
 #: solve-harness topic prefix (algorithms/base).  Topics:
 #: ``harness.run.done`` (algo, status, cycle + the HarnessCounters
 #: scorecard: host_sync_count, dispatch_wait_s, donated_chunks,

@@ -80,8 +80,39 @@ CHURN_KINDS = ("remove_agent_burst", "add_agent_burst", "edit_factor")
 #: its in-flight jobs keep running
 FLEET_KINDS = ("kill_replica", "stall_replica", "partition_replica")
 
-KINDS = ("kill_rank", "stall_rank", "kill_agent", "corrupt_checkpoint",
-         "truncate_checkpoint") + SERVE_KINDS + CHURN_KINDS + FLEET_KINDS
+#: runtime-layer (rank/agent/checkpoint) fault kinds — the original
+#: PR 1 set, consumed by RankFaultInjector and the coordinator watchdog
+RUNTIME_KINDS = ("kill_rank", "stall_rank", "kill_agent",
+                 "corrupt_checkpoint", "truncate_checkpoint")
+
+KINDS = RUNTIME_KINDS + SERVE_KINDS + CHURN_KINDS + FLEET_KINDS
+
+#: the one catalog of which OPTIONAL fields each kind may address —
+#: the machine-readable half of the fault-kind table in
+#: docs/resilience.rst ("Fault-kind catalog"): the docs test pins that
+#: every kind here is documented there and vice versa, and
+#: :meth:`FaultPlan.validate` rejects a fault addressing a field its
+#: kind never reads (the classic silent-no-op plan bug: a
+#: ``stall_tick`` with a ``rank``, a ``kill_replica`` with an
+#: ``agent``).  ``kind``/``cycle``/``attempt`` are legal on every
+#: fault and not listed.
+KIND_FIELDS: Dict[str, Tuple[str, ...]] = {
+    "kill_rank": ("rank",),
+    "stall_rank": ("rank", "duration"),
+    "kill_agent": ("agent",),
+    "corrupt_checkpoint": ("path",),
+    "truncate_checkpoint": ("path",),
+    "raise_in_step": ("jid",),
+    "nan_lane": ("jid",),
+    "torn_journal_write": ("jid",),
+    "stall_tick": ("duration",),
+    "edit_factor": ("constraint",),
+    "remove_agent_burst": ("count",),
+    "add_agent_burst": ("count",),
+    "kill_replica": ("replica",),
+    "stall_replica": ("replica", "duration"),
+    "partition_replica": ("replica", "duration"),
+}
 
 
 @dataclasses.dataclass
@@ -222,7 +253,39 @@ class FaultPlan:
         import yaml
 
         with open(path, encoding="utf-8") as f:
-            return cls.from_dict(yaml.safe_load(f))
+            plan = cls.from_dict(yaml.safe_load(f))
+        # a plan from disk is the chaos contract of a whole run: a
+        # misaddressed field (a stall_tick with a rank, a kill_replica
+        # with an agent) would silently never fire — fail loudly here
+        plan.validate()
+        return plan
+
+    def validate(self) -> List[str]:
+        """Check every fault only addresses fields its kind consumes
+        (:data:`KIND_FIELDS` — the catalog docs/resilience.rst's
+        fault-kind table documents) and return the sorted kinds the
+        plan uses.  ``__post_init__`` already enforces required
+        fields; this catches the opposite bug — a field the kind will
+        never read, i.e. a fault that cannot mean what its author
+        wrote."""
+        targeted = ("rank", "agent", "path", "jid", "count",
+                    "constraint", "replica")
+        for i, f in enumerate(self.faults):
+            allowed = KIND_FIELDS[f.kind]
+            extras = sorted(
+                name for name in targeted
+                if getattr(f, name) is not None and name not in allowed
+            )
+            if f.duration and "duration" not in allowed:
+                extras.append("duration")
+            if extras:
+                raise ValueError(
+                    f"fault #{i} ({f.kind}) addresses field(s) "
+                    f"{extras} that {f.kind!r} never consumes; it "
+                    f"accepts only {sorted(allowed)} (see the "
+                    f"fault-kind catalog in docs/resilience.rst)"
+                )
+        return sorted({f.kind for f in self.faults})
 
     def to_json(self) -> str:
         return json.dumps(

@@ -206,6 +206,11 @@ class ServeCounters:
     def __init__(self, replica: Optional[str] = None):
         self.replica = replica
         self.counts = {k: 0 for k in SERVE_COUNTERS}
+        #: per-tenant share of ``events_dropped`` (ISSUE 12 satellite):
+        #: the flat counter says the SERVICE lost stream events, this
+        #: says WHOSE — the twin's SLO scorecard charges a lossy gold
+        #: stream against gold attainment, which needs the attribution
+        self.events_dropped_by_tenant: dict = {}
 
     def inc(self, name: str, n: int = 1) -> None:
         if name not in self.counts:
@@ -215,9 +220,21 @@ class ServeCounters:
             )
         self.counts[name] += n
 
+    def drop_event(self, tenant: Optional[str], n: int = 1) -> None:
+        """Count one dropped stream event against its tenant (and the
+        flat ``events_dropped`` total)."""
+        self.inc("events_dropped", n)
+        t = tenant or "default"
+        self.events_dropped_by_tenant[t] = (
+            self.events_dropped_by_tenant.get(t, 0) + n
+        )
+
     def as_dict(self) -> dict:
         out = dict(self.counts)
         out["replica"] = self.replica
+        out["events_dropped_by_tenant"] = dict(
+            self.events_dropped_by_tenant
+        )
         return out
 
 
@@ -256,6 +273,45 @@ class FleetCounters:
             raise KeyError(
                 f"unknown fleet counter {name!r}; add it to "
                 f"FLEET_COUNTERS"
+            )
+        self.counts[name] += n
+
+    def as_dict(self) -> dict:
+        return dict(self.counts)
+
+
+#: counter names surfaced under the twin scenario's SLO scorecard
+#: (pydcop_tpu.scenario.slo.SloLadder / scenario.twin.TwinRunner) —
+#: the degradation ladder's rung audit plus the deadline-attainment
+#: tally, emitted as ``slo.*`` events and merged into the scorecard's
+#: ``ladder`` section (docs/scenarios.rst "The SLO guardrail ladder")
+SLO_COUNTERS = (
+    "jobs_scored",            # completions tallied into a tier window
+    "deadline_hits",          # FINISHED within the tier deadline
+    "deadline_misses",        # TIMEOUT / late / ERROR completions
+    "lossy_stream_misses",    # on-time jobs demoted to a miss because
+                              # their progress stream dropped events
+    "tier_breaches",          # rolling-attainment floor violations seen
+    "ladder_escalations",     # rung steps up (breach while below max)
+    "ladder_deescalations",   # rung steps down (hysteresis satisfied)
+    "bronze_sheds",           # rung-1 admissions refused at the door
+    "silver_clamps",          # rung-2 deadline-pressure engagements
+    "gold_reroutes",          # rung-3 emptiest-healthy placements
+)
+
+
+class SloCounters:
+    """SLO guardrail counters collected by the twin's degradation
+    ladder and merged into its scorecard (``slo.*`` events on ws/SSE,
+    docs/scenarios.rst)."""
+
+    def __init__(self):
+        self.counts = {k: 0 for k in SLO_COUNTERS}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        if name not in self.counts:
+            raise KeyError(
+                f"unknown slo counter {name!r}; add it to SLO_COUNTERS"
             )
         self.counts[name] += n
 

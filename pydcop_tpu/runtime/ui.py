@@ -58,6 +58,7 @@ class UiServer:
         event_bus.subscribe("serve.*", self._cb_serve)
         event_bus.subscribe("fleet.*", self._cb_fleet)
         event_bus.subscribe("portfolio.*", self._cb_portfolio)
+        event_bus.subscribe("slo.*", self._cb_slo)
 
     # -- event plumbing -----------------------------------------------------
 
@@ -304,6 +305,23 @@ class UiServer:
                                                  float, bool, type(None)))
                  else repr(evt)}))
 
+    def _cb_slo(self, topic: str, evt) -> None:
+        """SLO guardrail-ladder lifecycle (slo.tier.breach,
+        slo.ladder.escalated|released, slo.shed.bronze,
+        slo.clamp.silver, slo.reroute.gold, slo.scorecard — the city
+        twin's deterministic degradation ladder and its per-tier
+        attainment summary) pushed to GUI clients in the same envelope
+        shape as the serve/fleet forwarding; the SSE /events stream
+        gets them through the wildcard subscription like every
+        topic."""
+        if self._ws is not None:
+            self._ws.send_all(json.dumps(
+                {"evt": "slo",
+                 "kind": topic.split(".", 1)[-1],
+                 "data": evt if isinstance(evt, (dict, list, str, int,
+                                                 float, bool, type(None)))
+                 else repr(evt)}))
+
     # -- server -------------------------------------------------------------
 
     def start(self) -> None:
@@ -365,7 +383,7 @@ class UiServer:
                    self._cb_add_comp, self._cb_rem_comp, self._cb_fault,
                    self._cb_batch, self._cb_harness, self._cb_shard,
                    self._cb_dpop, self._cb_serve, self._cb_repair,
-                   self._cb_fleet, self._cb_portfolio):
+                   self._cb_fleet, self._cb_portfolio, self._cb_slo):
             event_bus.unsubscribe(cb)
         if self._server is not None:
             self._server.shutdown()

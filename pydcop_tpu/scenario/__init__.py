@@ -1,0 +1,43 @@
+"""City-scale digital twin: the everything-on-at-once scenario tier
+(ISSUE 12).
+
+One sustained, seeded, tick-driven scenario drives a replicated
+:class:`~pydcop_tpu.serve.SolveFleet` under multi-tenant deadline-tier
+traffic, live warm-repair churn, a combined chaos plan and optional
+``--auto`` portfolio selection — scored by SLO attainment (per-tier
+deadline attainment, p99, time-to-recover-cost, shed rate, RTO per
+kill) and guarded by the deterministic degradation ladder
+(docs/scenarios.rst).
+
+Entry points: ``pydcop_tpu twin`` (commands/twin.py), the ``twin``
+bench leg (``make bench-twin``) and the classes below.
+"""
+from pydcop_tpu.scenario.slo import (
+    RUNGS,
+    JobScore,
+    SloLadder,
+    TierSpec,
+    default_tiers,
+    scorecard,
+)
+from pydcop_tpu.scenario.twin import (
+    TwinJob,
+    TwinRunner,
+    build_twin_traffic,
+    default_chaos_plan,
+    standalone_results,
+)
+
+__all__ = [
+    "RUNGS",
+    "JobScore",
+    "SloLadder",
+    "TierSpec",
+    "default_tiers",
+    "scorecard",
+    "TwinJob",
+    "TwinRunner",
+    "build_twin_traffic",
+    "default_chaos_plan",
+    "standalone_results",
+]

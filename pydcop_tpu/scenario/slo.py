@@ -1,0 +1,284 @@
+"""Deadline tiers and the SLO guardrail ladder (ISSUE 12 tentpole).
+
+A city-scale twin is scored by *SLO attainment*, not iters/s: every
+tenant job belongs to a **tier** (gold/silver/bronze → admission
+priority + deadline), a job *hits* its SLO when it finishes within its
+tier deadline (a gold job additionally forfeits the hit when its
+progress stream dropped events — a lossy stream is a broken contract
+even if the result was on time), and each tier has a rolling
+**attainment floor**.
+
+When a floor is breached the :class:`SloLadder` escalates one rung —
+deterministically, in severity order, each rung a *real* lever on the
+serving stack:
+
+1. ``shed_bronze`` — bronze admissions are refused at the twin's front
+   door (counted + ``slo.shed.bronze``), freeing lanes for paying
+   tiers;
+2. ``clamp_silver`` — the fleet's deadline-pressure knob tightens
+   (:meth:`~pydcop_tpu.serve.fleet.SolveFleet.set_deadline_pressure`):
+   silver/bronze deadline lanes see a fraction of their remaining
+   budget in :func:`~pydcop_tpu.algorithms.base.
+   clamp_chunk_to_deadline`, shrinking their chunks so buckets reach
+   their boundaries — the only admission/completion points — sooner;
+   gold (>= the exempt priority) runs full chunks;
+3. ``reroute_gold`` — gold placements bypass warm-affinity routing and
+   land on the emptiest *healthy* replica
+   (``FleetRouter.place(prefer_emptiest=True)``): the shortest queue
+   wins even at the price of a compile.
+
+De-escalation is hysteretic: only after ``hold`` consecutive clean
+evaluations (no tier below floor) does the ladder step DOWN one rung
+(``slo.ladder.released``).  Escalation resets every tier's rolling
+window, so a rung is judged on the completions it actually governed,
+not on the backlog of misses that triggered it — this is what makes
+"engaged-and-released" deterministic in the smoke test.
+
+Every rung transition and breach is counted in
+:class:`~pydcop_tpu.runtime.stats.SloCounters` and emitted as
+``slo.*`` events (runtime/events.send_slo), forwarded to ws/SSE
+clients by runtime/ui.py like every lifecycle family.
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pydcop_tpu.runtime.events import send_slo
+from pydcop_tpu.runtime.stats import SloCounters
+
+#: ladder rungs in escalation order (index == rung level)
+RUNGS = ("normal", "shed_bronze", "clamp_silver", "reroute_gold")
+
+
+@dataclasses.dataclass(frozen=True)
+class TierSpec:
+    """One deadline tier: admission priority, latency budget and the
+    rolling-attainment floor the ladder guards."""
+
+    name: str
+    priority: int
+    deadline_s: Optional[float]
+    floor: float
+    share: float  # fraction of generated twin traffic
+
+    def scaled(self, deadline_s: Optional[float]) -> "TierSpec":
+        return dataclasses.replace(self, deadline_s=deadline_s)
+
+
+def default_tiers(
+    gold_deadline: float = 30.0,
+    silver_deadline: float = 10.0,
+    bronze_deadline: float = 20.0,
+) -> Tuple[TierSpec, ...]:
+    """The twin's default 3-tier ladder.  Floors: gold 99% (the
+    acceptance bar), silver 90%, bronze 50% — bronze exists to be
+    shed."""
+    return (
+        TierSpec("gold", priority=2, deadline_s=gold_deadline,
+                 floor=0.99, share=0.25),
+        TierSpec("silver", priority=1, deadline_s=silver_deadline,
+                 floor=0.90, share=0.25),
+        TierSpec("bronze", priority=0, deadline_s=bronze_deadline,
+                 floor=0.50, share=0.50),
+    )
+
+
+@dataclasses.dataclass
+class JobScore:
+    """One completed (or shed) twin job, as the scorecard sees it."""
+
+    label: str
+    tier: str
+    tenant: str
+    status: str  # FINISHED / TIMEOUT / ERROR / SHED
+    latency_s: Optional[float]
+    deadline_s: Optional[float]
+    hit: bool
+    shed: bool = False
+    lossy: bool = False
+
+
+class SloLadder:
+    """The deterministic degradation ladder over a set of tiers.
+
+    ``record`` feeds one completion into its tier's rolling window;
+    ``evaluate`` (called by the twin on a fixed cadence) breach-checks
+    every tier with at least ``min_samples`` fresh completions and
+    moves the rung at most one step per call.  ``enabled=False`` keeps
+    the full accounting (windows, breaches, scorecard) but never moves
+    the rung — the honest OFF arm of the ladder A/B in the twin bench.
+    """
+
+    def __init__(
+        self,
+        tiers: Tuple[TierSpec, ...],
+        counters: Optional[SloCounters] = None,
+        window: int = 12,
+        min_samples: int = 4,
+        hold: int = 6,
+        silver_pressure: float = 0.5,
+        enabled: bool = True,
+    ):
+        self.tiers: Dict[str, TierSpec] = {t.name: t for t in tiers}
+        self.counters = counters if counters is not None else SloCounters()
+        self.window = int(window)
+        self.min_samples = int(min_samples)
+        self.hold = int(hold)
+        #: rung-2 factor handed to SolveFleet.set_deadline_pressure
+        self.silver_pressure = float(silver_pressure)
+        self.enabled = bool(enabled)
+        self.rung = 0
+        self.max_rung_reached = 0
+        self._clean_evals = 0
+        self._windows: Dict[str, Deque[bool]] = {
+            t.name: deque(maxlen=self.window) for t in tiers
+        }
+
+    # -- levers the twin consults -------------------------------------------
+
+    @property
+    def shed_bronze(self) -> bool:
+        return self.rung >= 1
+
+    @property
+    def clamp_silver(self) -> bool:
+        return self.rung >= 2
+
+    @property
+    def reroute_gold(self) -> bool:
+        return self.rung >= 3
+
+    @property
+    def rung_name(self) -> str:
+        return RUNGS[self.rung]
+
+    # -- accounting ----------------------------------------------------------
+
+    def record(self, tier: str, hit: bool) -> None:
+        """Feed one scored completion into its tier's rolling window."""
+        self._windows[tier].append(bool(hit))
+        self.counters.inc("jobs_scored")
+        self.counters.inc("deadline_hits" if hit else "deadline_misses")
+
+    def attainment(self, tier: str) -> Optional[float]:
+        """Rolling attainment of ``tier`` since the last rung change,
+        or None below ``min_samples`` (a rung is judged only on
+        completions it governed)."""
+        w = self._windows[tier]
+        if len(w) < self.min_samples:
+            return None
+        return sum(w) / len(w)
+
+    def breached(self) -> List[Tuple[str, float]]:
+        out = []
+        for name, spec in self.tiers.items():
+            att = self.attainment(name)
+            if att is not None and att < spec.floor:
+                out.append((name, att))
+        return out
+
+    # -- the ladder ----------------------------------------------------------
+
+    def evaluate(self) -> int:
+        """One breach check; moves the rung at most one step.  Returns
+        the (possibly new) rung.  Escalation resets every window —
+        the new rung starts with a clean slate; de-escalation needs
+        ``hold`` consecutive clean evaluations (hysteresis)."""
+        breaches = self.breached()
+        for name, att in breaches:
+            self.counters.inc("tier_breaches")
+            send_slo("tier.breach", {
+                "tier": name, "attainment": round(att, 4),
+                "floor": self.tiers[name].floor,
+            })
+        if not self.enabled:
+            return self.rung
+        if breaches:
+            self._clean_evals = 0
+            if self.rung < len(RUNGS) - 1:
+                self.rung += 1
+                self.max_rung_reached = max(self.max_rung_reached,
+                                            self.rung)
+                self.counters.inc("ladder_escalations")
+                send_slo("ladder.escalated", {
+                    "rung": self.rung, "rung_name": self.rung_name,
+                    "tiers": [n for n, _ in breaches],
+                })
+                self._reset_windows()
+        else:
+            self._clean_evals += 1
+            if self.rung > 0 and self._clean_evals >= self.hold:
+                self.rung -= 1
+                self.counters.inc("ladder_deescalations")
+                send_slo("ladder.released", {
+                    "rung": self.rung, "rung_name": self.rung_name,
+                })
+                self._reset_windows()
+                self._clean_evals = 0
+        return self.rung
+
+    def _reset_windows(self) -> None:
+        for w in self._windows.values():
+            w.clear()
+
+
+def scorecard(scores: List[JobScore], tiers: Tuple[TierSpec, ...],
+              counters: SloCounters, rto_s: List[float],
+              recover_s: List[float]) -> Dict:
+    """The twin's SLO scorecard: per-tier deadline attainment and
+    latency percentiles, shed rate, time-to-recover-cost after each
+    live mutation, and the RTO of every injected replica kill
+    (docs/scenarios.rst "Scoring")."""
+    per_tier: Dict[str, Dict] = {}
+    for t in tiers:
+        mine = [s for s in scores if s.tier == t.name]
+        shed = [s for s in mine if s.shed]
+        scored = [s for s in mine if not s.shed]
+        lat = [s.latency_s for s in scored if s.latency_s is not None]
+        entry = {
+            "jobs": len(mine),
+            "scored": len(scored),
+            "shed": len(shed),
+            "hits": sum(1 for s in scored if s.hit),
+            "misses": sum(1 for s in scored if not s.hit),
+            "lossy_streams": sum(1 for s in scored if s.lossy),
+            "deadline_s": t.deadline_s,
+            "floor": t.floor,
+            "attainment": (
+                round(sum(1 for s in scored if s.hit) / len(scored), 4)
+                if scored else None
+            ),
+        }
+        if lat:
+            entry["p50_ms"] = round(
+                float(np.percentile(lat, 50)) * 1e3, 1)
+            entry["p99_ms"] = round(
+                float(np.percentile(lat, 99)) * 1e3, 1)
+        per_tier[t.name] = entry
+    total = len(scores)
+    shed_total = sum(1 for s in scores if s.shed)
+    out = {
+        "tiers": per_tier,
+        "jobs": total,
+        "shed_rate": round(shed_total / total, 4) if total else 0.0,
+        "slo": counters.as_dict(),
+        "rto_s": [round(r, 4) for r in rto_s],
+        "rto_max_s": round(max(rto_s), 4) if rto_s else None,
+        "recover_s": [round(r, 4) for r in recover_s],
+        "recover_s_mean": (
+            round(float(np.mean(recover_s)), 4) if recover_s else None
+        ),
+    }
+    send_slo("scorecard", {
+        "tiers": {
+            n: {"attainment": e["attainment"], "p99_ms": e.get("p99_ms")}
+            for n, e in per_tier.items()
+        },
+        "shed_rate": out["shed_rate"],
+        "rto_max_s": out["rto_max_s"],
+    })
+    return out

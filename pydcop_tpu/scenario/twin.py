@@ -1,0 +1,597 @@
+"""City-scale digital twin — everything on at once (ISSUE 12 tentpole).
+
+Every subsystem has its own bench leg; production systems break where
+the legs meet: a churn mutation landing while a replica is being
+killed while the admission queue is saturated.  The twin is ONE
+sustained scenario that drives a replicated
+:class:`~pydcop_tpu.serve.SolveFleet` under four concurrent pressures:
+
+* **multi-tenant traffic** — seeded Poisson arrivals over a mixed
+  workload pool (routing + tracking + graph coloring — the families
+  that stress axes coloring never touches), each job mapped to a
+  deadline tier (gold/silver/bronze → priority + ``deadline_s``;
+  scenario/slo.py);
+* **live churn** — one live problem held by a
+  :class:`~pydcop_tpu.runtime.repair.WarmRepairController`, mutated by
+  a scenario event stream (``churn_scenario`` jitter edits,
+  ``tracking_scenario`` target motion, agent re-hosting) plus the
+  fault plan's churn kinds — every ``change_factor`` a fixed-shape
+  warm buffer write, time-to-recover-cost measured per mutation;
+* **chaos** — ONE seeded :class:`~pydcop_tpu.runtime.faults.FaultPlan`
+  whose fleet kinds (``kill_replica``/``stall_replica``/
+  ``partition_replica``) fire in the fleet supervisor, serve kinds
+  (``nan_lane``/``raise_in_step``/``torn_journal_write``/
+  ``stall_tick``) fire inside every replica, and churn kinds
+  (``edit_factor``/``*_agent_burst``) fire against the live problem —
+  the combined plan no unit leg ever runs;
+* **--auto** — optional portfolio selection per traffic instance
+  (pydcop_tpu.portfolio.select; the heuristic fallback with no model),
+  recording the chosen configs.
+
+The run is **tick-driven and seeded**: arrivals, tier assignment,
+chaos and churn are all functions of their seeds and the tick counter,
+so the same configuration replays the same scenario; and because every
+serve path is bit-deterministic, the FINISHED jobs of a chaos run are
+bit-identical to an unfaulted replay (the twin bench pins this).
+
+Scoring is the SLO scorecard (scenario/slo.py): per-tier deadline
+attainment and p99, shed rate, time-to-recover-cost per mutation, and
+the RTO of every injected kill — guarded by the degradation
+:class:`~pydcop_tpu.scenario.slo.SloLadder` whose three rungs (shed
+bronze → clamp silver chunks → reroute gold to the emptiest healthy
+replica) are what keep gold at its floor while everything else burns.
+"""
+from __future__ import annotations
+
+import dataclasses
+import shutil
+import tempfile
+from time import monotonic
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pydcop_tpu.batch.engine import SUPPORTED_ALGOS
+from pydcop_tpu.dcop.scenario import Scenario
+from pydcop_tpu.runtime.events import send_slo
+from pydcop_tpu.runtime.faults import Fault, FaultPlan
+from pydcop_tpu.runtime.stats import SloCounters
+from pydcop_tpu.scenario.slo import (
+    JobScore,
+    SloLadder,
+    TierSpec,
+    default_tiers,
+    scorecard,
+)
+from pydcop_tpu.serve import ServeError, SolveFleet
+
+
+@dataclasses.dataclass
+class TwinJob:
+    """One unit of twin traffic: an instance, its tier and its seeded
+    arrival tick."""
+
+    index: int
+    dcop: Any
+    family: str
+    tier: str
+    tenant: str
+    seed: int
+    arrival_tick: int
+    algo: str
+    algo_params: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    label: str = ""
+    config: Optional[Dict[str, Any]] = None  # --auto chosen config
+    # runtime bookkeeping
+    spec: Any = None  # pre-built adapter spec (instance compilation
+    #                   happens off the measured scenario, like the
+    #                   threaded service's prep pool)
+    jid: Optional[str] = None
+    submitted_at: Optional[float] = None
+    scored: bool = False
+
+
+def build_twin_traffic(
+    n_jobs: int,
+    tiers: Tuple[TierSpec, ...],
+    seed: int = 0,
+    algo: str = "mgm",
+    mean_interarrival_ticks: float = 2.0,
+    routing_tasks: int = 12,
+    tracking_sensors: int = 16,
+    coloring_vars: int = 40,
+    auto: bool = False,
+) -> List[TwinJob]:
+    """Seeded twin traffic: instances cycle over the routing, tracking
+    and graph-coloring families (distinct seeds each), tiers are drawn
+    by their ``share`` weights, and arrivals follow a Poisson process
+    measured in *ticks* (exponential inter-arrivals, so the schedule
+    is a pure function of the seed — no wall clock).
+
+    ``auto=True`` asks the learned portfolio (or its heuristic
+    fallback when no model is trained) for each instance's config;
+    batch-eligible picks override ``algo`` and the choice is recorded
+    on the job (the ``--auto`` arm of the twin)."""
+    from pydcop_tpu.generators import (
+        generate_graph_coloring,
+        generate_routing,
+        generate_tracking,
+    )
+
+    rng = np.random.default_rng(seed)
+    shares = np.array([t.share for t in tiers], np.float64)
+    shares = shares / shares.sum()
+    inter = rng.exponential(mean_interarrival_ticks, n_jobs)
+    inter[0] = 0.0
+    ticks = np.cumsum(inter).astype(int)
+    jobs: List[TwinJob] = []
+    for i in range(n_jobs):
+        fam = ("routing", "tracking", "coloring")[i % 3]
+        if fam == "routing":
+            dcop = generate_routing(routing_tasks, seed=1000 + i)
+        elif fam == "tracking":
+            dcop = generate_tracking(tracking_sensors, n_targets=2,
+                                     seed=2000 + i)
+        else:
+            dcop = generate_graph_coloring(
+                n_variables=coloring_vars, n_colors=3,
+                n_edges=coloring_vars * 3, soft=True, n_agents=1,
+                seed=3000 + i,
+            )
+        tier = tiers[int(rng.choice(len(tiers), p=shares))]
+        job = TwinJob(
+            index=i, dcop=dcop, family=fam, tier=tier.name,
+            tenant=tier.name, seed=i, arrival_tick=int(ticks[i]),
+            algo=algo, label=f"{fam}:{i}",
+        )
+        if auto:
+            from pydcop_tpu.portfolio.select import select_config
+
+            sel = select_config(dcop)
+            job.config = sel.config.as_dict()
+            if sel.config.algo in SUPPORTED_ALGOS:
+                job.algo = sel.config.algo
+                job.algo_params = dict(sel.config.algo_params())
+        jobs.append(job)
+    return jobs
+
+
+def default_chaos_plan(
+    seed: int = 0,
+    kill_tick: int = 8,
+    kill_replica: int = 0,
+    stall_tick_at: int = 4,
+    nan_tick: int = 6,
+    churn_edit_ticks: Sequence[int] = (10, 18),
+) -> FaultPlan:
+    """The twin's combined chaos plan: one replica kill (fleet), one
+    wedged scheduler tick + one transient NaN lane + one torn journal
+    append (serve), and seeded ``edit_factor`` churn against the live
+    problem — every layer's fault machinery armed by ONE plan."""
+    faults = [
+        Fault(kind="kill_replica", replica=int(kill_replica),
+              cycle=int(kill_tick)),
+        Fault(kind="stall_tick", duration=0.05,
+              cycle=int(stall_tick_at)),
+        Fault(kind="nan_lane", cycle=int(nan_tick)),
+        Fault(kind="torn_journal_write", cycle=2),
+    ]
+    for t in churn_edit_ticks:
+        faults.append(Fault(kind="edit_factor", cycle=int(t)))
+    return FaultPlan(faults=faults, seed=int(seed))
+
+
+def standalone_results(jobs: Sequence[TwinJob],
+                       max_cycles: int = 200) -> Dict[str, Any]:
+    """The unfaulted anchor: each traffic instance solved standalone
+    with its exact (algo, seed) — by the serve determinism contract,
+    every FINISHED twin job must equal these bit for bit, chaos or
+    not."""
+    from pydcop_tpu.batch.engine import BatchItem, adapter_for
+
+    out: Dict[str, Any] = {}
+    for job in jobs:
+        adapter = adapter_for(job.algo)
+        spec = adapter.build_spec(BatchItem(
+            job.dcop, job.algo, algo_params=job.algo_params,
+            seed=job.seed,
+        ))
+        out[job.label] = spec.solver.run(max_cycles=max_cycles)
+    return out
+
+
+class TwinRunner:
+    """Drive the combined scenario tick by tick and score it.
+
+    >>> # sketch:
+    >>> # jobs = build_twin_traffic(12, tiers, seed=7)
+    >>> # twin = TwinRunner(jobs, tiers, fault_plan=default_chaos_plan())
+    >>> # card = twin.run()
+    >>> # card["tiers"]["gold"]["attainment"]
+
+    ``live_dcop``/``live_scenario`` arm the churn pressure: the live
+    problem solves warm (WarmRepairController) and the scenario's
+    events fire one per ``churn_every`` ticks, each followed by a
+    ``recover_cycles``-cycle warm re-convergence whose wall time is
+    the mutation's time-to-recover-cost.  ``fault_plan`` arms all
+    three chaos layers (see module docstring).  ``ladder=False`` keeps
+    the full SLO accounting but never escalates — the honest OFF arm
+    of the guardrail A/B."""
+
+    def __init__(
+        self,
+        jobs: Sequence[TwinJob],
+        tiers: Optional[Tuple[TierSpec, ...]] = None,
+        replicas: int = 2,
+        lanes: int = 4,
+        max_buckets: Optional[int] = None,
+        max_cycles: int = 200,
+        fault_plan: Optional[FaultPlan] = None,
+        journal_dir: Optional[str] = None,
+        live_dcop: Any = None,
+        live_scenario: Optional[Scenario] = None,
+        live_algo: str = "mgm",
+        churn_start: int = 3,
+        churn_every: int = 2,
+        recover_cycles: int = 24,
+        ladder: bool = True,
+        ladder_window: int = 12,
+        ladder_min_samples: int = 4,
+        ladder_hold: int = 3,
+        silver_pressure: float = 0.5,
+        stream: bool = False,
+    ):
+        self.jobs = list(jobs)
+        self.tiers = tiers if tiers is not None else default_tiers()
+        self.tier_by_name = {t.name: t for t in self.tiers}
+        self.replicas = int(replicas)
+        self.lanes = int(lanes)
+        self.max_buckets = max_buckets
+        self.max_cycles = int(max_cycles)
+        self.fault_plan = fault_plan
+        self.journal_dir = journal_dir
+        self.live_dcop = live_dcop
+        self.live_scenario = live_scenario
+        self.live_algo = live_algo
+        self.churn_start = int(churn_start)
+        self.churn_every = max(1, int(churn_every))
+        self.recover_cycles = int(recover_cycles)
+        self.stream = bool(stream)
+        self.counters = SloCounters()
+        self.ladder = SloLadder(
+            self.tiers, counters=self.counters, window=ladder_window,
+            min_samples=ladder_min_samples, hold=ladder_hold,
+            silver_pressure=silver_pressure, enabled=ladder,
+        )
+        self.scores: List[JobScore] = []
+        self.results: Dict[str, Any] = {}  # label -> SolveResult
+        self.recover_s: List[float] = []
+        self.fleet: Optional[SolveFleet] = None
+        self._ctl = None  # WarmRepairController over the live problem
+        self._pressure_on = False
+
+    # -- live-problem churn --------------------------------------------------
+
+    def _start_live(self) -> None:
+        if self.live_dcop is None:
+            return
+        from pydcop_tpu.runtime.repair import WarmRepairController
+
+        self._ctl = WarmRepairController(
+            self.live_dcop, self.live_algo,
+            seed=self.fault_plan.seed if self.fault_plan else 0,
+        )
+        res = self._ctl.solver.run(chunk=self._ctl.chunk,
+                                   cycles=self.recover_cycles)
+        self._ctl.phase_done(res)
+
+    def _recover(self) -> None:
+        """One warm re-convergence phase after a mutation; its wall
+        time lands in time_to_recover_s (RepairCounters) and the
+        per-mutation list."""
+        before = self._ctl.counters.counts["time_to_recover_s"]
+        res = self._ctl.solver.run(
+            resume=True, cycles=self.recover_cycles,
+            chunk=self._ctl.chunk,
+        )
+        self._ctl.phase_done(res)
+        after = self._ctl.counters.counts["time_to_recover_s"]
+        if after > before:
+            self.recover_s.append(after - before)
+
+    def _apply_churn_event(self, event) -> None:
+        """Apply one scenario event's actions through the warm
+        controller: tracking motion and jitter edits are fixed-shape
+        EditFactor writes; agent add/remove is the re-hosting
+        handshake (state retained, recovery clock still runs)."""
+        from pydcop_tpu.runtime.repair import perturbed_constraint
+
+        if event.is_delay:
+            return
+        mutated = False
+        for action in event.actions:
+            p = action.parameters
+            if action.type == "change_factor":
+                name = p["constraint"]
+                if p.get("family") == "tracking":
+                    from pydcop_tpu.generators.tracking import (
+                        moved_constraint,
+                    )
+
+                    new_c = moved_constraint(
+                        self.live_dcop, name, int(p["step"])
+                    )
+                else:
+                    new_c = perturbed_constraint(
+                        self.live_dcop.constraints[name],
+                        seed=int(p.get("seed", 0)),
+                    )
+                self._ctl.edit_factor(new_c)
+                mutated = True
+            elif action.type in ("remove_agent", "add_agent"):
+                # re-hosting churn: the warm solver keeps its device
+                # state; the run still re-converges, and the recovery
+                # clock measures that
+                self._ctl.mark_recovery()
+                mutated = True
+        if mutated:
+            self._recover()
+
+    def _apply_churn_fault(self, fault: Fault) -> None:
+        seed = self.fault_plan.seed if self.fault_plan else 0
+        if fault.kind == "edit_factor":
+            self._ctl.edit_factor_fault(fault, seed)
+        else:  # remove_agent_burst / add_agent_burst: re-hosting
+            self._ctl.mark_recovery()
+        self._recover()
+
+    # -- ladder side effects -------------------------------------------------
+
+    def _apply_rung(self) -> None:
+        """Engage/release the rung-2 fleet lever on transitions (rungs
+        1 and 3 act at submission time)."""
+        gold = max(t.priority for t in self.tiers)
+        if self.ladder.clamp_silver and not self._pressure_on:
+            self._pressure_on = True
+            self.counters.inc("silver_clamps")
+            self.fleet.set_deadline_pressure(
+                self.ladder.silver_pressure, exempt_priority=gold,
+            )
+            send_slo("clamp.silver", {
+                "pressure": self.ladder.silver_pressure,
+                "exempt_priority": gold,
+            })
+        elif not self.ladder.clamp_silver and self._pressure_on:
+            self._pressure_on = False
+            self.fleet.set_deadline_pressure(1.0)
+
+    # -- traffic -------------------------------------------------------------
+
+    def _submit_due(self, tick: int) -> None:
+        for job in self.jobs:
+            if job.jid is not None or job.scored:
+                continue
+            if job.arrival_tick > tick:
+                continue
+            tier = self.tier_by_name[job.tier]
+            if tier.name == "bronze" and self.ladder.shed_bronze:
+                self.counters.inc("bronze_sheds")
+                send_slo("shed.bronze", {"label": job.label})
+                job.scored = True
+                self.scores.append(JobScore(
+                    label=job.label, tier=tier.name, tenant=job.tenant,
+                    status="SHED", latency_s=None,
+                    deadline_s=tier.deadline_s, hit=False, shed=True,
+                ))
+                continue
+            placement = None
+            if tier.name == "gold" and self.ladder.reroute_gold:
+                placement = "emptiest"
+                self.counters.inc("gold_reroutes")
+                send_slo("reroute.gold", {"label": job.label})
+            try:
+                job.jid = self.fleet.submit(
+                    job.dcop, job.algo, algo_params=job.algo_params,
+                    seed=job.seed, tenant=job.tenant,
+                    priority=tier.priority,
+                    deadline_s=tier.deadline_s, label=job.label,
+                    placement=placement, stream=self.stream,
+                    spec=job.spec,
+                )
+                job.submitted_at = monotonic()
+            except ServeError:
+                # fleet admission control said no: a shed, scored
+                job.scored = True
+                self.scores.append(JobScore(
+                    label=job.label, tier=tier.name, tenant=job.tenant,
+                    status="SHED", latency_s=None,
+                    deadline_s=tier.deadline_s, hit=False, shed=True,
+                ))
+
+    def _job_lossy(self, job: TwinJob) -> bool:
+        """Did this job's progress stream drop events?  Read from the
+        serving replica's ServeJob (the per-job twin of the per-tenant
+        ``events_dropped_by_tenant`` surface)."""
+        fj = self.fleet._jobs.get(job.jid)
+        if fj is None:
+            return False
+        for h in self.fleet._handles.values():
+            sj = h.service._jobs.get(job.jid)
+            if sj is not None and sj.lossy_notified:
+                return True
+        return False
+
+    def _score_done(self) -> int:
+        """Score every newly-completed job; returns how many."""
+        n = 0
+        for job in self.jobs:
+            if job.jid is None or job.scored:
+                continue
+            fj = self.fleet._jobs.get(job.jid)
+            if fj is None or not fj.done.is_set():
+                continue
+            res = self.fleet.result(job.jid, timeout=5)
+            tier = self.tier_by_name[job.tier]
+            latency = monotonic() - job.submitted_at
+            lossy = self._job_lossy(job)
+            hit = (
+                res.status == "FINISHED"
+                and (tier.deadline_s is None
+                     or latency <= tier.deadline_s)
+            )
+            if hit and lossy and tier.name == "gold":
+                # a lossy gold stream is a broken contract even when
+                # the result was on time (ISSUE 12 satellite)
+                hit = False
+                self.counters.inc("lossy_stream_misses")
+            job.scored = True
+            n += 1
+            self.results[job.label] = res
+            self.scores.append(JobScore(
+                label=job.label, tier=tier.name, tenant=job.tenant,
+                status=res.status, latency_s=latency,
+                deadline_s=tier.deadline_s, hit=hit, lossy=lossy,
+            ))
+            self.ladder.record(tier.name, hit)
+        return n
+
+    # -- the run -------------------------------------------------------------
+
+    def run(self, max_ticks: int = 5000) -> Dict[str, Any]:
+        tmp = None
+        jd = self.journal_dir
+        if jd is None:
+            # failover re-seats need per-lane checkpoints on disk
+            tmp = tempfile.mkdtemp(prefix="twin_")
+            jd = tmp
+        self.fleet = SolveFleet(
+            replicas=self.replicas, lanes=self.lanes,
+            max_buckets=self.max_buckets,
+            max_cycles=self.max_cycles, journal_dir=jd,
+            checkpoint_every=1, fault_plan=self.fault_plan,
+        )
+        try:
+            # prewarm every family signature so admission never pays a
+            # cold compile inside the measured scenario, and pre-build
+            # every instance spec — tick-driven replicas have no prep
+            # pool, and an inline 4000-var instance compile landing on
+            # the scheduler thread mid-trace would charge seconds to
+            # whatever jobs are in flight (the threaded service builds
+            # specs off-thread for exactly this reason)
+            self.fleet.prewarm(
+                [(j.dcop, j.algo, j.algo_params) for j in self.jobs],
+                block=True,
+            )
+            from pydcop_tpu.batch.engine import BatchItem, adapter_for
+
+            for job in self.jobs:
+                if job.spec is None and job.algo in SUPPORTED_ALGOS:
+                    job.spec = adapter_for(job.algo).build_spec(
+                        BatchItem(job.dcop, job.algo,
+                                  algo_params=job.algo_params,
+                                  seed=job.seed, label=job.label)
+                    )
+            self._start_live()
+            churn_events = (
+                [e for e in self.live_scenario if not e.is_delay]
+                if (self.live_scenario is not None
+                    and self._ctl is not None) else []
+            )
+            churn_faults = (
+                list(self.fault_plan.churn_faults())
+                if (self.fault_plan is not None
+                    and self._ctl is not None) else []
+            )
+            next_churn = 0
+            settle = 0
+            # after the last completion the ladder still needs its
+            # hysteresis ticks to step back down — give it a bounded
+            # settle window instead of freezing it mid-rung
+            settle_budget = 3 * self.ladder.hold + 5
+            for tick in range(int(max_ticks)):
+                self._submit_due(tick)
+                # one churn pressure fires per churn window: scenario
+                # events first, then the plan's churn kinds
+                if (
+                    tick >= self.churn_start
+                    and (tick - self.churn_start) % self.churn_every == 0
+                ):
+                    if next_churn < len(churn_events):
+                        self._apply_churn_event(churn_events[next_churn])
+                        next_churn += 1
+                    elif churn_faults and (
+                        churn_faults[0].cycle <= tick
+                    ):
+                        self._apply_churn_fault(churn_faults.pop(0))
+                self.fleet.tick()
+                self._score_done()
+                # evaluate every tick, completions or not: windows
+                # reset on every rung change, so a quiet drain period
+                # is `hold` clean evaluations and the ladder releases
+                # — sustained misses keep re-feeding the windows and
+                # re-escalating
+                self.ladder.evaluate()
+                self._apply_rung()
+                done_traffic = all(j.scored for j in self.jobs)
+                churn_done = (
+                    next_churn >= len(churn_events)
+                    and not churn_faults
+                )
+                if done_traffic and churn_done:
+                    settle += 1
+                    if self.ladder.rung == 0 or settle > settle_budget:
+                        break
+            return self._scorecard()
+        finally:
+            try:
+                self.fleet.stop(drain=False)
+            finally:
+                if tmp is not None:
+                    shutil.rmtree(tmp, ignore_errors=True)
+
+    def _scorecard(self) -> Dict[str, Any]:
+        m = self.fleet.metrics()
+        rtos = [
+            r["rto_s"] for r in m["recoveries"]
+            if r.get("rto_s") is not None
+        ]
+        card = scorecard(self.scores, self.tiers, self.counters,
+                         rtos, self.recover_s)
+        card["ladder"] = {
+            "enabled": self.ladder.enabled,
+            "final_rung": self.ladder.rung,
+            "max_rung": self.ladder.max_rung_reached,
+            "engaged": self.counters.counts["ladder_escalations"] > 0,
+            "released": (
+                self.counters.counts["ladder_deescalations"] > 0
+            ),
+        }
+        card["fleet"] = {
+            k: m["fleet"][k] for k in (
+                "jobs_routed", "jobs_reseated", "replicas_down",
+                "reseat_checkpoint_hits", "faults_injected",
+                "jobs_shed",
+            )
+        }
+        card["serve"] = {
+            "events_dropped_by_tenant": self._dropped_by_tenant(),
+            "faults_injected": sum(
+                h.service.counters.counts["faults_injected"]
+                for h in self.fleet._handles.values()
+            ),
+        }
+        if self._ctl is not None:
+            card["churn"] = self._ctl.counters.as_dict()
+        auto = [j.config for j in self.jobs if j.config is not None]
+        if auto:
+            card["auto"] = {"configs": auto}
+        return card
+
+    def _dropped_by_tenant(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for h in self.fleet._handles.values():
+            for t, n in (
+                h.service.counters.events_dropped_by_tenant.items()
+            ):
+                out[t] = out.get(t, 0) + n
+        return out

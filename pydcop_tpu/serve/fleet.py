@@ -225,6 +225,8 @@ class FleetJob:
     source_file: Optional[str]
     replica: str
     submitted_at: float
+    stream: bool = False
+    spec: Any = None  # pre-built adapter spec (skips replica prep)
     reseats: int = 0
     done: threading.Event = dataclasses.field(
         default_factory=threading.Event
@@ -264,6 +266,7 @@ class SolveFleet:
         max_cycles: int = DEFAULT_MAX_CYCLES,
         journal_dir: Optional[str] = None,
         checkpoint_every: int = 4,
+        max_buckets: Optional[int] = None,
         max_pending: Optional[int] = None,
         tenant_quota: Optional[int] = None,
         fault_plan: Optional[FaultPlan] = None,
@@ -275,11 +278,23 @@ class SolveFleet:
         self.lanes = int(lanes)
         self.max_cycles = int(max_cycles)
         self.journal_dir = journal_dir
+        #: per-replica bound on concurrently-open buckets: beyond it
+        #: jobs queue for freed lanes instead of growing the working
+        #: set — what makes lane occupancy a real contended resource
+        #: (the twin's saturation model rides this)
+        self.max_buckets = max_buckets
         self.max_pending = max_pending
         self.tenant_quota = tenant_quota
         self.heartbeat_timeout = float(heartbeat_timeout)
         self.supervise_interval = float(supervise_interval)
         self.counters = counters if counters is not None else FleetCounters()
+        #: the full chaos plan: fleet kinds are consumed by the
+        #: supervisor below; SERVE kinds (raise_in_step / nan_lane /
+        #: torn_journal_write / stall_tick) are handed to every replica
+        #: service so one combined plan drives the whole stack — each
+        #: replica arms its own injector over the serve subset (the
+        #: city-twin scenario's combined chaos plan rides this)
+        self._fault_plan = fault_plan
         # spill at one bucket's worth of extra queue: warmth decides
         # placement at the margin, load in the bulk (router docstring)
         self.router = FleetRouter(spill_load=self.lanes)
@@ -344,6 +359,7 @@ class SolveFleet:
             max_cycles=self.max_cycles,
             journal_dir=jd,
             checkpoint_every=checkpoint_every,
+            max_buckets=self.max_buckets,
             # admission control lives at the FLEET front door; the
             # replica-side queue stays unbounded so the aggregate bound
             # is the only one in force
@@ -351,6 +367,7 @@ class SolveFleet:
             tenant_quota=None,
             replica=name,
             heartbeat_path=hb,
+            fault_plan=self._fault_plan,
         )
         handle = ReplicaHandle(
             name=name, index=index, service=service,
@@ -430,6 +447,20 @@ class SolveFleet:
 
     # -- front door ---------------------------------------------------------
 
+    def set_deadline_pressure(self, factor: float,
+                              exempt_priority: Optional[int] = None
+                              ) -> None:
+        """Fleet-wide deadline-pressure knob (the SLO ladder's rung-2
+        lever): every live replica's buckets shrink the chunks of
+        deadline lanes below ``exempt_priority`` to ``factor`` of
+        their remaining budget — see
+        :meth:`SolveService.set_deadline_pressure`."""
+        for h in self._handles.values():
+            if h.up and not h.dead:
+                h.service.set_deadline_pressure(
+                    factor, exempt_priority=exempt_priority
+                )
+
     def submit(
         self,
         dcop,
@@ -441,6 +472,9 @@ class SolveFleet:
         deadline_s: Optional[float] = None,
         label: Optional[str] = None,
         source_file: Optional[str] = None,
+        placement: Optional[str] = None,
+        stream: bool = False,
+        spec: Any = None,
     ) -> str:
         """Admit one job at the fleet front door, route it to a warm
         replica, and return its fleet-wide job id.  Raises the same
@@ -448,7 +482,12 @@ class SolveFleet:
         :class:`DeadlineInfeasible`, :class:`ServiceOverloaded` (with
         the fleet-level completion-rate ``retry_after``),
         :class:`ServiceStopped` — but evaluated against the AGGREGATE
-        bound and fleet-wide tenant quotas."""
+        bound and fleet-wide tenant quotas.
+
+        ``placement="emptiest"`` overrides the warm-first routing for
+        THIS job: least-loaded healthy replica, warmth ignored (the
+        SLO ladder's rung-3 protection of gold traffic;
+        docs/scenarios.rst)."""
         self._raise_if_dead()
         if deadline_s is not None and deadline_s <= 0:
             self.counters.inc("jobs_shed")
@@ -500,7 +539,10 @@ class SolveFleet:
             self._seq += 1
             jid = f"job-{self._seq:06d}"
             key = job_routing_key(dcop, algo, algo_params)
-            placed = self.router.place(key, jid=jid)
+            placed = self.router.place(
+                key, jid=jid,
+                prefer_emptiest=(placement == "emptiest"),
+            )
             if placed is None:
                 raise ServiceStopped("no routable replica")
             name, warm = placed
@@ -510,7 +552,7 @@ class SolveFleet:
                 tenant=tenant, priority=int(priority),
                 deadline_s=deadline_s, label=label,
                 source_file=source_file, replica=name,
-                submitted_at=monotonic(),
+                submitted_at=monotonic(), stream=stream, spec=spec,
             )
             self._jobs[jid] = fj
             self._tenant_open[tenant] = (
@@ -544,6 +586,7 @@ class SolveFleet:
                     seed=fj.seed, tenant=fj.tenant,
                     priority=fj.priority, deadline_s=fj.deadline_s,
                     label=fj.label, source_file=fj.source_file,
+                    stream=fj.stream, spec=fj.spec,
                     _jid=fj.jid, _restore=restore,
                 )
                 return

@@ -171,13 +171,21 @@ class FleetRouter:
     # -- placement ----------------------------------------------------------
 
     def place(self, key: Tuple, jid: Optional[str] = None,
-              exclude: Optional[str] = None
+              exclude: Optional[str] = None,
+              prefer_emptiest: bool = False,
               ) -> Optional[Tuple[str, bool]]:
         """Pick the replica for one job and account the placement.
         Returns ``(name, was_warm)``, or None when no replica is
         routable (the fleet front door turns that into a structured
         overload/stopped error).  ``exclude`` bars one replica (the
-        dead one, during re-seat)."""
+        dead one, during re-seat).
+
+        ``prefer_emptiest`` inverts the policy for ONE placement:
+        least-loaded healthy replica first, warmth ignored — the SLO
+        ladder's rung-3 lever (a protected gold job buys the shortest
+        queue even at the price of a compile; scenario/slo.py).
+        Routable already excludes down/stalled/partitioned replicas,
+        so "emptiest" is always also "healthy"."""
         candidates = [
             r for n, r in self._replicas.items()
             if r.routable and n != exclude
@@ -185,19 +193,25 @@ class FleetRouter:
         if not candidates:
             return None
         warm = [r for r in candidates if r.is_warm(key)]
-        pool = warm if warm else candidates
-        best = min(pool, key=lambda r: r.load)
-        if warm and self.spill_load is not None:
-            emptiest = min(candidates, key=lambda r: r.load)
-            if best.load - emptiest.load >= self.spill_load:
-                # warm affinity loses at the margin: spill to the
-                # emptiest peer, which warms up and splits the family
-                best = emptiest
-                warm = [best] if best.is_warm(key) else []
+        if prefer_emptiest:
+            best = min(candidates, key=lambda r: r.load)
+            warm = [best] if best.is_warm(key) else []
+        else:
+            pool = warm if warm else candidates
+            best = min(pool, key=lambda r: r.load)
+            if warm and self.spill_load is not None:
+                emptiest = min(candidates, key=lambda r: r.load)
+                if best.load - emptiest.load >= self.spill_load:
+                    # warm affinity loses at the margin: spill to the
+                    # emptiest peer, which warms up and splits the
+                    # family
+                    best = emptiest
+                    warm = [best] if best.is_warm(key) else []
         best.load += 1
         best.warm.add(key)
         send_fleet("router.placed", {
             "jid": jid, "replica": best.name,
             "key": [str(k) for k in key], "warm": bool(warm),
+            "emptiest": bool(prefer_emptiest),
         })
         return best.name, bool(warm)

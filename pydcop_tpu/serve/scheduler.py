@@ -240,6 +240,13 @@ class BucketWorker:
         #: the SAME tag (None = regular traffic), so bisected suspect
         #: groups cannot re-contaminate healthy buckets
         self.isolate_key: Optional[str] = None
+        #: deadline-pressure scaling of the chunk clamp (the SLO
+        #: ladder's rung-2 lever, SolveService.set_deadline_pressure):
+        #: < 1 makes deadline lanes below ``pressure_exempt_priority``
+        #: see only that fraction of their remaining budget, so they
+        #: hit chunk boundaries — the only admission points — sooner
+        self.deadline_pressure: float = 1.0
+        self.pressure_exempt_priority: Optional[int] = None
 
     # -- occupancy ----------------------------------------------------------
 
@@ -366,9 +373,13 @@ class BucketWorker:
                 continue
             n = min(self.chunk, self.limit - lane.age)
             if lane.job.deadline_at is not None:
-                n2 = clamp_chunk_to_deadline(
-                    n, self.rate, lane.job.deadline_at - now
-                )
+                remaining = lane.job.deadline_at - now
+                if self.deadline_pressure < 1.0 and (
+                    self.pressure_exempt_priority is None
+                    or lane.job.priority < self.pressure_exempt_priority
+                ):
+                    remaining *= self.deadline_pressure
+                n2 = clamp_chunk_to_deadline(n, self.rate, remaining)
                 if n2 < n:
                     self.counters.inc("deadline_shrunk_lanes")
                 n = n2

@@ -186,10 +186,12 @@ class ServeJob:
                 self.events.put_nowait({"event": event, **payload})
             except queue.Full:
                 # slow consumer: drop, never block solve — but COUNT
-                # the drop and tell the stream once that it is lossy,
-                # so a starved consumer is an alert, not a mystery
+                # the drop against the TENANT (the twin's SLO report
+                # charges a lossy gold stream against attainment) and
+                # tell the stream once that it is lossy, so a starved
+                # consumer is an alert, not a mystery
                 if self.counters is not None:
-                    self.counters.inc("events_dropped")
+                    self.counters.drop_event(self.tenant)
                 if not self.lossy_notified:
                     self.lossy_notified = True
                     send_serve("stream.lossy", {"jid": self.jid})
@@ -313,6 +315,9 @@ class SolveService:
             if heartbeat_path is not None else None
         )
         self._stall_until = 0.0  # injected stall gate (stall_replica)
+        #: (factor, exempt_priority) applied to every bucket's
+        #: deadline-chunk clamp — the SLO ladder's rung-2 lever
+        self._deadline_pressure: Tuple[float, Optional[int]] = (1.0, None)
         if journal_dir:
             os.makedirs(os.path.join(journal_dir, CKPT_SUBDIR),
                         exist_ok=True)
@@ -372,6 +377,26 @@ class SolveService:
         self._failure = RuntimeError("replica halted (injected kill)")
         self._stop = True
         self._wake.set()
+
+    def set_deadline_pressure(self, factor: float,
+                              exempt_priority: Optional[int] = None
+                              ) -> None:
+        """Tighten (or relax) the deadline-driven chunk shrinking of
+        every bucket: lanes whose job has a deadline see only
+        ``factor`` of their remaining budget when
+        :func:`~pydcop_tpu.algorithms.base.clamp_chunk_to_deadline`
+        sizes their next chunk, so they reach chunk boundaries — the
+        service's only admission/completion points — sooner.  Jobs at
+        priority >= ``exempt_priority`` are exempt (the SLO ladder
+        clamps silver/bronze lanes while gold runs full chunks;
+        docs/scenarios.rst "The SLO guardrail ladder").  ``factor=1``
+        restores normal behavior.  Applies to current buckets and
+        every bucket opened later."""
+        with self._lock:
+            self._deadline_pressure = (float(factor), exempt_priority)
+            for w in self._workers:
+                w.deadline_pressure = float(factor)
+                w.pressure_exempt_priority = exempt_priority
 
     def stall_for(self, duration: float) -> None:
         """Wedge the NEXT scheduler tick for ``duration`` seconds (the
@@ -1235,6 +1260,9 @@ class SolveService:
             )
             return jobs[1:]
         w.isolate_key = head.isolate_key
+        w.deadline_pressure, w.pressure_exempt_priority = (
+            self._deadline_pressure
+        )
         self._workers.append(w)
         self.counters.inc("buckets_opened")
         send_serve("bucket.opened", {

@@ -1,0 +1,106 @@
+"""CLI surface of the city-scale digital twin: ``pydcop_tpu twin``.
+
+The fast test is a tiny clean twin (no chaos, no churn): the JSON
+scorecard parses, every tier is accounted, nothing is shed, the
+ladder never needed to engage.
+
+``make twin-smoke`` is the slow-marked acceptance scenario (ISSUE 12
+satellite): 2 replicas, 3 tiers, 10 live mutations, 1 injected
+kill_replica — asserting a finite RTO, ZERO gold deadline misses,
+zero churn retraces, and the guardrail ladder engaged AND released
+(the bronze tier's unmeetable deadline forces the engagement; the
+post-shed drain clears it).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "PYTHONPATH": REPO,
+}
+
+
+def run_cli(*args, timeout=420):
+    return subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu", *args],
+        capture_output=True, text=True, timeout=timeout, env=ENV,
+        cwd=REPO,
+    )
+
+
+class TestTwinCli:
+    def test_small_clean_twin(self):
+        proc = run_cli(
+            "twin", "--jobs", "6", "--replicas", "2", "--lanes", "2",
+            "--no-chaos", "--no-churn", "--seed", "3",
+            "--max-cycles", "80",
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout)
+        assert out["status"] == "FINISHED"
+        assert out["jobs"] == 6
+        assert out["shed_rate"] == 0.0
+        assert set(out["tiers"]) == {"gold", "silver", "bronze"}
+        assert sum(t["scored"] for t in out["tiers"].values()) == 6
+        assert out["ladder"]["enabled"]
+        assert out["fleet"]["replicas_down"] == 0
+        assert out["slo"]["jobs_scored"] == 6
+
+    def test_no_ladder_flag(self):
+        proc = run_cli(
+            "twin", "--jobs", "4", "--replicas", "1", "--lanes", "2",
+            "--no-chaos", "--no-churn", "--no-ladder", "--seed", "3",
+            "--max-cycles", "60",
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout)
+        assert not out["ladder"]["enabled"]
+        assert out["slo"]["ladder_escalations"] == 0
+
+
+@pytest.mark.slow
+class TestTwinSmoke:
+    def test_twin_smoke_full_scenario(self):
+        """The ISSUE 12 smoke: 2 replicas, 3 tiers, 10 mutations, 1
+        kill — finite RTO, zero gold deadline misses, ladder
+        engaged-and-released, zero churn retraces."""
+        proc = run_cli(
+            "twin", "--jobs", "12", "--replicas", "2", "--lanes", "2",
+            "--mutations", "10", "--live-vars", "100",
+            "--seed", "1", "--max-cycles", "120",
+            "--kill-tick", "6",
+            # bronze's unmeetable budget forces the engagement the
+            # smoke asserts; gold stays generous so the pin is strict
+            "--gold-deadline", "60", "--silver-deadline", "60",
+            "--bronze-deadline", "0.0001",
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout)
+        assert out["status"] == "FINISHED"
+        # zero gold deadline misses
+        gold = out["tiers"]["gold"]
+        if gold["scored"]:
+            assert gold["misses"] == 0, gold
+            assert gold["attainment"] == 1.0
+        # the injected kill recovered with a finite RTO (or had no
+        # orphans to re-seat — then nothing was in flight, which the
+        # reseats counter distinguishes)
+        assert out["fleet"]["replicas_down"] == 1
+        if out["fleet"]["jobs_reseated"]:
+            assert out["rto_max_s"] is not None
+            assert out["rto_max_s"] > 0
+        # ladder engaged AND released
+        assert out["ladder"]["engaged"], out["slo"]
+        assert out["ladder"]["released"], out["ladder"]
+        assert out["ladder"]["final_rung"] == 0
+        # churn ran warm: 10 mutations' events, zero retraces
+        assert out["churn"]["mutations_applied"] > 0
+        assert out["churn"]["repair_retraces"] == 0
+        assert len(out["recover_s"]) > 0

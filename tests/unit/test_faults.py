@@ -95,6 +95,127 @@ class TestFaultPlan:
             FaultPlan.from_dict({"seed": 1})
 
 
+class TestFaultKindCatalog:
+    """ISSUE 12 satellite: ONE fault-kind catalog.  KIND_FIELDS is the
+    machine-readable half; the table in docs/resilience.rst
+    ("Fault-kind catalog") is the human half; FaultPlan.validate()
+    enforces it.  These pins catch the next PR that adds a kind
+    without documenting it (or documents one it never wired up)."""
+
+    #: a minimal valid spec per kind (required fields only)
+    MINIMAL = {
+        "kill_rank": {"rank": 0},
+        "stall_rank": {"rank": 0, "duration": 1.0},
+        "kill_agent": {"agent": "a1"},
+        "corrupt_checkpoint": {},
+        "truncate_checkpoint": {},
+        "raise_in_step": {"jid": "job-000001"},
+        "nan_lane": {},
+        "torn_journal_write": {},
+        "stall_tick": {"duration": 0.1},
+        "edit_factor": {"constraint": "c1"},
+        "remove_agent_burst": {"count": 2},
+        "add_agent_burst": {"count": 1},
+        "kill_replica": {"replica": 0},
+        "stall_replica": {"replica": 1, "duration": 0.5},
+        "partition_replica": {"replica": 0, "duration": 1.0},
+    }
+
+    def _docs_section(self):
+        path = os.path.join(os.path.dirname(__file__), "..", "..",
+                            "docs", "resilience.rst")
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        start = text.index("Fault-kind catalog")
+        end = text.index("Watchdog and backoff")
+        return text[start:end]
+
+    def test_catalog_covers_every_kind(self):
+        from pydcop_tpu.runtime.faults import KIND_FIELDS, KINDS
+
+        assert set(KIND_FIELDS) == set(KINDS)
+
+    def test_minimal_specs_cover_every_kind(self):
+        from pydcop_tpu.runtime.faults import KINDS
+
+        assert set(self.MINIMAL) == set(KINDS)
+
+    def test_every_kind_roundtrips_through_yaml(self, tmp_path):
+        """Every documented kind, written as YAML with exactly its
+        catalog fields, loads + validates + survives the env/json
+        channel byte-for-byte."""
+        import yaml
+
+        from pydcop_tpu.runtime.faults import KINDS
+
+        spec = {"seed": 3, "faults": [
+            {"kind": k, "cycle": i, **self.MINIMAL[k]}
+            for i, k in enumerate(sorted(KINDS))
+        ]}
+        p = tmp_path / "catalog.yaml"
+        p.write_text(yaml.safe_dump(spec))
+        plan = FaultPlan.from_yaml(str(p))  # from_yaml validates
+        assert plan.validate() == sorted(KINDS)
+        again = FaultPlan.from_json(plan.to_json())
+        assert [f.to_dict() for f in again.faults] == \
+               [f.to_dict() for f in plan.faults]
+
+    def test_every_kind_documented_and_nothing_else(self):
+        """The docs table names exactly the catalog's kinds, and every
+        kind's row names every field the catalog allows for it."""
+        import re
+
+        from pydcop_tpu.runtime.faults import KIND_FIELDS, KINDS
+
+        section = self._docs_section()
+        documented = set(re.findall(r"``([a-z_]+)``", section)) & {
+            *KINDS,
+            # a doc token that LOOKS like a kind but is not one would
+            # land here and fail the equality below
+        }
+        assert documented == set(KINDS), (
+            "docs/resilience.rst fault-kind table out of sync with "
+            "runtime.faults.KINDS"
+        )
+        rows = section.split("* - ``")
+        for kind in KINDS:
+            row = next(r for r in rows if r.startswith(kind + "``"))
+            for field in KIND_FIELDS[kind]:
+                assert f"``{field}``" in row, (
+                    f"docs row for {kind} does not name its "
+                    f"``{field}`` field"
+                )
+
+    def test_validate_rejects_misaddressed_fields(self):
+        plan = FaultPlan(faults=[
+            Fault(kind="stall_tick", duration=0.1, rank=3),
+        ])
+        with pytest.raises(ValueError, match="never consumes"):
+            plan.validate()
+        plan = FaultPlan(faults=[
+            Fault(kind="kill_replica", replica=0, agent="a1"),
+        ])
+        with pytest.raises(ValueError, match="never consumes"):
+            plan.validate()
+        # duration on a kind that never reads it
+        plan = FaultPlan(faults=[
+            Fault(kind="kill_rank", rank=0, duration=2.0),
+        ])
+        with pytest.raises(ValueError, match="never consumes"):
+            plan.validate()
+
+    def test_from_yaml_validates(self, tmp_path):
+        p = tmp_path / "bad.yaml"
+        p.write_text(
+            "faults:\n"
+            "  - kind: stall_tick\n"
+            "    duration: 0.5\n"
+            "    rank: 1\n"
+        )
+        with pytest.raises(ValueError, match="never consumes"):
+            FaultPlan.from_yaml(str(p))
+
+
 class TestRankFaultInjector:
     def _plan(self, **kw):
         return FaultPlan(faults=[Fault(**kw)])

@@ -21,8 +21,12 @@ from pydcop_tpu.generators import (
     generate_ising,
     generate_meeting_scheduling,
     generate_meetings_peav,
+    generate_routing,
+    generate_scenario,
     generate_secp,
     generate_smallworld,
+    generate_tracking,
+    tracking_scenario,
 )
 
 FAMILIES = {
@@ -41,6 +45,11 @@ FAMILIES = {
     "meetings_peav": lambda seed: generate_meetings_peav(
         slots_count=4, events_count=3, resources_count=3,
         max_resources_event=2, seed=seed)[0],
+    "routing": lambda seed: generate_routing(10, n_slots=4, seed=seed),
+    "routing_infeasible": lambda seed: generate_routing(
+        8, n_slots=4, infeasible=True, seed=seed),
+    "tracking": lambda seed: generate_tracking(
+        16, n_targets=2, seed=seed),
 }
 
 
@@ -74,3 +83,58 @@ class TestGeneratorDeterminism:
 
         assert build(5) == build(5)
         assert build(5) != build(6)
+
+
+def _scenario_canon(scenario):
+    """Canonical byte string of a scenario's event stream — order,
+    ids, delays and every action's full parameter set."""
+    return repr([
+        (e.id, e.delay,
+         [(a.type, sorted(a.parameters.items())) for a in e.actions])
+        for e in scenario
+    ])
+
+
+class TestScenarioDeterminism:
+    """ISSUE 12 satellite: the twin replays its churn streams from
+    their seeds, so every SCENARIO builder must be byte-deterministic
+    under global-RNG poisoning too — a stream that drifted between a
+    run and its replay would silently change which constraints mutate.
+    """
+
+    def _poison(self, seed):
+        random.seed(seed * 31 + 5)
+        np.random.seed((seed * 7919 + 3) % 2**31)
+
+    def test_generate_scenario_deterministic(self):
+        def build(seed):
+            self._poison(seed)
+            return _scenario_canon(generate_scenario(
+                [f"a{i}" for i in range(8)], n_events=4,
+                removals_per_event=2, seed=seed))
+
+        assert build(3) == build(3)
+        assert build(3) != build(4)
+
+    def test_churn_scenario_deterministic(self):
+        from pydcop_tpu.dcop.scenario import churn_scenario
+
+        def build(seed):
+            self._poison(seed)
+            dcop = generate_graph_coloring(
+                n_variables=10, n_colors=3, n_edges=18, soft=True,
+                seed=1)
+            return _scenario_canon(churn_scenario(
+                dcop, n_events=6, seed=seed))
+
+        assert build(3) == build(3)
+        assert build(3) != build(4)
+
+    def test_tracking_scenario_deterministic(self):
+        def build(seed):
+            self._poison(seed)
+            dcop = generate_tracking(16, n_targets=2, seed=seed)
+            return _scenario_canon(tracking_scenario(dcop, 3))
+
+        assert build(3) == build(3)
+        assert build(3) != build(4)

@@ -1,0 +1,569 @@
+"""City-scale digital twin (ISSUE 12): the new workload families, the
+SLO guardrail ladder, the serve-layer levers it pulls, and the twin
+runner end to end.
+
+Acceptance pins carried here:
+
+* routing instances provably exercise CEC pruning — nonzero pruned
+  wire bytes in ``metrics()["dpop"]`` — and the infeasible variant is
+  genuinely infeasible (violation >= 1 under an exact solve);
+* tracking instances drive warm repair with ZERO retraces;
+* both families solve end-to-end through ``solve`` AND serve
+  admission;
+* the ladder escalates deterministically, releases with hysteresis,
+  and its three rungs pull real levers (shed / deadline pressure /
+  emptiest placement);
+* a twin run under the combined chaos plan keeps FINISHED jobs
+  bit-identical to standalone solves.
+"""
+import queue
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.generators import (
+    generate_routing,
+    generate_tracking,
+    tracking_scenario,
+)
+from pydcop_tpu.generators.routing import HARD_COST, is_infeasible_cost
+from pydcop_tpu.generators.tracking import (
+    moved_constraint,
+    step_mutations,
+    target_positions,
+)
+from pydcop_tpu.runtime.events import event_bus
+from pydcop_tpu.runtime.faults import Fault, FaultPlan
+from pydcop_tpu.runtime.run import solve_result
+from pydcop_tpu.runtime.stats import SloCounters
+from pydcop_tpu.scenario import (
+    JobScore,
+    SloLadder,
+    TierSpec,
+    TwinRunner,
+    build_twin_traffic,
+    default_chaos_plan,
+    default_tiers,
+    standalone_results,
+)
+
+
+# ---------------------------------------------------------------------------
+# routing: hard-constraint density, infeasibility, CEC pruning
+# ---------------------------------------------------------------------------
+
+
+class TestRoutingFamily:
+    def test_hard_cost_matches_exact_engine_sentinel(self):
+        from pydcop_tpu.ops.dpop_sweep import BIG
+
+        assert HARD_COST == BIG
+
+    def test_feasible_instance_solves_clean(self):
+        dcop = generate_routing(10, n_slots=4, seed=3)
+        res = solve_result(dcop, "dpop")
+        assert res.status == "FINISHED"
+        assert res.violation == 0
+        assert res.cost < HARD_COST / 4
+        assert not is_infeasible_cost(
+            dcop.solution_cost(res.assignment, 1e12)[1]
+        )
+
+    def test_mgm_end_to_end(self):
+        dcop = generate_routing(12, n_slots=4, seed=5)
+        res = solve_result(dcop, "mgm", cycles=80)
+        assert res.status == "FINISHED"
+        assert res.violation == 0
+
+    def test_infeasible_variant_is_genuinely_infeasible(self):
+        """k tasks on k-1 equal slots: by pigeonhole NO assignment
+        avoids a hard violation — the exact optimum carries >= 1
+        violation and a raw cost >= BIG."""
+        bad = generate_routing(10, n_slots=4, infeasible=True, seed=3)
+        res = solve_result(bad, "dpop")
+        assert res.violation >= 1
+        raw = bad.solution_cost(res.assignment, 1e12)[1]
+        assert is_infeasible_cost(raw)
+
+    def test_rejects_silent_pigeonhole(self):
+        with pytest.raises(ValueError, match="infeasible"):
+            generate_routing(10, n_slots=3, tasks_per_resource=4)
+
+    def test_cec_pruning_fires_on_the_wire(self):
+        """The acceptance pin: a routing instance solved through the
+        separator-sharded sweep ships a PRUNED wire — nonzero pruned
+        bytes, strictly below dense — and stays bit-identical to the
+        single-device sweep (pruning is sound)."""
+        dcop = generate_routing(10, n_slots=4, seed=3)
+        ref = solve_result(dcop, "dpop")
+        res = solve_result(
+            dcop, "dpop", algo_params={"engine": "sharded", "shards": 2},
+        )
+        m = res.metrics()["dpop"]
+        assert m["engine"] == "sharded"
+        assert m["wire_bytes_pruned"] > 0
+        assert m["wire_bytes_pruned"] < m["wire_bytes_dense"]
+        assert m["pruned_fraction"] > 0
+        assert res.assignment == ref.assignment
+        assert res.cost == ref.cost
+
+
+# ---------------------------------------------------------------------------
+# tracking: seeded walk, local mutations, zero-retrace warm churn
+# ---------------------------------------------------------------------------
+
+
+class TestTrackingFamily:
+    def test_positions_pure_function_of_step(self):
+        a = target_positions(3, 5, seed=7, side=6)
+        b = target_positions(3, 5, seed=7, side=6)
+        assert np.array_equal(a, b)
+        assert not np.array_equal(a, target_positions(3, 6, seed=7,
+                                                      side=6))
+        assert not np.array_equal(a, target_positions(3, 5, seed=8,
+                                                      side=6))
+
+    def test_requires_square_grid(self):
+        with pytest.raises(ValueError, match="square"):
+            generate_tracking(10)
+
+    def test_moved_constraint_same_scope_shape(self):
+        dcop = generate_tracking(16, n_targets=2, seed=5)
+        for name in step_mutations(dcop, 1):
+            new_c = moved_constraint(dcop, name, 1)
+            old = dcop.constraints[name]
+            assert new_c.scope_names == old.scope_names
+            assert (np.asarray(new_c.to_tensor()).shape
+                    == np.asarray(old.to_tensor()).shape)
+
+    def test_mgm_end_to_end(self):
+        dcop = generate_tracking(16, n_targets=2, seed=5)
+        res = solve_result(dcop, "mgm", cycles=60)
+        assert res.status == "FINISHED"
+        assert res.cost < 0  # tracking utility is negated gain
+
+    def test_warm_repair_zero_retraces(self):
+        """The acceptance pin: a tracking target-walk churn stream
+        applied through the WarmRepairController costs ZERO retraces —
+        every step is a fixed-shape EditFactor buffer write."""
+        from pydcop_tpu.runtime.repair import WarmRepairController
+
+        dcop = generate_tracking(16, n_targets=2, seed=9)
+        scen = tracking_scenario(dcop, 4)
+        ctl = WarmRepairController(dcop, "mgm", seed=0)
+        res = ctl.solver.run(chunk=ctl.chunk, cycles=16)
+        ctl.phase_done(res)
+        applied = 0
+        for event in scen:
+            if event.is_delay:
+                continue
+            for action in event.actions:
+                p = action.parameters
+                ctl.edit_factor(moved_constraint(
+                    dcop, p["constraint"], int(p["step"])
+                ))
+                applied += 1
+            res = ctl.solver.run(resume=True, cycles=16,
+                                 chunk=ctl.chunk)
+            ctl.phase_done(res)
+        c = ctl.counters.as_dict()
+        assert applied > 0
+        assert c["mutations_applied"] == applied
+        assert c["repair_retraces"] == 0, c
+        assert c["time_to_recover_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# serve admission: both families through the streaming front door
+# ---------------------------------------------------------------------------
+
+
+class TestServeAdmission:
+    def test_new_families_through_solve_service(self):
+        from pydcop_tpu.batch.cache import CompileCache
+        from pydcop_tpu.serve import SolveService
+
+        routing = generate_routing(10, n_slots=4, seed=3)
+        tracking = generate_tracking(16, n_targets=2, seed=5)
+        svc = SolveService(lanes=2, cache=CompileCache(),
+                           max_cycles=80)
+        jids = [svc.submit(routing, "mgm", seed=0),
+                svc.submit(tracking, "mgm", seed=1)]
+        for _ in range(500):
+            if not svc.tick():
+                break
+        for jid, dcop in zip(jids, (routing, tracking)):
+            res = svc.result(jid, timeout=5)
+            assert res.status == "FINISHED"
+            ref = solve_result(dcop, "mgm",
+                               seed=jids.index(jid))
+            assert res.cost == ref.cost
+            assert res.assignment == ref.assignment
+
+
+# ---------------------------------------------------------------------------
+# the SLO ladder
+# ---------------------------------------------------------------------------
+
+
+def _tiers():
+    return (
+        TierSpec("gold", 2, 10.0, 0.99, 0.25),
+        TierSpec("silver", 1, 5.0, 0.90, 0.25),
+        TierSpec("bronze", 0, 20.0, 0.50, 0.50),
+    )
+
+
+class TestSloLadder:
+    def test_escalates_one_rung_per_breached_eval(self):
+        lad = SloLadder(_tiers(), min_samples=2, hold=2)
+        for _ in range(3):
+            lad.record("silver", False)
+        assert lad.evaluate() == 1 and lad.shed_bronze
+        # windows reset on escalation: no data → no breach → clean
+        assert lad.evaluate() == 1
+        for _ in range(2):
+            lad.record("silver", False)
+        assert lad.evaluate() == 2 and lad.clamp_silver
+        for _ in range(2):
+            lad.record("gold", False)
+        assert lad.evaluate() == 3 and lad.reroute_gold
+        # rung is capped at the top
+        for _ in range(2):
+            lad.record("gold", False)
+        assert lad.evaluate() == 3
+        c = lad.counters.as_dict()
+        assert c["ladder_escalations"] == 3
+        assert c["tier_breaches"] >= 3
+
+    def test_releases_with_hysteresis(self):
+        lad = SloLadder(_tiers(), min_samples=2, hold=3)
+        for _ in range(2):
+            lad.record("silver", False)
+        assert lad.evaluate() == 1
+        # two clean evaluations are not enough (hold=3)
+        assert lad.evaluate() == 1
+        assert lad.evaluate() == 1
+        assert lad.evaluate() == 0
+        assert lad.counters.counts["ladder_deescalations"] == 1
+
+    def test_below_min_samples_never_breaches(self):
+        lad = SloLadder(_tiers(), min_samples=4, hold=2)
+        for _ in range(3):
+            lad.record("gold", False)
+        assert lad.evaluate() == 0
+
+    def test_disabled_ladder_accounts_but_never_moves(self):
+        lad = SloLadder(_tiers(), min_samples=2, enabled=False)
+        for _ in range(4):
+            lad.record("gold", False)
+        assert lad.evaluate() == 0
+        assert lad.counters.counts["tier_breaches"] > 0
+        assert lad.counters.counts["ladder_escalations"] == 0
+
+    def test_events_emitted(self):
+        seen = []
+        cb = lambda t, e: seen.append(t)  # noqa: E731
+        event_bus.enabled = True
+        event_bus.subscribe("slo.*", cb)
+        try:
+            lad = SloLadder(_tiers(), min_samples=2, hold=1)
+            lad.record("silver", False)
+            lad.record("silver", False)
+            lad.evaluate()  # breach + escalate
+            lad.evaluate()  # clean → release (hold=1)
+        finally:
+            event_bus.unsubscribe(cb)
+            event_bus.enabled = False
+        assert "slo.tier.breach" in seen
+        assert "slo.ladder.escalated" in seen
+        assert "slo.ladder.released" in seen
+
+    def test_unknown_slo_counter_rejected(self):
+        with pytest.raises(KeyError):
+            SloCounters().inc("nope")
+
+
+# ---------------------------------------------------------------------------
+# serve-layer levers: deadline pressure, tenant drops, emptiest routing
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlinePressure:
+    def test_pressure_scales_clamp_for_non_exempt_lanes(self):
+        """With pressure f, a non-exempt deadline lane's chunk budget
+        is clamp(remaining * f * rate); an exempt (gold) lane keeps
+        its full budget."""
+        from time import monotonic
+
+        from pydcop_tpu.batch.cache import CompileCache
+        from pydcop_tpu.serve import SolveService
+
+        dcop = generate_routing(10, n_slots=4, seed=3)
+        svc = SolveService(lanes=2, cache=CompileCache(),
+                           max_cycles=400)
+        svc.set_deadline_pressure(1e-6, exempt_priority=2)
+        jid = svc.submit(dcop, "mgm", seed=0, priority=0,
+                         deadline_s=120.0)
+        svc.tick()  # first step measures the rate
+        (w,) = svc._workers
+        assert w.deadline_pressure == 1e-6
+        assert w.pressure_exempt_priority == 2
+        for _ in range(3):
+            svc.tick()
+        # clamped chunks: remaining*1e-6 seconds of budget → 1-cycle
+        # chunks, counted
+        assert svc.counters.counts["deadline_shrunk_lanes"] > 0
+        # restoring pressure lets the job finish normally — and mgm's
+        # coin-free stream makes the result independent of the chunk
+        # boundaries the clamp introduced
+        svc.set_deadline_pressure(1.0)
+        for _ in range(500):
+            if not svc.tick():
+                break
+        res = svc.result(jid, timeout=5)
+        ref = solve_result(dcop, "mgm", seed=0)
+        assert res.status == "FINISHED"
+        assert res.cost == ref.cost
+        assert res.assignment == ref.assignment
+
+    def test_pressure_applies_to_later_buckets_too(self):
+        from pydcop_tpu.batch.cache import CompileCache
+        from pydcop_tpu.serve import SolveService
+
+        svc = SolveService(lanes=2, cache=CompileCache())
+        svc.set_deadline_pressure(0.5, exempt_priority=1)
+        dcop = generate_routing(10, n_slots=4, seed=4)
+        svc.submit(dcop, "mgm", seed=0)
+        svc.tick()
+        (w,) = svc._workers
+        assert w.deadline_pressure == 0.5
+        assert w.pressure_exempt_priority == 1
+
+
+class TestTenantDropAttribution:
+    def test_events_dropped_by_tenant(self):
+        from pydcop_tpu.runtime.stats import ServeCounters
+        from pydcop_tpu.serve.service import ServeJob
+
+        counters = ServeCounters()
+        job = ServeJob(
+            jid="j1", dcop=None, algo="mgm", algo_params={}, seed=0,
+            tenant="gold", priority=2, deadline_s=None,
+            deadline_at=None, label=None, source_file=None,
+            stream=True, submitted_at=0.0, seq=1, counters=counters,
+        )
+        job.events = queue.Queue(maxsize=1)
+        job.emit("job.progress", {"cycle": 1})
+        job.emit("job.progress", {"cycle": 2})  # dropped
+        job.emit("job.progress", {"cycle": 3})  # dropped
+        assert counters.counts["events_dropped"] == 2
+        assert counters.as_dict()["events_dropped_by_tenant"] == {
+            "gold": 2
+        }
+
+    def test_surfaced_in_service_metrics(self):
+        from pydcop_tpu.batch.cache import CompileCache
+        from pydcop_tpu.serve import SolveService
+
+        svc = SolveService(lanes=2, cache=CompileCache())
+        svc.counters.drop_event("gold")
+        m = svc.metrics()["serve"]
+        assert m["events_dropped_by_tenant"] == {"gold": 1}
+
+
+class TestEmptiestPlacement:
+    def test_prefer_emptiest_beats_warm_affinity(self):
+        from pydcop_tpu.serve.router import FleetRouter
+
+        r = FleetRouter()
+        r.add_replica("replica-0")
+        r.add_replica("replica-1")
+        key = ("mgm", (), "constraints_hypergraph", (2,))
+        r.note_warm("replica-0", key)
+        for _ in range(3):
+            r.job_placed("replica-0")
+        # warm-first policy sticks to the loaded warm replica
+        name, warm = r.place(key)
+        assert name == "replica-0" and warm
+        r.job_finished("replica-0")
+        # emptiest policy ignores warmth: the idle cold peer wins
+        name, warm = r.place(key, prefer_emptiest=True)
+        assert name == "replica-1" and not warm
+
+    def test_emptiest_skips_unhealthy(self):
+        from pydcop_tpu.serve.router import FleetRouter
+
+        r = FleetRouter()
+        r.add_replica("replica-0")
+        r.add_replica("replica-1")
+        r.set_stalled("replica-1", True)  # emptiest but unhealthy
+        r.job_placed("replica-0")
+        key = ("mgm", (), "constraints_hypergraph", (2,))
+        name, _warm = r.place(key, prefer_emptiest=True)
+        assert name == "replica-0"
+
+    def test_fleet_placement_and_pressure_passthrough(self):
+        from pydcop_tpu.serve import SolveFleet
+
+        dcop = generate_routing(10, n_slots=4, seed=3)
+        fleet = SolveFleet(replicas=2, lanes=2, max_cycles=80)
+        try:
+            fleet.set_deadline_pressure(0.25, exempt_priority=2)
+            for h in fleet._handles.values():
+                assert h.service._deadline_pressure == (0.25, 2)
+            jid = fleet.submit(dcop, "mgm", seed=0,
+                               placement="emptiest")
+            for _ in range(500):
+                if not fleet.tick():
+                    break
+            res = fleet.result(jid, timeout=5)
+            assert res.status == "FINISHED"
+        finally:
+            fleet.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# the twin runner
+# ---------------------------------------------------------------------------
+
+
+def _small_jobs(n=6, seed=7, tiers=None):
+    return build_twin_traffic(
+        n, tiers if tiers is not None else default_tiers(),
+        seed=seed, coloring_vars=24, routing_tasks=8,
+        tracking_sensors=9,
+    )
+
+
+class TestTwinTraffic:
+    def test_schedule_is_seeded_deterministic(self):
+        a = _small_jobs(8, seed=3)
+        b = _small_jobs(8, seed=3)
+        assert [(j.tier, j.arrival_tick, j.family) for j in a] == \
+               [(j.tier, j.arrival_tick, j.family) for j in b]
+        c = _small_jobs(8, seed=4)
+        assert [(j.tier, j.arrival_tick) for j in a] != \
+               [(j.tier, j.arrival_tick) for j in c]
+
+    def test_families_cycle_and_tiers_follow_shares(self):
+        jobs = _small_jobs(9, seed=1)
+        assert {j.family for j in jobs} == {
+            "routing", "tracking", "coloring"
+        }
+        assert all(j.tier in ("gold", "silver", "bronze")
+                   for j in jobs)
+
+
+class TestTwinRunner:
+    def test_clean_run_scores_everything(self):
+        tiers = default_tiers()
+        jobs = _small_jobs(6, seed=7, tiers=tiers)
+        twin = TwinRunner(jobs, tiers, replicas=2, lanes=2,
+                          max_cycles=80)
+        card = twin.run(max_ticks=600)
+        assert all(j.scored for j in twin.jobs)
+        assert card["jobs"] == 6
+        assert card["shed_rate"] == 0.0
+        total = sum(
+            t["scored"] for t in card["tiers"].values()
+        )
+        assert total == 6
+        assert card["ladder"]["enabled"]
+        assert card["fleet"]["replicas_down"] == 0
+
+    def test_chaos_run_bitmatches_standalone(self):
+        """The acceptance pin: under the combined chaos plan (kill +
+        serve faults + churn), every FINISHED job equals its
+        standalone solve bit for bit."""
+        tiers = default_tiers()
+        jobs = _small_jobs(6, seed=11, tiers=tiers)
+        live = generate_tracking(16, n_targets=2, seed=12)
+        scen = tracking_scenario(live, 3)
+        plan = default_chaos_plan(seed=5, kill_tick=4)
+        twin = TwinRunner(jobs, tiers, replicas=2, lanes=2,
+                          max_cycles=80, fault_plan=plan,
+                          live_dcop=live, live_scenario=scen)
+        card = twin.run(max_ticks=800)
+        assert card["fleet"]["replicas_down"] == 1
+        assert card["fleet"]["faults_injected"] >= 1
+        base = standalone_results(jobs, max_cycles=80)
+        checked = 0
+        for label, res in twin.results.items():
+            if res.status != "FINISHED":
+                continue
+            checked += 1
+            assert res.cost == base[label].cost, label
+            assert res.assignment == base[label].assignment, label
+        assert checked > 0
+        # churn ran warm with zero retraces
+        assert card["churn"]["repair_retraces"] == 0
+        assert card["churn"]["mutations_applied"] > 0
+
+    def test_ladder_rungs_pull_their_levers(self):
+        """Force engagement with an unmeetable bronze deadline: the
+        ladder must shed later bronze arrivals (rung 1), and release
+        after the pressure clears."""
+        tiers = (
+            TierSpec("gold", 2, 30.0, 0.99, 0.2),
+            TierSpec("silver", 1, 30.0, 0.90, 0.2),
+            TierSpec("bronze", 0, 0.0001, 0.50, 0.6),
+        )
+        rng_jobs = _small_jobs(12, seed=2, tiers=tiers)
+        twin = TwinRunner(
+            rng_jobs, tiers, replicas=2, lanes=2, max_cycles=80,
+            ladder_min_samples=2, ladder_hold=2, ladder_window=6,
+        )
+        card = twin.run(max_ticks=800)
+        assert card["ladder"]["engaged"], card["slo"]
+        assert card["slo"]["ladder_escalations"] >= 1
+        # the run drains after the last completion: hysteresis clears
+        assert card["ladder"]["released"], card["ladder"]
+        assert card["ladder"]["final_rung"] == 0
+        bronze = card["tiers"]["bronze"]
+        if bronze["shed"]:
+            assert card["slo"]["bronze_sheds"] == bronze["shed"]
+
+    def test_scorecard_math(self):
+        from pydcop_tpu.scenario import scorecard
+
+        tiers = default_tiers()
+        counters = SloCounters()
+        scores = [
+            JobScore("a", "gold", "gold", "FINISHED", 0.5, 30.0, True),
+            JobScore("b", "gold", "gold", "TIMEOUT", 31.0, 30.0,
+                     False),
+            JobScore("c", "bronze", "bronze", "SHED", None, 20.0,
+                     False, shed=True),
+        ]
+        card = scorecard(scores, tiers, counters, [0.02], [0.1, 0.3])
+        assert card["tiers"]["gold"]["attainment"] == 0.5
+        assert card["tiers"]["bronze"]["shed"] == 1
+        assert card["tiers"]["bronze"]["attainment"] is None
+        assert card["shed_rate"] == pytest.approx(1 / 3, abs=1e-4)
+        assert card["rto_max_s"] == 0.02
+        assert card["recover_s_mean"] == pytest.approx(0.2)
+
+
+# ---------------------------------------------------------------------------
+# chaos-plan churn kinds through the twin
+# ---------------------------------------------------------------------------
+
+
+class TestTwinChurnFaults:
+    def test_edit_factor_fault_applies_warm(self):
+        tiers = default_tiers()
+        jobs = _small_jobs(3, seed=4, tiers=tiers)
+        live = generate_tracking(9, n_targets=2, seed=6)
+        plan = FaultPlan(faults=[
+            Fault(kind="edit_factor", cycle=1),
+            Fault(kind="remove_agent_burst", cycle=2, count=1),
+        ], seed=9)
+        twin = TwinRunner(jobs, tiers, replicas=1, lanes=2,
+                          max_cycles=60, fault_plan=plan,
+                          live_dcop=live, churn_start=1,
+                          churn_every=1)
+        card = twin.run(max_ticks=600)
+        assert card["churn"]["mutations_applied"] >= 1
+        assert card["churn"]["repair_retraces"] == 0
+        assert len(card["recover_s"]) >= 2

@@ -165,6 +165,19 @@ def test_events_are_pushed(served_orchestrator):
       "checkpoint": True}),
     ("fleet.recovery.done", "fleet",
      {"replica": "replica-0", "jobs": 3, "rto_s": 0.42}),
+    ("slo.tier.breach", "slo",
+     {"tier": "silver", "attainment": 0.75, "floor": 0.9}),
+    ("slo.ladder.escalated", "slo",
+     {"rung": 1, "rung_name": "shed_bronze", "tiers": ["silver"]}),
+    ("slo.ladder.released", "slo",
+     {"rung": 0, "rung_name": "normal"}),
+    ("slo.shed.bronze", "slo", {"label": "coloring:bronze:7"}),
+    ("slo.clamp.silver", "slo",
+     {"pressure": 0.5, "exempt_priority": 2}),
+    ("slo.reroute.gold", "slo", {"label": "routing:gold:4"}),
+    ("slo.scorecard", "slo",
+     {"tiers": {"gold": {"attainment": 1.0, "p99_ms": 412.0}},
+      "shed_rate": 0.1, "rto_max_s": 0.03}),
     ("batch.bucket.formed", "batch", {"algo": "mgm", "size": 3}),
     ("harness.run.done", "harness", {"algo": "mgm", "cycle": 21}),
     ("dpop.shard.plan", "dpop",
