@@ -4,7 +4,7 @@
 	bench-batch batch-smoke bench-harness bench-sharded bench-serve \
 	serve-smoke chaos-smoke bench-churn churn-smoke bench-dpop \
 	dpop-smoke bench-auto portfolio-smoke bench-fleet fleet-smoke \
-	bench-twin twin-smoke bench-r06
+	bench-twin twin-smoke bench-r06 analyze
 
 test: all-tests
 
@@ -26,6 +26,21 @@ all-tests:
 
 bench:
 	python bench.py
+
+# the static-analysis guard tier (ISSUE 13): audit every registered
+# engine×mode cycle program against its DECLARED ProgramBudget
+# (collectives per cycle, payload bytes, host callbacks, dtype tier,
+# embedded constants, donation — docs/analysis.rst), then lint the
+# tree for tracer-hostile calls in cycle/chunk code and lock-
+# discipline races in the serving tier.  Exits nonzero on ANY
+# finding; fast enough to run next to the smokes (seconds, no
+# solves — the registry audits SHAPE on tiny instances).  Runtime
+# recorded in BENCHREF.md "Program auditor".
+analyze:
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m pydcop_tpu analyze program
+	JAX_PLATFORMS=cpu python -m pydcop_tpu analyze lint
 
 # calibration probe + sharded local-search micro-bench only: a
 # minutes-long spot check of the lane-packed move-rule rate with its

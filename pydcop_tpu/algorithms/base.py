@@ -152,6 +152,77 @@ def default_chunk(
     return chunk
 
 
+#: dtype tier of the single-device harness programs: f32 math, int
+#: assignments/indices, uint32 PRNG streams, bool masks.  A silent
+#: f32→f64 upcast (or an over-tier constant) breaks the audit — the
+#: PGMax-style memory discipline (arXiv:2202.04110) made checkable.
+HARNESS_DTYPES = frozenset({
+    "float32", "int32", "uint32", "bool", "int8",
+    # typed PRNG key avals materialized by split/fold_in inside the
+    # traced chunk (uint32 storage; not an upcast)
+    "key<fry>",
+})
+
+#: slack on top of the measured tensor footprint for the small
+#: structural constants a traced chunk legitimately bakes (iota rows,
+#: scan bounds, domain masks)
+CONST_SLACK_BYTES = 1 << 16
+
+
+def tensor_const_bytes(obj) -> int:
+    """Total bytes of the arrays reachable from a tensors object —
+    what a cycle closure may bake into the executable as constants.
+    The declared ``max_const_bytes`` of the cold engines is this plus
+    :data:`CONST_SLACK_BYTES`; the warm engines subtract the operand
+    pytree (their tables travel as ARGUMENTS — PR 8's zero-retrace
+    contract, auditable via ``pydcop_tpu analyze program``)."""
+    seen = set()
+    total = 0
+
+    def walk(o):
+        nonlocal total
+        if id(o) in seen:
+            return
+        seen.add(id(o))
+        if hasattr(o, "nbytes") and hasattr(o, "dtype"):
+            total += int(o.nbytes)
+            return
+        if isinstance(o, (list, tuple)):
+            for x in o:
+                walk(x)
+            return
+        if isinstance(o, dict):
+            for x in o.values():
+                walk(x)
+            return
+        if dataclasses.is_dataclass(o) and not isinstance(o, type):
+            for f in dataclasses.fields(o):
+                walk(getattr(o, f.name))
+
+    walk(obj)
+    return total
+
+
+def harness_budget(max_const_bytes: int,
+                   dtypes=HARNESS_DTYPES) -> "ProgramBudget":
+    """The single-device chunk-runner budget: NO collectives, NO host
+    callbacks (PR 4's no-host-round-trip-per-cycle contract), donated
+    state buffers, one dtype tier."""
+    from pydcop_tpu.analysis.budget import (
+        COLLECTIVE_KINDS,
+        ProgramBudget,
+    )
+
+    return ProgramBudget(
+        collectives={k: 0 for k in COLLECTIVE_KINDS},
+        max_collective_bytes=0,
+        max_host_callbacks=0,
+        dtypes=dtypes,
+        max_const_bytes=int(max_const_bytes),
+        donate=True,
+    )
+
+
 def donation_supported() -> bool:
     """True where ``donate_argnums`` actually buys in-place buffer
     reuse.  On the CPU backend donation is a no-op that logs a warning
@@ -291,6 +362,17 @@ class SynchronousTensorSolver:
         the repair layer's retrace metric: a warm in-place mutation must
         add ZERO (pinned in tests/unit/test_warm_repair.py)."""
         return sum(self._masked_trace_counts.values())
+
+    def program_budget(self):
+        """Declared per-cycle budget of this solver's chunk runner
+        (audited by the ``pydcop_tpu.analysis`` registry sweep): no
+        collectives, no host callbacks, the f32 tier, and constants
+        bounded by the baked tensor footprint — cold solvers close
+        over their tables by design; warm solvers override this with
+        an operand-sized discount (algorithms/warm.py)."""
+        return harness_budget(
+            tensor_const_bytes(self.tensors) + CONST_SLACK_BYTES
+        )
 
     # -- convergence --------------------------------------------------------
 

@@ -77,6 +77,24 @@ class _WarmMixin:
         state = getattr(self, "_last_state", None)
         return state[-1] if state is not None else self.operands
 
+    def program_budget(self):
+        """Warm budget: the whole point of operand-carried state is
+        that the mutable tables (cost tensors, masks, unary rows,
+        edge wiring) are runner ARGUMENTS, not baked constants — so
+        the declared constant budget is the cold footprint MINUS the
+        operand pytree.  A regression that re-bakes a cost table
+        (breaking PR 8's zero-retrace mutation contract) blows this
+        cap in the audit sweep."""
+        from pydcop_tpu.algorithms.base import (
+            CONST_SLACK_BYTES,
+            harness_budget,
+            tensor_const_bytes,
+        )
+
+        baked = (tensor_const_bytes(self.tensors)
+                 - tensor_const_bytes(self.operands))
+        return harness_budget(max(0, baked) + CONST_SLACK_BYTES)
+
     def _sync_host(self, ops: Dict) -> None:
         """Mirror the operand leaves back onto ``self.tensors`` so host
         consumers (checkpoint shape checks, metrics, cold comparisons)

@@ -1,0 +1,50 @@
+"""Static analysis tier: declared performance budgets + source lint.
+
+Three pieces (ISSUE 13):
+
+* :mod:`pydcop_tpu.analysis.budget` — :class:`ProgramBudget`, the
+  per-engine declaration of what a compiled cycle program may contain
+  (collective counts/payload, host callbacks, dtype tier, embedded
+  constants, donation), failing loudly on undeclared fields;
+* :mod:`pydcop_tpu.analysis.auditor` — :func:`audit_program`, which
+  traces a cycle function, walks the jaxpr/StableHLO, and checks the
+  measured footprint against the declaration;
+* :mod:`pydcop_tpu.analysis.registry` — the engine×mode cell matrix
+  swept by ONE parametrized test and by ``pydcop_tpu analyze
+  program``;
+* :mod:`pydcop_tpu.analysis.lint` — the AST rules for tracer-hostile
+  calls in cycle/chunk code and lock-discipline races in the serving
+  tier, with inline reasoned waivers.
+
+``make analyze`` runs the program sweep + the lint and exits nonzero
+on any finding (docs/analysis.rst).
+"""
+from pydcop_tpu.analysis.auditor import audit_program
+from pydcop_tpu.analysis.budget import (
+    COLLECTIVE_KINDS,
+    AuditReport,
+    BudgetUndeclared,
+    Finding,
+    ProgramBudget,
+    UNDECLARED,
+)
+from pydcop_tpu.analysis.lint import (
+    LINT_RULES,
+    LintFinding,
+    lint_paths,
+    lint_source,
+)
+
+__all__ = [
+    "COLLECTIVE_KINDS",
+    "AuditReport",
+    "BudgetUndeclared",
+    "Finding",
+    "LINT_RULES",
+    "LintFinding",
+    "ProgramBudget",
+    "UNDECLARED",
+    "audit_program",
+    "lint_paths",
+    "lint_source",
+]

@@ -1,0 +1,302 @@
+"""Program auditor: measure a compiled cycle program against its budget.
+
+:func:`audit_program` traces a cycle function with
+``jax.make_jaxpr``, recursively walks the jaxpr (into scan/cond/pjit/
+shard_map/pallas sub-jaxprs), and checks the measured footprint against
+a declared :class:`~pydcop_tpu.analysis.budget.ProgramBudget`:
+
+* collective count per cycle by kind and per-collective payload bytes
+  (the PR 2/5 one-collective-per-cycle contracts);
+* zero host callbacks (the PR 4 no-host-round-trip-per-cycle
+  contract);
+* dtype tier map — every aval in the program must carry an allowed
+  dtype (no silent f32→f64 upcasts, no over-tier constants);
+* embedded-constant bytes — closure-captured arrays baked into the
+  executable (the PR 8 warm engines must stay near zero: their tables
+  are arguments, not constants);
+* donation — input→output aliasing actually present in the lowered
+  StableHLO (``tf.aliasing_output`` / ``jax.buffer_donor``), audited
+  where the backend applies donation and recorded as skipped elsewhere
+  (CPU drops donation; see ``algorithms.base.donation_supported``).
+
+The auditor measures ONE-cycle programs: callers pass a single-cycle
+key vector (like the jaxpr pin tests it replaces), so eqn counts are
+per-cycle counts.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from pydcop_tpu.analysis.budget import (
+    COLLECTIVE_KINDS,
+    AuditReport,
+    Finding,
+    ProgramBudget,
+)
+
+#: primitive name → declared collective kind (the ``2`` variants are
+#: the experimental-shard_map spellings; ``all_reduce`` lowers from the
+#: psum family)
+COLLECTIVE_PRIM_KIND = {
+    "psum": "psum",
+    "psum2": "psum",
+    "all_reduce": "psum",
+    "pmax": "pmax",
+    "pmax2": "pmax",
+    "pmin": "pmin",
+    "pmin2": "pmin",
+    "ppermute": "ppermute",
+}
+
+#: collective primitives with no kind in the budget map — their mere
+#: presence is a finding
+OTHER_COLLECTIVE_PRIMS = {
+    "all_gather", "all_to_all", "psum_scatter", "pgather",
+    "reduce_scatter", "pbroadcast",
+}
+
+#: host-callback escape hatches — a cycle program containing any of
+#: these ships data to the host mid-cycle
+CALLBACK_PRIMS = {
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call", "host_callback_call", "infeed", "outfeed",
+}
+
+#: StableHLO markers of input→output aliasing (donation)
+_ALIASING_MARKS = ("tf.aliasing_output", "jax.buffer_donor")
+
+
+def iter_eqns(jaxpr) -> Iterable:
+    """Yield every eqn of ``jaxpr`` and (recursively) of every
+    sub-jaxpr carried in eqn params (scan/cond/while bodies, pjit and
+    shard_map calls, pallas kernels, custom derivative rules)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for leaf in jax.tree_util.tree_leaves(
+                v, is_leaf=lambda x: hasattr(x, "eqns")
+                or hasattr(x, "jaxpr")
+            ):
+                if hasattr(leaf, "eqns"):
+                    yield from iter_eqns(leaf)
+                elif hasattr(leaf, "jaxpr"):
+                    yield from iter_eqns(leaf.jaxpr)
+
+
+def _aval_bytes(aval) -> int:
+    size = int(np.prod(aval.shape)) if aval.shape else 1
+    itemsize = getattr(
+        np.dtype(aval.dtype) if not hasattr(aval.dtype, "itemsize")
+        else aval.dtype, "itemsize", 4,
+    )
+    return size * int(itemsize)
+
+
+def collect_collectives(closed) -> List[Tuple[str, tuple, int]]:
+    """``(kind-or-primitive, first-operand shape, payload bytes)`` for
+    every collective in a (recursively traversed) closed jaxpr."""
+    out = []
+    for eqn in iter_eqns(closed.jaxpr):
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIM_KIND or name in OTHER_COLLECTIVE_PRIMS:
+            aval = eqn.invars[0].aval
+            out.append((
+                COLLECTIVE_PRIM_KIND.get(name, name),
+                tuple(aval.shape),
+                _aval_bytes(aval),
+            ))
+    return out
+
+
+def collect_dtypes(closed) -> set:
+    """Dtype names of every aval (eqn operands/results, program inputs,
+    embedded constants) in a closed jaxpr."""
+    seen = set()
+    for v in closed.jaxpr.invars:
+        if hasattr(v.aval, "dtype"):
+            seen.add(str(v.aval.dtype))
+    for c in closed.consts:
+        if hasattr(c, "dtype"):
+            seen.add(str(c.dtype))
+    for eqn in iter_eqns(closed.jaxpr):
+        for v in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(v, "aval", None)
+            if aval is not None and hasattr(aval, "dtype"):
+                seen.add(str(aval.dtype))
+    return seen
+
+
+def const_bytes(closed) -> int:
+    """Bytes of constants baked into the executable (closure-captured
+    arrays): what a budget's ``max_const_bytes`` caps.  Recurses into
+    sub-jaxprs — pjit/scan/shard_map hoist captured arrays into THEIR
+    closed jaxprs, so the top level alone under-counts — deduplicating
+    by object identity."""
+    seen = set()
+    total = 0
+
+    def add(consts):
+        nonlocal total
+        for c in consts:
+            if id(c) in seen:
+                continue
+            seen.add(id(c))
+            nbytes = getattr(c, "nbytes", None)
+            if nbytes is None:
+                nbytes = np.asarray(c).nbytes
+            total += int(nbytes)
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            for v in eqn.params.values():
+                for leaf in jax.tree_util.tree_leaves(
+                    v, is_leaf=lambda x: hasattr(x, "eqns")
+                    or hasattr(x, "jaxpr")
+                ):
+                    if hasattr(leaf, "consts"):
+                        add(leaf.consts)
+                    if hasattr(leaf, "eqns"):
+                        walk(leaf)
+                    elif hasattr(leaf, "jaxpr"):
+                        walk(leaf.jaxpr)
+
+    add(closed.consts)
+    walk(closed.jaxpr)
+    return total
+
+
+def donation_applied(lowered_text: str) -> bool:
+    """Does a lowered (StableHLO) module alias any input to an
+    output?  The lowering marks donated buffers with
+    ``tf.aliasing_output`` (older) or ``jax.buffer_donor`` (newer)."""
+    return any(m in lowered_text for m in _ALIASING_MARKS)
+
+
+def _donation_check(budget: ProgramBudget,
+                    lowered_text: Optional[str],
+                    findings: List[Finding], name: str) -> str:
+    from pydcop_tpu.algorithms.base import donation_supported
+
+    if not budget.donate:
+        return "not declared"
+    if not donation_supported():
+        # CPU lowering marks aliasing but XLA:CPU drops it at compile,
+        # and the engines themselves gate donate_argnums off CPU — the
+        # declared intent is auditable only on TPU/GPU
+        return "skipped (backend drops donation)"
+    if lowered_text is None:
+        findings.append(Finding(
+            "budget-donation",
+            "budget declares donation but no lowering was provided "
+            "to audit it",
+            name,
+        ))
+        return "missing lowering"
+    if donation_applied(lowered_text):
+        return "applied"
+    findings.append(Finding(
+        "budget-donation",
+        "budget declares donated hot buffers but the lowered module "
+        "aliases no input to an output",
+        name,
+    ))
+    return "missing"
+
+
+def audit_program(
+    fn,
+    args: tuple,
+    budget: ProgramBudget,
+    *,
+    name: str = "program",
+    lowered_text: Optional[str] = None,
+) -> AuditReport:
+    """Trace ``fn(*args)``, walk the jaxpr, and report every budget
+    violation.  ``lowered_text`` (``jitted.lower(*args).as_text()``)
+    feeds the donation check when the budget declares it."""
+    budget.validate()
+    findings: List[Finding] = []
+    closed = jax.make_jaxpr(fn)(*args)
+
+    # -- collectives --------------------------------------------------------
+    counts: Dict[str, int] = {k: 0 for k in COLLECTIVE_KINDS}
+    max_payload = 0
+    for kind, shape, nbytes in collect_collectives(closed):
+        if kind not in counts:
+            findings.append(Finding(
+                "budget-unknown-collective",
+                f"collective {kind!r} (operand {shape}) has no kind in "
+                f"the declared budget map",
+                name,
+            ))
+            continue
+        counts[kind] += 1
+        max_payload = max(max_payload, nbytes)
+    for kind in COLLECTIVE_KINDS:
+        if counts[kind] > int(budget.collectives[kind]):
+            findings.append(Finding(
+                "budget-collective-count",
+                f"{counts[kind]} {kind} per cycle exceeds the declared "
+                f"{budget.collectives[kind]}",
+                name,
+            ))
+    if max_payload > int(budget.max_collective_bytes):
+        findings.append(Finding(
+            "budget-collective-bytes",
+            f"collective payload {max_payload}B exceeds the declared "
+            f"{budget.max_collective_bytes}B",
+            name,
+        ))
+
+    # -- host callbacks -----------------------------------------------------
+    callbacks = [
+        eqn.primitive.name for eqn in iter_eqns(closed.jaxpr)
+        if eqn.primitive.name in CALLBACK_PRIMS
+        or "callback" in eqn.primitive.name
+    ]
+    if len(callbacks) > int(budget.max_host_callbacks):
+        findings.append(Finding(
+            "budget-host-callback",
+            f"{len(callbacks)} host callback(s) {sorted(set(callbacks))} "
+            f"exceed the declared {budget.max_host_callbacks}",
+            name,
+        ))
+
+    # -- dtype tier ---------------------------------------------------------
+    seen_dtypes = collect_dtypes(closed)
+    over_tier = sorted(seen_dtypes - budget.allowed_dtypes())
+    if over_tier:
+        findings.append(Finding(
+            "budget-dtype",
+            f"dtypes {over_tier} outside the declared tier map "
+            f"{sorted(budget.allowed_dtypes())}",
+            name,
+        ))
+
+    # -- embedded constants -------------------------------------------------
+    cbytes = const_bytes(closed)
+    if cbytes > int(budget.max_const_bytes):
+        findings.append(Finding(
+            "budget-const-bytes",
+            f"{cbytes}B of constants baked into the executable exceed "
+            f"the declared {budget.max_const_bytes}B",
+            name,
+        ))
+
+    donation = _donation_check(budget, lowered_text, findings, name)
+
+    scorecard: Dict[str, Any] = {
+        "collectives": counts,
+        "max_collective_payload_bytes": max_payload,
+        "host_callbacks": len(callbacks),
+        "dtypes": sorted(seen_dtypes),
+        "const_bytes": cbytes,
+        "donation": donation,
+        "eqns": sum(1 for _ in iter_eqns(closed.jaxpr)),
+    }
+    return AuditReport(
+        program=name, findings=findings, scorecard=scorecard
+    )

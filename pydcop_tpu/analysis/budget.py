@@ -1,0 +1,133 @@
+"""Declared performance budgets for compiled cycle programs.
+
+Every engine in this repo ships a handful of load-bearing guarantees —
+one collective per cycle (PR 2/5), zero host round-trips inside the
+chunk (PR 4), donation on the hot buffers, operand-carried tables so
+mutation costs zero retraces (PR 8), a single dtype tier with no silent
+upcasts (PGMax-style memory discipline, arXiv:2202.04110).  Until now
+each guarantee was pinned by a hand-written jaxpr assertion in whatever
+test file happened to grow it.  A :class:`ProgramBudget` is the
+*declared* half of that contract: a per-engine record, written next to
+the engine's cycle function, of what the compiled per-cycle program is
+allowed to contain.  The *measured* half is
+:func:`pydcop_tpu.analysis.auditor.audit_program`, which lowers the
+program and walks its jaxpr; the registry
+(:mod:`pydcop_tpu.analysis.registry`) sweeps the full engine×mode
+matrix.
+
+Budgets fail loudly when left partially declared: every field of
+:class:`ProgramBudget` defaults to the :data:`UNDECLARED` sentinel and
+:meth:`ProgramBudget.validate` (run by every audit) raises
+:class:`BudgetUndeclared` naming the missing fields — an engine cannot
+opt out of a dimension by forgetting it.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List
+
+#: collective kinds a budget must declare a per-cycle count for —
+#: the four primitives the sharded engines are allowed to use.  Any
+#: OTHER collective primitive found in an audited program (all_gather,
+#: psum_scatter, ...) is reported as ``budget-unknown-collective``.
+COLLECTIVE_KINDS = ("psum", "ppermute", "pmax", "pmin")
+
+
+class _Undeclared:
+    """Sentinel for budget fields that were never declared."""
+
+    def __repr__(self) -> str:  # pragma: no cover - repr only
+        return "UNDECLARED"
+
+
+UNDECLARED: Any = _Undeclared()
+
+
+class BudgetUndeclared(ValueError):
+    """A budget field (or collective kind) was left undeclared."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramBudget:
+    """Declared per-cycle resource budget of one compiled program.
+
+    ``collectives`` caps the per-cycle collective COUNT by kind and
+    must declare every kind in :data:`COLLECTIVE_KINDS` explicitly
+    (0 = forbidden).  ``max_collective_bytes`` caps the payload of any
+    single collective (first-operand ``size * itemsize``).
+    ``max_host_callbacks`` is the allowed number of host-callback
+    escape hatches (every engine here declares 0).  ``dtypes`` is the
+    allowed dtype-tier map: the set of dtype names any value in the
+    traced program may carry — a silent f32→f64 upcast or an
+    over-tier constant shows up as a ``budget-dtype`` finding.
+    ``max_const_bytes`` caps the bytes of constants baked into the
+    executable (closure-captured arrays): warm engines declare a tiny
+    cap because their tables travel as *arguments* (PR 8's zero-retrace
+    contract), cold engines declare their table footprint plus slack.
+    ``donate`` declares whether the hot state buffers must be donated
+    (input→output aliased) — audited on backends where XLA applies
+    donation, recorded as skipped elsewhere (mirroring
+    :func:`pydcop_tpu.algorithms.base.donation_supported`).
+    """
+
+    collectives: Any = UNDECLARED
+    max_collective_bytes: Any = UNDECLARED
+    max_host_callbacks: Any = UNDECLARED
+    dtypes: Any = UNDECLARED
+    max_const_bytes: Any = UNDECLARED
+    donate: Any = UNDECLARED
+
+    def validate(self) -> None:
+        missing = [
+            f.name for f in dataclasses.fields(self)
+            if getattr(self, f.name) is UNDECLARED
+        ]
+        if missing:
+            raise BudgetUndeclared(
+                f"budget fields left undeclared: {missing}"
+            )
+        undeclared_kinds = [
+            k for k in COLLECTIVE_KINDS if k not in self.collectives
+        ]
+        if undeclared_kinds:
+            raise BudgetUndeclared(
+                f"collective kinds left undeclared: {undeclared_kinds}"
+            )
+
+    def allowed_dtypes(self) -> frozenset:
+        return frozenset(str(d) for d in self.dtypes)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One budget-audit violation."""
+
+    rule: str
+    message: str
+    program: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class AuditReport:
+    """Result of auditing one program against its budget: the findings
+    (empty = within budget) plus the measured scorecard, which lands in
+    the ``analyze program`` JSON output."""
+
+    program: str
+    findings: List[Finding]
+    scorecard: Dict[str, Any]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "program": self.program,
+            "ok": self.ok,
+            "findings": [f.to_dict() for f in self.findings],
+            "scorecard": self.scorecard,
+        }

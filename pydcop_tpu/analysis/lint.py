@@ -1,0 +1,850 @@
+"""Source lint: tracer-hostile and concurrency hazards, by AST.
+
+Two hazard families this repo's guarantees depend on are invisible to
+the program auditor (they never make it into a jaxpr, or they live in
+plain host code):
+
+* **tracer hazards** — host pulls (``.item()`` / ``float()`` /
+  ``np.asarray`` on device values), wall clocks and the *global*
+  ``np.random`` stream inside cycle/chunk code.  Under ``jit`` these
+  either fail at trace time, silently bake a constant into the
+  executable, or force a device→host sync per cycle — exactly the
+  regressions PR 4 removed.
+* **lock-discipline races** — the serve/fleet tier (PR 6/7/11) runs
+  scheduler, supervisor and completion-tap threads against front-door
+  callers; its invariant is "shared attributes are accessed under
+  ``_lock``".  The race rule checks it per class, RacerD-style by
+  attribute *name*: the guarded set is every attribute written (i)
+  inside a ``with self._lock`` block, (ii) in a thread entry point (a
+  ``threading.Thread(target=...)`` method, a registered callback
+  lambda, or anything transitively self-called from one), or (iii) in
+  any public method; any access of a guarded attribute outside a lock
+  context then fires.  Private methods whose every intra-class call
+  site is lock-held are treated as lock-held (callers hold the lock);
+  ``__init__`` (pre-thread) and threading-primitive attributes
+  (Events, Locks) are exempt.
+
+Findings are suppressed by an inline waiver **with a reason**::
+
+    self._ticks += 1  # analyze: waive[unlocked-shared-attr] supervisor-only counter
+
+A waiver on its own line applies to the next line.  A waiver without a
+reason does not suppress anything and is itself reported
+(``waiver-missing-reason``).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+#: rule id → one-line description.  docs/analysis.rst pins this table
+#: (PR 12 fault-catalog style): adding a rule without documenting it —
+#: or documenting one that does not exist — fails the catalog test.
+LINT_RULES = {
+    "host-pull-in-jit": (
+        "``.item()`` / ``.tolist()`` / ``np.asarray`` / builtin "
+        "``float``/``int``/``bool`` applied to a traced value inside "
+        "cycle/chunk code — a device→host sync (or trace error) per "
+        "cycle"
+    ),
+    "time-in-jit": (
+        "``time.time()`` / ``perf_counter()`` / ``datetime.now()`` "
+        "inside a traced scope — bakes one trace-time constant into "
+        "the executable"
+    ),
+    "global-rng-in-jit": (
+        "global ``np.random.*`` / stdlib ``random.*`` draw inside a "
+        "traced scope — untraced, unseeded state invisible to the "
+        "per-chunk key stream"
+    ),
+    "unlocked-shared-attr": (
+        "attribute shared with a scheduler/supervisor thread accessed "
+        "outside a ``with self._lock`` block in a lock-owning class"
+    ),
+    "waiver-missing-reason": (
+        "``# analyze: waive[rule]`` with no reason string — waivers "
+        "must say why"
+    ),
+}
+
+WAIVER_RE = re.compile(r"#\s*analyze:\s*waive\[([^\]]*)\]\s*(.*)$")
+
+#: where the lock-discipline race rule applies: the serving tier's
+#: cross-thread classes (PR 6/7/11 invariants) and the shared compile
+#: cache.  ``<string>`` keeps in-memory fixtures (tests) in scope.
+RACE_SCOPE = ("serve/", "serve\\", "batch/cache.py", "batch\\cache.py",
+              "<string>")
+
+
+def _race_in_scope(path: str) -> bool:
+    return any(tok in path for tok in RACE_SCOPE)
+
+#: wrapper → positional args that are traced functions
+TRACE_WRAPPERS = {
+    "jit": (0,), "pjit": (0,), "vmap": (0,), "pmap": (0,),
+    "shard_map": (0,), "make_jaxpr": (0,), "scan": (0,),
+    "cond": (1, 2), "switch": (1, 2, 3, 4, 5),
+    "while_loop": (0, 1), "fori_loop": (2,),
+    "associative_scan": (0,), "remat": (0,), "checkpoint": (0,),
+    "grad": (0,), "value_and_grad": (0,),
+}
+
+#: function names that ARE cycle entry points even when the wrapper
+#: call lives in another module (``make_jaxpr``/``jit`` call sites in
+#: tests, engines assembling runners from kernel modules)
+TRACED_NAME_ROOTS = {"cycle", "cycle_fn", "packed_cycle_fn", "run_n",
+                     "run_chunk"}
+TRACED_NAME_SUFFIXES = ("_cycle",)
+
+#: attribute reads that KEEP a value tainted (array views); every
+#: other attribute access ends taint — config-object fields
+#: (``plan.Dmax``) are static metadata, not device values
+ARRAY_TAINT_ATTRS = {"T", "mT", "at", "real", "imag", "flat"}
+#: method calls that return arrays (keep taint through ``x.sum()``)
+ARRAY_TAINT_METHODS = {
+    "sum", "mean", "min", "max", "argmin", "argmax", "astype",
+    "reshape", "dot", "squeeze", "ravel", "take", "clip", "round",
+    "prod", "cumsum", "transpose", "flatten", "set", "get", "add",
+    "multiply",
+}
+
+#: ``np.random`` members that are NOT the global stream
+SAFE_NP_RANDOM = {"default_rng", "Generator", "SeedSequence", "PCG64",
+                  "Philox", "BitGenerator", "RandomState"}
+
+#: mutating method names counted as attribute writes by the race rule
+MUTATORS = {"append", "extend", "add", "insert", "remove", "discard",
+            "pop", "popleft", "appendleft", "clear", "update",
+            "setdefault", "popitem"}
+
+#: threading primitives whose attributes are themselves sync devices
+#: (exempt from the race rule)
+_SYNC_CTORS = {"Lock", "RLock", "Condition", "Event", "Semaphore",
+               "BoundedSemaphore", "Barrier"}
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+@dataclasses.dataclass
+class LintFinding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+
+def _dotted_tail(func) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _self_attr(node) -> Optional[str]:
+    """``self.X`` → ``"X"``."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _is_sync_ctor(value, ctors) -> bool:
+    if isinstance(value, ast.Call):
+        tail = _dotted_tail(value.func)
+        return tail in ctors
+    return False
+
+
+class _Parents(ast.NodeVisitor):
+    """Annotate every node with its parent."""
+
+    def visit(self, node):
+        for child in ast.iter_child_nodes(node):
+            child._lint_parent = node  # type: ignore[attr-defined]
+            self.visit(child)
+
+
+def _enclosing_functions(node) -> List[ast.AST]:
+    out = []
+    cur = getattr(node, "_lint_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            out.append(cur)
+        cur = getattr(cur, "_lint_parent", None)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# traced-scope detection
+
+
+def _collect_functions(tree) -> List[ast.AST]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda))]
+
+
+def _traced_functions(tree) -> Set[ast.AST]:
+    """Functions traced by JAX: structural roots (passed to
+    jit/scan/cond/shard_map/..., or decorated), name-pattern roots
+    (``*_cycle``, ``run_n``, ...), plus everything they transitively
+    call by (self.)name within the module."""
+    funcs = _collect_functions(tree)
+    by_name: Dict[str, List[ast.AST]] = {}
+    for f in funcs:
+        if not isinstance(f, ast.Lambda):
+            by_name.setdefault(f.name, []).append(f)
+
+    traced: Set[ast.AST] = set()
+
+    def mark_name(name: str) -> None:
+        for f in by_name.get(name, []):
+            traced.add(f)
+
+    for f in funcs:
+        if isinstance(f, ast.Lambda):
+            continue
+        if f.name in TRACED_NAME_ROOTS or (
+                f.name.endswith(TRACED_NAME_SUFFIXES)
+                and not f.name.startswith(("make_", "build_"))):
+            traced.add(f)
+        for dec in f.decorator_list:
+            tail = _dotted_tail(
+                dec.func if isinstance(dec, ast.Call) else dec
+            )
+            if tail in ("jit", "pjit", "remat", "checkpoint"):
+                traced.add(f)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = _dotted_tail(node.func)
+        if tail not in TRACE_WRAPPERS:
+            continue
+        positions = TRACE_WRAPPERS[tail]
+        for pos in positions:
+            if pos >= len(node.args):
+                continue
+            arg = node.args[pos]
+            if isinstance(arg, ast.Lambda):
+                traced.add(arg)
+            elif isinstance(arg, ast.Name):
+                mark_name(arg.id)
+            else:
+                sa = _self_attr(arg)
+                if sa:
+                    mark_name(sa)
+        for kw in node.keywords:
+            if kw.arg in ("f", "fun", "body_fun", "cond_fun"):
+                if isinstance(kw.value, ast.Name):
+                    mark_name(kw.value.id)
+                elif isinstance(kw.value, ast.Lambda):
+                    traced.add(kw.value)
+
+    # transitive closure over (self.)name calls from traced bodies
+    changed = True
+    while changed:
+        changed = False
+        for f in list(traced):
+            for node in ast.walk(f):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = None
+                if isinstance(node.func, ast.Name):
+                    callee = node.func.id
+                else:
+                    callee = _self_attr(node.func)
+                if callee is None:
+                    continue
+                for g in by_name.get(callee, []):
+                    if g not in traced:
+                        traced.add(g)
+                        changed = True
+    return traced
+
+
+# ---------------------------------------------------------------------------
+# tracer-hazard checks
+
+
+def _expr_tainted(expr, taint: Set[str]) -> bool:
+    """Does ``expr`` carry a tainted (device) value?  Attribute access
+    ends taint (``plan.Dmax`` is static config; ``x.shape`` is
+    metadata) except for array views (``x.T``, ``x.at``) and
+    array-returning method calls (``x.sum()``)."""
+
+    def walk(node) -> bool:
+        if isinstance(node, ast.Attribute):
+            if node.attr in ARRAY_TAINT_ATTRS:
+                return walk(node.value)
+            return False
+        if isinstance(node, ast.Call):
+            tail = _dotted_tail(node.func)
+            if tail in ("len", "isinstance", "range"):
+                return False
+            if isinstance(node.func, ast.Attribute):
+                if (node.func.attr in ARRAY_TAINT_METHODS
+                        and walk(node.func.value)):
+                    return True
+                return any(walk(a) for a in node.args) or any(
+                    walk(k.value) for k in node.keywords
+                )
+        if isinstance(node, ast.Name) and node.id in taint:
+            return True
+        return any(walk(c) for c in ast.iter_child_nodes(node))
+
+    return walk(expr)
+
+
+def _check_traced_function(fn, taint_in: Set[str], path: str,
+                           findings: List[LintFinding]) -> None:
+    if isinstance(fn, ast.Lambda):
+        params = [a.arg for a in fn.args.args]
+        body: List[ast.AST] = [fn.body]
+    else:
+        params = [a.arg for a in fn.args.args
+                  + fn.args.kwonlyargs + fn.args.posonlyargs]
+        if fn.args.vararg:
+            params.append(fn.args.vararg.arg)
+        body = list(fn.body)
+    taint = set(taint_in) | {p for p in params if p != "self"}
+
+    def flag(rule: str, node, msg: str) -> None:
+        findings.append(LintFinding(rule, path, node.lineno, msg))
+
+    def visit(node) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            # nested defs are checked on their own pass with the outer
+            # taint handed down (closure variables stay tainted)
+            _check_traced_function(node, taint, path, findings)
+            return
+        if isinstance(node, ast.Assign):
+            if _expr_tainted(node.value, taint):
+                for tgt in node.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            taint.add(n.id)
+        if isinstance(node, ast.Call):
+            tail = _dotted_tail(node.func)
+            # .item()/.tolist() on anything device-shaped
+            if tail in ("item", "tolist") and isinstance(
+                    node.func, ast.Attribute) and not node.args:
+                flag("host-pull-in-jit", node,
+                     f".{tail}() inside a traced scope pulls the value "
+                     f"to the host")
+            # np.asarray / np.array on a traced value
+            if (isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in ("np", "onp", "numpy")
+                    and tail in ("asarray", "array", "asanyarray")
+                    and node.args
+                    and _expr_tainted(node.args[0], taint)):
+                flag("host-pull-in-jit", node,
+                     f"np.{tail}() on a traced value inside a traced "
+                     f"scope")
+            # builtin float()/int()/bool() on a traced value
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in ("float", "int", "bool")
+                    and len(node.args) == 1
+                    and _expr_tainted(node.args[0], taint)):
+                flag("host-pull-in-jit", node,
+                     f"builtin {node.func.id}() on a traced value "
+                     f"forces a host sync (or trace error)")
+            # wall clocks
+            if (isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in ("time", "datetime")
+                    and tail in ("time", "perf_counter", "monotonic",
+                                 "now", "utcnow")):
+                flag("time-in-jit", node,
+                     f"{node.func.value.id}.{tail}() inside a traced "
+                     f"scope is a trace-time constant")
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in ("perf_counter", "monotonic")):
+                flag("time-in-jit", node,
+                     f"{node.func.id}() inside a traced scope is a "
+                     f"trace-time constant")
+            # global RNG streams
+            if (isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Attribute)
+                    and isinstance(node.func.value.value, ast.Name)
+                    and node.func.value.value.id in ("np", "numpy")
+                    and node.func.value.attr == "random"
+                    and tail not in SAFE_NP_RANDOM):
+                flag("global-rng-in-jit", node,
+                     f"global np.random.{tail}() inside a traced scope")
+            if (isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "random"
+                    and tail in ("random", "randint", "uniform",
+                                 "choice", "shuffle", "seed", "gauss",
+                                 "sample", "randrange")):
+                flag("global-rng-in-jit", node,
+                     f"stdlib random.{tail}() inside a traced scope")
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in body:
+        visit(stmt)
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline race check
+
+
+def _lock_attrs(cls) -> Set[str]:
+    out = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_sync_ctor(
+                node.value, _LOCK_CTORS):
+            for tgt in node.targets:
+                sa = _self_attr(tgt)
+                if sa:
+                    out.add(sa)
+    return out
+
+
+def _sync_attr_names(tree) -> Set[str]:
+    """Attribute names bound to threading primitives anywhere in the
+    module (``self.done = threading.Event()``, dataclass
+    ``done: threading.Event = field(default_factory=threading.Event)``)
+    — exempt from the race rule: they ARE synchronization devices."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and _is_sync_ctor(
+                node.value, _SYNC_CTORS):
+            for tgt in node.targets:
+                sa = _self_attr(tgt)
+                if sa:
+                    out.add(sa)
+                elif isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+        if isinstance(node, ast.AnnAssign):
+            ann = ast.dump(node.annotation)
+            if any(c in ann for c in _SYNC_CTORS):
+                if isinstance(node.target, ast.Name):
+                    out.add(node.target.id)
+                else:
+                    sa = _self_attr(node.target)
+                    if sa:
+                        out.add(sa)
+    return out
+
+
+def _owned_names(fn) -> Set[str]:
+    """Names bound in ``fn`` to freshly-constructed objects (literals
+    or ``CapitalizedName(...)`` calls): accesses through them are
+    thread-local until published."""
+    owned = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        fresh = isinstance(v, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                               ast.DictComp, ast.SetComp, ast.Constant))
+        if isinstance(v, ast.Call):
+            tail = _dotted_tail(v.func)
+            if tail and (tail[:1].isupper() or tail in
+                         ("dict", "list", "set", "deepcopy")):
+                fresh = True
+        if fresh:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    owned.add(tgt.id)
+    return owned
+
+
+def _in_lock_block(node, lock_attrs: Set[str]) -> bool:
+    cur = getattr(node, "_lint_parent", None)
+    child = node
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        if isinstance(cur, ast.With) and any(
+                _self_attr(item.context_expr) in lock_attrs
+                for item in cur.items):
+            # only the body is protected, not the context expr itself
+            if any(child is n for n in cur.body):
+                return True
+        child = cur
+        cur = getattr(cur, "_lint_parent", None)
+    return False
+
+
+def _attr_writes(fn, owned: Set[str],
+                 delegated: Set[str]) -> List[Tuple[str, ast.AST]]:
+    """(attribute name, node) for every write through a non-owned
+    object: plain/aug/subscript assignment or a mutator call.
+    ``delegated`` attrs hold instances of lock-owning classes — a
+    mutator call THROUGH them (``self.journal.append(...)``) is that
+    class's own discipline, not a write to the holder attribute."""
+    out = []
+
+    def obj_ok(value) -> bool:
+        return (isinstance(value, ast.Name)
+                and value.id not in owned)
+
+    for node in ast.walk(fn):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for tgt in targets:
+            base = tgt
+            if isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, ast.Attribute) and obj_ok(base.value):
+                out.append((base.attr, tgt))
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in MUTATORS
+                and isinstance(node.func.value, ast.Attribute)
+                and obj_ok(node.func.value.value)
+                and node.func.value.attr not in delegated):
+            out.append((node.func.value.attr, node))
+    return out
+
+
+def _attr_accesses(fn, owned: Set[str]) -> List[Tuple[str, ast.AST]]:
+    """(attribute name, node) for every read OR write of ``obj.attr``
+    through a non-owned object name."""
+    out = []
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id not in owned):
+            out.append((node.attr, node))
+    return out
+
+
+def _thread_roots(cls) -> Set[str]:
+    """Methods that run on another thread: ``Thread(target=self.X)``
+    targets and methods called inside callback lambdas assigned to an
+    attribute (``other.on_complete = lambda ...: self._tap(...)``)."""
+    roots: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call):
+            tail = _dotted_tail(node.func)
+            if tail == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        sa = _self_attr(kw.value)
+                        if sa:
+                            roots.add(sa)
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Lambda):
+            is_attr_target = any(
+                isinstance(t, ast.Attribute) for t in node.targets
+            )
+            if is_attr_target:
+                for call in ast.walk(node.value):
+                    if isinstance(call, ast.Call):
+                        sa = _self_attr(call.func)
+                        if sa:
+                            roots.add(sa)
+    return roots
+
+
+def _method_calls(fn) -> Set[str]:
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            sa = _self_attr(node.func)
+            if sa:
+                out.add(sa)
+    return out
+
+
+def _class_methods(cls) -> Dict[str, ast.AST]:
+    return {
+        n.name: n for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _thread_reach(cls, extra_roots: Set[str]) -> Tuple[Set[str],
+                                                       Set[str]]:
+    """(roots, transitive closure over self-calls) of the methods that
+    run on another thread."""
+    methods = _class_methods(cls)
+    roots = set(r for r in (_thread_roots(cls) | extra_roots)
+                if r in methods)
+    reach = set(roots)
+    frontier = list(reach)
+    while frontier:
+        m = frontier.pop()
+        for callee in _method_calls(methods[m]):
+            if callee in methods and callee not in reach:
+                reach.add(callee)
+                frontier.append(callee)
+    return roots, reach
+
+
+def _module_race_info(tree) -> Tuple[Dict[str, Set[str]],
+                                     Dict[str, Set[str]]]:
+    """Per-class cross-class race facts:
+
+    * *extra thread roots* — methods of one class invoked from another
+      class's thread-side methods through a held instance
+      (``self.journal.append(...)`` in the fleet supervisor makes
+      ``FleetJournal.append`` thread-side); one propagation round
+      covers the composition depth in this tree;
+    * *delegated attrs* — attributes holding instances of lock-owning
+      in-module classes (their internal discipline is checked in their
+      own class, not charged to the holder).
+    """
+    classes = {n.name: n for n in ast.walk(tree)
+               if isinstance(n, ast.ClassDef)}
+    # (owner class, attr) -> held class, from `self.X = D(...)`
+    held: Dict[Tuple[str, str], str] = {}
+    for cname, cls in classes.items():
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                tail = _dotted_tail(node.value.func)
+                if tail in classes:
+                    for tgt in node.targets:
+                        sa = _self_attr(tgt)
+                        if sa:
+                            held[(cname, sa)] = tail
+    extra: Dict[str, Set[str]] = {}
+    for cname, cls in classes.items():
+        methods = _class_methods(cls)
+        _roots, reach = _thread_reach(cls, set())
+        for mname in reach:
+            for node in ast.walk(methods[mname]):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                base = node.func.value
+                sa = _self_attr(base)
+                if sa and (cname, sa) in held:
+                    extra.setdefault(
+                        held[(cname, sa)], set()
+                    ).add(node.func.attr)
+    delegated: Dict[str, Set[str]] = {}
+    for (cname, attr), dname in held.items():
+        if dname in classes and _lock_attrs(classes[dname]):
+            delegated.setdefault(cname, set()).add(attr)
+    return extra, delegated
+
+
+def _check_class_races(cls, path: str, sync_names: Set[str],
+                       extra_roots: Set[str], delegated: Set[str],
+                       findings: List[LintFinding]) -> None:
+    lock_attrs = _lock_attrs(cls)
+    if not lock_attrs:
+        return
+    methods = _class_methods(cls)
+    roots, reach = _thread_reach(cls, extra_roots)
+
+    # lock-held private methods: every intra-class call site is inside
+    # a lock block (or inside another lock-held method); public and
+    # thread-entry methods are externally callable and never qualify
+    call_sites: Dict[str, List[Tuple[str, ast.AST]]] = {}
+    for mname, m in methods.items():
+        for node in ast.walk(m):
+            if isinstance(node, ast.Call):
+                sa = _self_attr(node.func)
+                if sa in methods:
+                    call_sites.setdefault(sa, []).append((mname, node))
+    lock_held: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for mname, m in methods.items():
+            if (mname in lock_held or not mname.startswith("_")
+                    or mname.startswith("__")
+                    or mname in roots):
+                continue
+            sites = call_sites.get(mname)
+            if not sites:
+                continue
+            if all(
+                caller in lock_held
+                or _in_lock_block(node, lock_attrs)
+                for caller, node in sites
+            ):
+                lock_held.add(mname)
+                changed = True
+
+    owned_by_method = {
+        mname: _owned_names(m) for mname, m in methods.items()
+    }
+
+    # attribute classification:
+    #   thread_written — written by a thread-side method (the
+    #       scheduler/supervisor closure);
+    #   written_under_lock / lock_accessed — evidence the class
+    #       considers the attribute lock-protected.
+    thread_written: Set[str] = set()
+    written_under_lock: Set[str] = set()
+    lock_accessed: Set[str] = set()
+    for mname, m in methods.items():
+        if mname == "__init__":
+            continue
+        owned = owned_by_method[mname]
+        for attr, node in _attr_writes(m, owned, delegated):
+            if attr in sync_names or attr in lock_attrs:
+                continue
+            if _in_lock_block(node, lock_attrs):
+                written_under_lock.add(attr)
+            if mname in reach:
+                thread_written.add(attr)
+        for attr, node in _attr_accesses(m, owned):
+            if _in_lock_block(node, lock_attrs):
+                lock_accessed.add(attr)
+    # lock-protected: written under the lock, or thread-written AND
+    # touched under the lock somewhere (the rest of the class relies
+    # on the lock for it)
+    lock_protected = written_under_lock | (
+        thread_written & lock_accessed
+    )
+    if not lock_protected and not thread_written:
+        return
+
+    # findings: (F1) ANY unlocked access of a lock-protected
+    # attribute; (F2) a non-thread-side method touching a
+    # thread-written attribute without the lock (cross-thread access).
+    # Unlocked accesses of thread-confined attributes BY the owning
+    # thread stay silent — single-writer state needs no lock until
+    # someone else reads it.
+    for mname, m in methods.items():
+        if mname == "__init__" or mname in lock_held:
+            continue
+        owned = owned_by_method[mname]
+        for attr, node in _attr_accesses(m, owned):
+            if attr in sync_names or attr in lock_attrs:
+                continue
+            if _in_lock_block(node, lock_attrs):
+                continue
+            if attr in lock_protected:
+                findings.append(LintFinding(
+                    "unlocked-shared-attr", path, node.lineno,
+                    f"{cls.name}.{mname}: `{attr}` is lock-protected "
+                    f"(under {sorted(lock_attrs)}) elsewhere in the "
+                    f"class but accessed here without the lock",
+                ))
+            elif attr in thread_written and mname not in reach:
+                findings.append(LintFinding(
+                    "unlocked-shared-attr", path, node.lineno,
+                    f"{cls.name}.{mname}: `{attr}` is written by a "
+                    f"scheduler/supervisor-thread method but accessed "
+                    f"from this caller-side method without "
+                    f"{sorted(lock_attrs)}",
+                ))
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+
+def _parse_waivers(src: str, path: str,
+                   findings: List[LintFinding]
+                   ) -> Dict[int, Set[str]]:
+    waivers: Dict[int, Set[str]] = {}
+    for i, line in enumerate(src.splitlines(), 1):
+        m = WAIVER_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = m.group(2).strip()
+        if not reason or not rules:
+            findings.append(LintFinding(
+                "waiver-missing-reason", path, i,
+                "waiver must name at least one rule and give a reason "
+                "string",
+            ))
+            continue
+        target = i if line[:m.start()].strip() else i + 1
+        waivers.setdefault(target, set()).update(rules)
+    return waivers
+
+
+def lint_source(src: str, path: str = "<string>",
+                rules: Optional[Iterable[str]] = None
+                ) -> List[LintFinding]:
+    """Lint one source string; returns unwaived findings (plus any
+    waiver-format errors)."""
+    findings: List[LintFinding] = []
+    waivers = _parse_waivers(src, path, findings)
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:  # pragma: no cover - tree ships parseable
+        findings.append(LintFinding(
+            "syntax-error", path, e.lineno or 0, str(e)
+        ))
+        return findings
+    _Parents().visit(tree)
+
+    raw: List[LintFinding] = []
+    traced = _traced_functions(tree)
+    outer_traced = [
+        f for f in traced
+        if not any(e in traced for e in _enclosing_functions(f))
+    ]
+    for fn in outer_traced:
+        _check_traced_function(fn, set(), path, raw)
+    if _race_in_scope(path):
+        sync_names = _sync_attr_names(tree)
+        extra, delegated = _module_race_info(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                _check_class_races(node, path, sync_names,
+                                   extra.get(node.name, set()),
+                                   delegated.get(node.name, set()),
+                                   raw)
+
+    seen = set()
+    for f in raw:
+        if f.rule in waivers.get(f.line, ()):  # waived with reason
+            continue
+        key = (f.rule, f.line, f.message)
+        if key in seen:
+            continue
+        seen.add(key)
+        findings.append(f)
+    if rules is not None:
+        wanted = set(rules)
+        findings = [f for f in findings if f.rule in wanted]
+    return findings
+
+
+#: default lint surface: every package source file
+DEFAULT_PATHS = ("pydcop_tpu",)
+
+
+def lint_paths(paths: Iterable[str],
+               rules: Optional[Iterable[str]] = None
+               ) -> List[LintFinding]:
+    findings: List[LintFinding] = []
+    for root in paths:
+        if os.path.isfile(root):
+            files = [root]
+        else:
+            files = []
+            for dirpath, _dirs, names in os.walk(root):
+                if "__pycache__" in dirpath:
+                    continue
+                files.extend(
+                    os.path.join(dirpath, n)
+                    for n in names if n.endswith(".py")
+                )
+        for f in sorted(files):
+            with open(f, encoding="utf-8") as fh:
+                src = fh.read()
+            findings.extend(lint_source(src, f, rules=rules))
+    return findings

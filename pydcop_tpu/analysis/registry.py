@@ -1,0 +1,449 @@
+"""Budget registry: every engine's cycle program, audited as a matrix.
+
+Each **cell** names one (engine × execution mode) pair and lazily
+builds the triple the auditor needs: the traced cycle program, concrete
+one-cycle arguments, and the engine's DECLARED
+:class:`~pydcop_tpu.analysis.budget.ProgramBudget` (written next to the
+cycle function it governs: ``algorithms/base.py`` for the chunked
+harness, ``algorithms/warm.py`` for the operand-carried warm engines,
+``batch/engine.py`` for the vmapped bucket runner, ``parallel/mesh.py``
+for the sharded engines, ``parallel/dpop_mesh.py`` for the tiled exact
+sweep).  ONE parametrized test (tests/unit/test_analysis.py) sweeps the
+whole registry, replacing the ad-hoc per-file jaxpr pins, and the CLI
+(``pydcop_tpu analyze program``) runs the same sweep standalone.
+
+Cells use tiny fixed instances — the audit checks program SHAPE
+(collective counts, payload ceilings, callback/constant/dtype
+discipline), not throughput, so small graphs keep the sweep inside the
+fast tier.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from pydcop_tpu.analysis.auditor import audit_program
+from pydcop_tpu.analysis.budget import AuditReport, ProgramBudget
+
+#: local-search rules with a sharded generic engine
+LS_RULES = ("mgm", "dsa", "adsa", "dba", "gdba")
+#: rules with a packed (lane-major pallas) sharded engine
+LS_PACKED_RULES = ("mgm", "dsa", "adsa")
+#: algorithms on the single-device chunked harness
+HARNESS_ALGOS = ("maxsum", "mgm", "dsa", "adsa", "gdba")
+#: algorithms with a warm (operand-carried) engine
+WARM_ALGOS = ("maxsum", "mgm", "dsa", "adsa")
+
+
+@dataclasses.dataclass
+class AuditedProgram:
+    """One registry cell, built: the traced program + declared budget.
+    ``lower`` (optional) produces the lowered StableHLO text for the
+    donation check — only invoked on backends that apply donation."""
+
+    name: str
+    fn: Any
+    args: tuple
+    budget: ProgramBudget
+    lower: Optional[Callable[[], str]] = None
+
+
+CELLS: Dict[str, Callable[[], AuditedProgram]] = {}
+
+
+def register_cell(name: str):
+    def deco(builder):
+        CELLS[name] = builder
+        return builder
+
+    return deco
+
+
+def cell_names() -> List[str]:
+    return sorted(CELLS)
+
+
+def build_cell(name: str) -> AuditedProgram:
+    return CELLS[name]()
+
+
+def audit_cell(name: str) -> AuditReport:
+    from pydcop_tpu.algorithms.base import donation_supported
+
+    prog = build_cell(name)
+    lowered = None
+    if (prog.lower is not None and prog.budget.donate
+            and donation_supported()):
+        lowered = prog.lower()
+    return audit_program(
+        prog.fn, prog.args, prog.budget, name=prog.name,
+        lowered_text=lowered,
+    )
+
+
+def audit_all(pattern: Optional[str] = None
+              ) -> Dict[str, AuditReport]:
+    """Audit every registered cell (optionally filtered by substring).
+    This is the `analyze program` sweep."""
+    out = {}
+    for name in cell_names():
+        if pattern and pattern not in name:
+            continue
+        out[name] = audit_cell(name)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shared tiny instances
+
+
+@functools.lru_cache(maxsize=None)
+def _gc_dcop(V=16, E=24, seed=1):
+    from pydcop_tpu.generators import generate_graph_coloring
+
+    return generate_graph_coloring(
+        n_variables=V, n_colors=3, n_edges=E, soft=True, n_agents=1,
+        seed=seed,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_factor_tensors(V=32, C=3, seed=0):
+    """Ring-lattice coloring factor graph — partition-friendly, the
+    same locality profile the boundary-comm pins used."""
+    from pydcop_tpu.ops.compile import compile_binary_from_arrays
+
+    rng = np.random.default_rng(seed)
+    idx = np.arange(V)
+    ei = np.concatenate([idx, idx])
+    ej = np.concatenate([(idx + 1) % V, (idx + 2) % V])
+    mats = rng.uniform(0, 1, (2 * V, C, C)).astype(np.float32)
+    mats += np.eye(C, dtype=np.float32) * 5
+    return compile_binary_from_arrays(
+        ei, ej, mats, V,
+        unary=rng.uniform(0, 0.01, (V, C)).astype(np.float32),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_constraint_tensors(V=24, C=3, seed=0):
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+    from pydcop_tpu.ops.compile import compile_constraint_graph
+
+    rng = np.random.default_rng(seed)
+    d = DCOP("ring", "min")
+    dom = Domain("colors", "color", list(range(C)))
+    vs = [Variable(f"v{i:03d}", dom) for i in range(V)]
+    for v in vs:
+        d.add_variable(v)
+    k = 0
+    for i in range(V):
+        for off in (1, 2):
+            m = rng.uniform(0, 1, (C, C)) + np.eye(C) * 5
+            d.add_constraint(NAryMatrixRelation(
+                [vs[i], vs[(i + off) % V]], m, name=f"c{k}"))
+            k += 1
+    d.add_agents([AgentDef(f"a{i}") for i in range(4)])
+    return compile_constraint_graph(d)
+
+
+@functools.lru_cache(maxsize=None)
+def _mesh(n=8):
+    """An n-device mesh, degrading to however many devices this
+    process actually has (a 1-chip or env-clobbered run still audits
+    every cell — the engines' comm plans, and therefore the declared
+    budgets, adapt to the mesh size)."""
+    import jax
+
+    from pydcop_tpu.parallel.mesh import build_mesh
+
+    return build_mesh(min(n, len(jax.devices())))
+
+
+def _one_cycle_keys(n=1):
+    import jax
+
+    return jax.random.split(jax.random.PRNGKey(0), n)
+
+
+# ---------------------------------------------------------------------------
+# single-device harness cells (PR 4 contract)
+
+
+def _harness_cell(algo: str) -> AuditedProgram:
+    import jax
+
+    from pydcop_tpu.algorithms import load_algorithm_module
+
+    solver = load_algorithm_module(algo).build_solver(
+        _gc_dcop(), seed=0
+    )
+    chunk = 4
+    runner = solver._masked_chunk_runner(chunk, collect=False)
+    state = solver.initial_state()
+    keys = jax.random.split(jax.random.PRNGKey(0), chunk)
+    args = (state, keys, chunk)
+    return AuditedProgram(
+        name=f"single/{algo}",
+        fn=runner,
+        args=args,
+        budget=solver.program_budget(),
+        lower=lambda: runner.lower(*args).as_text(),
+    )
+
+
+for _algo in HARNESS_ALGOS:
+    register_cell(f"single/{_algo}")(
+        functools.partial(_harness_cell, _algo)
+    )
+
+
+# ---------------------------------------------------------------------------
+# warm (operand-carried) cells (PR 8 contract)
+
+
+def _warm_cell(algo: str) -> AuditedProgram:
+    import jax
+
+    from pydcop_tpu.algorithms.warm import build_warm_solver
+
+    solver = build_warm_solver(
+        _gc_dcop(), algo=algo, seed=0, headroom=0.25, min_free=2
+    )
+    chunk = 4
+    runner = solver._masked_chunk_runner(chunk, collect=False)
+    state = solver.initial_state()
+    keys = jax.random.split(jax.random.PRNGKey(0), chunk)
+    args = (state, keys, chunk)
+    return AuditedProgram(
+        name=f"warm/{algo}",
+        fn=runner,
+        args=args,
+        budget=solver.program_budget(),
+        lower=lambda: runner.lower(*args).as_text(),
+    )
+
+
+for _algo in WARM_ALGOS:
+    register_cell(f"warm/{_algo}")(
+        functools.partial(_warm_cell, _algo)
+    )
+
+
+# ---------------------------------------------------------------------------
+# batch bucket-runner cells (PR 3/6 contract)
+
+
+def _batch_cell(algo: str) -> AuditedProgram:
+    import jax.numpy as jnp
+
+    from pydcop_tpu.batch.engine import (
+        BatchItem,
+        BucketMeta,
+        adapter_for,
+        bucket_runner_budget,
+        build_bucket_runner,
+    )
+    from pydcop_tpu.serve.scheduler import (
+        dummy_bucket_inputs,
+        serve_target,
+    )
+
+    adapter = adapter_for(algo)
+    spec = adapter.build_spec(BatchItem(_gc_dcop(), algo, seed=0))
+    target = serve_target([spec.dims])
+    B, chunk = 3, 4
+    runner = build_bucket_runner(
+        adapter, BucketMeta.of(target), {}, chunk
+    )
+    arrays, state, xs = dummy_bucket_inputs(algo, target, B, chunk)
+    args = (
+        arrays, state, xs,
+        jnp.zeros((B,), jnp.int32), jnp.zeros((B,), bool),
+    )
+    return AuditedProgram(
+        name=f"batch/{algo}",
+        fn=runner,
+        args=args,
+        budget=bucket_runner_budget(),
+        lower=lambda: runner.lower(*args).as_text(),
+    )
+
+
+for _algo in ("mgm", "maxsum"):
+    register_cell(f"batch/{_algo}")(
+        functools.partial(_batch_cell, _algo)
+    )
+
+
+# ---------------------------------------------------------------------------
+# sharded maxsum cells (PR 2/5 contracts)
+
+
+def _sharded_maxsum_cell(overlap: str, use_packed: bool,
+                         exchange: bool = False) -> AuditedProgram:
+    from pydcop_tpu.parallel.mesh import ShardedMaxSum
+
+    t = _ring_factor_tensors()
+    comp = ShardedMaxSum(
+        t, _mesh(), damping=0.5, use_packed=use_packed,
+        overlap=overlap, exchange=exchange,
+    )
+    comp._build()
+    keys = _one_cycle_keys(1)
+    if use_packed:
+        state, _ = comp.init_messages()
+        args = (state, keys) + tuple(comp._run_args)
+    else:
+        q, r = comp.init_messages()
+        args = (q, r, keys) + tuple(comp._run_args)
+    kind = "packed" if use_packed else "generic"
+    mode = "exchange" if exchange else overlap
+    return AuditedProgram(
+        name=f"sharded/maxsum/{kind}/{mode}",
+        fn=comp._run_n,
+        args=args,
+        budget=comp.program_budget(),
+    )
+
+
+for _ov, _pk, _ex in (
+    ("off", False, False),
+    ("exact", False, False),
+    ("exact", False, True),
+    ("stale", False, False),
+    ("off", True, False),
+    ("exact", True, False),
+):
+    _kind = "packed" if _pk else "generic"
+    _mode = "exchange" if _ex else _ov
+    register_cell(f"sharded/maxsum/{_kind}/{_mode}")(
+        functools.partial(_sharded_maxsum_cell, _ov, _pk, _ex)
+    )
+
+
+# ---------------------------------------------------------------------------
+# sharded local-search cells (PR 2/5 contracts)
+
+
+def _sharded_ls_cell(rule: str, overlap: str,
+                     use_packed: bool) -> AuditedProgram:
+    import jax.numpy as jnp
+
+    from pydcop_tpu.parallel.mesh import ShardedLocalSearch
+
+    params = (
+        {"activation": 0.7, "variant": "B"} if rule == "adsa" else {}
+    )
+    s = ShardedLocalSearch(
+        _ring_constraint_tensors(), _mesh(), rule=rule,
+        algo_params=params, use_packed=use_packed, overlap=overlap,
+    )
+    s._build()
+    keys = _one_cycle_keys(1)
+    compact = s.comm.compact
+    if use_packed:
+        x = jnp.zeros((1, s.packs.Vp), jnp.float32)
+        if compact:
+            x = jnp.zeros((s.n_shards, 1, s.packs.Vp), jnp.float32)
+    else:
+        V = s.base.n_vars
+        x = jnp.zeros((V,), jnp.int32)
+        if compact:
+            x = jnp.zeros((s.n_shards, V), jnp.int32)
+    args = (x, keys, s.initial_aux()) + tuple(
+        s._bucket_args) + tuple(s._extra_args)
+    kind = "packed" if use_packed else "generic"
+    return AuditedProgram(
+        name=f"sharded/{rule}/{kind}/{overlap}",
+        fn=s._run_n,
+        args=args,
+        budget=s.program_budget(),
+    )
+
+
+for _rule in LS_RULES:
+    for _ov in ("off", "exact"):
+        register_cell(f"sharded/{_rule}/generic/{_ov}")(
+            functools.partial(_sharded_ls_cell, _rule, _ov, False)
+        )
+for _rule, _ov in (("mgm", "off"), ("mgm", "exact"), ("dsa", "off")):
+    register_cell(f"sharded/{_rule}/packed/{_ov}")(
+        functools.partial(_sharded_ls_cell, _rule, _ov, True)
+    )
+
+
+# ---------------------------------------------------------------------------
+# separator-sharded exact DPOP cells (PR 9 contract)
+
+
+@functools.lru_cache(maxsize=None)
+def _dpop_engine():
+    from pydcop_tpu.graph import pseudotree
+    from pydcop_tpu.ops.dpop_shard import plan_tiled_sweep
+    from pydcop_tpu.parallel.dpop_mesh import ShardedSepDpop
+
+    dcop = _gc_dcop(V=12, E=16, seed=3)
+    tree = pseudotree.build_computation_graph(dcop)
+    mesh = _mesh(4)
+    plan = plan_tiled_sweep(
+        tree, dcop, "min", n_shards=int(mesh.devices.size)
+    )
+    eng = ShardedSepDpop(plan, mesh)
+    eng._build()
+    return eng
+
+
+def _dpop_util_cell() -> AuditedProgram:
+    eng = _dpop_engine()
+    L = len(eng.plan.base.levels)
+    # run the leaf level for real to get a concretely-shaped child
+    # message, then audit the first REAL util step (the one with the
+    # pruned-wire psum)
+    _tables, msg = eng._util_fns[L - 1](eng._local[L - 1])
+    li = L - 2
+    g_idx, g_valid, unpack = eng._wire[li + 1]
+    args = (eng._local[li], msg, eng._align[li + 1],
+            eng._pslot[li + 1], g_idx, g_valid, unpack)
+    return AuditedProgram(
+        name="sharded/dpop/util-step",
+        fn=eng._util_fns[li],
+        args=args,
+        budget=eng.util_step_budget(li),
+    )
+
+
+def _dpop_value_cell() -> AuditedProgram:
+    import jax.numpy as jnp
+
+    eng = _dpop_engine()
+    L = len(eng.plan.base.levels)
+    tables = [None] * L
+    msg = None
+    for li in range(L - 1, -1, -1):
+        if li == L - 1:
+            tables[li], msg = eng._util_fns[li](eng._local[li])
+        else:
+            g_idx, g_valid, unpack = eng._wire[li + 1]
+            tables[li], msg = eng._util_fns[li](
+                eng._local[li], msg, eng._align[li + 1],
+                eng._pslot[li + 1], g_idx, g_valid, unpack,
+            )
+    assign = jnp.zeros((eng.plan.base.n_nodes + 1,), jnp.int32)
+    sep_ids, node_ids, strides = eng._sep[0]
+    args = (assign, tables[0], sep_ids, node_ids, strides)
+    return AuditedProgram(
+        name="sharded/dpop/value-step",
+        fn=eng._value_fns[0],
+        args=args,
+        budget=eng.value_step_budget(0),
+    )
+
+
+register_cell("sharded/dpop/util-step")(_dpop_util_cell)
+register_cell("sharded/dpop/value-step")(_dpop_value_cell)

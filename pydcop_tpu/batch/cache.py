@@ -104,12 +104,13 @@ class CompileCache:
             return key in self._fns
 
     def stats(self) -> Dict[str, int]:
-        return {
-            "hits": self.hits,
-            "misses": self.misses,
-            "entries": len(self._fns),
-            "prewarmed": self.prewarmed,
-        }
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "entries": len(self._fns),
+                "prewarmed": self.prewarmed,
+            }
 
     def clear(self) -> None:
         with self._lock:

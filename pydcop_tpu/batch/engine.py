@@ -600,6 +600,24 @@ def runner_cache_key(algo: str, pkey: Tuple, signature: Tuple,
     return (algo, pkey) + tuple(signature) + ("chunk", chunk)
 
 
+#: structural slack for the runner's baked scan/iota constants
+RUNNER_CONST_SLACK_BYTES = 1 << 16
+
+
+def bucket_runner_budget():
+    """Declared per-cycle budget of the vmapped bucket runner (audited
+    by the ``pydcop_tpu.analysis`` registry sweep): like the
+    single-device harness — no collectives, no host callbacks, f32
+    tier — but with a near-ZERO constant budget: every instance array
+    arrives as a stacked ARGUMENT (that is what makes the runner
+    reusable across bucket fills and serve lane churn), so a closure
+    that starts baking instance data in would break cache reuse and
+    blows this cap."""
+    from pydcop_tpu.algorithms.base import harness_budget
+
+    return harness_budget(RUNNER_CONST_SLACK_BYTES)
+
+
 def build_bucket_runner(adapter: _AdapterBase, meta: BucketMeta,
                         params: Dict[str, Any], chunk: int):
     """ONE fixed-shape runner per bucket signature: always scans

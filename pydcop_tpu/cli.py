@@ -5,7 +5,8 @@ global options (-v verbosity, --timeout with a forced-exit slack timer,
 --output, --version, --log) and the subcommand tree (solve, run,
 orchestrator, agent, distribute, graph, generate, batch, replica_dist,
 consolidate) — plus ``serve``, the continuous-batching solve service
-(no reference twin; docs/serving.rst).
+(no reference twin; docs/serving.rst), and ``analyze``, the program
+auditor + source lint (docs/analysis.rst).
 """
 from __future__ import annotations
 
@@ -45,6 +46,7 @@ def make_parser() -> argparse.ArgumentParser:
     subparsers = parser.add_subparsers(dest="command", required=True)
     from pydcop_tpu.commands import (
         agent,
+        analyze,
         batch,
         consolidate,
         distribute,
@@ -61,7 +63,7 @@ def make_parser() -> argparse.ArgumentParser:
 
     for module in (solve, run, orchestrator, agent, distribute, graph,
                    generate, batch, replica_dist, consolidate, serve,
-                   portfolio, twin):
+                   portfolio, twin, analyze):
         module.set_parser(subparsers)
     return parser
 

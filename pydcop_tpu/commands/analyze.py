@@ -1,0 +1,94 @@
+"""`pydcop_tpu analyze` — the program auditor + source lint front door.
+
+No reference twin (docs/analysis.rst): ``program`` sweeps the budget
+registry — every engine×mode cycle program lowered, its jaxpr walked,
+and the measured collective/callback/dtype/constant footprint checked
+against the budget DECLARED next to its cycle function — and ``lint``
+runs the AST rules (tracer-hostile calls in cycle/chunk code, the
+serve-tier lock-discipline race check).  Both print a JSON scorecard
+and exit nonzero on any finding, so ``make analyze`` slots next to the
+smokes as a fast guard tier.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from time import perf_counter
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "analyze",
+        help="program auditor (declared budgets) + source lint",
+    )
+    sub = parser.add_subparsers(dest="analyze_cmd", required=True)
+
+    p = sub.add_parser(
+        "program",
+        help="audit every registered engine cycle program against "
+             "its declared budget",
+    )
+    p.set_defaults(func=_program)
+    p.add_argument("--cell", default=None,
+                   help="substring filter over registry cell names "
+                        "(default: the full sweep)")
+    p.add_argument("--list", action="store_true", dest="list_cells",
+                   help="list registry cells and exit")
+
+    p = sub.add_parser(
+        "lint",
+        help="AST lint: tracer hazards in cycle/chunk code + "
+             "lock-discipline races in the serving tier",
+    )
+    p.set_defaults(func=_lint)
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/directories to lint "
+                        "(default: pydcop_tpu/)")
+    p.add_argument("--rule", action="append", default=None,
+                   help="restrict to one or more rule ids "
+                        "(repeatable; see docs/analysis.rst)")
+
+
+def _emit(payload) -> None:
+    json.dump(payload, sys.stdout, indent=2, sort_keys=True)
+    sys.stdout.write("\n")
+
+
+def _program(args) -> int:
+    from pydcop_tpu.analysis.registry import audit_all, cell_names
+
+    if args.list_cells:
+        _emit({"cells": cell_names()})
+        return 0
+    t0 = perf_counter()
+    reports = audit_all(pattern=args.cell)
+    wall = perf_counter() - t0
+    findings = [
+        f.to_dict() for rep in reports.values() for f in rep.findings
+    ]
+    _emit({
+        "audited": len(reports),
+        "ok": not findings,
+        "findings": findings,
+        "scorecard": {
+            name: rep.scorecard for name, rep in reports.items()
+        },
+        "wall_s": round(wall, 3),
+    })
+    return 1 if findings else 0
+
+
+def _lint(args) -> int:
+    from pydcop_tpu.analysis.lint import DEFAULT_PATHS, lint_paths
+
+    paths = args.paths or list(DEFAULT_PATHS)
+    t0 = perf_counter()
+    findings = lint_paths(paths, rules=args.rule)
+    wall = perf_counter() - t0
+    _emit({
+        "paths": paths,
+        "ok": not findings,
+        "findings": [f.to_dict() for f in findings],
+        "wall_s": round(wall, 3),
+    })
+    return 1 if findings else 0

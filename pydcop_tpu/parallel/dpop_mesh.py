@@ -363,6 +363,51 @@ class ShardedSepDpop:
             )))
         self._steps_built = True
 
+    # ---- declared budgets (audited by pydcop_tpu.analysis) ----------------
+
+    def _step_budget(self, payload_bytes: int):
+        from pydcop_tpu.analysis.budget import (
+            COLLECTIVE_KINDS,
+            ProgramBudget,
+        )
+
+        counts = {k: 0 for k in COLLECTIVE_KINDS}
+        counts["psum"] = 1
+        return ProgramBudget(
+            collectives=counts,
+            max_collective_bytes=int(payload_bytes),
+            max_host_callbacks=0,
+            dtypes=frozenset(
+                {"float32", "int32", "uint32", "bool"}
+            ),
+            # the per-level step closes over nothing bulky: tables,
+            # alignment maps and the pruned wire all arrive as
+            # shard_map ARGUMENTS
+            max_const_bytes=1 << 16,
+            # tables are NOT donated: every level's table is kept for
+            # the VALUE pass
+            donate=False,
+        )
+
+    def util_step_budget(self, li: int):
+        """Declared budget of level ``li``'s UTIL step: exactly ONE
+        psum — the masked-gather reconstruction of the child message
+        from the PRUNED wire (each entry has exactly one valid
+        contributor, so the sum is f32-exact) — sized by the wire
+        block, never the dense separator space."""
+        g_idx = self._wire[li + 1][0]
+        per_dev = int(np.prod(g_idx.shape)) // max(
+            1, self.plan.n_shards
+        )
+        return self._step_budget(4 * per_dev)
+
+    def value_step_budget(self, li: int):
+        """Declared budget of level ``li``'s VALUE step: ONE psum of
+        the [B, Dmax] argmin column slab (exactly one device holds
+        each addressed column; the rest contribute exact zeros)."""
+        lv = self.plan.base.levels[li]
+        return self._step_budget(4 * lv.B * self.plan.base.Dmax)
+
     # ---- execution --------------------------------------------------------
 
     def run(self) -> np.ndarray:

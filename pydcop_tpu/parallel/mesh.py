@@ -486,6 +486,45 @@ class _CommPlanMixin:
         dict (``SolveResult.metrics()['shard']``, bench extras)."""
         return self.comm.counters(self.n_shards).as_dict()
 
+    #: dtype tier of the sharded cycle programs (the harness tier;
+    #: ppermute exchange plans add int32 routing tables, already in;
+    #: ``key<fry>`` is the typed-PRNG-key aval of in-cycle coin draws)
+    SHARDED_DTYPES = frozenset({
+        "float32", "int32", "uint32", "bool", "int8", "key<fry>",
+    })
+    #: structural-constant allowance of a sharded cycle program:
+    #: iota/slot-map/routing constants, NOT cost tables (those travel
+    #: as run_n ARGUMENTS — what keeps edit_factor a zero-retrace
+    #: in-place write, PR 8)
+    SHARDED_CONST_SLACK = 1 << 16
+
+    def _comm_budget(self, counts, extra_const: int = 0):
+        """Assemble a ProgramBudget from a per-cycle collective count
+        map + the plan's payload geometry — the declared half of the
+        PR 2/5 one-collective-per-cycle contracts, audited against the
+        traced program by the analysis registry sweep."""
+        from pydcop_tpu.analysis.budget import (
+            COLLECTIVE_KINDS,
+            ProgramBudget,
+        )
+
+        plan = self.comm
+        width = (
+            plan.width_dense if plan.mode == "dense"
+            else plan.width_compact
+        )
+        payload = 4 * max(1, width) * max(1, plan.rows)
+        full = {k: 0 for k in COLLECTIVE_KINDS}
+        full.update(counts)
+        return ProgramBudget(
+            collectives=full,
+            max_collective_bytes=payload,
+            max_host_callbacks=0,
+            dtypes=self.SHARDED_DTYPES,
+            max_const_bytes=self.SHARDED_CONST_SLACK + extra_const,
+            donate=True,
+        )
+
 
 class ShardedMaxSum(_CommPlanMixin):
     """MaxSum over a device mesh: one psum of partial beliefs per cycle.
@@ -545,6 +584,21 @@ class ShardedMaxSum(_CommPlanMixin):
                        engine="maxsum", packed=self.packs is not None)
         self._run_n = None
         self._finalize = None
+
+    def program_budget(self):
+        """Declared per-cycle budget of the maxsum cycle program
+        (next to the cycle fns below; audited by the analysis registry
+        sweep): ONE belief combine per cycle — a psum of the dense
+        space or the compact boundary slab, or the edge-colored
+        ppermute rounds — and nothing else."""
+        plan = self.comm
+        if plan.collective == "none":
+            counts = {}
+        elif plan.collective == "ppermute":
+            counts = {"ppermute": max(1, len(plan.rounds or ()))}
+        else:
+            counts = {"psum": 1}
+        return self._comm_budget(counts)
 
     # -- kernel -------------------------------------------------------------
 
@@ -1692,6 +1746,31 @@ class ShardedLocalSearch(_CommPlanMixin):
                     rows = rows * w
                 partial = partial + segment_sum(rows, vi_blk[:, p], V + 1)
         return partial
+
+    def program_budget(self):
+        """Declared per-cycle budget of the local-search cycle program
+        (audited by the analysis registry sweep): ONE cost-table psum
+        per cycle, plus — for the neighborhood-arbitrating rules on
+        the packed engine — exactly one pmax/pmin pair of routed-gain
+        partials (PR 2's collective contract).  The generic engine
+        arbitrates on replicated state: no extra collectives."""
+        plan = self.comm
+        arbitrates = self.rule in ("mgm", "dba", "gdba")
+        counts = {}
+        if plan.collective == "ppermute":
+            # arbitrating rules exchange three slabs per round:
+            # routed-gain tables plus the neighborhood-max and
+            # tiebreak partials
+            per_round = 3 if arbitrates else 1
+            counts["ppermute"] = per_round * max(
+                1, len(plan.rounds or ())
+            )
+        elif plan.collective != "none" or plan.mode == "dense":
+            counts["psum"] = 1
+            if self.packs is not None and arbitrates:
+                counts["pmax"] = 1
+                counts["pmin"] = 1
+        return self._comm_budget(counts)
 
     # -- rule-specific sharded extras ---------------------------------------
 

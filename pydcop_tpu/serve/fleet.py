@@ -118,14 +118,26 @@ class FleetJournal:
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
+        #: records appended by this process + the most recent one —
+        #: read through :meth:`stats` (supervisor thread writes, front
+        #: door reads: both sides hold the lock)
+        self.appended = 0
+        self._tail: Optional[Dict[str, Any]] = None
 
     def append(self, rec: Dict[str, Any]) -> None:
         line = json.dumps(rec, sort_keys=True) + "\n"
         with self._lock:
+            self.appended += 1
+            self._tail = rec
             with open(self.path, "a", encoding="utf-8") as f:
                 f.write(line)
                 f.flush()
                 os.fsync(f.fileno())
+
+    def stats(self) -> Dict[str, Any]:
+        """{appended, last record} of this process's journal stream."""
+        with self._lock:
+            return {"appended": self.appended, "last": self._tail}
 
     def load(self) -> Tuple[List[Dict[str, Any]], int]:
         """(records, torn line count) — torn/glued lines are skipped
@@ -455,11 +467,13 @@ class SolveFleet:
         deadline lanes below ``exempt_priority`` to ``factor`` of
         their remaining budget — see
         :meth:`SolveService.set_deadline_pressure`."""
-        for h in self._handles.values():
-            if h.up and not h.dead:
-                h.service.set_deadline_pressure(
-                    factor, exempt_priority=exempt_priority
-                )
+        with self._lock:
+            live = [h for h in self._handles.values()
+                    if h.up and not h.dead]
+        for h in live:
+            h.service.set_deadline_pressure(
+                factor, exempt_priority=exempt_priority
+            )
 
     def submit(
         self,
@@ -709,7 +723,8 @@ class SolveFleet:
         serving replica is named in ``metrics()["serve"]``.  Raises
         :class:`ServiceStopped` instead of hanging when every replica
         is down."""
-        fj = self._jobs[jid]
+        with self._lock:
+            fj = self._jobs[jid]
         deadline = None if timeout is None else monotonic() + timeout
         while not fj.done.is_set():
             self._raise_if_dead()
@@ -721,12 +736,16 @@ class SolveFleet:
                     f"job {jid} not done within {timeout}s"
                 )
             fj.done.wait(0.1 if remain is None else min(0.1, remain))
-        assert fj.result is not None
-        return fj.result
+        with self._lock:
+            res = fj.result
+        assert res is not None
+        return res
 
     def wait_all(self, timeout: Optional[float] = None) -> bool:
         deadline = None if timeout is None else monotonic() + timeout
-        for fj in list(self._jobs.values()):
+        with self._lock:
+            jobs = list(self._jobs.values())
+        for fj in jobs:
             while not fj.done.is_set():
                 self._raise_if_dead()
                 remain = (
@@ -800,12 +819,15 @@ class SolveFleet:
         own interval while replicas tick themselves."""
         self._supervise()
         busy = False
-        for h in self._handles.values():
-            if h.up and not h.dead:
-                busy = h.service.tick() or busy
-        undone = any(
-            not fj.done.is_set() for fj in self._jobs.values()
-        )
+        with self._lock:
+            live = [h for h in self._handles.values()
+                    if h.up and not h.dead]
+        for h in live:
+            busy = h.service.tick() or busy
+        with self._lock:
+            undone = any(
+                not fj.done.is_set() for fj in self._jobs.values()
+            )
         return (busy or undone) and bool(self.router.up())
 
     def _supervise(self) -> None:
@@ -824,7 +846,9 @@ class SolveFleet:
         # route around (stall != death — re-seating a stalled-but-
         # alive replica's jobs would race its own completions)
         for h in list(self._handles.values()):
-            if not h.up:
+            with self._lock:
+                h_up = h.up
+            if not h_up:
                 continue
             if h.dead:
                 self._replica_down(
@@ -838,23 +862,32 @@ class SolveFleet:
                 stale = bool(stalled_ranks(
                     {0: h.hb_path}, self.heartbeat_timeout
                 ))
-                if stale and not h.stalled:
-                    h.stalled = True
+                with self._lock:
+                    flipped = (
+                        "stale" if stale and not h.stalled
+                        else "healed" if not stale and h.stalled
+                        else None
+                    )
+                    if flipped:
+                        h.stalled = stale
+                if flipped == "stale":
                     self.router.set_stalled(h.name, True)
                     self.counters.inc("replicas_stalled")
                     send_fleet("replica.stalled", {"name": h.name})
-                elif not stale and h.stalled:
-                    h.stalled = False
+                elif flipped == "healed":
                     self.router.set_stalled(h.name, False)
                     self.counters.inc("replicas_healed")
                     send_fleet("replica.healed", {
                         "name": h.name, "was": "stalled",
                     })
-            if (
-                h.partition_until is not None
-                and h.partition_until <= now
-            ):
-                h.partition_until = None
+            with self._lock:
+                heal_partition = (
+                    h.partition_until is not None
+                    and h.partition_until <= now
+                )
+                if heal_partition:
+                    h.partition_until = None
+            if heal_partition:
                 self.router.set_partitioned(h.name, False)
                 self.counters.inc("replicas_healed")
                 send_fleet("replica.healed", {
@@ -862,21 +895,25 @@ class SolveFleet:
                 })
 
     def _inject(self, kind: str, fault, now: float) -> None:
+        # analyze: waive[unlocked-shared-attr] fault.replica is the immutable FaultSpec field, not FleetJob.replica — attribute-name collision
         h = self.handle(int(fault.replica))
         self.counters.inc("faults_injected")
         send_fleet("fault.injected", {
             "kind": kind, "replica": h.name, "tick": self._ticks,
         })
         if kind == "kill_replica":
-            if h.up and not h.killed:
+            with self._lock:
+                live = h.up and not h.killed
+            if live:
                 h.kill()
         elif kind == "stall_replica":
             h.service.stall_for(fault.duration)
         elif kind == "partition_replica":
-            h.partition_until = (
-                now + fault.duration if fault.duration > 0
-                else float("inf")
-            )
+            with self._lock:
+                h.partition_until = (
+                    now + fault.duration if fault.duration > 0
+                    else float("inf")
+                )
             self.router.set_partitioned(h.name, True)
             self.counters.inc("replicas_partitioned")
             send_fleet("replica.partitioned", {
@@ -885,7 +922,8 @@ class SolveFleet:
 
     def _replica_down(self, h: ReplicaHandle, reason: str,
                       t_detect: float) -> None:
-        h.up = False
+        with self._lock:
+            h.up = False
         self.router.mark_down(h.name)
         self.counters.inc("replicas_down")
         send_fleet("replica.down", {"name": h.name, "reason": reason})
@@ -959,6 +997,13 @@ class SolveFleet:
                 placed = self.router.place(
                     fj.key, jid=fj.jid, exclude=dead.name
                 )
+                if placed is not None:
+                    # placement bookkeeping in the same critical
+                    # section as the routing decision: a concurrent
+                    # _replica_down scanning fj.replica for orphans
+                    # must see the new seat, never the dead one
+                    fj.replica = placed[0]
+                    fj.reseats += 1
             if placed is None:
                 self._fail_job(
                     fj, "replica lost with no routable peer"
@@ -983,9 +1028,6 @@ class SolveFleet:
                         block=True,
                     )
                 self.counters.inc("reseat_cold_restarts")
-            with self._lock:
-                fj.replica = peer_name
-                fj.reseats += 1
             self.counters.inc("jobs_reseated")
             send_fleet("job.reseated", {
                 "jid": fj.jid, "from": dead.name, "to": peer_name,
@@ -1008,10 +1050,7 @@ class SolveFleet:
                  for k, v in rec.items() if k != "t_detect"}
                 for rec in self.recoveries
             ]
-        return {
-            "fleet": self.counters.as_dict(),
-            "router": self.router.stats(),
-            "replicas": {
+            replicas = {
                 name: {
                     "up": h.up,
                     "stalled": h.stalled,
@@ -1020,10 +1059,19 @@ class SolveFleet:
                     "cache": h.service.cache.stats(),
                 }
                 for name, h in self._handles.items()
-            },
+            }
+        return {
+            "fleet": self.counters.as_dict(),
+            "router": self.router.stats(),
+            "replicas": replicas,
+            "journal": (
+                self.journal.stats() if self.journal is not None
+                else None
+            ),
             "pending": sum(
-                h.service._backlog for h in self._handles.values()
-                if h.up
+                h.service._backlog
+                for name, h in self._handles.items()
+                if replicas[name]["up"]
             ),
             "recoveries": recov,
         }

@@ -374,7 +374,10 @@ class SolveService:
         peer replica or a restarted service to recover from.  Blocked
         :meth:`result` callers raise :class:`ServiceStopped` through
         the liveness gate instead of hanging."""
-        self._failure = RuntimeError("replica halted (injected kill)")
+        with self._lock:
+            self._failure = RuntimeError(
+                "replica halted (injected kill)"
+            )
         self._stop = True
         self._wake.set()
 
@@ -404,7 +407,8 @@ class SolveService:
         :meth:`tick`, on the scheduler thread itself, so the heartbeat
         file goes genuinely stale — from the supervisor's viewpoint
         this is indistinguishable from a real wedged collective."""
-        self._stall_until = monotonic() + float(duration)
+        with self._lock:
+            self._stall_until = monotonic() + float(duration)
 
     def __enter__(self) -> "SolveService":
         self.start()
@@ -418,9 +422,11 @@ class SolveService:
         that died (supervisor exhausted, thread killed) or was stopped
         with work in flight will never complete anything again —
         callers get :class:`ServiceStopped`, not a silent hang."""
-        if self._failure is not None:
+        with self._lock:
+            failure = self._failure
+        if failure is not None:
             raise ServiceStopped(
-                f"scheduler thread died: {self._failure!r}"
+                f"scheduler thread died: {failure!r}"
             )
         if not self._thread_started:
             return  # synchronous tick() driving: no thread to die
@@ -438,7 +444,9 @@ class SolveService:
         Raises :class:`ServiceStopped` instead of blocking forever when
         the scheduler thread is dead."""
         deadline = None if timeout is None else monotonic() + timeout
-        for job in list(self._jobs.values()):
+        with self._lock:
+            jobs = list(self._jobs.values())
+        for job in jobs:
             while not job.done.is_set():
                 self._raise_if_dead()
                 remain = (
@@ -644,7 +652,8 @@ class SolveService:
         Raises :class:`ServiceStopped` — instead of blocking forever —
         when the scheduler thread died or the service was stopped with
         the job still in flight."""
-        job = self._jobs[jid]
+        with self._lock:
+            job = self._jobs[jid]
         deadline = None if timeout is None else monotonic() + timeout
         while not job.done.is_set():
             self._raise_if_dead()
@@ -654,13 +663,16 @@ class SolveService:
                     f"job {jid} not done within {timeout}s"
                 )
             job.done.wait(0.1 if remain is None else min(0.1, remain))
-        if job.service_stopped:
+        with self._lock:
+            stopped, failure = job.service_stopped, self._failure
+            res = job.result
+        if stopped:
             raise ServiceStopped(
                 f"job {jid} failed: scheduler thread died "
-                f"({self._failure!r})"
+                f"({failure!r})"
             )
-        assert job.result is not None
-        return job.result
+        assert res is not None
+        return res
 
     def stream(self, jid: str, timeout: float = 60.0
                ) -> Iterator[Dict[str, Any]]:
@@ -670,7 +682,8 @@ class SolveService:
         must have been submitted with ``stream=True``.  ``timeout``
         bounds the gap between consecutive events; a dead scheduler
         raises :class:`ServiceStopped` instead of a silent stall."""
-        job = self._jobs[jid]
+        with self._lock:
+            job = self._jobs[jid]
         deadline = monotonic() + timeout
         while True:
             remain = deadline - monotonic()
@@ -864,15 +877,19 @@ class SolveService:
                 self._wake.clear()
 
     def _scheduler_died(self, exc: BaseException) -> None:
-        self._failure = exc
+        with self._lock:
+            self._failure = exc
         send_serve("fault.scheduler_dead", {
             "error": str(exc),
             "restarts": self.max_scheduler_restarts,
         })
-        for job in list(self._jobs.values()):
+        with self._lock:
+            jobs = list(self._jobs.values())
+        for job in jobs:
             if job.done.is_set():
                 continue
-            job.service_stopped = True
+            with self._lock:
+                job.service_stopped = True
             try:
                 self._complete(job, SolveResult(
                     status="ERROR", assignment={}, cost=None,
@@ -893,9 +910,11 @@ class SolveService:
         (:meth:`_quarantine_worker`) — the failure never escapes to
         the other buckets or, in thread mode, past the supervisor."""
         self._ticks += 1
-        if self._stall_until:
-            remain = self._stall_until - monotonic()
+        with self._lock:
+            stall = self._stall_until
             self._stall_until = 0.0
+        if stall:
+            remain = stall - monotonic()
             if remain > 0:
                 sleep(remain)  # wedged: the heartbeat goes stale too
         if self._hb is not None:
@@ -915,7 +934,9 @@ class SolveService:
                 })
                 sleep(f.duration)
         self._admit_pending()
-        for w in list(self._workers):
+        with self._lock:
+            workers = list(self._workers)
+        for w in workers:
             if w.occupied == 0:
                 continue
             try:
@@ -1014,8 +1035,9 @@ class SolveService:
         lane replays the standalone stream, so healthy results stay
         bit-identical to a fault-free run."""
         jobs = [ln.job for ln in w.lanes if ln is not None]
-        if w in self._workers:
-            self._workers.remove(w)
+        with self._lock:
+            if w in self._workers:
+                self._workers.remove(w)
         self.counters.inc("buckets_failed")
         send_serve("fault.bucket_failed", {
             "algo": w.algo, "error": str(exc),
@@ -1064,7 +1086,8 @@ class SolveService:
         if job.retries <= self.max_job_retries:
             delay = min(self.backoff_max,
                         self.backoff_base * (2 ** (job.retries - 1)))
-            job.not_before = monotonic() + delay
+            with self._lock:
+                job.not_before = monotonic() + delay
             self.counters.inc("jobs_retried")
             send_serve("fault.retry", {
                 "jid": job.jid, "attempt": job.retries,
@@ -1125,7 +1148,9 @@ class SolveService:
         leftover: List[ServeJob] = []
         not_ready: List[ServeJob] = []
         for job in pending:
-            if job.not_before > now:  # quarantine backoff gate
+            with self._lock:
+                gated = job.not_before > now
+            if gated:  # quarantine backoff gate
                 not_ready.append(job)
                 continue
             ready = self._prepare(job)
@@ -1146,10 +1171,12 @@ class SolveService:
         # ``max_buckets``: beyond it jobs queue for the next freed lane
         # instead of growing the working set without limit
         while leftover:
-            if (
-                self.max_buckets is not None
-                and len(self._workers) >= self.max_buckets
-            ):
+            with self._lock:
+                full = (
+                    self.max_buckets is not None
+                    and len(self._workers) >= self.max_buckets
+                )
+            if full:
                 with self._lock:
                     self._pending.extend(leftover)
                 break
@@ -1189,7 +1216,9 @@ class SolveService:
 
     def _try_admit(self, job: ServeJob) -> bool:
         pkey = _params_key(job.algo_params)
-        for w in self._workers:
+        with self._lock:
+            workers = list(self._workers)
+        for w in workers:
             if w.isolate_key != job.isolate_key:
                 continue  # quarantine groups never mix
             if not (w.matches(job.algo, pkey) and w.free > 0):
@@ -1260,10 +1289,11 @@ class SolveService:
             )
             return jobs[1:]
         w.isolate_key = head.isolate_key
-        w.deadline_pressure, w.pressure_exempt_priority = (
-            self._deadline_pressure
-        )
-        self._workers.append(w)
+        with self._lock:
+            w.deadline_pressure, w.pressure_exempt_priority = (
+                self._deadline_pressure
+            )
+            self._workers.append(w)
         self.counters.inc("buckets_opened")
         send_serve("bucket.opened", {
             "algo": w.algo, "lanes": w.B, "warm": w.runner_was_warm,
@@ -1293,9 +1323,11 @@ class SolveService:
         the whole group — admission then hits the warm runner — else
         the group's own pooled target."""
         candidates = list(self._prewarmed.get((algo, pkey), []))
-        candidates += [
-            w.target for w in self._workers if w.matches(algo, pkey)
-        ]
+        with self._lock:
+            candidates += [
+                w.target for w in self._workers
+                if w.matches(algo, pkey)
+            ]
         for t in candidates:
             if all(fits(d, t) for d in dims):
                 return t
@@ -1303,8 +1335,10 @@ class SolveService:
 
     def _maintain_workers(self) -> None:
         # merge under-filled same-signature buckets (smaller → larger)
+        with self._lock:
+            workers = list(self._workers)
         by_sig: Dict[Tuple, List[BucketWorker]] = {}
-        for w in self._workers:
+        for w in workers:
             if 0 < w.occupied <= max(1, int(w.B * self.merge_below)):
                 by_sig.setdefault(
                     (w.algo, w.pkey, w.isolate_key) + w.signature, []
@@ -1325,9 +1359,12 @@ class SolveService:
                         "signature": [str(s) for s in dst.signature],
                     })
         # close drained buckets (their compiled runner stays cached)
-        for w in list(self._workers):
+        for w in workers:
             if w.occupied == 0 and w.steps > 0:
-                self._workers.remove(w)
+                with self._lock:
+                    if w not in self._workers:
+                        continue
+                    self._workers.remove(w)
                 self.counters.inc("buckets_closed")
                 send_serve("bucket.closed", {
                     "algo": w.algo,
@@ -1373,7 +1410,8 @@ class SolveService:
                   error: Optional[str] = None) -> None:
         if job.done.is_set():
             return  # already terminal (defensive: double release)
-        job.result = res
+        with self._lock:
+            job.result = res
         now = monotonic()
         with self._lock:
             if job.in_backlog:
@@ -1433,6 +1471,7 @@ class SolveService:
         line = json.dumps(rec, sort_keys=True) + "\n"
         inj = self._injector
         if inj is not None:
+            # analyze: waive[unlocked-shared-attr] advisory tick stamp for the fault injector; a torn int read is impossible under the GIL
             f_t = inj.due("torn_journal_write", self._ticks,
                           jid=job.jid)
             if f_t is not None:
@@ -1451,7 +1490,8 @@ class SolveService:
                 os.fsync(f.fileno())
 
     def _journal_done(self, jid: str) -> None:
-        self._done_jids.add(jid)
+        with self._lock:
+            self._done_jids.add(jid)
         if not self.journal_dir:
             return
         # the batch command's JID resume protocol: append + fsync per
@@ -1651,7 +1691,9 @@ class SolveService:
                 # keep resuming
                 torn += 1
                 continue
-            if jid in self._done_jids or jid in self._jobs:
+            with self._lock:
+                seen = jid in self._done_jids or jid in self._jobs
+            if seen:
                 continue
             if not rec.get("file"):
                 continue  # not resumable without a source

@@ -1,0 +1,88 @@
+"""`pydcop_tpu analyze {program,lint}` front door (ISSUE 13).
+
+Fast CLI surface: the lint half end-to-end on fixture files (findings
+as JSON, nonzero exit), the registry listing, and one single-cell
+program audit.  The full 8-device program sweep rides `make analyze`
+and the slow-marked sweep test in tests/unit/test_analysis.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+
+
+def _run(*args, timeout=300):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu", *args],
+        capture_output=True, text=True, env=env, cwd=REPO,
+        timeout=timeout,
+    )
+
+
+class TestAnalyzeLintCli:
+    def test_violating_file_exits_nonzero_with_findings(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import time\n"
+            "def cycle_fn(x):\n"
+            "    t = time.time()\n"
+            "    return x\n"
+        )
+        out = _run("analyze", "lint", str(bad))
+        assert out.returncode == 1, out.stdout + out.stderr
+        payload = json.loads(out.stdout)
+        assert not payload["ok"]
+        assert payload["findings"][0]["rule"] == "time-in-jit"
+        assert payload["findings"][0]["line"] == 3
+
+    def test_clean_file_exits_zero(self, tmp_path):
+        good = tmp_path / "good.py"
+        good.write_text("def helper(x):\n    return x + 1\n")
+        out = _run("analyze", "lint", str(good))
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert json.loads(out.stdout)["ok"]
+
+    def test_shipped_tree_lints_clean_via_cli(self):
+        out = _run("analyze", "lint")
+        assert out.returncode == 0, out.stdout + out.stderr
+        payload = json.loads(out.stdout)
+        assert payload["ok"] and payload["findings"] == []
+
+    def test_rule_filter(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import time, numpy as np\n"
+            "def cycle_fn(x):\n"
+            "    t = time.time()\n"
+            "    u = np.random.uniform()\n"
+            "    return x\n"
+        )
+        out = _run("analyze", "lint", str(bad),
+                   "--rule", "global-rng-in-jit")
+        payload = json.loads(out.stdout)
+        assert [f["rule"] for f in payload["findings"]] == [
+            "global-rng-in-jit"
+        ]
+
+
+class TestAnalyzeProgramCli:
+    def test_list_cells(self):
+        out = _run("analyze", "program", "--list")
+        assert out.returncode == 0, out.stdout + out.stderr
+        cells = json.loads(out.stdout)["cells"]
+        assert len(cells) >= 20
+        assert "single/mgm" in cells
+
+    def test_single_cell_audit_exits_zero(self):
+        out = _run("analyze", "program", "--cell", "single/mgm")
+        assert out.returncode == 0, out.stdout + out.stderr
+        payload = json.loads(out.stdout)
+        assert payload["ok"] and payload["audited"] == 1
+        sc = payload["scorecard"]["single/mgm"]
+        assert sc["host_callbacks"] == 0
+        assert sc["collectives"]["psum"] == 0

@@ -1,0 +1,526 @@
+"""Program auditor + budget registry + source lint (ISSUE 13).
+
+The contract under test:
+
+* every registered engine×mode cycle program audits CLEAN against the
+  budget declared next to its cycle fn — ONE parametrized sweep
+  replacing the ad-hoc per-file jaxpr pins (the matrix covers ≥ 20
+  programs: single-device harness, warm, batch bucket runner, sharded
+  generic/packed × dense/compact/stale/exchange, DPOP per-level
+  steps);
+* a budget with ANY field (or collective kind) left undeclared fails
+  loudly — an engine cannot opt out of a dimension by forgetting it;
+* each auditor check fires on a violating program (collective count /
+  payload bytes / host callback / dtype tier / embedded constants);
+* each lint rule has a minimal positive fixture that fires and a
+  negative that stays silent; waivers suppress only WITH a reason;
+* removing ANY ``with self._lock:`` acquisition in serve/fleet.py
+  makes the race rule fire (mutated-copy sweep) — the lock discipline
+  is load-bearing, not decorative;
+* the docs rule catalog (docs/analysis.rst) stays in sync with
+  ``LINT_RULES`` and the ``ProgramBudget`` fields (PR 12
+  fault-catalog style).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pydcop_tpu.analysis import (
+    COLLECTIVE_KINDS,
+    BudgetUndeclared,
+    LINT_RULES,
+    ProgramBudget,
+    audit_program,
+    lint_source,
+)
+from pydcop_tpu.analysis import registry
+from pydcop_tpu.analysis.auditor import donation_applied
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+
+
+def full_budget(**over):
+    base = dict(
+        collectives={k: 0 for k in COLLECTIVE_KINDS},
+        max_collective_bytes=0,
+        max_host_callbacks=0,
+        dtypes={"float32", "int32", "uint32", "bool", "key<fry>"},
+        max_const_bytes=1 << 20,
+        donate=False,
+    )
+    base.update(over)
+    return ProgramBudget(**base)
+
+
+# ---------------------------------------------------------------------------
+# budget declaration discipline
+
+
+class TestBudgetDeclarations:
+    def test_undeclared_field_fails_loudly(self):
+        budget = ProgramBudget(max_host_callbacks=0)
+        with pytest.raises(BudgetUndeclared, match="collectives"):
+            audit_program(lambda x: x * 2, (jnp.zeros(3),), budget)
+
+    def test_undeclared_collective_kind_fails_loudly(self):
+        budget = full_budget(collectives={"psum": 1})
+        with pytest.raises(BudgetUndeclared, match="ppermute"):
+            audit_program(lambda x: x * 2, (jnp.zeros(3),), budget)
+
+    def test_fully_declared_budget_passes_validate(self):
+        full_budget().validate()
+
+
+# ---------------------------------------------------------------------------
+# auditor checks, each demonstrated on a violating program
+
+
+class TestAuditorChecks:
+    def test_clean_program_audits_clean(self):
+        rep = audit_program(
+            lambda x: jnp.sum(x * 2), (jnp.zeros(3),), full_budget(),
+            name="t",
+        )
+        assert rep.ok
+        assert rep.scorecard["host_callbacks"] == 0
+
+    def test_host_callback_detected(self):
+        def f(x):
+            return jax.pure_callback(
+                lambda v: np.asarray(v),
+                jax.ShapeDtypeStruct((), jnp.float32), x[0],
+            )
+
+        rep = audit_program(f, (jnp.zeros(3),), full_budget())
+        assert [g.rule for g in rep.findings] == ["budget-host-callback"]
+        assert rep.scorecard["host_callbacks"] == 1
+
+    def test_dtype_tier_violation_detected(self):
+        rep = audit_program(
+            lambda x: x * 2.0, (jnp.zeros(3),),
+            full_budget(dtypes={"int32"}),
+        )
+        assert any(g.rule == "budget-dtype" for g in rep.findings)
+
+    def test_embedded_constant_bytes_detected(self):
+        table = jnp.asarray(
+            np.random.default_rng(0).uniform(size=(64, 64))
+            .astype(np.float32)
+        )
+
+        def f(x):
+            return jnp.sum(x[:, None] * table)
+
+        rep = audit_program(
+            f, (jnp.zeros(64),), full_budget(max_const_bytes=128)
+        )
+        assert any(
+            g.rule == "budget-const-bytes" for g in rep.findings
+        )
+        assert rep.scorecard["const_bytes"] >= 64 * 64 * 4
+
+    def test_collective_count_violation_detected(self):
+        """The dense sharded maxsum program against a ZERO-psum budget
+        — the regression shape the old pin tests guarded."""
+        prog = registry.build_cell("sharded/maxsum/generic/off")
+        tight = dataclasses.replace(
+            prog.budget, collectives={k: 0 for k in COLLECTIVE_KINDS}
+        )
+        rep = audit_program(prog.fn, prog.args, tight)
+        assert any(
+            g.rule == "budget-collective-count" for g in rep.findings
+        )
+
+    def test_collective_payload_violation_detected(self):
+        """Dense payload against the compact slab's byte cap — the
+        'compact mode regressed to whole-space psum' failure mode."""
+        prog = registry.build_cell("sharded/maxsum/generic/off")
+        compact = registry.build_cell("sharded/maxsum/generic/exact")
+        cap = compact.budget.max_collective_bytes
+        assert cap < prog.budget.max_collective_bytes
+        tight = dataclasses.replace(
+            prog.budget, max_collective_bytes=cap
+        )
+        rep = audit_program(prog.fn, prog.args, tight)
+        assert any(
+            g.rule == "budget-collective-bytes" for g in rep.findings
+        )
+
+    def test_donation_marks_detected_in_lowering(self):
+        """The StableHLO aliasing matcher itself (CPU lowering still
+        MARKS donation; XLA:CPU merely drops it at compile — so the
+        audit records 'skipped' on CPU but the matcher is testable)."""
+        x = jnp.zeros((8,), jnp.float32)
+        with_don = jax.jit(
+            lambda v: v * 2, donate_argnums=(0,)
+        ).lower(x).as_text()
+        without = jax.jit(lambda v: v * 2).lower(x).as_text()
+        assert donation_applied(with_don)
+        assert not donation_applied(without)
+
+    def test_donation_skipped_on_cpu_backend(self):
+        from pydcop_tpu.algorithms.base import donation_supported
+
+        rep = audit_program(
+            lambda x: x * 2, (jnp.zeros(3),),
+            full_budget(donate=True),
+        )
+        if not donation_supported():
+            assert rep.scorecard["donation"].startswith("skipped")
+            assert rep.ok
+
+
+# ---------------------------------------------------------------------------
+# the registry sweep: every engine×mode cell within its declared budget
+
+
+class TestBudgetSweep:
+    def test_matrix_covers_the_engine_modes(self):
+        names = registry.cell_names()
+        assert len(names) >= 20
+        for token in (
+            "single/maxsum", "single/gdba", "warm/maxsum",
+            "batch/mgm", "sharded/maxsum/generic/off",
+            "sharded/maxsum/generic/exact",
+            "sharded/maxsum/generic/stale",
+            "sharded/maxsum/packed/exact",
+            "sharded/mgm/packed/off", "sharded/dpop/util-step",
+        ):
+            assert token in names, token
+
+    @pytest.mark.parametrize("cell", registry.cell_names())
+    def test_cell_within_declared_budget(self, cell):
+        rep = registry.audit_cell(cell)
+        assert rep.ok, [f.to_dict() for f in rep.findings]
+
+    def test_warm_engines_bake_less_than_cold(self):
+        """The PR 8 operand-carry contract, via the auditor: a warm
+        cycle program embeds strictly fewer constant bytes than its
+        cold twin (tables travel as arguments, not closures)."""
+        cold = registry.audit_cell("single/mgm").scorecard
+        warm = registry.audit_cell("warm/mgm").scorecard
+        assert warm["const_bytes"] < cold["const_bytes"]
+
+    def test_batch_runner_bakes_nothing(self):
+        sc = registry.audit_cell("batch/mgm").scorecard
+        assert sc["const_bytes"] == 0
+
+    def test_sweep_has_zero_host_callbacks_everywhere(self):
+        for cell in ("single/maxsum", "sharded/maxsum/generic/exact",
+                     "batch/maxsum"):
+            assert registry.audit_cell(cell).scorecard[
+                "host_callbacks"] == 0
+
+
+# ---------------------------------------------------------------------------
+# lint rules: positive fires / negative silent, per rule
+
+
+class TestLintTracerRules:
+    def test_host_pull_positive(self):
+        src = (
+            "import numpy as np\n"
+            "def cycle_fn(x, key):\n"
+            "    a = np.asarray(x)\n"
+            "    b = float(x)\n"
+            "    c = x.item()\n"
+            "    return x\n"
+        )
+        rules = [f.rule for f in lint_source(src)]
+        assert rules.count("host-pull-in-jit") == 3
+
+    def test_host_pull_negative(self):
+        src = (
+            "import numpy as np\n"
+            "def cycle_fn(x, key):\n"
+            "    n = int(x.shape[0])\n"          # static metadata
+            "    idx = np.arange(4)\n"           # static constant
+            "    return x * 2\n"
+            "def host_helper(y):\n"
+            "    return float(np.asarray(y))\n"  # not a traced scope
+        )
+        assert lint_source(src) == []
+
+    def test_time_positive_and_negative(self):
+        pos = (
+            "import time\n"
+            "def run_chunk(state, keys):\n"
+            "    t0 = time.time()\n"
+            "    return state\n"
+        )
+        assert [f.rule for f in lint_source(pos)] == ["time-in-jit"]
+        neg = (
+            "import time\n"
+            "def drive(state):\n"
+            "    t0 = time.perf_counter()\n"
+            "    return state\n"
+        )
+        assert lint_source(neg) == []
+
+    def test_global_rng_positive_and_negative(self):
+        pos = (
+            "import numpy as np\n"
+            "def dsa_cycle(x, key):\n"
+            "    u = np.random.uniform(size=4)\n"
+            "    return x\n"
+        )
+        assert [f.rule for f in lint_source(pos)] == [
+            "global-rng-in-jit"
+        ]
+        neg = (
+            "import numpy as np\n"
+            "def dsa_cycle(x, key):\n"
+            "    rng = np.random.default_rng(0)\n"  # local generator
+            "    return x\n"
+            "def build_instance(seed):\n"
+            "    return np.random.uniform(size=4)\n"  # host scope
+        )
+        assert lint_source(neg) == []
+
+    def test_structural_jit_detection(self):
+        """A function is traced because it is PASSED to jit/scan, not
+        because of its name."""
+        src = (
+            "import jax, time\n"
+            "def helper(state, k):\n"
+            "    t = time.time()\n"
+            "    return state, None\n"
+            "def drive(state, keys):\n"
+            "    return jax.lax.scan(helper, state, keys)\n"
+        )
+        assert [f.rule for f in lint_source(src)] == ["time-in-jit"]
+
+
+RACE_POSITIVE = """
+import threading
+
+class Svc:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._jobs = {}
+        self.rate = None
+
+    def start(self):
+        t = threading.Thread(target=self._loop)
+        t.start()
+
+    def _loop(self):
+        with self._lock:
+            self.rate = 1.0
+        self._jobs["x"] = 1
+
+    def result(self, jid):
+        return self._jobs[jid]
+"""
+
+RACE_NEGATIVE = """
+import threading
+
+class Svc:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._jobs = {}
+        self.rate = None
+
+    def start(self):
+        t = threading.Thread(target=self._loop)
+        t.start()
+
+    def _loop(self):
+        with self._lock:
+            self.rate = 1.0
+            self._jobs["x"] = 1
+
+    def result(self, jid):
+        with self._lock:
+            return self._jobs[jid]
+"""
+
+
+class TestLintRaceRule:
+    def test_unlocked_cross_thread_read_fires(self):
+        findings = lint_source(
+            RACE_POSITIVE, "pydcop_tpu/serve/fixture.py"
+        )
+        assert any(
+            f.rule == "unlocked-shared-attr" and "result" in f.message
+            for f in findings
+        )
+
+    def test_locked_access_is_silent(self):
+        assert lint_source(
+            RACE_NEGATIVE, "pydcop_tpu/serve/fixture.py"
+        ) == []
+
+    def test_rule_scoped_to_serving_tier(self):
+        """The same pattern outside serve/ + batch/cache.py is out of
+        scope (runtime/ui.py's asyncio server is single-threaded by
+        design — documented in docs/analysis.rst)."""
+        assert lint_source(
+            RACE_POSITIVE, "pydcop_tpu/runtime/ui.py"
+        ) == []
+
+    def test_lock_held_private_method_is_silent(self):
+        src = (
+            "import threading\n"
+            "class Svc:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.rate = None\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop).start()\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self.rate = 1.0\n"
+            "    def snap(self):\n"
+            "        with self._lock:\n"
+            "            return self._read()\n"
+            "    def _read(self):\n"
+            "        return self.rate\n"  # every call site holds lock
+        )
+        assert lint_source(src, "pydcop_tpu/serve/fixture.py") == []
+
+
+class TestWaivers:
+    POS = (
+        "import time\n"
+        "def cycle_fn(x):\n"
+        "    t = time.time(){COMMENT}\n"
+        "    return x\n"
+    )
+
+    def test_waiver_with_reason_suppresses(self):
+        src = self.POS.replace(
+            "{COMMENT}",
+            "  # analyze: waive[time-in-jit] trace-time label only",
+        )
+        assert lint_source(src) == []
+
+    def test_waiver_without_reason_is_an_error_and_suppresses_nothing(
+            self):
+        src = self.POS.replace(
+            "{COMMENT}", "  # analyze: waive[time-in-jit]"
+        )
+        rules = sorted(f.rule for f in lint_source(src))
+        assert rules == ["time-in-jit", "waiver-missing-reason"]
+
+    def test_standalone_waiver_line_covers_next_line(self):
+        src = (
+            "import time\n"
+            "def cycle_fn(x):\n"
+            "    # analyze: waive[time-in-jit] profiling scaffold\n"
+            "    t = time.time()\n"
+            "    return x\n"
+        )
+        assert lint_source(src) == []
+
+    def test_waiver_for_other_rule_does_not_suppress(self):
+        src = self.POS.replace(
+            "{COMMENT}",
+            "  # analyze: waive[host-pull-in-jit] wrong rule",
+        )
+        assert [f.rule for f in lint_source(src)] == ["time-in-jit"]
+
+
+# ---------------------------------------------------------------------------
+# the shipped tree lints clean, and fleet.py's locks are load-bearing
+
+
+class TestShippedTree:
+    def test_package_lints_clean(self):
+        from pydcop_tpu.analysis.lint import lint_paths
+
+        findings = lint_paths([os.path.join(REPO, "pydcop_tpu")])
+        assert findings == [], [f.to_dict() for f in findings]
+
+    def test_removing_any_fleet_lock_fires_the_race_rule(self):
+        """Mutated-fixture sweep: every ``with self._lock:``
+        acquisition in serve/fleet.py, removed one at a time (the
+        block body kept, the acquisition replaced by ``if True:``),
+        must produce at least one unlocked-shared-attr finding — the
+        discipline the rule encodes is exactly the discipline the
+        fleet relies on."""
+        path = os.path.join(REPO, "pydcop_tpu", "serve", "fleet.py")
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        lines = src.splitlines()
+        lock_lines = [
+            i for i, line in enumerate(lines)
+            if re.search(r"with self\._lock:", line)
+        ]
+        assert len(lock_lines) >= 10  # the fleet really uses its lock
+        lint_path = "pydcop_tpu/serve/fleet.py"
+        assert lint_source(src, lint_path) == []
+        for i in lock_lines:
+            mutated = lines[:]
+            mutated[i] = re.sub(
+                r"with self\._lock:", "if True:", mutated[i]
+            )
+            findings = lint_source("\n".join(mutated), lint_path)
+            assert any(
+                f.rule == "unlocked-shared-attr" for f in findings
+            ), f"removing the lock at line {i + 1} went undetected"
+
+
+# ---------------------------------------------------------------------------
+# docs catalog pins (PR 12 fault-catalog style)
+
+
+class TestDocsCatalog:
+    def _docs(self):
+        path = os.path.join(REPO, "docs", "analysis.rst")
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+
+    def test_every_lint_rule_documented(self):
+        text = self._docs()
+        start = text.index("Rule catalog")
+        section = text[start:]
+        for rule in LINT_RULES:
+            assert f"``{rule}``" in section, rule
+
+    def test_no_phantom_rules_documented(self):
+        text = self._docs()
+        start = text.index("Rule catalog")
+        end = text.index("Waiver policy")
+        documented = set(re.findall(r"``([a-z][\w\-]+)``",
+                                    text[start:end]))
+        rule_like = {d for d in documented if "-" in d}
+        assert rule_like <= set(LINT_RULES), (
+            rule_like - set(LINT_RULES)
+        )
+
+    def test_every_budget_field_documented(self):
+        text = self._docs()
+        for f in dataclasses.fields(ProgramBudget):
+            assert f"``{f.name}``" in text, f.name
+
+
+# ---------------------------------------------------------------------------
+# CLI scorecard
+
+
+@pytest.mark.slow
+class TestAnalyzeCliSweep:
+    def test_program_sweep_exits_zero_with_scorecard(self):
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8")
+        out = subprocess.run(
+            [sys.executable, "-m", "pydcop_tpu", "analyze", "program"],
+            capture_output=True, text=True, env=env, cwd=REPO,
+            timeout=600,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        payload = json.loads(out.stdout)
+        assert payload["ok"] and payload["audited"] >= 20

@@ -136,6 +136,26 @@ class TestWarmRestart:
 
 
 class TestNoHostRoundTripPerCycle:
+    def test_registry_audits_the_masked_runner(self):
+        """The no-host-callback / zero-collective contract of the
+        masked chunk runner is now DECLARED
+        (SynchronousTensorSolver.program_budget) and audited by the
+        analysis registry sweep (ISSUE 13) — the migrated form of the
+        jaxpr pin below, which is kept as a legacy cross-check on the
+        auditor's walker."""
+        from pydcop_tpu.analysis import registry
+
+        for algo in ALGOS:
+            prog = registry.build_cell(f"single/{algo}")
+            assert prog.budget.max_host_callbacks == 0
+            assert all(
+                v == 0 for v in prog.budget.collectives.values()
+            )
+            rep = registry.audit_cell(f"single/{algo}")
+            assert rep.ok, (algo,
+                            [f.to_dict() for f in rep.findings])
+            assert rep.scorecard["host_callbacks"] == 0
+
     def test_masked_runner_jaxpr_is_one_scan_with_scalar_conv(self, dcop):
         solver = _solver("mgm", dcop)
         runner = solver._masked_chunk_runner(7, collect=False)

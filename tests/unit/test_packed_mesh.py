@@ -421,33 +421,48 @@ class TestPackedShardedLocalSearch:
                 and not hasattr(jax, "shard_map")):
             np.testing.assert_array_equal(got, golden)
 
-    def test_collective_budget(self):
-        """The whole point of the packed move rule: per cycle, ONE psum
-        of partial tables — plus, for MGM only, exactly one pmax/pmin
-        pair for the cross-shard neighborhood arbitration.  Counted in
-        the traced jaxpr of a 1-cycle run so a regression that adds a
-        gather-backed collective (or a second psum) fails loudly.
-        Pinned on the DENSE path (overlap='off'); the boundary-
-        compacted budget — same counts, [*, Bp] operands — is pinned
-        in tests/unit/test_boundary_comm.py."""
+    def test_collective_budget_via_registry(self):
+        """The packed move rule's collective budget — ONE psum of
+        partial tables, plus the pmax/pmin arbitration pair for MGM —
+        is now DECLARED next to the engine
+        (ShardedLocalSearch.program_budget) and audited by the
+        analysis registry sweep (ISSUE 13), which replaced the string
+        pins that used to live here."""
+        from pydcop_tpu.analysis import registry
+
+        mgm = registry.build_cell("sharded/mgm/packed/off")
+        assert mgm.budget.collectives == {
+            "psum": 1, "pmax": 1, "pmin": 1, "ppermute": 0,
+        }
+        dsa = registry.build_cell("sharded/dsa/packed/off")
+        assert dsa.budget.collectives == {
+            "psum": 1, "pmax": 0, "pmin": 0, "ppermute": 0,
+        }
+        for cell in ("sharded/mgm/packed/off", "sharded/dsa/packed/off"):
+            rep = registry.audit_cell(cell)
+            assert rep.ok, [f.to_dict() for f in rep.findings]
+
+    def test_collective_budget_legacy_pin(self):
+        """LEGACY jaxpr string pin, MGM only (kept as a cross-check on
+        the auditor's jaxpr walker — an auditor bug that stopped
+        seeing collectives would not break the audit sweep, but it
+        would break this)."""
         import re
 
         import jax.numpy as jnp
 
         t = compile_constraint_graph(_instance(seed=2))
         mesh = build_mesh(8)
-        expected = {"mgm": (1, 1, 1), "dsa": (1, 0, 0)}
-        for rule, (n_psum, n_pmax, n_pmin) in expected.items():
-            s = ShardedLocalSearch(t, mesh, rule=rule, use_packed=True,
-                                   overlap="off")
-            s._build()
-            x_row = jnp.zeros((1, s.packs.Vp), jnp.float32)
-            keys = jax.random.split(jax.random.PRNGKey(0), 1)
-            jaxpr = str(jax.make_jaxpr(s._run_n)(
-                x_row, keys, (), *s._bucket_args, *s._extra_args))
-            assert len(re.findall(r"\bpsum", jaxpr)) == n_psum, rule
-            assert len(re.findall(r"\bpmax\b", jaxpr)) == n_pmax, rule
-            assert len(re.findall(r"\bpmin\b", jaxpr)) == n_pmin, rule
+        s = ShardedLocalSearch(t, mesh, rule="mgm", use_packed=True,
+                               overlap="off")
+        s._build()
+        x_row = jnp.zeros((1, s.packs.Vp), jnp.float32)
+        keys = jax.random.split(jax.random.PRNGKey(0), 1)
+        jaxpr = str(jax.make_jaxpr(s._run_n)(
+            x_row, keys, (), *s._bucket_args, *s._extra_args))
+        assert len(re.findall(r"\bpsum", jaxpr)) == 1
+        assert len(re.findall(r"\bpmax\b", jaxpr)) == 1
+        assert len(re.findall(r"\bpmin\b", jaxpr)) == 1
 
     def test_mgm_matches_single_device(self):
         from pydcop_tpu.algorithms._local_search import (
