@@ -180,6 +180,30 @@ bench-twin:
 bench-r06:
 	python bench.py --only r06 --snapshot BENCH_r06.json
 
+# elastic device-fault tier (ISSUE 14): degraded-throughput curve
+# 8→6→4 devices on the partitioned 2000-var instance, SDC detection
+# latency with zero false positives on the clean legs, sentinel
+# overhead vs sentinel-off (BENCHREF.md "Elastic mesh")
+bench-elastic:
+	python bench.py --only elastic
+
+# the r06 legs + the elastic leg in one run with a machine-readable
+# BENCH_r07.json snapshot (ISSUE 14 satellite)
+bench-r07:
+	python bench.py --only r07 --snapshot BENCH_r07.json
+
+# the elastic device-fault tier end-to-end through the CLI: 8-device
+# CPU mesh, two kill_device faults mid-solve through
+# `solve --fault-plan`, the solve completes on 6 devices and the
+# final assignment bit-matches the clean elastic run (exact-restore
+# path); slow-marked, so it does NOT run in tier-1 — run it next to
+# faults/chaos-smoke whenever touching parallel/elastic or the
+# sentinels.  The fast (not-slow) elastic CLI tests ride tier-1 via
+# tests/cli.
+elastic-smoke:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/cli/test_elastic_cli.py -q -m slow
+
 # the small twin end-to-end through the CLI: 2 replicas, 3 tiers, 10
 # mutations, 1 injected kill — finite RTO, zero gold deadline misses,
 # ladder engaged-and-released; slow-marked, so it does NOT run in

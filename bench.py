@@ -2399,6 +2399,121 @@ def build_partitioned_tensors(args, V=None, E_per_var=3):
     )
 
 
+def bench_elastic_subprocess(args):
+    """Elastic device-fault tier (ISSUE 14) on a virtual 8-device CPU
+    mesh, in a subprocess so the forced-CPU platform doesn't poison
+    this process's TPU backend."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    cmd = [sys.executable, os.path.abspath(__file__), "--only",
+           "elastic-inner", "--sharded-vars",
+           str(args.sharded_vars), "--watchdog", "0"]
+    out = subprocess.run(
+        cmd,
+        capture_output=True, text=True, timeout=1800, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+    )
+    lines = out.stdout.strip().splitlines()
+    if not lines:
+        raise RuntimeError(
+            f"elastic subprocess produced no output "
+            f"(rc={out.returncode}): " + out.stderr.strip()[-400:]
+        )
+    return json.loads(lines[-1])
+
+
+def bench_elastic_inner(args):
+    """Runs inside the CPU-mesh subprocess (BENCHREF.md "Elastic
+    mesh"): the degraded-throughput curve 8→6→4 devices on the
+    partitioned sharded instance, SDC detection latency with zero
+    false positives on the clean legs, and the sentinel overhead
+    (interleaved on/off bursts, repeat-best — the same
+    drift-discipline as the sharded canary)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from pydcop_tpu.parallel.elastic import ElasticRunner
+    from pydcop_tpu.runtime.faults import Fault, FaultPlan
+
+    tensors = build_partitioned_tensors(args, V=args.sharded_vars)
+    devices = jax.devices()
+    chunk, timed_cycles = 20, 60
+
+    def rate(n_dev, sentinel, fault_plan=None, scrub_every=0):
+        r = ElasticRunner(
+            tensors, engine="maxsum", devices=devices[:n_dev],
+            chunk=chunk, sentinel=sentinel, fault_plan=fault_plan,
+            scrub_every=scrub_every,
+        )
+        r.solve(chunk, seed=0)  # build + warmup chunk
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            res = r.solve(timed_cycles, seed=0)
+            dt = time.perf_counter() - t0
+            best = max(best, timed_cycles / dt)
+        return best, res
+
+    extra = {}
+    # 1) degraded-throughput curve: sustained rate at each mesh size
+    #    the elastic shrink lands on
+    for n in (8, 6, 4):
+        extra[f"elastic_iters_per_s_{n}dev"], _ = rate(n, True)
+    # 2) the shrink machinery end-to-end: 8→6→4 in ONE faulted solve
+    plan = FaultPlan(faults=[
+        Fault(kind="kill_device", device=7, cycle=chunk + 1),
+        Fault(kind="kill_device", device=6, cycle=2 * chunk + 1),
+        Fault(kind="shrink_mesh", devices=4, cycle=3 * chunk + 1),
+    ], seed=9)
+    runner = ElasticRunner(tensors, engine="maxsum", devices=devices,
+                           chunk=chunk, sentinel=True,
+                           fault_plan=plan)
+    res = runner.solve(5 * chunk, seed=0)
+    extra["elastic_shrink_run_devices_final"] = res.n_devices
+    extra["elastic_shrink_run_shrinks"] = \
+        res.counters.counts["elastic_shrinks"]
+    # 3) SDC detection latency (chunks) + zero false positives on the
+    #    clean legs above (operand checksums are constants, so clean
+    #    trips are impossible by construction — assert anyway)
+    plan = FaultPlan(faults=[
+        Fault(kind="corrupt_slab", operand="bucket0",
+              cycle=chunk + 1),
+    ], seed=3)
+    _, res_clean = rate(8, True, scrub_every=2)
+    sdc = ElasticRunner(tensors, engine="maxsum", devices=devices,
+                        chunk=chunk, sentinel=True, fault_plan=plan)
+    res_sdc = sdc.solve(4 * chunk, seed=0)
+    assert res_sdc.counters.counts["sdc_detected"] == 1
+    extra["elastic_sdc_detection_latency_chunks"] = \
+        res_sdc.counters.counts["detection_latency_chunks"]
+    extra["elastic_false_positives"] = (
+        res_clean.counters.counts["sentinel_trips"]
+        + res_clean.counters.counts["scrub_mismatches"]
+    )
+    # 4) sentinel overhead: interleaved on/off bursts, repeat-best
+    on = off = 0.0
+    for _ in range(3):
+        b_off, _ = rate(8, False)
+        b_on, _ = rate(8, True)
+        off, on = max(off, b_off), max(on, b_on)
+    overhead = max(0.0, (off - on) / off * 100.0) if off else 0.0
+    extra["elastic_sentinel_overhead_pct"] = overhead
+    extra["elastic_iters_per_s_8dev_sentinel_off"] = off
+    out = {
+        "metric": "elastic_sharded_iters_per_s",
+        "value": extra["elastic_iters_per_s_8dev"],
+        "unit": "iters/s (8-dev CPU mesh, sentinel on)",
+        "vs_baseline": 0.0,
+        "extra": extra,
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
 def bench_sharded_inner(args):
     """Runs inside the CPU-mesh subprocess."""
     # sitecustomize clobbers JAX_PLATFORMS; jax.config (pre-backend-init)
@@ -2778,7 +2893,8 @@ def main():
                  "local", "scalefree", "mixed", "sharded",
                  "sharded-inner", "dpop-sharded", "dpop-sharded-inner",
                  "probe", "batch", "harness", "serve", "fleet", "churn",
-                 "auto", "twin", "r06"],
+                 "auto", "twin", "elastic", "elastic-inner", "r06",
+                 "r07"],
         default="all",
     )
     # watchdog covers the FULL run: the wholesweep DPOP kernel compile
@@ -2789,6 +2905,49 @@ def main():
     args = ap.parse_args()
     if args.cycles is None:
         args.cycles = 50 if args.stretch else 2000
+
+    if args.only == "r07":
+        # consolidated r07 record (ISSUE 14 satellite): the r06 legs
+        # plus the elastic device-fault leg, EACH in a fresh
+        # subprocess (same isolation rationale as r06 below)
+        legs = ("serve", "churn", "dpop-sharded", "auto", "fleet",
+                "twin", "elastic")
+        fwd = []
+        skip_next = False
+        for a in sys.argv[1:]:
+            if skip_next:
+                skip_next = False
+                continue
+            if a in ("--only", "--snapshot"):
+                skip_next = True
+                continue
+            if a.startswith(("--only=", "--snapshot=")):
+                continue
+            fwd.append(a)
+        extra = {}
+        for leg in legs:
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--only", leg] + fwd
+            try:
+                r = subprocess.run(
+                    cmd, capture_output=True, text=True, timeout=3000,
+                )
+                parsed = json.loads(
+                    r.stdout.strip().splitlines()[-1]
+                )
+                extra.update(parsed.get("extra", {}))
+            except Exception as e:
+                extra[f"{leg}_error"] = repr(e)[:500]
+        out = {
+            "metric": "r07_consolidated",
+            "value": extra.get("elastic_iters_per_s_8dev", 0.0),
+            "unit": "elastic 8-dev iters/s (sentinel on)",
+            "vs_baseline": 0.0,
+            "extra": extra,
+        }
+        _maybe_snapshot(args, out)
+        print(json.dumps(out), flush=True)
+        return
 
     if args.only == "r06":
         # consolidated r06 record (ISSUE 12 satellite): the serve /
@@ -2842,6 +3001,10 @@ def main():
 
     if args.only == "sharded-inner":
         bench_sharded_inner(args)
+        return
+
+    if args.only == "elastic-inner":
+        bench_elastic_inner(args)
         return
 
     if args.only == "dpop-sharded-inner":
@@ -3075,6 +3238,27 @@ def main():
             extra.update(bench_twin(args, probe=probe))
         except Exception as e:
             extra["twin_error"] = repr(e)
+
+    if args.only in ("all", "elastic"):
+        # elastic device-fault tier (ISSUE 14): degraded-throughput
+        # curve 8→6→4 devices, SDC detection latency with zero false
+        # positives, sentinel overhead (BENCHREF.md "Elastic mesh")
+        el = None
+        try:
+            el = bench_elastic_subprocess(args)
+            extra.update(el.get("extra", {}))
+        except Exception as e:
+            extra["elastic_error"] = repr(e)
+        if args.only == "elastic":
+            out = el if el is not None else {
+                "metric": "elastic_error", "value": 0.0, "unit": "",
+                "vs_baseline": 0.0, "extra": extra,
+            }
+            if watchdog:
+                watchdog.cancel()
+            _maybe_snapshot(args, out)
+            print(json.dumps(out), flush=True)
+            return
 
     def run_with_transient_retry(fn, err_key):
         # the tunneled remote-compile service occasionally drops a

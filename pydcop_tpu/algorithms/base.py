@@ -93,6 +93,11 @@ class SolveResult:
     #: failover paths stay auditable post-hoc; None for solves that
     #: never passed through the serve tier
     serve: Optional[Dict[str, Any]] = None
+    #: device-fault-tier scorecard (runtime/stats.IntegrityCounters:
+    #: sentinel trips, scrub runs/mismatches, SDC detections, elastic
+    #: shrinks, cold repacks, devices lost), attached by the elastic
+    #: sharded driver (parallel/elastic); None elsewhere
+    integrity: Optional[Dict[str, Any]] = None
 
     def metrics(self) -> Dict[str, Any]:
         out = {
@@ -119,6 +124,8 @@ class SolveResult:
             out["portfolio"] = dict(self.portfolio)
         if self.serve is not None:
             out["serve"] = dict(self.serve)
+        if self.integrity is not None:
+            out["integrity"] = dict(self.integrity)
         return out
 
 

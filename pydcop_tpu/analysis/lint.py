@@ -73,10 +73,17 @@ LINT_RULES = {
 WAIVER_RE = re.compile(r"#\s*analyze:\s*waive\[([^\]]*)\]\s*(.*)$")
 
 #: where the lock-discipline race rule applies: the serving tier's
-#: cross-thread classes (PR 6/7/11 invariants) and the shared compile
-#: cache.  ``<string>`` keeps in-memory fixtures (tests) in scope.
+#: cross-thread classes (PR 6/7/11 invariants), the shared compile
+#: cache, and the tick/thread-crossed code that landed after the rule
+#: was first scoped (ISSUE 14 satellite): the city-twin runner
+#: (scenario/twin.py — its fleet's supervisor thread runs under the
+#: tick loop) and the fleet router (serve/router.py — front-door
+#: placements race supervisor health/capacity flips; it owns its own
+#: lock now).  serve/ already covers router.py by prefix; twin.py is
+#: listed explicitly.  ``<string>`` keeps in-memory fixtures (tests)
+#: in scope.
 RACE_SCOPE = ("serve/", "serve\\", "batch/cache.py", "batch\\cache.py",
-              "<string>")
+              "scenario/twin.py", "scenario\\twin.py", "<string>")
 
 
 def _race_in_scope(path: str) -> bool:

@@ -286,13 +286,14 @@ for _algo in ("mgm", "maxsum"):
 
 
 def _sharded_maxsum_cell(overlap: str, use_packed: bool,
-                         exchange: bool = False) -> AuditedProgram:
+                         exchange: bool = False,
+                         sentinel: bool = False) -> AuditedProgram:
     from pydcop_tpu.parallel.mesh import ShardedMaxSum
 
     t = _ring_factor_tensors()
     comp = ShardedMaxSum(
         t, _mesh(), damping=0.5, use_packed=use_packed,
-        overlap=overlap, exchange=exchange,
+        overlap=overlap, exchange=exchange, sentinel=sentinel,
     )
     comp._build()
     keys = _one_cycle_keys(1)
@@ -304,6 +305,8 @@ def _sharded_maxsum_cell(overlap: str, use_packed: bool,
         args = (q, r, keys) + tuple(comp._run_args)
     kind = "packed" if use_packed else "generic"
     mode = "exchange" if exchange else overlap
+    if sentinel:
+        mode = "sentinel" if mode == "off" else f"sentinel-{mode}"
     return AuditedProgram(
         name=f"sharded/maxsum/{kind}/{mode}",
         fn=comp._run_n,
@@ -326,13 +329,25 @@ for _ov, _pk, _ex in (
         functools.partial(_sharded_maxsum_cell, _ov, _pk, _ex)
     )
 
+# sentinel-instrumented chunk runners (ISSUE 14): the integrity
+# sentinel's checksum psum PAIR is part of the declared budget (host
+# callbacks stay 0 — the invariants ride the values tensor out)
+for _ov, _pk in (("off", False), ("exact", False), ("off", True)):
+    _kind = "packed" if _pk else "generic"
+    _mode = "sentinel" if _ov == "off" else f"sentinel-{_ov}"
+    register_cell(f"sharded/maxsum/{_kind}/{_mode}")(
+        functools.partial(_sharded_maxsum_cell, _ov, _pk, False,
+                          True)
+    )
+
 
 # ---------------------------------------------------------------------------
 # sharded local-search cells (PR 2/5 contracts)
 
 
 def _sharded_ls_cell(rule: str, overlap: str,
-                     use_packed: bool) -> AuditedProgram:
+                     use_packed: bool,
+                     sentinel: bool = False) -> AuditedProgram:
     import jax.numpy as jnp
 
     from pydcop_tpu.parallel.mesh import ShardedLocalSearch
@@ -343,6 +358,7 @@ def _sharded_ls_cell(rule: str, overlap: str,
     s = ShardedLocalSearch(
         _ring_constraint_tensors(), _mesh(), rule=rule,
         algo_params=params, use_packed=use_packed, overlap=overlap,
+        sentinel=sentinel,
     )
     s._build()
     keys = _one_cycle_keys(1)
@@ -359,8 +375,9 @@ def _sharded_ls_cell(rule: str, overlap: str,
     args = (x, keys, s.initial_aux()) + tuple(
         s._bucket_args) + tuple(s._extra_args)
     kind = "packed" if use_packed else "generic"
+    mode = "sentinel" if sentinel else overlap
     return AuditedProgram(
-        name=f"sharded/{rule}/{kind}/{overlap}",
+        name=f"sharded/{rule}/{kind}/{mode}",
         fn=s._run_n,
         args=args,
         budget=s.program_budget(),
@@ -376,6 +393,11 @@ for _rule, _ov in (("mgm", "off"), ("mgm", "exact"), ("dsa", "off")):
     register_cell(f"sharded/{_rule}/packed/{_ov}")(
         functools.partial(_sharded_ls_cell, _rule, _ov, True)
     )
+# sentinel-instrumented local-search chunk runner (ISSUE 14; the
+# sentinel needs the generic dense layout — mesh.py rejects the rest)
+register_cell("sharded/mgm/generic/sentinel")(
+    functools.partial(_sharded_ls_cell, "mgm", "off", False, True)
+)
 
 
 # ---------------------------------------------------------------------------

@@ -48,6 +48,7 @@ def make_parser() -> argparse.ArgumentParser:
         agent,
         analyze,
         batch,
+        checkpoint_cmd,
         consolidate,
         distribute,
         generate,
@@ -63,7 +64,7 @@ def make_parser() -> argparse.ArgumentParser:
 
     for module in (solve, run, orchestrator, agent, distribute, graph,
                    generate, batch, replica_dist, consolidate, serve,
-                   portfolio, twin, analyze):
+                   portfolio, twin, analyze, checkpoint_cmd):
         module.set_parser(subparsers)
     return parser
 

@@ -127,6 +127,28 @@ def set_parser(subparsers):
                         "with zero retraces; repair counters land in "
                         "metrics['repair'] (maxsum/mgm/dsa/adsa)")
     # crash resilience (docs/resilience.rst)
+    # elastic device-fault tier (docs/resilience.rst, "Device loss and
+    # data integrity"): a fault plan with device kinds routes the
+    # solve through parallel/elastic — chunk-boundary snapshots,
+    # integrity sentinels, shadow scrub and the recovery ladder
+    parser.add_argument("--fault-plan", default=None,
+                        help="seeded FaultPlan YAML; device kinds "
+                        "(kill_device/shrink_mesh/corrupt_slab) run "
+                        "the solve on the elastic sharded driver")
+    parser.add_argument("--elastic", action="store_true",
+                        help="force the elastic sharded driver even "
+                        "without a fault plan (sentinel + scrub "
+                        "coverage on a clean run)")
+    parser.add_argument("--elastic-chunk", type=int, default=8,
+                        help="cycles per elastic chunk boundary "
+                        "(snapshot + sentinel cadence; default 8)")
+    parser.add_argument("--scrub-every", type=int, default=0,
+                        help="shadow-recompute scrub every K chunks "
+                        "(0 = sentinel-only)")
+    parser.add_argument("--elastic-min-devices", type=int, default=2,
+                        help="shrink floor: below this many surviving "
+                        "devices the ladder cold-repacks instead "
+                        "(default 2)")
     parser.add_argument("--checkpoint", default=None,
                         help="rotating snapshot directory: solver state "
                         "is persisted every --checkpoint-every cycles "
@@ -161,19 +183,29 @@ def run_cmd(args):
         if (args.batch or args.distribution or args.checkpoint
                 or args.resume or args.headroom is not None
                 or args.dpop_budget_mb is not None
-                or args.i_bound is not None or args.dpop_no_prune):
+                or args.i_bound is not None or args.dpop_no_prune
+                or args.fault_plan or args.elastic):
             output_metrics(
                 {"status": "ERROR",
                  "error": "--auto does not combine with --batch, "
-                 "--distribution, checkpointing, --headroom or the "
-                 "--dpop-* shorthands; it owns the engine "
-                 "configuration"},
+                 "--distribution, checkpointing, --headroom, "
+                 "--fault-plan/--elastic or the --dpop-* shorthands; "
+                 "it owns the engine configuration"},
                 args.output,
             )
             return 1
         return _run_auto(args)
 
     if args.batch:
+        if args.fault_plan or args.elastic:
+            output_metrics(
+                {"status": "ERROR",
+                 "error": "--fault-plan/--elastic drive the elastic "
+                 "sharded driver for ONE solve; they do not combine "
+                 "with --batch"},
+                args.output,
+            )
+            return 1
         return _run_batch(args)
 
     try:
@@ -222,6 +254,28 @@ def run_cmd(args):
             )
             return 1
 
+    fault_plan = None
+    if args.fault_plan:
+        from pydcop_tpu.runtime.faults import FaultPlan
+
+        try:
+            fault_plan = FaultPlan.from_yaml(args.fault_plan)
+        except Exception as e:
+            output_metrics(
+                {"status": "ERROR",
+                 "error": f"cannot load fault plan: {e}"},
+                args.output,
+            )
+            return 1
+    elastic_opts = None
+    if args.elastic or (fault_plan is not None
+                        and fault_plan.device_faults()):
+        elastic_opts = {
+            "chunk": args.elastic_chunk,
+            "scrub_every": args.scrub_every,
+            "min_devices": args.elastic_min_devices,
+        }
+
     ui = None
     if args.uiport:
         from pydcop_tpu.runtime.events import event_bus
@@ -247,6 +301,8 @@ def run_cmd(args):
             shard_overlap=args.shard_overlap,
             shard_boundary_threshold=args.shard_boundary_threshold,
             headroom=args.headroom,
+            fault_plan=fault_plan,
+            elastic=elastic_opts,
         )
     except Exception as e:
         output_metrics({"status": "ERROR", "error": str(e)}, args.output)

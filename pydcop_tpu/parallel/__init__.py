@@ -7,6 +7,7 @@ computation graph is partitioned into edge shards laid out over a
 riding ICI/DCN instead of HTTP messages (SURVEY.md §2.8 mapping).
 """
 from pydcop_tpu.parallel.dpop_mesh import ShardedDpopSweep, ShardedSepDpop
+from pydcop_tpu.parallel.elastic import ElasticDpop, ElasticRunner
 from pydcop_tpu.parallel.mesh import (
     ShardedLocalSearch,
     ShardedMaxSum,
@@ -16,6 +17,8 @@ from pydcop_tpu.parallel.mesh import (
 from pydcop_tpu.parallel.partition import partition_factors
 
 __all__ = [
+    "ElasticDpop",
+    "ElasticRunner",
     "ShardedDpopSweep",
     "ShardedSepDpop",
     "ShardedLocalSearch",

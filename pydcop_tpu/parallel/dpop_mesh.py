@@ -163,6 +163,39 @@ class ShardedDpopSweep:
         # are the memory-bound term, don't hold them twice
         self._args_np = None
 
+    # -- named staged operands (ISSUE 14: corrupt_slab targets) -------------
+
+    def operand_names(self) -> tuple:
+        """Addressable staged device operands (the ``corrupt_slab``
+        fault's namespace): ``local`` — the float per-level local
+        table block, the one slab of the sweep worth corrupting."""
+        return ("local",)
+
+    def get_operand(self, name: str):
+        if name != "local":
+            raise ValueError(
+                f"unknown DPOP operand {name!r}; the sweep stages "
+                f"'local'"
+            )
+        if self._fn is None:
+            self._build()
+        return self._dev_args[0]
+
+    def set_operand(self, name: str, array) -> None:
+        """Replace ONE staged operand in place (same shape/dtype/
+        sharding) — the elastic tier's corruption-injection and heal
+        hook (parallel/elastic.ElasticDpop)."""
+        old = self.get_operand(name)
+        new = jax.device_put(
+            jnp.asarray(array, dtype=old.dtype), old.sharding
+        )
+        if new.shape != old.shape:
+            raise ValueError(
+                f"operand {name!r} shape {new.shape} != staged "
+                f"{old.shape}"
+            )
+        self._dev_args = (new,) + tuple(self._dev_args[1:])
+
     def run(self) -> np.ndarray:
         """Full UTIL+VALUE sweep on the mesh → assign_idx [n_nodes]."""
         if self._fn is None:

@@ -1,0 +1,856 @@
+"""Elastic mesh: survive device loss and silent data corruption
+mid-solve (ISSUE 14 tentpole).
+
+The sharded engines (parallel/mesh.py, parallel/dpop_mesh.py) assume
+the device set they were built on outlives the solve and that every
+bit they staged stays staged.  This module drops both assumptions:
+
+* **chunk-boundary snapshots** — the driver runs the solve in chunks
+  and persists the continuation state at every boundary in CANONICAL
+  (layout-independent) form through runtime/checkpoint.py: atomic
+  write, per-array CRC32, rotation.  For the generic BP engine that is
+  the per-edge message arrays in ORIGINAL edge order
+  (:func:`canonical_edge_map` — the inverse of the shard-major
+  stacking); for local search it is the [V] assignment; the packed
+  engine snapshots its leaf pytree (layout-bound, restorable on the
+  same mesh).
+
+* **elastic shrink** — when a ``kill_device``/``shrink_mesh`` fault
+  drops devices mid-chunk, the in-flight chunk is lost; the driver
+  re-runs ``partition_factors``/``analyze_boundary``/
+  ``build_exchange_plan`` for the surviving device set (one engine
+  rebuild — the counted repartition), remaps the snapshot into the new
+  layout and re-runs the lost chunk.  On the exact-restore path
+  (generic engines, exact-tier arithmetic) the continued trajectory is
+  bit-identical to an unfailed run; engines whose state cannot cross
+  layouts (packed) take the ladder floor instead: ONE counted cold
+  repack + deterministic replay from cycle 0 (PR 8 semantics).
+
+* **integrity sentinels + shadow scrub** — the engines' in-jit
+  sentinel vector (runtime/integrity.py) rides the values tensor out
+  of every chunk; the driver trips on nonfinite state, a broken
+  mean-centring residual, or operand-checksum drift from the reference
+  recorded at build time (operands are constants, so drift IS
+  corruption — zero false positives by construction).  Every
+  ``scrub_every`` chunks a SHADOW engine — same partition, device
+  order rotated by one, freshly staged operands — re-executes the
+  chunk from the boundary snapshot and its state checksum is compared
+  with the primary's: a mismatch is silent data corruption the
+  invariants missed.
+
+* **recovery ladder** — sentinel trip/scrub mismatch → rebuild the
+  engine with pristine operands + restore the CRC'd boundary snapshot
+  → device gone → elastic shrink → state can't cross layouts → one
+  counted cold repack + replay.  Every rung is surfaced as
+  ``integrity.*``/``elastic.*`` events (ws/SSE) and counted in
+  ``stats.IntegrityCounters``.
+
+Fault kinds consumed here: ``kill_device``, ``shrink_mesh``,
+``corrupt_slab`` (runtime/faults.py, ``FaultPlan.device_faults()``).
+docs/resilience.rst ("Device loss and data integrity") states the
+guarantees and the exactness tier they ride on.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import tempfile
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pydcop_tpu.runtime import integrity
+from pydcop_tpu.runtime.checkpoint import CheckpointManager
+from pydcop_tpu.runtime.events import send_elastic, send_integrity
+from pydcop_tpu.runtime.stats import IntegrityCounters
+
+logger = logging.getLogger(__name__)
+
+#: local-search rules whose continuation state is just the assignment
+#: (no sharded weight pytree) — the exact-restore set
+_STATELESS_LS = ("mgm", "dsa", "adsa")
+
+
+# ---------------------------------------------------------------------------
+# canonical (layout-independent) message codec for the generic engine
+# ---------------------------------------------------------------------------
+
+
+def canonical_edge_map(st, base) -> np.ndarray:
+    """Stacked-edge → canonical-edge index map of one generic sharded
+    layout (``-1`` on dummy edges).
+
+    Canonical edge order is the ORIGINAL compile order — bucket-major,
+    factor order within the bucket, scope position within the factor —
+    which no partition can disturb.  The stacked order is shard-major
+    with per-shard bucket blocks and zero-padded dummies
+    (shard_factor_graph); ``st.factor_rows`` is the factor→stacked-row
+    map that makes the inversion total.
+    """
+    S = st.n_shards
+    Es = st.edges_per_shard
+    E = int(np.asarray(st.edge_var).shape[0])
+    out = np.full(E, -1, dtype=np.int64)
+    # canonical offsets over ALL original buckets (empties are 0-wide)
+    base_off = []
+    off = 0
+    for b in base.buckets:
+        base_off.append(off)
+        off += int(b.n_factors) * int(b.arity)
+    nonempty = [bi for bi, b in enumerate(base.buckets)
+                if b.n_factors > 0]
+    # per-shard offsets of each sharded bucket's edge block
+    blk_off = []
+    o = 0
+    for sb in st.buckets:
+        blk_off.append(o)
+        o += sb.factors_per_shard * sb.arity
+    for j, (bi, sb) in enumerate(zip(nonempty, st.buckets)):
+        a, Fs = sb.arity, sb.factors_per_shard
+        rows = np.asarray(st.factor_rows[j])
+        f = np.flatnonzero(rows >= 0)
+        r = rows[f]
+        s, i = r // Fs, r % Fs
+        for p in range(a):
+            stacked = s * Es + blk_off[j] + i * a + p
+            out[stacked] = base_off[bi] + f * a + p
+    return out
+
+
+def canonical_messages(engine, arr) -> np.ndarray:
+    """One stacked [E, D] message array → canonical [E0, D] order
+    (dummy rows dropped)."""
+    st, base = engine.st, engine.base
+    cmap = _cached_edge_map(engine)
+    E0 = sum(int(b.n_factors) * int(b.arity) for b in base.buckets)
+    a = np.asarray(arr)
+    out = np.zeros((E0,) + a.shape[1:], dtype=a.dtype)
+    valid = cmap >= 0
+    out[cmap[valid]] = a[valid]
+    return out
+
+
+def stacked_messages(engine, canon) -> np.ndarray:
+    """Inverse of :func:`canonical_messages` for ``engine``'s layout
+    (dummies zero — exactly what the kernels expect)."""
+    st = engine.st
+    cmap = _cached_edge_map(engine)
+    c = np.asarray(canon)
+    D = st.max_domain_size
+    out = np.zeros((cmap.shape[0], D), dtype=c.dtype)
+    valid = cmap >= 0
+    out[valid] = c[cmap[valid]]
+    return out
+
+
+def _cached_edge_map(engine) -> np.ndarray:
+    m = getattr(engine, "_canon_edge_map", None)
+    if m is None:
+        m = canonical_edge_map(engine.st, engine.base)
+        engine._canon_edge_map = m
+    return m
+
+
+# ---------------------------------------------------------------------------
+# the elastic driver
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ElasticResult:
+    """Outcome of one elastic solve."""
+
+    values: np.ndarray          # final assignment indices [V]
+    cycles: int
+    n_devices: int              # devices the solve FINISHED on
+    counters: IntegrityCounters
+    sentinel: Optional[integrity.SentinelReading] = None
+
+    def metrics(self) -> Dict[str, Any]:
+        return {
+            "cycles": self.cycles,
+            "n_devices": self.n_devices,
+            "integrity": self.counters.as_dict(),
+        }
+
+
+class ElasticRunner:
+    """Chunked sharded solve that survives device loss and SDC.
+
+    ``engine`` selects the family: ``"maxsum"`` (ShardedMaxSum) or a
+    local-search rule (``"mgm"``/``"dsa"``/``"adsa"``/``"dba"``/
+    ``"gdba"`` — ShardedLocalSearch).  ``use_packed`` opts into the
+    lane-packed per-shard layout (maxsum only here; its state is
+    layout-bound, so mesh shrinks take the cold-repack rung).
+
+    The exact-restore guarantee: with ``use_packed=False`` and
+    exact-tier arithmetic (integer-valued costs, power-of-two domains
+    — docs/resilience.rst), the final assignment of a faulted run is
+    bit-identical to the unfaulted run of the same seed/chunking.
+    """
+
+    def __init__(
+        self,
+        tensors,
+        engine: str = "maxsum",
+        devices: Optional[Sequence] = None,
+        fault_plan=None,
+        chunk: int = 8,
+        scrub_every: int = 0,
+        min_devices: int = 2,
+        snapshot_dir: Optional[str] = None,
+        snapshot_keep: int = 4,
+        sentinel: bool = True,
+        use_packed: bool = False,
+        overlap: Optional[str] = "off",
+        damping: float = 0.5,
+        activation: Optional[float] = None,
+        algo_params: Optional[dict] = None,
+        resid_tol: float = 1e-2,
+        counters: Optional[IntegrityCounters] = None,
+    ):
+        import jax
+
+        self.tensors = tensors
+        self.kind = "maxsum" if engine in ("maxsum", "amaxsum") \
+            else "local_search"
+        self.rule = None if self.kind == "maxsum" else engine
+        if self.kind == "local_search" and engine not in (
+                "mgm", "dsa", "adsa", "dba", "gdba"):
+            raise ValueError(f"unknown elastic engine {engine!r}")
+        self._devices: List = list(
+            devices if devices is not None else jax.devices()
+        )
+        self._device_perm = 0
+        self.chunk = max(1, int(chunk))
+        self.scrub_every = max(0, int(scrub_every))
+        self.min_devices = max(1, int(min_devices))
+        self.sentinel = bool(sentinel)
+        self.use_packed = bool(use_packed)
+        self.overlap = overlap
+        self.damping = damping
+        self.activation = activation
+        self.algo_params = dict(algo_params or {})
+        self.resid_tol = float(resid_tol)
+        self.counters = counters or IntegrityCounters()
+        self._tmp = None
+        if snapshot_dir is None:
+            self._tmp = tempfile.TemporaryDirectory(
+                prefix="elastic_ck_"
+            )
+            snapshot_dir = self._tmp.name
+        self._mgr = CheckpointManager(snapshot_dir,
+                                      keep=max(1, snapshot_keep))
+        self._pending = list(fault_plan.device_faults()) \
+            if fault_plan is not None else []
+        self._plan_seed = int(getattr(fault_plan, "seed", 0) or 0)
+        #: chunk index of each not-yet-detected corrupt_slab injection
+        self._undetected: List[int] = []
+        self.engine = None
+        self._shadow = None
+        self._operand_ref: Optional[int] = None
+        self._state = None
+        self._chunks: List[int] = []  # committed chunk sizes (replay)
+
+    # -- engine lifecycle ---------------------------------------------------
+
+    @property
+    def exact_restorable(self) -> bool:
+        """True when the continuation state crosses layouts exactly:
+        the generic engines with layout-free (or canonicalizable)
+        state.  Packed layouts and the weight-carrying breakout rules
+        replay instead (the cold-repack rung)."""
+        if self.use_packed:
+            return False
+        return self.kind == "maxsum" or self.rule in _STATELESS_LS
+
+    def _make_engine(self, devices, permute: int = 0,
+                     sentinel: Optional[bool] = None):
+        import jax.numpy as jnp  # noqa: F401  (engine import side)
+        from jax.sharding import Mesh
+
+        from pydcop_tpu.parallel.mesh import (
+            AXIS,
+            ShardedLocalSearch,
+            ShardedMaxSum,
+        )
+
+        devs = list(devices)
+        if permute:
+            devs = devs[permute % len(devs):] \
+                + devs[:permute % len(devs)]
+        mesh = Mesh(np.array(devs), (AXIS,))
+        sent = self.sentinel if sentinel is None else sentinel
+        if self.kind == "maxsum":
+            eng = ShardedMaxSum(
+                self.tensors, mesh, damping=self.damping,
+                activation=self.activation,
+                use_packed=self.use_packed, overlap=self.overlap,
+                sentinel=sent,
+            )
+        else:
+            eng = ShardedLocalSearch(
+                self.tensors, mesh, rule=self.rule,
+                algo_params=self.algo_params,
+                use_packed=self.use_packed, overlap=self.overlap,
+                sentinel=sent and not self.use_packed,
+            )
+        eng._build()
+        return eng
+
+    def _build(self, devices) -> None:
+        """(Re)build the primary engine: re-runs the partitioner, the
+        boundary analysis and the exchange plan for ``devices`` and
+        restages every operand — the counted repartition."""
+        self.engine = self._make_engine(devices)
+        self._shadow = None
+        self.counters.inc("repartitions")
+        self._operand_ref = self._record_operand_ref(self.engine)
+
+    def _record_operand_ref(self, eng) -> Optional[int]:
+        if not getattr(eng, "sentinel", False):
+            return None
+        total = 0
+        arrays = []
+        if self.kind == "maxsum" and eng.packs is not None:
+            # the packed sentinel sums vmask + inv_dcount + cost_rows
+            arrays = [np.asarray(a) for a in eng._run_args[
+                (1 if eng.comm.compact else 2):
+                (4 if eng.comm.compact else 5)
+            ]]
+        elif self.kind == "maxsum":
+            arrays = [np.asarray(eng.get_operand(n))
+                      for n in eng.operand_names()]
+        else:
+            arrays = [np.asarray(eng.get_operand(n))
+                      for n in eng.operand_names()]
+        total = integrity.wrapsum_host(arrays)
+        return total
+
+    # -- state plumbing -----------------------------------------------------
+
+    def _canonical_arrays(self, state) -> Dict[str, np.ndarray]:
+        if self.kind == "maxsum":
+            q, r = state
+            if self.engine.packs is not None:
+                import jax
+
+                leaves, _ = jax.tree.flatten(q)
+                return {f"leaf_{i}": np.asarray(l)
+                        for i, l in enumerate(leaves)}
+            return {
+                "q": canonical_messages(self.engine, q),
+                "r": canonical_messages(self.engine, r),
+            }
+        x, aux = state
+        arrays = {"x": np.asarray(x, dtype=np.int32)}
+        for i, a in enumerate(aux):
+            arrays[f"aux_{i}"] = np.asarray(a)
+        return arrays
+
+    def _adopt_canonical(self, eng, arrays, meta):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from pydcop_tpu.parallel.mesh import AXIS
+
+        if self.kind == "maxsum":
+            if eng.packs is not None:
+                if int(meta.get("n_shards", -1)) != eng.n_shards:
+                    raise ValueError(
+                        "packed snapshot is layout-bound: cannot "
+                        "restore across a mesh shrink"
+                    )
+                q0, _ = eng.init_messages()
+                ref, treedef = jax.tree.flatten(q0)
+                leaves = [
+                    jax.device_put(
+                        jnp.asarray(arrays[f"leaf_{i}"], r.dtype),
+                        r.sharding,
+                    )
+                    for i, r in enumerate(ref)
+                ]
+                st = jax.tree.unflatten(treedef, leaves)
+                return (st, st)
+            sh = NamedSharding(eng.mesh, P(AXIS, None))
+            q = jax.device_put(
+                jnp.asarray(stacked_messages(eng, arrays["q"])), sh
+            )
+            r = jax.device_put(
+                jnp.asarray(stacked_messages(eng, arrays["r"])), sh
+            )
+            return (q, r)
+        x = eng.state_from_values(arrays["x"])
+        aux_arrays = []
+        i = 0
+        while f"aux_{i}" in arrays:
+            aux_arrays.append(arrays[f"aux_{i}"])
+            i += 1
+        if aux_arrays:
+            # weight-carrying rules (dba/gdba): the stacked aux is
+            # layout-bound, so this path only runs on the SAME layout
+            # (the heal rung); mesh shrinks replay instead
+            ref = eng.initial_aux()
+            if len(ref) != len(aux_arrays) or any(
+                    np.shape(a) != tuple(r.shape)
+                    for a, r in zip(aux_arrays, ref)):
+                raise ValueError(
+                    "aux snapshot is layout-bound: cannot restore "
+                    "across a mesh shrink (the replay rung handles "
+                    "this)"
+                )
+            aux = tuple(
+                jax.device_put(jnp.asarray(a, r.dtype), r.sharding)
+                for a, r in zip(aux_arrays, ref)
+            )
+        else:
+            aux = ()
+        return (x, aux)
+
+    def _snapshot(self, cycle: int) -> None:
+        meta = {
+            "kind": "elastic",
+            "engine": self.kind,
+            "n_shards": len(self._devices),
+            "packed": self.engine.packs is not None
+            if self.kind == "maxsum" else False,
+        }
+        self._mgr.save_state(
+            cycle, self._canonical_arrays(self._state), meta
+        )
+        self.counters.inc("snapshots_saved")
+
+    def _restore(self, cycle: int, eng) -> Any:
+        """Load the CRC'd snapshot for ``cycle`` (newest-first walk —
+        corrupt files are skipped with a warning, exactly resume()'s
+        discipline) and adopt it into ``eng``'s layout."""
+        got = self._mgr.latest_valid_state()
+        if got is None:
+            raise RuntimeError(
+                "no valid chunk-boundary snapshot to restore from"
+            )
+        ck_cycle, meta, arrays = got
+        if ck_cycle != cycle:
+            raise RuntimeError(
+                f"snapshot at cycle {ck_cycle} cannot restore "
+                f"boundary {cycle}"
+            )
+        return self._adopt_canonical(eng, arrays, meta)
+
+    # -- chunk execution ----------------------------------------------------
+
+    def _run_chunk(self, eng, state, n: int, seed: int,
+                   chunk_i: int):
+        if self.kind == "maxsum":
+            eng._epoch = chunk_i
+            q, r = state
+            values, q2, r2 = eng.run(cycles=n, q=q, r=r, seed=seed)
+            return values, (q2, r2)
+        x, aux = state
+        values, x2, aux2 = eng.run_chunked(
+            n, x=x, aux=aux, seed=seed, epoch=chunk_i
+        )
+        return values, (x2, aux2)
+
+    def _init_state(self, eng, seed: int):
+        if self.kind == "maxsum":
+            q, r = eng.init_messages(seed)
+            eng._epoch = 0
+            return (q, r)
+        import jax
+
+        from pydcop_tpu.algorithms._local_search import (
+            random_valid_values,
+        )
+
+        x0 = np.asarray(random_valid_values(
+            self.tensors, jax.random.PRNGKey(seed + 17)
+        ))
+        return (eng.state_from_values(x0), eng.initial_aux())
+
+    # -- fault consumption --------------------------------------------------
+
+    def _due_corrupt(self, boundary: int) -> List:
+        out = [f for f in self._pending
+               if f.kind == "corrupt_slab" and f.cycle <= boundary]
+        self._pending = [f for f in self._pending if f not in out]
+        return out
+
+    def _next_device_fault(self, boundary: int, n: int):
+        for f in self._pending:
+            if f.kind in ("kill_device", "shrink_mesh") \
+                    and f.cycle < boundary + n:
+                self._pending.remove(f)
+                return f
+        return None
+
+    def _apply_corrupt(self, fault, chunk_i: int) -> None:
+        eng = self.engine
+        name = fault.operand
+        state_names = (("q", "r") if self.kind == "maxsum"
+                       else ("x",))
+        seed = self._plan_seed ^ (fault.cycle + 1)
+        if name in state_names and not (
+                self.kind == "maxsum" and eng.packs is not None):
+            # state corruption: flip a bit in the driver's held
+            # continuation arrays (caught by the shadow scrub)
+            import jax
+
+            if self.kind == "maxsum":
+                idx = state_names.index(name)
+                leaf = self._state[idx]
+                host = integrity.flip_bit(
+                    np.asarray(leaf), seed, shard=fault.device,
+                    n_shards=len(self._devices),
+                )
+                new = jax.device_put(host, leaf.sharding)
+                st = list(self._state)
+                st[idx] = new
+                self._state = tuple(st)
+            else:
+                host = integrity.flip_bit(
+                    np.asarray(self._state[0], dtype=np.int32),
+                    seed, shard=fault.device,
+                    n_shards=len(self._devices),
+                )
+                self._state = (
+                    eng.state_from_values(host), self._state[1]
+                )
+        else:
+            arr = np.asarray(eng.get_operand(name))
+            eng.set_operand(name, integrity.flip_bit(
+                arr, seed, shard=fault.device,
+                n_shards=len(self._devices),
+            ))
+        self._undetected.append(chunk_i)
+        send_integrity("injected", {
+            "operand": name, "cycle": fault.cycle, "chunk": chunk_i,
+        })
+
+    # -- ladder rungs -------------------------------------------------------
+
+    def _detected(self, chunk_i: int, how: str) -> None:
+        if self._undetected:
+            first = self._undetected.pop(0)
+            self.counters.inc("sdc_detected")
+            self.counters.inc("detection_latency_chunks",
+                              max(0, chunk_i - first))
+        logger.warning("integrity: corruption detected by %s at "
+                       "chunk %d", how, chunk_i)
+
+    def _heal(self, boundary: int, reason: str) -> None:
+        """Rung 1: rebuild the engine with pristine operands on the
+        SAME device set and restore the CRC'd boundary snapshot."""
+        self._build(self._devices)
+        self._state = self._restore(boundary, self.engine)
+        self.counters.inc("snapshot_restores")
+        send_integrity("restore", {
+            "cycle": boundary, "reason": reason,
+            "devices": len(self._devices),
+        })
+
+    def _shrink(self, fault, boundary: int, seed: int) -> None:
+        """Rungs 2–3: drop the dead devices, repartition onto the
+        survivors, exact-restore the boundary snapshot — or, when the
+        state cannot cross layouts, ONE counted cold repack + replay
+        (PR 8 semantics)."""
+        before = len(self._devices)
+        if fault.kind == "kill_device":
+            i = int(fault.device) % before
+            survivors = (self._devices[:i] + self._devices[i + 1:])
+        else:
+            survivors = self._devices[:max(1, int(fault.devices))]
+        lost = before - len(survivors)
+        if lost <= 0:
+            return
+        self.counters.inc("devices_lost", lost)
+        send_elastic("device.lost", {
+            "kind": fault.kind, "cycle": fault.cycle,
+            "from": before, "to": len(survivors),
+        })
+        self._devices = survivors
+        exact = (self.exact_restorable
+                 and len(survivors) >= self.min_devices)
+        self._build(survivors)
+        if exact:
+            self._state = self._restore(boundary, self.engine)
+            self.counters.inc("elastic_shrinks")
+            send_elastic("shrink", {
+                "from": before, "to": len(survivors),
+                "cycle": boundary, "exact_restore": True,
+            })
+        else:
+            self.counters.inc("cold_repacks")
+            send_elastic("repack", {
+                "devices": len(survivors), "cycle": boundary,
+            })
+            self._replay_to(boundary, seed)
+        send_elastic("resumed", {
+            "cycle": boundary, "devices": len(survivors),
+        })
+
+    def _replay_to(self, boundary: int, seed: int) -> None:
+        """Deterministic replay of the committed chunk schedule on the
+        rebuilt engine — same seed, same chunk sizes, same epochs →
+        the same trajectory (exact tier), now in the new layout."""
+        self._state = self._init_state(self.engine, seed)
+        done = 0
+        for i, n in enumerate(self._chunks):
+            if done >= boundary:
+                break
+            _v, self._state = self._run_chunk(
+                self.engine, self._state, n, seed, i
+            )
+            done += n
+        self._snapshot(boundary)
+
+    # -- scrub --------------------------------------------------------------
+
+    def _scrub(self, boundary: int, n: int, seed: int,
+               chunk_i: int, primary: integrity.SentinelReading
+               ) -> bool:
+        """Shadow re-execution of the chunk just run: same partition,
+        device order rotated by one, operands staged fresh from the
+        host tensors, state restored from the boundary snapshot.  A
+        state-checksum mismatch is SDC on the primary."""
+        self.counters.inc("scrub_runs")
+        if self._shadow is None:
+            self._shadow = self._make_engine(
+                self._devices, permute=1, sentinel=True
+            )
+        shadow = self._shadow
+        state = self._restore(boundary, shadow)
+        _v, _s = self._run_chunk(shadow, state, n, seed, chunk_i)
+        reading = integrity.decode_sentinel(shadow.last_sentinel)
+        send_integrity("scrub.run", {
+            "chunk": chunk_i, "cycle": boundary + n,
+            "shadow_devices": "rot1",
+        })
+        if reading.state_checksum != primary.state_checksum:
+            self.counters.inc("scrub_mismatches")
+            send_integrity("scrub.mismatch", {
+                "chunk": chunk_i,
+                "primary": primary.state_checksum,
+                "shadow": reading.state_checksum,
+            })
+            return True
+        return False
+
+    # -- main loop ----------------------------------------------------------
+
+    def solve(self, cycles: int, seed: int = 0) -> ElasticResult:
+        """Run ``cycles`` cycles chunked, consuming the fault plan at
+        chunk boundaries, and return the final assignment + the
+        integrity scorecard.  A re-used runner keeps its compiled
+        engine (and whatever mesh a previous solve shrank to) — only
+        the continuation state and the snapshot stream restart."""
+        if self.engine is None:
+            self._build(self._devices)
+        self._state = self._init_state(self.engine, seed)
+        self._chunks = []
+        # a re-used runner starts a FRESH snapshot stream: stale
+        # boundaries from a previous solve() must never shadow this
+        # run's restores
+        for _c, path in self._mgr.snapshots():
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self._snapshot(0)
+        done = 0
+        chunk_i = 0
+        values = None
+        guard = 0
+        while done < cycles:
+            guard += 1
+            if guard > 16 * (cycles // self.chunk + 2):
+                raise RuntimeError(
+                    "elastic ladder failed to converge (livelock?)"
+                )
+            n = min(self.chunk, cycles - done)
+            for f in self._due_corrupt(done):
+                self._apply_corrupt(f, chunk_i)
+            devf = self._next_device_fault(done, n)
+            values, state2 = self._run_chunk(
+                self.engine, self._state, n, seed, chunk_i
+            )
+            self.counters.inc("chunks_run")
+            if devf is not None:
+                # the chunk died mid-collective: its result is lost
+                self._shrink(devf, done, seed)
+                continue
+            reading = None
+            if getattr(self.engine, "sentinel", False):
+                reading = integrity.decode_sentinel(
+                    self.engine.last_sentinel
+                )
+                reason = reading.trip_reason(
+                    operand_ref=self._operand_ref,
+                    resid_tol=self.resid_tol,
+                )
+                if reason is not None:
+                    self.counters.inc("sentinel_trips")
+                    send_integrity("sentinel.trip", {
+                        "reason": reason, "chunk": chunk_i,
+                        "reading": dataclasses.asdict(reading),
+                    })
+                    self._detected(chunk_i, f"sentinel:{reason}")
+                    self._heal(done, reason)
+                    continue
+            if (self.scrub_every and reading is not None
+                    and (chunk_i + 1) % self.scrub_every == 0):
+                if self._scrub(done, n, seed, chunk_i, reading):
+                    self._detected(chunk_i, "scrub")
+                    self._heal(done, "scrub")
+                    continue
+            self._state = state2
+            done += n
+            self._chunks.append(n)
+            chunk_i += 1
+            self._snapshot(done)
+        return ElasticResult(
+            values=np.asarray(values),
+            cycles=done,
+            n_devices=len(self._devices),
+            counters=self.counters,
+            sentinel=(
+                integrity.decode_sentinel(self.engine.last_sentinel)
+                if getattr(self.engine, "sentinel", False)
+                and self.engine.last_sentinel is not None else None
+            ),
+        )
+
+
+# ---------------------------------------------------------------------------
+# elastic exact inference (sharded DPOP)
+# ---------------------------------------------------------------------------
+
+
+class ElasticDpop:
+    """Device-fault tier for the sharded DPOP sweep.
+
+    The sweep is a one-shot program (no continuation state), so the
+    ladder simplifies: device loss → re-pad the plan onto the
+    survivors (ShardedDpopSweep re-tiles its batch axis per shard
+    count) and re-run; ``corrupt_slab`` on a staged table operand →
+    the shadow re-execution (device order rotated by one, operands
+    staged fresh) disagrees on the final assignment, the primary is
+    rebuilt pristine and re-run.  Exactly-representable costs make
+    the sweep shard-count invariant (dpop_mesh docstring), so every
+    recovered run is bit-identical to the unfailed one.
+    """
+
+    def __init__(self, plan, devices: Optional[Sequence] = None,
+                 fault_plan=None, scrub: bool = True,
+                 min_devices: int = 1,
+                 counters: Optional[IntegrityCounters] = None):
+        import jax
+
+        self.plan = plan
+        self._devices = list(
+            devices if devices is not None else jax.devices()
+        )
+        self.scrub = bool(scrub)
+        self.min_devices = max(1, int(min_devices))
+        self.counters = counters or IntegrityCounters()
+        self._pending = list(fault_plan.device_faults()) \
+            if fault_plan is not None else []
+        self._plan_seed = int(getattr(fault_plan, "seed", 0) or 0)
+        self.engine = None
+
+    def _make_engine(self, devices, permute: int = 0):
+        from jax.sharding import Mesh
+
+        from pydcop_tpu.parallel.dpop_mesh import ShardedDpopSweep
+        from pydcop_tpu.parallel.mesh import AXIS
+
+        devs = list(devices)
+        if permute:
+            devs = devs[permute % len(devs):] \
+                + devs[:permute % len(devs)]
+        eng = ShardedDpopSweep(self.plan, Mesh(np.array(devs),
+                                               (AXIS,)))
+        eng._build()
+        return eng
+
+    def _corrupt(self, eng, fault) -> None:
+        old = eng.get_operand(fault.operand)
+        eng.set_operand(fault.operand, integrity.flip_bit(
+            np.asarray(old), self._plan_seed ^ (fault.cycle + 1),
+            shard=fault.device, n_shards=len(self._devices),
+        ))
+        send_integrity("injected", {
+            "operand": fault.operand, "cycle": fault.cycle,
+        })
+
+    def solve(self) -> ElasticResult:
+        # device faults fire before/mid sweep: the sweep restarts on
+        # the survivors either way (one-shot program)
+        for f in list(self._pending):
+            if f.kind in ("kill_device", "shrink_mesh"):
+                self._pending.remove(f)
+                before = len(self._devices)
+                if f.kind == "kill_device":
+                    i = int(f.device) % before
+                    self._devices = (self._devices[:i]
+                                     + self._devices[i + 1:])
+                else:
+                    self._devices = self._devices[
+                        :max(1, int(f.devices))]
+                lost = before - len(self._devices)
+                if lost > 0:
+                    self.counters.inc("devices_lost", lost)
+                    self.counters.inc("elastic_shrinks")
+                    send_elastic("device.lost", {
+                        "kind": f.kind, "from": before,
+                        "to": len(self._devices),
+                    })
+        if len(self._devices) < self.min_devices:
+            raise RuntimeError(
+                f"{len(self._devices)} devices left, need "
+                f">= {self.min_devices}"
+            )
+        self.engine = self._make_engine(self._devices)
+        self.counters.inc("repartitions")
+        injected = False
+        for f in list(self._pending):
+            if f.kind == "corrupt_slab":
+                self._pending.remove(f)
+                self._corrupt(self.engine, f)
+                injected = True
+        assign = self.engine.run()
+        self.counters.inc("chunks_run")
+        if self.scrub:
+            self.counters.inc("scrub_runs")
+            shadow = self._make_engine(self._devices, permute=1)
+            ref = shadow.run()
+            send_integrity("scrub.run", {"sweep": True})
+            # the assignment compare catches divergence that reached
+            # the answer; the operand-checksum compare catches flips
+            # the argmin absorbed (a low mantissa bit) — both engines
+            # staged from the same plan, so ANY difference is
+            # corruption, with zero false positives by construction
+            op_prim = integrity.wrapsum_host(
+                [np.asarray(self.engine.get_operand("local"))]
+            )
+            op_ref = integrity.wrapsum_host(
+                [np.asarray(shadow.get_operand("local"))]
+            )
+            if not np.array_equal(assign, ref) or op_prim != op_ref:
+                self.counters.inc("scrub_mismatches")
+                if injected:
+                    self.counters.inc("sdc_detected")
+                send_integrity("scrub.mismatch", {"sweep": True})
+                # heal: rebuild the primary pristine and re-run
+                self.engine = self._make_engine(self._devices)
+                self.counters.inc("snapshot_restores")
+                send_integrity("restore", {"sweep": True})
+                assign = self.engine.run()
+        return ElasticResult(
+            values=np.asarray(assign),
+            cycles=1,
+            n_devices=len(self._devices),
+            counters=self.counters,
+        )

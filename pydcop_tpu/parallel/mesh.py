@@ -554,11 +554,19 @@ class ShardedMaxSum(_CommPlanMixin):
         overlap: Optional[str] = None,
         boundary_threshold: float = 0.5,
         exchange: Optional[bool] = None,
+        sentinel: bool = False,
     ):
         self.mesh = mesh or build_mesh()
         self.n_shards = self.mesh.devices.size
         self.base = tensors
         self.packs = None
+        #: in-jit integrity sentinels (ISSUE 14): the chunk runner
+        #: additionally computes nonfinite/checksum/residual
+        #: invariants per shard, combined with ONE extra psum pair per
+        #: CHUNK and appended to the values tensor — the host read
+        #: stays one tensor per chunk (runtime/integrity.py)
+        self.sentinel = bool(sentinel)
+        self.last_sentinel = None
         if use_packed is None:
             # the per-shard pallas kernels only pay off on real TPU
             # shards; on CPU meshes (tests, the bench canary) they run
@@ -598,7 +606,59 @@ class ShardedMaxSum(_CommPlanMixin):
             counts = {"ppermute": max(1, len(plan.rounds or ()))}
         else:
             counts = {"psum": 1}
+        if self.sentinel:
+            # the sentinel's psum PAIR (uint32 invariants + float
+            # residual) rides once per CHUNK, not per cycle — the
+            # registry traces a one-cycle chunk, where it shows up as
+            # two extra tiny psums (runtime/integrity.py)
+            counts["psum"] = counts.get("psum", 0) + 2
         return self._comm_budget(counts)
+
+    # -- integrity sentinels (ISSUE 14) -------------------------------------
+
+    def _build_sentinel_fn(self, n_buckets: int):
+        """shard_map'd sentinel reduction over the (q, r) message
+        blocks + the staged bucket cost slabs: per-shard nonfinite
+        count, wrapping state/operand checksums and the BP
+        mean-centring residual, combined with one psum pair and packed
+        into ONE replicated int32[4] vector (runtime/integrity.py).
+        Returns ``(fn, op_idx)`` — ``op_idx`` indexes the float cost
+        slabs inside ``self._run_args``."""
+        if not self.sentinel:
+            return None, ()
+        from pydcop_tpu.runtime import integrity
+
+        op_idx = tuple(1 + 2 * k for k in range(n_buckets))
+
+        def sent(q_blk, r_blk, *op_blks):
+            resid = jnp.float32(0.0)
+            if q_blk.size:
+                # outgoing q is mean-centred: each edge's domain row
+                # must sum to ~0 (masked entries are exact zeros)
+                resid = jnp.max(jnp.abs(jnp.sum(q_blk, axis=-1)))
+            ints, rs = integrity.sentinel_block(
+                (q_blk, r_blk), op_blks, resid=resid
+            )
+            return integrity.combine_sentinel(ints, rs, AXIS)
+
+        fn = shard_map(
+            sent, mesh=self.mesh,
+            in_specs=tuple([P(AXIS)] * (2 + len(op_idx))),
+            out_specs=P(), check_vma=False,
+        )
+        return fn, op_idx
+
+    def _split_sentinel(self, values, n: int, host_values: bool):
+        """Peel the sentinel lanes off the chunk's ONE output tensor
+        (values ++ sentinel) and stash them on ``last_sentinel``."""
+        if not self.sentinel:
+            return np.asarray(values) if host_values else values
+        if host_values:
+            vf = np.asarray(values)
+            self.last_sentinel = vf[n:]
+            return vf[:n]
+        self.last_sentinel = values[n:]
+        return values[:n]
 
     # -- kernel -------------------------------------------------------------
 
@@ -800,6 +860,9 @@ class ShardedMaxSum(_CommPlanMixin):
             ])
             bucket_specs.extend([P(AXIS), P(AXIS)])
         n_buckets = len(st.buckets)
+        self._sent_fn, self._sent_idx = self._build_sentinel_fn(
+            n_buckets
+        )
         # local-row reduction layout (gather+fold instead of the
         # [V+1, D] scatter) — the compact generic engine's fast path
         self._lr = (
@@ -898,6 +961,8 @@ class ShardedMaxSum(_CommPlanMixin):
         Bp = int(comm.bnd.shape[0]) if comm.bnd is not None else 0
         bel_rows = lr["rows"] if lr is not None else V
 
+        sent_fn, sent_idx = self._sent_fn, self._sent_idx
+
         def run_n(q, r, keys, *args):
             carry0 = (q, r, jnp.zeros((S, bel_rows, D), jnp.float32))
             if stale:
@@ -908,7 +973,11 @@ class ShardedMaxSum(_CommPlanMixin):
                 return carry, None
 
             carry, _ = jax.lax.scan(body, carry0, keys)
-            return carry[0], carry[1], carry[2]
+            out = (carry[0], carry[1], carry[2])
+            if sent_fn is not None:
+                out += (sent_fn(carry[0], carry[1],
+                                *[args[i] for i in sent_idx]),)
+            return out
 
         self._run_n = jax.jit(
             run_n,
@@ -1140,6 +1209,31 @@ class ShardedMaxSum(_CommPlanMixin):
         bel_idx = 3 if activation is not None else 1
         self._bel_idx = bel_idx
 
+        # packed integrity sentinel (ISSUE 14): nonfinite + wrapping
+        # checksums over the sharded message carries and the staged
+        # packed cost slabs (vmask / inv_dcount / cost_rows — the
+        # corrupt_slab targets), one psum pair per CHUNK appended to
+        # the values tensor (runtime/integrity.py)
+        sent_fn = None
+        sent_state = 3 if activation is not None else 1
+        if self.sentinel:
+            from pydcop_tpu.runtime import integrity
+
+            def _sent(*blks):
+                state_blks = [b[0] for b in blks[:sent_state]]
+                op_blks = [b[0] for b in blks[sent_state:]]
+                ints, rs = integrity.sentinel_block(
+                    state_blks, op_blks
+                )
+                return integrity.combine_sentinel(ints, rs, AXIS)
+
+            sent_fn = shard_map(
+                _sent, mesh=self.mesh,
+                in_specs=tuple([P(AXIS)] * (sent_state + 3)),
+                out_specs=P(), check_vma=False,
+            )
+        self._packed_sent = sent_fn
+
         if compact:
             # stale's pending halo slab is a scan carry INTERNAL to
             # run_n (zeros each run — a 1-cycle boundary re-warm per
@@ -1169,6 +1263,11 @@ class ShardedMaxSum(_CommPlanMixin):
                         carry[:4] + carry[5:] if has_act
                         else carry[:2]
                     )
+                if sent_fn is not None:
+                    carry = tuple(carry) + (sent_fn(
+                        *[carry[i] for i in range(sent_state)],
+                        *args[1:4],
+                    ),)
                 return carry
 
             self._run_args = base_args
@@ -1209,6 +1308,11 @@ class ShardedMaxSum(_CommPlanMixin):
                     jnp.where(mask_p > 0, state[bel_idx], PAD_COST),
                     axis=0,
                 ).astype(jnp.int32)
+                if sent_fn is not None:
+                    values_p = jnp.concatenate([values_p, sent_fn(
+                        *[state[i] for i in range(sent_state)],
+                        *args[1:4],
+                    )])
                 return state, values_p
 
         # donate the scan-state pytree (chunked/resumed runs feed the
@@ -1221,6 +1325,8 @@ class ShardedMaxSum(_CommPlanMixin):
     def _make_run_n(self, sharded):
         # global arrays must be jit ARGUMENTS, not closure constants —
         # multi-process meshes reject closing over non-addressable shards
+        sent_fn, sent_idx = self._sent_fn, self._sent_idx
+
         def run_n(q, r, keys, *args):
             def body(carry, k):
                 q, r = carry
@@ -1228,7 +1334,15 @@ class ShardedMaxSum(_CommPlanMixin):
                 return (q2, r2), values
 
             (q, r), values_hist = jax.lax.scan(body, (q, r), keys)
-            return q, r, values_hist[-1]
+            out = values_hist[-1]
+            if sent_fn is not None:
+                # sentinel lanes ride the values tensor: the host read
+                # stays ONE tensor per chunk (PR 4 discipline)
+                out = jnp.concatenate([
+                    out.astype(jnp.int32),
+                    sent_fn(q, r, *[args[i] for i in sent_idx]),
+                ])
+            return q, r, out
 
         # donate the (q, r) message buffers — each chunked run() call
         # feeds the previous call's outputs back in, so the [E, D]
@@ -1385,6 +1499,63 @@ class ShardedMaxSum(_CommPlanMixin):
                 jnp.asarray(h, dtype=ref.dtype), ref.sharding))
         return jax.tree.unflatten(treedef, leaves)
 
+    # -- named staged operands (ISSUE 14: corrupt_slab targets) -------------
+
+    def operand_names(self) -> tuple:
+        """Addressable staged device operands (the ``corrupt_slab``
+        fault's ``operand`` namespace): the per-bucket cost slabs of
+        the generic engine (``bucket0``..), or the packed engine's
+        one lane-packed cost array (``cost``)."""
+        if self._run_n is None:
+            self._build()
+        if self.packs is not None:
+            return ("cost",)
+        return tuple(
+            f"bucket{k}" for k in range(len(self.st.buckets))
+        )
+
+    def _operand_index(self, name: str) -> int:
+        if self.packs is not None:
+            if name != "cost":
+                raise ValueError(
+                    f"unknown packed operand {name!r}; expected 'cost'"
+                )
+            # cost_rows rides after (unary_p, vmask, inv_dcount) in
+            # base_args; the dense layout prepends mask_p
+            return 3 if self.comm.compact else 4
+        names = self.operand_names()
+        if name not in names:
+            raise ValueError(
+                f"unknown operand {name!r}; this engine stages "
+                f"{list(names)}"
+            )
+        return 1 + 2 * int(name[len("bucket"):])
+
+    def get_operand(self, name: str):
+        """The staged device array behind ``name``."""
+        if self._run_n is None:
+            self._build()
+        return self._run_args[self._operand_index(name)]
+
+    def set_operand(self, name: str, array) -> None:
+        """Replace ONE staged operand in place (same shape/dtype/
+        sharding) — zero retraces, same mechanism as edit_factor."""
+        if self._run_n is None:
+            self._build()
+        i = self._operand_index(name)
+        old = self._run_args[i]
+        new = jax.device_put(
+            jnp.asarray(array, dtype=old.dtype), old.sharding
+        )
+        if new.shape != old.shape:
+            raise ValueError(
+                f"operand {name!r} shape {new.shape} != staged "
+                f"{old.shape}"
+            )
+        args = list(self._run_args)
+        args[i] = new
+        self._run_args = tuple(args)
+
     def edit_factor(self, bucket_i: int, factor_i: int, table) -> None:
         """Warm in-place factor edit (ISSUE 8): rewrite ONE stacked
         slab row of the generic engine at a fixed shape.
@@ -1466,22 +1637,38 @@ class ShardedMaxSum(_CommPlanMixin):
         if self.packs is not None:
             if self.comm.compact:
                 state = self._run_n(q, keys, *self._run_args)
+                if self.sentinel:
+                    sent_vec, state = state[-1], tuple(state[:-1])
                 values = self._finalize(
                     state[self._bel_idx], *self._fin_args
                 )
+                if self.sentinel:
+                    values = jnp.concatenate([values, sent_vec])
             else:
                 state, values = self._run_n(q, keys, *self._run_args)
+            values = self._split_sentinel(
+                values, int(self.packs.Vp), host_values
+            )
             values = (
-                np.asarray(values)[self._values_map] if host_values
+                values[self._values_map] if host_values
                 else values[jnp.asarray(self._values_map)]
             )
             return values, state, state
         if self.comm.compact:
-            q, r, belv = self._run_n(q, r, keys, *self._run_args)
+            out = self._run_n(q, r, keys, *self._run_args)
+            q, r, belv = out[0], out[1], out[2]
             values = self._finalize(belv, *self._fin_args)
-            return (np.asarray(values) if host_values else values), q, r
+            if self.sentinel:
+                values = jnp.concatenate([values, out[3]])
+            values = self._split_sentinel(
+                values, self.st.n_vars, host_values
+            )
+            return values, q, r
         q, r, values = self._run_n(q, r, keys, *self._run_args)
-        return (np.asarray(values) if host_values else values), q, r
+        values = self._split_sentinel(
+            values, self.st.n_vars, host_values
+        )
+        return values, q, r
 
 
 def st_factors(sb: ShardedBucket) -> int:
@@ -1666,7 +1853,8 @@ class ShardedLocalSearch(_CommPlanMixin):
                  use_packed: Optional[bool] = None,
                  overlap: Optional[str] = None,
                  boundary_threshold: float = 0.5,
-                 exchange: Optional[bool] = None):
+                 exchange: Optional[bool] = None,
+                 sentinel: bool = False):
         from pydcop_tpu.ops.compile import ConstraintGraphTensors
 
         assert isinstance(tensors, ConstraintGraphTensors), (
@@ -1713,6 +1901,19 @@ class ShardedLocalSearch(_CommPlanMixin):
         _announce_comm(self.comm, self.n_shards,
                        engine=f"local_search:{rule}",
                        packed=self.packs is not None)
+        #: in-jit integrity sentinels (ISSUE 14): supported on the
+        #: generic dense engine (the elastic driver's path) — the
+        #: packed/compact layouts keep the scrub-only protection
+        self.sentinel = bool(sentinel)
+        self.last_sentinel = None
+        if self.sentinel and (
+                self.packs is not None or self.comm.compact):
+            raise ValueError(
+                "sentinel=True needs the generic dense local-search "
+                "engine (use_packed=False, overlap='off') — the "
+                "packed/compact layouts are covered by the shadow "
+                "scrub instead (docs/resilience.rst)"
+            )
         self._run_n = None
         self._finalize = None
 
@@ -1770,6 +1971,10 @@ class ShardedLocalSearch(_CommPlanMixin):
             if self.packs is not None and arbitrates:
                 counts["pmax"] = 1
                 counts["pmin"] = 1
+        if self.sentinel:
+            # one extra psum pair per CHUNK (uint32 invariants + float
+            # residual) — see ShardedMaxSum.program_budget
+            counts["psum"] = counts.get("psum", 0) + 2
         return self._comm_budget(counts)
 
     # -- rule-specific sharded extras ---------------------------------------
@@ -2330,11 +2535,49 @@ class ShardedLocalSearch(_CommPlanMixin):
                 (x, aux), _ = jax.lax.scan(body, (x, aux), keys)
                 return x, aux
 
+        if self.sentinel:
+            # integrity sentinel (ISSUE 14): wrap the chunk runner so
+            # the sentinel lanes ride the assignment tensor — per-shard
+            # checksums of the staged cost slabs psum'd once per chunk,
+            # the replicated assignment checksummed on shard 0 only
+            # (so the value is shard-count independent), one host
+            # tensor per chunk as everywhere else
+            from pydcop_tpu.runtime import integrity
+
+            sent_idx = tuple(2 * k for k in range(n_buckets))
+
+            def _sent(x_rep, *op_blks):
+                ints, rs = integrity.sentinel_block((), op_blks)
+                first = (
+                    jax.lax.axis_index(AXIS) == 0
+                ).astype(jnp.uint32)
+                ints = ints.at[1].add(
+                    integrity.wrapsum_words(x_rep) * first
+                )
+                return integrity.combine_sentinel(ints, rs, AXIS)
+
+            sent_sm = shard_map(
+                _sent, mesh=self.mesh,
+                in_specs=(P(),) + tuple(
+                    [P(AXIS)] * len(sent_idx)
+                ),
+                out_specs=P(), check_vma=False,
+            )
+            base_run = run_n
+
+            def run_n(x, keys, aux, *rest):
+                x2, aux2 = base_run(x, keys, aux, *rest)
+                s = sent_sm(x2, *[rest[i] for i in sent_idx])
+                return jnp.concatenate([x2.astype(jnp.int32), s]), aux2
+
         # donate the assignment row and the breakout weight state (the
         # bulky gdba per-entry tensors in particular) — no-op'd on CPU
         self._run_n = jax.jit(
             run_n,
-            donate_argnums=(0, 2) if donation_supported() else (),
+            donate_argnums=(
+                ((2,) if self.sentinel else (0, 2))
+                if donation_supported() else ()
+            ),
         )
         if compact:
             own_src = sp.own_rows if sp is not None else st.own_rows
@@ -2354,6 +2597,138 @@ class ShardedLocalSearch(_CommPlanMixin):
                 check_vma=False,
             ))
 
+    # -- named staged operands (ISSUE 14: corrupt_slab targets) -------------
+
+    def operand_names(self) -> tuple:
+        """Addressable staged device operands (``corrupt_slab``
+        targets): per-bucket cost slabs (generic) or the packed cost
+        array (``cost``)."""
+        if self._run_n is None:
+            self._build()
+        if self.packs is not None:
+            return ("cost",)
+        return tuple(
+            f"bucket{k}" for k in range(len(self.st.buckets))
+        )
+
+    def _operand_index(self, name: str) -> int:
+        if self.packs is not None:
+            if name != "cost":
+                raise ValueError(
+                    f"unknown packed operand {name!r}; expected 'cost'"
+                )
+            return 0  # first cost slab in _bucket_args
+        names = self.operand_names()
+        if name not in names:
+            raise ValueError(
+                f"unknown operand {name!r}; this engine stages "
+                f"{list(names)}"
+            )
+        return 2 * int(name[len("bucket"):])
+
+    def get_operand(self, name: str):
+        if self._run_n is None:
+            self._build()
+        return self._bucket_args[self._operand_index(name)]
+
+    def set_operand(self, name: str, array) -> None:
+        """Replace ONE staged operand in place (zero retraces)."""
+        if self._run_n is None:
+            self._build()
+        i = self._operand_index(name)
+        old = self._bucket_args[i]
+        new = jax.device_put(
+            jnp.asarray(array, dtype=old.dtype), old.sharding
+        )
+        if new.shape != old.shape:
+            raise ValueError(
+                f"operand {name!r} shape {new.shape} != staged "
+                f"{old.shape}"
+            )
+        self._bucket_args[i] = new
+
+    # -- continuation-state codecs (ISSUE 14 elastic driver) ----------------
+
+    def state_from_values(self, values):
+        """[V] int assignment → this engine's OPAQUE continuation
+        state (packed column row / per-shard view / plain array —
+        whatever the built layout carries)."""
+        if self.packs is not None:
+            sp = self.packs
+            vorder = np.asarray(sp.pg0.var_order)
+            row = (
+                jnp.zeros((1, sp.Vp), jnp.float32)
+                .at[0, vorder].set(
+                    jnp.asarray(values).astype(jnp.float32)
+                )
+            )
+            if self.comm.compact:
+                row = jax.device_put(
+                    jnp.broadcast_to(row, (self.n_shards, 1, sp.Vp)),
+                    NamedSharding(self.mesh, P(AXIS, None, None)),
+                )
+            return row
+        xv = jnp.asarray(values, dtype=jnp.int32)
+        if self.comm.compact:
+            xv = jax.device_put(
+                jnp.broadcast_to(xv, (self.n_shards, xv.shape[0])),
+                NamedSharding(self.mesh, P(AXIS, None)),
+            )
+        return xv
+
+    def state_values(self, x) -> np.ndarray:
+        """Inverse of :meth:`state_from_values`: continuation state →
+        host [V] int32 assignment in variable order (the compact
+        layouts reconcile per-shard views with the owner-masked
+        finalize psum — one small collective per call)."""
+        if self.packs is not None:
+            vorder = np.asarray(self.packs.pg0.var_order)
+            if self.comm.compact:
+                x = self._finalize(x, self._own_arg)
+            return np.asarray(x)[0, vorder].astype(np.int32)
+        if self.comm.compact:
+            return np.asarray(self._finalize(x, self._own_arg))
+        return np.asarray(x).astype(np.int32)
+
+    def run_chunked(self, cycles: int, x=None, aux=None, seed: int = 0,
+                  epoch: Optional[int] = None):
+        """Chunked continuation run (ISSUE 14): ``cycles`` cycles from
+        the OPAQUE continuation state ``(x, aux)`` (None = fresh
+        seeded start), returning ``(values, x, aux)``.
+
+        ``epoch`` folds a chunk counter into the coin-key stream so
+        chunked runs draw fresh coins per chunk (``None`` reproduces
+        :meth:`run`'s stream — what run() itself uses).  MGM is
+        coin-free, so its chunked trajectory is IDENTICAL to one
+        unchunked run of the same total cycles — the exact-restore
+        guarantee the elastic tier leans on.  With ``sentinel=True``
+        the sentinel lanes are split off into ``last_sentinel`` and
+        the values/continuation stay [V]-shaped."""
+        if self._run_n is None:
+            self._build()
+        from pydcop_tpu.algorithms._local_search import random_valid_values
+
+        if x is None:
+            x0 = random_valid_values(
+                self.base, jax.random.PRNGKey(seed + 17)
+            )
+            x = self.state_from_values(x0)
+            aux = self.initial_aux()
+        key = jax.random.PRNGKey(seed)
+        if epoch is not None:
+            key = jax.random.fold_in(key, epoch)
+        keys = jax.random.split(key, cycles)
+        x, aux = self._run_n(
+            x, keys, aux, *self._bucket_args, *self._extra_args,
+        )
+        if self.sentinel:
+            host = np.asarray(x)
+            V = self.base.n_vars
+            self.last_sentinel = host[V:]
+            values = host[:V].astype(np.int32)
+            return values, jnp.asarray(values), aux
+        return self.state_values(x), x, aux
+
     def run(self, cycles: int = 20, seed: int = 0):
         """Returns the final value indices [V].
 
@@ -2361,45 +2736,5 @@ class ShardedLocalSearch(_CommPlanMixin):
         for the whole run: the initial assignment is packed ONCE before
         the scan and the final row unpacked ONCE after it — the only
         variable-order indexing in a packed solve."""
-        if self._run_n is None:
-            self._build()
-        from pydcop_tpu.algorithms._local_search import random_valid_values
-
-        x0 = random_valid_values(self.base, jax.random.PRNGKey(seed + 17))
-        keys = jax.random.split(jax.random.PRNGKey(seed), cycles)
-        compact = self.comm.compact
-        if self.packs is not None:
-            sp = self.packs
-            vorder = np.asarray(sp.pg0.var_order)
-            x_row = (
-                jnp.zeros((1, sp.Vp), jnp.float32)
-                .at[0, vorder].set(x0.astype(jnp.float32))
-            )
-            if compact:
-                # compact modes carry the assignment as per-shard VIEWS
-                x_row = jax.device_put(
-                    jnp.broadcast_to(x_row, (self.n_shards, 1, sp.Vp)),
-                    NamedSharding(self.mesh, P(AXIS, None, None)),
-                )
-            x_row, _aux = self._run_n(
-                x_row, keys, self.initial_aux(), *self._bucket_args,
-                *self._extra_args,
-            )
-            if compact:
-                x_row = self._finalize(x_row, self._own_arg)
-            return np.asarray(x_row)[0, vorder].astype(np.int32)
-        if compact:
-            xv = jax.device_put(
-                jnp.broadcast_to(x0, (self.n_shards, x0.shape[0])),
-                NamedSharding(self.mesh, P(AXIS, None)),
-            )
-            xv, _aux = self._run_n(
-                xv, keys, self.initial_aux(), *self._bucket_args,
-                *self._extra_args,
-            )
-            return np.asarray(self._finalize(xv, self._own_arg))
-        x, _aux = self._run_n(
-            x0, keys, self.initial_aux(), *self._bucket_args,
-            *self._extra_args,
-        )
-        return np.asarray(x)
+        values, _x, _aux = self.run_chunked(cycles, seed=seed)
+        return values

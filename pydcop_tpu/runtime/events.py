@@ -142,6 +142,48 @@ def send_shard(event: str, payload) -> None:
     event_bus.send(SHARD_TOPIC_PREFIX + event, payload)
 
 
+#: data-integrity topic prefix (runtime/integrity +
+#: parallel/elastic).  Topics:
+#: ``integrity.sentinel.trip`` (reason nonfinite/residual/operand,
+#: chunk, reading — an in-jit invariant sentinel fired),
+#: ``integrity.scrub.run`` (chunk, shadow mode) and
+#: ``integrity.scrub.mismatch`` (chunk, primary/shadow checksums — the
+#: shadow re-execution disagreed with the primary: silent data
+#: corruption detected),
+#: ``integrity.injected`` (operand, chunk — a corrupt_slab fault
+#: fired),
+#: ``integrity.restore`` (cycle, snapshot — state restored from a
+#: CRC'd chunk-boundary snapshot) — subscribe with ``integrity.*``
+#: (the UI server pushes them to ws/SSE clients alongside
+#: ``faults.*``).
+INTEGRITY_TOPIC_PREFIX = "integrity."
+
+
+def send_integrity(event: str, payload) -> None:
+    """Publish a data-integrity event on the global bus (no-op unless
+    observability is enabled)."""
+    event_bus.send(INTEGRITY_TOPIC_PREFIX + event, payload)
+
+
+#: elastic-mesh topic prefix (parallel/elastic).  Topics:
+#: ``elastic.device.lost`` (device, cycle — a kill_device/shrink_mesh
+#: fault dropped mesh devices),
+#: ``elastic.shrink`` (from/to device counts, cycle, exact_restore —
+#: the solve repartitioned onto the survivors and continued),
+#: ``elastic.repack`` (devices, cycle — the ladder floor: one counted
+#: cold repack + replay),
+#: ``elastic.resumed`` (cycle, devices — the shrunk solve is running
+#: again) — subscribe with ``elastic.*`` (the UI server pushes them to
+#: ws/SSE clients alongside ``shard.*``).
+ELASTIC_TOPIC_PREFIX = "elastic."
+
+
+def send_elastic(event: str, payload) -> None:
+    """Publish an elastic-mesh lifecycle event on the global bus
+    (no-op unless observability is enabled)."""
+    event_bus.send(ELASTIC_TOPIC_PREFIX + event, payload)
+
+
 #: exact-inference (DPOP) topic prefix (algorithms/dpop +
 #: ops/dpop_shard).  Topics:
 #: ``dpop.shard.plan`` (n_shards, levels, bytes_per_device,

@@ -85,7 +85,20 @@ FLEET_KINDS = ("kill_replica", "stall_replica", "partition_replica")
 RUNTIME_KINDS = ("kill_rank", "stall_rank", "kill_agent",
                  "corrupt_checkpoint", "truncate_checkpoint")
 
-KINDS = RUNTIME_KINDS + SERVE_KINDS + CHURN_KINDS + FLEET_KINDS
+#: device-tier fault kinds (consumed by the elastic sharded driver,
+#: pydcop_tpu.parallel.elastic.ElasticRunner / ElasticDpop) —
+#: ``kill_device`` drops one mesh device at the next chunk boundary
+#: (the solve shrinks onto the survivors; with a ``replica`` it instead
+#: targets a fleet replica, which advertises reduced capacity to the
+#: router), ``shrink_mesh`` shrinks the mesh to ``devices`` devices in
+#: one step, and ``corrupt_slab`` flips one seeded bit in a named
+#: staged device operand (``operand``, e.g. ``bucket0``/``q``/``x``/
+#: ``local``) at a cycle boundary — the silent-data-corruption probe
+#: the integrity sentinels and the shadow scrub must catch
+DEVICE_KINDS = ("kill_device", "shrink_mesh", "corrupt_slab")
+
+KINDS = (RUNTIME_KINDS + SERVE_KINDS + CHURN_KINDS + FLEET_KINDS
+         + DEVICE_KINDS)
 
 #: the one catalog of which OPTIONAL fields each kind may address —
 #: the machine-readable half of the fault-kind table in
@@ -112,6 +125,9 @@ KIND_FIELDS: Dict[str, Tuple[str, ...]] = {
     "kill_replica": ("replica",),
     "stall_replica": ("replica", "duration"),
     "partition_replica": ("replica", "duration"),
+    "kill_device": ("device", "replica"),
+    "shrink_mesh": ("devices",),
+    "corrupt_slab": ("operand", "device"),
 }
 
 
@@ -140,8 +156,20 @@ class Fault:
     #: edit_factor: the constraint to hot-swap (None = seeded choice)
     constraint: Optional[str] = None
     #: fleet faults: target replica index (kill_replica / stall_replica
-    #: / partition_replica)
+    #: / partition_replica).  On ``kill_device`` a replica makes the
+    #: fault a FLEET fault instead: that replica loses one device and
+    #: advertises reduced capacity to the router.
     replica: Optional[int] = None
+    #: kill_device: the mesh device index to drop; corrupt_slab: the
+    #: shard whose slab block takes the bit-flip (None = anywhere)
+    device: Optional[int] = None
+    #: shrink_mesh: the target device count after the shrink
+    devices: Optional[int] = None
+    #: corrupt_slab: the named staged operand to flip a bit in (the
+    #: elastic engines publish their addressable operand names via
+    #: ``operand_names()`` — e.g. ``bucket0``, ``q``, ``r``, ``x``,
+    #: ``local``)
+    operand: Optional[str] = None
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -161,6 +189,13 @@ class Fault:
         if self.kind in ("remove_agent_burst", "add_agent_burst") \
                 and self.count is not None and self.count < 1:
             raise ValueError(f"{self.kind} fault needs a 'count' >= 1")
+        if self.kind == "kill_device" and self.device is None:
+            raise ValueError("kill_device fault needs a 'device'")
+        if self.kind == "shrink_mesh" and (
+                self.devices is None or self.devices < 1):
+            raise ValueError("shrink_mesh fault needs 'devices' >= 1")
+        if self.kind == "corrupt_slab" and not self.operand:
+            raise ValueError("corrupt_slab fault needs an 'operand'")
 
     def to_dict(self) -> Dict:
         # 'attempt' must survive even as None (None = every attempt —
@@ -220,6 +255,18 @@ class FaultPlan:
             replica: 1                 # placements for `duration`
             cycle: 3                   # seconds (0 = rest of run)
             duration: 1.0
+          - kind: kill_device          # device: drop mesh device 7 at
+            device: 7                  # the next chunk boundary >= 8;
+            cycle: 8                   # with `replica: N` the fleet
+                                       # replica N loses a device and
+                                       # advertises reduced capacity
+          - kind: shrink_mesh          # device: shrink the mesh to 4
+            devices: 4                 # devices in one step
+            cycle: 16
+          - kind: corrupt_slab         # device: flip one seeded bit in
+            operand: bucket0           # a named staged operand (SDC
+            cycle: 12                  # probe); `device` restricts the
+            device: 2                  # flip to that shard's block
     """
 
     faults: List[Fault] = dataclasses.field(default_factory=list)
@@ -269,7 +316,8 @@ class FaultPlan:
         never read, i.e. a fault that cannot mean what its author
         wrote."""
         targeted = ("rank", "agent", "path", "jid", "count",
-                    "constraint", "replica")
+                    "constraint", "replica", "device", "devices",
+                    "operand")
         for i, f in enumerate(self.faults):
             allowed = KIND_FIELDS[f.kind]
             extras = sorted(
@@ -322,10 +370,25 @@ class FaultPlan:
         return [f for f in self.faults if f.kind in SERVE_KINDS]
 
     def fleet_faults(self) -> List[Fault]:
-        """Replica-level faults (kill/stall/partition) consumed by the
-        solve fleet's supervisor (serve/fleet.py) through the same
+        """Replica-level faults (kill/stall/partition, plus
+        replica-scoped ``kill_device``) consumed by the solve fleet's
+        supervisor (serve/fleet.py) through the same
         :class:`ServeFaultInjector` consultation protocol."""
-        return [f for f in self.faults if f.kind in FLEET_KINDS]
+        return [f for f in self.faults
+                if f.kind in FLEET_KINDS
+                or (f.kind == "kill_device" and f.replica is not None)]
+
+    def device_faults(self) -> List[Fault]:
+        """Device-tier faults (kill_device/shrink_mesh/corrupt_slab)
+        consumed by the elastic sharded driver
+        (parallel/elastic.ElasticRunner) at chunk boundaries, ordered
+        by cycle.  A ``kill_device`` carrying a ``replica`` belongs to
+        the fleet (see :meth:`fleet_faults`) and is excluded here."""
+        out = [f for f in self.faults
+               if f.kind in DEVICE_KINDS
+               and not (f.kind == "kill_device"
+                        and f.replica is not None)]
+        return sorted(out, key=lambda f: f.cycle)
 
     def churn_faults(self) -> List[Fault]:
         """Agent-churn / live-mutation faults (kill_agent + the burst

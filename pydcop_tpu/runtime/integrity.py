@@ -1,0 +1,217 @@
+"""In-jit integrity sentinels + silent-data-corruption primitives.
+
+The resilience tiers so far (PR 1/7/11) trust the device: a rank can
+crash, a scheduler can wedge, a replica can die — but a bit flipped in
+a boundary slab mid-collective would sail straight through every one of
+them and come out as a *wrong answer*.  This module is the device-tier
+half of the elastic-mesh story (parallel/elastic.py drives it):
+
+* **sentinel blocks** — cheap invariant reductions computed INSIDE the
+  sharded cycle programs and combined with one extra ``psum`` pair per
+  chunk, so the host read stays one tensor per chunk (the PR 4
+  discipline).  Three invariants ride one int32[4] vector:
+
+  - ``nonfinite`` — count of non-finite entries in the message/state
+    carries (a flipped exponent bit is very likely to land here);
+  - ``state checksum`` — a wrapping uint32 sum of the bitcast state
+    words.  Wrapping integer addition is associative and commutative,
+    so the checksum is *layout-independent*: the same per-edge messages
+    stacked under any shard partition (zero-padded dummies included)
+    produce the same word sum — which is what lets a shadow engine
+    built under a permuted shard assignment be compared bit-for-bit;
+  - ``operand checksum`` — the same wrapping sum over the staged cost
+    slabs.  Operands never change during a run, so ANY drift from the
+    reference recorded at build time is corruption, with zero false
+    positives by construction;
+  - ``residual`` — the belief-normalization invariant of the BP
+    engines: outgoing q messages are mean-centred, so each edge's
+    domain-row must sum to ~0; the sentinel carries the psum of the
+    per-shard max |row sum| (bitcast into the int vector).
+
+* **seeded bit-flips** (:func:`flip_bit`) — the ``corrupt_slab`` fault
+  kind's payload: deterministically flip one bit of one word of a host
+  array copy, so tests and the bench can inject SDC reproducibly.
+
+* **host-side checksums** (:func:`wrapsum_host`) — the same wrapping
+  sum computed with numpy, bit-for-bit equal to the in-jit one; the
+  elastic driver records operand references with it at build time.
+
+Exactness tier: the *state* checksum comparison between a primary and
+a shadow run is bit-exact whenever the arithmetic itself is exact
+(integer-valued costs, power-of-two domain sizes and damping — the
+same tier the sharded DPOP bit-identity pins ride).  The *operand*
+checksum needs no exactness at all: it compares a constant against
+itself.  docs/resilience.rst ("Device loss and data integrity") states
+the contract.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+#: width of a sentinel vector: [nonfinite, state cksum, operand cksum,
+#: residual bits]
+SENTINEL_WIDTH = 4
+
+
+def wrapsum_words(x):
+    """In-jit wrapping uint32 word sum of one array (any dtype).
+
+    float32 arrays are bitcast (not cast) so every mantissa bit
+    counts; integer/bool arrays sum their values.  Zero padding
+    contributes zero, and the modular sum is order-independent — the
+    two properties the layout-independence argument above rests on.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if x.size == 0:
+        return jnp.uint32(0)
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        w = jax.lax.bitcast_convert_type(
+            x.astype(jnp.float32), jnp.uint32
+        )
+    else:
+        w = x.astype(jnp.uint32)
+    return jnp.sum(w, dtype=jnp.uint32)
+
+
+def sentinel_block(state_leaves, operand_leaves, resid=None):
+    """Per-shard sentinel partial (call INSIDE shard_map, then psum).
+
+    Returns ``(ints uint32[3], resid float32[1])`` — the two vectors
+    the caller combines with one ``psum`` each (integer invariants
+    cannot ride a float reduction without losing bits, hence the
+    pair).  ``resid`` defaults to 0 for engines without a
+    normalization invariant (local search)."""
+    import jax.numpy as jnp
+
+    nf = jnp.uint32(0)
+    cks = jnp.uint32(0)
+    for leaf in state_leaves:
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            nf = nf + jnp.sum(
+                ~jnp.isfinite(leaf), dtype=jnp.uint32
+            )
+        cks = cks + wrapsum_words(leaf)
+    opk = jnp.uint32(0)
+    for leaf in operand_leaves:
+        opk = opk + wrapsum_words(leaf)
+    ints = jnp.stack([nf, cks, opk])
+    if resid is None:
+        resid = jnp.float32(0.0)
+    return ints, jnp.reshape(resid.astype(jnp.float32), (1,))
+
+
+def combine_sentinel(ints, resid, axis_name: str):
+    """psum the two sentinel partials across the mesh and pack them
+    into ONE replicated int32[4] vector (the residual rides bitcast in
+    lane 3)."""
+    import jax
+    import jax.numpy as jnp
+
+    ints = jax.lax.psum(ints, axis_name)
+    resid = jax.lax.psum(resid, axis_name)
+    rbits = jax.lax.bitcast_convert_type(resid, jnp.uint32)
+    return jnp.concatenate([ints, rbits]).astype(jnp.int32)
+
+
+@dataclasses.dataclass
+class SentinelReading:
+    """Host-side decode of one sentinel vector."""
+
+    nonfinite: int
+    state_checksum: int
+    operand_checksum: int
+    residual: float
+
+    def trip_reason(self, operand_ref: Optional[int] = None,
+                    resid_tol: float = 1e-2) -> Optional[str]:
+        """The first tripped invariant, or None when all hold.
+        ``operand_ref`` is the build-time operand checksum (skipped
+        when None — e.g. before the first chunk established it)."""
+        if self.nonfinite:
+            return "nonfinite"
+        if not (abs(self.residual) <= resid_tol):  # NaN-safe
+            return "residual"
+        if operand_ref is not None \
+                and self.operand_checksum != operand_ref:
+            return "operand"
+        return None
+
+
+def decode_sentinel(vec) -> SentinelReading:
+    """int32[4] sentinel vector (device or host) → reading."""
+    v = np.asarray(vec)
+    if v.shape[-1] != SENTINEL_WIDTH:
+        raise ValueError(
+            f"sentinel vector has width {v.shape[-1]}, "
+            f"expected {SENTINEL_WIDTH}"
+        )
+    u = v.astype(np.int64) & 0xFFFFFFFF
+    resid = float(
+        np.asarray(u[3], dtype=np.uint32).view(np.float32)
+    )
+    return SentinelReading(
+        nonfinite=int(u[0]),
+        state_checksum=int(u[1]),
+        operand_checksum=int(u[2]),
+        residual=resid,
+    )
+
+
+def wrapsum_host(arrays: Sequence[np.ndarray]) -> int:
+    """Host twin of the in-jit operand checksum: the wrapping uint32
+    word sum over ``arrays``, bit-for-bit equal to what
+    :func:`sentinel_block` computes over the same values on device."""
+    total = np.uint32(0)
+    with np.errstate(over="ignore"):
+        for a in arrays:
+            a = np.ascontiguousarray(a)
+            if a.dtype == np.float32:
+                w = a.view(np.uint32)
+            elif a.dtype.kind == "f":
+                w = a.astype(np.float32).view(np.uint32)
+            else:
+                w = a.astype(np.uint32)
+            total = np.uint32(
+                (int(total) + int(np.sum(w, dtype=np.uint64)))
+                & 0xFFFFFFFF
+            )
+    return int(total)
+
+
+def flip_bit(arr: np.ndarray, seed: int,
+             shard: Optional[int] = None,
+             n_shards: int = 1) -> np.ndarray:
+    """Return a copy of ``arr`` with ONE seeded bit flipped — the
+    ``corrupt_slab`` payload.  ``shard`` restricts the flip to that
+    shard's leading-axis block (shard-major stacking, ``n_shards``
+    blocks); same seed + same shape → same flipped bit."""
+    import random
+
+    a = np.ascontiguousarray(np.array(arr, copy=True))
+    if a.dtype == np.float32:
+        words = a.view(np.uint32).ravel()
+    elif a.dtype == np.int32:
+        words = a.view(np.uint32).ravel()
+    else:
+        raise ValueError(
+            f"corrupt_slab targets float32/int32 operands, got "
+            f"{a.dtype}"
+        )
+    if words.size == 0:
+        raise ValueError("cannot corrupt an empty operand")
+    lo, hi = 0, words.size
+    if shard is not None and n_shards > 1:
+        block = words.size // n_shards
+        if block:
+            lo = min(int(shard), n_shards - 1) * block
+            hi = lo + block
+    rng = random.Random(seed)
+    pos = rng.randrange(lo, hi)
+    bit = rng.randrange(32)
+    words[pos] ^= np.uint32(1 << bit)
+    return a

@@ -45,8 +45,21 @@ def solve_result(
     shard_overlap: Optional[str] = None,
     shard_boundary_threshold: float = 0.5,
     headroom: Optional[float] = None,
+    fault_plan=None,
+    elastic: Optional[Dict[str, Any]] = None,
 ) -> SolveResult:
     """Solve a DCOP and return the full result + metrics.
+
+    ``fault_plan`` (a runtime/faults.FaultPlan) with device-tier kinds
+    (``kill_device``/``shrink_mesh``/``corrupt_slab``) routes the
+    solve through the ELASTIC sharded driver (parallel/elastic,
+    docs/resilience.rst "Device loss and data integrity"): the solve
+    runs chunked over the device mesh with chunk-boundary snapshots,
+    in-jit integrity sentinels and the recovery ladder armed —
+    ``metrics()['integrity']`` carries the scorecard.  ``elastic`` (a
+    dict: chunk / scrub_every / min_devices / sentinel / use_packed /
+    snapshot_dir) tunes the driver, and alone (without a fault plan)
+    also selects it — how clean runs get sentinel + scrub coverage.
 
     ``shard_overlap`` selects the sharded engines' collective path on
     the placement-driven (multi-device) path: ``off`` keeps the dense
@@ -96,6 +109,15 @@ def solve_result(
 
     algo_def = _build_algo_def(dcop, algo, algo_params)
     algo_module = load_algorithm_module(algo_def.algo)
+
+    device_faults = (
+        fault_plan.device_faults() if fault_plan is not None else []
+    )
+    if device_faults or elastic is not None:
+        return _solve_elastic(
+            dcop, algo_def, cycles, seed, fault_plan,
+            dict(elastic or {}), shard_overlap,
+        )
 
     if isinstance(distribution, Distribution):
         if checkpoint_dir or resume:
@@ -221,6 +243,135 @@ def _run_with_checkpoints(
     if history:
         res.history = history
     return res
+
+
+#: algorithms the elastic device-fault tier can drive (the sharded
+#: engine families; dpop rides ElasticDpop's one-shot sweep)
+ELASTIC_ALGOS = ("maxsum", "amaxsum", "mgm", "dsa", "adsa", "dba",
+                 "gdba", "dpop")
+
+
+def _solve_elastic(
+    dcop: DCOP,
+    algo_def: AlgorithmDef,
+    cycles: Optional[int],
+    seed: int,
+    fault_plan,
+    opts: Dict[str, Any],
+    shard_overlap: Optional[str],
+) -> SolveResult:
+    """Run a solve through the elastic sharded driver
+    (parallel/elastic): chunked over the device mesh, chunk-boundary
+    snapshots, integrity sentinels + shadow scrub, and the device
+    fault plan consumed at chunk boundaries."""
+    from time import perf_counter
+
+    import numpy as np
+
+    from pydcop_tpu.algorithms import DEFAULT_INFINITY
+    from pydcop_tpu.parallel.elastic import ElasticDpop, ElasticRunner
+    from pydcop_tpu.runtime.stats import resolved_config
+
+    algo = algo_def.algo
+    if algo not in ELASTIC_ALGOS:
+        raise ValueError(
+            f"a device fault plan needs one of the elastic engine "
+            f"families {ELASTIC_ALGOS}, not {algo!r}"
+        )
+    t0 = perf_counter()
+    if algo == "dpop":
+        from pydcop_tpu.graph import pseudotree
+        from pydcop_tpu.ops.dpop_sweep import compile_sweep
+
+        tree = pseudotree.build_computation_graph(dcop)
+        plan = compile_sweep(tree, dcop, dcop.objective)
+        if plan is None:
+            raise ValueError(
+                "this problem does not compile to a whole-table DPOP "
+                "sweep; the elastic tier cannot drive it"
+            )
+        runner = ElasticDpop(
+            plan, fault_plan=fault_plan,
+            scrub=bool(opts.get("scrub_every", 1)),
+            min_devices=int(opts.get("min_devices", 1)),
+        )
+        res = runner.solve()
+        assignment = {}
+        for gidx, name in enumerate(plan.gid_to_name):
+            v = dcop.variables[name]
+            assignment[name] = v.domain[int(res.values[gidx])]
+        for name, v in dcop.variables.items():
+            if name not in assignment:
+                costs = v.cost_vector()
+                idx = int(np.argmin(costs) if dcop.objective == "min"
+                          else np.argmax(costs))
+                assignment[name] = v.domain[idx]
+        n_cycles = 1
+        tensors = None
+    else:
+        if algo in ("maxsum", "amaxsum"):
+            from pydcop_tpu.ops.compile import compile_factor_graph
+
+            tensors = compile_factor_graph(dcop)
+            engine = "maxsum"
+            activation = None
+            if algo == "amaxsum":
+                from pydcop_tpu.algorithms.amaxsum import (
+                    DEFAULT_ACTIVATION,
+                )
+
+                activation = float(algo_def.params.get(
+                    "activation", DEFAULT_ACTIVATION
+                ))
+            extra = {
+                "damping": (
+                    0.5 if algo_def.params.get("damping") is None
+                    else float(algo_def.params["damping"])
+                ),
+                "activation": activation,
+            }
+        else:
+            from pydcop_tpu.ops.compile import compile_constraint_graph
+
+            tensors = compile_constraint_graph(dcop)
+            engine = algo
+            extra = {"algo_params": dict(algo_def.params)}
+        runner = ElasticRunner(
+            tensors, engine=engine, fault_plan=fault_plan,
+            chunk=int(opts.get("chunk", 8)),
+            scrub_every=int(opts.get("scrub_every", 0)),
+            min_devices=int(opts.get("min_devices", 2)),
+            snapshot_dir=opts.get("snapshot_dir"),
+            sentinel=bool(opts.get("sentinel", True)),
+            use_packed=bool(opts.get("use_packed", False)),
+            overlap=shard_overlap or "off",
+            **extra,
+        )
+        n_cycles = cycles or 30
+        res = runner.solve(n_cycles, seed=seed)
+        assignment = tensors.assignment_from_indices(
+            np.asarray(res.values)
+        )
+    violation, cost = dcop.solution_cost(assignment, DEFAULT_INFINITY)
+    config = resolved_config(algo, "elastic_mesh",
+                             chunk=int(opts.get("chunk", 8)))
+    shard = None
+    eng = getattr(runner, "engine", None)
+    if eng is not None and hasattr(eng, "comm_stats"):
+        shard = eng.comm_stats()
+    return SolveResult(
+        status="FINISHED",
+        assignment=assignment,
+        cost=cost,
+        violation=violation,
+        cycle=res.cycles if algo != "dpop" else n_cycles,
+        msg_count=0,
+        msg_size=0.0,
+        time=perf_counter() - t0,
+        shard=shard,
+        config=config,
+        integrity=res.counters.as_dict(),
+    )
 
 
 def _solve_under_placement(

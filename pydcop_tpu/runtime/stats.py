@@ -74,6 +74,56 @@ class FaultCounters:
         return any(self.counts.values())
 
 
+#: counter names surfaced under ``metrics()['integrity']`` by the
+#: elastic device-fault tier (parallel/elastic.ElasticRunner +
+#: runtime/integrity) — the device-loss / silent-data-corruption
+#: scorecard of a sharded solve
+INTEGRITY_COUNTERS = (
+    "chunks_run",               # sentinel-checked chunk dispatches
+    "sentinel_trips",           # in-jit sentinel tripped (nonfinite /
+                                # residual / operand-checksum drift)
+    "scrub_runs",               # shadow re-executions performed
+    "scrub_mismatches",         # shadow checksum disagreed w/ primary
+    "sdc_detected",             # injected corruptions caught (trip or
+                                # scrub — counted once per injection)
+    "detection_latency_chunks",  # chunks from injection to detection
+                                # (sum over detected corruptions)
+    "snapshot_restores",        # state restored from a CRC'd chunk-
+                                # boundary snapshot (ladder rung 1)
+    "elastic_shrinks",          # exact-restore shrinks onto survivors
+    "repartitions",             # partition/boundary/exchange re-plans
+    "cold_repacks",             # full rebuild + replay (ladder floor)
+    "devices_lost",             # mesh devices dropped by faults
+    "snapshots_saved",          # chunk-boundary snapshots written
+)
+
+
+class IntegrityCounters:
+    """Device-fault-tier counters collected by the elastic driver and
+    merged into its end metrics (``metrics()['integrity']``)."""
+
+    def __init__(self):
+        self.counts = {k: 0 for k in INTEGRITY_COUNTERS}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        if name not in self.counts:
+            raise KeyError(
+                f"unknown integrity counter {name!r}; add it to "
+                f"INTEGRITY_COUNTERS"
+            )
+        self.counts[name] += n
+
+    def as_dict(self) -> dict:
+        return dict(self.counts)
+
+    @property
+    def any_faults(self) -> bool:
+        return any(self.counts[k] for k in (
+            "sentinel_trips", "scrub_mismatches", "sdc_detected",
+            "elastic_shrinks", "cold_repacks", "devices_lost",
+        ))
+
+
 #: counter names surfaced under metrics["batch"] by the batched solve
 #: engine (pydcop_tpu.batch.engine.BatchEngine.counters) — one schema
 #: for the library API, the in-process CLI runner and the bench
@@ -258,6 +308,10 @@ FLEET_COUNTERS = (
     "faults_injected",         # fleet fault-plan faults fired
     "journal_torn_lines",      # torn fleet-journal lines skipped on load
     "recoveries_completed",    # replica losses fully recovered (RTO set)
+    "devices_lost",            # mesh devices lost by replicas
+                               # (kill_device faults with a replica)
+    "capacity_reduced",        # reduced-capacity advertisements pushed
+                               # to the router after device loss
 )
 
 

@@ -50,6 +50,8 @@ class UiServer:
         event_bus.subscribe("agents.add_computation.*", self._cb_add_comp)
         event_bus.subscribe("agents.rem_computation.*", self._cb_rem_comp)
         event_bus.subscribe("faults.*", self._cb_fault)
+        event_bus.subscribe("integrity.*", self._cb_integrity)
+        event_bus.subscribe("elastic.*", self._cb_elastic)
         event_bus.subscribe("repair.*", self._cb_repair)
         event_bus.subscribe("batch.*", self._cb_batch)
         event_bus.subscribe("harness.*", self._cb_harness)
@@ -176,6 +178,33 @@ class UiServer:
         if self._ws is not None:
             self._ws.send_all(json.dumps(
                 {"evt": "fault",
+                 "kind": topic.split(".", 1)[-1],
+                 "data": evt if isinstance(evt, (dict, list, str, int,
+                                                 float, bool, type(None)))
+                 else repr(evt)}))
+
+    def _cb_integrity(self, topic: str, evt) -> None:
+        """Data-integrity lifecycle (integrity.sentinel.trip,
+        integrity.scrub.run|mismatch, integrity.injected,
+        integrity.restore) pushed to GUI clients in the same envelope
+        shape as the fault family; the SSE /events stream gets them
+        through the wildcard subscription like every topic."""
+        if self._ws is not None:
+            self._ws.send_all(json.dumps(
+                {"evt": "integrity",
+                 "kind": topic.split(".", 1)[-1],
+                 "data": evt if isinstance(evt, (dict, list, str, int,
+                                                 float, bool, type(None)))
+                 else repr(evt)}))
+
+    def _cb_elastic(self, topic: str, evt) -> None:
+        """Elastic-mesh lifecycle (elastic.device.lost,
+        elastic.shrink, elastic.repack, elastic.resumed) pushed to GUI
+        clients; the SSE /events stream gets them through the wildcard
+        subscription like every topic."""
+        if self._ws is not None:
+            self._ws.send_all(json.dumps(
+                {"evt": "elastic",
                  "kind": topic.split(".", 1)[-1],
                  "data": evt if isinstance(evt, (dict, list, str, int,
                                                  float, bool, type(None)))

@@ -163,11 +163,16 @@ def default_chaos_plan(
     stall_tick_at: int = 4,
     nan_tick: int = 6,
     churn_edit_ticks: Sequence[int] = (10, 18),
+    device_loss_tick: Optional[int] = 5,
+    device_loss_replica: int = 1,
 ) -> FaultPlan:
     """The twin's combined chaos plan: one replica kill (fleet), one
     wedged scheduler tick + one transient NaN lane + one torn journal
-    append (serve), and seeded ``edit_factor`` churn against the live
-    problem — every layer's fault machinery armed by ONE plan."""
+    append (serve), seeded ``edit_factor`` churn against the live
+    problem, and one device loss (ISSUE 14: a ``kill_device`` against
+    a SURVIVING replica, which keeps serving but advertises reduced
+    capacity to the router) — every layer's fault machinery armed by
+    ONE plan."""
     faults = [
         Fault(kind="kill_replica", replica=int(kill_replica),
               cycle=int(kill_tick)),
@@ -176,6 +181,12 @@ def default_chaos_plan(
         Fault(kind="nan_lane", cycle=int(nan_tick)),
         Fault(kind="torn_journal_write", cycle=2),
     ]
+    if device_loss_tick is not None:
+        faults.append(Fault(
+            kind="kill_device", device=0,
+            replica=int(device_loss_replica),
+            cycle=int(device_loss_tick),
+        ))
     for t in churn_edit_ticks:
         faults.append(Fault(kind="edit_factor", cycle=int(t)))
     return FaultPlan(faults=faults, seed=int(seed))

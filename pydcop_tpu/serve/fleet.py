@@ -185,6 +185,11 @@ class ReplicaHandle:
     stalled: bool = False
     killed_at: Optional[float] = None
     partition_until: Optional[float] = None
+    #: device-loss bookkeeping (ISSUE 14): ``kill_device`` faults with
+    #: this replica's index drop devices one by one; the supervisor
+    #: advertises the remaining fraction to the router as capacity
+    devices_total: int = 1
+    devices_lost: int = 0
 
     def kill(self) -> None:
         """The thread-hosted twin of ``kill -9``: halt the scheduler
@@ -286,6 +291,7 @@ class SolveFleet:
         supervise_interval: float = 0.05,
         shared_xla_cache: bool = False,
         counters: Optional[FleetCounters] = None,
+        devices_per_replica: int = 8,
     ):
         self.lanes = int(lanes)
         self.max_cycles = int(max_cycles)
@@ -299,6 +305,9 @@ class SolveFleet:
         self.tenant_quota = tenant_quota
         self.heartbeat_timeout = float(heartbeat_timeout)
         self.supervise_interval = float(supervise_interval)
+        #: nominal mesh size per replica: the denominator of the
+        #: reduced-capacity advertisement after kill_device faults
+        self.devices_per_replica = max(1, int(devices_per_replica))
         self.counters = counters if counters is not None else FleetCounters()
         #: the full chaos plan: fleet kinds are consumed by the
         #: supervisor below; SERVE kinds (raise_in_step / nan_lane /
@@ -384,6 +393,7 @@ class SolveFleet:
         handle = ReplicaHandle(
             name=name, index=index, service=service,
             journal_dir=jd, hb_path=hb,
+            devices_total=self.devices_per_replica,
         )
         service.on_complete = (
             lambda job, res, h=handle: self._on_replica_complete(
@@ -836,7 +846,7 @@ class SolveFleet:
         inj = self._injector
         if inj is not None:
             for kind in ("kill_replica", "stall_replica",
-                         "partition_replica"):
+                         "partition_replica", "kill_device"):
                 while True:
                     f = inj.due(kind, self._ticks)
                     if f is None:
@@ -918,6 +928,31 @@ class SolveFleet:
             self.counters.inc("replicas_partitioned")
             send_fleet("replica.partitioned", {
                 "name": h.name, "duration": fault.duration,
+            })
+        elif kind == "kill_device":
+            # a replica that lost a mesh device keeps serving at
+            # reduced capacity (ISSUE 14): advertise the remaining
+            # device fraction to the router so placement drains
+            # toward whole peers; losing the LAST device is a death
+            with self._lock:
+                h.devices_lost = min(h.devices_lost + 1,
+                                     h.devices_total)
+                remaining = h.devices_total - h.devices_lost
+                cap = remaining / h.devices_total
+                live = h.up and not h.killed
+            self.counters.inc("devices_lost")
+            if remaining <= 0:
+                send_fleet("replica.device_lost", {
+                    "name": h.name, "remaining": 0, "capacity": 0.0,
+                })
+                if live:
+                    h.kill()
+                return
+            self.router.set_capacity(h.name, cap)
+            self.counters.inc("capacity_reduced")
+            send_fleet("replica.device_lost", {
+                "name": h.name, "remaining": remaining,
+                "capacity": cap,
             })
 
     def _replica_down(self, h: ReplicaHandle, reason: str,

@@ -37,12 +37,21 @@ forever; with it, warmth decides placement at the margin and load
 decides it in the bulk, which is what makes jobs/s scale with replica
 count (the ``fleet`` bench leg).
 
-The router is pure host-side bookkeeping with no locks of its own; the
-fleet serializes access under its lock (serve/fleet.py).
+Replicas that lose mesh devices (a ``kill_device`` fault with a
+``replica`` — ISSUE 14) advertise **reduced capacity**: the router
+scales each replica's load by its remaining device fraction when
+ranking, so a half-capacity replica looks twice as loaded and traffic
+drains toward whole peers without marking the shrunk one down.
+
+The router is crossed by two threads — the fleet front door places
+jobs while the supervisor thread flips health/capacity state — so it
+owns its own lock and every state access goes through it (the
+lock-discipline lint covers this file).
 """
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from pydcop_tpu.batch.engine import _params_key
@@ -82,6 +91,10 @@ class _ReplicaState:
     stalled: bool = False
     partitioned: bool = False
     load: int = 0  # open (placed-but-unfinished) jobs
+    #: remaining device fraction (1.0 = whole mesh); a replica that
+    #: lost devices advertises < 1 and its load is scaled up by the
+    #: inverse when ranking placements (ISSUE 14)
+    capacity: float = 1.0
     warm: set = dataclasses.field(default_factory=set)
     #: ground-truth warmth probe (the replica's CompileCache.has),
     #: consulted for exact runner cache keys on re-seat placement
@@ -96,6 +109,13 @@ class _ReplicaState:
             return True
         return bool(self.warm_probe is not None and self.warm_probe(key))
 
+    @property
+    def effective_load(self) -> float:
+        """Open jobs scaled by the inverse remaining capacity — the
+        ranking metric: a replica at half capacity with 2 jobs is as
+        loaded as a whole one with 4."""
+        return self.load / max(self.capacity, 1e-6)
+
 
 class FleetRouter:
     """Places jobs on replicas by compile-cache routing key.
@@ -108,6 +128,7 @@ class FleetRouter:
 
     def __init__(self, spill_load: Optional[int] = None):
         self.spill_load = spill_load
+        self._lock = threading.Lock()
         self._replicas: Dict[str, _ReplicaState] = {}
 
     # -- membership ---------------------------------------------------------
@@ -115,58 +136,86 @@ class FleetRouter:
     def add_replica(self, name: str,
                     warm_probe: Optional[Callable[[Tuple], bool]] = None
                     ) -> None:
-        self._replicas[name] = _ReplicaState(
-            name=name, warm_probe=warm_probe
-        )
+        with self._lock:
+            self._replicas[name] = _ReplicaState(
+                name=name, warm_probe=warm_probe
+            )
 
     def mark_down(self, name: str) -> None:
-        self._replicas[name].up = False
+        with self._lock:
+            self._replicas[name].up = False
 
     def mark_up(self, name: str) -> None:
-        r = self._replicas[name]
-        r.up, r.stalled, r.partitioned = True, False, False
+        with self._lock:
+            r = self._replicas[name]
+            r.up, r.stalled, r.partitioned = True, False, False
+            r.capacity = 1.0
 
     def set_stalled(self, name: str, stalled: bool) -> None:
-        self._replicas[name].stalled = stalled
+        with self._lock:
+            self._replicas[name].stalled = stalled
 
     def set_partitioned(self, name: str, partitioned: bool) -> None:
-        self._replicas[name].partitioned = partitioned
+        with self._lock:
+            self._replicas[name].partitioned = partitioned
+
+    def set_capacity(self, name: str, capacity: float) -> None:
+        """Advertise a replica's remaining device fraction (ISSUE 14):
+        the fleet supervisor pushes this after a ``kill_device`` fault
+        so placement drains toward whole peers WITHOUT marking the
+        shrunk replica down (it still serves — just less)."""
+        with self._lock:
+            self._replicas[name].capacity = max(
+                0.0, min(1.0, float(capacity))
+            )
 
     # -- load accounting (one open job = one unit) --------------------------
 
     def job_placed(self, name: str) -> None:
-        self._replicas[name].load += 1
+        with self._lock:
+            self._replicas[name].load += 1
 
     def job_finished(self, name: str) -> None:
-        r = self._replicas.get(name)
-        if r is not None and r.load > 0:
-            r.load -= 1
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is not None and r.load > 0:
+                r.load -= 1
 
     def note_warm(self, name: str, key: Tuple) -> None:
         """Record that ``name`` holds (or is compiling) a runner for
         ``key`` — called on prewarm and on every placement."""
-        self._replicas[name].warm.add(key)
+        with self._lock:
+            self._replicas[name].warm.add(key)
 
     # -- queries ------------------------------------------------------------
 
     def routable(self) -> List[str]:
-        return [n for n, r in self._replicas.items() if r.routable]
+        with self._lock:
+            return [n for n, r in self._replicas.items() if r.routable]
 
     def up(self) -> List[str]:
-        return [n for n, r in self._replicas.items() if r.up]
+        with self._lock:
+            return [n for n, r in self._replicas.items() if r.up]
 
     def load(self, name: str) -> int:
-        return self._replicas[name].load
+        with self._lock:
+            return self._replicas[name].load
+
+    def capacity(self, name: str) -> float:
+        with self._lock:
+            return self._replicas[name].capacity
 
     def stats(self) -> Dict[str, Any]:
-        return {
-            n: {
-                "up": r.up, "stalled": r.stalled,
-                "partitioned": r.partitioned, "load": r.load,
-                "warm_keys": len(r.warm),
+        with self._lock:
+            return {
+                n: {
+                    "up": r.up, "stalled": r.stalled,
+                    "partitioned": r.partitioned, "load": r.load,
+                    "capacity": r.capacity,
+                    "warm_keys": len(r.warm),
+                }
+                for n, r in self._replicas.items()
             }
-            for n, r in self._replicas.items()
-        }
 
     # -- placement ----------------------------------------------------------
 
@@ -186,32 +235,40 @@ class FleetRouter:
         queue even at the price of a compile; scenario/slo.py).
         Routable already excludes down/stalled/partitioned replicas,
         so "emptiest" is always also "healthy"."""
-        candidates = [
-            r for n, r in self._replicas.items()
-            if r.routable and n != exclude
-        ]
-        if not candidates:
-            return None
-        warm = [r for r in candidates if r.is_warm(key)]
-        if prefer_emptiest:
-            best = min(candidates, key=lambda r: r.load)
-            warm = [best] if best.is_warm(key) else []
-        else:
-            pool = warm if warm else candidates
-            best = min(pool, key=lambda r: r.load)
-            if warm and self.spill_load is not None:
-                emptiest = min(candidates, key=lambda r: r.load)
-                if best.load - emptiest.load >= self.spill_load:
-                    # warm affinity loses at the margin: spill to the
-                    # emptiest peer, which warms up and splits the
-                    # family
-                    best = emptiest
-                    warm = [best] if best.is_warm(key) else []
-        best.load += 1
-        best.warm.add(key)
+        with self._lock:
+            candidates = [
+                r for n, r in self._replicas.items()
+                if r.routable and n != exclude
+            ]
+            if not candidates:
+                return None
+            warm = [r for r in candidates if r.is_warm(key)]
+            if prefer_emptiest:
+                best = min(candidates, key=lambda r: r.effective_load)
+                warm = [best] if best.is_warm(key) else []
+            else:
+                pool = warm if warm else candidates
+                # ranking is by EFFECTIVE load (load / remaining
+                # capacity): a replica that lost half its devices
+                # looks twice as loaded, so traffic drains toward
+                # whole peers (ISSUE 14)
+                best = min(pool, key=lambda r: r.effective_load)
+                if warm and self.spill_load is not None:
+                    emptiest = min(candidates,
+                                   key=lambda r: r.effective_load)
+                    if (best.effective_load - emptiest.effective_load
+                            >= self.spill_load):
+                        # warm affinity loses at the margin: spill to
+                        # the emptiest peer, which warms up and splits
+                        # the family
+                        best = emptiest
+                        warm = [best] if best.is_warm(key) else []
+            best.load += 1
+            best.warm.add(key)
+            name = best.name
         send_fleet("router.placed", {
-            "jid": jid, "replica": best.name,
+            "jid": jid, "replica": name,
             "key": [str(k) for k in key], "warm": bool(warm),
             "emptiest": bool(prefer_emptiest),
         })
-        return best.name, bool(warm)
+        return name, bool(warm)

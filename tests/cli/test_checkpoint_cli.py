@@ -1,0 +1,93 @@
+"""CLI surface of ``pydcop_tpu checkpoint scrub`` (ISSUE 14
+satellite): offline CRC/schema verification of a journal/checkpoint
+tree, exit 1 on corruption, ``--fix`` quarantining exactly the files
+``resume()`` would have skipped."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "PYTHONPATH": REPO,
+}
+
+
+def run_cli(*args, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu", *args],
+        capture_output=True, text=True, timeout=timeout, env=ENV,
+        cwd=REPO,
+    )
+
+
+def _make_tree(root):
+    from pydcop_tpu.runtime.checkpoint import write_state_npz
+    from pydcop_tpu.runtime.faults import corrupt_checkpoint
+
+    sub = os.path.join(root, "replica-0")
+    os.makedirs(sub)
+    write_state_npz(os.path.join(root, "ck_00000001.npz"),
+                    {"a": np.arange(8)}, {"kind": "solver"})
+    write_state_npz(os.path.join(sub, "ck_00000002.npz"),
+                    {"a": np.arange(8)}, {"kind": "solver"})
+    corrupt_checkpoint(os.path.join(sub, "ck_00000002.npz"), seed=1)
+    with open(os.path.join(root, "journal.jsonl"), "w") as f:
+        f.write('{"kind": "job"}\n{"kind": "done"}\ntorn-tail')
+    with open(os.path.join(sub, "bad.jsonl"), "w") as f:
+        f.write('{"kind": "job"}\nGARBAGE\n{"kind": "done"}\n')
+
+
+class TestCheckpointScrub:
+    def test_clean_tree_exits_zero(self, tmp_path):
+        from pydcop_tpu.runtime.checkpoint import write_state_npz
+
+        write_state_npz(str(tmp_path / "ck_00000001.npz"),
+                        {"a": np.arange(4)}, {"kind": "solver"})
+        proc = run_cli("checkpoint", "scrub", str(tmp_path))
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout)
+        assert out["status"] == "OK"
+        assert out["checked"] == 1
+        assert out["corrupt"] == []
+
+    def test_corruption_found_exits_one(self, tmp_path):
+        _make_tree(str(tmp_path))
+        proc = run_cli("checkpoint", "scrub", str(tmp_path))
+        assert proc.returncode == 1
+        out = json.loads(proc.stdout)
+        assert out["status"] == "CORRUPT"
+        assert out["checked"] == 4
+        bad = {c["file"] for c in out["corrupt"]}
+        assert bad == {os.path.join("replica-0", "ck_00000002.npz"),
+                       os.path.join("replica-0", "bad.jsonl")}
+        # the torn TAIL is tolerated (counted), not corruption
+        assert out["torn_tails_tolerated"] == 1
+
+    def test_fix_quarantines_and_exits_zero(self, tmp_path):
+        _make_tree(str(tmp_path))
+        proc = run_cli("checkpoint", "scrub", str(tmp_path), "--fix")
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout)
+        assert len(out["quarantined"]) == 2
+        sub = tmp_path / "replica-0"
+        assert (sub / "ck_00000002.npz.quarantined").exists()
+        assert not (sub / "ck_00000002.npz").exists()
+        # the scrubbed tree is clean now
+        proc = run_cli("checkpoint", "scrub", str(tmp_path))
+        assert proc.returncode == 0
+        # and resume-side walkers see only the good snapshot
+        from pydcop_tpu.runtime.checkpoint import CheckpointManager
+
+        got = CheckpointManager(str(sub)).latest_valid_state()
+        assert got is None  # the only snapshot there was quarantined
+
+    def test_missing_directory_errors(self, tmp_path):
+        proc = run_cli("checkpoint", "scrub",
+                       str(tmp_path / "nope"))
+        assert proc.returncode == 1

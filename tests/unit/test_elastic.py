@@ -1,0 +1,577 @@
+"""Elastic mesh + integrity tier (ISSUE 14).
+
+The chaos matrix acceptance pin: for each of
+``kill_device``/``shrink_mesh``/``corrupt_slab`` × {sharded maxsum
+generic, sharded maxsum packed, sharded MGM, sharded DPOP}, the
+injected run completes, and on the exact-restore path the final
+assignment is bit-identical to the unfailed run.  ``corrupt_slab`` is
+detected with zero false positives on clean runs.
+
+The maxsum bit-identity pins ride the exact arithmetic tier
+(docs/resilience.rst "Device loss and data integrity"): integer
+costs, power-of-two domain sizes, damping 0.5 and a bounded cycle
+count keep every message a small dyadic rational, so f32 addition is
+associative and the trajectory is partition-independent.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.runtime.faults import Fault, FaultPlan
+from pydcop_tpu.runtime.integrity import (
+    SENTINEL_WIDTH,
+    decode_sentinel,
+    flip_bit,
+    wrapsum_host,
+)
+
+CYCLES, CHUNK = 12, 4
+LS_CYCLES, LS_CHUNK = 20, 5
+
+
+@pytest.fixture(scope="module")
+def exact_factor_tensors():
+    """Ring coloring with integer costs and D=4 — the exact tier."""
+    from pydcop_tpu.ops.compile import compile_binary_from_arrays
+
+    V, D = 32, 4
+    rng = np.random.default_rng(0)
+    idx = np.arange(V)
+    ei = np.concatenate([idx, idx])
+    ej = np.concatenate([(idx + 1) % V, (idx + 2) % V])
+    mats = rng.integers(0, 8, (2 * V, D, D)).astype(np.float32)
+    unary = rng.integers(0, 4, (V, D)).astype(np.float32)
+    return compile_binary_from_arrays(ei, ej, mats, V, unary=unary)
+
+
+@pytest.fixture(scope="module")
+def constraint_tensors():
+    from pydcop_tpu.analysis.registry import _ring_constraint_tensors
+
+    return _ring_constraint_tensors()
+
+
+@pytest.fixture(scope="module")
+def dpop_plan():
+    from pydcop_tpu.generators import generate_graph_coloring
+    from pydcop_tpu.graph import pseudotree
+    from pydcop_tpu.ops.dpop_sweep import compile_sweep
+
+    dcop = generate_graph_coloring(
+        n_variables=12, n_colors=3, n_edges=16, soft=True,
+        n_agents=1, seed=3,
+    )
+    tree = pseudotree.build_computation_graph(dcop)
+    return compile_sweep(tree, dcop, "min")
+
+
+def _runner(tensors, engine, plan=None, **kw):
+    from pydcop_tpu.parallel.elastic import ElasticRunner
+
+    kw.setdefault("sentinel", True)
+    return ElasticRunner(tensors, engine=engine, fault_plan=plan,
+                         **kw)
+
+
+@pytest.fixture(scope="module")
+def clean_maxsum(exact_factor_tensors):
+    return _runner(exact_factor_tensors, "maxsum",
+                   chunk=CHUNK).solve(CYCLES, seed=0)
+
+
+@pytest.fixture(scope="module")
+def clean_maxsum_packed(exact_factor_tensors):
+    return _runner(exact_factor_tensors, "maxsum", chunk=CHUNK,
+                   use_packed=True).solve(CYCLES, seed=0)
+
+
+@pytest.fixture(scope="module")
+def clean_mgm(constraint_tensors):
+    return _runner(constraint_tensors, "mgm",
+                   chunk=LS_CHUNK).solve(LS_CYCLES, seed=0)
+
+
+@pytest.fixture(scope="module")
+def clean_dpop(dpop_plan):
+    from pydcop_tpu.parallel.elastic import ElasticDpop
+
+    return ElasticDpop(dpop_plan).solve()
+
+
+# ---------------------------------------------------------------------------
+# integrity primitives
+
+
+class TestIntegrityPrimitives:
+    def test_wrapsum_device_host_agree(self):
+        import jax
+        import jax.numpy as jnp
+
+        from pydcop_tpu.runtime.integrity import wrapsum_words
+
+        rng = np.random.default_rng(1)
+        a = rng.normal(size=(37, 5)).astype(np.float32)
+        dev = int(jax.jit(wrapsum_words)(jnp.asarray(a)))
+        assert dev == wrapsum_host([a])
+
+    def test_wrapsum_is_layout_independent(self):
+        rng = np.random.default_rng(2)
+        a = rng.normal(size=(24,)).astype(np.float32)
+        perm = rng.permutation(24)
+        padded = np.concatenate(
+            [a[perm], np.zeros(8, np.float32)]
+        )
+        assert wrapsum_host([a]) == wrapsum_host([padded])
+
+    def test_flip_bit_is_seeded_and_single_bit(self):
+        a = np.zeros((16, 4), np.float32)
+        b1 = flip_bit(a, seed=5)
+        b2 = flip_bit(a, seed=5)
+        assert np.array_equal(b1, b2)
+        diff = a.view(np.uint32) ^ b1.view(np.uint32)
+        assert bin(int(diff.sum(dtype=np.uint64))).count("1") == 1
+
+    def test_flip_bit_respects_shard_block(self):
+        a = np.zeros((8, 4), np.float32)
+        b = flip_bit(a, seed=1, shard=3, n_shards=4)
+        rows = np.flatnonzero((a != b).any(axis=1))
+        assert rows.size == 1 and 6 <= rows[0] < 8
+
+    def test_decode_roundtrip(self):
+        import jax.numpy as jnp
+
+        v = jnp.asarray([3, 7, 11,
+                         np.float32(0.5).view(np.int32)],
+                        dtype=jnp.int32)
+        r = decode_sentinel(v)
+        assert (r.nonfinite, r.state_checksum,
+                r.operand_checksum) == (3, 7, 11)
+        assert r.residual == 0.5
+        with pytest.raises(ValueError):
+            decode_sentinel(np.zeros(3, np.int32))
+
+    def test_trip_reasons(self):
+        from pydcop_tpu.runtime.integrity import SentinelReading
+
+        ok = SentinelReading(0, 1, 2, 0.0)
+        assert ok.trip_reason(operand_ref=2) is None
+        assert SentinelReading(1, 1, 2, 0.0).trip_reason() \
+            == "nonfinite"
+        assert SentinelReading(0, 1, 2, 5.0).trip_reason() \
+            == "residual"
+        assert SentinelReading(0, 1, 2, float("nan")).trip_reason() \
+            == "residual"
+        assert ok.trip_reason(operand_ref=9) == "operand"
+        assert ok.trip_reason(operand_ref=None) is None
+
+    def test_counters_schema(self):
+        from pydcop_tpu.runtime.stats import IntegrityCounters
+
+        c = IntegrityCounters()
+        c.inc("sentinel_trips")
+        assert c.any_faults
+        with pytest.raises(KeyError):
+            c.inc("nope")
+
+
+# ---------------------------------------------------------------------------
+# canonical codec
+
+
+class TestCanonicalCodec:
+    def test_roundtrip_across_meshes(self, exact_factor_tensors):
+        import jax
+        from jax.sharding import Mesh
+
+        from pydcop_tpu.parallel.elastic import (
+            canonical_messages,
+            stacked_messages,
+        )
+        from pydcop_tpu.parallel.mesh import AXIS, ShardedMaxSum
+
+        devs = jax.devices()
+        e8 = ShardedMaxSum(exact_factor_tensors,
+                           Mesh(np.array(devs), (AXIS,)),
+                           use_packed=False)
+        e5 = ShardedMaxSum(exact_factor_tensors,
+                           Mesh(np.array(devs[:5]), (AXIS,)),
+                           use_packed=False)
+        rng = np.random.default_rng(3)
+        E8 = int(np.asarray(e8.st.edge_var).shape[0])
+        D = e8.st.max_domain_size
+        # messages live on REAL edges; dummy rows are zero by contract
+        stacked = np.zeros((E8, D), np.float32)
+        real = np.asarray(e8.st.edge_var) < e8.st.n_vars
+        stacked[real] = rng.normal(
+            size=(int(real.sum()), D)
+        ).astype(np.float32)
+        canon = canonical_messages(e8, stacked)
+        back = stacked_messages(e8, canon)
+        assert np.array_equal(back, stacked)
+        # cross-mesh transport preserves every real-edge message
+        re5 = stacked_messages(e5, canon)
+        assert np.array_equal(canonical_messages(e5, re5), canon)
+
+
+# ---------------------------------------------------------------------------
+# the chaos matrix (acceptance pin)
+
+
+class TestChaosMatrix:
+    # -- sharded maxsum, generic (exact-restore path: bitmatch) ----------
+
+    def test_maxsum_clean_zero_false_positives(self, clean_maxsum):
+        c = clean_maxsum.counters.counts
+        assert c["sentinel_trips"] == 0
+        assert c["scrub_mismatches"] == 0
+
+    def test_maxsum_kill_device(self, exact_factor_tensors,
+                                clean_maxsum):
+        plan = FaultPlan(faults=[
+            Fault(kind="kill_device", device=3, cycle=5),
+        ], seed=7)
+        r = _runner(exact_factor_tensors, "maxsum", plan,
+                    chunk=CHUNK).solve(CYCLES, seed=0)
+        assert r.n_devices == clean_maxsum.n_devices - 1
+        assert r.counters.counts["elastic_shrinks"] == 1
+        assert r.counters.counts["devices_lost"] == 1
+        assert np.array_equal(r.values, clean_maxsum.values)
+
+    def test_maxsum_shrink_mesh(self, exact_factor_tensors,
+                                clean_maxsum):
+        plan = FaultPlan(faults=[
+            Fault(kind="shrink_mesh", devices=5, cycle=6),
+        ], seed=7)
+        r = _runner(exact_factor_tensors, "maxsum", plan,
+                    chunk=CHUNK).solve(CYCLES, seed=0)
+        assert r.n_devices == 5
+        assert r.counters.counts["repartitions"] >= 2
+        assert np.array_equal(r.values, clean_maxsum.values)
+
+    def test_maxsum_corrupt_slab_operand(self, exact_factor_tensors,
+                                         clean_maxsum):
+        plan = FaultPlan(faults=[
+            Fault(kind="corrupt_slab", operand="bucket0", cycle=4),
+        ], seed=3)
+        r = _runner(exact_factor_tensors, "maxsum", plan,
+                    chunk=CHUNK).solve(CYCLES, seed=0)
+        c = r.counters.counts
+        # detected within ONE chunk by the operand-checksum sentinel
+        assert c["sentinel_trips"] == 1
+        assert c["sdc_detected"] == 1
+        assert c["detection_latency_chunks"] <= 1
+        assert c["snapshot_restores"] == 1
+        assert np.array_equal(r.values, clean_maxsum.values)
+
+    def test_maxsum_corrupt_state_caught_by_scrub(
+            self, exact_factor_tensors, clean_maxsum):
+        plan = FaultPlan(faults=[
+            Fault(kind="corrupt_slab", operand="q", cycle=4),
+        ], seed=3)
+        r = _runner(exact_factor_tensors, "maxsum", plan,
+                    chunk=CHUNK, scrub_every=1).solve(CYCLES, seed=0)
+        c = r.counters.counts
+        assert c["scrub_mismatches"] == 1
+        assert c["sdc_detected"] == 1
+        assert np.array_equal(r.values, clean_maxsum.values)
+
+    def test_maxsum_below_floor_cold_repacks(
+            self, exact_factor_tensors, clean_maxsum):
+        """The ladder floor: shrinking under --elastic-min-devices
+        takes ONE counted cold repack + replay instead of the warm
+        shrink — and still bit-matches (exact tier)."""
+        plan = FaultPlan(faults=[
+            Fault(kind="shrink_mesh", devices=2, cycle=5),
+        ], seed=7)
+        r = _runner(exact_factor_tensors, "maxsum", plan,
+                    chunk=CHUNK, min_devices=4).solve(CYCLES, seed=0)
+        c = r.counters.counts
+        assert c["cold_repacks"] == 1
+        assert c["elastic_shrinks"] == 0
+        assert np.array_equal(r.values, clean_maxsum.values)
+
+    # -- sharded maxsum, packed (cold-repack rung on shrink) -------------
+
+    def test_packed_kill_device(self, exact_factor_tensors,
+                                clean_maxsum_packed):
+        plan = FaultPlan(faults=[
+            Fault(kind="kill_device", device=2, cycle=5),
+        ], seed=7)
+        r = _runner(exact_factor_tensors, "maxsum", plan,
+                    chunk=CHUNK, use_packed=True).solve(CYCLES,
+                                                        seed=0)
+        c = r.counters.counts
+        assert r.n_devices == clean_maxsum_packed.n_devices - 1
+        assert c["cold_repacks"] == 1  # packed state is layout-bound
+        # deterministic replay on the exact tier still bit-matches
+        assert np.array_equal(r.values, clean_maxsum_packed.values)
+
+    def test_packed_shrink_mesh(self, exact_factor_tensors,
+                                clean_maxsum_packed):
+        plan = FaultPlan(faults=[
+            Fault(kind="shrink_mesh", devices=6, cycle=6),
+        ], seed=7)
+        r = _runner(exact_factor_tensors, "maxsum", plan,
+                    chunk=CHUNK, use_packed=True).solve(CYCLES,
+                                                        seed=0)
+        assert r.n_devices == 6
+        assert np.array_equal(r.values, clean_maxsum_packed.values)
+
+    def test_packed_corrupt_slab(self, exact_factor_tensors,
+                                 clean_maxsum_packed):
+        plan = FaultPlan(faults=[
+            Fault(kind="corrupt_slab", operand="cost", cycle=4),
+        ], seed=5)
+        r = _runner(exact_factor_tensors, "maxsum", plan,
+                    chunk=CHUNK, use_packed=True).solve(CYCLES,
+                                                        seed=0)
+        c = r.counters.counts
+        assert c["sentinel_trips"] == 1
+        assert c["sdc_detected"] == 1
+        assert np.array_equal(r.values, clean_maxsum_packed.values)
+
+    # -- sharded MGM (exact-restore path: bitmatch) ----------------------
+
+    def test_mgm_clean_zero_false_positives(self, clean_mgm):
+        c = clean_mgm.counters.counts
+        assert c["sentinel_trips"] == 0
+        assert c["scrub_mismatches"] == 0
+
+    def test_mgm_kill_device(self, constraint_tensors, clean_mgm):
+        plan = FaultPlan(faults=[
+            Fault(kind="kill_device", device=1, cycle=7),
+        ], seed=1)
+        r = _runner(constraint_tensors, "mgm", plan,
+                    chunk=LS_CHUNK).solve(LS_CYCLES, seed=0)
+        assert r.counters.counts["elastic_shrinks"] == 1
+        assert np.array_equal(r.values, clean_mgm.values)
+
+    def test_mgm_shrink_mesh(self, constraint_tensors, clean_mgm):
+        plan = FaultPlan(faults=[
+            Fault(kind="shrink_mesh", devices=4, cycle=11),
+        ], seed=1)
+        r = _runner(constraint_tensors, "mgm", plan,
+                    chunk=LS_CHUNK).solve(LS_CYCLES, seed=0)
+        assert r.n_devices == 4
+        assert np.array_equal(r.values, clean_mgm.values)
+
+    def test_mgm_corrupt_slab(self, constraint_tensors, clean_mgm):
+        plan = FaultPlan(faults=[
+            Fault(kind="corrupt_slab", operand="bucket0", cycle=5),
+        ], seed=2)
+        r = _runner(constraint_tensors, "mgm", plan,
+                    chunk=LS_CHUNK).solve(LS_CYCLES, seed=0)
+        c = r.counters.counts
+        assert c["sentinel_trips"] == 1
+        assert c["sdc_detected"] == 1
+        assert c["detection_latency_chunks"] <= 1
+        assert np.array_equal(r.values, clean_mgm.values)
+
+    # -- sharded DPOP (one-shot sweep) -----------------------------------
+
+    def test_dpop_clean_zero_false_positives(self, clean_dpop):
+        assert clean_dpop.counters.counts["scrub_mismatches"] == 0
+
+    def test_dpop_kill_device(self, dpop_plan, clean_dpop):
+        from pydcop_tpu.parallel.elastic import ElasticDpop
+
+        plan = FaultPlan(faults=[
+            Fault(kind="kill_device", device=5, cycle=0),
+        ], seed=1)
+        r = ElasticDpop(dpop_plan, fault_plan=plan).solve()
+        assert r.n_devices == clean_dpop.n_devices - 1
+        assert np.array_equal(r.values, clean_dpop.values)
+
+    def test_dpop_shrink_mesh(self, dpop_plan, clean_dpop):
+        from pydcop_tpu.parallel.elastic import ElasticDpop
+
+        plan = FaultPlan(faults=[
+            Fault(kind="shrink_mesh", devices=4, cycle=0),
+        ], seed=1)
+        r = ElasticDpop(dpop_plan, fault_plan=plan).solve()
+        assert r.n_devices == 4
+        assert np.array_equal(r.values, clean_dpop.values)
+
+    def test_dpop_corrupt_slab(self, dpop_plan, clean_dpop):
+        from pydcop_tpu.parallel.elastic import ElasticDpop
+
+        plan = FaultPlan(faults=[
+            Fault(kind="corrupt_slab", operand="local", cycle=0),
+        ], seed=2)
+        r = ElasticDpop(dpop_plan, fault_plan=plan).solve()
+        c = r.counters.counts
+        assert c["scrub_mismatches"] == 1
+        assert c["sdc_detected"] == 1
+        assert c["snapshot_restores"] == 1
+        assert np.array_equal(r.values, clean_dpop.values)
+
+
+# ---------------------------------------------------------------------------
+# sentinel plumbing on the engines
+
+
+class TestSentinelPlumbing:
+    def test_sentinel_rides_values_tensor(self, exact_factor_tensors):
+        """One tensor per chunk: [V] values ++ int32[4] sentinel."""
+        import jax
+
+        from pydcop_tpu.parallel.mesh import ShardedMaxSum, build_mesh
+
+        eng = ShardedMaxSum(exact_factor_tensors, build_mesh(),
+                            use_packed=False, sentinel=True)
+        v, q, r = eng.run(cycles=2, seed=0)
+        assert v.shape == (exact_factor_tensors.n_vars,)
+        assert eng.last_sentinel.shape == (SENTINEL_WIDTH,)
+        reading = decode_sentinel(eng.last_sentinel)
+        assert reading.nonfinite == 0
+        # operand checksum matches the host reference exactly
+        ref = wrapsum_host([
+            np.asarray(eng.get_operand(n))
+            for n in eng.operand_names()
+        ])
+        assert reading.operand_checksum == ref
+        del jax  # imported for parity with other engines' tests
+
+    def test_sentinel_does_not_perturb_values(
+            self, exact_factor_tensors):
+        from pydcop_tpu.parallel.mesh import ShardedMaxSum, build_mesh
+
+        a = ShardedMaxSum(exact_factor_tensors, build_mesh(),
+                          use_packed=False, sentinel=True)
+        b = ShardedMaxSum(exact_factor_tensors, build_mesh(),
+                          use_packed=False, sentinel=False)
+        va, *_ = a.run(cycles=3, seed=0)
+        vb, *_ = b.run(cycles=3, seed=0)
+        assert np.array_equal(va, vb)
+
+    def test_state_checksum_is_partition_independent(
+            self, exact_factor_tensors):
+        """The layout-independence claim the scrub rests on: dense vs
+        boundary-compacted layouts produce the SAME state checksum."""
+        from pydcop_tpu.parallel.mesh import ShardedMaxSum, build_mesh
+
+        readings = []
+        for overlap in ("off", "exact"):
+            e = ShardedMaxSum(exact_factor_tensors, build_mesh(),
+                              use_packed=False, overlap=overlap,
+                              sentinel=True)
+            e.run(cycles=3, seed=0)
+            readings.append(decode_sentinel(e.last_sentinel))
+        assert (readings[0].state_checksum
+                == readings[1].state_checksum)
+
+    def test_ls_sentinel_requires_generic_dense(
+            self, constraint_tensors):
+        from pydcop_tpu.parallel.mesh import (
+            ShardedLocalSearch,
+            build_mesh,
+        )
+
+        with pytest.raises(ValueError, match="generic dense"):
+            ShardedLocalSearch(constraint_tensors, build_mesh(),
+                               rule="mgm", use_packed=False,
+                               overlap="exact", sentinel=True)
+
+    def test_mgm_chunked_equals_unchunked(self, constraint_tensors):
+        from pydcop_tpu.parallel.mesh import (
+            ShardedLocalSearch,
+            build_mesh,
+        )
+
+        whole = ShardedLocalSearch(constraint_tensors, build_mesh(),
+                                   rule="mgm", use_packed=False,
+                                   overlap="off")
+        v_whole = whole.run(cycles=10, seed=0)
+        chunked = ShardedLocalSearch(constraint_tensors, build_mesh(),
+                                     rule="mgm", use_packed=False,
+                                     overlap="off")
+        vals, x, aux = chunked.run_chunked(4, seed=0, epoch=0)
+        vals, x, aux = chunked.run_chunked(6, x=x, aux=aux, seed=0,
+                                           epoch=1)
+        assert np.array_equal(vals, v_whole)
+
+
+# ---------------------------------------------------------------------------
+# events + fleet capacity advertising
+
+
+class TestEventsAndFleet:
+    def test_integrity_and_elastic_events_emitted(
+            self, exact_factor_tensors):
+        from pydcop_tpu.runtime.events import event_bus
+
+        seen = []
+        cb = lambda topic, evt: seen.append(topic)  # noqa: E731
+        event_bus.enabled = True
+        event_bus.subscribe("integrity.*", cb)
+        event_bus.subscribe("elastic.*", cb)
+        try:
+            plan = FaultPlan(faults=[
+                Fault(kind="corrupt_slab", operand="bucket0",
+                      cycle=4),
+                Fault(kind="kill_device", device=1, cycle=9),
+            ], seed=3)
+            _runner(exact_factor_tensors, "maxsum", plan,
+                    chunk=CHUNK).solve(CYCLES, seed=0)
+        finally:
+            event_bus.unsubscribe(cb)
+            event_bus.enabled = False
+        assert "integrity.injected" in seen
+        assert "integrity.sentinel.trip" in seen
+        assert "integrity.restore" in seen
+        assert "elastic.device.lost" in seen
+        assert "elastic.shrink" in seen
+        assert "elastic.resumed" in seen
+
+    def test_router_capacity_scales_placement(self):
+        from pydcop_tpu.serve.router import FleetRouter
+
+        router = FleetRouter()
+        router.add_replica("a")
+        router.add_replica("b")
+        router.set_capacity("a", 0.25)
+        # a at quarter capacity with 1 job is "heavier" than b with 3
+        router.job_placed("a")
+        for _ in range(3):
+            router.job_placed("b")
+        name, _warm = router.place(("mgm", (), "x", (2,)))
+        assert name == "b"
+        assert router.stats()["a"]["capacity"] == 0.25
+
+    def test_fleet_kill_device_advertises_capacity(self, tmp_path):
+        from pydcop_tpu.serve.fleet import SolveFleet
+
+        plan = FaultPlan(faults=[
+            Fault(kind="kill_device", device=0, replica=1, cycle=0),
+        ], seed=1)
+        fleet = SolveFleet(
+            replicas=2, lanes=1, fault_plan=plan,
+            journal_dir=str(tmp_path), devices_per_replica=4,
+        )
+        try:
+            f = plan.fleet_faults()[0]
+            fleet._inject("kill_device", f, 0.0)
+            stats = fleet.router.stats()
+            assert stats["replica-1"]["capacity"] == 0.75
+            assert fleet.counters.counts["devices_lost"] == 1
+            assert fleet.counters.counts["capacity_reduced"] == 1
+            # placement drains toward the whole replica under equal
+            # load pressure
+            fleet.router.job_placed("replica-0")
+            fleet.router.job_placed("replica-1")
+            name, _w = fleet.router.place(("mgm", (), "x", (2,)))
+            assert name == "replica-0"
+        finally:
+            fleet.stop(drain=False)
+
+    def test_twin_chaos_plan_carries_device_fault(self):
+        from pydcop_tpu.scenario.twin import default_chaos_plan
+
+        plan = default_chaos_plan()
+        kinds = plan.validate()
+        assert "kill_device" in kinds
+        # replica-scoped: consumed by the FLEET, not the elastic tier
+        assert not plan.device_faults()
+        assert any(f.kind == "kill_device"
+                   for f in plan.fleet_faults())

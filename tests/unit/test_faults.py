@@ -119,6 +119,9 @@ class TestFaultKindCatalog:
         "kill_replica": {"replica": 0},
         "stall_replica": {"replica": 1, "duration": 0.5},
         "partition_replica": {"replica": 0, "duration": 1.0},
+        "kill_device": {"device": 3},
+        "shrink_mesh": {"devices": 4},
+        "corrupt_slab": {"operand": "bucket0"},
     }
 
     def _docs_section(self):
