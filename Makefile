@@ -4,7 +4,8 @@
 	bench-batch batch-smoke bench-harness bench-sharded bench-serve \
 	serve-smoke chaos-smoke bench-churn churn-smoke bench-dpop \
 	dpop-smoke bench-auto portfolio-smoke bench-fleet fleet-smoke \
-	bench-twin twin-smoke bench-r06 analyze
+	bench-twin twin-smoke bench-r06 analyze bench-search search-smoke \
+	bench-r08
 
 test: all-tests
 
@@ -67,6 +68,31 @@ bench-sharded:
 # "Sharded exact inference", BENCHREF.md "Sharded exact DPOP")
 bench-dpop:
 	python bench.py --only dpop-sharded
+
+# anytime exact search (ISSUE 15): optimality-gap-vs-time curve +
+# node throughput on two high-width instances that full DPOP refuses
+# under budget (typed UtilTableTooLarge pinned in the leg), drift-
+# normalized (docs/performance.rst "Frontier-batched exact search",
+# BENCHREF.md "Anytime exact search")
+bench-search:
+	python bench.py --only search
+
+# the anytime exact search end-to-end through the CLI: the kill-9
+# checkpoint/resume scenario (SIGKILL a checkpointing
+# `solve --anytime-exact` mid-search, `--resume` lands on the exact
+# frontier state and still proves the clean optimum); slow-marked, so
+# it does NOT run in tier-1 — run it next to dpop-smoke whenever
+# touching pydcop_tpu/search/.  The fast (not-slow) search CLI tests
+# ride tier-1 via tests/cli.
+search-smoke:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/cli/test_search_cli.py tests/unit/test_search.py \
+		-q
+
+# the r07 legs + the anytime exact-search leg in one run with a
+# machine-readable BENCH_r08.json snapshot (ISSUE 15 satellite)
+bench-r08:
+	python bench.py --only r08 --snapshot BENCH_r08.json
 
 # fast sharded-DPOP smoke: the tiled-vs-single-device parity matrix,
 # pruning property and mini-bucket bound-sandwich tests on the CPU

@@ -2345,6 +2345,155 @@ def bench_dpop_sharded_inner(args):
     return out
 
 
+def bench_search_subprocess(args):
+    """Anytime exact search on the CPU backend, in a subprocess for
+    the same platform-isolation reason as the other forced-CPU legs."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, os.path.abspath(__file__), "--only",
+           "search-inner", "--repeat", str(args.repeat),
+           "--watchdog", "0"]
+    out = subprocess.run(
+        cmd,
+        capture_output=True, text=True, timeout=900, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+    )
+    lines = out.stdout.strip().splitlines()
+    if not lines:
+        raise RuntimeError(
+            f"search subprocess produced no output "
+            f"(rc={out.returncode}): " + out.stderr.strip()[-400:]
+        )
+    return json.loads(lines[-1])
+
+
+def build_search_dcop(K, R, D, seed):
+    """High-width anytime-search instance: ``R`` cliques of ``K``
+    variables at domain ``D`` — induced width K-1, so the widest util
+    table holds ``D^K`` entries and full DPOP refuses under any
+    budget below it, while the frontier engine needs only its [B, n]
+    slab.  Integer costs: exactly representable."""
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    rng = np.random.default_rng(seed)
+    dcop = DCOP("search_bench", objective="min")
+    dom = Domain("d", "vals", list(range(D)))
+    k = 0
+    for r in range(R):
+        vs = [Variable(f"b{r}v{i:02d}", dom) for i in range(K)]
+        for v in vs:
+            dcop.add_variable(v)
+        for i in range(K):
+            for j in range(i + 1, K):
+                m = rng.integers(0, 10, (D, D)).astype(float)
+                dcop.add_constraint(
+                    NAryMatrixRelation([vs[i], vs[j]], m, name=f"c{k}")
+                )
+                k += 1
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
+def bench_search_inner(args):
+    """Runs inside the CPU subprocess: the optimality-gap-vs-time
+    curve of `solve --anytime-exact` on TWO high-width instances that
+    full DPOP refuses under budget (typed UtilTableTooLarge — pinned
+    here), with node throughput and the proof wall in the JSON;
+    drift-normalized via the calibration probe (BENCHREF.md "Anytime
+    exact search")."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from pydcop_tpu.graph import pseudotree
+    from pydcop_tpu.ops.dpop_shard import (
+        UtilTableTooLarge, plan_tiled_sweep,
+    )
+    from pydcop_tpu.search.solver import FrontierSearchSolver
+
+    try:
+        probe = make_drift_probe(repeat=max(2, args.repeat))
+    except Exception:
+        probe = None
+
+    out = {}
+    # two instances, two bound tiers: the DPOP-exact heuristic
+    # (near-instant proof) and a weak i_bound=2 mini-bucket heuristic
+    # (a real anytime trajectory with a visibly closing gap)
+    legs = (
+        ("k10x4", dict(K=10, R=1, D=4, seed=3), 0, 8),
+        ("k11x3_ib2", dict(K=11, R=2, D=3, seed=7), 2, 8),
+    )
+    for label, spec, i_bound, steps in legs:
+        dcop = build_search_dcop(**spec)
+        tree = pseudotree.build_computation_graph(dcop)
+        # pin the typed refusal: even the 8-way tiled sweep busts a
+        # budget set below one tile — the regime this engine opens
+        probe_plan = plan_tiled_sweep(tree, dcop, "min", n_shards=8)
+        budget = probe_plan.bytes_per_device // 2
+        refused = False
+        try:
+            plan_tiled_sweep(tree, dcop, "min", n_shards=8,
+                             budget_bytes=budget)
+        except UtilTableTooLarge:
+            refused = True
+        out[f"search_dpop_refusal_typed_{label}"] = refused
+
+        solver = FrontierSearchSolver(
+            dcop, tree=tree, frontier_width=256, steps=steps,
+            i_bound=i_bound,
+        )
+        t0 = time.perf_counter()
+        res = solver.run(collect_cycles=True)
+        wall = time.perf_counter() - t0
+        s = res.metrics()["search"]
+        out[f"search_proved_optimal_{label}"] = s["optimal"]
+        out[f"search_time_to_proof_s_{label}"] = round(wall, 4)
+        out[f"search_nodes_per_s_{label}"] = s["nodes_per_s"]
+        out[f"search_nodes_{label}"] = s["nodes"]
+        out[f"search_chunks_{label}"] = s["chunks"]
+        out[f"search_bound_source_{label}"] = s["bound_source"]
+        out[f"search_cost_{label}"] = res.cost
+        # host-loop bitmatch: the proof must land on the legacy NCBB
+        # host loop's optimum (integer costs — exactly representable)
+        from pydcop_tpu.algorithms.ncbb import NcbbSolver
+
+        host = NcbbSolver(dcop).run()
+        out[f"search_host_bitmatch_{label}"] = bool(
+            res.cost == host.cost
+        )
+        # the gap trajectory, downsampled to <= 64 points (long weak-
+        # bound searches emit thousands of chunks; the curve's shape
+        # is the record, not every sample)
+        hist = res.history or []
+        stride = max(1, len(hist) // 64)
+        keep = hist[::stride]
+        if hist and keep[-1] is not hist[-1]:
+            keep.append(hist[-1])
+        out[f"search_gap_curve_{label}"] = [
+            [round(h["time"], 4), h["lower_bound"],
+             h["upper_bound"] if h["cost"] is not None else None]
+            for h in keep
+        ]
+    if probe is not None:
+        pr = probe()
+        out["search_probe_rate"] = round(pr, 1)
+        if pr:
+            # wall x probe-rate is dimensionless: cancels host drift
+            out["search_proof_probe_normalized_k10x4"] = round(
+                out["search_time_to_proof_s_k10x4"] * pr, 2
+            )
+    headline = {
+        "metric": "search_time_to_proof_s_k10x4",
+        "value": out["search_time_to_proof_s_k10x4"], "unit": "s",
+        "vs_baseline": 0.0,
+        "extra": out,
+    }
+    print(json.dumps(headline), flush=True)
+    return headline
+
+
 def bench_sharded_subprocess(args):
     """ShardedMaxSum on a virtual 8-device CPU mesh, in a subprocess so
     the forced-CPU platform doesn't poison this process's TPU backend."""
@@ -2893,8 +3042,8 @@ def main():
                  "local", "scalefree", "mixed", "sharded",
                  "sharded-inner", "dpop-sharded", "dpop-sharded-inner",
                  "probe", "batch", "harness", "serve", "fleet", "churn",
-                 "auto", "twin", "elastic", "elastic-inner", "r06",
-                 "r07"],
+                 "auto", "twin", "elastic", "elastic-inner", "search",
+                 "search-inner", "r06", "r07", "r08"],
         default="all",
     )
     # watchdog covers the FULL run: the wholesweep DPOP kernel compile
@@ -2905,6 +3054,49 @@ def main():
     args = ap.parse_args()
     if args.cycles is None:
         args.cycles = 50 if args.stretch else 2000
+
+    if args.only == "r08":
+        # consolidated r08 record (ISSUE 15 satellite): the r07 legs
+        # plus the anytime exact-search leg, EACH in a fresh
+        # subprocess (same isolation rationale as r06 below)
+        legs = ("serve", "churn", "dpop-sharded", "auto", "fleet",
+                "twin", "elastic", "search")
+        fwd = []
+        skip_next = False
+        for a in sys.argv[1:]:
+            if skip_next:
+                skip_next = False
+                continue
+            if a in ("--only", "--snapshot"):
+                skip_next = True
+                continue
+            if a.startswith(("--only=", "--snapshot=")):
+                continue
+            fwd.append(a)
+        extra = {}
+        for leg in legs:
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--only", leg] + fwd
+            try:
+                r = subprocess.run(
+                    cmd, capture_output=True, text=True, timeout=3000,
+                )
+                parsed = json.loads(
+                    r.stdout.strip().splitlines()[-1]
+                )
+                extra.update(parsed.get("extra", {}))
+            except Exception as e:
+                extra[f"{leg}_error"] = repr(e)[:500]
+        out = {
+            "metric": "r08_consolidated",
+            "value": extra.get("search_time_to_proof_s_k10x4", 0.0),
+            "unit": "anytime exact proof wall (s, k10x4)",
+            "vs_baseline": 0.0,
+            "extra": extra,
+        }
+        _maybe_snapshot(args, out)
+        print(json.dumps(out), flush=True)
+        return
 
     if args.only == "r07":
         # consolidated r07 record (ISSUE 14 satellite): the r06 legs
@@ -3009,6 +3201,10 @@ def main():
 
     if args.only == "dpop-sharded-inner":
         bench_dpop_sharded_inner(args)
+        return
+
+    if args.only == "search-inner":
+        bench_search_inner(args)
         return
 
     if args.stretch:
@@ -3238,6 +3434,27 @@ def main():
             extra.update(bench_twin(args, probe=probe))
         except Exception as e:
             extra["twin_error"] = repr(e)
+
+    if args.only in ("all", "search"):
+        # anytime exact search (ISSUE 15): gap-vs-time curve + node
+        # throughput on two high-width instances that full DPOP
+        # refuses under budget (BENCHREF.md "Anytime exact search")
+        se = None
+        try:
+            se = bench_search_subprocess(args)
+            extra.update(se.get("extra", {}))
+        except Exception as e:
+            extra["search_error"] = repr(e)
+        if args.only == "search":
+            out = se if se is not None else {
+                "metric": "search_error", "value": 0.0, "unit": "",
+                "vs_baseline": 0.0, "extra": extra,
+            }
+            if watchdog:
+                watchdog.cancel()
+            _maybe_snapshot(args, out)
+            print(json.dumps(out), flush=True)
+            return
 
     if args.only in ("all", "elastic"):
         # elastic device-fault tier (ISSUE 14): degraded-throughput

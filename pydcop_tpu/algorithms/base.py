@@ -83,6 +83,13 @@ class SolveResult:
     #: schema shared by the portfolio dataset harness and the --auto
     #: gap audit; None only for solvers not yet on the schema
     config: Optional[Dict[str, Any]] = None
+    #: anytime exact-search scorecard (search/solver + the
+    #: runtime/stats.SearchCounters host-traffic counts: frontier
+    #: shape, bound source, nodes/leaves/pruned, the final
+    #: lower/upper sandwich, the optimality-proof flag and the
+    #: counted spill-fallback events); None unless the solve ran the
+    #: frontier engine
+    search: Optional[Dict[str, Any]] = None
     #: portfolio auto-selection audit (runtime/stats.PORTFOLIO_FIELDS:
     #: chosen config, model provenance, predicted vs actual), attached
     #: by ``solve --auto`` (pydcop_tpu.portfolio.select.solve_auto)
@@ -118,6 +125,8 @@ class SolveResult:
             out["repair"] = dict(self.repair)
         if self.dpop is not None:
             out["dpop"] = dict(self.dpop)
+        if self.search is not None:
+            out["search"] = dict(self.search)
         if self.config is not None:
             out["config"] = dict(self.config)
         if self.portfolio is not None:

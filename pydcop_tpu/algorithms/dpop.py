@@ -48,15 +48,18 @@ GRAPH_TYPE = "pseudotree"
 # it for repeated same-topology solves, see ops/pallas_dpop.py);
 # "sharded" forces the separator-tiled mesh sweep (util tables split
 # over the devices — docs/performance.rst "Sharded exact inference");
-# "minibucket" the bounded approximation.  `budget_mb` is the
-# PER-DEVICE table budget the auto tier routes on (0 = engine caps),
-# `i_bound` the mini-bucket width bound (0 = off), `prune` toggles the
-# cross-edge-consistency wire pruning, `shards` caps the mesh width
-# (0 = all local devices).
+# "frontier" the device-resident anytime branch-and-bound
+# (pydcop_tpu.search — exact without materializing ANY util table, so
+# it survives widths every sweep refuses; docs/performance.rst
+# "Frontier-batched exact search"); "minibucket" the bounded
+# approximation.  `budget_mb` is the PER-DEVICE table budget the auto
+# tier routes on (0 = engine caps), `i_bound` the mini-bucket width
+# bound (0 = off), `prune` toggles the cross-edge-consistency wire
+# pruning, `shards` caps the mesh width (0 = all local devices).
 algo_params = [
     AlgoParameterDef("engine", "str",
                      ["auto", "sweep", "wholesweep", "sharded",
-                      "minibucket"], "auto"),
+                      "frontier", "minibucket"], "auto"),
     AlgoParameterDef("budget_mb", "float", None, 0.0),
     AlgoParameterDef("i_bound", "int", None, 0),
     AlgoParameterDef("prune", "bool", None, True),
@@ -145,8 +148,11 @@ class DpopSolver:
         # width, one jitted batched step per level (survives a single
         # wide hub); (3) per-node hybrid loop; and, when the tables
         # exceed one device (planner byte estimate vs budget_mb or the
-        # engine caps), (4) the separator-SHARDED mesh sweep and (5)
-        # the bounded mini-bucket fallback (i_bound > 0) — a typed
+        # engine caps), (4) the separator-SHARDED mesh sweep, (5) the
+        # FRONTIER anytime exact search (no util table anywhere — an
+        # over-budget width stays exactly solvable when the search
+        # proves optimality within its node budget) and (6) the
+        # bounded mini-bucket fallback (i_bound > 0) — a typed
         # UtilTableTooLarge only after all of those are exhausted
         import logging
 
@@ -164,16 +170,22 @@ class DpopSolver:
             return self._run_minibucket()
         if self.engine == "sharded":
             return self._run_sharded()
+        if self.engine == "frontier":
+            return self._run_frontier(forced=True)
         if self.engine == "auto" and self.budget_bytes is not None:
             est = estimate_sweep_bytes(self.tree)
             if est["bytes"] > self.budget_bytes:
                 # the single-device sweep would blow the per-device
-                # budget: tile it over the mesh; degrade to mini-bucket
-                # bounds when even a tile is too big and an i_bound
+                # budget: tile it over the mesh; then try the frontier
+                # search (which needs no table at all) and only then
+                # degrade to mini-bucket bounds when an i_bound
                 # permits it
                 try:
                     return self._run_sharded()
                 except UtilTableTooLarge:
+                    res = self._run_frontier()
+                    if res is not None:
+                        return res
                     if self.i_bound > 0:
                         return self._run_minibucket()
                     raise
@@ -206,10 +218,71 @@ class DpopSolver:
                 try:
                     return self._run_sharded()
                 except UtilTableTooLarge:
+                    res = self._run_frontier()
+                    if res is not None:
+                        return res
                     if self.i_bound > 0:
                         return self._run_minibucket()
                     raise
         return self._run_pernode()
+
+    #: auto-ladder node budget of the frontier tier: the search must
+    #: PROVE optimality within this many device chunks or the ladder
+    #: falls through to mini-bucket bounds (a forced engine="frontier"
+    #: runs open-ended instead)
+    frontier_auto_chunks: int = 512
+
+    def _run_frontier(self, forced: bool = False) -> Optional[SolveResult]:
+        """Tier (5) of the auto ladder (and ``engine="frontier"``):
+        exact anytime search over the same pseudo-tree, bound tables
+        sized to the per-device budget.  In auto mode the result only
+        stands when the search CLOSED the gap — an unproven incumbent
+        falls through to the mini-bucket sandwich rather than being
+        passed off as exact."""
+        from pydcop_tpu.portfolio.select import (
+            FRONTIER_MAX_DOMAIN,
+            FRONTIER_MAX_VARS,
+        )
+        from pydcop_tpu.search.solver import (
+            DEFAULT_MAX_CHUNKS,
+            FrontierSearchSolver,
+        )
+
+        if not forced:
+            # the search regime is high width at SMALL n: bulk
+            # instances would burn the whole node budget unproven —
+            # skip straight to the mini-bucket sandwich there (same
+            # ceilings the portfolio feasibility mask applies)
+            n_vars = len(self.dcop.variables)
+            Dmax = max(
+                (len(v.domain)
+                 for v in self.dcop.variables.values()),
+                default=1,
+            )
+            if (n_vars > FRONTIER_MAX_VARS
+                    or Dmax > FRONTIER_MAX_DOMAIN):
+                return None
+
+        solver = FrontierSearchSolver(
+            self.dcop, tree=self.tree, seed=0, algo="dpop",
+            i_bound=self.i_bound,
+            bound_budget_bytes=self.budget_bytes,
+            max_chunks=(
+                DEFAULT_MAX_CHUNKS if forced
+                else self.frontier_auto_chunks
+            ),
+        )
+        res = solver.run()
+        if not forced and not (
+            res.search is not None and res.search.get("optimal")
+        ):
+            return None
+        self.last_engine = "frontier"
+        res.config = self._resolved_config(
+            i_bound=res.search.get("i_bound", self.i_bound)
+        )
+        res.config["engine"] = "frontier"
+        return res
 
     def _run_sweep(self, plan, perlevel: bool = False) -> SolveResult:
         import jax

@@ -20,7 +20,11 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from pydcop_tpu.algorithms import AlgorithmDef, DEFAULT_INFINITY
+from pydcop_tpu.algorithms import (
+    AlgoParameterDef,
+    AlgorithmDef,
+    DEFAULT_INFINITY,
+)
 from pydcop_tpu.algorithms.base import SolveResult
 from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.graph import pseudotree as pt_module
@@ -28,7 +32,19 @@ from pydcop_tpu.graph.pseudotree import ComputationPseudoTree
 
 GRAPH_TYPE = "pseudotree"
 
-algo_params = []
+# reference: no parameters.  Same framework-side ``engine`` family as
+# syncbb (ISSUE 15): "host" keeps the recursive pseudo-tree search,
+# "frontier" the device-resident frontier-batched anytime B&B, "auto"
+# routes by problem size (syncbb.AUTO_FRONTIER_MIN_VARS).
+algo_params = [
+    AlgoParameterDef("engine", "str", ["host", "frontier", "auto"],
+                     "host"),
+    AlgoParameterDef("frontier_width", "int", None, 0),
+    AlgoParameterDef("ring", "int", None, 0),
+    AlgoParameterDef("search_chunk", "int", None, 0),
+    AlgoParameterDef("i_bound", "int", None, 0),
+    AlgoParameterDef("budget_mb", "float", None, 0.0),
+]
 
 
 class NcbbSolver:
@@ -157,6 +173,14 @@ class NcbbSolver:
 
 
 def build_solver(dcop: DCOP, computation_graph=None, algo_def=None, seed=0):
+    from pydcop_tpu.algorithms.syncbb import _resolve_engine
+
+    if _resolve_engine(dcop, algo_def) == "frontier":
+        from pydcop_tpu.search.solver import build_frontier_solver
+
+        return build_frontier_solver(
+            dcop, computation_graph, algo_def, seed=seed, algo="ncbb"
+        )
     return NcbbSolver(dcop, computation_graph, algo_def, seed)
 
 

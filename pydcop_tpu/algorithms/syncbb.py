@@ -19,14 +19,57 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from pydcop_tpu.algorithms import AlgorithmDef, DEFAULT_INFINITY
+from pydcop_tpu.algorithms import (
+    AlgoParameterDef,
+    AlgorithmDef,
+    DEFAULT_INFINITY,
+)
 from pydcop_tpu.algorithms.base import SolveResult
 from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.graph import ordered_graph as og_module
 
 GRAPH_TYPE = "ordered_graph"
 
-algo_params = []  # reference: no parameters
+#: problems at/above this many variables route ``engine=auto`` to the
+#: frontier engine (below it the host token walk finishes in
+#: microseconds anyway and stays bit-compatible with the reference)
+AUTO_FRONTIER_MIN_VARS = 16
+
+# reference: no parameters.  The ``engine`` family is a framework-side
+# addition (ISSUE 15): "host" keeps the reference-parity CPA token
+# walk; "frontier" runs the device-resident frontier-batched anytime
+# B&B (pydcop_tpu.search — anytime bound sandwich on ws/SSE,
+# optimality proof when the bound meets the incumbent); "auto" takes
+# the frontier engine at AUTO_FRONTIER_MIN_VARS+ variables.
+# ``frontier_width`` is the slab's row count B (0 = auto),
+# ``ring`` the device spill buffer (0 = 8*B), ``search_chunk`` the
+# expand steps per device chunk (0 = 8), ``i_bound`` the mini-bucket
+# bound-table width (0 = auto from budget_mb; >= induced width =
+# DPOP-exact bounds), ``budget_mb`` the bound-table byte budget.
+algo_params = [
+    AlgoParameterDef("engine", "str", ["host", "frontier", "auto"],
+                     "host"),
+    AlgoParameterDef("frontier_width", "int", None, 0),
+    AlgoParameterDef("ring", "int", None, 0),
+    AlgoParameterDef("search_chunk", "int", None, 0),
+    AlgoParameterDef("i_bound", "int", None, 0),
+    AlgoParameterDef("budget_mb", "float", None, 0.0),
+]
+
+
+def _resolve_engine(dcop: DCOP, algo_def) -> str:
+    params = (
+        algo_def.params if algo_def is not None and algo_def.params
+        else {}
+    )
+    engine = params.get("engine", "host")
+    if engine == "auto":
+        engine = (
+            "frontier"
+            if len(dcop.variables) >= AUTO_FRONTIER_MIN_VARS
+            else "host"
+        )
+    return engine
 
 
 class SyncBBSolver:
@@ -172,6 +215,12 @@ class SyncBBSolver:
 
 
 def build_solver(dcop: DCOP, computation_graph=None, algo_def=None, seed=0):
+    if _resolve_engine(dcop, algo_def) == "frontier":
+        from pydcop_tpu.search.solver import build_frontier_solver
+
+        return build_frontier_solver(
+            dcop, computation_graph, algo_def, seed=seed, algo="syncbb"
+        )
     return SyncBBSolver(dcop, computation_graph, algo_def, seed)
 
 

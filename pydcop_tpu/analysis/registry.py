@@ -469,3 +469,61 @@ def _dpop_value_cell() -> AuditedProgram:
 
 register_cell("sharded/dpop/util-step")(_dpop_util_cell)
 register_cell("sharded/dpop/value-step")(_dpop_value_cell)
+
+
+# ---------------------------------------------------------------------------
+# frontier-batched exact search cells (ISSUE 15 contract)
+
+
+@functools.lru_cache(maxsize=None)
+def _search_engine():
+    from pydcop_tpu.search.frontier import FrontierEngine
+    from pydcop_tpu.search.plan import compile_search_plan
+
+    plan = compile_search_plan(_gc_dcop(V=10, E=14, seed=5), i_bound=2)
+    return FrontierEngine(plan, frontier_width=16, ring=64, steps=4)
+
+
+def _search_chunk_cell() -> AuditedProgram:
+    """The frontier chunk runner: expand/bound/select steps scanned
+    inside ONE jit whose host-visible output besides the donated state
+    pytree is a single [2] f32 vector (incumbent + bound) — zero host
+    callbacks, zero collectives, the f32/i32/bool tier, constants
+    bounded by the plan's flat gather tables (declared next to the
+    cycle fn: search/frontier.frontier_chunk_budget)."""
+    eng = _search_engine()
+    runner = eng.chunk_runner()
+    args = (eng.initial_state(),)
+    return AuditedProgram(
+        name="search/frontier/chunk",
+        fn=runner,
+        args=args,
+        budget=eng.program_budget(),
+        lower=lambda: runner.lower(*args).as_text(),
+    )
+
+
+def _search_step_cell() -> AuditedProgram:
+    """One bare expand/bound/select step (the scan body), audited
+    against the same budget minus donation (the step is not the
+    donation boundary — the chunk runner is)."""
+    import dataclasses as _dc
+    import jax
+
+    from pydcop_tpu.search.frontier import frontier_chunk_budget
+
+    eng = _search_engine()
+    step = jax.jit(eng._make_step())
+    budget = _dc.replace(
+        frontier_chunk_budget(eng.plan.table_bytes), donate=False
+    )
+    return AuditedProgram(
+        name="search/frontier/expand-step",
+        fn=step,
+        args=(eng.initial_state(),),
+        budget=budget,
+    )
+
+
+register_cell("search/frontier/chunk")(_search_chunk_cell)
+register_cell("search/frontier/expand-step")(_search_step_cell)

@@ -119,6 +119,31 @@ def set_parser(subparsers):
     parser.add_argument("--dpop-no-prune", action="store_true",
                         help="disable the cross-edge-consistency wire "
                         "pruning of the sharded DPOP sweep")
+    # anytime exact search (docs/performance.rst "Frontier-batched
+    # exact search"): the device-resident branch-and-bound engine for
+    # the hard-instance regime (high induced width, small n) where
+    # full DPOP issues a typed UtilTableTooLarge refusal
+    parser.add_argument("--anytime-exact", action="store_true",
+                        help="run the frontier-batched anytime "
+                        "branch-and-bound engine (exact search on "
+                        "device: a [B, depth] slab of partial "
+                        "assignments expanded per jitted step with "
+                        "mini-bucket lower bounds, incumbent + bound "
+                        "read as 2 scalars per chunk).  Streams the "
+                        "tightening lower <= optimum <= upper "
+                        "sandwich as search.* events and terminates "
+                        "with an optimality PROOF when the gap "
+                        "closes; metrics land in metrics['search'].  "
+                        "Default algorithm syncbb; also valid with "
+                        "-a ncbb or -a dpop (shorthand for the "
+                        "frontier engine); --i-bound/--dpop-budget-mb "
+                        "size the bound tables")
+    parser.add_argument("--frontier-width", type=int, default=0,
+                        help="with --anytime-exact (or "
+                        "engine:frontier): frontier slab rows B "
+                        "(0 = auto); wider explores more nodes per "
+                        "step, narrower spills sooner to the device "
+                        "ring buffer")
     # warm repair (docs/resilience.rst "Warm repair and agent churn")
     parser.add_argument("--headroom", type=float, default=None,
                         help="build the WARM-repair engine with this "
@@ -164,6 +189,34 @@ def run_cmd(args):
     from pydcop_tpu.dcop import load_dcop_from_file
     from pydcop_tpu.runtime import solve_result
 
+    if args.anytime_exact:
+        if args.auto or args.batch:
+            output_metrics(
+                {"status": "ERROR",
+                 "error": "--anytime-exact is its own engine "
+                 "selection; it does not combine with --auto or "
+                 "--batch"},
+                args.output,
+            )
+            return 1
+        if args.algo is None:
+            args.algo = "syncbb"
+        if args.algo not in ("syncbb", "ncbb", "dpop"):
+            output_metrics(
+                {"status": "ERROR",
+                 "error": f"--anytime-exact runs the exact-search "
+                 f"family (syncbb/ncbb/dpop), not {args.algo!r}"},
+                args.output,
+            )
+            return 1
+    elif args.frontier_width and args.algo not in ("syncbb", "ncbb"):
+        output_metrics(
+            {"status": "ERROR",
+             "error": "--frontier-width only applies with "
+             "--anytime-exact or the syncbb/ncbb frontier engine"},
+            args.output,
+        )
+        return 1
     if args.auto and args.algo:
         output_metrics(
             {"status": "ERROR",
@@ -214,6 +267,27 @@ def run_cmd(args):
         output_metrics({"status": "ERROR", "error": str(e)}, args.output)
         return 1
     algo_params = parse_algo_params(args.algo_params)
+    if args.anytime_exact:
+        # flag shorthands for the frontier engine params (the engine
+        # itself is a first-class -p engine:frontier on syncbb/ncbb
+        # and dpop; the flag just spells the common case)
+        algo_params["engine"] = "frontier"
+        if args.frontier_width and args.algo in ("syncbb", "ncbb"):
+            algo_params.setdefault("frontier_width",
+                                   args.frontier_width)
+        if args.i_bound is not None:
+            algo_params.setdefault("i_bound", args.i_bound)
+        if args.dpop_budget_mb is not None:
+            algo_params.setdefault("budget_mb", args.dpop_budget_mb)
+    if args.algo in ("syncbb", "ncbb"):
+        # the same shorthands work for the search family directly
+        if args.frontier_width:
+            algo_params.setdefault("frontier_width",
+                                   args.frontier_width)
+        if args.i_bound is not None:
+            algo_params.setdefault("i_bound", args.i_bound)
+        if args.dpop_budget_mb is not None:
+            algo_params.setdefault("budget_mb", args.dpop_budget_mb)
     if args.algo == "dpop":
         # flag shorthands for the sharded/mini-bucket engine params
         if args.dpop_budget_mb is not None:
@@ -222,12 +296,14 @@ def run_cmd(args):
             algo_params.setdefault("i_bound", args.i_bound)
         if args.dpop_no_prune:
             algo_params["prune"] = False
-    elif (args.dpop_budget_mb is not None or args.i_bound is not None
-          or args.dpop_no_prune):
+    elif (not args.anytime_exact
+          and args.algo not in ("syncbb", "ncbb")
+          and (args.dpop_budget_mb is not None
+               or args.i_bound is not None or args.dpop_no_prune)):
         output_metrics(
             {"status": "ERROR",
              "error": "--dpop-budget-mb/--i-bound/--dpop-no-prune only "
-             "apply to -a dpop"},
+             "apply to -a dpop (or the exact-search family)"},
             args.output,
         )
         return 1

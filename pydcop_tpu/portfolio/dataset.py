@@ -292,6 +292,10 @@ def _downsample_curve(history, sign: float,
     curve: List[List[float]] = []
     best = float("inf")
     for h in history or []:
+        if h.get("cost") is None:
+            # anytime exact-search chunks before the first incumbent
+            # have no assignment yet — nothing to envelope
+            continue
         c = sign * float(h["cost"])
         if c < best:
             best = c

@@ -61,11 +61,13 @@ N_FEATURES = len(FEATURE_NAMES)
 #: config-encoding vocabularies (one-hot blocks of
 #: :func:`encode_config`); "harness" is the chunked-scan engine every
 #: round-based solver runs through, the rest are the DPOP engine tiers
+#: plus "frontier" — the anytime exact-search arm (ISSUE 15) the
+#: syncbb/ncbb family exposes for the high-width small-n regime
 ALGO_CHOICES: Tuple[str, ...] = (
-    "maxsum", "mgm", "dsa", "adsa", "gdba", "dpop",
+    "maxsum", "mgm", "dsa", "adsa", "gdba", "dpop", "syncbb", "ncbb",
 )
 ENGINE_CHOICES: Tuple[str, ...] = (
-    "harness", "auto", "minibucket", "sharded",
+    "harness", "auto", "minibucket", "sharded", "frontier",
 )
 OVERLAP_CHOICES: Tuple[str, ...] = ("default", "off", "exact", "stale")
 

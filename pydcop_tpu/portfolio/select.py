@@ -53,6 +53,13 @@ HEURISTIC_EXACT_BYTES = 16 * 2**20
 #: ... and the per-node refusal cap would not fire either
 HEURISTIC_EXACT_ENTRIES = 10_000_000
 
+#: feasibility ceiling of the frontier exact-search arm: branch and
+#: bound is for the hard-instance regime (high induced width, SMALL
+#: n) — past these shape limits the slab/bound tables stop paying and
+#: the cell is masked like an over-budget DPOP tier
+FRONTIER_MAX_VARS = 256
+FRONTIER_MAX_DOMAIN = 32
+
 
 @dataclasses.dataclass(frozen=True)
 class PortfolioConfig:
@@ -91,6 +98,15 @@ class PortfolioConfig:
 
     def algo_params(self) -> Dict[str, Any]:
         """The ``-p``-style algo params this config resolves to."""
+        if self.algo in ("syncbb", "ncbb"):
+            # the exact-search family: only the frontier arm is in the
+            # grid (the host loops are never a throughput pick)
+            params = {"engine": self.engine}
+            if self.i_bound > 0:
+                params["i_bound"] = int(self.i_bound)
+            if self.budget_mb > 0:
+                params["budget_mb"] = float(self.budget_mb)
+            return params
         if self.algo != "dpop":
             return {}
         params: Dict[str, Any] = {"engine": self.engine}
@@ -129,6 +145,12 @@ DEFAULT_GRID: Tuple[PortfolioConfig, ...] = (
     PortfolioConfig("dpop", engine="auto",
                     budget_mb=AUTO_DPOP_BUDGET_MB),
     PortfolioConfig("dpop", engine="minibucket", i_bound=2),
+    # the anytime exact-search arm (ISSUE 15): proves optimality in
+    # the high-width small-n regime where the DPOP tiers refuse and
+    # local search stalls (docs/performance.rst "Frontier-batched
+    # exact search")
+    PortfolioConfig("syncbb", engine="frontier",
+                    budget_mb=AUTO_DPOP_BUDGET_MB),
 )
 
 #: 3-cell grid for smokes/tests: one BP engine, one local-search
@@ -174,6 +196,26 @@ def feasible_grid(
     sweep_bytes = int(info.get("sweep_bytes", 0))
     max_entries = int(info.get("max_node_entries", 0))
     for cfg in grid:
+        if cfg.algo in ("syncbb", "ncbb"):
+            # the frontier exact-search arm: its regime is high width
+            # at SMALL n — mask it out of bulk instances where the
+            # search space dwarfs any node budget
+            n_vars = int(info.get("n_vars", 0))
+            max_dom = int(info.get("max_domain", 0))
+            if n_vars > FRONTIER_MAX_VARS:
+                masked.append((cfg, (
+                    f"frontier exact search targets small-n hard "
+                    f"instances (n={n_vars} > {FRONTIER_MAX_VARS})"
+                )))
+                continue
+            if max_dom > FRONTIER_MAX_DOMAIN:
+                masked.append((cfg, (
+                    f"domain size {max_dom} exceeds the frontier "
+                    f"slab cap {FRONTIER_MAX_DOMAIN}"
+                )))
+                continue
+            feasible.append(cfg)
+            continue
         if cfg.algo != "dpop":
             feasible.append(cfg)
             continue
@@ -212,6 +254,13 @@ def heuristic_config(info: Dict[str, Any]) -> PortfolioConfig:
             and info.get("max_node_entries", 0)
             <= HEURISTIC_EXACT_ENTRIES):
         return PortfolioConfig("dpop", engine="auto",
+                               budget_mb=AUTO_DPOP_BUDGET_MB)
+    if (info.get("n_vars", 10**9) <= FRONTIER_MAX_VARS // 4
+            and info.get("max_domain", 10**9) <= FRONTIER_MAX_DOMAIN):
+        # the hard-instance regime DPOP just refused: high induced
+        # width at small n — exactly where the anytime frontier
+        # search proves optima local search never reaches
+        return PortfolioConfig("syncbb", engine="frontier",
                                budget_mb=AUTO_DPOP_BUDGET_MB)
     return PortfolioConfig("mgm")
 

@@ -267,6 +267,25 @@ def send_slo(event: str, payload) -> None:
     event_bus.send(SLO_TOPIC_PREFIX + event, payload)
 
 
+#: anytime exact-search topic prefix (pydcop_tpu.search).  Topics:
+#: ``search.bounds`` (chunk, incumbent, lower_bound, upper_bound, gap,
+#: proved — the anytime bound sandwich, one event per device chunk:
+#: exactly the stream PR 9's mini-bucket fallback emits, but
+#: TIGHTENING over time until the gap closes to an optimality proof),
+#: ``search.spill.drain`` (chunk, stash_rows — the counted host spill
+#: fallback engaged: annex rows pulled to the host stash),
+#: ``search.done`` (status, optimal, chunks, nodes, cost) — subscribe
+#: with ``search.*`` (the UI server pushes them to ws/SSE clients
+#: alongside ``dpop.*``).
+SEARCH_TOPIC_PREFIX = "search."
+
+
+def send_search(event: str, payload) -> None:
+    """Publish an exact-search engine event on the global bus (no-op
+    unless observability is enabled)."""
+    event_bus.send(SEARCH_TOPIC_PREFIX + event, payload)
+
+
 #: solve-harness topic prefix (algorithms/base).  Topics:
 #: ``harness.run.done`` (algo, status, cycle + the HarnessCounters
 #: scorecard: host_sync_count, dispatch_wait_s, donated_chunks,

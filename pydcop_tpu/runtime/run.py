@@ -233,6 +233,11 @@ def _run_with_checkpoints(
         mgr.save_solver(solver, done)
         if res.status == "TIMEOUT":
             break
+        if res.cycle < n:
+            # the solver finished ahead of its cycle budget (e.g. the
+            # frontier search proved optimality): burning the rest of
+            # the budget in no-op chunks would just churn snapshots
+            break
     if res is None:  # resumed at/after the requested budget
         res = solver.run(cycles=1, collect_cycles=collect_cycles,
                          resume=warm)

@@ -225,6 +225,9 @@ SERVE_COUNTERS = (
     "buckets_closed",
     "deadline_shrunk_lanes",  # lane-chunks clamped for deadline pressure
     "prewarmed_runners",      # runners scheduled for ahead-of-arrival compile
+    "prewarm_skipped_exact",  # predicted configs outside the vmap set
+                              # (e.g. the frontier exact-search arm):
+                              # nothing to prewarm, solved 1-by-1 later
     "checkpoints_saved",      # per-lane chunk-boundary snapshots written
     # -- fault isolation / overload (ISSUE 7): the alerting surface of
     # a production service — docs/serving.rst "Failure model"
@@ -373,6 +376,43 @@ class SloCounters:
         return dict(self.counts)
 
 
+#: counter names surfaced under ``SolveResult.metrics()["search"]`` by
+#: the frontier-batched exact search driver (search/solver) — the PR 4
+#: discipline made auditable: ``scalar_reads`` must equal
+#: ``2 * chunks`` in the steady state (one incumbent + one bound
+#: scalar per chunk), and every departure from it is a counted spill
+#: event, never silent extra traffic
+SEARCH_COUNTERS = (
+    "chunks",            # device chunk dispatches
+    "scalar_reads",      # host-read scalars (2 per chunk steady-state)
+    "spill_drains",      # annex drains (the counted host fallback)
+    "spill_rows",        # rows pulled host-side across all drains
+    "reinjected_rows",   # stashed rows returned to the device
+)
+
+
+class SearchCounters:
+    """Host-traffic counters of the frontier search chunk loop,
+    merged into ``SolveResult.metrics()['search']``."""
+
+    def __init__(self):
+        self.counts = {k: 0 for k in SEARCH_COUNTERS}
+
+    def __getitem__(self, name: str) -> int:
+        return self.counts[name]
+
+    def __setitem__(self, name: str, value: int) -> None:
+        if name not in self.counts:
+            raise KeyError(
+                f"unknown search counter {name!r}; add it to "
+                f"SEARCH_COUNTERS"
+            )
+        self.counts[name] = value
+
+    def as_dict(self) -> dict:
+        return dict(self.counts)
+
+
 #: counter names surfaced under ``SolveResult.metrics()["harness"]`` by
 #: the chunked solve harness (algorithms/base.SynchronousTensorSolver.run)
 #: — the device-residency scorecard of a solve: how often the host
@@ -487,7 +527,8 @@ class ShardCommCounters:
 CONFIG_FIELDS = (
     "algo",                # algorithm name actually executed
     "engine",              # harness | sweep* | pernode | wholesweep |
-                           # sharded | minibucket | sharded_mesh
+                           # sharded | minibucket | sharded_mesh |
+                           # frontier (anytime exact search)
     "chunk",               # harness chunk size (0 = single-shot path)
     "overlap",             # default | off | exact | stale
     "boundary_threshold",  # PR 5 auto-policy threshold in force

@@ -57,6 +57,7 @@ class UiServer:
         event_bus.subscribe("harness.*", self._cb_harness)
         event_bus.subscribe("shard.*", self._cb_shard)
         event_bus.subscribe("dpop.*", self._cb_dpop)
+        event_bus.subscribe("search.*", self._cb_search)
         event_bus.subscribe("serve.*", self._cb_serve)
         event_bus.subscribe("fleet.*", self._cb_fleet)
         event_bus.subscribe("portfolio.*", self._cb_portfolio)
@@ -312,6 +313,21 @@ class UiServer:
         if self._ws is not None:
             self._ws.send_all(json.dumps(
                 {"evt": "dpop",
+                 "kind": topic.split(".", 1)[-1],
+                 "data": evt if isinstance(evt, (dict, list, str, int,
+                                                 float, bool, type(None)))
+                 else repr(evt)}))
+
+    def _cb_search(self, topic: str, evt) -> None:
+        """Anytime exact-search lifecycle (search.bounds — the
+        tightening lower/upper sandwich per device chunk —
+        search.spill.drain and search.done) pushed to GUI clients in
+        the same envelope shape as the dpop.* forwarding; the SSE
+        /events stream gets them through the wildcard subscription
+        like every topic."""
+        if self._ws is not None:
+            self._ws.send_all(json.dumps(
+                {"evt": "search",
                  "kind": topic.split(".", 1)[-1],
                  "data": evt if isinstance(evt, (dict, list, str, int,
                                                  float, bool, type(None)))

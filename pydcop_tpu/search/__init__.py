@@ -1,0 +1,34 @@
+"""Device-resident anytime branch-and-bound (ISSUE 15).
+
+The last pre-seed algorithms still running host-side sequential loops —
+SyncBB's token walk and NCBB's recursive subtree search — become one
+frontier-batched exact engine: a fixed-shape ``[B, n]`` slab of partial
+assignments along a pseudo-tree DFS order, expanded one level per step
+inside jit, with static mini-bucket lower bounds (the Kask–Dechter
+heuristic, exact when the i-bound covers the induced width — the
+DPOP-sourced tier) evaluated as batched gather kernels, best-first
+selection and incumbent updates on device, and the host reading ONE
+``[2]`` stats vector — incumbent + global bound — per chunk (the PR 4
+discipline).  Overflowing frontier rows spill to a device-side ring
+buffer, then to a small annex the host drains at chunk boundaries (the
+counted spill fallback); the anytime ``lower <= optimum <= upper``
+sandwich streams over ws/SSE as ``search.*`` events.
+
+* :mod:`pydcop_tpu.search.plan` — host-side compile: DFS order,
+  per-depth constraint gather specs, mini-bucket bound tables;
+* :mod:`pydcop_tpu.search.frontier` — the jitted expand/bound/select
+  step, chunk runner and its declared ProgramBudget;
+* :mod:`pydcop_tpu.search.solver` — the anytime driver behind
+  ``solve --anytime-exact`` and ``engine=frontier`` on syncbb/ncbb
+  (checkpoint/resume-compatible, ``search.*`` event stream).
+"""
+from pydcop_tpu.search.plan import (  # noqa: F401
+    SearchPlan,
+    compile_search_plan,
+    estimate_search_bytes,
+    suggest_search_i_bound,
+)
+from pydcop_tpu.search.solver import (  # noqa: F401
+    FrontierSearchSolver,
+    build_frontier_solver,
+)

@@ -1,0 +1,439 @@
+"""The jitted frontier step and chunk runner of the anytime B&B.
+
+One *step* expands the best-first prefix of the live slab one level
+along the search order: every expanded row produces ``Dmax`` children
+whose cost increments and mini-bucket lower bounds are gathered from
+the plan's flat tables as two batched kernels, leaf children update the
+device-resident incumbent (value + argmin assignment), children at or
+above the incumbent are pruned on arrival, and the survivor pool —
+unexpanded rows + children + a ring pop + host-reinjected rows — is
+sorted once by ``f = g + h`` so the best ``B`` stay in the slab and the
+overflow is pushed back (ring first, then the spill annex).  Expansion
+is capacity-throttled so no node is ever dropped: when slab + ring +
+annex are full the step stalls (expands nothing) until the host drains
+the annex at the next chunk boundary — the counted spill fallback.
+
+A *chunk* is ``lax.scan`` over steps; its host-visible output is the
+state pytree (donated, device-resident) plus ONE ``[2]`` f32 vector:
+``[incumbent, bound]`` — the PR 4 two-scalars-per-chunk discipline.
+The bound scalar doubles as the spill signal: it is NaN when annex
+rows await draining (an exact sentinel — see plan.SPILL_SENTINEL;
+such chunks publish no bound and the previous one remains valid).
+The search is finished when ``bound >= incumbent`` — no open node can
+beat the incumbent — which doubles as the optimality proof.
+
+The chunk program's declared :class:`ProgramBudget` lives here, next
+to the cycle fn it governs (:func:`frontier_chunk_budget`), and is
+swept by the ``analysis`` registry (``search/frontier/*`` cells).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import numpy as np
+
+from pydcop_tpu.search.plan import BIG, SearchPlan
+
+#: dtype tier of the frontier programs: f32 costs/bounds, i32
+#: assignments/indices/counters, bool masks — no PRNG (the search is
+#: deterministic), no f64 anywhere
+FRONTIER_DTYPES = frozenset({"float32", "int32", "bool"})
+
+
+def frontier_chunk_budget(plan_table_bytes: int,
+                          donate: bool = True):
+    """Declared budget of the frontier chunk runner: a single-device
+    program — zero collectives, ZERO host callbacks (the incumbent and
+    bound ride the ``[2]`` stats vector out), the f32/i32/bool tier,
+    constants bounded by the plan's flat gather tables (a cold engine:
+    the problem is baked, the SLAB travels as a donated argument)."""
+    from pydcop_tpu.algorithms.base import CONST_SLACK_BYTES
+    from pydcop_tpu.analysis.budget import (
+        COLLECTIVE_KINDS,
+        ProgramBudget,
+    )
+
+    return ProgramBudget(
+        collectives={k: 0 for k in COLLECTIVE_KINDS},
+        max_collective_bytes=0,
+        max_host_callbacks=0,
+        dtypes=FRONTIER_DTYPES,
+        max_const_bytes=int(plan_table_bytes) + CONST_SLACK_BYTES,
+        donate=donate,
+    )
+
+
+@dataclasses.dataclass
+class FrontierShape:
+    """Fixed shapes of one engine instance."""
+
+    B: int        # slab rows (frontier width)
+    R: int        # ring rows (device spill)
+    A: int        # annex/inject rows (host spill quantum)
+    steps: int    # expand steps per chunk
+
+
+class FrontierEngine:
+    """Compiled device half of the frontier search: builds the jitted
+    step + chunk runner over a :class:`SearchPlan` and exposes the
+    initial/injected state pytrees.  Driving (anytime loop, events,
+    spill drain) lives in ``search.solver``."""
+
+    def __init__(self, plan: SearchPlan, frontier_width: int = 256,
+                 ring: int = 0, annex: int = 0, steps: int = 16):
+        B = max(2, int(frontier_width))
+        D = max(1, plan.Dmax)
+        self.plan = plan
+        # annex scales with the slab: a chunk whose spills outrun the
+        # annex stalls expansion until the next host drain, so a
+        # too-small quantum turns sustained pressure into idle steps
+        self.shape = FrontierShape(
+            B=B,
+            R=int(ring) if ring else 8 * B,
+            A=max(int(annex) if annex else B // 4, D, 8),
+            steps=max(1, int(steps)),
+        )
+        self._runner = None
+        self._trace_counts: Dict[Any, int] = {}
+
+    # -- state --------------------------------------------------------------
+
+    def initial_state(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        p, s = self.plan, self.shape
+        n = max(p.n, 1)
+
+        def rows(m):
+            return {
+                "assign": jnp.zeros((m, n), jnp.int32),
+                "g": jnp.zeros((m,), jnp.float32),
+                "f": jnp.full((m,), BIG, jnp.float32),
+                "depth": jnp.zeros((m,), jnp.int32),
+            }
+
+        front = rows(s.B)
+        # the root row: empty prefix, f = the global MBE bound
+        front["f"] = front["f"].at[0].set(jnp.float32(p.root_bound))
+        state = {
+            "f_" + k: v for k, v in front.items()
+        }
+        state["f_live"] = (
+            jnp.zeros((s.B,), bool).at[0].set(p.n > 0)
+        )
+        # ring and annex carry one extra "dump" row absorbing the
+        # masked scatter lanes, so a genuine push never collides with
+        # a no-op write (scatter duplicates are unordered)
+        for pre, m in (("r_", s.R + 1), ("x_", s.A + 1), ("j_", s.A)):
+            for k, v in rows(m).items():
+                state[pre + k] = v
+        state["r_count"] = jnp.int32(0)
+        state["x_count"] = jnp.int32(0)
+        state["j_count"] = jnp.int32(0)
+        state["incumbent"] = jnp.float32(BIG)
+        state["best_assign"] = jnp.zeros((n,), jnp.int32)
+        state["nodes"] = jnp.int32(0)
+        state["leaves"] = jnp.int32(0)
+        state["pruned"] = jnp.int32(0)
+        state["lost"] = jnp.int32(0)
+        return state
+
+    # -- gather kernels -----------------------------------------------------
+
+    def _build_kernels(self):
+        import jax
+        import jax.numpy as jnp
+
+        p = self.plan
+        unary = jnp.asarray(p.unary)
+        c_flat = jnp.asarray(p.c_flat)
+        c_base = jnp.asarray(p.c_base)
+        c_valid = jnp.asarray(p.c_valid)
+        c_pos = jnp.asarray(p.c_pos)
+        c_stride = jnp.asarray(p.c_stride)
+        c_own = jnp.asarray(p.c_own_stride)
+        h_flat = jnp.asarray(p.h_flat)
+        m_base = jnp.asarray(p.m_base)
+        m_valid = jnp.asarray(p.m_valid)
+        m_pos = jnp.asarray(p.m_pos)
+        m_stride = jnp.asarray(p.m_stride)
+        h_const = jnp.asarray(p.h_const)
+        D = p.Dmax
+
+        def inc_row(assign, k):
+            """[Dmax] cost increments of assigning order[k] under the
+            row's prefix — one gather-sum over the flat tables."""
+            base = c_base[k] + jnp.sum(
+                c_stride[k] * assign[c_pos[k]], axis=-1
+            )  # [Cmax]
+            offs = base[:, None] + (
+                jnp.arange(D, dtype=jnp.int32)[None, :] * c_own[k][:, None]
+            )
+            vals = c_flat[offs]  # [Cmax, D]
+            return unary[k] + jnp.sum(
+                c_valid[k][:, None] * vals, axis=0
+            )
+
+        def h_row(assign, d):
+            """Mini-bucket lower bound of the suffix below depth d."""
+            base = m_base[d] + jnp.sum(
+                m_stride[d] * assign[m_pos[d]], axis=-1
+            )
+            return h_const[d] + jnp.sum(m_valid[d] * h_flat[base])
+
+        return jax.vmap(inc_row), jax.vmap(
+            jax.vmap(h_row, in_axes=(0, None)), in_axes=(0, 0)
+        )
+
+    # -- step ---------------------------------------------------------------
+
+    def _make_step(self):
+        import jax.numpy as jnp
+
+        p, s = self.plan, self.shape
+        n = max(p.n, 1)
+        D = p.Dmax
+        B, R, A = s.B, s.R, s.A
+        dom = jnp.asarray(p.dom_sizes) if p.n else jnp.ones(
+            (1,), jnp.int32
+        )
+        inc_rows, h_rows = self._build_kernels()
+        INF = jnp.float32(np.inf)
+
+        def step(st):
+            U = st["incumbent"]
+            # rows at/above the incumbent can never improve it: dead
+            live = st["f_live"] & (st["f_f"] < U)
+            live_count = jnp.sum(live)
+            stored = (
+                live_count + st["r_count"] + st["x_count"]
+                + st["j_count"]
+            )
+            slack = jnp.int32(B + R + A) - stored
+            E = jnp.clip(slack // jnp.int32(max(D - 1, 1)), 0, B)
+
+            # best-first choice of the E rows to expand
+            keys = jnp.where(live, st["f_f"], INF)
+            rank = jnp.argsort(jnp.argsort(keys))
+            expand = live & (rank < E)
+
+            k = st["f_depth"]                       # [B]
+            inc = inc_rows(st["f_assign"], k)       # [B, D]
+            g_c = st["f_g"][:, None] + inc
+            vals = jnp.arange(D, dtype=jnp.int32)
+            child_assign = jnp.where(
+                jnp.arange(n, dtype=jnp.int32)[None, None, :]
+                == k[:, None, None],
+                vals[None, :, None],
+                st["f_assign"][:, None, :],
+            )                                       # [B, D, n]
+            d_child = jnp.minimum(k + 1, p.n)
+            h_c = h_rows(child_assign, d_child)     # [B, D]
+            f_c = g_c + h_c
+
+            is_leaf = (k + 1 == p.n)                # [B]
+            val_ok = vals[None, :] < dom[jnp.clip(k, 0, n - 1)][:, None]
+            leaf_g = jnp.where(
+                expand[:, None] & is_leaf[:, None] & val_ok, g_c, INF
+            )
+            best_flat = jnp.argmin(leaf_g)
+            leaf_min = leaf_g.reshape(-1)[best_flat]
+            improved = leaf_min < U
+            U2 = jnp.where(improved, leaf_min, U)
+            best_assign = jnp.where(
+                improved,
+                child_assign.reshape(-1, n)[best_flat],
+                st["best_assign"],
+            )
+
+            child_open = (
+                expand[:, None] & (~is_leaf)[:, None] & (f_c < U2)
+            )
+            n_pruned = jnp.sum(
+                expand[:, None] & (~is_leaf)[:, None] & val_ok
+                & (f_c >= U2)
+            )
+
+            # ---- pool: survivors + children + ring pop + inject
+            def cat(field, children_val, ring_val, inj_val):
+                return jnp.concatenate(
+                    [field, children_val, ring_val, inj_val], axis=0
+                )
+
+            pop_idx = st["r_count"] - 1 - jnp.arange(B, dtype=jnp.int32)
+            pop_ok = pop_idx >= 0
+            pop_at = jnp.clip(pop_idx, 0, R - 1)
+            inj_ok = jnp.arange(A, dtype=jnp.int32) < st["j_count"]
+
+            pool_assign = cat(
+                st["f_assign"], child_assign.reshape(-1, n),
+                st["r_assign"][pop_at], st["j_assign"],
+            )
+            pool_g = cat(st["f_g"], g_c.reshape(-1),
+                         st["r_g"][pop_at], st["j_g"])
+            pool_f = cat(st["f_f"], f_c.reshape(-1),
+                         st["r_f"][pop_at], st["j_f"])
+            pool_depth = cat(
+                st["f_depth"],
+                jnp.broadcast_to(k[:, None] + 1, (B, D)).reshape(-1),
+                st["r_depth"][pop_at], st["j_depth"],
+            )
+            pool_ok = jnp.concatenate([
+                live & ~expand,
+                child_open.reshape(-1),
+                pop_ok,
+                inj_ok,
+            ]) & (pool_f < U2)
+
+            order = jnp.argsort(jnp.where(pool_ok, pool_f, INF))
+            pool_assign = pool_assign[order]
+            pool_g = pool_g[order]
+            pool_f = pool_f[order]
+            pool_depth = pool_depth[order]
+            pool_ok = pool_ok[order]
+
+            n_valid = jnp.sum(pool_ok)
+            r_count = jnp.maximum(
+                st["r_count"] - jnp.sum(pop_ok), 0
+            )
+            n_push = jnp.maximum(n_valid - B, 0)
+            to_ring = jnp.minimum(n_push, R - r_count)
+            to_annex = jnp.minimum(
+                n_push - to_ring, A - st["x_count"]
+            )
+            lost = n_push - to_ring - to_annex
+
+            P = pool_f.shape[0]
+            ov = jnp.arange(P, dtype=jnp.int32) - B  # overflow rank
+            pushing = pool_ok & (ov >= 0)
+            # ring pushes go in REVERSE priority order so the stack top
+            # (popped first next step) holds the best overflow row
+            ring_slot = r_count + (to_ring - 1 - ov)
+            ring_idx = jnp.where(
+                pushing & (ov < to_ring), ring_slot, R
+            )
+            annex_slot = st["x_count"] + (ov - to_ring)
+            annex_idx = jnp.where(
+                pushing & (ov >= to_ring) & (ov < to_ring + to_annex),
+                annex_slot, A,
+            )
+
+            # note: ring/annex buffers carry one extra dump row (index
+            # R / A) that absorbs the non-pushed scatter lanes
+            r_assign = st["r_assign"].at[jnp.clip(ring_idx, 0, R)].set(
+                jnp.where((ring_idx < R)[:, None], pool_assign,
+                          st["r_assign"][jnp.clip(ring_idx, 0, R)]))
+            r_g = st["r_g"].at[jnp.clip(ring_idx, 0, R)].set(
+                jnp.where(ring_idx < R, pool_g,
+                          st["r_g"][jnp.clip(ring_idx, 0, R)]))
+            r_f = st["r_f"].at[jnp.clip(ring_idx, 0, R)].set(
+                jnp.where(ring_idx < R, pool_f,
+                          st["r_f"][jnp.clip(ring_idx, 0, R)]))
+            r_depth = st["r_depth"].at[jnp.clip(ring_idx, 0, R)].set(
+                jnp.where(ring_idx < R, pool_depth,
+                          st["r_depth"][jnp.clip(ring_idx, 0, R)]))
+            xcl = jnp.clip(annex_idx, 0, A)
+            x_ok = annex_idx < A
+            x_assign = st["x_assign"].at[xcl].set(
+                jnp.where(x_ok[:, None], pool_assign,
+                          st["x_assign"][xcl]))
+            x_g = st["x_g"].at[xcl].set(
+                jnp.where(x_ok, pool_g, st["x_g"][xcl]))
+            x_f = st["x_f"].at[xcl].set(
+                jnp.where(x_ok, pool_f, st["x_f"][xcl]))
+            x_depth = st["x_depth"].at[xcl].set(
+                jnp.where(x_ok, pool_depth, st["x_depth"][xcl]))
+
+            return {
+                "f_assign": pool_assign[:B],
+                "f_g": pool_g[:B],
+                "f_f": pool_f[:B],
+                "f_depth": pool_depth[:B],
+                "f_live": pool_ok[:B],
+                "r_assign": r_assign, "r_g": r_g, "r_f": r_f,
+                "r_depth": r_depth,
+                "r_count": r_count + to_ring,
+                "x_assign": x_assign, "x_g": x_g, "x_f": x_f,
+                "x_depth": x_depth,
+                "x_count": st["x_count"] + to_annex,
+                "j_assign": st["j_assign"], "j_g": st["j_g"],
+                "j_f": st["j_f"], "j_depth": st["j_depth"],
+                "j_count": jnp.int32(0),
+                "incumbent": U2,
+                "best_assign": best_assign,
+                "nodes": st["nodes"] + jnp.sum(expand),
+                "leaves": st["leaves"] + jnp.sum(
+                    jnp.where(expand & is_leaf, 1, 0)
+                ),
+                "pruned": st["pruned"] + n_pruned,
+                "lost": st["lost"] + lost,
+            }
+
+        return step
+
+    def lower_bound(self, st):
+        """Global bound: min over every open row's f, clamped by the
+        incumbent (traced — part of the chunk program)."""
+        import jax.numpy as jnp
+
+        INF = jnp.float32(np.inf)
+        s = self.shape
+        lb = jnp.minimum(
+            jnp.min(jnp.where(st["f_live"], st["f_f"], INF)),
+            jnp.min(jnp.where(
+                jnp.arange(s.R + 1, dtype=jnp.int32) < st["r_count"],
+                st["r_f"], INF,
+            )),
+        )
+        lb = jnp.minimum(lb, jnp.min(jnp.where(
+            jnp.arange(s.A + 1, dtype=jnp.int32) < st["x_count"],
+            st["x_f"], INF,
+        )))
+        lb = jnp.minimum(lb, jnp.min(jnp.where(
+            jnp.arange(s.A, dtype=jnp.int32) < st["j_count"],
+            st["j_f"], INF,
+        )))
+        return jnp.minimum(st["incumbent"], lb)
+
+    def chunk_runner(self):
+        """ONE jitted runner per engine: scans ``shape.steps`` expand
+        steps and returns ``(state, [incumbent, bound'])`` — the state
+        donated and device-resident, the two scalars the only
+        steady-state host traffic (bound' carries the spill flag)."""
+        if self._runner is not None:
+            return self._runner
+        import jax
+        import jax.numpy as jnp
+
+        from pydcop_tpu.algorithms.base import donation_supported
+
+        step = self._make_step()
+        steps = self.shape.steps
+
+        def run_chunk(state):
+            self._trace_counts["chunk"] = (
+                self._trace_counts.get("chunk", 0) + 1
+            )
+            state, _ = jax.lax.scan(
+                lambda st, _: (step(st), None), state, None,
+                length=steps,
+            )
+            lb = self.lower_bound(state)
+            # NaN = "annex needs draining": an exact sentinel — an
+            # additive flag offset would cost the bound up to an
+            # f32 ulp of the offset (enough to fake a proof)
+            enc = jnp.where(
+                state["x_count"] > 0, jnp.float32(jnp.nan), lb
+            )
+            return state, jnp.stack([state["incumbent"], enc])
+
+        donate = (0,) if donation_supported() else ()
+        self._runner = jax.jit(run_chunk, donate_argnums=donate)
+        return self._runner
+
+    def trace_count(self) -> int:
+        return sum(self._trace_counts.values())
+
+    def program_budget(self):
+        return frontier_chunk_budget(self.plan.table_bytes)

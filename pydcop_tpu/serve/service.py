@@ -738,6 +738,11 @@ class SolveService:
             dcop, algo = it[0], it[1]
             params = dict(it[2]) if len(it) > 2 and it[2] else {}
             if algo not in SUPPORTED_ALGOS:
+                # e.g. a predicted frontier exact-search config: it
+                # has no bucket runner to warm (it solves 1-by-1 on
+                # the fallback path) — count it so the prewarm keyset
+                # stays auditable instead of silently shrinking
+                self.counters.inc("prewarm_skipped_exact")
                 continue
             adapter = adapter_for(algo)
             spec = adapter.build_spec(

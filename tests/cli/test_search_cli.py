@@ -1,0 +1,168 @@
+"""CLI surface of the anytime exact search (ISSUE 15):
+``solve --anytime-exact`` / ``--frontier-width`` and the
+``engine:frontier`` algo param, plus the slow-marked kill-9 smoke
+(``make search-smoke``): SIGKILL a checkpointing search mid-run, then
+``--resume`` onto the exact frontier state and finish with the clean
+run's proven optimum."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "PYTHONPATH": REPO,
+}
+
+
+def run_cli(*args, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu", *args],
+        capture_output=True, text=True, timeout=timeout, env=ENV,
+        cwd=REPO,
+    )
+
+
+def _write_clique(path, K=9, D=4, seed=5):
+    """High-width instance (induced width K-1) with integer costs —
+    the regime where full DPOP refuses under budget and the frontier
+    engine proves the optimum."""
+    rng = np.random.default_rng(seed)
+    lines = ["name: clique", "objective: min", "domains:",
+             f"  d: {{values: [{', '.join(str(i) for i in range(D))}]}}",
+             "variables:"]
+    for i in range(K):
+        lines.append(f"  v{i:02d}: {{domain: d}}")
+    lines.append("constraints:")
+    k = 0
+    for i in range(K):
+        for j in range(i + 1, K):
+            m = rng.integers(0, 10, (D, D))
+            by_cost = {}
+            for a in range(D):
+                for b in range(D):
+                    if m[a, b]:
+                        by_cost.setdefault(int(m[a, b]), []).append(
+                            f"{a} {b}"
+                        )
+            vals = ", ".join(
+                f"{cost}: \"{' | '.join(combos)}\""
+                for cost, combos in sorted(by_cost.items())
+            )
+            lines.append(
+                f"  c{k}: {{type: extensional, "
+                f"variables: [v{i:02d}, v{j:02d}], "
+                f"default: 0, values: {{{vals}}}}}"
+            )
+            k += 1
+    lines += ["agents: [a0]"]
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
+
+
+class TestAnytimeExactCli:
+    def test_help_covers_the_flags(self):
+        proc = run_cli("solve", "--help")
+        assert proc.returncode == 0
+        assert "--anytime-exact" in proc.stdout
+        assert "--frontier-width" in proc.stdout
+        assert "optimality" in proc.stdout.lower()
+
+    def test_anytime_exact_proves_and_reports(self, tmp_path):
+        yaml = _write_clique(str(tmp_path / "clique.yaml"), K=8, D=3)
+        proc = run_cli("solve", "--anytime-exact",
+                       "--frontier-width", "64", yaml)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        m = json.loads(proc.stdout)
+        assert m["status"] == "FINISHED"
+        s = m["search"]
+        assert s["optimal"] is True and s["gap"] == 0.0
+        assert s["lower_bound"] <= m["cost"] <= s["upper_bound"]
+        assert s["engine"] == "frontier"
+        assert m["config"]["engine"] == "frontier"
+        assert s["lost_rows"] == 0
+
+    def test_engine_param_spelling(self, tmp_path):
+        yaml = _write_clique(str(tmp_path / "c.yaml"), K=7, D=3)
+        proc = run_cli("solve", "-a", "ncbb", "-p", "engine:frontier",
+                       yaml)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        m = json.loads(proc.stdout)
+        assert m["search"]["optimal"] is True
+
+    def test_flag_combos_rejected(self, tmp_path):
+        yaml = _write_clique(str(tmp_path / "c.yaml"), K=6, D=3)
+        proc = run_cli("solve", "--anytime-exact", "--auto", yaml)
+        assert proc.returncode == 1
+        assert "anytime-exact" in json.loads(proc.stdout)["error"]
+        proc = run_cli("solve", "--anytime-exact", "-a", "maxsum",
+                       yaml)
+        assert proc.returncode == 1
+        proc = run_cli("solve", "-a", "mgm", "--frontier-width", "8",
+                       yaml)
+        assert proc.returncode == 1
+
+
+@pytest.mark.slow
+class TestKill9Smoke:
+    def test_kill9_then_resume_finishes_exact(self, tmp_path):
+        """The ``make search-smoke`` scenario: a checkpointing
+        anytime-exact solve is SIGKILLed mid-search; rerunning with
+        ``--resume`` restores the frontier slab + incumbent from the
+        newest CRC-valid snapshot and still proves the clean
+        optimum."""
+        yaml = _write_clique(str(tmp_path / "clique.yaml"), K=9, D=4)
+        ck = str(tmp_path / "ck")
+
+        clean = run_cli("solve", "--anytime-exact",
+                        "--frontier-width", "64", yaml)
+        assert clean.returncode == 0, clean.stderr[-2000:]
+        want = json.loads(clean.stdout)["cost"]
+
+        # tiny chunks + per-chunk snapshots so the kill lands mid-run
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "pydcop_tpu", "solve",
+             "--anytime-exact", "--frontier-width", "16",
+             "-p", "search_chunk:1", "--cycles", "100000",
+             "--checkpoint", ck, "--checkpoint-every", "1", yaml],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=ENV,
+            cwd=REPO,
+        )
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if os.path.isdir(ck) and any(
+                f.endswith(".npz") for f in os.listdir(ck)
+            ):
+                break
+            if proc.poll() is not None:
+                break
+            time.sleep(0.2)
+        assert proc.poll() is None, (
+            "solve finished before a snapshot landed; shrink the "
+            "chunk further"
+        )
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+
+        # the slab/ring shapes must match the snapshot (same
+        # frontier_width); steps-per-chunk is runner-side only, so
+        # the resumed run can take bigger strides to the proof
+        resumed = run_cli("solve", "--anytime-exact",
+                          "--frontier-width", "16",
+                          "-p", "search_chunk:16",
+                          "--cycles", "100000",
+                          "--checkpoint", ck, "--checkpoint-every",
+                          "200", "--resume", yaml, timeout=600)
+        assert resumed.returncode == 0, resumed.stderr[-2000:]
+        m = json.loads(resumed.stdout)
+        assert m["search"]["optimal"] is True
+        assert m["cost"] == want

@@ -394,15 +394,31 @@ class TestRouting:
         assert err.suggested_i_bound >= 1
         assert "i-bound" in str(err)
 
-    def test_too_large_degrades_to_minibucket_with_i_bound(self):
+    def test_too_large_routes_to_frontier_then_minibucket(self):
+        """ISSUE 15 re-ordered this rung: over-budget instances try
+        the frontier exact search BEFORE degrading to mini-bucket
+        bounds — in the search regime (small n) the ladder now proves
+        the optimum where it used to return a sandwich.  The
+        mini-bucket tier is still the floor: forcing the engine (or
+        an instance outside the search regime) reaches it."""
         dcop = random_dcop(40, 20, dom_sizes=(3,), seed=5)
         solver = DpopSolver(dcop)
         solver.budget_bytes = 64
         solver.i_bound = 2
         res = solver.run()
-        assert solver.last_engine == "minibucket"
+        assert solver.last_engine == "frontier"
         assert res.status == "FINISHED"
-        assert res.dpop["lower_bound"] <= res.dpop["upper_bound"]
+        assert res.search["optimal"]
+        assert res.config["engine"] == "frontier"
+        # the floor is intact: the forced tier still degrades to the
+        # bound sandwich, and it brackets the frontier's proven cost
+        forced = DpopSolver(dcop)
+        forced.engine = "minibucket"
+        forced.i_bound = 2
+        mb = forced.run()
+        assert forced.last_engine == "minibucket"
+        assert (mb.dpop["lower_bound"] - 1e-6 <= res.cost
+                <= mb.dpop["upper_bound"] + 1e-6)
 
     def test_pernode_refusal_is_typed(self, monkeypatch):
         """The per-node path's old bare MemoryError is now the typed

@@ -181,16 +181,35 @@ class TestSelection:
         assert sel.config == heuristic_config(sel.info)
 
     def test_typed_refusal_stays_typed(self):
-        """Masking is advisory: FORCING an over-budget exact config
+        """Masking is advisory: FORCING an over-budget sweep config
         still raises the typed UtilTableTooLarge, never a silent
-        downgrade."""
+        downgrade.  (engine="auto" with the same impossible budget is
+        no longer a refusal: ISSUE 15 registered the frontier exact
+        search between the sharded tier and the mini-bucket fallback,
+        so auto PROVES the optimum instead — pinned below.)"""
         from pydcop_tpu.ops.dpop_shard import UtilTableTooLarge
         from pydcop_tpu.runtime.run import solve_result
 
         dcop = _gc(12, seed=0, edges=40)
         with pytest.raises(UtilTableTooLarge):
             solve_result(dcop, "dpop",
-                         algo_params={"budget_mb": 1e-6})
+                         algo_params={"budget_mb": 1e-6,
+                                      "engine": "sharded"})
+
+    def test_auto_over_budget_routes_to_frontier(self):
+        """The ISSUE 15 ladder: engine="auto" under an impossible
+        byte budget lands on the frontier exact search (gap closed,
+        engine recorded) instead of refusing or degrading to bounds,
+        and the answer matches the unbudgeted exact sweep."""
+        from pydcop_tpu.runtime.run import solve_result
+
+        dcop = _gc(12, seed=0, edges=40)
+        res = solve_result(dcop, "dpop",
+                           algo_params={"budget_mb": 1e-6})
+        assert res.config["engine"] == "frontier"
+        assert res.search is not None and res.search["optimal"]
+        exact = solve_result(dcop, "dpop")
+        assert res.cost == pytest.approx(exact.cost, abs=1e-6)
 
 
 # ---------------------------------------------------------------------------

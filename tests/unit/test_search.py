@@ -1,0 +1,452 @@
+"""Frontier-batched anytime exact search (ISSUE 15).
+
+The contract under test:
+
+* **host-loop parity pin** — the frontier engine returns the
+  bit-identical optimal assignment and cost as the legacy syncbb/ncbb
+  host loops on exactly-representable (integer) costs, over seeded
+  matrices on chain / hub / dense graphs, min and max mode;
+* **anytime semantics** — the incumbent stream is monotone
+  non-increasing and ``lower <= optimum <= upper`` holds at every
+  emitted chunk, terminating in an optimality proof (gap exactly 0);
+* **spill fallback** — a deliberately tiny slab + ring forces the
+  annex path: drains are counted, every spilled row is reinjected,
+  NOTHING is lost, and the search still proves the same optimum;
+* **host-traffic discipline** — the chunk runner's only non-state
+  output is one [2] f32 vector (incumbent + bound), the compiled
+  runner traces ONCE across runs, and the registry carries the
+  ``search/frontier/*`` budget cells (zero host callbacks, zero
+  collectives — swept by the parametrized audit in test_analysis);
+* **the dpop auto ladder** — an instance where ``engine=auto``
+  previously degraded to mini-bucket bounds now PROVES optimality via
+  the frontier tier (the ISSUE 15 acceptance scenario), while bulk
+  instances outside the search regime still fall through;
+* **checkpoint/resume** — the search state rides the existing CRC'd
+  snapshot layer; a run cut short resumes onto the exact frontier
+  state and finishes with the clean run's answer.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+
+def _edges(shape: str, n: int):
+    if shape == "chain":
+        return [(i, i + 1) for i in range(n - 1)]
+    if shape == "hub":
+        return [(0, i) for i in range(1, n)]
+    if shape == "dense":
+        return [(i, j) for i in range(n) for j in range(i + 1, n)]
+    raise ValueError(shape)
+
+
+def make_dcop(shape: str, seed: int, n: int = 8, D: int = 3,
+              objective: str = "min") -> DCOP:
+    """Seeded integer-cost instance: every cost is an exact f32
+    integer, so host-vs-device cost equality is bit-for-bit."""
+    rng = np.random.default_rng(seed)
+    dcop = DCOP(f"{shape}-{seed}", objective=objective)
+    dom = Domain("d", "v", list(range(D)))
+    vs = [Variable(f"v{i:02d}", dom) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for k, (i, j) in enumerate(_edges(shape, n)):
+        m = rng.integers(0, 97, (D, D)).astype(float)
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[i], vs[j]], m, name=f"c{k}")
+        )
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
+def frontier(dcop, **kw):
+    from pydcop_tpu.search.solver import FrontierSearchSolver
+
+    return FrontierSearchSolver(dcop, **kw)
+
+
+# ---------------------------------------------------------------------------
+# host-loop parity pin
+# ---------------------------------------------------------------------------
+
+
+class TestHostParity:
+    @pytest.mark.parametrize("shape", ["chain", "hub", "dense"])
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_bit_identical_to_syncbb_and_ncbb(self, shape, seed):
+        from pydcop_tpu.algorithms.ncbb import NcbbSolver
+        from pydcop_tpu.algorithms.syncbb import SyncBBSolver
+
+        n = 7 if shape == "dense" else 9
+        dcop = make_dcop(shape, seed, n=n)
+        host = SyncBBSolver(dcop).run()
+        ncbb = NcbbSolver(dcop).run()
+        res = frontier(dcop, frontier_width=32, steps=4).run()
+        assert res.search["optimal"]
+        assert res.cost == host.cost == ncbb.cost
+        assert res.assignment == host.assignment
+        assert res.assignment == ncbb.assignment
+
+    def test_max_mode_parity(self):
+        from pydcop_tpu.algorithms.ncbb import NcbbSolver
+
+        dcop = make_dcop("dense", 11, n=6, objective="max")
+        host = NcbbSolver(dcop).run()
+        res = frontier(dcop, frontier_width=32, steps=4).run()
+        assert res.search["optimal"]
+        assert res.cost == host.cost
+        assert res.assignment == host.assignment
+
+    def test_engine_param_routes_from_build_solver(self):
+        from pydcop_tpu.algorithms import AlgorithmDef
+        from pydcop_tpu.algorithms import syncbb as syncbb_mod
+        from pydcop_tpu.search.solver import FrontierSearchSolver
+
+        dcop = make_dcop("chain", 5, n=6)
+        adef = AlgorithmDef.build_with_default_params(
+            "syncbb", {"engine": "frontier"}
+        )
+        solver = syncbb_mod.build_solver(dcop, None, adef)
+        assert isinstance(solver, FrontierSearchSolver)
+        # the default stays the reference-parity host loop
+        adef_host = AlgorithmDef.build_with_default_params("syncbb", {})
+        assert isinstance(
+            syncbb_mod.build_solver(dcop, None, adef_host),
+            syncbb_mod.SyncBBSolver,
+        )
+
+
+# ---------------------------------------------------------------------------
+# anytime semantics: monotone incumbent, bound sandwich, proof
+# ---------------------------------------------------------------------------
+
+
+class TestAnytime:
+    def test_sandwich_and_monotone_incumbent(self):
+        from pydcop_tpu.algorithms.ncbb import NcbbSolver
+
+        dcop = make_dcop("dense", 3, n=9, D=3)
+        optimum = NcbbSolver(dcop).run().cost
+        # a weak bound (i_bound=1) forces a real search: many chunks,
+        # a live sandwich, and a late proof
+        res = frontier(dcop, frontier_width=8, steps=2,
+                       i_bound=1).run(collect_cycles=True)
+        assert res.search["optimal"]
+        assert res.cost == optimum
+        ub = [h["upper_bound"] for h in res.history]
+        lb = [h["lower_bound"] for h in res.history]
+        inc = [h["cost"] for h in res.history if h["cost"] is not None]
+        assert len(res.history) >= 2
+        assert all(b <= a + 1e-9 for a, b in zip(inc, inc[1:])), (
+            "incumbent must be monotone non-increasing"
+        )
+        # spill chunks before the first clean one publish no bound
+        pairs = [(lo, hi) for lo, hi in zip(lb, ub)
+                 if lo is not None]
+        assert pairs
+        assert all(lo - 1e-6 <= optimum <= hi + 1e-6
+                   for lo, hi in pairs)
+        assert res.history[-1]["gap"] == 0.0
+
+    def test_bound_source_tiers(self):
+        dcop = make_dcop("dense", 3, n=7)
+        exact = frontier(dcop, frontier_width=32)
+        assert exact.plan.exact_heuristic
+        assert exact.plan.info()["bound_source"] == "dpop-exact"
+        weak = frontier(dcop, frontier_width=32, i_bound=1)
+        assert not weak.plan.exact_heuristic
+        assert weak.plan.info()["bound_source"] == "minibucket"
+        # both admissible: identical proven optimum
+        assert exact.run().cost == weak.run().cost
+
+    def test_search_events_stream(self):
+        from pydcop_tpu.runtime.events import event_bus
+
+        got = []
+        event_bus.enabled = True
+        event_bus.subscribe("search.*", lambda t, e: got.append((t, e)))
+        try:
+            dcop = make_dcop("chain", 2, n=8)
+            frontier(dcop, frontier_width=16).run()
+        finally:
+            event_bus.enabled = False
+            event_bus._subs = [
+                (t, cb) for t, cb in event_bus._subs
+                if t != "search.*"
+            ]
+        bounds = [e for t, e in got if t == "search.bounds"]
+        assert bounds, "search.bounds must stream per chunk"
+        assert {"incumbent", "lower_bound", "upper_bound",
+                "gap", "proved"} <= set(bounds[0])
+        assert any(t == "search.done" for t, _e in got)
+
+
+# ---------------------------------------------------------------------------
+# spill fallback: ring + annex, counted, lossless
+# ---------------------------------------------------------------------------
+
+
+class TestSpill:
+    def test_tiny_slab_spills_losslessly(self):
+        from pydcop_tpu.algorithms.syncbb import SyncBBSolver
+
+        dcop = make_dcop("dense", 7, n=8, D=3)
+        host = SyncBBSolver(dcop).run()
+        res = frontier(dcop, frontier_width=4, ring=8, steps=3,
+                       i_bound=1).run()
+        s = res.search
+        assert s["optimal"] and res.cost == host.cost
+        assert s["spill_drains"] > 0, "the annex path must engage"
+        assert s["spill_rows"] > 0
+        assert s["reinjected_rows"] == s["spill_rows"]
+        assert s["lost_rows"] == 0
+        assert s["stash_rows"] == 0
+
+    def test_no_spill_on_roomy_slab(self):
+        dcop = make_dcop("chain", 1, n=8)
+        res = frontier(dcop, frontier_width=64).run()
+        s = res.search
+        assert s["spill_drains"] == 0 and s["spill_rows"] == 0
+        assert s["lost_rows"] == 0
+
+
+# ---------------------------------------------------------------------------
+# host-traffic discipline: 2 scalars per chunk, one trace, audited
+# ---------------------------------------------------------------------------
+
+
+class TestDiscipline:
+    def test_chunk_outputs_two_scalars_beside_state(self):
+        """The jaxpr-level pin of the PR 4 discipline: the chunk
+        runner's only output that is NOT the donated state pytree is
+        one [2] f32 vector — incumbent + bound."""
+        import jax
+
+        dcop = make_dcop("chain", 1, n=8)
+        s = frontier(dcop, frontier_width=16)
+        runner = s.engine.chunk_runner()
+        state = s.initial_state()
+        out_state, out_stats = jax.eval_shape(runner, state)
+        assert set(out_state) == set(state)
+        assert out_stats.shape == (2,)
+        assert out_stats.dtype == np.float32
+
+    def test_single_trace_across_runs_and_counted_reads(self):
+        dcop = make_dcop("chain", 4, n=10)
+        s = frontier(dcop, frontier_width=16, steps=2)
+        r1 = s.run(cycles=2)
+        r2 = s.run(cycles=50, resume=True)
+        assert s.trace_count() == 1, (
+            "chunk runner must compile once, not per run"
+        )
+        assert r2.search["optimal"]
+        # steady state (no spill): exactly 2 scalars per chunk
+        for r in (r1, r2):
+            if r.search["spill_drains"] == 0:
+                assert (r.search["scalar_reads"]
+                        == 2 * r.search["chunks"])
+
+    def test_registry_carries_the_budget_cells(self):
+        from pydcop_tpu.analysis import registry
+
+        names = registry.cell_names()
+        assert "search/frontier/chunk" in names
+        assert "search/frontier/expand-step" in names
+        # audited clean here too (the parametrized sweep in
+        # test_analysis covers every cell; this pins the contract
+        # from the search side so a registry regression names it)
+        rep = registry.audit_cell("search/frontier/chunk")
+        assert rep.ok, [f.to_dict() for f in rep.findings]
+        assert rep.scorecard["host_callbacks"] == 0
+
+    def test_config_engine_recorded(self):
+        dcop = make_dcop("chain", 2, n=8)
+        res = frontier(dcop, frontier_width=16).run()
+        assert res.config["engine"] == "frontier"
+        assert res.config["algo"] == "syncbb"
+        assert res.config["i_bound"] == res.search["i_bound"]
+
+
+# ---------------------------------------------------------------------------
+# the dpop auto ladder (the ISSUE 15 acceptance scenario)
+# ---------------------------------------------------------------------------
+
+
+def _clique(K: int, D: int, seed: int) -> DCOP:
+    rng = np.random.default_rng(seed)
+    dcop = DCOP("clique", objective="min")
+    dom = Domain("d", "v", list(range(D)))
+    vs = [Variable(f"v{i:02d}", dom) for i in range(K)]
+    for v in vs:
+        dcop.add_variable(v)
+    k = 0
+    for i in range(K):
+        for j in range(i + 1, K):
+            m = rng.integers(0, 10, (D, D)).astype(float)
+            dcop.add_constraint(
+                NAryMatrixRelation([vs[i], vs[j]], m, name=f"c{k}")
+            )
+            k += 1
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
+class TestDpopLadder:
+    def test_auto_proves_where_minibucket_was_the_ceiling(self):
+        """The acceptance pin: a high-width instance whose util table
+        busts the budget on every device USED to degrade to the
+        mini-bucket bound sandwich (no exact answer); the frontier
+        tier now closes the gap to 0 and returns the true optimum."""
+        from pydcop_tpu.runtime.run import solve_result
+
+        dcop = _clique(10, 4, 3)  # induced width 9: 4^10-entry table
+        budget = {"budget_mb": 0.05, "i_bound": 2}
+        # pre-ISSUE behavior, still reachable by forcing the engine:
+        # bounds with a nonzero gap, not an exact answer
+        mb = solve_result(
+            dcop, "dpop", algo_params={**budget,
+                                       "engine": "minibucket"})
+        assert mb.dpop["gap"] > 0
+        # the auto ladder now lands on the frontier tier and PROVES
+        res = solve_result(dcop, "dpop", algo_params=budget)
+        assert res.config["engine"] == "frontier"
+        assert res.search["optimal"]
+        exact = solve_result(dcop, "dpop")  # unbudgeted sweep
+        assert res.cost == exact.cost
+        # and the mini-bucket sandwich indeed bracketed this optimum
+        assert (mb.dpop["lower_bound"] - 1e-6 <= res.cost
+                <= mb.dpop["upper_bound"] + 1e-6)
+
+    def test_bulk_instances_still_fall_through(self):
+        """Outside the search regime (large n) the ladder must not
+        burn the frontier node budget: it degrades to mini-bucket
+        bounds exactly as before."""
+        from pydcop_tpu.algorithms.dpop import DpopSolver
+        from pydcop_tpu.portfolio.select import FRONTIER_MAX_VARS
+
+        dcop = make_dcop("chain", 0, n=8)
+        solver = DpopSolver(dcop)
+        # fake a bulk instance by lowering the regime ceiling
+        import pydcop_tpu.portfolio.select as sel
+        old = sel.FRONTIER_MAX_VARS
+        sel.FRONTIER_MAX_VARS = 4
+        try:
+            assert solver._run_frontier() is None
+        finally:
+            sel.FRONTIER_MAX_VARS = old
+        assert FRONTIER_MAX_VARS == old
+
+    def test_forced_frontier_engine_on_dpop(self):
+        from pydcop_tpu.runtime.run import solve_result
+
+        dcop = make_dcop("dense", 9, n=7)
+        res = solve_result(dcop, "dpop",
+                           algo_params={"engine": "frontier"})
+        assert res.search["optimal"]
+        exact = solve_result(dcop, "dpop")
+        assert res.cost == exact.cost
+
+
+# ---------------------------------------------------------------------------
+# portfolio surface
+# ---------------------------------------------------------------------------
+
+
+class TestPortfolioArm:
+    def test_grid_has_the_frontier_arm_and_masks_bulk(self):
+        from pydcop_tpu.portfolio.select import (
+            DEFAULT_GRID,
+            FRONTIER_MAX_VARS,
+            feasible_grid,
+        )
+
+        arm = [c for c in DEFAULT_GRID
+               if c.algo == "syncbb" and c.engine == "frontier"]
+        assert len(arm) == 1
+        small = {"n_vars": 24, "max_domain": 4,
+                 "sweep_bytes": 10**12, "max_node_entries": 10**11}
+        feasible, _ = feasible_grid(DEFAULT_GRID, small, n_devices=1)
+        assert arm[0] in feasible
+        bulk = {"n_vars": FRONTIER_MAX_VARS + 1, "max_domain": 4}
+        feasible, masked = feasible_grid(DEFAULT_GRID, bulk,
+                                         n_devices=1)
+        assert arm[0] not in feasible
+        assert any(c == arm[0] for c, _r in masked)
+
+    def test_config_encoding_covers_frontier(self):
+        from pydcop_tpu.portfolio.features import (
+            ALGO_CHOICES,
+            ENGINE_CHOICES,
+            encode_config,
+        )
+        from pydcop_tpu.portfolio.select import PortfolioConfig
+
+        assert "syncbb" in ALGO_CHOICES
+        assert "frontier" in ENGINE_CHOICES
+        enc = encode_config(
+            PortfolioConfig("syncbb", engine="frontier")
+        )
+        assert enc[ALGO_CHOICES.index("syncbb")] == 1.0
+        assert enc[len(ALGO_CHOICES)
+                   + ENGINE_CHOICES.index("frontier")] == 1.0
+
+    def test_frontier_arm_executes_through_solve_auto_path(self):
+        from pydcop_tpu.portfolio.select import PortfolioConfig
+        from pydcop_tpu.runtime.run import solve_result
+
+        cfg = PortfolioConfig("syncbb", engine="frontier")
+        dcop = make_dcop("dense", 5, n=6)
+        res = solve_result(dcop, cfg.algo,
+                           algo_params=cfg.algo_params(),
+                           **cfg.solve_kwargs())
+        assert res.search is not None and res.search["optimal"]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume on the exact search state
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointResume:
+    def test_resume_lands_on_the_search_state(self, tmp_path):
+        from pydcop_tpu.runtime.run import solve_result
+
+        dcop = _clique(9, 4, 5)
+        params = {"engine": "frontier", "frontier_width": 64,
+                  "search_chunk": 2}
+        clean = solve_result(dcop, "syncbb", algo_params=params)
+        assert clean.search["optimal"] and clean.cycle > 2
+        # cut the run short, snapshots on; then resume to completion
+        part = solve_result(dcop, "syncbb", algo_params=params,
+                            cycles=2, checkpoint_dir=str(tmp_path),
+                            checkpoint_every=1)
+        assert not part.search["optimal"]
+        assert any(p.suffix == ".npz" for p in tmp_path.iterdir())
+        res = solve_result(dcop, "syncbb", algo_params=params,
+                           cycles=500, checkpoint_dir=str(tmp_path),
+                           checkpoint_every=50, resume=True)
+        assert res.search["optimal"]
+        assert res.cost == clean.cost
+        assert res.assignment == clean.assignment
+
+    def test_corrupt_snapshot_skipped_on_resume(self, tmp_path):
+        from pydcop_tpu.runtime.faults import corrupt_checkpoint
+        from pydcop_tpu.runtime.run import solve_result
+
+        dcop = make_dcop("dense", 6, n=7)
+        params = {"engine": "frontier", "frontier_width": 16,
+                  "search_chunk": 2}
+        solve_result(dcop, "syncbb", algo_params=params, cycles=3,
+                     checkpoint_dir=str(tmp_path), checkpoint_every=1)
+        snaps = sorted(p for p in tmp_path.iterdir()
+                       if p.suffix == ".npz")
+        corrupt_checkpoint(str(snaps[-1]), seed=3)
+        res = solve_result(dcop, "syncbb", algo_params=params,
+                           cycles=500, checkpoint_dir=str(tmp_path),
+                           checkpoint_every=100, resume=True)
+        assert res.search["optimal"]
