@@ -5,7 +5,7 @@
 	serve-smoke chaos-smoke bench-churn churn-smoke bench-dpop \
 	dpop-smoke bench-auto portfolio-smoke bench-fleet fleet-smoke \
 	bench-twin twin-smoke bench-r06 analyze bench-search search-smoke \
-	bench-r08
+	bench-r08 bench-pfleet pfleet-smoke
 
 test: all-tests
 
@@ -89,8 +89,8 @@ search-smoke:
 		tests/cli/test_search_cli.py tests/unit/test_search.py \
 		-q
 
-# the r07 legs + the anytime exact-search leg in one run with a
-# machine-readable BENCH_r08.json snapshot (ISSUE 15 satellite)
+# the r07 legs + the anytime exact-search and process-fleet legs in
+# one run with a machine-readable BENCH_r08.json snapshot
 bench-r08:
 	python bench.py --only r08 --snapshot BENCH_r08.json
 
@@ -137,6 +137,25 @@ serve-smoke:
 # deployment and failover", BENCHREF.md "Fleet serve")
 bench-fleet:
 	python bench.py --only fleet
+
+# process fleet (ISSUE 16): the fleet trace against 1/2/4 replica
+# CHILD PROCESSES behind the CRC-framed socket journal — jobs/s + p99
+# scaling, bit-match, the kill_process RTO, and the cold-join
+# zero-compile pin (docs/serving.rst "Process fleet")
+bench-pfleet:
+	python bench.py --only pfleet
+
+# the process-fleet chaos scenario end-to-end through the CLI: serve
+# --processes with a fault-plan kill_process — a REAL kill -9 of a
+# whole replica child mid-trace; every job completes bit-identically
+# on the survivor with a finite RTO and the watchdog relaunches the
+# slot.  Slow-marked, so it does NOT run in tier-1 — run it next to
+# fleet-smoke whenever touching serve/procfleet.py, serve/wire.py or
+# serve/artifacts.py.  The subprocess acceptance pins DO ride tier-1
+# via tests/unit/test_procfleet.py.
+pfleet-smoke:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/cli/test_fleet_cli.py -q -m slow -k process
 
 # the fleet failover scenario end-to-end through the CLI: start a
 # 2-replica fleet, kill one replica mid-trace (fault-plan

@@ -1576,6 +1576,275 @@ def bench_fleet(args, probe=None):
     return out
 
 
+def bench_pfleet(args, probe=None):
+    """Process fleet (ISSUE 16): the fleet leg's Poisson trace
+    replayed against 1, 2 and 4 replica CHILD PROCESSES behind the
+    socket journal — real failure domains instead of threads.
+
+    Reported:
+
+    * ``pfleet_<n>_jobs_per_sec`` + p50/p99 latency per process count
+      and the ``pfleet_scaling_<n>x`` ratios.  Unlike the thread
+      fleet, each replica owns a whole interpreter (no shared GIL), so
+      on multi-core hosts this curve measures REAL scale-out plus the
+      socket/serialization overhead of crossing the process boundary;
+    * ``pfleet_bitmatch`` — every job equals its standalone solve
+      (determinism survives the YAML file-trip and the JSON wire);
+    * ``pfleet_kill_*`` / ``pfleet_rto_s`` — a real ``kill -9``
+      lands on a whole replica while it holds in-flight jobs: every
+      job still completes bit-identically, the orphans re-seat, the
+      RTO is finite, the watchdog relaunches the slot;
+    * ``pfleet_cold_join_compiles`` — a replica cold-joined after the
+      chaos run prewarms purely from the shared artifact store: the
+      pin is ZERO XLA compiles (``misses == 0``) before its first job.
+
+    Legs after the first bring their replicas up from the previous
+    leg's exported artifacts (copied into the fresh journal dir), so
+    the curve measures serving, not recompiles.
+    """
+    import shutil
+    import signal
+    import tempfile
+
+    from pydcop_tpu.batch.engine import BatchItem, adapter_for
+    from pydcop_tpu.dcop import load_dcop_from_file
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.generators import generate_graph_coloring
+    from pydcop_tpu.serve import ProcessFleet
+    from pydcop_tpu.serve.procfleet import ARTIFACT_SUBDIR
+
+    n_jobs = args.serve_jobs
+    rate = args.serve_rate
+    max_cycles = 200
+    sizes = (args.serve_vars, args.serve_vars // 2)
+    root = tempfile.mkdtemp(prefix="bench_pfleet_")
+    paths, dcops = [], []
+    try:
+        for i in range(n_jobs):
+            V = sizes[i % len(sizes)]
+            d = generate_graph_coloring(
+                n_variables=V, n_colors=args.colors, n_edges=V * 3,
+                soft=True, n_agents=1, seed=300 + i,
+            )
+            p = os.path.join(root, f"job{i:03d}.yaml")
+            with open(p, "w") as f:
+                f.write(dcop_yaml(d))
+            paths.append(p)
+            # jobs cross the process boundary by YAML path: the
+            # baseline must solve the same FILE-TRIPPED instance the
+            # replicas load
+            dcops.append(load_dcop_from_file([p]))
+        adapter = adapter_for("dsa")
+        baseline = [
+            adapter.build_spec(BatchItem(d, "dsa", seed=i)).solver.run(
+                max_cycles=max_cycles
+            )
+            for i, d in enumerate(dcops)
+        ]
+        # the service POOLS prewarm targets by (algo, params, shape
+        # family): both generated sizes are binary graph-coloring at
+        # the same D, so they share ONE pooled runner.  The readiness
+        # polls below must expect the pooled count, not the target
+        # count.
+        expected_runners = len({
+            adapter.build_spec(BatchItem(dcops[i], "dsa",
+                                         seed=i)).dims.family_key
+            for i in (0, 1)
+        })
+        rng = np.random.default_rng(args.serve_seed)
+        inter = rng.exponential(1.0 / rate, n_jobs)
+        inter[0] = 0.0
+        offsets = np.cumsum(inter)
+
+        def submit_trace(fleet, tick=False):
+            t0 = time.perf_counter()
+            jids = []
+            for i, d in enumerate(dcops):
+                now = time.perf_counter() - t0
+                while not tick and now < offsets[i]:
+                    time.sleep(min(0.005, offsets[i] - now))
+                    now = time.perf_counter() - t0
+                while tick and now < offsets[i]:
+                    fleet.tick()
+                    now = time.perf_counter() - t0
+                jids.append((
+                    fleet.submit(d, "dsa", seed=i,
+                                 source_file=paths[i]),
+                    time.perf_counter() - t0,
+                ))
+            return jids
+
+        def prewarm_all(fleet):
+            """Warm EVERY replica for both job shapes before the
+            trace clock starts (the thread leg's block=True twin)."""
+            targets = [(paths[0], "dsa", {}), (paths[1], "dsa", {})]
+            names = list(fleet.router.routable())
+            for name in names:
+                fleet.handle(name).service.prewarm(targets)
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                fleet.tick()
+                if all(
+                    fleet.handle(n).service.cache.stats()
+                    .get("entries", 0) >= expected_runners
+                    for n in names
+                ):
+                    return True
+                time.sleep(0.02)
+            return False
+
+        def drain(fleet):
+            for _ in range(60000):
+                if not fleet.tick():
+                    return
+                time.sleep(0.005)
+
+        def seed_artifacts(jd):
+            src = os.path.join(art_src, ARTIFACT_SUBDIR) \
+                if art_src else None
+            if src and os.path.isdir(src):
+                shutil.copytree(
+                    src, os.path.join(jd, ARTIFACT_SUBDIR),
+                    dirs_exist_ok=True,
+                )
+
+        def pcts(lat, prefix):
+            return {
+                f"{prefix}_p50_ms": round(
+                    float(np.percentile(lat, 50)) * 1e3, 1),
+                f"{prefix}_p99_ms": round(
+                    float(np.percentile(lat, 99)) * 1e3, 1),
+            }
+
+        out = {
+            "pfleet_jobs": n_jobs,
+            "pfleet_rate_jobs_per_sec": rate,
+            "pfleet_arrival_seed": args.serve_seed,
+        }
+        bitmatch = True
+        art_src = None
+        for n in (1, 2, 4):
+            jd = os.path.join(root, f"fleet{n}")
+            os.makedirs(jd, exist_ok=True)
+            seed_artifacts(jd)
+            fleet = ProcessFleet(replicas=n, lanes=args.serve_lanes,
+                                 max_cycles=max_cycles,
+                                 journal_dir=jd)
+            try:
+                if not fleet.wait_ready(timeout=300):
+                    raise RuntimeError("replicas never ready")
+                prewarm_all(fleet)
+                t0 = time.perf_counter()
+                jids = submit_trace(fleet, tick=True)
+                drain(fleet)
+                results = [fleet.result(j, timeout=300)
+                           for j, _s in jids]
+                wall = time.perf_counter() - t0
+                lat = [
+                    (s + r.time) - offsets[i]
+                    for i, ((_j, s), r) in enumerate(zip(jids, results))
+                ]
+            finally:
+                fleet.stop(drain=False)
+            bitmatch = bitmatch and all(
+                r.cost == b.cost and r.cycle == b.cycle
+                and r.assignment == b.assignment
+                for r, b in zip(results, baseline)
+            )
+            out[f"pfleet_{n}_jobs_per_sec"] = round(n_jobs / wall, 2)
+            out.update(pcts(lat, f"pfleet_{n}"))
+            art_src = jd
+        for n in (2, 4):
+            out[f"pfleet_scaling_{n}x"] = round(
+                out[f"pfleet_{n}_jobs_per_sec"]
+                / out["pfleet_1_jobs_per_sec"], 2,
+            )
+        out["pfleet_bitmatch"] = bitmatch
+
+        # -- chaos: a REAL ``kill -9`` of replica 0 with the trace in
+        # flight; survivors re-seat and finish bit-identically.  The
+        # plan-driven ``kill_process`` path is pinned by the chaos
+        # tests; here the SIGKILL is delivered directly once the
+        # victim holds in-flight jobs, so the re-seat count and the
+        # RTO measurement are never vacuous (a planned tick number
+        # can fire during the prewarm ticks, before any submission).
+        jd = os.path.join(root, "fleet_kill")
+        os.makedirs(jd, exist_ok=True)
+        seed_artifacts(jd)
+        fleet = ProcessFleet(replicas=2, lanes=args.serve_lanes,
+                             max_cycles=max_cycles, journal_dir=jd,
+                             checkpoint_every=1, backoff_base=0.1)
+        try:
+            if not fleet.wait_ready(timeout=300):
+                raise RuntimeError("replicas never ready")
+            prewarm_all(fleet)
+            jids = [
+                fleet.submit(d, "dsa", seed=i, source_file=paths[i])
+                for i, d in enumerate(dcops)
+            ]
+            victim = fleet.handle(0)
+            t_kill = time.monotonic()
+            while time.monotonic() - t_kill < 10.0:
+                fleet.tick()
+                if victim.service.tick() \
+                        and time.monotonic() - t_kill >= 0.5:
+                    break  # the victim is mid-solve: kill it now
+                time.sleep(0.005)
+            os.kill(victim.proc.pid, signal.SIGKILL)
+            drain(fleet)
+            results = [fleet.result(j, timeout=300) for j in jids]
+            m = fleet.metrics()
+            out["pfleet_kill_all_completed"] = all(
+                r.status == "FINISHED" for r in results
+            )
+            out["pfleet_kill_bitmatch"] = all(
+                r.cost == b.cost and r.cycle == b.cycle
+                and r.assignment == b.assignment
+                for r, b in zip(results, baseline)
+            )
+            out["pfleet_kill_reseated"] = m["fleet"]["jobs_reseated"]
+            out["pfleet_kill_replicas_down"] = (
+                m["fleet"]["replicas_down"]
+            )
+            out["pfleet_kill_relaunched"] = (
+                m["fleet"]["replicas_relaunched"]
+            )
+            rtos = [r["rto_s"] for r in m["recoveries"]
+                    if r.get("rto_s") is not None]
+            out["pfleet_rto_s"] = round(max(rtos), 4) if rtos else None
+
+            # -- cold join: a replica added AFTER the chaos run warms
+            # purely from the shared artifact store — zero XLA compiles
+            name = fleet.add_replica()
+            fleet.wait_ready(timeout=300)
+            hc = fleet.handle(name)
+            hc.service.prewarm([(paths[0], "dsa", {}),
+                                (paths[1], "dsa", {})])
+            deadline = time.monotonic() + 300
+            stats = {}
+            while time.monotonic() < deadline:
+                fleet.tick()
+                stats = hc.service.cache.stats()
+                if stats.get("entries", 0) >= expected_runners:
+                    break
+                time.sleep(0.02)
+            out["pfleet_cold_join_runners"] = stats.get("entries", 0)
+            out["pfleet_cold_join_compiles"] = stats.get("misses", -1)
+            out["pfleet_cold_join_artifact_hits"] = stats.get(
+                "artifact_hits", 0
+            )
+        finally:
+            fleet.stop(drain=False)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    if probe is not None:
+        pr = probe()
+        if pr:
+            out["pfleet_throughput_normalized"] = round(
+                out["pfleet_1_jobs_per_sec"] / pr, 6)
+    return out
+
+
 def bench_churn(args, probe=None):
     """Warm-repair churn recovery (ISSUE 8): a seeded sustained
     mutation stream against a LIVE instance — time-to-recover-cost per
@@ -3041,7 +3310,8 @@ def main():
         choices=["all", "maxsum", "dpop", "convergence", "convergence2",
                  "local", "scalefree", "mixed", "sharded",
                  "sharded-inner", "dpop-sharded", "dpop-sharded-inner",
-                 "probe", "batch", "harness", "serve", "fleet", "churn",
+                 "probe", "batch", "harness", "serve", "fleet",
+                 "pfleet", "churn",
                  "auto", "twin", "elastic", "elastic-inner", "search",
                  "search-inner", "r06", "r07", "r08"],
         default="all",
@@ -3056,11 +3326,12 @@ def main():
         args.cycles = 50 if args.stretch else 2000
 
     if args.only == "r08":
-        # consolidated r08 record (ISSUE 15 satellite): the r07 legs
-        # plus the anytime exact-search leg, EACH in a fresh
+        # consolidated r08 record (ISSUE 15 satellite; the process-
+        # fleet leg joined in ISSUE 16): the r07 legs plus the anytime
+        # exact-search and process-fleet legs, EACH in a fresh
         # subprocess (same isolation rationale as r06 below)
         legs = ("serve", "churn", "dpop-sharded", "auto", "fleet",
-                "twin", "elastic", "search")
+                "pfleet", "twin", "elastic", "search")
         fwd = []
         skip_next = False
         for a in sys.argv[1:]:
@@ -3284,7 +3555,7 @@ def main():
     # measurement so both see the same tunnel state
     probe = None
     if args.only in ("all", "maxsum", "probe", "batch", "harness",
-                     "serve", "fleet", "churn", "twin"):
+                     "serve", "fleet", "pfleet", "churn", "twin"):
         try:
             probe = make_drift_probe(repeat=args.repeat)
         except Exception as e:
@@ -3418,6 +3689,15 @@ def main():
             extra.update(bench_fleet(args, probe=probe))
         except Exception as e:
             extra["fleet_error"] = repr(e)
+
+    if args.only in ("all", "pfleet"):
+        # process fleet (ISSUE 16): jobs/s + p99 across 1/2/4 replica
+        # child processes, RTO under a real kill -9, and the cold-join
+        # zero-compile pin (BENCHREF.md "Process fleet")
+        try:
+            extra.update(bench_pfleet(args, probe=probe))
+        except Exception as e:
+            extra["pfleet_error"] = repr(e)
 
     if args.only in ("all", "churn"):
         try:

@@ -79,9 +79,14 @@ WAIVER_RE = re.compile(r"#\s*analyze:\s*waive\[([^\]]*)\]\s*(.*)$")
 #: (scenario/twin.py — its fleet's supervisor thread runs under the
 #: tick loop) and the fleet router (serve/router.py — front-door
 #: placements race supervisor health/capacity flips; it owns its own
-#: lock now).  serve/ already covers router.py by prefix; twin.py is
-#: listed explicitly.  ``<string>`` keeps in-memory fixtures (tests)
-#: in scope.
+#: lock now).  serve/ already covers router.py by prefix — and, since
+#: ISSUE 16, the process-fleet tier (serve/procfleet.py, whose proxy
+#: counters/cache snapshots are written by the hub pump under the
+#: supervisor thread while submit paths read them; serve/wire.py,
+#: whose hub endpoints are shared between pump and send callers; and
+#: serve/artifacts.py, racing store mutations across processes via
+#: atomic renames).  twin.py is listed explicitly.  ``<string>`` keeps
+#: in-memory fixtures (tests) in scope.
 RACE_SCOPE = ("serve/", "serve\\", "batch/cache.py", "batch\\cache.py",
               "scenario/twin.py", "scenario\\twin.py", "<string>")
 

@@ -36,12 +36,26 @@ class CompileCache:
     re-entrant so a builder that itself consults the cache does not
     deadlock."""
 
-    def __init__(self):
+    def __init__(self, artifacts: Optional[Any] = None):
         self._fns: Dict[Tuple, Any] = {}
         self.hits = 0
         self.misses = 0
         self.prewarmed = 0
+        self.artifact_hits = 0
+        #: optional level 1.5 — a serve.artifacts.ArtifactStore of
+        #: AOT-serialized executables shared across processes: a miss
+        #: here first tries to LOAD the compiled runner (zero XLA
+        #: compiles, counted as ``artifact_hits`` not ``misses``), and
+        #: a cold build that produced a serializable runner is exported
+        #: so the NEXT process skips the compile too
+        self.artifacts = artifacts
         self._lock = threading.RLock()
+
+    @property
+    def exports_artifacts(self) -> bool:
+        """True when builders should compile ahead-of-time so their
+        runners can be serialized into the artifact store."""
+        return self.artifacts is not None
 
     def get_or_build(self, key: Tuple, builder: Callable[[], Any],
                      prewarm: bool = False) -> Tuple[Any, bool]:
@@ -53,6 +67,15 @@ class CompileCache:
                 self.hits += 1
                 send_batch("compile.hit", {"key": _printable(key)})
                 return self._fns[key], True
+            if self.artifacts is not None:
+                fn = self.artifacts.load(key)
+                if fn is not None:
+                    # a peer already paid this compile: zero XLA work
+                    self.artifact_hits += 1
+                    self._fns[key] = fn
+                    send_batch("compile.artifact_hit",
+                               {"key": _printable(key)})
+                    return fn, True
             self.misses += 1
             if prewarm:
                 self.prewarmed += 1
@@ -62,6 +85,8 @@ class CompileCache:
             )
             fn = builder()
             self._fns[key] = fn
+            if self.artifacts is not None:
+                self.artifacts.save(key, fn)
             return fn, False
 
     def prewarm(self, entries: Iterable[Tuple[Tuple, Callable[[], Any]]],
@@ -103,14 +128,25 @@ class CompileCache:
         with self._lock:
             return key in self._fns
 
+    def key_strings(self) -> list:
+        """Printable forms of every resident runner key — what a
+        replica process streams to the fleet head so the router's
+        warmth probe has ground truth without a round-trip."""
+        with self._lock:
+            return sorted(_printable(k) for k in self._fns)
+
     def stats(self) -> Dict[str, int]:
         with self._lock:
-            return {
+            out = {
                 "hits": self.hits,
                 "misses": self.misses,
                 "entries": len(self._fns),
                 "prewarmed": self.prewarmed,
             }
+            if self.artifacts is not None:
+                out["artifact_hits"] = self.artifact_hits
+                out["artifacts"] = self.artifacts.stats()
+            return out
 
     def clear(self) -> None:
         with self._lock:
@@ -118,6 +154,7 @@ class CompileCache:
             self.hits = 0
             self.misses = 0
             self.prewarmed = 0
+            self.artifact_hits = 0
 
 
 #: process-wide default cache: engines share it unless given their own,

@@ -58,13 +58,15 @@ def make_parser() -> argparse.ArgumentParser:
         replica_dist,
         run,
         serve,
+        serve_replica,
         solve,
         twin,
     )
 
     for module in (solve, run, orchestrator, agent, distribute, graph,
                    generate, batch, replica_dist, consolidate, serve,
-                   portfolio, twin, analyze, checkpoint_cmd):
+                   serve_replica, portfolio, twin, analyze,
+                   checkpoint_cmd):
         module.set_parser(subparsers)
     return parser
 

@@ -84,6 +84,14 @@ def set_parser(subparsers):
                         "output JSON gains a 'fleet' section "
                         "(docs/serving.rst 'Fleet deployment and "
                         "failover')")
+    parser.add_argument("--processes", action="store_true",
+                        help="with --replicas N > 1: each replica is "
+                        "a real child PROCESS (ProcessFleet) — "
+                        "socket-streamed journal, kill -9 failure "
+                        "domain, shared serialized-runner artifacts "
+                        "for zero-compile bring-up; requires "
+                        "--journal-dir (docs/serving.rst 'Process "
+                        "fleet')")
     parser.add_argument("--deadline", type=float, default=None,
                         help="per-job deadline in seconds (deadline-"
                         "pressured lanes shrink their chunks; expired "
@@ -188,7 +196,30 @@ def run_cmd(args):
             return 1
 
     fleet = None
-    if args.replicas > 1:
+    if args.replicas > 1 and args.processes:
+        from pydcop_tpu.serve import ProcessFleet
+
+        if not args.journal_dir:
+            output_metrics(
+                {"status": "ERROR",
+                 "error": "--processes requires --journal-dir (the "
+                          "socket journal, heartbeat files and shared "
+                          "artifact store live there)"},
+                args.output,
+            )
+            return 1
+        fleet = ProcessFleet(
+            replicas=args.replicas,
+            lanes=args.lanes,
+            max_cycles=args.max_cycles,
+            journal_dir=args.journal_dir,
+            max_pending=args.max_pending,
+            tenant_quota=args.tenant_quota,
+            fault_plan=fault_plan,
+        )
+        fleet.wait_ready()
+        service = fleet
+    elif args.replicas > 1:
         fleet = SolveFleet(
             replicas=args.replicas,
             lanes=args.lanes,
@@ -215,9 +246,13 @@ def run_cmd(args):
     if args.resume:
         n_resumed = service.resume()
     if args.prewarm and pool:
+        # a process fleet ships prewarms by source path (the DCOP
+        # objects live in the children); everything else takes objects
+        heads = ([fn for fn, _dcop in pool]
+                 if args.replicas > 1 and args.processes
+                 else [dcop for _fn, dcop in pool])
         service.prewarm(
-            [(dcop, args.algo, algo_params) for _fn, dcop in pool],
-            block=True,
+            [(h, args.algo, algo_params) for h in heads], block=True,
         )
     service.start()
 

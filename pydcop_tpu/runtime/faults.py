@@ -80,6 +80,20 @@ CHURN_KINDS = ("remove_agent_burst", "add_agent_burst", "edit_factor")
 #: its in-flight jobs keep running
 FLEET_KINDS = ("kill_replica", "stall_replica", "partition_replica")
 
+#: process-fleet fault kinds (consumed by the process fleet's
+#: supervisor, pydcop_tpu.serve.procfleet.ProcessFleet, through the
+#: same :class:`ServeFaultInjector`) — ``kill_process`` SIGKILLs an
+#: entire replica child process mid-trace (the REAL kill -9: every
+#: lane, thread and socket of that process dies at once; detection is
+#: heartbeat staleness + waitpid, recovery is the PR 6 re-seat),
+#: ``partition_socket`` severs a replica's journal socket and refuses
+#: its re-dials for ``duration`` seconds (frames buffer client-side
+#: and replay-from-offset on heal — in-flight jobs keep running,
+#: nothing double-applies), and ``corrupt_artifact`` flips one seeded
+#: byte in a replica's exported runner artifact (the next loader must
+#: reject it loudly on CRC and recompile)
+PROCESS_KINDS = ("kill_process", "partition_socket", "corrupt_artifact")
+
 #: runtime-layer (rank/agent/checkpoint) fault kinds — the original
 #: PR 1 set, consumed by RankFaultInjector and the coordinator watchdog
 RUNTIME_KINDS = ("kill_rank", "stall_rank", "kill_agent",
@@ -98,7 +112,7 @@ RUNTIME_KINDS = ("kill_rank", "stall_rank", "kill_agent",
 DEVICE_KINDS = ("kill_device", "shrink_mesh", "corrupt_slab")
 
 KINDS = (RUNTIME_KINDS + SERVE_KINDS + CHURN_KINDS + FLEET_KINDS
-         + DEVICE_KINDS)
+         + PROCESS_KINDS + DEVICE_KINDS)
 
 #: the one catalog of which OPTIONAL fields each kind may address —
 #: the machine-readable half of the fault-kind table in
@@ -125,6 +139,9 @@ KIND_FIELDS: Dict[str, Tuple[str, ...]] = {
     "kill_replica": ("replica",),
     "stall_replica": ("replica", "duration"),
     "partition_replica": ("replica", "duration"),
+    "kill_process": ("replica",),
+    "partition_socket": ("replica", "duration"),
+    "corrupt_artifact": ("replica", "path"),
     "kill_device": ("device", "replica"),
     "shrink_mesh": ("devices",),
     "corrupt_slab": ("operand", "device"),
@@ -182,7 +199,9 @@ class Fault:
         if self.kind in ("stall_rank", "stall_tick",
                          "stall_replica") and self.duration <= 0:
             raise ValueError(f"{self.kind} fault needs a 'duration' > 0")
-        if self.kind in FLEET_KINDS and self.replica is None:
+        if (self.kind in FLEET_KINDS
+                or self.kind in ("kill_process", "partition_socket")) \
+                and self.replica is None:
             raise ValueError(f"{self.kind} fault needs a 'replica'")
         if self.kind == "kill_agent" and not self.agent:
             raise ValueError("kill_agent fault needs an 'agent'")
@@ -255,6 +274,21 @@ class FaultPlan:
             replica: 1                 # placements for `duration`
             cycle: 3                   # seconds (0 = rest of run)
             duration: 1.0
+          - kind: kill_process         # process fleet: SIGKILL the
+            replica: 1                 # whole replica child process
+            cycle: 4                   # (the real kill -9; heartbeat
+                                       # staleness + waitpid detect it,
+                                       # survivors re-seat its jobs)
+          - kind: partition_socket     # process fleet: sever replica
+            replica: 0                 # 0's journal socket and refuse
+            cycle: 6                   # re-dials for `duration` s
+            duration: 1.0              # (0 = rest of run); frames
+                                       # buffer + replay on heal
+          - kind: corrupt_artifact     # process fleet: flip one seeded
+            cycle: 2                   # byte in an exported runner
+                                       # artifact (CRC must catch it;
+                                       # `replica`/`path` narrow the
+                                       # target, omit for seeded pick)
           - kind: kill_device          # device: drop mesh device 7 at
             device: 7                  # the next chunk boundary >= 8;
             cycle: 8                   # with `replica: N` the fleet
@@ -377,6 +411,13 @@ class FaultPlan:
         return [f for f in self.faults
                 if f.kind in FLEET_KINDS
                 or (f.kind == "kill_device" and f.replica is not None)]
+
+    def process_faults(self) -> List[Fault]:
+        """Process-fleet faults (kill_process / partition_socket /
+        corrupt_artifact) consumed by the process fleet's supervisor
+        (serve/procfleet.ProcessFleet) — the OS-level escalation of
+        :meth:`fleet_faults`."""
+        return [f for f in self.faults if f.kind in PROCESS_KINDS]
 
     def device_faults(self) -> List[Fault]:
         """Device-tier faults (kill_device/shrink_mesh/corrupt_slab)

@@ -315,6 +315,12 @@ FLEET_COUNTERS = (
                                # (kill_device faults with a replica)
     "capacity_reduced",        # reduced-capacity advertisements pushed
                                # to the router after device loss
+    "replicas_relaunched",     # dead replica PROCESSES respawned by the
+                               # process fleet's backoff relauncher
+    "socket_partitions",       # journal-socket partitions injected
+                               # (partition_socket faults)
+    "artifacts_corrupted",     # serialized runner artifacts corrupted
+                               # in place (corrupt_artifact faults)
 )
 
 

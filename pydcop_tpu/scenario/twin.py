@@ -165,6 +165,8 @@ def default_chaos_plan(
     churn_edit_ticks: Sequence[int] = (10, 18),
     device_loss_tick: Optional[int] = 5,
     device_loss_replica: int = 1,
+    process_kill_tick: Optional[int] = None,
+    process_kill_replica: int = 0,
 ) -> FaultPlan:
     """The twin's combined chaos plan: one replica kill (fleet), one
     wedged scheduler tick + one transient NaN lane + one torn journal
@@ -172,7 +174,11 @@ def default_chaos_plan(
     problem, and one device loss (ISSUE 14: a ``kill_device`` against
     a SURVIVING replica, which keeps serving but advertises reduced
     capacity to the router) — every layer's fault machinery armed by
-    ONE plan."""
+    ONE plan.  With ``process_kill_tick`` set (ISSUE 16: the plan is
+    feeding a :class:`~pydcop_tpu.serve.ProcessFleet`), a whole
+    replica *process* is additionally SIGKILLed at that tick — the
+    thread-mode default stays ``None`` so existing twin pins are
+    untouched."""
     faults = [
         Fault(kind="kill_replica", replica=int(kill_replica),
               cycle=int(kill_tick)),
@@ -186,6 +192,11 @@ def default_chaos_plan(
             kind="kill_device", device=0,
             replica=int(device_loss_replica),
             cycle=int(device_loss_tick),
+        ))
+    if process_kill_tick is not None:
+        faults.append(Fault(
+            kind="kill_process", replica=int(process_kill_replica),
+            cycle=int(process_kill_tick),
         ))
     for t in churn_edit_ticks:
         faults.append(Fault(kind="edit_factor", cycle=int(t)))

@@ -12,8 +12,19 @@ on the ws/SSE channel.  :class:`SolveFleet` replicates the service
 horizontally: N replicas behind a compile-cache-keyed router, with
 journal streaming, heartbeat-supervised failover re-seating (results
 bit-identical to an unfailed run) and fleet-level admission control.
-See docs/serving.rst.
+:class:`ProcessFleet` hardens that into real failure domains: each
+replica is a child *process* supervised by the watchdog protocol, the
+journal is a CRC-framed record stream over a local socket, and a
+relaunched or cold-joining replica bootstraps warm from shared
+``jax.export``-style serialized runner artifacts — zero XLA compiles
+to first job.  See docs/serving.rst.
 """
+from pydcop_tpu.serve.artifacts import (  # noqa: F401
+    ArtifactStore,
+    CorruptArtifactError,
+    StaleArtifactError,
+    abi_tag,
+)
 from pydcop_tpu.serve.errors import (  # noqa: F401
     DeadlineInfeasible,
     ServeError,
@@ -24,6 +35,11 @@ from pydcop_tpu.serve.fleet import (  # noqa: F401
     FleetJournal,
     ReplicaHandle,
     SolveFleet,
+)
+from pydcop_tpu.serve.procfleet import (  # noqa: F401
+    ProcessFleet,
+    ProcessReplicaHandle,
+    ReplicaWorker,
 )
 from pydcop_tpu.serve.router import (  # noqa: F401
     FleetRouter,
@@ -42,17 +58,24 @@ from pydcop_tpu.serve.service import (  # noqa: F401
 )
 
 __all__ = [
+    "ArtifactStore",
     "BucketWorker",
+    "CorruptArtifactError",
     "DeadlineInfeasible",
     "FleetJournal",
     "FleetRouter",
+    "ProcessFleet",
+    "ProcessReplicaHandle",
     "ReplicaHandle",
+    "ReplicaWorker",
     "ServeError",
     "ServeJob",
     "ServiceOverloaded",
     "ServiceStopped",
     "SolveFleet",
     "SolveService",
+    "StaleArtifactError",
+    "abi_tag",
     "dummy_bucket_inputs",
     "fits",
     "job_routing_key",

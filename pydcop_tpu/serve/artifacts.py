@@ -1,0 +1,321 @@
+"""AOT runner artifacts — serialized compiled executables keyed by
+``runner_cache_key``, so a relaunched or newly joined replica serves
+its first job with ZERO XLA compiles.
+
+The two-level compile cache (batch/cache.py) already skips the
+*expensive half* of a cold start via the persistent XLA cache, but a
+fresh process still pays tracing and cache plumbing per runner, and
+the XLA cache is keyed by HLO fingerprint — it cannot answer "what do
+I need to be warm for this routing key?".  This module closes that
+gap with explicit, addressable artifacts:
+
+* a runner compiled ahead-of-time (``jax.jit(...).lower().compile()``)
+  serializes through ``jax.experimental.serialize_executable`` into a
+  ``(payload, in_tree, out_tree)`` triple;
+* :class:`ArtifactStore` persists that triple under a filename derived
+  from the exact compile-cache key, as a self-describing file: one
+  JSON header line (format version, ABI tag, CRC32 + size of the
+  blob, printable key) followed by the pickled triple;
+* a loading replica verifies format, ABI (jax/jaxlib versions and
+  backend — serialized executables are machine-specific) and CRC
+  before deserializing.  A stale artifact raises
+  :class:`StaleArtifactError`, a damaged one
+  :class:`CorruptArtifactError`; the cache layer logs both loudly,
+  counts them, and falls back to a fresh compile that OVERWRITES the
+  bad file — rejection is never silent and never fatal.
+
+Writes are atomic (tmp + fsync + rename), matching the checkpoint
+discipline (PR 6): a kill mid-export can leave a tmp file around but
+never a half-written artifact under the real name.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+#: bumped when the on-disk layout changes
+ARTIFACT_FORMAT = 1
+
+
+class ArtifactError(RuntimeError):
+    """Base for artifact rejections (never raised past the cache)."""
+
+
+class StaleArtifactError(ArtifactError):
+    """ABI/format mismatch: built by a different jax/jaxlib/backend
+    (or an older store layout) — unusable here, must recompile."""
+
+
+class CorruptArtifactError(ArtifactError):
+    """Damaged bytes: bad header, CRC mismatch, or an unpicklable
+    blob — rejected loudly, recompiled, overwritten."""
+
+
+def abi_tag() -> Dict[str, str]:
+    """The compatibility fingerprint stamped into every artifact.
+    Serialized executables are tied to the exact XLA build and target
+    backend, so all three components must match to load."""
+    import jax
+    import jaxlib
+
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.version.__version__,
+        "backend": jax.default_backend(),
+    }
+
+
+class AotRunner:
+    """A compiled bucket runner plus its serialized form.
+
+    Callable exactly like the jitted runner it replaces (the bucket
+    worker cannot tell them apart); carries the serialization triple
+    so exporting to the store never re-serializes, and a loaded
+    runner can be re-exported to a peer without a round-trip."""
+
+    def __init__(self, compiled: Any,
+                 triple: Tuple[bytes, Any, Any]):
+        self._compiled = compiled
+        self.triple = triple
+
+    def __call__(self, arrays, state, xs, n_active, done_mask):
+        return self._compiled(arrays, state, xs, n_active, done_mask)
+
+
+def _serialize_compiled(compiled: Any) -> Tuple[bytes, Any, Any]:
+    from jax.experimental import serialize_executable as se
+
+    return se.serialize(compiled)
+
+
+def _deserialize(triple: Tuple[bytes, Any, Any]) -> Any:
+    from jax.experimental import serialize_executable as se
+
+    return se.deserialize_and_load(*triple)
+
+
+def artifact_name(key: Tuple) -> str:
+    """Stable filename for a compile-cache key (keys are tuples of
+    primitives + nested shape tuples — ``repr`` is deterministic)."""
+    return hashlib.sha1(repr(key).encode("utf-8")).hexdigest() + ".rnr"
+
+
+class ArtifactStore:
+    """Directory of serialized runner executables, one per compile-
+    cache key.  Shared by every replica process of a fleet (it lives
+    under the fleet's journal directory), so one replica's compile is
+    every FUTURE replica's free bring-up.
+
+    Thread-safe: the owning service's scheduler and prewarm threads
+    both reach it through the compile cache; a lock serializes the
+    read-verify-load and write-fsync-rename sections."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.saved = 0
+        self.rejected_stale = 0
+        self.rejected_corrupt = 0
+        self.save_verify_failed = 0
+
+    def path_for(self, key: Tuple) -> str:
+        return os.path.join(self.root, artifact_name(key))
+
+    # -- export --------------------------------------------------------------
+
+    def save(self, key: Tuple, runner: Any) -> Optional[str]:
+        """Persist a runner's executable.  Only AOT-built runners
+        carry a serialization triple; anything else is skipped (the
+        fleet decides at build time which runners export)."""
+        triple = getattr(runner, "triple", None)
+        if triple is None:
+            return None
+        try:
+            blob = pickle.dumps(triple)
+        except Exception as e:  # never fail the solve over an export
+            log.warning("artifact export failed for %r: %s", key, e)
+            return None
+        # self-verify BEFORE publishing: some executables serialize
+        # into payloads that cannot be loaded back (notably ones whose
+        # compile was satisfied from the persistent XLA cache — the
+        # payload lacks its kernel symbols).  A broken artifact must
+        # never reach the store; a cold replica trusting it would die.
+        try:
+            _deserialize(triple)
+        except Exception as e:
+            with self._lock:
+                self.save_verify_failed += 1
+            log.warning("artifact for %r failed save-time verification "
+                        "(%s) — NOT exported", key, e)
+            self._send_reject(self.path_for(key), "unverifiable", str(e))
+            return None
+        import zlib
+
+        header = json.dumps({
+            "format": ARTIFACT_FORMAT,
+            "abi": abi_tag(),
+            "crc": zlib.crc32(blob) & 0xFFFFFFFF,
+            "size": len(blob),
+            "key": [str(k) for k in key],
+        }, sort_keys=True).encode("utf-8") + b"\n"
+        path = self.path_for(key)
+        tmp = path + ".tmp"
+        with self._lock:
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(header)
+                    f.write(blob)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            except OSError as e:
+                log.warning("artifact write failed for %r: %s", key, e)
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                return None
+            self.saved += 1
+        from pydcop_tpu.runtime.events import send_batch
+
+        send_batch("artifact.saved", {"path": path})
+        return path
+
+    # -- import --------------------------------------------------------------
+
+    def load(self, key: Tuple) -> Optional[AotRunner]:
+        """Deserialize the runner for ``key`` if a usable artifact
+        exists.  Returns None on a plain miss; stale/corrupt files are
+        rejected LOUDLY (warning log + counter + event) and also
+        return None so the caller recompiles and overwrites."""
+        path = self.path_for(key)
+        try:
+            with self._lock:
+                triple = self._read_verified(path)
+        except FileNotFoundError:
+            with self._lock:
+                self.misses += 1
+            return None
+        except StaleArtifactError as e:
+            with self._lock:
+                self.rejected_stale += 1
+            log.warning("STALE runner artifact rejected (%s): %s "
+                        "— recompiling", path, e)
+            self._send_reject(path, "stale", str(e))
+            return None
+        except CorruptArtifactError as e:
+            with self._lock:
+                self.rejected_corrupt += 1
+            log.warning("CORRUPT runner artifact rejected (%s): %s "
+                        "— recompiling", path, e)
+            self._send_reject(path, "corrupt", str(e))
+            return None
+        try:
+            compiled = _deserialize(triple)
+        except Exception as e:
+            with self._lock:
+                self.rejected_corrupt += 1
+            log.warning("runner artifact failed to deserialize (%s): "
+                        "%s — recompiling", path, e)
+            self._send_reject(path, "corrupt", str(e))
+            return None
+        with self._lock:
+            self.hits += 1
+        return AotRunner(compiled, triple)
+
+    def _read_verified(self, path: str) -> Tuple[bytes, Any, Any]:
+        import zlib
+
+        with open(path, "rb") as f:
+            raw = f.read()
+        nl = raw.find(b"\n")
+        if nl < 0:
+            raise CorruptArtifactError("no header line")
+        try:
+            header = json.loads(raw[:nl].decode("utf-8"))
+        except ValueError as e:
+            raise CorruptArtifactError(f"unparseable header: {e}")
+        if not isinstance(header, dict):
+            raise CorruptArtifactError("header is not an object")
+        if header.get("format") != ARTIFACT_FORMAT:
+            raise StaleArtifactError(
+                f"format {header.get('format')!r} != {ARTIFACT_FORMAT}"
+            )
+        abi = header.get("abi")
+        here = abi_tag()
+        if abi != here:
+            raise StaleArtifactError(f"abi {abi!r} != {here!r}")
+        blob = raw[nl + 1:]
+        if len(blob) != header.get("size"):
+            raise CorruptArtifactError(
+                f"size {len(blob)} != declared {header.get('size')}"
+            )
+        if zlib.crc32(blob) & 0xFFFFFFFF != header.get("crc"):
+            raise CorruptArtifactError("blob CRC mismatch")
+        try:
+            triple = pickle.loads(blob)
+        except Exception as e:
+            raise CorruptArtifactError(f"unpicklable blob: {e}")
+        if not (isinstance(triple, tuple) and len(triple) == 3):
+            raise CorruptArtifactError("blob is not a (payload, "
+                                       "in_tree, out_tree) triple")
+        return triple
+
+    def _send_reject(self, path: str, why: str, detail: str) -> None:
+        from pydcop_tpu.runtime.events import send_batch
+
+        send_batch("artifact.rejected",
+                   {"path": path, "why": why, "detail": detail})
+
+    # -- introspection -------------------------------------------------------
+
+    def entries(self) -> int:
+        try:
+            return sum(1 for n in os.listdir(self.root)
+                       if n.endswith(".rnr"))
+        except OSError:
+            return 0
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "saved": self.saved,
+                "rejected_stale": self.rejected_stale,
+                "rejected_corrupt": self.rejected_corrupt,
+                "save_verify_failed": self.save_verify_failed,
+                "entries": self.entries(),
+            }
+
+
+def corrupt_artifact_file(path: str, seed: int = 0) -> bool:
+    """Flip one byte inside an artifact's blob (the ``corrupt_artifact``
+    fault's hand): a deterministic, seeded bit of damage the CRC check
+    must catch.  Returns False when the file is missing or too short
+    to damage safely."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return False
+    nl = raw.find(b"\n")
+    if nl < 0 or len(raw) <= nl + 2:
+        return False
+    # pick a deterministic offset inside the blob
+    span = len(raw) - (nl + 1)
+    off = nl + 1 + (seed * 2654435761 + 17) % span
+    flipped = raw[:off] + bytes([raw[off] ^ 0xFF]) + raw[off + 1:]
+    with open(path, "wb") as f:
+        f.write(flipped)
+    return True

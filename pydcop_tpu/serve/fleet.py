@@ -204,6 +204,12 @@ class ReplicaHandle:
     def dead(self) -> bool:
         return self.killed or self.service._failure is not None
 
+    @property
+    def down_reason(self) -> str:
+        """Why the supervisor is taking this replica down — process
+        handles override with the exit-code taxonomy."""
+        return "injected kill" if self.killed else "scheduler died"
+
     def done_jids(self) -> set:
         """``JID:`` completion lines that reached this replica's disk —
         the ground truth a re-seat must respect: a job whose completion
@@ -335,11 +341,15 @@ class SolveFleet:
         #: open recovery records; each: {replica, t_detect, jobs,
         #: pending(set), rto_s} — rto_s lands when pending empties
         self.recoveries: List[Dict[str, Any]] = []
+        #: heartbeat staleness is normally only judged once start()
+        #: arms the replica schedulers (a tick-driven test fleet never
+        #: beats its files); process fleets flip this on — their
+        #: children beat heartbeats regardless of how the head runs
+        self._hb_check_always = False
+        armed = self._injector_faults(fault_plan)
         self._injector = (
-            ServeFaultInjector(fault_plan,
-                               faults=fault_plan.fleet_faults())
-            if fault_plan is not None and fault_plan.fleet_faults()
-            else None
+            ServeFaultInjector(fault_plan, faults=armed)
+            if armed else None
         )
 
         self.journal: Optional[FleetJournal] = None
@@ -362,6 +372,19 @@ class SolveFleet:
 
         for i in range(int(replicas)):
             self._add_replica(i, checkpoint_every)
+
+    def _injector_faults(self, fault_plan: Optional[FaultPlan]):
+        """Which of the plan's faults THIS fleet's supervisor consumes
+        (the process fleet adds the process kinds)."""
+        if fault_plan is None:
+            return []
+        return fault_plan.fleet_faults()
+
+    #: the fault kinds the supervisor polls each pass, in firing order
+    _INJECT_KINDS: Tuple[str, ...] = (
+        "kill_replica", "stall_replica", "partition_replica",
+        "kill_device",
+    )
 
     # -- replicas -----------------------------------------------------------
 
@@ -845,8 +868,7 @@ class SolveFleet:
         now = monotonic()
         inj = self._injector
         if inj is not None:
-            for kind in ("kill_replica", "stall_replica",
-                         "partition_replica", "kill_device"):
+            for kind in self._INJECT_KINDS:
                 while True:
                     f = inj.due(kind, self._ticks)
                     if f is None:
@@ -863,12 +885,12 @@ class SolveFleet:
             if h.dead:
                 self._replica_down(
                     h,
-                    reason=("injected kill" if h.killed
-                            else "scheduler died"),
+                    reason=h.down_reason,
                     t_detect=h.killed_at or now,
                 )
                 continue
-            if self._started and h.hb_path and os.path.exists(h.hb_path):
+            if (self._started or self._hb_check_always) \
+                    and h.hb_path and os.path.exists(h.hb_path):
                 stale = bool(stalled_ranks(
                     {0: h.hb_path}, self.heartbeat_timeout
                 ))

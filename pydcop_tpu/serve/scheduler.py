@@ -150,21 +150,34 @@ def dummy_bucket_inputs(algo: str, target: InstanceDims, B: int,
 
 
 def warm_bucket_runner(adapter, target: InstanceDims,
-                       params: Dict[str, Any], B: int, chunk: int):
+                       params: Dict[str, Any], B: int, chunk: int,
+                       aot: bool = False):
     """Build AND compile one bucket runner.  ``jax.jit`` alone defers
     tracing and XLA compilation to the first call, so a prewarm that
     stopped at the wrapper would still pay the cold compile at
     admission time — this executes the runner once at the real shapes
     (all lanes idle: ``n_active=0``, all done) so the executable is
-    resident before the first job arrives."""
+    resident before the first job arrives.
+
+    With ``aot=True`` the runner is compiled ahead-of-time
+    (``lower().compile()``) and returned as a serializable
+    :class:`~pydcop_tpu.serve.artifacts.AotRunner` — the same compile,
+    paid once, but its executable can be exported to the fleet's
+    artifact store so future replica processes skip it entirely."""
     runner = build_bucket_runner(
         adapter, BucketMeta.of(target), params, chunk
     )
     arrays, state, xs = dummy_bucket_inputs(adapter.algo, target, B, chunk)
-    out = runner(
-        arrays, state, xs,
-        jnp.zeros((B,), jnp.int32), jnp.ones((B,), bool),
-    )
+    n0 = jnp.zeros((B,), jnp.int32)
+    done = jnp.ones((B,), bool)
+    if aot:
+        from pydcop_tpu.serve.artifacts import (
+            AotRunner, _serialize_compiled,
+        )
+
+        compiled = runner.lower(arrays, state, xs, n0, done).compile()
+        runner = AotRunner(compiled, _serialize_compiled(compiled))
+    out = runner(arrays, state, xs, n0, done)
     jax.block_until_ready(out)
     return runner
 
@@ -221,7 +234,8 @@ class BucketWorker:
         self.runner, self.runner_was_warm = cache.get_or_build(
             key,
             lambda: warm_bucket_runner(
-                self.adapter, target, self.params, self.B, self.chunk
+                self.adapter, target, self.params, self.B, self.chunk,
+                aot=getattr(cache, "exports_artifacts", False),
             ),
         )
         self.arrays, self.state, _ = dummy_bucket_inputs(

@@ -772,7 +772,10 @@ class SolveService:
             entries.append((
                 key,
                 lambda a=adapter, t=target, p=params, b=lanes, c=chunk:
-                warm_bucket_runner(a, t, p, b, c),
+                warm_bucket_runner(
+                    a, t, p, b, c,
+                    aot=getattr(self.cache, "exports_artifacts", False),
+                ),
             ))
         self.counters.inc("prewarmed_runners", len(entries))
         send_serve("prewarm.scheduled", {"runners": len(entries)})
@@ -834,7 +837,10 @@ class SolveService:
             entries.append((
                 key,
                 lambda a=adapter, t=target, p=params, b=self.lanes,
-                c=chunk: warm_bucket_runner(a, t, p, b, c),
+                c=chunk: warm_bucket_runner(
+                    a, t, p, b, c,
+                    aot=getattr(self.cache, "exports_artifacts", False),
+                ),
             ))
         if not entries:
             return 0

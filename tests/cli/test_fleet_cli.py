@@ -73,6 +73,14 @@ class TestFleetSmoke:
         assert set(fleet["replicas"]) == {"replica-0", "replica-1"}
         assert all(r["up"] for r in fleet["replicas"].values())
 
+    def test_processes_requires_journal_dir(self):
+        proc = run_cli(
+            "serve", "-a", "dsa", "--replicas", "2", "--processes",
+            TUTO,
+        )
+        assert proc.returncode == 1
+        assert "journal-dir" in json.loads(proc.stdout)["error"]
+
     def test_resume_rejected_with_replicas(self):
         proc = run_cli(
             "serve", "-a", "mgm", "--replicas", "2", "--resume",
@@ -80,6 +88,60 @@ class TestFleetSmoke:
         )
         assert proc.returncode == 1
         assert "fleet" in json.loads(proc.stdout)["error"]
+
+
+@pytest.mark.slow
+class TestProcessFleetKillSmoke:
+    """`make pfleet-smoke`: the ISSUE 16 chaos pin through the CLI —
+    a REAL ``kill -9`` of a whole replica child process mid-trace.
+    Every job must still complete bit-identically on the survivor and
+    the watchdog must relaunch the slot."""
+
+    def test_kill_process_midtrace_all_complete_bit_identical(
+        self, tmp_path
+    ):
+        from pydcop_tpu.dcop import load_dcop_from_file
+        from pydcop_tpu.runtime.run import solve_result
+
+        plan = tmp_path / "plan.yaml"
+        plan.write_text(
+            "seed: 7\n"
+            "faults:\n"
+            "  - kind: kill_process\n"
+            "    replica: 0\n"
+            "    cycle: 3\n"
+        )
+        journal = str(tmp_path / "pfleet")
+        proc = run_cli(
+            "serve", "-a", "dsa", "--jobs", "8", "--replicas", "2",
+            "--processes", "--lanes", "2", "--max-cycles", "2000",
+            "--journal-dir", journal, "--fault-plan", str(plan),
+            "--prewarm", TUTO, CSP,
+            timeout=600,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout)
+        assert out["status"] == "FINISHED"
+        assert len(out["results"]) == 8
+        dcops = {f: load_dcop_from_file([f]) for f in (TUTO, CSP)}
+        for jid, m in out["results"].items():
+            assert m["status"] == "FINISHED", (jid, m)
+            fn, seed = m["label"].rsplit(":", 1)
+            seq = solve_result(dcops[fn], "dsa", seed=int(seed))
+            assert m["cost"] == seq.cost, (jid, m)
+            assert m["cycle"] == seq.cycle, (jid, m)
+            assert m["assignment"] == seq.assignment, (jid, m)
+        fleet = out["fleet"]["fleet"]
+        assert fleet["replicas_down"] >= 1
+        assert fleet["faults_injected"] >= 1
+        recov = out["fleet"]["recoveries"]
+        assert recov and recov[0]["rto_s"] is not None
+        # the journal socket framed + fsynced the whole handoff
+        fj = os.path.join(journal, "fleet.jsonl")
+        with open(fj, encoding="utf-8") as f:
+            kinds = [json.loads(line)["kind"] for line in f
+                     if line.strip()]
+        assert kinds.count("done") == 8
 
 
 @pytest.mark.slow

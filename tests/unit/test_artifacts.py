@@ -1,0 +1,229 @@
+"""Serialized runner artifacts (pydcop_tpu.serve.artifacts).
+
+The zero-compile bring-up layer, pinned without spawning processes:
+
+* a compiled runner round-trips through ``serialize_executable`` +
+  the store and still computes the SAME outputs;
+* version/ABI pinning: a different format version or a different
+  jax/jaxlib/backend tag is a **stale** refusal — never deserialized;
+* corruption (flipped blob byte, truncated file, garbage header) is a
+  **corrupt** refusal caught by CRC/structure checks — never
+  deserialized, counted, recompiled;
+* the compile cache counts an artifact load as ``artifact_hits``
+  (NOT a miss) — the cold-join acceptance pin ``misses == 0`` reads
+  straight off these counters.
+"""
+import itertools
+import json
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pydcop_tpu.batch.cache import CompileCache
+from pydcop_tpu.serve.artifacts import (
+    ARTIFACT_FORMAT,
+    AotRunner,
+    ArtifactStore,
+    _serialize_compiled,
+    abi_tag,
+    artifact_name,
+    corrupt_artifact_file,
+)
+
+KEY = ("dsa", "p=1", ((3, 4), (2,)), 8, 7)
+
+
+_salt = itertools.count(time.time_ns() % (1 << 30))
+
+
+@pytest.fixture(autouse=True)
+def _no_persistent_xla_cache():
+    """Compile with the persistent XLA cache OFF, exactly as an
+    exporting replica does (serve/procfleet.py ReplicaWorker): with
+    the cache engaged, the second and later same-shaped compiles in a
+    process serialize into payloads missing their deduplicated kernel
+    symbols ("Symbols not found: broadcast_add_fusion.1") and cannot
+    be loaded back.  ``config.update(None)`` alone is not enough once
+    the cache singleton is memoized — it must also be reset."""
+    import jax
+
+    try:
+        from jax._src import compilation_cache as cc
+    except ImportError:  # pragma: no cover - older/newer layout
+        cc = None
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    if cc is not None:
+        cc.reset_cache()
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev)
+    if cc is not None:
+        cc.reset_cache()
+
+
+def _aot_runner():
+    """A tiny compiled function shaped like a bucket runner call.
+
+    Each call bakes a fresh constant into the function so the compile
+    is always a real compile; tests only compare a runner against its
+    own loaded copy, so the constant value is irrelevant."""
+    import jax
+
+    salt = float(next(_salt))
+
+    def fn(arrays, state, xs, n_active, done_mask):
+        return (arrays * 2 + state) * 0 + salt, xs + n_active, done_mask
+
+    args = (jnp.arange(4.0), jnp.ones(4), jnp.zeros(3),
+            jnp.int32(2), jnp.zeros(3, dtype=bool))
+    compiled = jax.jit(fn).lower(*args).compile()
+    return AotRunner(compiled, _serialize_compiled(compiled)), args
+
+
+class TestStoreRoundtrip:
+    def test_save_load_same_outputs(self, tmp_path):
+        runner, args = _aot_runner()
+        store = ArtifactStore(str(tmp_path))
+        path = store.save(KEY, runner)
+        assert path and os.path.exists(path)
+        loaded = ArtifactStore(str(tmp_path)).load(KEY)
+        assert loaded is not None
+        a, b, c = runner(*args)
+        a2, b2, c2 = loaded(*args)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(a2))
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(b2))
+        np.testing.assert_array_equal(np.asarray(c), np.asarray(c2))
+
+    def test_plain_miss_counts_miss(self, tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        assert store.load(KEY) is None
+        assert store.stats()["misses"] == 1
+
+    def test_runner_without_triple_not_exported(self, tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        assert store.save(KEY, lambda *a: None) is None
+        assert store.stats()["entries"] == 0
+
+    def test_name_is_stable(self):
+        assert artifact_name(KEY) == artifact_name(KEY)
+        assert artifact_name(KEY) != artifact_name(KEY[:-1] + (8,))
+
+
+class TestRejections:
+    def _saved(self, tmp_path):
+        runner, _args = _aot_runner()
+        store = ArtifactStore(str(tmp_path))
+        path = store.save(KEY, runner)
+        return store, path
+
+    def test_corrupt_blob_rejected_loudly(self, tmp_path, caplog):
+        _store, path = self._saved(tmp_path)
+        assert corrupt_artifact_file(path, seed=3)
+        fresh = ArtifactStore(str(tmp_path))
+        with caplog.at_level("WARNING"):
+            assert fresh.load(KEY) is None
+        assert fresh.stats()["rejected_corrupt"] == 1
+        assert any("CORRUPT" in r.message for r in caplog.records)
+
+    def test_truncated_file_rejected(self, tmp_path):
+        _store, path = self._saved(tmp_path)
+        raw = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(raw[: len(raw) // 2])
+        fresh = ArtifactStore(str(tmp_path))
+        assert fresh.load(KEY) is None
+        assert fresh.stats()["rejected_corrupt"] == 1
+
+    def test_stale_format_version_refused(self, tmp_path, caplog):
+        _store, path = self._saved(tmp_path)
+        raw = open(path, "rb").read()
+        nl = raw.find(b"\n")
+        header = json.loads(raw[:nl])
+        header["format"] = ARTIFACT_FORMAT + 1
+        with open(path, "wb") as f:
+            f.write(json.dumps(header, sort_keys=True).encode()
+                    + b"\n" + raw[nl + 1:])
+        fresh = ArtifactStore(str(tmp_path))
+        with caplog.at_level("WARNING"):
+            assert fresh.load(KEY) is None
+        assert fresh.stats()["rejected_stale"] == 1
+        assert any("STALE" in r.message for r in caplog.records)
+
+    def test_stale_abi_refused(self, tmp_path):
+        """An artifact from a different jax/jaxlib/backend must not
+        even be unpickled here — serialized executables are
+        machine-specific."""
+        _store, path = self._saved(tmp_path)
+        raw = open(path, "rb").read()
+        nl = raw.find(b"\n")
+        header = json.loads(raw[:nl])
+        header["abi"] = dict(header["abi"], jax="0.0.1-elsewhere")
+        with open(path, "wb") as f:
+            f.write(json.dumps(header, sort_keys=True).encode()
+                    + b"\n" + raw[nl + 1:])
+        fresh = ArtifactStore(str(tmp_path))
+        assert fresh.load(KEY) is None
+        assert fresh.stats()["rejected_stale"] == 1
+
+    def test_recompile_overwrites_bad_artifact(self, tmp_path):
+        _store, path = self._saved(tmp_path)
+        corrupt_artifact_file(path)
+        fresh = ArtifactStore(str(tmp_path))
+        assert fresh.load(KEY) is None
+        runner, _args = _aot_runner()
+        assert fresh.save(KEY, runner) == path
+        assert fresh.load(KEY) is not None
+
+    def test_abi_tag_shape(self):
+        tag = abi_tag()
+        assert set(tag) == {"jax", "jaxlib", "backend"}
+
+
+class TestCacheIntegration:
+    def test_artifact_hit_is_not_a_miss(self, tmp_path):
+        """The cold-join pin's arithmetic: a peer's exported runner
+        loads with misses == 0 and artifact_hits == entries."""
+        runner, _args = _aot_runner()
+        ArtifactStore(str(tmp_path)).save(KEY, runner)
+
+        cold = CompileCache(artifacts=ArtifactStore(str(tmp_path)))
+        fn, was_hit = cold.get_or_build(
+            KEY, builder=lambda: pytest.fail("must not compile")
+        )
+        assert was_hit
+        stats = cold.stats()
+        assert stats["misses"] == 0
+        assert stats["artifact_hits"] == 1
+        assert stats["entries"] == 1
+
+    def test_cold_build_exports_for_the_next_process(self, tmp_path):
+        warm = CompileCache(artifacts=ArtifactStore(str(tmp_path)))
+        runner, _args = _aot_runner()
+        fn, was_hit = warm.get_or_build(KEY, builder=lambda: runner)
+        assert not was_hit
+        assert warm.stats()["artifacts"]["saved"] == 1
+        # second cache = second process: zero compiles
+        cold = CompileCache(artifacts=ArtifactStore(str(tmp_path)))
+        _fn, was_hit = cold.get_or_build(
+            KEY, builder=lambda: pytest.fail("must not compile")
+        )
+        assert was_hit
+        assert cold.stats()["misses"] == 0
+
+    def test_corrupt_artifact_falls_back_to_builder(self, tmp_path):
+        runner, _args = _aot_runner()
+        store = ArtifactStore(str(tmp_path))
+        path = store.save(KEY, runner)
+        corrupt_artifact_file(path)
+        built = []
+        cache = CompileCache(artifacts=ArtifactStore(str(tmp_path)))
+        _fn, was_hit = cache.get_or_build(
+            KEY, builder=lambda: built.append(1) or runner
+        )
+        assert not was_hit and built == [1]
+        assert cache.stats()["artifacts"]["rejected_corrupt"] == 1
+        # the recompile overwrote the damage
+        assert ArtifactStore(str(tmp_path)).load(KEY) is not None

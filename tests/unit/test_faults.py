@@ -122,6 +122,9 @@ class TestFaultKindCatalog:
         "kill_device": {"device": 3},
         "shrink_mesh": {"devices": 4},
         "corrupt_slab": {"operand": "bucket0"},
+        "kill_process": {"replica": 0},
+        "partition_socket": {"replica": 1, "duration": 1.0},
+        "corrupt_artifact": {},
     }
 
     def _docs_section(self):

@@ -130,7 +130,11 @@ class TestFeatures:
         vec = featurize(dcop)
         wall = time.perf_counter() - t0
         assert np.isfinite(vec).all()
-        assert wall < 20.0, f"featurize took {wall:.1f}s on 100k vars"
+        # a shape pass runs ~10s on the slow reference container; any
+        # accidental table materialization is minutes-to-hours.  The
+        # budget needs headroom for suite-tail load on 1-core hosts
+        # (observed 20.0003s under a full tier-1 run), not precision.
+        assert wall < 60.0, f"featurize took {wall:.1f}s on 100k vars"
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,251 @@
+"""Socket journal wire protocol (pydcop_tpu.serve.wire).
+
+The process fleet's journal discipline, pinned at the frame level
+(ISSUE 16 satellite — the edge cases a live fleet only hits under
+chaos):
+
+* **torn frame at the kill point**: a ``kill -9`` mid-send leaves a
+  partial tail frame — held pending, counted on close, never applied;
+* **glued frames**: one recv carrying several frames decodes them all;
+* **CRC skip-and-count**: a corrupt payload skips exactly that frame
+  (the length prefix preserves resync) and the stream continues;
+* **header corruption is fatal for the connection, not the journal**:
+  bad magic / absurd length kill the decoder; the sender's replay
+  machinery re-delivers on reconnect;
+* **replay-from-offset never double-applies**: a completion record
+  whose ack was lost with the connection is either dropped at the
+  reconnect handshake (the hub's applied high-water mark) or deduped
+  by seq — applied exactly once, every interleaving;
+* **partition buffering**: frames sent into a partition buffer client-
+  side and replay on heal — nothing lost, nothing doubled.
+"""
+import socket
+import threading
+import time
+
+import pytest
+
+from pydcop_tpu.serve.wire import (
+    MAGIC,
+    FrameDecoder,
+    JournalClient,
+    JournalHub,
+    encode_frame,
+)
+
+
+class TestFrameDecoder:
+    def test_roundtrip_single_frame(self):
+        d = FrameDecoder()
+        out = d.feed(encode_frame({"a": 1}))
+        assert out == [{"a": 1}]
+        assert d.torn == 0
+
+    def test_glued_frames_decode_all(self):
+        d = FrameDecoder()
+        blob = b"".join(encode_frame({"i": i}) for i in range(5))
+        assert d.feed(blob) == [{"i": i} for i in range(5)]
+
+    def test_partial_tail_waits_then_completes(self):
+        d = FrameDecoder()
+        frame = encode_frame({"x": "y"})
+        assert d.feed(frame[:7]) == []
+        assert d.feed(frame[7:]) == [{"x": "y"}]
+        assert d.torn == 0
+
+    def test_torn_tail_counted_on_close(self):
+        """The kill -9 signature: a send cut short mid-frame."""
+        d = FrameDecoder()
+        frame = encode_frame({"jid": "job-000001", "evt": "complete"})
+        d.feed(frame[: len(frame) - 3])
+        assert d.close() == 1
+        assert d.torn == 1
+
+    def test_crc_mismatch_skips_and_counts_but_resyncs(self):
+        d = FrameDecoder()
+        bad = bytearray(encode_frame({"n": 1}))
+        bad[-1] ^= 0xFF  # corrupt the payload, header intact
+        good = encode_frame({"n": 2})
+        out = d.feed(bytes(bad) + good)
+        assert out == [{"n": 2}]
+        assert d.torn == 1
+        assert not d.dead
+
+    def test_bad_magic_kills_decoder(self):
+        d = FrameDecoder()
+        blob = bytearray(encode_frame({"n": 1}))
+        assert blob[:2] == MAGIC
+        blob[0] ^= 0xFF
+        assert d.feed(bytes(blob)) == []
+        assert d.dead
+        assert d.torn == 1
+
+    def test_absurd_length_kills_decoder(self):
+        import struct
+
+        d = FrameDecoder()
+        header = struct.Struct("<2sII").pack(MAGIC, 1 << 30, 0)
+        d.feed(header)
+        assert d.dead
+
+    def test_non_dict_payload_skipped(self):
+        import json
+        import struct
+        import zlib
+
+        payload = json.dumps([1, 2]).encode()
+        frame = struct.Struct("<2sII").pack(
+            MAGIC, len(payload), zlib.crc32(payload) & 0xFFFFFFFF
+        ) + payload
+        d = FrameDecoder()
+        assert d.feed(frame) == []
+        assert d.torn == 1
+        assert not d.dead
+
+
+class _Pump:
+    """Background hub pump — the role the fleet supervisor plays."""
+
+    def __init__(self, hub):
+        self.hub = hub
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self.hub.pump(0.01)
+
+    def stop(self):
+        self._stop.set()
+        self._t.join(timeout=5)
+
+
+@pytest.fixture
+def hub_records():
+    records = []
+
+    def on_record(client, body):
+        records.append((client, body))
+
+    hub = JournalHub(on_record=on_record)
+    pump = _Pump(hub)
+    yield hub, records
+    pump.stop()
+    hub.stop()
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestHubClient:
+    def test_records_apply_in_order(self, hub_records):
+        hub, records = hub_records
+        cli = JournalClient(("127.0.0.1", hub.port), "r0")
+        assert cli.connect()
+        for i in range(4):
+            cli.send({"n": i})
+        assert _wait(lambda: len(records) == 4)
+        assert [b["n"] for _c, b in records] == [0, 1, 2, 3]
+        cli.close()
+
+    def test_lost_ack_reconnect_never_double_applies(self, hub_records):
+        """THE completion-record pin: the record reaches the hub, the
+        connection dies before the client sees the ack, the client
+        replays on reconnect — applied exactly once."""
+        hub, records = hub_records
+        cli = JournalClient(("127.0.0.1", hub.port), "r0")
+        assert cli.connect()
+        cli.send({"evt": "complete", "jid": "job-000007"})
+        assert _wait(lambda: len(records) == 1)
+        # the ack is in flight but the client never reads it: the
+        # frame is still in its replay buffer when the link dies
+        assert len(cli.ep.unacked) == 1
+        cli._disconnect()
+        assert cli.connect()  # handshake learns hub applied=1
+        cli.send({"evt": "after"})
+        assert _wait(lambda: len(records) == 2)
+        events = [b.get("evt") for _c, b in records]
+        assert events == ["complete", "after"]  # never twice
+        assert _wait(lambda: hub.stats()["connected"] == ["r0"])
+
+    def test_torn_frame_at_kill_point_counted(self, hub_records):
+        """A raw connection killed mid-frame: the hub counts the torn
+        tail and applies nothing from it."""
+        hub, records = hub_records
+        sock = socket.create_connection(("127.0.0.1", hub.port),
+                                        timeout=5)
+        sock.sendall(encode_frame({"hello": {"client": "torn",
+                                             "applied": 0}}))
+        frame = encode_frame({"seq": 1,
+                              "body": {"evt": "complete",
+                                       "jid": "job-000001"}})
+        sock.sendall(frame[: len(frame) - 4])
+        time.sleep(0.1)
+        sock.close()  # the kill point
+        assert _wait(lambda: hub.stats()["torn_frames"] >= 1)
+        assert records == []
+
+    def test_head_to_client_commands_dedupe(self, hub_records):
+        hub, _records = hub_records
+        got = []
+        cli = JournalClient(("127.0.0.1", hub.port), "r0",
+                            on_record=got.append)
+        assert cli.connect()
+        assert _wait(lambda: hub.connected("r0"))
+        hub.send("r0", {"cmd": "submit", "jid": "job-000001"})
+        assert _wait(lambda: bool(cli.pump(0.05) or got))
+        assert got == [{"cmd": "submit", "jid": "job-000001"}]
+        # sever without the hub noticing, reconnect: the hub replays
+        # its unacked suffix, the client's seq dedup drops re-sends
+        cli._disconnect()
+        assert cli.connect()
+        hub.send("r0", {"cmd": "stats"})
+        deadline = time.monotonic() + 5
+        while len(got) < 2 and time.monotonic() < deadline:
+            cli.pump(0.05)
+        assert got == [{"cmd": "submit", "jid": "job-000001"},
+                       {"cmd": "stats"}]
+        cli.close()
+
+    def test_partition_buffers_and_replays_on_heal(self, hub_records):
+        hub, records = hub_records
+        cli = JournalClient(("127.0.0.1", hub.port), "r0",
+                            max_retries=1, backoff_base=0.01)
+        assert cli.connect()
+        cli.send({"n": 0})
+        assert _wait(lambda: len(records) == 1)
+        hub.partition("r0")
+        # sends into the partition buffer client-side (the send may
+        # report a live link once before TCP notices the drop)
+        for i in range(1, 4):
+            cli.send({"n": i})
+            cli.pump(0.01)
+        assert len(records) == 1
+        assert "r0" in hub.stats()["partitioned"]
+        hub.heal_partition("r0")
+        deadline = time.monotonic() + 5
+        while len(records) < 4 and time.monotonic() < deadline:
+            cli.pump(0.02)
+            time.sleep(0.01)
+        assert [b["n"] for _c, b in records] == [0, 1, 2, 3]
+        cli.close()
+
+    def test_bounded_retry_reports_failure(self):
+        # a port nothing listens on: bounded retries, then False
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        cli = JournalClient(("127.0.0.1", port), "r0",
+                            max_retries=2, backoff_base=0.01)
+        t0 = time.monotonic()
+        assert not cli.connect()
+        assert time.monotonic() - t0 < 5
+        assert not cli.connected
