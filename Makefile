@@ -5,7 +5,8 @@
 	serve-smoke chaos-smoke bench-churn churn-smoke bench-dpop \
 	dpop-smoke bench-auto portfolio-smoke bench-fleet fleet-smoke \
 	bench-twin twin-smoke bench-r06 analyze bench-search search-smoke \
-	bench-r08 bench-pfleet pfleet-smoke
+	bench-r08 bench-pfleet pfleet-smoke bench-structured \
+	structured-smoke bench-r09
 
 test: all-tests
 
@@ -93,6 +94,29 @@ search-smoke:
 # one run with a machine-readable BENCH_r08.json snapshot
 bench-r08:
 	python bench.py --only r08 --snapshot BENCH_r08.json
+
+# table-free structured constraints (ISSUE 17): dense-vs-structured
+# byte ratios at table-fitting arity with evaluation/frontier parity
+# pinned, plus the 100-arity end-to-end headline no table path can
+# represent (docs/performance.rst "Table-free constraints",
+# BENCHREF.md "Table-free constraints")
+bench-structured:
+	python bench.py --only structured
+
+# the 100-arity window end-to-end through the CLI in seconds:
+# `generate routing_structured` emits the parameter form (KBs, not a
+# 4^100 table), maxsum runs table-free message kernels, the frontier
+# engine returns a FEASIBLE anytime answer — run it whenever touching
+# pydcop_tpu/dcop/structured.py or ops/structured_kernels.py
+structured-smoke:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/cli/test_structured_cli.py tests/unit/test_structured.py \
+		-q
+
+# the r08 legs + the table-free structured-constraints leg in one run
+# with a machine-readable BENCH_r09.json snapshot (ISSUE 17 satellite)
+bench-r09:
+	python bench.py --only r09 --snapshot BENCH_r09.json
 
 # fast sharded-DPOP smoke: the tiled-vs-single-device parity matrix,
 # pruning property and mini-bucket bound-sandwich tests on the CPU

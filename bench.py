@@ -2763,6 +2763,163 @@ def bench_search_inner(args):
     return headline
 
 
+def bench_structured_subprocess(args):
+    """Table-free structured kernels on the CPU backend, in a
+    subprocess for the same platform-isolation reason as the other
+    forced-CPU legs."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, os.path.abspath(__file__), "--only",
+           "structured-inner", "--repeat", str(args.repeat),
+           "--watchdog", "0"]
+    out = subprocess.run(
+        cmd,
+        capture_output=True, text=True, timeout=900, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+    )
+    lines = out.stdout.strip().splitlines()
+    if not lines:
+        raise RuntimeError(
+            f"structured subprocess produced no output "
+            f"(rc={out.returncode}): " + out.stderr.strip()[-400:]
+        )
+    return json.loads(lines[-1])
+
+
+def _densified_twin(dcop):
+    """Same instance with every structured constraint materialized as
+    its dense table (guarded — only valid at table-fitting arity)."""
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.structured import StructuredConstraint
+
+    out = DCOP(
+        dcop.name + "_dense",
+        objective=dcop.objective,
+        domains=dict(dcop.domains),
+        variables=dict(dcop.variables),
+        agents=dict(dcop.agents),
+    )
+    for c in dcop.constraints.values():
+        out.add_constraint(
+            c.densified() if isinstance(c, StructuredConstraint) else c
+        )
+    return out
+
+
+def bench_structured_inner(args):
+    """Table-free constraints (ISSUE 17): the routing-window family
+    through the structured kernels vs the densified table path at a
+    table-fitting arity (10 at D=4: a 4 MB dense table), parity
+    pinned on maxsum AND the frontier engine; then the headline
+    100-arity instance NO table path can even represent (a 4^100
+    table), solved end-to-end with device bytes linear in arity
+    (BENCHREF.md "Table-free constraints")."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from pydcop_tpu.algorithms import AlgorithmDef
+    from pydcop_tpu.algorithms.base import tensor_const_bytes
+    from pydcop_tpu.algorithms.maxsum import MaxSumSolver
+    from pydcop_tpu.algorithms.maxsum import algo_params as ms_params
+    from pydcop_tpu.dcop.structured import StructuredConstraint
+    from pydcop_tpu.generators import generate_routing_structured
+    from pydcop_tpu.ops.compile import compile_factor_graph
+    from pydcop_tpu.search.solver import FrontierSearchSolver
+
+    algo = AlgorithmDef.build_with_default_params(
+        "maxsum", {}, parameters_definitions=ms_params)
+    out = {}
+
+    # -- parity tier: arity 10, the dense twin still materializable ----
+    K_FIT = 10
+    d = generate_routing_structured(
+        K_FIT, n_slots=4, window=K_FIT, p_soft=0.0, seed=0)
+    dd = _densified_twin(d)
+    ts, td = compile_factor_graph(d), compile_factor_graph(dd)
+    b_s, b_d = tensor_const_bytes(ts), tensor_const_bytes(td)
+    param_bytes = sum(sb.param_bytes() for sb in ts.sbuckets)
+    table_bytes = sum(
+        int(c.dense_entries()) * 4
+        for c in d.constraints.values()
+        if isinstance(c, StructuredConstraint)
+    )
+    out["structured_const_bytes_k10"] = int(b_s)
+    out["structured_dense_const_bytes_k10"] = int(b_d)
+    out["structured_bytes_ratio_k10"] = round(b_d / max(b_s, 1), 1)
+    # per-cycle factor-side traffic: the dense message update re-reads
+    # the whole D^k table, the structured kernel only its parameters
+    out["structured_msg_bytes_per_cycle_k10"] = int(param_bytes)
+    out["structured_dense_msg_bytes_per_cycle_k10"] = int(table_bytes)
+    out["structured_wire_ratio_k10"] = round(
+        table_bytes / max(param_bytes, 1), 1)
+
+    # evaluation parity: the two compilations must agree EXACTLY on
+    # the cost of every assignment (trajectory equality is not a
+    # sound pin here — lowering changes the factor-graph topology)
+    from pydcop_tpu.ops.compile import total_cost
+
+    rng = np.random.default_rng(4)
+    n_vars = len(d.variables)
+    eval_gap = 0.0
+    for _ in range(50):
+        x = rng.integers(0, 4, n_vars)
+        a, b = float(total_cost(ts, x)), float(total_cost(td, x))
+        # relative: hard-violation sums sit at 1e9+ where the f32 ulp
+        # is ~64 and summation order differs between the two paths
+        eval_gap = max(eval_gap, abs(a - b) / max(1.0, abs(a)))
+    out["structured_eval_rel_gap_k10"] = float(eval_gap)
+    out["structured_eval_parity_k10"] = bool(eval_gap <= 1e-6)
+    t0 = time.perf_counter()
+    rs = MaxSumSolver(d, ts, algo, seed=0).run(cycles=20)
+    out["structured_maxsum_wall_s_k10"] = round(
+        time.perf_counter() - t0, 3)
+
+    fs = FrontierSearchSolver(d, frontier_width=128, i_bound=2).run()
+    fd = FrontierSearchSolver(dd, frontier_width=128, i_bound=2).run()
+    out["structured_frontier_cost_k10"] = round(fs.cost, 6)
+    out["structured_frontier_parity_k10"] = bool(
+        fs.search["optimal"] and fd.search["optimal"]
+        and abs(fs.cost - fd.cost) <= 1e-3)
+
+    # -- headline tier: arity 100, table path impossible ---------------
+    K = 100
+    d100 = generate_routing_structured(
+        K, n_slots=4, window=K, p_soft=0.0, seed=0)
+    t100 = compile_factor_graph(d100)
+    out["structured_const_bytes_k100"] = int(tensor_const_bytes(t100))
+    out["structured_dense_bytes_k100"] = max(
+        c.dense_bytes()
+        for c in d100.constraints.values()
+        if isinstance(c, StructuredConstraint)
+    )  # ~6.4e60: the point of the exercise
+
+    t0 = time.perf_counter()
+    ms = MaxSumSolver(d100, t100, algo, seed=0).run(cycles=10)
+    out["structured_maxsum_wall_s_k100"] = round(
+        time.perf_counter() - t0, 3)
+    out["structured_maxsum_assigned_k100"] = len(ms.assignment) == K
+
+    sol = FrontierSearchSolver(d100, frontier_width=256, i_bound=2)
+    out["structured_plan_bytes_k100"] = int(sol.plan.table_bytes)
+    t0 = time.perf_counter()
+    res = sol.run(cycles=5)
+    out["structured_frontier_wall_s_k100"] = round(
+        time.perf_counter() - t0, 3)
+    out["structured_frontier_feasible_k100"] = res.violation == 0
+    out["structured_frontier_cost_k100"] = round(res.cost, 6)
+
+    headline = {
+        "metric": "structured_wire_ratio_k10",
+        "value": out["structured_wire_ratio_k10"],
+        "unit": "x (dense table bytes / structured param bytes "
+                "per message cycle)",
+        "vs_baseline": 0.0,
+        "extra": out,
+    }
+    print(json.dumps(headline), flush=True)
+    return headline
+
+
 def bench_sharded_subprocess(args):
     """ShardedMaxSum on a virtual 8-device CPU mesh, in a subprocess so
     the forced-CPU platform doesn't poison this process's TPU backend."""
@@ -3313,7 +3470,8 @@ def main():
                  "probe", "batch", "harness", "serve", "fleet",
                  "pfleet", "churn",
                  "auto", "twin", "elastic", "elastic-inner", "search",
-                 "search-inner", "r06", "r07", "r08"],
+                 "search-inner", "structured", "structured-inner",
+                 "r06", "r07", "r08", "r09"],
         default="all",
     )
     # watchdog covers the FULL run: the wholesweep DPOP kernel compile
@@ -3324,6 +3482,49 @@ def main():
     args = ap.parse_args()
     if args.cycles is None:
         args.cycles = 50 if args.stretch else 2000
+
+    if args.only == "r09":
+        # consolidated r09 record (ISSUE 17 satellite): the r08 legs
+        # plus the table-free structured-constraints leg, EACH in a
+        # fresh subprocess (same isolation rationale as r06 below)
+        legs = ("serve", "churn", "dpop-sharded", "auto", "fleet",
+                "pfleet", "twin", "elastic", "search", "structured")
+        fwd = []
+        skip_next = False
+        for a in sys.argv[1:]:
+            if skip_next:
+                skip_next = False
+                continue
+            if a in ("--only", "--snapshot"):
+                skip_next = True
+                continue
+            if a.startswith(("--only=", "--snapshot=")):
+                continue
+            fwd.append(a)
+        extra = {}
+        for leg in legs:
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--only", leg] + fwd
+            try:
+                r = subprocess.run(
+                    cmd, capture_output=True, text=True, timeout=3000,
+                )
+                parsed = json.loads(
+                    r.stdout.strip().splitlines()[-1]
+                )
+                extra.update(parsed.get("extra", {}))
+            except Exception as e:
+                extra[f"{leg}_error"] = repr(e)[:500]
+        out = {
+            "metric": "r09_consolidated",
+            "value": extra.get("structured_wire_ratio_k10", 0.0),
+            "unit": "x (dense/structured bytes per message cycle)",
+            "vs_baseline": 0.0,
+            "extra": extra,
+        }
+        _maybe_snapshot(args, out)
+        print(json.dumps(out), flush=True)
+        return
 
     if args.only == "r08":
         # consolidated r08 record (ISSUE 15 satellite; the process-
@@ -3472,6 +3673,10 @@ def main():
 
     if args.only == "dpop-sharded-inner":
         bench_dpop_sharded_inner(args)
+        return
+
+    if args.only == "structured-inner":
+        bench_structured_inner(args)
         return
 
     if args.only == "search-inner":
@@ -3728,6 +3933,28 @@ def main():
         if args.only == "search":
             out = se if se is not None else {
                 "metric": "search_error", "value": 0.0, "unit": "",
+                "vs_baseline": 0.0, "extra": extra,
+            }
+            if watchdog:
+                watchdog.cancel()
+            _maybe_snapshot(args, out)
+            print(json.dumps(out), flush=True)
+            return
+
+    if args.only in ("all", "structured"):
+        # table-free structured constraints (ISSUE 17): dense-vs-
+        # structured byte ratios at table-fitting arity with parity
+        # pinned, plus the 100-arity end-to-end headline (BENCHREF.md
+        # "Table-free constraints")
+        st = None
+        try:
+            st = bench_structured_subprocess(args)
+            extra.update(st.get("extra", {}))
+        except Exception as e:
+            extra["structured_error"] = repr(e)
+        if args.only == "structured":
+            out = st if st is not None else {
+                "metric": "structured_error", "value": 0.0, "unit": "",
                 "vs_baseline": 0.0, "extra": extra,
             }
             if watchdog:

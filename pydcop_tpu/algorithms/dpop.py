@@ -79,6 +79,21 @@ class DpopSolver:
 
     def __init__(self, dcop: DCOP, tree: Optional[ComputationPseudoTree] =
                  None, algo_def: Optional[AlgorithmDef] = None, seed: int = 0):
+        from pydcop_tpu.dcop.structured import (
+            has_structured,
+            lower_structured_for_inference,
+        )
+
+        if has_structured(dcop):
+            # symbolic projection of separable (linear) factors: they
+            # become per-variable unaries BEFORE the pseudo-tree is
+            # built, so UTIL joins never see the high-arity scope.
+            # Non-separable (cardinality) primitives stay structured;
+            # small ones densify through the guard below, over-budget
+            # ones route to the frontier rung.  A caller-supplied tree
+            # describes the un-lowered graph — rebuild.
+            dcop = lower_structured_for_inference(dcop)
+            tree = None
         self.dcop = dcop
         self.mode = dcop.objective
         self.tree = tree or pt_module.build_computation_graph(dcop)
@@ -166,12 +181,33 @@ class DpopSolver:
         )
 
         log = logging.getLogger("pydcop_tpu.dpop")
+        if self.engine == "frontier":
+            return self._run_frontier(forced=True)
+        # structured constraints that survive lowering (cardinality
+        # primitives) above the table cap can NEVER densify — the only
+        # exact engine for them is the table-free frontier search
+        from pydcop_tpu.dcop.structured import StructuredConstraint
+
+        over_structured = [
+            c.dense_entries()
+            for c in self.dcop.constraints.values()
+            if isinstance(c, StructuredConstraint)
+            and c.dense_entries() > self.max_table_entries
+        ]
+        if over_structured:
+            if self.engine == "auto":
+                res = self._run_frontier(forced=True)
+                if res is not None:
+                    return res
+            raise UtilTableTooLarge(
+                estimated_bytes=int(min(4.0 * max(over_structured),
+                                        float(2**62))),
+                budget_bytes=self.budget_bytes,
+            )
         if self.engine == "minibucket":
             return self._run_minibucket()
         if self.engine == "sharded":
             return self._run_sharded()
-        if self.engine == "frontier":
-            return self._run_frontier(forced=True)
         if self.engine == "auto" and self.budget_bytes is not None:
             est = estimate_sweep_bytes(self.tree)
             if est["bytes"] > self.budget_bytes:

@@ -103,6 +103,10 @@ class MaxSumSolver(SynchronousTensorSolver):
         self.packed = None
         if use_packed is None:
             use_packed = jax.default_backend() == "tpu"
+        # table-free (structured) buckets run through the generic bucket
+        # loop only: the packed/edge-slab engines assume all-binary tables
+        if getattr(self.tensors, "sbuckets", None):
+            use_packed = False
         if use_packed:
             from pydcop_tpu.ops.pallas_maxsum import try_pack_for_pallas
 
@@ -113,6 +117,7 @@ class MaxSumSolver(SynchronousTensorSolver):
         # form is bit-identical and compiles in seconds at any size
         self.eslabs = None
         if (self.packed is None
+                and not getattr(self.tensors, "sbuckets", None)
                 and self.tensors.n_edges >= 1_000_000
                 and len(self.tensors.buckets) == 1
                 and self.tensors.buckets[0].arity == 2):

@@ -44,6 +44,7 @@ algo_params = [
     AlgoParameterDef("search_chunk", "int", None, 0),
     AlgoParameterDef("i_bound", "int", None, 0),
     AlgoParameterDef("budget_mb", "float", None, 0.0),
+    AlgoParameterDef("seed_incumbent", "bool", None, True),
 ]
 
 

@@ -45,7 +45,9 @@ AUTO_FRONTIER_MIN_VARS = 16
 # ``ring`` the device spill buffer (0 = 8*B), ``search_chunk`` the
 # expand steps per device chunk (0 = 8), ``i_bound`` the mini-bucket
 # bound-table width (0 = auto from budget_mb; >= induced width =
-# DPOP-exact bounds), ``budget_mb`` the bound-table byte budget.
+# DPOP-exact bounds), ``budget_mb`` the bound-table byte budget,
+# ``seed_incumbent`` toggles the beam-dive incumbent seeding of a
+# fresh frontier run (a real leaf before the first chunk).
 algo_params = [
     AlgoParameterDef("engine", "str", ["host", "frontier", "auto"],
                      "host"),
@@ -54,6 +56,7 @@ algo_params = [
     AlgoParameterDef("search_chunk", "int", None, 0),
     AlgoParameterDef("i_bound", "int", None, 0),
     AlgoParameterDef("budget_mb", "float", None, 0.0),
+    AlgoParameterDef("seed_incumbent", "bool", None, True),
 ]
 
 

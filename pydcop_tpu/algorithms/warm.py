@@ -105,6 +105,12 @@ class _WarmMixin:
         t.edge_var = ops["edge_var"]
         for b, tt in zip(t.buckets, ops["tensors"]):
             b.tensors = tt
+        for sb, leaves in zip(getattr(t, "sbuckets", None) or [],
+                              ops.get("s_costs", ())):
+            if sb.kind == "linear":
+                sb.rows, sb.bias = leaves
+            else:
+                (sb.count_cost,) = leaves
 
     def _fresh_row_values(self, ops: Dict, slots: Sequence[int],
                           values: jnp.ndarray) -> jnp.ndarray:
@@ -406,6 +412,21 @@ def repack_solver(old, headroom: Optional[float] = None,
                     a = old_lay.arities[b]
                     olo = old.tensors.buckets[b].edge_offset + k * a
                     nlo = new.tensors.buckets[nb].edge_offset + nk * a
+                    q[nlo:nlo + a] = oq[olo:olo + a]
+                    r[nlo:nlo + a] = orr[olo:olo + a]
+            # structured primitives keep their scopes across a repack:
+            # carry their edge messages by primitive name
+            new_slots = {
+                n: (sb.edge_offset + k * sb.arity, sb.arity)
+                for sb in getattr(new.tensors, "sbuckets", None) or []
+                for k, n in enumerate(sb.names)
+            }
+            for sb in getattr(old.tensors, "sbuckets", None) or []:
+                for k, n in enumerate(sb.names):
+                    if n not in new_slots:
+                        continue
+                    nlo, a = new_slots[n]
+                    olo = sb.edge_offset + k * sb.arity
                     q[nlo:nlo + a] = oq[olo:olo + a]
                     r[nlo:nlo + a] = orr[olo:olo + a]
         new_state = (jnp.asarray(q), jnp.asarray(r),

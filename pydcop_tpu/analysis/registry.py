@@ -527,3 +527,98 @@ def _search_step_cell() -> AuditedProgram:
 
 register_cell("search/frontier/chunk")(_search_chunk_cell)
 register_cell("search/frontier/expand-step")(_search_step_cell)
+
+
+# ---------------------------------------------------------------------------
+# table-free (structured) cells
+
+
+@functools.lru_cache(maxsize=None)
+def _structured_dcop(V=12, D=4, seed=7):
+    """One arity-V resource rule over a ring of dense binaries.  The
+    resource rule's dense twin would hold D**V entries (~64 MB at the
+    default shape) — three orders of magnitude over the cells' constant
+    caps — so the audits below FAIL if any consumer quietly densifies a
+    structured constraint back into a table."""
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+    from pydcop_tpu.dcop.structured import ResourceConstraint
+
+    rng = np.random.default_rng(seed)
+    d = DCOP("structured", "min")
+    dom = Domain("slots", "slot", list(range(D)))
+    vs = [Variable(f"v{i:03d}", dom) for i in range(V)]
+    pref = rng.uniform(0, 10, (V, D))
+    cc = np.tile(
+        (np.maximum(0.0, np.arange(V + 1) - 4) * 25.0)[None, :], (D, 1)
+    )
+    d.add_constraint(
+        ResourceConstraint("win", vs, pref, list(range(D)), cc)
+    )
+    for i in range(V):
+        m = rng.uniform(0, 1, (D, D))
+        d.add_constraint(NAryMatrixRelation(
+            [vs[i], vs[(i + 1) % V]], m, name=f"e{i}"))
+    d.add_agents([AgentDef(f"a{i}") for i in range(2)])
+    return d
+
+
+def _structured_maxsum_cell() -> AuditedProgram:
+    """Harness maxsum over a structured instance: the closed-form
+    message kernels (ops/structured_kernels.py) keep the baked constants
+    at the O(k·D) parameter arrays — the declared cap admits NO D^arity
+    buffer (tensor_const_bytes walks the structured buckets' parameter
+    leaves; a densifying regression blows the cap by ~1000×)."""
+    import jax
+
+    from pydcop_tpu.algorithms import load_algorithm_module
+
+    solver = load_algorithm_module("maxsum").build_solver(
+        _structured_dcop(), seed=0
+    )
+    chunk = 4
+    runner = solver._masked_chunk_runner(chunk, collect=False)
+    state = solver.initial_state()
+    keys = jax.random.split(jax.random.PRNGKey(0), chunk)
+    args = (state, keys, chunk)
+    return AuditedProgram(
+        name="single/maxsum/structured",
+        fn=runner,
+        args=args,
+        budget=solver.program_budget(),
+        lower=lambda: runner.lower(*args).as_text(),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _structured_search_engine():
+    from pydcop_tpu.search.frontier import FrontierEngine
+    from pydcop_tpu.search.plan import compile_search_plan
+
+    plan = compile_search_plan(_structured_dcop(), i_bound=2)
+    return FrontierEngine(plan, frontier_width=16, ring=64, steps=4)
+
+
+def _structured_search_cell() -> AuditedProgram:
+    """Frontier chunk runner over a structured instance: the cardinality
+    rule rides as per-depth increment entries (plan.s_* arrays, O(k²)
+    ints/floats), never as a table — same zero-collective/zero-callback
+    contract as search/frontier/chunk with the constant cap set by the
+    TABLE-FREE plan bytes."""
+    eng = _structured_search_engine()
+    runner = eng.chunk_runner()
+    args = (eng.initial_state(),)
+    return AuditedProgram(
+        name="search/frontier/structured-chunk",
+        fn=runner,
+        args=args,
+        budget=eng.program_budget(),
+        lower=lambda: runner.lower(*args).as_text(),
+    )
+
+
+register_cell("single/maxsum/structured")(_structured_maxsum_cell)
+register_cell("search/frontier/structured-chunk")(
+    _structured_search_cell
+)

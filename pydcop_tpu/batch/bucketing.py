@@ -94,6 +94,11 @@ def bucket_signature(target: InstanceDims, batch_size: int) -> Tuple:
 def dims_of(tensors, graph_type: str) -> InstanceDims:
     """Shape signature of a compiled tensor graph
     (ops.compile.GraphTensorsBase subclass)."""
+    if getattr(tensors, "sbuckets", None):
+        raise NotImplementedError(
+            "batched lanes do not yet pad table-free (structured) buckets; "
+            "solve structured instances on a dedicated lane"
+        )
     arities = tuple(b.arity for b in tensors.buckets)
     fs = tuple(b.n_factors for b in tensors.buckets)
     m = 0

@@ -134,6 +134,25 @@ def set_parser(subparsers):
     p.add_argument("--capacity", type=float, default=100)
     p.add_argument("--seed", type=int, default=0)
 
+    # table-free routing (ISSUE 17): the same window family emitted
+    # as STRUCTURED resource constraints — arity-100 windows dump as
+    # a few KB of parameters where the dense form would be a 4^100
+    # table (docs/performance.rst "Table-free constraints")
+    p = gen_sub.add_parser("routing_structured")
+    p.set_defaults(func=_routing_structured)
+    p.add_argument("--tasks_count", "-V", type=int, required=True)
+    p.add_argument("--slots_count", type=int, default=4)
+    p.add_argument("--window", type=int, default=None,
+                   help="tasks per resource window (default "
+                   "slots_count; window == tasks_count gives one "
+                   "full-arity constraint)")
+    p.add_argument("--slot_capacity", type=int, default=None)
+    p.add_argument("--p_soft", type=float, default=0.15)
+    p.add_argument("--infeasible", action="store_true")
+    p.add_argument("--agents_count", type=int, default=None)
+    p.add_argument("--capacity", type=float, default=100)
+    p.add_argument("--seed", type=int, default=0)
+
     # moving-target tracking (ISSUE 12): the classic dynamic-DCOP
     # benchmark; --steps also emits the target walk's change_factor
     # scenario next to the DCOP (docs/scenarios.rst)
@@ -366,6 +385,24 @@ def _routing(args):
         n_tasks=args.tasks_count,
         n_slots=args.slots_count,
         tasks_per_resource=args.tasks_per_resource,
+        p_soft=args.p_soft,
+        infeasible=args.infeasible,
+        n_agents=args.agents_count,
+        capacity=args.capacity,
+        seed=args.seed,
+    )
+    return _write(args, dcop_yaml(dcop))
+
+
+def _routing_structured(args):
+    from pydcop_tpu.dcop import dcop_yaml
+    from pydcop_tpu.generators import generate_routing_structured
+
+    dcop = generate_routing_structured(
+        n_tasks=args.tasks_count,
+        n_slots=args.slots_count,
+        window=args.window,
+        slot_capacity=args.slot_capacity,
         p_soft=args.p_soft,
         infeasible=args.infeasible,
         n_agents=args.agents_count,

@@ -1,0 +1,573 @@
+"""Structured (table-free) constraints: compile *structure*, not D^arity.
+
+Every engine in this repo historically consumed dense cost tables
+(:meth:`Constraint.to_tensor`), so device memory and collective bytes scale
+as ``D^arity`` and high-arity families (routing window/resource rules,
+AllDiff, meeting scheduling) were capped at small arity.  This module is the
+constraint IR that removes that cap: each structured class carries a few
+small parameter arrays and compiles to closed-form batched kernels
+(:mod:`pydcop_tpu.ops.structured_kernels`) — cost-at-assignment,
+per-variable min-marginal / message updates, and per-depth increment/bound
+forms for the frontier engine — with peak memory *independent of arity*.
+
+The IR is deliberately tiny.  Two **primitive** classes cover everything the
+generators emit, and richer classes :meth:`~StructuredConstraint.lower` onto
+them exactly (no approximation):
+
+* :class:`LinearConstraint` — separable cost
+  ``bias + sum_p tables[p][x_p]``.  Fully factorizes: maxsum messages are
+  O(k·D), DPOP projection is symbolic (per-variable unaries), frontier
+  increments fold into the plan's unary slabs.
+* :class:`CardinalityConstraint` — cost is a function of *how many* scope
+  variables take a designated value: ``count_cost[#{p : x_p == value}]``.
+  Covers capacity caps, mutual exclusion and AllDiff (via one primitive per
+  value).  Messages use an exact O(k log k + k·D) sorted-delta update.
+* :class:`ResourceConstraint` — the PR 12 routing family: per-position
+  preference rows plus per-value capacity curves.  Lowers to one
+  LinearConstraint + one CardinalityConstraint per counted value.
+
+Exactness tiers (PR 5 style): cost-at-assignment and frontier increments
+are **exact** vs the densified table (same float32 adds in a fixed order);
+message/min-marginal kernels are **ulp-tier** (identical math, different
+float32 summation order than the table reduction — parity pinned to rtol in
+``tests/unit/test_structured.py``).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pydcop_tpu.dcop.objects import Variable
+from pydcop_tpu.dcop.relations import (
+    Constraint,
+    NAryMatrixRelation,
+    DEFAULT_TYPE,
+)
+from pydcop_tpu.utils.serialization import REPR_MODULE, REPR_QUALNAME, simple_repr, from_repr
+
+#: Refuse to densify a structured constraint above this many table entries
+#: (2**22 entries = 16 MiB float32).  Anything larger must stay table-free;
+#: hitting this limit on a hot path is a bug, not a fallback.
+MAX_DENSIFY_ENTRIES = 1 << 22
+
+
+class DensifyError(ValueError):
+    """A structured constraint was asked to materialize an over-budget table."""
+
+
+class StructuredConstraint(Constraint):
+    """Base for table-free constraints.
+
+    Subclasses declare ``kind`` and implement :meth:`params`,
+    :meth:`lower`, and :meth:`_value`.  ``to_tensor`` stays available for
+    parity tests and small-arity fallbacks but is guarded by
+    :data:`MAX_DENSIFY_ENTRIES` so no engine can silently densify a
+    100-arity factor.
+    """
+
+    kind: str = "structured"
+
+    def dense_entries(self) -> int:
+        """Number of entries a densified table would hold (may be huge)."""
+        n = 1
+        for v in self._variables:
+            n *= len(v.domain)
+        return n
+
+    def dense_bytes(self) -> float:
+        """Bytes the densified float32 table would take (as a float — the
+        whole point is that this can exceed 2**63)."""
+        b = 4.0
+        for v in self._variables:
+            b *= len(v.domain)
+        return b
+
+    def params(self) -> Dict[str, Any]:
+        """JSON/YAML-safe parameter dict (plain python lists/floats)."""
+        raise NotImplementedError
+
+    def lower(self) -> List["StructuredConstraint"]:
+        """Exact decomposition into primitives (Linear / Cardinality).
+
+        ``sum(p(x) for p in c.lower()) == c(x)`` for every assignment.
+        """
+        raise NotImplementedError
+
+    def to_tensor(self) -> np.ndarray:
+        if self.dense_entries() > MAX_DENSIFY_ENTRIES:
+            raise DensifyError(
+                f"constraint {self.name!r} (kind={self.kind}, arity="
+                f"{self.arity}) would densify to {self.dense_entries()} "
+                f"entries > MAX_DENSIFY_ENTRIES={MAX_DENSIFY_ENTRIES}; "
+                "use the structured kernels instead"
+            )
+        return super().to_tensor()
+
+    def densified(self) -> NAryMatrixRelation:
+        """Guarded dense twin, for parity tests and small-arity fallbacks."""
+        return NAryMatrixRelation(self.dimensions, self.to_tensor(), self.name)
+
+
+class LinearConstraint(StructuredConstraint):
+    """Separable cost: ``bias + sum_p tables[p][index(x_p)]``.
+
+    ``tables[p]`` is a 1-D cost row over ``variables[p]``'s domain (indexed
+    in domain order).  Parameters are stored float64 so YAML round-trips are
+    value-exact; kernels cast to float32 at compile time.
+    """
+
+    kind = "linear"
+
+    def __init__(
+        self,
+        name: str,
+        variables: Sequence[Variable],
+        tables: Sequence[Sequence[float]],
+        bias: float = 0.0,
+    ):
+        super().__init__(name, variables)
+        if len(tables) != len(self._variables):
+            raise ValueError(
+                f"{name}: {len(tables)} cost rows for {len(self._variables)} variables"
+            )
+        self._tables = [np.asarray(t, dtype=np.float64) for t in tables]
+        for v, t in zip(self._variables, self._tables):
+            if t.shape != (len(v.domain),):
+                raise ValueError(
+                    f"{name}: cost row for {v.name} has shape {t.shape}, "
+                    f"domain size {len(v.domain)}"
+                )
+        self._bias = float(bias)
+
+    @property
+    def tables(self) -> List[np.ndarray]:
+        return list(self._tables)
+
+    @property
+    def bias(self) -> float:
+        return self._bias
+
+    def _value(self, assignment: Dict) -> float:
+        total = self._bias
+        for v, t in zip(self._variables, self._tables):
+            total += float(
+                np.float32(t[v.domain.index(assignment[v.name])])
+            )
+        return total
+
+    def lower(self) -> List["StructuredConstraint"]:
+        return [self]
+
+    def slice(self, partial_assignment: Dict[str, Any]) -> Constraint:
+        fixed = {k: v for k, v in partial_assignment.items()
+                 if k in self.scope_names}
+        if not fixed:
+            return self
+        bias = self._bias
+        keep_vars: List[Variable] = []
+        keep_tables: List[np.ndarray] = []
+        for v, t in zip(self._variables, self._tables):
+            if v.name in fixed:
+                bias += float(t[v.domain.index(fixed[v.name])])
+            else:
+                keep_vars.append(v)
+                keep_tables.append(t)
+        return LinearConstraint(self._name, keep_vars, keep_tables, bias)
+
+    def params(self) -> Dict[str, Any]:
+        return {
+            "class": "linear",
+            "tables": [[float(x) for x in t] for t in self._tables],
+            "bias": float(self._bias),
+        }
+
+    def _simple_repr(self):
+        return {
+            REPR_MODULE: type(self).__module__,
+            REPR_QUALNAME: type(self).__qualname__,
+            "name": self._name,
+            "variables": simple_repr(self._variables),
+            "tables": [[float(x) for x in t] for t in self._tables],
+            "bias": float(self._bias),
+        }
+
+    @classmethod
+    def _from_repr(cls, r):
+        return cls(r["name"], from_repr(r["variables"]), r["tables"], r["bias"])
+
+
+class CardinalityConstraint(StructuredConstraint):
+    """Cost depends only on how many scope variables equal ``value``:
+    ``count_cost[#{p : x_p == value}]`` with ``len(count_cost) == arity+1``.
+
+    Covers capacity caps (``penalty * max(0, c - cap)``), mutual exclusion
+    (``0, 0, BIG, BIG, ...``) and, summed over values, AllDiff.
+    """
+
+    kind = "cardinality"
+
+    def __init__(
+        self,
+        name: str,
+        variables: Sequence[Variable],
+        value: Any,
+        count_cost: Sequence[float],
+    ):
+        super().__init__(name, variables)
+        self._counted = value
+        self._count_cost = np.asarray(count_cost, dtype=np.float64)
+        k = len(self._variables)
+        if self._count_cost.shape != (k + 1,):
+            raise ValueError(
+                f"{name}: count_cost must have arity+1={k + 1} entries, "
+                f"got shape {self._count_cost.shape}"
+            )
+
+    @property
+    def counted_value(self) -> Any:
+        return self._counted
+
+    @property
+    def count_cost(self) -> np.ndarray:
+        return self._count_cost
+
+    def counted_indices(self) -> np.ndarray:
+        """Per-position domain index of the counted value (-1 if absent)."""
+        out = np.empty(len(self._variables), dtype=np.int32)
+        for p, v in enumerate(self._variables):
+            vals = list(v.domain)
+            out[p] = vals.index(self._counted) if self._counted in vals else -1
+        return out
+
+    def _count(self, assignment: Dict) -> int:
+        return sum(
+            1 for v in self._variables if assignment[v.name] == self._counted
+        )
+
+    def _value(self, assignment: Dict) -> float:
+        return float(np.float32(self._count_cost[self._count(assignment)]))
+
+    def lower(self) -> List["StructuredConstraint"]:
+        return [self]
+
+    def slice(self, partial_assignment: Dict[str, Any]) -> Constraint:
+        fixed = {k: v for k, v in partial_assignment.items()
+                 if k in self.scope_names}
+        if not fixed:
+            return self
+        base = sum(1 for n, val in fixed.items() if val == self._counted)
+        remaining = [v for v in self._variables if v.name not in fixed]
+        cc = self._count_cost[base:base + len(remaining) + 1]
+        return CardinalityConstraint(self._name, remaining, self._counted, cc)
+
+    def min_remaining_delta(self) -> float:
+        """``min_{c' >= c} count_cost[c'] - count_cost[c]`` over all c.
+
+        An admissible per-factor lower bound on the cost still to come once
+        some prefix of the scope is assigned; 0 for monotone
+        (nondecreasing) curves, possibly negative otherwise (max mode).
+        """
+        cc = self._count_cost.astype(np.float64)
+        suffix_min = np.minimum.accumulate(cc[::-1])[::-1]
+        return float(np.min(suffix_min - cc))
+
+    def params(self) -> Dict[str, Any]:
+        return {
+            "class": "cardinality",
+            "value": self._counted,
+            "count_cost": [float(x) for x in self._count_cost],
+        }
+
+    def _simple_repr(self):
+        return {
+            REPR_MODULE: type(self).__module__,
+            REPR_QUALNAME: type(self).__qualname__,
+            "name": self._name,
+            "variables": simple_repr(self._variables),
+            "value": self._counted,
+            "count_cost": [float(x) for x in self._count_cost],
+        }
+
+    @classmethod
+    def _from_repr(cls, r):
+        return cls(r["name"], from_repr(r["variables"]), r["value"],
+                   r["count_cost"])
+
+
+class ResourceConstraint(StructuredConstraint):
+    """Window/resource rule (the PR 12 routing family):
+
+    ``cost(x) = sum_p pref[p][x_p] + sum_v count_cost[v][#{p : x_p == values[v]}]``
+
+    i.e. per-task slot preferences plus a per-slot capacity curve.  Lowers
+    exactly to one :class:`LinearConstraint` (the preference part) plus one
+    :class:`CardinalityConstraint` per counted value with a non-trivial
+    curve.
+    """
+
+    kind = "resource"
+
+    def __init__(
+        self,
+        name: str,
+        variables: Sequence[Variable],
+        pref: Sequence[Sequence[float]],
+        values: Sequence[Any],
+        count_cost: Sequence[Sequence[float]],
+    ):
+        super().__init__(name, variables)
+        k = len(self._variables)
+        self._pref = [np.asarray(t, dtype=np.float64) for t in pref]
+        if len(self._pref) != k:
+            raise ValueError(f"{name}: {len(self._pref)} pref rows for {k} variables")
+        for v, t in zip(self._variables, self._pref):
+            if t.shape != (len(v.domain),):
+                raise ValueError(
+                    f"{name}: pref row for {v.name} has shape {t.shape}, "
+                    f"domain size {len(v.domain)}"
+                )
+        self._values = list(values)
+        self._count_cost = np.asarray(count_cost, dtype=np.float64)
+        if self._count_cost.shape != (len(self._values), k + 1):
+            raise ValueError(
+                f"{name}: count_cost must be [n_values={len(self._values)}, "
+                f"arity+1={k + 1}], got {self._count_cost.shape}"
+            )
+
+    @property
+    def pref(self) -> List[np.ndarray]:
+        return list(self._pref)
+
+    @property
+    def values(self) -> List[Any]:
+        return list(self._values)
+
+    @property
+    def count_cost(self) -> np.ndarray:
+        return self._count_cost
+
+    @classmethod
+    def all_different(
+        cls, name: str, variables: Sequence[Variable], penalty: float = 1.0
+    ) -> "ResourceConstraint":
+        """Soft AllDiff: ``penalty`` per clashing pair.  The count curve
+        ``penalty * c*(c-1)/2`` per value sums to exactly the number of
+        equal pairs, so this matches the pairwise formulation bit-for-bit
+        in float64 parameter space."""
+        vals: List[Any] = []
+        for v in variables:
+            for d in v.domain:
+                if d not in vals:
+                    vals.append(d)
+        k = len(variables)
+        counts = np.arange(k + 1, dtype=np.float64)
+        curve = penalty * counts * (counts - 1.0) / 2.0
+        pref = [np.zeros(len(v.domain)) for v in variables]
+        cc = np.tile(curve, (len(vals), 1))
+        return cls(name, variables, pref, vals, cc)
+
+    def _value(self, assignment: Dict) -> float:
+        total = 0.0
+        for v, t in zip(self._variables, self._pref):
+            total += float(np.float32(t[v.domain.index(assignment[v.name])]))
+        for vi, val in enumerate(self._values):
+            c = sum(1 for v in self._variables if assignment[v.name] == val)
+            total += float(np.float32(self._count_cost[vi][c]))
+        return total
+
+    def lower(self) -> List[StructuredConstraint]:
+        out: List[StructuredConstraint] = []
+        if any(np.any(t != 0.0) for t in self._pref):
+            out.append(
+                LinearConstraint(
+                    f"{self._name}__lin", self._variables, self._pref
+                )
+            )
+        for vi, val in enumerate(self._values):
+            row = self._count_cost[vi]
+            if np.all(row == row[0]):
+                # Constant curve contributes row[0] regardless of count;
+                # nonzero constants are kept so total cost stays exact.
+                if row[0] == 0.0:
+                    continue
+            out.append(
+                CardinalityConstraint(
+                    f"{self._name}__c{vi}", self._variables, val, row
+                )
+            )
+        if not out:
+            out.append(
+                LinearConstraint(f"{self._name}__lin", self._variables,
+                                 self._pref)
+            )
+        return out
+
+    def slice(self, partial_assignment: Dict[str, Any]) -> Constraint:
+        fixed = {k: v for k, v in partial_assignment.items()
+                 if k in self.scope_names}
+        if not fixed:
+            return self
+        keep_vars: List[Variable] = []
+        keep_pref: List[np.ndarray] = []
+        bias = 0.0
+        for v, t in zip(self._variables, self._pref):
+            if v.name in fixed:
+                bias += float(t[v.domain.index(fixed[v.name])])
+            else:
+                keep_vars.append(v)
+                keep_pref.append(t)
+        n_keep = len(keep_vars)
+        cc = np.empty((len(self._values), n_keep + 1), dtype=np.float64)
+        for vi, val in enumerate(self._values):
+            base = sum(1 for n, fv in fixed.items() if fv == val)
+            cc[vi] = self._count_cost[vi][base:base + n_keep + 1]
+        sliced = ResourceConstraint(self._name, keep_vars, keep_pref,
+                                    self._values, cc)
+        if bias:
+            # Fold the fixed positions' preference cost into the first
+            # remaining pref row (exact: added once per assignment).
+            if keep_pref:
+                sliced._pref[0] = sliced._pref[0] + bias
+            else:
+                sliced = LinearConstraint(self._name, [], [], bias)  # type: ignore
+        return sliced
+
+    def params(self) -> Dict[str, Any]:
+        return {
+            "class": "resource",
+            "pref": [[float(x) for x in t] for t in self._pref],
+            "values": list(self._values),
+            "count_cost": [[float(x) for x in row] for row in self._count_cost],
+        }
+
+    def _simple_repr(self):
+        return {
+            REPR_MODULE: type(self).__module__,
+            REPR_QUALNAME: type(self).__qualname__,
+            "name": self._name,
+            "variables": simple_repr(self._variables),
+            "pref": [[float(x) for x in t] for t in self._pref],
+            "values": list(self._values),
+            "count_cost": [[float(x) for x in row] for row in self._count_cost],
+        }
+
+    @classmethod
+    def _from_repr(cls, r):
+        return cls(r["name"], from_repr(r["variables"]), r["pref"],
+                   r["values"], r["count_cost"])
+
+
+#: name → class, for YAML loading (`type: structured` blocks).
+STRUCTURED_CLASSES: Dict[str, type] = {
+    "linear": LinearConstraint,
+    "cardinality": CardinalityConstraint,
+    "resource": ResourceConstraint,
+}
+
+
+def structured_from_params(
+    name: str, variables: Sequence[Variable], params: Dict[str, Any]
+) -> StructuredConstraint:
+    """Rebuild a structured constraint from its :meth:`params` dict."""
+    cls_name = params.get("class")
+    if cls_name == "linear":
+        return LinearConstraint(name, variables, params["tables"],
+                                params.get("bias", 0.0))
+    if cls_name == "cardinality":
+        return CardinalityConstraint(name, variables, params["value"],
+                                     params["count_cost"])
+    if cls_name == "resource":
+        return ResourceConstraint(name, variables, params["pref"],
+                                  params["values"], params["count_cost"])
+    raise ValueError(f"unknown structured constraint class {cls_name!r}")
+
+
+def detect_structure(
+    c: Constraint, max_entries: int = 4096
+) -> Optional[StructuredConstraint]:
+    """Try to recover structure from an opaque constraint.
+
+    Currently detects exact separability (→ :class:`LinearConstraint`) by
+    densifying small constraints and checking the rank-1-in-cost-space
+    decomposition reconstructs the table exactly.  Covers the seed model's
+    ``ExpressionFunction`` sums like ``"x1 + 2*x2 - x3"``.  Returns None if
+    no structure is found or the constraint is too large to check.
+    """
+    if isinstance(c, StructuredConstraint):
+        return c
+    shape = c.shape
+    if not shape or int(np.prod(shape)) > max_entries:
+        return None
+    t = np.asarray(c.to_tensor(), dtype=np.float64)
+    if not np.all(np.isfinite(t)):
+        return None
+    origin = (0,) * len(shape)
+    ref = t[origin]
+    rows: List[np.ndarray] = []
+    for p, n in enumerate(shape):
+        idx = list(origin)
+        row = np.empty(n, dtype=np.float64)
+        for d in range(n):
+            idx[p] = d
+            row[d] = t[tuple(idx)] - ref
+        rows.append(row)
+        idx[p] = 0
+    recon = np.full(shape, ref, dtype=np.float64)
+    for p, row in enumerate(rows):
+        bshape = [1] * len(shape)
+        bshape[p] = shape[p]
+        recon = recon + row.reshape(bshape)
+    if not np.array_equal(recon.astype(DEFAULT_TYPE), t.astype(DEFAULT_TYPE)):
+        return None
+    return LinearConstraint(c.name, c.dimensions, rows, float(ref))
+
+
+def has_structured(dcop) -> bool:
+    return any(
+        isinstance(c, StructuredConstraint) for c in dcop.constraints.values()
+    )
+
+
+def lower_structured_for_inference(dcop, max_table_entries: int = MAX_DENSIFY_ENTRIES):
+    """DPOP-facing lowering: rewrite a DCOP so exact-inference engines see
+    only constraints they can process without materializing D^arity.
+
+    * Linear primitives project symbolically: each becomes ``arity`` unary
+      matrix relations (one per scope position, bias folded into the first)
+      — DPOP's UTIL join then never sees the high-arity scope at all.
+    * Cardinality primitives stay structured (the frontier rung handles
+      them table-free); callers that must densify go through the
+      :data:`MAX_DENSIFY_ENTRIES` guard.
+
+    Returns a new DCOP sharing Variable/Domain objects with the input.
+    """
+    from pydcop_tpu.dcop.dcop import DCOP
+
+    out = DCOP(
+        dcop.name,
+        objective=dcop.objective,
+        domains=dict(dcop.domains),
+        variables=dict(dcop.variables),
+        agents=dict(dcop.agents),
+    )
+    out.external_variables = dict(dcop.external_variables)
+    out.dist_hints = dcop.dist_hints
+    for c in dcop.constraints.values():
+        if not isinstance(c, StructuredConstraint):
+            out.add_constraint(c)
+            continue
+        for prim in c.lower():
+            if isinstance(prim, LinearConstraint):
+                for p, (v, row) in enumerate(zip(prim.dimensions, prim.tables)):
+                    m = np.asarray(row, dtype=np.float64)
+                    if p == 0:
+                        m = m + prim.bias
+                    out.add_constraint(
+                        NAryMatrixRelation([v], m.astype(DEFAULT_TYPE),
+                                           f"{prim.name}__u{p}")
+                    )
+            else:
+                out.add_constraint(prim)
+    return out

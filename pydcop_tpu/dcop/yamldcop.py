@@ -184,10 +184,12 @@ def _build_constraints(loaded, dcop: DCOP) -> Dict[str, Constraint]:
             )
         elif ctype == "extensional":
             constraints[name] = _build_extensional(name, c, dcop)
+        elif ctype == "structured":
+            constraints[name] = _build_structured(name, c, dcop)
         else:
             raise DcopInvalidFormatError(
                 f"Constraint {name}: unknown type {ctype!r} "
-                "(must be 'intention' or 'extensional')"
+                "(must be 'intention', 'extensional' or 'structured')"
             )
     return constraints
 
@@ -233,6 +235,25 @@ def _build_extensional(name, c, dcop: DCOP) -> NAryMatrixRelation:
                 )
                 matrix[idx] = cost
     return NAryMatrixRelation(variables, matrix, name)
+
+
+def _build_structured(name, c, dcop: DCOP):
+    """``type: structured`` constraints round-trip by PARAMETERS — the
+    closed-form classes of pydcop_tpu.dcop.structured — never through a
+    densified table (a 100-arity resource rule has no D^100 table to
+    write)."""
+    from pydcop_tpu.dcop.structured import structured_from_params
+
+    var_names = c["variables"]
+    if isinstance(var_names, str):
+        var_names = [var_names]
+    variables = [_lookup_var(dcop, vn) for vn in var_names]
+    try:
+        return structured_from_params(name, variables, c.get("params") or {})
+    except (KeyError, ValueError) as e:
+        raise DcopInvalidFormatError(
+            f"Constraint {name}: invalid structured parameters ({e})"
+        ) from None
 
 
 def _build_agents(loaded) -> Dict[str, AgentDef]:
@@ -345,6 +366,17 @@ def dcop_yaml(dcop: DCOP) -> str:
 
 
 def _constraint_yaml(c: Constraint) -> Dict:
+    from pydcop_tpu.dcop.structured import StructuredConstraint
+
+    if isinstance(c, StructuredConstraint):
+        # structure-preserving: parameters, never a densified table
+        # (silent densification used to make structured instances
+        # explode — or simply hang — at dump time)
+        return {
+            "type": "structured",
+            "variables": c.scope_names,
+            "params": c.params(),
+        }
     expr = getattr(c, "expression", None)
     if expr is not None:
         return {"type": "intention", "function": expr}

@@ -16,7 +16,10 @@ from pydcop_tpu.generators.smallworld import generate_smallworld
 from pydcop_tpu.generators.iot import generate_iot
 from pydcop_tpu.generators.agents_gen import generate_agents
 from pydcop_tpu.generators.scenario_gen import generate_scenario
-from pydcop_tpu.generators.routing import generate_routing
+from pydcop_tpu.generators.routing import (
+    generate_routing,
+    generate_routing_structured,
+)
 from pydcop_tpu.generators.tracking import (
     generate_tracking,
     tracking_scenario,
@@ -33,6 +36,7 @@ __all__ = [
     "generate_agents",
     "generate_scenario",
     "generate_routing",
+    "generate_routing_structured",
     "generate_tracking",
     "tracking_scenario",
 ]

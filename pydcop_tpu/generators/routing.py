@@ -184,3 +184,115 @@ def generate_routing(
          for i in range(n_agents)]
     )
     return dcop
+
+
+def generate_routing_structured(
+    n_tasks: int,
+    n_slots: int = 4,
+    window: Optional[int] = None,
+    slot_capacity: Optional[int] = None,
+    p_soft: float = 0.15,
+    soft_scale: float = 9.0,
+    infeasible: bool = False,
+    n_agents: Optional[int] = None,
+    capacity: float = 100,
+    seed: int = 0,
+) -> DCOP:
+    """Table-free twin of :func:`generate_routing`: each resource window
+    is ONE :class:`~pydcop_tpu.dcop.structured.ResourceConstraint` over
+    all its tasks instead of a clique of pairwise exclusion tables.
+
+    The structured form carries the same scheduling semantics —
+    per-task earliness preference + rotating hard-barred release slot
+    (the linear part), per-slot occupancy capped at ``slot_capacity``
+    with ``HARD_COST`` per excess task (the cardinality part; the
+    default ``ceil(window / n_slots)`` is the tightest uniformly
+    feasible cap, and equals 1 when ``window <= n_slots``, i.e. exact
+    mutual exclusion) — but compiles to O(window · n_slots) parameters,
+    so ``window`` can exceed 100 where the dense twin's
+    ``n_slots ** window`` table is physically impossible.  Windows
+    overlap by one task (connected clique chain, as in the dense
+    family); ``p_soft`` cross-window affinity pairs stay dense binary
+    tables, exercising the mixed dense+structured compile path.
+
+    ``infeasible=True`` drops the FIRST window's cap below
+    ``window / n_slots`` — pigeonhole-infeasible: every assignment
+    carries at least one hard violation and the optimum classifies via
+    :func:`is_infeasible_cost`.
+
+    Same (args, seed) → byte-identical YAML, pinned in
+    tests/unit/test_generators_determinism.py.
+    """
+    from pydcop_tpu.dcop.structured import ResourceConstraint
+
+    D = int(n_slots)
+    k = int(window) if window else D
+    if k < 2 or D < 2:
+        raise ValueError("need window >= 2 and n_slots >= 2")
+    if n_tasks < k:
+        raise ValueError(f"n_tasks={n_tasks} below window={k}")
+    rng = np.random.default_rng(seed)
+    dcop = DCOP(f"routing_structured_{n_tasks}", "min")
+    domain = Domain("slots", "slot", list(range(D)))
+    tasks = [Variable(f"t{i:04d}", domain) for i in range(n_tasks)]
+    for t in tasks:
+        dcop.add_variable(t)
+
+    windows = []
+    start = 0
+    while start < n_tasks - 1:
+        windows.append(list(range(start, min(start + k, n_tasks))))
+        start += k - 1
+
+    pref = rng.uniform(0.0, 1.0, size=(n_tasks, D)).astype(np.float64)
+    pref += np.arange(D, dtype=np.float64) * 0.25  # earlier is cheaper
+    for i in range(n_tasks):
+        pref[i, i % D] = HARD_COST  # rotating release window (hard)
+
+    cap = (
+        int(slot_capacity) if slot_capacity
+        else int(np.ceil(k / D))
+    )
+    seen = set()
+    for r, members in enumerate(windows):
+        kk = len(members)
+        r_cap = cap
+        if infeasible and r == 0:
+            r_cap = max(0, int(np.ceil(kk / D)) - 1)
+        counts = np.arange(kk + 1, dtype=np.float64)
+        curve = HARD_COST * np.maximum(0.0, counts - r_cap)
+        dcop.add_constraint(ResourceConstraint(
+            f"w{r:05d}",
+            [tasks[i] for i in members],
+            pref[members],
+            list(range(D)),
+            np.tile(curve[None, :], (D, 1)),
+        ))
+        for a in range(kk):
+            for b in range(a + 1, kk):
+                seen.add((members[a], members[b]))
+
+    # soft cross-window affinity pairs: dense binary, as in the dense
+    # family — the mixed compile path is part of the family's contract
+    n_con = 0
+    n_soft = int(p_soft * n_tasks)
+    for _ in range(n_soft):
+        i, j = int(rng.integers(n_tasks)), int(rng.integers(n_tasks))
+        if i == j:
+            continue
+        i, j = min(i, j), max(i, j)
+        if (i, j) in seen:
+            continue
+        seen.add((i, j))
+        m = rng.uniform(0.0, soft_scale, size=(D, D)).astype(np.float64)
+        dcop.add_constraint(NAryMatrixRelation(
+            [tasks[i], tasks[j]], m, name=f"s{n_con:05d}",
+        ))
+        n_con += 1
+
+    n_agents = n_agents if n_agents is not None else n_tasks
+    dcop.add_agents(
+        [AgentDef(f"a{i:04d}", capacity=capacity)
+         for i in range(n_agents)]
+    )
+    return dcop

@@ -34,6 +34,13 @@ import jax.numpy as jnp
 from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.dcop.objects import Variable
 from pydcop_tpu.dcop.relations import Constraint
+from pydcop_tpu.dcop.structured import StructuredConstraint
+from pydcop_tpu.ops.structured_kernels import (
+    StructuredBucket,
+    build_structured_buckets,
+    structured_factor_values,
+    structured_local_tables,
+)
 
 # Large-but-finite padding cost: min-reductions never pick padded entries,
 # and sums of a few pads stay finite in float32 (reference uses a 100000
@@ -74,6 +81,10 @@ class GraphTensorsBase:
     sign: float  # +1 for min problems, -1 for max (costs pre-multiplied)
     initial_values: np.ndarray  # [V] int32 domain indices
     has_initial: np.ndarray = None  # [V] bool — variable had initial_value
+    # Table-free factors: structured constraints compile into parameter
+    # buckets instead of D^arity tensors; their edges follow the dense
+    # buckets' edges in the flat [E, D] layout.
+    sbuckets: List[StructuredBucket] = field(default_factory=list)
 
     @property
     def n_vars(self) -> int:
@@ -172,10 +183,20 @@ def _compile_common(
             init[i] = v.domain.index(v.initial_value)
             has_init[i] = True
 
+    # Structured constraints never densify: lower them to primitives and
+    # compile those into parameter buckets after the dense arity buckets.
+    dense: List[Constraint] = []
+    prims: List[StructuredConstraint] = []
+    for c in constraints:
+        if isinstance(c, StructuredConstraint):
+            prims.extend(c.lower())
+        else:
+            dense.append(c)
+
     # bucket constraints by arity (stable order: by arity, then input order)
-    factor_names = [c.name for c in constraints]
+    factor_names = [c.name for c in dense] + [p.name for p in prims]
     by_arity: Dict[int, List[int]] = {}
-    for gi, c in enumerate(constraints):
+    for gi, c in enumerate(dense):
         by_arity.setdefault(c.arity, []).append(gi)
 
     buckets: List[FactorBucket] = []
@@ -187,7 +208,7 @@ def _compile_common(
         tensors = np.full((F,) + (D,) * arity, PAD_COST, dtype=np.float32)
         var_idx = np.zeros((F, arity), dtype=np.int32)
         for k, gi in enumerate(idxs):
-            c = constraints[gi]
+            c = dense[gi]
             t = sign * c.to_tensor()
             tensors[(k,) + tuple(slice(0, s) for s in t.shape)] = t
             var_idx[k] = [var_pos[v.name] for v in c.dimensions]
@@ -202,6 +223,11 @@ def _compile_common(
         )
         edge_var_parts.append(var_idx.reshape(-1))
         offset += F * arity
+
+    sbuckets, s_edge_parts, _ = build_structured_buckets(
+        prims, var_pos, D, sign, offset, len(dense)
+    )
+    edge_var_parts.extend(s_edge_parts)
 
     edge_var = (
         np.concatenate(edge_var_parts)
@@ -220,6 +246,7 @@ def _compile_common(
         sign,
         init,
         has_init,
+        sbuckets,
     )
 
 
@@ -357,6 +384,8 @@ def total_cost(tensors: GraphTensorsBase, x: jnp.ndarray) -> jnp.ndarray:
     cost = jnp.zeros((), dtype=jnp.float32)
     for b in tensors.buckets:
         cost = cost + jnp.sum(bucket_factor_values(b, x))
+    for sb in getattr(tensors, "sbuckets", None) or []:
+        cost = cost + jnp.sum(structured_factor_values(sb, x))
     V = tensors.n_vars
     unary = tensors.unary_costs[jnp.arange(V), x] * (
         tensors.domain_mask[jnp.arange(V), x]
@@ -381,11 +410,19 @@ def local_cost_tables(
 
     ``bucket_tensors`` substitutes per-bucket cost tensors (e.g. GDBA's
     weighted tensors); ``factor_weights`` ([n_factors]) scales each factor's
-    contribution (e.g. DBA's breakout weights).
+    contribution (e.g. DBA's breakout weights).  Both are dense-only knobs:
+    structured factors have no tensors to substitute and refuse weighting
+    rather than silently ignoring it.
     """
     from pydcop_tpu.ops.segments import segment_sum
 
     V, D = tensors.n_vars, tensors.max_domain_size
+    sbuckets = getattr(tensors, "sbuckets", None) or []
+    if sbuckets and (bucket_tensors is not None or factor_weights is not None):
+        raise NotImplementedError(
+            "per-factor weighting (DBA/GDBA) is not supported on structured "
+            "constraints; densify them or use an unweighted algorithm"
+        )
     if include_unary:
         out = jnp.where(tensors.domain_mask > 0, tensors.unary_costs, PAD_COST)
     else:
@@ -412,6 +449,9 @@ def local_cost_tables(
             if w is not None:
                 rows = rows * w
             out = out + segment_sum(rows, b.var_idx[:, p], V)
+    for sb in sbuckets:
+        if sb.n_factors:
+            out = out + structured_local_tables(sb, x, V, D)
     # clamp padding back (segment sums may have added pad costs on valid
     # rows only through real factors, but invalid slots can accumulate)
     return jnp.where(tensors.domain_mask > 0, out, PAD_COST)

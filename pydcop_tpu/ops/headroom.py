@@ -39,11 +39,17 @@ import numpy as np
 import jax.numpy as jnp
 
 from pydcop_tpu.dcop.relations import Constraint
+from pydcop_tpu.dcop.structured import StructuredConstraint
 from pydcop_tpu.ops.compile import (
     ConstraintGraphTensors,
     FactorBucket,
     FactorGraphTensors,
     PAD_COST,
+)
+from pydcop_tpu.ops.structured_kernels import (
+    StructuredBucket,
+    cardinality_factor_arrays,
+    linear_factor_arrays,
 )
 
 #: host-side placeholder name of an unclaimed slot (never a real name:
@@ -319,6 +325,27 @@ def reserve_headroom(
         edge_var_parts.append(vi_cap.reshape(-1))
         offset += Fc * a
         gid += Fc
+    # -- structured (table-free) buckets ------------------------------------
+    # Carried at their compiled size: structured factors have no free
+    # headroom slots — their parameters are warm-patched in place
+    # (EditFactor → replace-by-name), but adding/removing one is a repack.
+    # Edge ids are re-based after the dense CAPACITY edges so the flat
+    # [E, D] message slab stays contiguous.
+    sbuckets: List[StructuredBucket] = []
+    for sb in getattr(tensors, "sbuckets", None) or []:
+        sbuckets.append(
+            dataclasses.replace(
+                sb,
+                factor_ids=np.arange(
+                    gid, gid + sb.n_factors, dtype=np.int32
+                ),
+                edge_offset=offset,
+            )
+        )
+        factor_names_cap.extend(sb.names)
+        edge_var_parts.append(np.asarray(sb.var_idx).reshape(-1))
+        offset += sb.n_edges
+        gid += sb.n_factors
     edge_var = (
         np.concatenate(edge_var_parts)
         if edge_var_parts else np.zeros(0, dtype=np.int32)
@@ -346,6 +373,7 @@ def reserve_headroom(
         sign=tensors.sign,
         initial_values=init,
         has_initial=has_init,
+        sbuckets=sbuckets,
         layout=layout,
     )
     if graph == "factor":
@@ -354,7 +382,7 @@ def reserve_headroom(
         # neighbor pairs are DERIVED per-cycle from the var_idx operands
         # (duplicates across factors are harmless to the segment-max
         # arbitration); the static arrays here only back host metrics
-        src, dst = derived_pairs_host(buckets)
+        src, dst = derived_pairs_host(buckets, sbuckets)
         cap = HeadroomConstraintTensors(
             **common,
             neighbor_src=jnp.asarray(src),
@@ -384,6 +412,15 @@ def make_operands(cap) -> Dict:
             jnp.asarray(b.var_idx, dtype=jnp.int32) for b in cap.buckets
         ),
         "edge_var": jnp.asarray(cap.edge_var, dtype=jnp.int32),
+        # structured (table-free) parameters: a few O(k·D) scalar arrays
+        # per bucket instead of a D^arity slab — the warm-mutation path
+        # patches THESE, so even a 100-arity factor edit is a handful of
+        # float writes (scopes are static; see apply_mutation)
+        "s_costs": tuple(
+            (sb.rows, sb.bias) if sb.kind == "linear"
+            else (sb.count_cost,)
+            for sb in getattr(cap, "sbuckets", None) or []
+        ),
     }
 
 
@@ -402,13 +439,21 @@ def operand_view(cap, ops: Dict):
         buckets=buckets,
         edge_var=ops["edge_var"],
     )
+    sbs = getattr(cap, "sbuckets", None) or []
+    if sbs:
+        kw["sbuckets"] = [
+            dataclasses.replace(sb, rows=leaves[0], bias=leaves[1])
+            if sb.kind == "linear"
+            else dataclasses.replace(sb, count_cost=leaves[0])
+            for sb, leaves in zip(sbs, ops["s_costs"])
+        ]
     if isinstance(cap, HeadroomConstraintTensors):
-        src, dst = derived_pairs(ops["var_idx"], cap.buckets)
+        src, dst = derived_pairs(ops["var_idx"], cap.buckets, sbs)
         kw.update(neighbor_src=src, neighbor_dst=dst)
     return dataclasses.replace(cap, **kw)
 
 
-def derived_pairs(var_idx_leaves, buckets):
+def derived_pairs(var_idx_leaves, buckets, sbuckets=()):
     """Directed neighbor pairs derived from the var_idx operands — one
     ordered pair per (factor slot, position pair), fixed shape.
 
@@ -416,11 +461,21 @@ def derived_pairs(var_idx_leaves, buckets):
     duplicates (two factors over the same scope yield the pair twice)
     and parking self-pairs from free slots — both are no-ops to the
     segment-max/min arbitration of ``neighborhood_winner`` (max and min
-    are idempotent; parking's gain is always 0).
+    are idempotent; parking's gain is always 0).  Structured buckets'
+    scopes are STATIC (mutations patch parameters only), so their pairs
+    ride along from the host arrays.
     """
     src_parts, dst_parts = [], []
     for vi, b in zip(var_idx_leaves, buckets):
         a = b.arity
+        for p in range(a):
+            for q in range(a):
+                if p != q:
+                    src_parts.append(vi[:, p])
+                    dst_parts.append(vi[:, q])
+    for sb in sbuckets:
+        vi = jnp.asarray(sb.var_idx, dtype=jnp.int32)
+        a = sb.arity
         for p in range(a):
             for q in range(a):
                 if p != q:
@@ -435,9 +490,9 @@ def derived_pairs(var_idx_leaves, buckets):
     )
 
 
-def derived_pairs_host(buckets) -> Tuple[np.ndarray, np.ndarray]:
+def derived_pairs_host(buckets, sbuckets=()) -> Tuple[np.ndarray, np.ndarray]:
     src, dst = derived_pairs(
-        tuple(np.asarray(b.var_idx) for b in buckets), buckets
+        tuple(np.asarray(b.var_idx) for b in buckets), buckets, sbuckets
     )
     return np.asarray(src), np.asarray(dst)
 
@@ -524,6 +579,26 @@ def apply_mutation(cap, layout: HeadroomLayout, ops: Dict, mut) -> Tuple[
     (unknown names, scope mismatches) — and in both cases the layout,
     operands and host metadata are left untouched.
     """
+    if isinstance(mut, EditFactor) and isinstance(
+            mut.constraint, StructuredConstraint):
+        return _apply_structured_edit(cap, layout, ops, mut.constraint)
+
+    if isinstance(mut, AddFactor) and isinstance(
+            mut.constraint, StructuredConstraint):
+        # structured factors have no reserve slots (their whole point is
+        # that the parameter arrays are tiny and exactly sized); adding
+        # one warm would need a shape change → counted repack
+        raise HeadroomExhausted(
+            f"structured factor {mut.constraint.name!r} cannot be added "
+            "at a fixed shape; repack required"
+        )
+
+    if isinstance(mut, RemoveFactor) and _structured_slots(cap, mut.name):
+        raise ValueError(
+            f"structured factor {mut.name!r} cannot be removed warm; "
+            "edit its parameters to a zero-cost curve or repack"
+        )
+
     if isinstance(mut, EditFactor):
         c = mut.constraint
         b, k = layout.factor_slot(c.name)
@@ -662,4 +737,94 @@ def _factor_dirty(cap, layout: HeadroomLayout, b: int, k: int,
                    if int(v) != layout.parking],
         edge_lo=lo,
         edge_hi=lo + bko.arity,
+    )
+
+
+def _structured_slots(cap, name: str) -> List[Tuple[int, int]]:
+    """(bucket index, slot) of every structured primitive named ``name``
+    or ``name__*`` (a composite constraint lowers to several)."""
+    out = []
+    prefix = name + "__"
+    for bi, sb in enumerate(getattr(cap, "sbuckets", None) or []):
+        for k, n in enumerate(sb.names):
+            if n == name or n.startswith(prefix):
+                out.append((bi, k))
+    return out
+
+
+def _apply_structured_edit(cap, layout: HeadroomLayout, ops: Dict,
+                           constraint: StructuredConstraint) -> Tuple[
+        Dict, Dirty]:
+    """Warm-patch a structured constraint: the mutation writes a few
+    O(k·D) parameter rows instead of a D^arity table slab.
+
+    The edited constraint must lower to the SAME primitive set (names,
+    kinds, scopes, counted-value layout) as the compiled one — only the
+    cost parameters move; a structural change is a repack.
+    """
+    sbs = getattr(cap, "sbuckets", None) or []
+    # resolve + validate every primitive before writing anything
+    plan = []
+    for prim in constraint.lower():
+        hit = None
+        for bi, sb in enumerate(sbs):
+            if prim.name in sb.names:
+                hit = (bi, sb.names.index(prim.name))
+                break
+        if hit is None:
+            raise ValueError(
+                f"structured edit of {constraint.name!r} produced "
+                f"primitive {prim.name!r} with no compiled slot — "
+                "structural changes require a repack"
+            )
+        bi, k = hit
+        sb = sbs[bi]
+        if prim.kind != sb.kind or prim.arity != sb.arity:
+            raise ValueError(
+                f"primitive {prim.name!r} is {prim.kind}/{prim.arity}, "
+                f"slot expects {sb.kind}/{sb.arity}"
+            )
+        scope = [layout.var_slot(d.name) for d in prim.dimensions]
+        if scope != [int(v) for v in np.asarray(sb.var_idx[k])]:
+            raise ValueError(
+                f"primitive {prim.name!r} changes its scope — "
+                "mutations must keep the scope"
+            )
+        if sb.kind == "cardinality":
+            cnt, cc = cardinality_factor_arrays(prim, cap.sign)
+            if not np.array_equal(np.asarray(sb.cnt_idx[k]), cnt):
+                raise ValueError(
+                    f"primitive {prim.name!r} changes its counted-value "
+                    "layout; only cost parameters may be patched warm"
+                )
+            plan.append((bi, k, sb, (cc,)))
+        else:
+            rows, bias = linear_factor_arrays(
+                prim, cap.max_domain_size, cap.sign
+            )
+            plan.append((bi, k, sb, (rows, bias)))
+
+    ops = dict(ops)
+    leaves = list(ops["s_costs"])
+    var_slots: List[int] = []
+    lo, hi = None, 0
+    for bi, k, sb, new in plan:
+        if sb.kind == "linear":
+            rows_l, bias_l = leaves[bi]
+            leaves[bi] = (
+                rows_l.at[k].set(jnp.asarray(new[0])),
+                bias_l.at[k].set(jnp.asarray(new[1])),
+            )
+        else:
+            (cc_l,) = leaves[bi]
+            leaves[bi] = (cc_l.at[k].set(jnp.asarray(new[0])),)
+        var_slots.extend(int(v) for v in np.asarray(sb.var_idx[k]))
+        elo = sb.edge_offset + k * sb.arity
+        lo = elo if lo is None else min(lo, elo)
+        hi = max(hi, elo + sb.arity)
+    ops["s_costs"] = tuple(leaves)
+    return ops, Dirty(
+        var_slots=sorted(set(var_slots)),
+        edge_lo=lo or 0,
+        edge_hi=hi,
     )

@@ -26,6 +26,7 @@ import numpy as np
 
 from pydcop_tpu.ops.compile import FactorBucket, FactorGraphTensors
 from pydcop_tpu.ops.segments import masked_argmin, masked_mean, segment_sum
+from pydcop_tpu.ops.structured_kernels import structured_factor_messages
 
 
 def _broadcast_to_axis(msg: jnp.ndarray, axis: int, arity: int) -> jnp.ndarray:
@@ -62,7 +63,12 @@ def factor_to_var_messages(
 def all_factor_messages(
     tensors: FactorGraphTensors, q_flat: jnp.ndarray
 ) -> jnp.ndarray:
-    """factor→var messages for every bucket, as a flat [E, D] edge array."""
+    """factor→var messages for every bucket, as a flat [E, D] edge array.
+
+    Structured buckets ride the same edge layout (their edges follow the
+    dense buckets'), but their messages come from closed-form kernels —
+    O(k·D) / O(k²) per factor — instead of the D^arity table reduction.
+    """
     parts: List[jnp.ndarray] = []
     for b in tensors.buckets:
         F, a = b.n_factors, b.arity
@@ -70,6 +76,15 @@ def all_factor_messages(
             F, a, -1
         )
         parts.append(factor_to_var_messages(b, q_bucket).reshape(F * a, -1))
+    for sb in getattr(tensors, "sbuckets", None) or []:
+        F, a = sb.n_factors, sb.arity
+        q_bucket = q_flat[sb.edge_offset : sb.edge_offset + F * a].reshape(
+            F, a, -1
+        )
+        dmask = tensors.domain_mask[sb.var_idx]  # [F, a, D]
+        parts.append(
+            structured_factor_messages(sb, q_bucket, dmask).reshape(F * a, -1)
+        )
     if not parts:
         return jnp.zeros_like(q_flat)
     return jnp.concatenate(parts, axis=0)

@@ -372,6 +372,12 @@ def shard_factor_graph(
     locality partitioner — this is how an explicit placement (a
     distribution YAML, reference pydcop/commands/solve.py:483-507) drives
     device sharding."""
+    if getattr(tensors, "sbuckets", None):
+        raise NotImplementedError(
+            "sharded maxsum does not yet shard table-free (structured) "
+            "buckets; run the single-device engine or densify small "
+            "structured constraints first"
+        )
     V = tensors.n_vars
     if assigns is None:
         assigns = partition_factors(

@@ -14,6 +14,13 @@ pass).  That makes featurization cheap enough to run inline in
 still carrying the signals every routing heuristic in the framework
 has historically keyed on.
 
+Table-free constraints (ISSUE 17) add three structure signals — the
+structured-constraint fraction and the log dense-table byte totals
+(overall and structured-only).  Both byte numbers are ANALYTIC
+(:meth:`dcop.structured.StructuredConstraint.dense_bytes` and a domain
+product for dense factors): a 100-arity window contributes its 4^100
+hypothetical bytes to the feature without any table ever existing.
+
 Config encoding lives here too (:func:`encode_config`): the model
 scores (instance, config) PAIRS, so a candidate config is embedded as
 a small fixed vector (algo/engine/overlap one-hots + the numeric
@@ -54,6 +61,9 @@ FEATURE_NAMES: Tuple[str, ...] = (
     "cut_fraction_8",
     "boundary_fraction_8",
     "objective_is_max",
+    "structured_frac",
+    "log10_dense_table_bytes",
+    "log10_structured_dense_bytes",
 )
 
 N_FEATURES = len(FEATURE_NAMES)
@@ -111,6 +121,10 @@ def featurize_detail(dcop, n_shards: int = REFERENCE_SHARDS):
     selection policy needs (planner byte estimates, induced width,
     cut fraction, ...).  Returns ``(vector [N_FEATURES] float32,
     info dict)``.  Never builds a cost or util table."""
+    from pydcop_tpu.dcop.structured import (
+        MAX_DENSIFY_ENTRIES,
+        StructuredConstraint,
+    )
     from pydcop_tpu.graph import pseudotree as pt
     from pydcop_tpu.ops.dpop_shard import estimate_sweep_bytes
     from pydcop_tpu.parallel.boundary import analyze_boundary
@@ -119,6 +133,31 @@ def featurize_detail(dcop, n_shards: int = REFERENCE_SHARDS):
     n_vars = len(dcop.variables)
     n_factors = len(dcop.constraints)
     n_agents = len(dcop.agents)
+
+    # table-free structure census: counts per structured kind and the
+    # ANALYTIC dense-table byte totals — pure arithmetic on domain
+    # sizes, so a 4^100 window costs one float multiply, not a table
+    n_structured = 0
+    structured_kinds: Dict[str, int] = {}
+    dense_table_bytes = 0.0
+    structured_dense_bytes = 0.0
+    structured_over_cap = False
+    for c in dcop.constraints.values():
+        if isinstance(c, StructuredConstraint):
+            n_structured += 1
+            structured_kinds[c.kind] = (
+                structured_kinds.get(c.kind, 0) + 1
+            )
+            b = c.dense_bytes()
+            structured_dense_bytes += b
+            dense_table_bytes += b
+            if c.dense_entries() > MAX_DENSIFY_ENTRIES:
+                structured_over_cap = True
+        else:
+            b = 4.0
+            for v in c.dimensions:
+                b *= len(v.domain)
+            dense_table_bytes += b
 
     dom_sizes = np.asarray(
         [len(v.domain) for v in dcop.variables.values()] or [1],
@@ -175,6 +214,9 @@ def featurize_detail(dcop, n_shards: int = REFERENCE_SHARDS):
         cut_fraction,
         boundary_fraction,
         1.0 if dcop.objective == "max" else 0.0,
+        n_structured / max(1, n_factors),
+        np.log10(max(4.0, dense_table_bytes)),
+        np.log10(max(4.0, structured_dense_bytes)),
     ], dtype=np.float32)
     assert vec.shape == (N_FEATURES,)
 
@@ -189,6 +231,12 @@ def featurize_detail(dcop, n_shards: int = REFERENCE_SHARDS):
         "cut_fraction": float(cut_fraction),
         "boundary_fraction": float(boundary_fraction),
         "objective": dcop.objective,
+        "n_structured": n_structured,
+        "structured_kinds": structured_kinds,
+        "structured_frac": n_structured / max(1, n_factors),
+        "dense_table_bytes": float(dense_table_bytes),
+        "structured_dense_bytes": float(structured_dense_bytes),
+        "structured_over_table_cap": structured_over_cap,
     }
     return vec, info
 

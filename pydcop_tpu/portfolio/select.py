@@ -7,7 +7,11 @@ three strict stages:
    removed BEFORE any scoring: DPOP exact tiers whose planner byte
    estimate (:func:`ops.dpop_shard.estimate_sweep_bytes`, a pure shape
    pass) exceeds the budget on the available device count, sharded
-   tiers without a mesh to shard over.  Masking is advisory routing
+   tiers without a mesh to shard over, and — for instances carrying
+   table-free structured constraints (ISSUE 17) — the weighted
+   local-search cells (no tensors to weight) plus every table-bound
+   DPOP tier when a structured factor can never densify, so the
+   selector lands on a table-free path.  Masking is advisory routing
    only — a user who *forces* an infeasible config still gets the
    typed refusal (:class:`ops.dpop_shard.UtilTableTooLarge`), never a
    silent downgrade;
@@ -195,7 +199,21 @@ def feasible_grid(
     masked: List[Tuple[PortfolioConfig, str]] = []
     sweep_bytes = int(info.get("sweep_bytes", 0))
     max_entries = int(info.get("max_node_entries", 0))
+    n_structured = int(info.get("n_structured", 0))
+    structured_over_cap = bool(
+        info.get("structured_over_table_cap", False)
+    )
     for cfg in grid:
+        if cfg.algo in ("gdba", "dba") and n_structured > 0:
+            # the weighted local-search family substitutes per-factor
+            # cost tensors — structured factors have none and the
+            # compile layer refuses rather than silently ignoring the
+            # weights (ISSUE 17)
+            masked.append((cfg, (
+                "per-factor weighting is not supported on structured "
+                "constraints"
+            )))
+            continue
         if cfg.algo in ("syncbb", "ncbb"):
             # the frontier exact-search arm: its regime is high width
             # at SMALL n — mask it out of bulk instances where the
@@ -219,6 +237,21 @@ def feasible_grid(
         if cfg.algo != "dpop":
             feasible.append(cfg)
             continue
+        if structured_over_cap:
+            # a structured constraint past the densify cap can NEVER
+            # materialize a util table: only the table-free frontier
+            # arm (which engine="auto" routes to, within its shape
+            # limits) keeps this cell runnable — everything else ends
+            # in a typed UtilTableTooLarge
+            n_vars = int(info.get("n_vars", 0))
+            max_dom = int(info.get("max_domain", 0))
+            if (cfg.engine != "auto" or n_vars > FRONTIER_MAX_VARS
+                    or max_dom > FRONTIER_MAX_DOMAIN):
+                masked.append((cfg, (
+                    "a structured constraint would densify past the "
+                    "table cap; only the table-free engines can run it"
+                )))
+                continue
         if cfg.engine == "sharded" and n_dev < 2:
             masked.append((cfg, "sharded DPOP needs a multi-device "
                            "mesh"))

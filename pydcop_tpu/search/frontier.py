@@ -95,6 +95,7 @@ class FrontierEngine:
             steps=max(1, int(steps)),
         )
         self._runner = None
+        self._dive_fns: Dict[int, Any] = {}
         self._trace_counts: Dict[Any, int] = {}
 
     # -- state --------------------------------------------------------------
@@ -159,11 +160,19 @@ class FrontierEngine:
         m_pos = jnp.asarray(p.m_pos)
         m_stride = jnp.asarray(p.m_stride)
         h_const = jnp.asarray(p.h_const)
+        s_flat = jnp.asarray(p.s_flat)
+        s_base = jnp.asarray(p.s_base)
+        s_valid = jnp.asarray(p.s_valid)
+        s_cnt = jnp.asarray(p.s_cnt)
+        s_pri_pos = jnp.asarray(p.s_pri_pos)
+        s_pri_cnt = jnp.asarray(p.s_pri_cnt)
+        s_pri_valid = jnp.asarray(p.s_pri_valid)
         D = p.Dmax
 
         def inc_row(assign, k):
             """[Dmax] cost increments of assigning order[k] under the
-            row's prefix — one gather-sum over the flat tables."""
+            row's prefix — one gather-sum over the flat tables, plus the
+            table-free cardinality deltas (structured constraints)."""
             base = c_base[k] + jnp.sum(
                 c_stride[k] * assign[c_pos[k]], axis=-1
             )  # [Cmax]
@@ -171,8 +180,25 @@ class FrontierEngine:
                 jnp.arange(D, dtype=jnp.int32)[None, :] * c_own[k][:, None]
             )
             vals = c_flat[offs]  # [Cmax, D]
-            return unary[k] + jnp.sum(
+            out = unary[k] + jnp.sum(
                 c_valid[k][:, None] * vals, axis=0
+            )
+            # cardinality deltas: count prior counted positions in the
+            # prefix, then charge count_cost[c+1]-count_cost[c] on the
+            # counted candidate value only (telescoping → exact g)
+            cnt = jnp.sum(
+                s_pri_valid[k]
+                * (assign[s_pri_pos[k]] == s_pri_cnt[k]),
+                axis=-1,
+            ).astype(jnp.int32)  # [Smax]
+            off = s_base[k] + cnt
+            delta = (s_flat[off + 1] - s_flat[off]) * s_valid[k]  # [Smax]
+            hit = (
+                jnp.arange(D, dtype=jnp.int32)[None, :]
+                == s_cnt[k][:, None]
+            )  # [Smax, D]
+            return out + jnp.sum(
+                jnp.where(hit, delta[:, None], 0.0), axis=0
             )
 
         def h_row(assign, d):
@@ -213,9 +239,15 @@ class FrontierEngine:
             slack = jnp.int32(B + R + A) - stored
             E = jnp.clip(slack // jnp.int32(max(D - 1, 1)), 0, B)
 
-            # best-first choice of the E rows to expand
+            # best-first choice of the E rows to expand; equal-f ties
+            # break toward DEEPER rows — when the heuristic is (near)
+            # exact, e.g. the separable part of structured constraints,
+            # the whole optimal prefix ties on f and a shallow-first
+            # order degenerates to breadth-first churn that never
+            # reaches a leaf at high arity
+            deep = jnp.argsort(-st["f_depth"], stable=True)
             keys = jnp.where(live, st["f_f"], INF)
-            rank = jnp.argsort(jnp.argsort(keys))
+            rank = jnp.argsort(deep[jnp.argsort(keys[deep], stable=True)])
             expand = live & (rank < E)
 
             k = st["f_depth"]                       # [B]
@@ -286,7 +318,11 @@ class FrontierEngine:
                 inj_ok,
             ]) & (pool_f < U2)
 
-            order = jnp.argsort(jnp.where(pool_ok, pool_f, INF))
+            # same deeper-first tie-break as the expansion choice, so
+            # equal-f children outrank their parents in the slab
+            pdeep = jnp.argsort(-pool_depth, stable=True)
+            order = pdeep[jnp.argsort(
+                jnp.where(pool_ok, pool_f, INF)[pdeep], stable=True)]
             pool_assign = pool_assign[order]
             pool_g = pool_g[order]
             pool_f = pool_f[order]
@@ -371,6 +407,75 @@ class FrontierEngine:
             }
 
         return step
+
+    def beam_dive(self, width: int = 64):
+        """Depth-synchronous beam rollout: carry ``width`` partial
+        rows from the empty prefix to the leaves, keeping the best
+        ``width`` children by f = g + h at every depth.  Returns
+        ``(assign, cost)`` of the best leaf — a true upper bound
+        usable as an initial incumbent.
+
+        Best-first alone can touch no leaf for arbitrarily long when
+        the bound is inexact and the space is deep (a 100-ary
+        structured constraint has 4^100 leaves); seeding the incumbent
+        with this rollout turns the very first chunk into pruning
+        work.  A beam (rather than a single greedy path) survives
+        tight feasibility structure — with exact capacities and
+        forbidden values a lone rollout can paint itself into a
+        corner no single-step lookahead warns about."""
+        import jax
+        import jax.numpy as jnp
+
+        p = self.plan
+        if not p.n:
+            return np.zeros((0,), np.int32), 0.0
+        inc_rows, h_rows = self._build_kernels()
+        n, D = p.n, p.Dmax
+        W = max(int(width), 1)
+        dom = jnp.asarray(p.dom_sizes)
+        INF = jnp.float32(np.inf)
+
+        def body(carry, k):
+            assign, g, ok = carry             # [W,n], [W], [W]
+            ks = jnp.full((W,), k, jnp.int32)
+            inc = inc_rows(assign, ks)        # [W, D]
+            g_c = g[:, None] + inc
+            vals = jnp.arange(D, dtype=jnp.int32)
+            child = jnp.where(
+                jnp.arange(n, dtype=jnp.int32)[None, None, :] == k,
+                vals[None, :, None], assign[:, None, :],
+            )                                 # [W, D, n]
+            h = h_rows(child, jnp.minimum(ks + 1, n))
+            f = jnp.where(
+                ok[:, None] & (vals[None, :] < dom[k]),
+                g_c + h, INF,
+            ).reshape(-1)
+            _, idx = jax.lax.top_k(-f, W)
+            w_i, d_i = idx // D, idx % D
+            return (
+                child[w_i, d_i], g_c[w_i, d_i], f[idx] < INF
+            ), None
+
+        def dive(a0, g0, ok0):
+            (assign, g, ok), _ = jax.lax.scan(
+                body, (a0, g0, ok0), jnp.arange(n, dtype=jnp.int32)
+            )
+            leaf_g = jnp.where(ok, g, INF)
+            best = jnp.argmin(leaf_g)
+            return assign[best], leaf_g[best]
+
+        # one bring-up program per beam width, cached like the chunk
+        # runner (but outside the steady-state trace discipline: it
+        # runs once before the chunk loop, never inside it)
+        fn = self._dive_fns.get(W)
+        if fn is None:
+            fn = self._dive_fns[W] = jax.jit(dive)
+        assign, g = fn(
+            jnp.zeros((W, n), jnp.int32),
+            jnp.zeros((W,), jnp.float32),
+            jnp.zeros((W,), bool).at[0].set(True),
+        )
+        return np.asarray(assign), float(g)
 
     def lower_bound(self, st):
         """Global bound: min over every open row's f, clamped by the

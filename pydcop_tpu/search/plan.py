@@ -120,6 +120,18 @@ class SearchPlan:
     c_pos: np.ndarray              # [n, Cmax, Amax] i32 scope positions
     c_stride: np.ndarray           # [n, Cmax, Amax] i32 (0 = padding)
     c_own_stride: np.ndarray       # [n, Cmax] i32
+    # table-free cardinality increments (structured constraints): at the
+    # depth of each scope position, g grows by the telescoping delta
+    # s_flat[base + cnt + 1] - s_flat[base + cnt] when the candidate
+    # value is the counted one (cnt = counted positions already
+    # assigned).  Sum over depths = count_cost[final count], exactly.
+    s_flat: np.ndarray             # [sum curves] f32 (normalized, [0]=0)
+    s_base: np.ndarray             # [n, Smax] i32 offsets into s_flat
+    s_valid: np.ndarray            # [n, Smax] f32 0/1
+    s_cnt: np.ndarray              # [n, Smax] i32 counted value idx here
+    s_pri_pos: np.ndarray          # [n, Smax, Kmax] i32 earlier positions
+    s_pri_cnt: np.ndarray          # [n, Smax, Kmax] i32 their counted idx
+    s_pri_valid: np.ndarray        # [n, Smax, Kmax] f32 0/1
     # mini-bucket bound messages, laid out per child depth d in [0, n]:
     i_bound: int
     exact_heuristic: bool          # no mini-bucket ever split
@@ -207,15 +219,81 @@ def compile_search_plan(
             sign * np.asarray(v.cost_vector(), np.float64)
         ).astype(np.float32)
 
-    # ---- constraints, positioned and attached at their deepest var
+    # ---- constraints, positioned and attached at their deepest var.
+    # Structured constraints never densify: linear primitives fold into
+    # the unary slabs (entering the mini-bucket bound exactly), and
+    # cardinality primitives become per-depth telescoping increments.
+    from pydcop_tpu.dcop.structured import (
+        CardinalityConstraint,
+        LinearConstraint,
+        StructuredConstraint,
+    )
+
     per_depth: List[List[Tuple[np.ndarray, Tuple[int, ...]]]] = [
         [] for _ in range(max(n, 1))
     ]
+    # card entries per depth: (base_offset, cnt_idx_here, prior list)
+    card_depth: List[List[Tuple[int, int, List[Tuple[int, int]]]]] = [
+        [] for _ in range(max(n, 1))
+    ]
+    s_chunks: List[np.ndarray] = [np.zeros(2, np.float32)]  # safe slot 0/1
+    s_off = 2
+    card_lb_by_last: List[Tuple[int, float]] = []  # (last scope pos, lb)
+    has_card = False
     for c in dcop.constraints.values():
         if any(nm in ext for nm in c.scope_names):
             c = c.slice(ext)
         scope_pos = [pos[v.name] for v in c.dimensions if v.name in pos]
         if not scope_pos:
+            continue
+        if isinstance(c, StructuredConstraint):
+            for prim in c.lower():
+                p_scope = [pos[v.name] for v in prim.dimensions]
+                if isinstance(prim, LinearConstraint):
+                    for p, row in zip(p_scope, prim.tables):
+                        dom = dom_sizes[p]
+                        unary[p, :dom] += (
+                            sign * row.astype(np.float64)
+                        ).astype(np.float32)
+                    if prim.bias:
+                        p0 = p_scope[0]
+                        unary[p0, : dom_sizes[p0]] += np.float32(
+                            sign * prim.bias
+                        )
+                    continue
+                assert isinstance(prim, CardinalityConstraint)
+                cc = sign * prim.count_cost.astype(np.float64)
+                if np.all(cc == cc[0]):
+                    # constant curve: fold into the first position's unary
+                    if cc[0]:
+                        p0 = min(p_scope)
+                        unary[p0, : dom_sizes[p0]] += np.float32(cc[0])
+                    continue
+                has_card = True
+                cc_n = (cc - cc[0]).astype(np.float32)
+                if cc[0]:
+                    p0 = min(p_scope)
+                    unary[p0, : dom_sizes[p0]] += np.float32(cc[0])
+                base = s_off
+                s_chunks.append(cc_n)
+                s_off += cc_n.size
+                suffix_min = np.minimum.accumulate(
+                    cc_n[::-1].astype(np.float64))[::-1]
+                lb = float(np.min(suffix_min - cc_n))
+                cnt_idx = prim.counted_indices()
+                order_ix = np.argsort(np.asarray(p_scope, np.int64),
+                                      kind="stable")
+                sorted_scope = [
+                    (p_scope[i], int(cnt_idx[i])) for i in order_ix
+                ]
+                card_lb_by_last.append((sorted_scope[-1][0], lb))
+                priors: List[Tuple[int, int]] = []
+                for p, ci in sorted_scope:
+                    if ci >= 0:
+                        card_depth[p].append((base, ci, list(priors)))
+                        priors.append((p, ci))
+                # positions whose domain lacks the counted value can
+                # never change the count: no entry, not a prior
             continue
         t = (sign * np.asarray(c.to_tensor(), np.float64)).astype(
             np.float32
@@ -224,6 +302,27 @@ def compile_search_plan(
         t = np.ascontiguousarray(np.transpose(t, tuple(perm)))
         scope = tuple(sorted(scope_pos))
         per_depth[scope[-1]].append((t, scope))
+
+    s_flat = np.concatenate(s_chunks)
+    Smax = max((len(es) for es in card_depth), default=0) or 1
+    Kmax = max(
+        (len(pr) for es in card_depth for _b, _c, pr in es), default=0
+    ) or 1
+    s_base = np.zeros((max(n, 1), Smax), np.int32)
+    s_valid = np.zeros((max(n, 1), Smax), np.float32)
+    s_cnt = np.zeros((max(n, 1), Smax), np.int32)
+    s_pri_pos = np.zeros((max(n, 1), Smax, Kmax), np.int32)
+    s_pri_cnt = np.zeros((max(n, 1), Smax, Kmax), np.int32)
+    s_pri_valid = np.zeros((max(n, 1), Smax, Kmax), np.float32)
+    for k, es in enumerate(card_depth):
+        for ei, (base, ci, priors) in enumerate(es):
+            s_base[k, ei] = base
+            s_valid[k, ei] = 1.0
+            s_cnt[k, ei] = ci
+            for j, (p, pc) in enumerate(priors):
+                s_pri_pos[k, ei, j] = p
+                s_pri_cnt[k, ei, j] = pc
+                s_pri_valid[k, ei, j] = 1.0
 
     Cmax = max((len(cs) for cs in per_depth), default=0) or 1
     Amax = max(
@@ -322,6 +421,12 @@ def compile_search_plan(
     h_const = np.zeros(n + 1, np.float32)
     for d in range(n + 1):
         h_const[d] = float(const_by_src[d:].sum()) if n else 0.0
+        # admissible slack for still-open cardinality curves: the worst
+        # remaining count-cost delta (0 for monotone min-mode curves —
+        # capacity penalties only grow with count)
+        h_const[d] += sum(
+            lb for last, lb in card_lb_by_last if d <= last
+        )
         for mi, m in enumerate(by_depth[d]):
             strides = (
                 np.asarray(m.table.strides, np.int64) // m.table.itemsize
@@ -336,13 +441,18 @@ def compile_search_plan(
         c_flat.nbytes + h_flat.nbytes + c_base.nbytes + c_pos.nbytes
         + c_stride.nbytes + m_base.nbytes + m_pos.nbytes
         + m_stride.nbytes + unary.nbytes
+        + s_flat.nbytes + s_base.nbytes + s_cnt.nbytes
+        + s_pri_pos.nbytes + s_pri_cnt.nbytes + s_pri_valid.nbytes
     )
     return SearchPlan(
         order=order, dom_sizes=dom_sizes, domain_values=domain_values,
         sign=sign, n=n, Dmax=Dmax, unary=unary,
         c_flat=c_flat, c_base=c_base, c_valid=c_valid, c_pos=c_pos,
         c_stride=c_stride, c_own_stride=c_own,
-        i_bound=i_bound, exact_heuristic=(n_splits == 0),
+        s_flat=s_flat, s_base=s_base, s_valid=s_valid, s_cnt=s_cnt,
+        s_pri_pos=s_pri_pos, s_pri_cnt=s_pri_cnt,
+        s_pri_valid=s_pri_valid,
+        i_bound=i_bound, exact_heuristic=(n_splits == 0 and not has_card),
         h_flat=h_flat, m_base=m_base, m_valid=m_valid, m_pos=m_pos,
         m_stride=m_stride, h_const=h_const,
         root_bound=float(h_const[0]), bucket_splits=n_splits,

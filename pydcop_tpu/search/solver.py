@@ -56,6 +56,7 @@ class FrontierSearchSolver:
         i_bound: int = 0,
         bound_budget_bytes: Optional[int] = None,
         max_chunks: int = DEFAULT_MAX_CHUNKS,
+        seed_incumbent: bool = True,
     ):
         self.dcop = dcop
         self.mode = dcop.objective
@@ -73,6 +74,8 @@ class FrontierSearchSolver:
         S = int(steps or params.get("search_chunk") or 0)
         ib = int(i_bound or params.get("i_bound") or 0)
         budget_mb = float(params.get("budget_mb") or 0.0)
+        self.seed_incumbent = bool(
+            params.get("seed_incumbent", seed_incumbent))
         if bound_budget_bytes is None and budget_mb > 0:
             bound_budget_bytes = int(budget_mb * 2**20)
         self.max_chunks = int(max_chunks)
@@ -190,6 +193,24 @@ class FrontierSearchSolver:
         if not warm:
             self._stash = []
             self._lb_best = -np.inf
+        if not warm and self.seed_incumbent:
+            # seed the incumbent with one beam rollout: pruning
+            # starts on the first chunk, and the anytime answer is a
+            # real leaf even if best-first never reaches one
+            # width grows with n: tight feasibility structure (exact
+            # capacities, forbidden values) needs more surviving
+            # alternatives the deeper the rollout goes
+            dive_assign, dive_g = self.engine.beam_dive(
+                width=max(64, 4 * self.n))
+            if dive_g < BIG / 2:
+                import jax.numpy as jnp
+
+                state = {
+                    **state,
+                    "incumbent": jnp.float32(dive_g),
+                    "best_assign": jnp.asarray(
+                        dive_assign, jnp.int32),
+                }
         counters = SearchCounters()
         history: List[Dict[str, Any]] = []
         status = "FINISHED"

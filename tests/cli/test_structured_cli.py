@@ -1,0 +1,63 @@
+"""CLI surface of the table-free structured constraints (ISSUE 17):
+``generate routing_structured`` emits a 100-arity window as a few KB
+of parameters, and ``solve`` runs it end-to-end — maxsum (table-free
+message kernels) and the frontier engine (feasible anytime answer) —
+where the dense path's 4^100 table is physically impossible.  This is
+the ``make structured-smoke`` pipeline."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "PYTHONPATH": REPO,
+}
+
+
+def run_cli(*args, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu", *args],
+        capture_output=True, text=True, timeout=timeout, env=ENV,
+        cwd=REPO,
+    )
+
+
+def _generate(path, n=100):
+    r = run_cli(
+        "-o", str(path), "generate", "routing_structured",
+        "-V", str(n), "--window", str(n), "--p_soft", "0",
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    return path
+
+
+class TestStructuredCli:
+    def test_generate_emits_parameter_form(self, tmp_path):
+        y = _generate(tmp_path / "rs.yaml", n=100)
+        text = y.read_text()
+        # 100-arity as parameters, not a table: the whole file is KBs
+        assert "type: structured" in text
+        assert os.path.getsize(y) < 100_000
+
+    def test_maxsum_solves_hundred_arity(self, tmp_path):
+        y = _generate(tmp_path / "rs.yaml", n=100)
+        r = run_cli("solve", "--algo", "maxsum", "--cycles", "5",
+                    str(y))
+        assert r.returncode == 0, r.stderr[-800:]
+        out = json.loads(r.stdout)
+        assert len(out["assignment"]) == 100
+
+    def test_frontier_finds_feasible_hundred_arity(self, tmp_path):
+        y = _generate(tmp_path / "rs.yaml", n=100)
+        r = run_cli("solve", "--algo", "syncbb", "--anytime-exact",
+                    "--i-bound", "2", "--cycles", "5", str(y))
+        assert r.returncode == 0, r.stderr[-800:]
+        out = json.loads(r.stdout)
+        # exact caps + barred slots: the beam-seeded incumbent is a
+        # real feasible leaf, not the all-zero default
+        assert out["violation"] == 0
+        assert 0.0 < out["cost"] < 1000.0

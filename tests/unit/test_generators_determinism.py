@@ -22,6 +22,7 @@ from pydcop_tpu.generators import (
     generate_meeting_scheduling,
     generate_meetings_peav,
     generate_routing,
+    generate_routing_structured,
     generate_scenario,
     generate_secp,
     generate_smallworld,
@@ -48,6 +49,10 @@ FAMILIES = {
     "routing": lambda seed: generate_routing(10, n_slots=4, seed=seed),
     "routing_infeasible": lambda seed: generate_routing(
         8, n_slots=4, infeasible=True, seed=seed),
+    "routing_structured": lambda seed: generate_routing_structured(
+        10, n_slots=4, p_soft=0.3, seed=seed),
+    "routing_structured_wide": lambda seed: generate_routing_structured(
+        24, n_slots=4, window=12, seed=seed),
     "tracking": lambda seed: generate_tracking(
         16, n_targets=2, seed=seed),
 }
@@ -83,6 +88,40 @@ class TestGeneratorDeterminism:
 
         assert build(5) == build(5)
         assert build(5) != build(6)
+
+
+class TestStructuredRoundTrip:
+    """Table-free satellite: ``type: structured`` YAML round-trips by
+    parameters — loading must NOT silently densify (the old behavior),
+    and dump(load(dump(d))) is byte-canonical."""
+
+    def test_yaml_round_trip_preserves_structure(self):
+        from pydcop_tpu.dcop.structured import StructuredConstraint
+        from pydcop_tpu.dcop.yamldcop import load_dcop
+
+        d = generate_routing_structured(10, n_slots=4, p_soft=0.3, seed=2)
+        y1 = dcop_yaml(d)
+        d2 = load_dcop(y1)
+        assert dcop_yaml(d2) == y1
+        orig = {c.name for c in d.constraints.values()
+                if isinstance(c, StructuredConstraint)}
+        back = {c.name for c in d2.constraints.values()
+                if isinstance(c, StructuredConstraint)}
+        assert orig and back == orig
+
+    def test_wide_window_dumps_without_densifying(self):
+        from pydcop_tpu.dcop.structured import StructuredConstraint
+        from pydcop_tpu.dcop.yamldcop import load_dcop
+
+        # the 100-arity window's dense twin would hold 4**100 entries;
+        # dumping succeeds only through the parameter form
+        d = generate_routing_structured(100, n_slots=4, window=100,
+                                        p_soft=0.0, seed=0)
+        d2 = load_dcop(dcop_yaml(d))
+        assert any(
+            isinstance(c, StructuredConstraint) and c.arity == 100
+            for c in d2.constraints.values()
+        )
 
 
 def _scenario_canon(scenario):

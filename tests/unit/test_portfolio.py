@@ -137,6 +137,73 @@ class TestFeatures:
         assert wall < 60.0, f"featurize took {wall:.1f}s on 100k vars"
 
 
+class TestStructuredRouting:
+    """Table-free constraints in the portfolio (ISSUE 17): the
+    featurizer carries the structure census without materializing a
+    table, and the selector masks every cell that would have to."""
+
+    def _structured(self, n=20):
+        from pydcop_tpu.generators import generate_routing_structured
+
+        return generate_routing_structured(
+            n, window=n, p_soft=0.0, seed=0,
+        )
+
+    def test_structure_features_are_analytic(self):
+        vec, info = featurize_detail(self._structured(20))
+        assert np.isfinite(vec).all()
+        assert info["n_structured"] == 1
+        assert info["structured_kinds"] == {"resource": 1}
+        assert 0.0 < info["structured_frac"] <= 1.0
+        # 4^20 entries * 4 bytes — far past the densify cap, computed
+        # as pure arithmetic (the test budget itself pins that no
+        # table of this size was ever built)
+        assert info["structured_dense_bytes"] == pytest.approx(
+            4.0 * 4.0**20
+        )
+        assert info["structured_over_table_cap"] is True
+
+    def test_dense_instance_reports_zero_structure(self):
+        vec, info = featurize_detail(_gc(8))
+        assert info["n_structured"] == 0
+        assert info["structured_over_table_cap"] is False
+        assert vec[-3] == 0.0              # structured_frac
+        assert vec[-1] == pytest.approx(np.log10(4.0))
+
+    def test_mask_leaves_only_table_free_cells(self):
+        _vec, info = featurize_detail(self._structured(20))
+        feasible, masked = feasible_grid(
+            DEFAULT_GRID, info, n_devices=1
+        )
+        reasons = {c.key(): r for c, r in masked}
+        # the weighted family has no tensors to weight
+        assert any(c.algo == "gdba" for c, _ in masked)
+        # the bounded mini-bucket tier is table-bound: masked; the
+        # auto tier survives only because it routes to the frontier
+        feas_dpop = {c.engine for c in feasible if c.algo == "dpop"}
+        assert feas_dpop <= {"auto"}
+        assert any(
+            c.algo == "dpop" and "table cap" in r for c, r in masked
+        )
+        # the table-free paths stay on the menu
+        assert any(c.algo == "maxsum" for c in feasible)
+        assert any(c.algo == "syncbb" for c in feasible)
+        assert all("densify" in r or "weighting" in r
+                   or "table" in r for r in reasons.values())
+
+    def test_heuristic_pick_is_table_free(self):
+        _vec, info = featurize_detail(self._structured(20))
+        feasible, _ = feasible_grid(DEFAULT_GRID, info, n_devices=1)
+        cfg = heuristic_config(info)
+        # whatever the fallback picks for this regime, it must be a
+        # cell the mask kept — the selector never lands on a config
+        # that would raise a densify refusal
+        assert cfg.algo != "gdba"
+        assert cfg in feasible or any(
+            c.algo == cfg.algo for c in feasible
+        )
+
+
 # ---------------------------------------------------------------------------
 # selection: masks, heuristic fallback, typed refusals
 # ---------------------------------------------------------------------------

@@ -417,8 +417,11 @@ class TestCheckpointResume:
         from pydcop_tpu.runtime.run import solve_result
 
         dcop = _clique(9, 4, 5)
+        # incumbent seeding off: the point is cutting a MULTI-chunk
+        # run short and resuming, and the seeded dive proves this
+        # instance within a single chunk
         params = {"engine": "frontier", "frontier_width": 64,
-                  "search_chunk": 2}
+                  "search_chunk": 2, "seed_incumbent": False}
         clean = solve_result(dcop, "syncbb", algo_params=params)
         assert clean.search["optimal"] and clean.cycle > 2
         # cut the run short, snapshots on; then resume to completion
