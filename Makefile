@@ -6,7 +6,7 @@
 	dpop-smoke bench-auto portfolio-smoke bench-fleet fleet-smoke \
 	bench-twin twin-smoke bench-r06 analyze bench-search search-smoke \
 	bench-r08 bench-pfleet pfleet-smoke bench-structured \
-	structured-smoke bench-r09
+	structured-smoke bench-r09 bench-memo memo-smoke bench-r10
 
 test: all-tests
 
@@ -117,6 +117,29 @@ structured-smoke:
 # with a machine-readable BENCH_r09.json snapshot (ISSUE 17 satellite)
 bench-r09:
 	python bench.py --only r09 --snapshot BENCH_r09.json
+
+# cross-request solution cache smoke (ISSUE 18): serve a seeded
+# duplicate trace twice through the real CLI — the second pass
+# rehydrates the persisted cache and must hit; the slow leg SIGKILLs
+# the service mid-trace and asserts `--resume` rehydrates the CRC'd
+# entries with bit-identical answers.  Run it whenever touching
+# pydcop_tpu/serve/memo.py or dcop/canonical.py
+memo-smoke:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/cli/test_memo_cli.py -q
+
+# solution-cache bench only: hit taxonomy on a duplicate/variant/novel
+# trace, warm-vs-cold p50/p99 (drift-normalized), the k-edit variant
+# speedup pin and the per-algo never-worse booleans (docs/serving.rst
+# "Solution cache and warm-start serving", BENCHREF.md "Solution
+# cache")
+bench-memo:
+	python bench.py --only memo
+
+# the r09 legs + the solution-cache leg in one run with a
+# machine-readable BENCH_r10.json snapshot (ISSUE 18 satellite)
+bench-r10:
+	python bench.py --only r10 --snapshot BENCH_r10.json
 
 # fast sharded-DPOP smoke: the tiled-vs-single-device parity matrix,
 # pruning property and mini-bucket bound-sandwich tests on the CPU

@@ -2018,6 +2018,180 @@ def bench_churn(args, probe=None):
     return out
 
 
+def bench_memo(args, probe=None):
+    """Cross-request solution cache (ISSUE 18): the hit taxonomy on a
+    seeded duplicate/variant/novel request trace, warm-vs-cold request
+    latency (p50/p99, drift-normalized), the k-edit variant speedup
+    pin (``memo_variant_3x_better``), the per-warm-algo never-worse
+    booleans, and the fleet mid-trace-kill bit-match
+    (docs/serving.rst "Solution cache and warm-start serving").
+
+    The cold reference runs the SERVICE cycle budget (``max_cycles``
+    2000, the deployment default) — the comparison is "what would this
+    request have cost without the cache", not a truncated solve.  The
+    first variant serve pays a one-time YAML parse + warm-kernel
+    compile; like every other leg, one warmup request of each kind
+    runs before the timed trace so the steady-state rates are
+    compile-free.  ``churn_speedup``-style same-process ratios cancel
+    host drift; the absolute latencies are probe-normalized on top.
+    """
+    from pydcop_tpu.generators import generate_graph_coloring
+    from pydcop_tpu.runtime.repair import perturbed_constraint
+    from pydcop_tpu.runtime.run import solve_result
+    from pydcop_tpu.serve.memo import MemoCache, MemoConfig
+
+    V = args.memo_vars
+    algo = "mgm"
+    cold_cycles = 2000          # the serve-tier default budget
+    out = {"memo_vars": V, "memo_algo": algo,
+           "memo_cold_cycles": cold_cycles}
+
+    def inst(seed, n=V):
+        return generate_graph_coloring(
+            n_variables=n, n_colors=3, n_edges=2 * n - 2, soft=True,
+            seed=seed)
+
+    def edit(d, edit_seed, which=2):
+        name = sorted(d.constraints)[which % len(d.constraints)]
+        d.constraints[name] = perturbed_constraint(
+            d.constraints[name], seed=edit_seed)
+        return d
+
+    def cold(d, cycles=cold_cycles):
+        return solve_result(d, algo, seed=1, cycles=cycles)
+
+    # -- warmup: pay the compiles + the one-time YAML parse OUTSIDE
+    # the timed trace (seed 900 never reappears below) ----------------
+    wcache = MemoCache(MemoConfig())
+    w = inst(900)
+    wcache.memoize(wcache.probe(w, algo, seed=1), w, cold(w))
+    wv = edit(inst(900), 901)
+    wcache.serve_variant(wcache.probe(wv, algo, seed=1), wv)
+
+    # -- seeded trace: 4 novel bases, 8 exact duplicates, 4 one-edit
+    # variants — the "millions of users" shape in miniature -----------
+    bases = list(range(4))
+    trace = ([("novel", s, None) for s in bases]
+             + [("dup", s, None) for s in bases]
+             + [("variant", s, 100 + i) for i, s in enumerate(bases)]
+             + [("dup", s, None) for s in bases])
+    cache = MemoCache(MemoConfig())
+    lat = {"exact": [], "variant": [], "miss": []}
+    cold_variant = []
+    never_worse_trace = []
+    for kind, s, es in trace:
+        d = inst(s) if es is None else edit(inst(s), es)
+        t0 = time.perf_counter()
+        p = cache.probe(d, algo, seed=1)
+        if p.kind == "exact":
+            res = cache.result_from_entry(p.entry, p)
+        elif p.kind == "variant":
+            res = cache.serve_variant(p, d)
+            if res is None:        # never-worse fallback: solve cold
+                res = cold(d)
+                cache.memoize(p, d, res)
+        else:
+            res = cold(d)
+            cache.memoize(p, d, res)
+        lat[p.kind].append(time.perf_counter() - t0)
+        if kind == "variant":
+            # the cold reference for the SAME variant request,
+            # measured in the same process right after the warm serve
+            t1 = time.perf_counter()
+            rc = cold(d)
+            cold_variant.append(time.perf_counter() - t1)
+            if res.cost is not None and rc.cost is not None:
+                never_worse_trace.append(res.cost <= rc.cost + 1e-6)
+
+    st = cache.stats()
+    n_req = len(trace)
+    out["memo_trace_requests"] = n_req
+    out["memo_hits_exact"] = st["hits_exact"]
+    out["memo_hits_variant"] = st["hits_variant"]
+    out["memo_misses"] = st["misses"]
+    out["memo_cold_fallbacks"] = st["variant_cold_fallbacks"]
+    out["memo_hit_rate"] = round(
+        (st["hits_exact"] + st["hits_variant"]) / n_req, 4)
+    for k in ("exact", "variant", "miss"):
+        if lat[k]:
+            out[f"memo_{k}_p50_ms"] = round(
+                float(np.percentile(lat[k], 50)) * 1000, 3)
+            out[f"memo_{k}_p99_ms"] = round(
+                float(np.percentile(lat[k], 99)) * 1000, 3)
+    if lat["variant"] and cold_variant:
+        warm_mean = float(np.mean(lat["variant"]))
+        cold_mean = float(np.mean(cold_variant))
+        out["memo_variant_speedup"] = round(cold_mean / warm_mean, 2)
+        out["memo_variant_3x_better"] = (
+            out["memo_variant_speedup"] >= 3.0)
+    out["memo_never_worse_trace"] = (
+        bool(never_worse_trace) and all(never_worse_trace))
+    if probe is not None:
+        pr = probe()
+        if pr:
+            for k in ("exact", "variant", "miss"):
+                if lat[k]:
+                    out[f"memo_{k}_normalized"] = round(
+                        float(np.mean(lat[k])) * pr, 6)
+
+    # -- never-worse guarantee, pinned per warm-capable algo (small
+    # instances: the booleans are the product, not the rates) ---------
+    for a in ("mgm", "dsa", "adsa", "maxsum"):
+        c = MemoCache(MemoConfig())
+        d = inst(11, n=60)
+        p = c.probe(d, a, seed=1)
+        c.memoize(p, d, solve_result(d, a, seed=1, cycles=300))
+        v = edit(inst(11, n=60), 33)
+        pv = c.probe(v, a, seed=1)
+        okflag = True
+        if pv.kind == "variant":
+            r = c.serve_variant(pv, v)
+            if r is not None:      # served: must not regress cold
+                rc = solve_result(v, a, seed=1, cycles=300)
+                okflag = (r.cost is not None and rc.cost is not None
+                          and r.cost <= rc.cost + 1e-6)
+            # r is None = cold fallback: the guarantee held by refusal
+        out[f"memo_never_worse_{a}"] = bool(okflag)
+
+    # -- fleet mid-trace kill: entries shared through the journal tap
+    # survive a replica kill — duplicates of EVERY base (including
+    # those solved on the dead replica) still exact-hit bit-identically
+    # on the survivor ------------------------------------------------
+    from pydcop_tpu.serve.fleet import SolveFleet
+
+    t0 = time.perf_counter()
+    fl = SolveFleet(replicas=2, lanes=2, max_cycles=cold_cycles,
+                    memo=MemoConfig())
+
+    def drain(jid, max_ticks=3000):
+        for _ in range(max_ticks):
+            fl.tick()
+            try:
+                return fl.result(jid, timeout=0.01)
+            except TimeoutError:
+                continue
+        return fl.result(jid, timeout=1)
+
+    try:
+        first = {s: drain(fl.submit(inst(s), algo, seed=1))
+                 for s in bases}
+        fl.handle(0).kill()            # mid-trace replica kill
+        bitmatch, kill_hits = True, 0
+        for s in bases:
+            r = drain(fl.submit(inst(s), algo, seed=1))
+            if (r.memo or {}).get("hit") == "exact":
+                kill_hits += 1
+            if (r.assignment != first[s].assignment
+                    or r.cost != first[s].cost):
+                bitmatch = False
+    finally:
+        fl.stop(drain=False)
+    out["memo_fleet_kill_exact_hits"] = kill_hits
+    out["memo_fleet_kill_bitmatch"] = bool(bitmatch)
+    out["memo_fleet_wall_s"] = round(time.perf_counter() - t0, 3)
+    return out
+
+
 def bench_auto(args, probe=None):
     """Learned-portfolio auto-selection (ISSUE 10): train the cost
     model on a seeded sweep of TRAINING families, then score a
@@ -3330,6 +3504,12 @@ def main():
                     help="cold-baseline mutations (each pays a full "
                     "repack + XLA recompile, so the baseline is capped "
                     "and reported as a per-mutation mean)")
+    # solution-cache leg (ISSUE 18; BENCHREF.md "Solution cache")
+    ap.add_argument("--memo-vars", type=int, default=800,
+                    help="instance size of the solution-cache trace "
+                    "(big enough that a cold solve visibly costs, "
+                    "small enough that the 16-request trace stays "
+                    "in minutes)")
     ap.add_argument("--edges", type=int, default=30_000)
     ap.add_argument("--colors", type=int, default=3)
     ap.add_argument(
@@ -3471,7 +3651,7 @@ def main():
                  "pfleet", "churn",
                  "auto", "twin", "elastic", "elastic-inner", "search",
                  "search-inner", "structured", "structured-inner",
-                 "r06", "r07", "r08", "r09"],
+                 "memo", "r06", "r07", "r08", "r09", "r10"],
         default="all",
     )
     # watchdog covers the FULL run: the wholesweep DPOP kernel compile
@@ -3482,6 +3662,50 @@ def main():
     args = ap.parse_args()
     if args.cycles is None:
         args.cycles = 50 if args.stretch else 2000
+
+    if args.only == "r10":
+        # consolidated r10 record (ISSUE 18 satellite): the r09 legs
+        # plus the solution-cache leg, EACH in a fresh subprocess
+        # (same isolation rationale as r06 below)
+        legs = ("serve", "churn", "dpop-sharded", "auto", "fleet",
+                "pfleet", "twin", "elastic", "search", "structured",
+                "memo")
+        fwd = []
+        skip_next = False
+        for a in sys.argv[1:]:
+            if skip_next:
+                skip_next = False
+                continue
+            if a in ("--only", "--snapshot"):
+                skip_next = True
+                continue
+            if a.startswith(("--only=", "--snapshot=")):
+                continue
+            fwd.append(a)
+        extra = {}
+        for leg in legs:
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--only", leg] + fwd
+            try:
+                r = subprocess.run(
+                    cmd, capture_output=True, text=True, timeout=3000,
+                )
+                parsed = json.loads(
+                    r.stdout.strip().splitlines()[-1]
+                )
+                extra.update(parsed.get("extra", {}))
+            except Exception as e:
+                extra[f"{leg}_error"] = repr(e)[:500]
+        out = {
+            "metric": "r10_consolidated",
+            "value": extra.get("memo_variant_speedup", 0.0),
+            "unit": "x (cold solve / warm variant serve)",
+            "vs_baseline": 0.0,
+            "extra": extra,
+        }
+        _maybe_snapshot(args, out)
+        print(json.dumps(out), flush=True)
+        return
 
     if args.only == "r09":
         # consolidated r09 record (ISSUE 17 satellite): the r08 legs
@@ -3760,7 +3984,8 @@ def main():
     # measurement so both see the same tunnel state
     probe = None
     if args.only in ("all", "maxsum", "probe", "batch", "harness",
-                     "serve", "fleet", "pfleet", "churn", "twin"):
+                     "serve", "fleet", "pfleet", "churn", "twin",
+                     "memo"):
         try:
             probe = make_drift_probe(repeat=args.repeat)
         except Exception as e:
@@ -3909,6 +4134,15 @@ def main():
             extra.update(bench_churn(args, probe=probe))
         except Exception as e:
             extra["churn_error"] = repr(e)
+
+    if args.only in ("all", "memo"):
+        # cross-request solution cache (ISSUE 18): hit taxonomy,
+        # warm-vs-cold latency, the variant-speedup pin and the fleet
+        # mid-trace-kill bit-match (BENCHREF.md "Solution cache")
+        try:
+            extra.update(bench_memo(args, probe=probe))
+        except Exception as e:
+            extra["memo_error"] = repr(e)
 
     if args.only in ("all", "twin"):
         # city-scale digital twin (ISSUE 12): the combined sustained
@@ -4076,16 +4310,19 @@ def main():
     if args.only in ("dpop", "local", "convergence", "convergence2",
                      "scalefree", "mixed", "sharded", "dpop-sharded",
                      "probe", "batch", "harness", "serve", "churn",
-                     "auto", "twin") \
+                     "auto", "twin", "memo") \
             and not value:
         # single-part run: promote the part's headline measurement (not
         # config constants like stretch_vars) to the primary slot
         headline = ("_per_sec", "_wall_s", "_cycles_per", "probe_rate",
                     "batch_throughput", "serve_throughput",
                     "churn_speedup", "auto_speedup",
+                    "memo_variant_speedup",
                     "twin_gold_attainment_ladder_on")
         if args.only == "twin":
             headline = ("twin_gold_attainment_ladder_on",) + headline
+        if args.only == "memo":
+            headline = ("memo_variant_speedup",) + headline
         k = next(
             (k for k in extra if any(h in k for h in headline)),
             next((k for k in extra if not k.endswith("_error")), None),

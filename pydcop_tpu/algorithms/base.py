@@ -100,6 +100,13 @@ class SolveResult:
     #: failover paths stay auditable post-hoc; None for solves that
     #: never passed through the serve tier
     serve: Optional[Dict[str, Any]] = None
+    #: solution-cache provenance ({"hit": "exact"|"variant"|"miss",
+    #: "key", "edits", "distance", "seed_cost", "cold_fallback"}) —
+    #: how the cross-request cache served this job (bit-identical
+    #: replay, warm-started repair, or a plain solve), attached by
+    #: the serve tier's memo layer (pydcop_tpu.serve.memo); None for
+    #: solves that never consulted it
+    memo: Optional[Dict[str, Any]] = None
     #: device-fault-tier scorecard (runtime/stats.IntegrityCounters:
     #: sentinel trips, scrub runs/mismatches, SDC detections, elastic
     #: shrinks, cold repacks, devices lost), attached by the elastic
@@ -133,6 +140,8 @@ class SolveResult:
             out["portfolio"] = dict(self.portfolio)
         if self.serve is not None:
             out["serve"] = dict(self.serve)
+        if self.memo is not None:
+            out["memo"] = dict(self.memo)
         if self.integrity is not None:
             out["integrity"] = dict(self.integrity)
         return out

@@ -85,8 +85,12 @@ WAIVER_RE = re.compile(r"#\s*analyze:\s*waive\[([^\]]*)\]\s*(.*)$")
 #: supervisor thread while submit paths read them; serve/wire.py,
 #: whose hub endpoints are shared between pump and send callers; and
 #: serve/artifacts.py, racing store mutations across processes via
-#: atomic renames).  twin.py is listed explicitly.  ``<string>`` keeps
-#: in-memory fixtures (tests) in scope.
+#: atomic renames).  twin.py is listed explicitly.  Since ISSUE 18
+#: the solution cache (serve/memo.py) rides the serve/ prefix too:
+#: its entry map is probed by scheduler threads while fleet adoption
+#: taps and churn/TTL sweeps mutate it — every shared-map touch must
+#: hold the cache lock.  ``<string>`` keeps in-memory fixtures
+#: (tests) in scope.
 RACE_SCOPE = ("serve/", "serve\\", "batch/cache.py", "batch\\cache.py",
               "scenario/twin.py", "scenario\\twin.py", "<string>")
 

@@ -133,6 +133,26 @@ def set_parser(subparsers):
                         help="serve the GUI websocket protocol + HTTP "
                         "/state + SSE /events on this port (ws on "
                         "port+1), with serve.* events forwarded")
+    parser.add_argument("--memo", action="store_true",
+                        help="enable the cross-request solution cache "
+                        "(docs/serving.rst 'Solution cache and "
+                        "warm-start serving'): exact duplicates are "
+                        "served bit-identically from the cache, "
+                        "near-duplicates warm-start from the nearest "
+                        "cached solution — never worse than a cold "
+                        "solve.  Persisted beside the journal when "
+                        "--journal-dir is given; --resume rehydrates")
+    parser.add_argument("--memo-ttl", type=float, default=3600.0,
+                        help="solution-cache entry time-to-live in "
+                        "seconds")
+    parser.add_argument("--memo-max-edits", type=int, default=8,
+                        help="max factor-diff edits for a warm-start "
+                        "variant hit (beyond it: cold solve)")
+    parser.add_argument("--seed-period", type=int, default=None,
+                        help="cycle job seeds with this period "
+                        "instead of 0..N-1 — with one file, jobs i "
+                        "and i+PERIOD are exact duplicates (the memo "
+                        "smoke's duplicate trace)")
     return parser
 
 
@@ -195,6 +215,14 @@ def run_cmd(args):
             )
             return 1
 
+    memo_cfg = None
+    if args.memo:
+        from pydcop_tpu.serve import MemoConfig
+
+        memo_cfg = MemoConfig(
+            ttl_s=args.memo_ttl, max_edits=args.memo_max_edits,
+        )
+
     fleet = None
     if args.replicas > 1 and args.processes:
         from pydcop_tpu.serve import ProcessFleet
@@ -216,6 +244,7 @@ def run_cmd(args):
             max_pending=args.max_pending,
             tenant_quota=args.tenant_quota,
             fault_plan=fault_plan,
+            memo=memo_cfg,
         )
         fleet.wait_ready()
         service = fleet
@@ -231,6 +260,7 @@ def run_cmd(args):
             # the production front door shares the persistent XLA
             # cache dir across replicas and restarts
             shared_xla_cache=bool(args.journal_dir),
+            memo=memo_cfg,
         )
         service = fleet  # same submit/result/stop surface below
     else:
@@ -241,6 +271,7 @@ def run_cmd(args):
             max_pending=args.max_pending,
             tenant_quota=args.tenant_quota,
             fault_plan=fault_plan,
+            memo=memo_cfg,
         )
     n_resumed = 0
     if args.resume:
@@ -277,9 +308,10 @@ def run_cmd(args):
         wait = offsets[i] - (time.monotonic() - t0)
         if wait > 0:
             time.sleep(wait)
+        seed = i if args.seed_period is None else i % args.seed_period
         try:
             jids.append(service.submit(
-                dcop, args.algo, algo_params=algo_params, seed=i,
+                dcop, args.algo, algo_params=algo_params, seed=seed,
                 priority=args.priority, deadline_s=args.deadline,
                 label=f"{fn}:{i}", source_file=fn,
             ))

@@ -54,6 +54,10 @@ def set_parser(subparsers):
     parser.add_argument("--stats-interval", type=float, default=0.25,
                         help="seconds between counter/cache-key "
                         "snapshots streamed to the head")
+    parser.add_argument("--memo", action="store_true",
+                        help="enable the cross-request solution cache "
+                        "(entries persisted under the journal dir and "
+                        "shared fleet-wide via memo_adopt frames)")
     return parser
 
 
@@ -74,6 +78,7 @@ def run_cmd(args):
         max_buckets=args.max_buckets,
         fault_plan=FaultPlan.from_env(),
         stats_interval=args.stats_interval,
+        memo=bool(getattr(args, "memo", False)),
     )
     return worker.run()
 

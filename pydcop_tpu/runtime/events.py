@@ -102,6 +102,28 @@ def send_serve(event: str, payload) -> None:
     event_bus.send(SERVE_TOPIC_PREFIX + event, payload)
 
 
+#: solution-cache topic prefix (pydcop_tpu.serve.memo).  Topics:
+#: ``memo.hit.exact`` (jid, tenant, key — a submitted instance's
+#: content hash matched a cached solve, served bit-identically),
+#: ``memo.hit.variant`` (jid, tenant, key, edits, distance — a
+#: near-duplicate served by warm-repairing the nearest cached
+#: instance), ``memo.miss`` (jid, tenant), ``memo.insert`` (key,
+#: tenant, cost), ``memo.invalidate`` (tenant, reason ∈ {ttl, churn},
+#: dropped), ``memo.fallback.cold`` (jid, reason — a warm repair
+#: converged worse than its seed or exhausted headroom; the cold
+#: result was served instead, upholding the never-worse guarantee)
+#: and ``memo.corrupt.skipped`` (path — a CRC-failed entry skipped on
+#: rehydrate/adopt) — subscribe with ``memo.*`` (the UI server pushes
+#: them to ws/SSE clients alongside ``serve.*``).
+MEMO_TOPIC_PREFIX = "memo."
+
+
+def send_memo(event: str, payload) -> None:
+    """Publish a solution-cache event on the global bus (no-op unless
+    observability is enabled)."""
+    event_bus.send(MEMO_TOPIC_PREFIX + event, payload)
+
+
 #: solve-fleet topic prefix (pydcop_tpu.serve.fleet).  Topics:
 #: ``fleet.replica.up`` / ``fleet.replica.down`` (name, reason — a
 #: replica joined the fleet / was declared dead by the supervisor),

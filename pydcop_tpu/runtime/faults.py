@@ -53,9 +53,12 @@ ENV_FAULT_ATTEMPT = "PYDCOP_TPU_FAULT_ATTEMPT"
 #: pydcop_tpu.serve.SolveService) — ``raise_in_step`` throws inside a
 #: bucket's chunk step, ``nan_lane`` poisons one lane's float state,
 #: ``torn_journal_write`` cuts a journal append short mid-line, and
-#: ``stall_tick`` wedges one scheduler tick for ``duration`` seconds
+#: ``stall_tick`` wedges one scheduler tick for ``duration`` seconds,
+#: and ``corrupt_cache_entry`` flips bytes in a persisted solution-
+#: cache entry right after it is written (serve/memo.py) — the CRC
+#: check at rehydrate/adopt time must skip-and-count it, never serve it
 SERVE_KINDS = ("raise_in_step", "nan_lane", "torn_journal_write",
-               "stall_tick")
+               "stall_tick", "corrupt_cache_entry")
 
 #: agent-churn / live-mutation fault kinds (consumed by the
 #: orchestrator's warm-repair path, runtime/repair.py) —
@@ -133,6 +136,7 @@ KIND_FIELDS: Dict[str, Tuple[str, ...]] = {
     "nan_lane": ("jid",),
     "torn_journal_write": ("jid",),
     "stall_tick": ("duration",),
+    "corrupt_cache_entry": ("jid",),
     "edit_factor": ("constraint",),
     "remove_agent_burst": ("count",),
     "add_agent_burst": ("count",),
@@ -253,6 +257,11 @@ class FaultPlan:
           - kind: torn_journal_write   # serve: cut an append mid-line
           - kind: stall_tick           # serve: wedge one tick
             duration: 0.5
+          - kind: corrupt_cache_entry  # serve: flip bytes in the
+            jid: job-000002            # solution-cache npz written for
+                                       # this job (omit jid: the next
+                                       # insert); rehydrate/adopt must
+                                       # skip-and-count it, never serve
           - kind: edit_factor          # churn: hot-swap a constraint's
             cycle: 10                  # table (seeded perturbation);
             constraint: c12            # omit 'constraint' for a seeded

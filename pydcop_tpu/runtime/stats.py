@@ -321,6 +321,8 @@ FLEET_COUNTERS = (
                                # (partition_socket faults)
     "artifacts_corrupted",     # serialized runner artifacts corrupted
                                # in place (corrupt_artifact faults)
+    "memo_shared",             # solution-cache entries broadcast to
+                               # peer replicas via the journal stream
 )
 
 
@@ -336,6 +338,51 @@ class FleetCounters:
             raise KeyError(
                 f"unknown fleet counter {name!r}; add it to "
                 f"FLEET_COUNTERS"
+            )
+        self.counts[name] += n
+
+    def as_dict(self) -> dict:
+        return dict(self.counts)
+
+
+#: counter names surfaced under ``metrics()["memo"]`` by the
+#: cross-request solution cache (pydcop_tpu.serve.memo.MemoCache) —
+#: the hit-taxonomy / invalidation / sharing scorecard of a serving
+#: session (docs/serving.rst "Solution cache and warm-start serving")
+MEMO_COUNTERS = (
+    "hits_exact",              # content-hash exact-duplicate hits
+    "hits_variant",            # embedding-matched warm-start hits
+    "misses",                  # lookups that found nothing servable
+    "inserts",                 # solved jobs added to the cache
+    "evicted_lru",             # entries displaced at max_entries
+    "expired_ttl",             # entries dropped past their TTL
+    "invalidated_churn",       # entries dropped by a churn event
+    "variant_rejected_gate",   # candidates refused by the feasibility
+                               # gate (shape mismatch / diff too large)
+    "variant_cold_fallbacks",  # warm repairs discarded for converging
+                               # worse than their seed (never-worse
+                               # guarantee: the cold result is served)
+    "variant_repacks",         # headroom-exhausted repacks during replay
+    "corrupt_skipped",         # CRC-failed npz entries skipped-and-
+                               # counted on rehydrate/adopt, never served
+    "rehydrated",              # entries restored from disk by resume()
+    "adopted",                 # entries adopted from fleet peers via the
+                               # journal stream (thread + socket wire)
+)
+
+
+class MemoCounters:
+    """Solution-cache counters collected by the MemoCache and merged
+    into the serve summary (``SolveService.metrics()['memo']``)."""
+
+    def __init__(self):
+        self.counts = {k: 0 for k in MEMO_COUNTERS}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        if name not in self.counts:
+            raise KeyError(
+                f"unknown memo counter {name!r}; add it to "
+                f"MEMO_COUNTERS"
             )
         self.counts[name] += n
 

@@ -59,6 +59,7 @@ class UiServer:
         event_bus.subscribe("dpop.*", self._cb_dpop)
         event_bus.subscribe("search.*", self._cb_search)
         event_bus.subscribe("serve.*", self._cb_serve)
+        event_bus.subscribe("memo.*", self._cb_memo)
         event_bus.subscribe("fleet.*", self._cb_fleet)
         event_bus.subscribe("portfolio.*", self._cb_portfolio)
         event_bus.subscribe("slo.*", self._cb_slo)
@@ -272,6 +273,23 @@ class UiServer:
                                                  float, bool, type(None)))
                  else repr(evt)}))
 
+    def _cb_memo(self, topic: str, evt) -> None:
+        """Solution-cache lifecycle (memo.hit.exact|variant, memo.miss,
+        memo.insert, memo.invalidate, memo.fallback.cold,
+        memo.corrupt.skipped — the cross-request cache's hit taxonomy
+        and invalidation audit, docs/serving.rst "Solution cache and
+        warm-start serving") pushed to GUI clients in the same
+        envelope shape as the serve.* forwarding; the SSE /events
+        stream gets them through the wildcard subscription like every
+        topic."""
+        if self._ws is not None:
+            self._ws.send_all(json.dumps(
+                {"evt": "memo",
+                 "kind": topic.split(".", 1)[-1],
+                 "data": evt if isinstance(evt, (dict, list, str, int,
+                                                 float, bool, type(None)))
+                 else repr(evt)}))
+
     def _cb_fleet(self, topic: str, evt) -> None:
         """Solve-fleet lifecycle (fleet.replica.up|down|stalled|
         healed|partitioned, fleet.router.placed, fleet.job.reseated|
@@ -428,7 +446,8 @@ class UiServer:
                    self._cb_add_comp, self._cb_rem_comp, self._cb_fault,
                    self._cb_batch, self._cb_harness, self._cb_shard,
                    self._cb_dpop, self._cb_serve, self._cb_repair,
-                   self._cb_fleet, self._cb_portfolio, self._cb_slo):
+                   self._cb_memo, self._cb_fleet, self._cb_portfolio,
+                   self._cb_slo):
             event_bus.unsubscribe(cb)
         if self._server is not None:
             self._server.shutdown()

@@ -17,7 +17,12 @@ replica is a child *process* supervised by the watchdog protocol, the
 journal is a CRC-framed record stream over a local socket, and a
 relaunched or cold-joining replica bootstraps warm from shared
 ``jax.export``-style serialized runner artifacts — zero XLA compiles
-to first job.  See docs/serving.rst.
+to first job.  Above the compile cache sits the cross-request
+*solution* cache (:class:`MemoCache`): canonical-hash exact hits are
+served bit-identically without touching a lane, embedding-matched
+variants warm-start from the nearest cached solution and repair only
+the factor diff — guaranteed never worse than a cold solve.  See
+docs/serving.rst.
 """
 from pydcop_tpu.serve.artifacts import (  # noqa: F401
     ArtifactStore,
@@ -35,6 +40,12 @@ from pydcop_tpu.serve.fleet import (  # noqa: F401
     FleetJournal,
     ReplicaHandle,
     SolveFleet,
+)
+from pydcop_tpu.serve.memo import (  # noqa: F401
+    MemoCache,
+    MemoConfig,
+    MemoEntry,
+    MemoProbe,
 )
 from pydcop_tpu.serve.procfleet import (  # noqa: F401
     ProcessFleet,
@@ -64,6 +75,10 @@ __all__ = [
     "DeadlineInfeasible",
     "FleetJournal",
     "FleetRouter",
+    "MemoCache",
+    "MemoConfig",
+    "MemoEntry",
+    "MemoProbe",
     "ProcessFleet",
     "ProcessReplicaHandle",
     "ReplicaHandle",

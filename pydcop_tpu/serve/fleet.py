@@ -86,6 +86,7 @@ from pydcop_tpu.serve.errors import (
     ServiceOverloaded,
     ServiceStopped,
 )
+from pydcop_tpu.serve.memo import MEMO_SUBDIR, MemoCache, MemoConfig
 from pydcop_tpu.serve.router import FleetRouter, job_routing_key
 from pydcop_tpu.serve.service import (
     CKPT_SUBDIR,
@@ -298,6 +299,7 @@ class SolveFleet:
         shared_xla_cache: bool = False,
         counters: Optional[FleetCounters] = None,
         devices_per_replica: int = 8,
+        memo=None,
     ):
         self.lanes = int(lanes)
         self.max_cycles = int(max_cycles)
@@ -325,6 +327,17 @@ class SolveFleet:
         # spill at one bucket's worth of extra queue: warmth decides
         # placement at the margin, load in the bulk (router docstring)
         self.router = FleetRouter(spill_load=self.lanes)
+        #: solution-memo config shared by every replica cache.  Each
+        #: replica owns its OWN MemoCache (persisted under its own
+        #: journal subdir, rehydrated by its own resume()) — fleet-wide
+        #: sharing happens through the insert tap below: a solved
+        #: instance memoised on one replica is adopted by every peer,
+        #: so a duplicate routed anywhere hits.
+        self.memo_cfg: Optional[MemoConfig] = None
+        if memo:
+            self.memo_cfg = (
+                memo if isinstance(memo, MemoConfig) else MemoConfig()
+            )
 
         self._jobs: Dict[str, FleetJob] = {}
         self._handles: Dict[str, ReplicaHandle] = {}
@@ -396,6 +409,14 @@ class SolveFleet:
             jd = os.path.join(self.journal_dir, name)
             os.makedirs(jd, exist_ok=True)
             hb = os.path.join(self.journal_dir, f"{name}.hb")
+        memo = None
+        if self.memo_cfg is not None:
+            memo = MemoCache(
+                self.memo_cfg,
+                directory=(
+                    os.path.join(jd, MEMO_SUBDIR) if jd else None
+                ),
+            )
         service = SolveService(
             lanes=self.lanes,
             cache=CompileCache(),  # per-replica L1: warmth is local
@@ -412,6 +433,7 @@ class SolveFleet:
             replica=name,
             heartbeat_path=hb,
             fault_plan=self._fault_plan,
+            memo=memo,
         )
         handle = ReplicaHandle(
             name=name, index=index, service=service,
@@ -423,6 +445,10 @@ class SolveFleet:
                 h, job, res
             )
         )
+        if memo is not None:
+            memo.on_insert = (
+                lambda entry, h=handle: self._on_memo_insert(h, entry)
+            )
         self._handles[name] = handle
         self.router.add_replica(name, warm_probe=service.cache.has)
         self.counters.inc("replicas_up")
@@ -688,6 +714,36 @@ class SolveFleet:
                         "jobs": rec["jobs"],
                         "rto_s": rec["rto_s"],
                     })
+
+    def _on_memo_insert(self, handle: ReplicaHandle, entry) -> None:
+        """The per-replica memo insert tap: stream a ``memo`` record to
+        the fleet journal and ADOPT the freshly-solved entry into every
+        peer replica's cache, so a duplicate of an instance first
+        solved on ``replica-0`` hits even when the router lands it on
+        ``replica-3``.  Adoption clones the entry (peer caches stay
+        independently evictable) and does not re-persist it — the
+        solving replica's npz is the durable copy; peers that restart
+        simply re-adopt on the next insert or rehydrate their own."""
+        if self.journal is not None:
+            self.journal.append({
+                "kind": "memo", "key": entry.key,
+                "tenant": entry.tenant, "algo": entry.algo,
+                "replica": handle.name,
+                "path": entry.path,
+            })
+        shared = 0
+        for peer in list(self._handles.values()):
+            if peer.name == handle.name:
+                continue
+            cache = getattr(peer.service, "memo", None)
+            if cache is not None and cache.adopt_entry(entry):
+                shared += 1
+        if shared:
+            self.counters.inc("memo_shared", shared)
+            send_fleet("memo.shared", {
+                "key": entry.key, "from": handle.name,
+                "peers": shared,
+            })
 
     def _on_replica_complete(self, handle: ReplicaHandle, job,
                              res: SolveResult) -> None:
@@ -1100,6 +1156,18 @@ class SolveFleet:
 
     # -- metrics ------------------------------------------------------------
 
+    def churn_event(self, tenant: Optional[str] = None) -> int:
+        """Fleet-wide memo invalidation: broadcast a churn event to
+        every replica's solution cache (see
+        :meth:`SolveService.churn_event`).  Returns total entries
+        dropped across the fleet."""
+        dropped = 0
+        for h in list(self._handles.values()):
+            fn = getattr(h.service, "churn_event", None)
+            if fn is not None:
+                dropped += fn(tenant)
+        return dropped
+
     def metrics(self) -> Dict[str, Any]:
         with self._lock:
             recov = [
@@ -1114,6 +1182,13 @@ class SolveFleet:
                     "partitioned": h.partition_until is not None,
                     "serve": h.service.counters.as_dict(),
                     "cache": h.service.cache.stats(),
+                    # ReplicaProxy (process fleet) has no memo attr:
+                    # child memo stats ride the child's own metrics
+                    "memo": (
+                        h.service.memo.stats()
+                        if getattr(h.service, "memo", None)
+                        is not None else None
+                    ),
                 }
                 for name, h in self._handles.items()
             }

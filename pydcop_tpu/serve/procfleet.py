@@ -413,6 +413,7 @@ class ProcessFleet(SolveFleet):
         backoff_max: float = 4.0,
         python: Optional[str] = None,
         child_env: Optional[Dict[str, str]] = None,
+        memo=None,
     ):
         if not journal_dir:
             raise ValueError(
@@ -449,6 +450,7 @@ class ProcessFleet(SolveFleet):
             supervise_interval=supervise_interval,
             shared_xla_cache=False, counters=counters,
             devices_per_replica=devices_per_replica,
+            memo=memo,
         )
         # child heartbeats beat regardless of how the head runs: judge
         # staleness in tick-driven mode too
@@ -508,6 +510,8 @@ class ProcessFleet(SolveFleet):
         ]
         if self.max_buckets is not None:
             cmd += ["--max-buckets", str(self.max_buckets)]
+        if self.memo_cfg is not None:
+            cmd += ["--memo"]
         env = {**os.environ, **self._child_env}
         # the artifact store replaces the persistent XLA cache in the
         # children — and the two must not coexist: an executable that
@@ -661,10 +665,51 @@ class ProcessFleet(SolveFleet):
             proxy.cache.update({}, body.get("keys"))
         elif evt == "reject":
             self._on_child_reject(h, proxy, body)
+        elif evt == "memo":
+            self._on_child_memo(h, body)
         elif evt == "journal":
             rec = body.get("record")
             if self.journal is not None and isinstance(rec, dict):
                 self.journal.append(rec)
+
+    def _on_child_memo(self, h: ProcessReplicaHandle,
+                       body: Dict[str, Any]) -> None:
+        """A child memoised a freshly-solved instance: journal the
+        record and tell every OTHER child to adopt the persisted entry
+        off the shared filesystem (``memo_adopt`` command → child-side
+        :meth:`MemoCache.adopt_file`, CRC-checked — a corrupt frame is
+        skipped-and-counted child-side, never served).  The socket-wire
+        twin of the thread fleet's in-memory adoption tap."""
+        path = body.get("path")
+        if self.journal is not None:
+            self.journal.append({
+                "kind": "memo", "key": body.get("key"),
+                "tenant": body.get("tenant"),
+                "algo": body.get("algo"),
+                "replica": h.name, "path": path,
+            })
+        if not path:
+            return
+        shared = 0
+        with self._lock:
+            peers = [
+                p.name for p in self._handles.values()
+                if p.name != h.name and p.up and not p.dead
+            ]
+        for peer in peers:
+            try:
+                self.hub.send(peer, {
+                    "cmd": "memo_adopt", "path": path,
+                })
+                shared += 1
+            except Exception:
+                pass  # a severed peer just misses this adoption
+        if shared:
+            self.counters.inc("memo_shared", shared)
+            send_fleet("memo.shared", {
+                "key": body.get("key"), "from": h.name,
+                "peers": shared,
+            })
 
     def _on_child_complete(self, h: ProcessReplicaHandle,
                            proxy: ReplicaProxy,
@@ -682,6 +727,7 @@ class ProcessFleet(SolveFleet):
         res.serve = r.get("serve")
         res.harness = r.get("harness")
         res.config = r.get("config")
+        res.memo = r.get("memo")
         proxy.job_closed()
         job = _RemoteJobView(
             jid=body.get("jid", ""), tenant=body.get("tenant", ""),
@@ -901,6 +947,7 @@ class ReplicaWorker:
         max_buckets: Optional[int] = None,
         fault_plan: Optional[FaultPlan] = None,
         stats_interval: float = 0.25,
+        memo: bool = False,
     ):
         from pydcop_tpu.serve.service import SolveService
 
@@ -929,6 +976,24 @@ class ReplicaWorker:
             except Exception:  # older jax without the option: fine
                 pass
         self.cache = CompileCache(artifacts=store)
+        memo_cache = None
+        if memo:
+            # persisted under THIS child's journal subdir (the shared
+            # filesystem): peers adopt the npz by path on memo_adopt
+            from pydcop_tpu.serve.memo import (
+                MEMO_SUBDIR,
+                MemoCache,
+                MemoConfig,
+            )
+
+            memo_cache = MemoCache(
+                MemoConfig(),
+                directory=(
+                    os.path.join(journal_dir, MEMO_SUBDIR)
+                    if journal_dir else None
+                ),
+                on_insert=self._queue_memo,
+            )
         self.service = SolveService(
             lanes=lanes, cache=self.cache,
             counters=ServeCounters(replica=name),
@@ -937,7 +1002,7 @@ class ReplicaWorker:
             max_buckets=max_buckets, max_pending=None,
             tenant_quota=None, replica=name,
             heartbeat_path=heartbeat_path, fault_plan=fault_plan,
-            on_complete=self._queue_complete,
+            on_complete=self._queue_complete, memo=memo_cache,
         )
         self.client = JournalClient(
             connect, name, on_record=self._on_command,
@@ -970,10 +1035,24 @@ class ReplicaWorker:
                 "serve": _json_safe(res.serve or {}),
                 "harness": _json_safe(res.harness),
                 "config": _json_safe(res.config),
+                "memo": _json_safe(res.memo),
             },
         }
         with self._outlock:
             self._outbox.append(body)
+
+    def _queue_memo(self, entry) -> None:
+        """Memo insert tap (scheduler thread): announce the persisted
+        entry to the head so peers adopt it.  An unpersisted entry
+        (no journal dir) has no shared-filesystem medium — skip."""
+        if not entry.path:
+            return
+        with self._outlock:
+            self._outbox.append({
+                "evt": "memo", "key": entry.key,
+                "tenant": entry.tenant, "algo": entry.algo,
+                "path": entry.path,
+            })
 
     # -- command dispatch (main loop) ----------------------------------------
 
@@ -1016,6 +1095,12 @@ class ReplicaWorker:
                 float(body.get("factor", 1.0)),
                 exempt_priority=body.get("exempt_priority"),
             )
+        elif cmd == "memo_adopt":
+            path = body.get("path")
+            if path and self.service.memo is not None:
+                # CRC-checked load: a corrupt entry is skipped-and-
+                # counted inside adopt_file, never served
+                self.service.memo.adopt_file(path)
         elif cmd == "stats":
             self._send_stats()
         elif cmd == "stop":

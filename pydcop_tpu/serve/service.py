@@ -172,6 +172,11 @@ class ServeJob:
     isolate_key: Optional[str] = None  # quarantine group tag
     lossy_notified: bool = False  # one serve.stream.lossy per job
     service_stopped: bool = False  # failed by a dead scheduler
+    # solution-cache bookkeeping (serve/memo.py): one probe per job,
+    # kept so completion can insert without re-canonicalizing
+    memo_checked: bool = False   # probe ran (exactly once per job)
+    memo_probe: Any = None       # the MemoProbe (hit artifacts)
+    memo_served: bool = False    # answered from cache, skip insert
 
     def restore_target(self) -> InstanceDims:
         """The exact padded target a checkpointed job must re-seat at
@@ -253,6 +258,7 @@ class SolveService:
         heartbeat_path: Optional[str] = None,
         on_complete: Optional[Callable[["ServeJob", SolveResult],
                                        None]] = None,
+        memo=None,
     ):
         self.lanes = int(lanes)
         self.max_buckets = max_buckets
@@ -318,6 +324,24 @@ class SolveService:
         #: (factor, exempt_priority) applied to every bucket's
         #: deadline-chunk clamp — the SLO ladder's rung-2 lever
         self._deadline_pressure: Tuple[float, Optional[int]] = (1.0, None)
+        #: cross-request solution cache (serve/memo.py, ISSUE 18):
+        #: ``memo`` is None/False (disabled), True / a MemoConfig
+        #: (build one, persisted beside the journal when there is
+        #: one), or a ready MemoCache (the fleet passes per-replica
+        #: caches wired with its sharing tap)
+        self.memo = None
+        if memo is not None and memo is not False:
+            from pydcop_tpu.serve.memo import (
+                MEMO_SUBDIR, MemoCache, MemoConfig,
+            )
+
+            if isinstance(memo, MemoCache):
+                self.memo = memo
+            else:
+                cfg = memo if isinstance(memo, MemoConfig) else None
+                mdir = (os.path.join(journal_dir, MEMO_SUBDIR)
+                        if journal_dir else None)
+                self.memo = MemoCache(cfg, directory=mdir)
         if journal_dir:
             os.makedirs(os.path.join(journal_dir, CKPT_SUBDIR),
                         exist_ok=True)
@@ -700,6 +724,16 @@ class SolveService:
                 return
             deadline = monotonic() + timeout
 
+    def churn_event(self, tenant: Optional[str] = None) -> int:
+        """A churn event (live mutation burst, scenario epoch, tenant
+        redeploy) makes cached RESULTS stale even though the service
+        itself is fine: drop the tenant's solution-cache namespace
+        (every tenant when None).  No-op without a memo cache; returns
+        the number of entries invalidated."""
+        if self.memo is None:
+            return 0
+        return self.memo.churn_event(tenant)
+
     def metrics(self) -> Dict[str, Any]:
         with self._lock:
             workers = [
@@ -708,12 +742,15 @@ class SolveService:
                 for w in self._workers
             ]
             pending = len(self._pending)
-        return {
+        out = {
             "serve": self.counters.as_dict(),
             "cache": self.cache.stats(),
             "workers": workers,
             "pending": pending,
         }
+        if self.memo is not None:
+            out["memo"] = self.memo.stats()
+        return out
 
     # -- prewarm ------------------------------------------------------------
 
@@ -1164,6 +1201,10 @@ class SolveService:
             if gated:  # quarantine backoff gate
                 not_ready.append(job)
                 continue
+            if self.memo is not None and not job.memo_checked:
+                job.memo_checked = True
+                if self._serve_from_memo(job):
+                    continue
             ready = self._prepare(job)
             if ready is False:
                 continue
@@ -1396,6 +1437,45 @@ class SolveService:
                 "jid": lane.job.jid, "cycle": cycle, "cost": cost,
             })
 
+    def _serve_from_memo(self, job: ServeJob) -> bool:
+        """Consult the cross-request solution cache (serve/memo.py)
+        before paying for admission.  Returns True when the job was
+        answered from cache (exact replay or warm-started variant
+        repair) — it never reaches a bucket; False routes it onward
+        with its probe attached so completion inserts the solve.
+
+        Runs on the scheduler thread like ``_solve_fallback``: the
+        exact path is O(canonicalize), the variant path does k warm
+        repairs — both far below a cold solve.
+        """
+        probe = self.memo.probe(
+            job.dcop, job.algo, algo_params=job.algo_params,
+            seed=job.seed, tenant=job.tenant,
+        )
+        job.memo_probe = probe
+        if probe.kind == "exact":
+            res = self.memo.result_from_entry(probe.entry, probe)
+            res.time = monotonic() - job.submitted_at
+            job.memo_served = True
+            self._complete(job, res)
+            return True
+        if probe.kind == "variant":
+            res = self.memo.serve_variant(
+                probe, job.dcop, algo_params=job.algo_params,
+            )
+            if res is not None:
+                res.time = monotonic() - job.submitted_at
+                job.memo_served = True
+                self._complete(job, res)
+                return True
+            # warm repair could not uphold the never-worse guarantee:
+            # mark the provenance and solve cold through the normal
+            # path (fallback counted by the cache)
+            probe.kind = "miss"
+            probe.cold_fallback = True
+            probe.entry = probe.diff = probe.distance = None
+        return False
+
     def _solve_fallback(self, job: ServeJob) -> None:
         """Algorithms outside the batched set solve sequentially on
         the scheduler thread — counted, never silently dropped."""
@@ -1453,6 +1533,24 @@ class SolveService:
             "jid": job.jid,
             "resumed": job.resumed,
         }
+        if self.memo is not None and job.memo_probe is not None:
+            job.memo_probe.decorate(res)
+            if (not job.memo_served and error is None
+                    and res.status == "FINISHED"):
+                entry = self.memo.memoize(job.memo_probe, job.dcop,
+                                          res)
+                inj = self._injector
+                if entry is not None and entry.path and inj is not None:
+                    # analyze: waive[unlocked-shared-attr] advisory tick stamp for the fault injector; a torn int read is impossible under the GIL
+                    due = inj.due("corrupt_cache_entry", self._ticks,
+                                  jid=job.jid)
+                    if due is not None:
+                        self.counters.inc("faults_injected")
+                        send_serve("fault.injected", {
+                            "kind": "corrupt_cache_entry",
+                            "jid": job.jid,
+                        })
+                        self.memo.corrupt_entry(entry.key)
         payload = {
             "jid": job.jid, "status": res.status, "cycle": res.cycle,
             "cost": res.cost, "latency": round(res.time, 4),
@@ -1683,6 +1781,13 @@ class SolveService:
             return 0
         from pydcop_tpu.dcop import load_dcop_from_file
         from pydcop_tpu.runtime.checkpoint import read_state_npz
+
+        if self.memo is not None:
+            # rehydrate the solution cache from its CRC'd npz entries
+            # beside the journal — a duplicate of an already-served
+            # job hits again right after the crash; corrupt entries
+            # are skipped-and-counted, never served
+            self.memo.rehydrate()
 
         path = os.path.join(self.journal_dir, JOBS_JOURNAL)
         if not os.path.exists(path):

@@ -1,0 +1,142 @@
+"""CLI surface of the cross-request solution cache: ``pydcop_tpu
+serve --memo`` (the `make memo-smoke` scenario).
+
+The fast test serves a seeded duplicate trace twice through real CLI
+processes: pass 2 starts cold in a fresh process, rehydrates the
+persisted cache via ``--resume`` and must answer with a positive
+exact-hit rate and bit-identical results.  The kill -9 test is
+``slow``-marked: a SIGKILLed service loses nothing — the restarted
+process rehydrates the CRC'd entries and serves duplicates from them.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+TUTO = os.path.join(INSTANCES, "graph_coloring_tuto.yaml")
+
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "PYTHONPATH": REPO,
+}
+
+
+def run_cli(*args, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu", *args],
+        capture_output=True, text=True, timeout=timeout, env=ENV,
+        cwd=REPO,
+    )
+
+
+def _memo_stats(out):
+    return out["serve"]["memo"]
+
+
+class TestMemoSmoke:
+    def test_duplicate_trace_twice_second_pass_hits(self, tmp_path):
+        """`make memo-smoke` leg 1: the same seeded duplicate trace
+        served twice; the second pass (a FRESH process rehydrating the
+        persisted cache) answers duplicates from the cache with a
+        positive hit rate and bit-identical results."""
+        journal = str(tmp_path / "journal")
+        args = ("serve", "-a", "mgm", "--jobs", "4",
+                "--seed-period", "2", "--lanes", "2",
+                "--memo", "--journal-dir", journal, TUTO)
+        p1 = run_cli(*args)
+        assert p1.returncode == 0, p1.stderr[-2000:]
+        out1 = json.loads(p1.stdout)
+        memo1 = _memo_stats(out1)
+        assert memo1["inserts"] >= 1
+        # the persisted entries are on disk beside the journal
+        memo_dir = os.path.join(journal, "memo")
+        assert [f for f in os.listdir(memo_dir) if f.endswith(".npz")]
+
+        p2 = run_cli(*args[:-1], "--resume", TUTO)
+        assert p2.returncode == 0, p2.stderr[-2000:]
+        out2 = json.loads(p2.stdout)
+        memo2 = _memo_stats(out2)
+        assert memo2["rehydrated"] >= 1
+        assert memo2["hits_exact"] >= 1  # second-pass hit rate > 0
+        # every cache-served job is bit-identical to its pass-1 twin
+        by_label1 = {m["label"]: m for m in out1["results"].values()
+                     if isinstance(m, dict) and m.get("label")}
+        for m in out2["results"].values():
+            if not isinstance(m, dict) or not m.get("memo"):
+                continue
+            if m["memo"].get("hit") != "exact":
+                continue
+            twin = by_label1[m["label"]]
+            assert m["assignment"] == twin["assignment"]
+            assert m["cost"] == twin["cost"]
+
+    def test_memo_provenance_in_per_job_metrics(self, tmp_path):
+        """Every job served with --memo carries a hit/miss provenance
+        stamp in its metrics."""
+        p = run_cli("serve", "-a", "mgm", "--jobs", "2",
+                    "--seed-period", "1", "--lanes", "2",
+                    "--memo", TUTO)
+        assert p.returncode == 0, p.stderr[-2000:]
+        out = json.loads(p.stdout)
+        kinds = [m["memo"]["hit"] for m in out["results"].values()
+                 if isinstance(m, dict)]
+        assert len(kinds) == 2
+        assert all(k in ("exact", "variant", "miss") for k in kinds)
+
+
+@pytest.mark.slow
+class TestMemoCrashRehydrate:
+    def test_kill9_midtrace_then_resume_rehydrates_cache(
+            self, tmp_path):
+        """`make memo-smoke` leg 2: SIGKILL the serving process
+        mid-trace AFTER at least one entry persisted; the restarted
+        process rehydrates the cache from the CRC'd npz files and
+        serves duplicates from it — no correctness lost."""
+        journal = str(tmp_path / "journal")
+        memo_dir = os.path.join(journal, "memo")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "pydcop_tpu", "serve", "-a", "mgm",
+             "--jobs", "12", "--seed-period", "2",
+             "--arrival", "poisson", "--rate", "10",
+             "--arrival-seed", "3", "--lanes", "2",
+             "--memo", "--journal-dir", journal, TUTO],
+            env=ENV, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        # wait until a memo entry lands on disk, then kill -9
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if os.path.isdir(memo_dir) and any(
+                    f.endswith(".npz") for f in os.listdir(memo_dir)):
+                break
+            if proc.poll() is not None:
+                break  # trace finished before we could kill: still fine
+            time.sleep(0.05)
+        else:
+            proc.kill()
+            raise AssertionError("no memo entry was ever persisted")
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+
+        p2 = run_cli(
+            "serve", "-a", "mgm", "--jobs", "4", "--seed-period", "2",
+            "--lanes", "2", "--memo", "--journal-dir", journal,
+            "--resume", TUTO,
+        )
+        assert p2.returncode == 0, p2.stderr[-2000:]
+        out = json.loads(p2.stdout)
+        memo = _memo_stats(out)
+        assert memo["rehydrated"] >= 1
+        assert memo["corrupt_skipped"] == 0
+        assert memo["hits_exact"] >= 1
+        for jid, m in out["results"].items():
+            if isinstance(m, dict) and m.get("status"):
+                assert m["status"] == "FINISHED", (jid, m)

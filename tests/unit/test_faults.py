@@ -113,6 +113,7 @@ class TestFaultKindCatalog:
         "nan_lane": {},
         "torn_journal_write": {},
         "stall_tick": {"duration": 0.1},
+        "corrupt_cache_entry": {},
         "edit_factor": {"constraint": "c1"},
         "remove_agent_burst": {"count": 2},
         "add_agent_burst": {"count": 1},
