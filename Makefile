@@ -6,7 +6,8 @@
 	dpop-smoke bench-auto portfolio-smoke bench-fleet fleet-smoke \
 	bench-twin twin-smoke bench-r06 analyze bench-search search-smoke \
 	bench-r08 bench-pfleet pfleet-smoke bench-structured \
-	structured-smoke bench-r09 bench-memo memo-smoke bench-r10
+	structured-smoke bench-r09 bench-memo memo-smoke bench-r10 \
+	precision-smoke bench-precision bench-r11
 
 test: all-tests
 
@@ -140,6 +141,27 @@ bench-memo:
 # machine-readable BENCH_r10.json snapshot (ISSUE 18 satellite)
 bench-r10:
 	python bench.py --only r10 --snapshot BENCH_r10.json
+
+# mixed-precision tier smoke (ISSUE 19): quantization round-trip /
+# saturation properties, f32 bit-identity pins, bf16 statistical
+# equivalence, typed tier refusals, checkpoint tier guard and the
+# audited wire-byte cut of the bf16 sharded cells.  Run it whenever
+# touching ops/precision.py, ops/compile.py or parallel/mesh.py
+precision-smoke:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/unit/test_precision.py -q
+
+# mixed-precision bench only: per-tier throughput + final cost for
+# maxsum/mgm, the declared bf16 gate and the jaxpr-walked collective
+# payload cut of the bf16 wire cells vs their f32 twins
+# (docs/performance.rst "Mixed precision tiers")
+bench-precision:
+	python bench.py --only precision
+
+# the r10 legs + the mixed-precision leg in one run with a
+# machine-readable BENCH_r11.json snapshot (ISSUE 19 satellite)
+bench-r11:
+	python bench.py --only r11 --snapshot BENCH_r11.json
 
 # fast sharded-DPOP smoke: the tiled-vs-single-device parity matrix,
 # pruning property and mini-bucket bound-sandwich tests on the CPU

@@ -2192,6 +2192,137 @@ def bench_memo(args, probe=None):
     return out
 
 
+def bench_precision(args, probe=None):
+    """Mixed-precision storage tiers (ISSUE 19): per-tier harness
+    throughput + final cost for maxsum and mgm on one soft
+    graph-coloring instance, the bf16 runs checked against the ONE
+    declared statistical gate (``ops.precision.BF16_COST_RTOL/ATOL``
+    — the same pair the equivalence tests assert), and the
+    collective-payload byte cut of the bf16 sharded wire cells vs
+    their f32 twins, read off the audit registry's jaxpr walk — the
+    same ``max_collective_payload_bytes`` the per-tier budgets
+    enforce, NOT an itemsize estimate (docs/performance.rst "Mixed
+    precision tiers").
+
+    Throughput ratios are same-process (host drift cancels like
+    ``churn_speedup``); one warmup run per (algo, tier) pays the
+    compile outside the timed window.  The gate here is the ONE-SIDED
+    form of the declared pair, over 3-seed mean final costs: loopy
+    max-sum at bench scale is chaotic enough that bf16's rounding acts
+    as beneficial noise and lands BELOW f32 by more than RTOL — for a
+    minimization tier that is a pass, not a failure, so the check is
+    ``mean(bf16) <= mean(f32) + max(ATOL, RTOL*|mean(f32)|)`` (the
+    small-instance equivalence tests keep the two-sided form).  The
+    int8 rows ride along for the table-byte story (4 B -> 1 B per
+    entry is structural — ``precision_int8_table_bytes_cut_x`` is
+    exact, not measured); the float-valued coloring tables here are
+    deliberately OUTSIDE the int8 losslessness rule, so its costs are
+    reported but not gated — ``solve --auto`` would mask int8 on this
+    instance.
+    """
+    from pydcop_tpu.generators import generate_graph_coloring
+    from pydcop_tpu.ops.precision import BF16_COST_ATOL, BF16_COST_RTOL
+    from pydcop_tpu.runtime.run import solve_result
+
+    V = 200
+    cycles = 200
+    # headline slot reserved FIRST: single-leg promotion scans extra
+    # in insertion order, and the per-tier throughput keys would match
+    # the generic "_cycles_per" pattern before the real headline
+    out = {"precision_payload_cut_x": 0.0,
+           "precision_vars": V, "precision_cycles": cycles}
+    d = generate_graph_coloring(
+        n_variables=V, n_colors=3, n_edges=2 * V - 2, soft=True, seed=7)
+
+    gates_ok = True
+    seeds = (1, 2, 3)
+    for algo in ("maxsum", "mgm"):
+        costs = {}
+        for tier in ("f32", "bf16", "int8"):
+            params = {} if tier == "f32" else {"precision": tier}
+            solve_result(d, algo, cycles=50, seed=1, chunk=50,
+                         algo_params=params)      # warmup: compile
+            t0 = time.perf_counter()
+            r = solve_result(d, algo, cycles=cycles, seed=1, chunk=50,
+                             algo_params=params)
+            dt = time.perf_counter() - t0
+            out[f"precision_{algo}_{tier}_cycles_per_s"] = round(
+                cycles / dt, 1)
+            out[f"precision_{algo}_{tier}_cost"] = round(
+                float(r.cost), 3)
+            if tier in ("f32", "bf16"):
+                # 3-seed mean for the gate (compile already warm; the
+                # extra seeds reuse the staged kernels)
+                cs = [float(r.cost)] + [
+                    float(solve_result(
+                        d, algo, cycles=cycles, seed=s, chunk=50,
+                        algo_params=params).cost)
+                    for s in seeds[1:]
+                ]
+                costs[tier] = sum(cs) / len(cs)
+                out[f"precision_{algo}_{tier}_mean_cost"] = round(
+                    costs[tier], 3)
+        gate = max(BF16_COST_ATOL, BF16_COST_RTOL * abs(costs["f32"]))
+        ok = bool(costs["bf16"] <= costs["f32"] + gate)
+        out[f"precision_{algo}_bf16_within_gate"] = ok
+        gates_ok = gates_ok and ok
+    out["precision_bf16_within_gate"] = gates_ok
+
+    # audited wire-byte cut: walk the SAME registry cells the per-tier
+    # budgets gate (compact sharded maxsum + packed local search).  A
+    # real mesh needs >1 device or the comm plan degenerates to width
+    # 1 and the walk sees no collectives at all, so this runs on the
+    # virtual 8-device CPU mesh in a subprocess (same pattern as the
+    # sharded legs — XLA device count is fixed at process start).
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    audit_src = (
+        "import json\n"
+        "from pydcop_tpu.analysis import registry\n"
+        "res = {}\n"
+        "for label, f32_cell, bf16_cell in (\n"
+        "    ('maxsum', 'sharded/maxsum/generic/exact',\n"
+        "     'sharded/maxsum/generic/exact-bf16'),\n"
+        "    ('mgm', 'sharded/mgm/packed/exact',\n"
+        "     'sharded/mgm/packed/exact-bf16'),\n"
+        "):\n"
+        "    a = registry.audit_cell(f32_cell)\n"
+        "    b = registry.audit_cell(bf16_cell)\n"
+        "    res[label] = {\n"
+        "        'f32': int(a.scorecard['max_collective_payload_bytes']),\n"
+        "        'bf16': int(b.scorecard['max_collective_payload_bytes']),\n"
+        "        'clean': not a.findings and not b.findings,\n"
+        "    }\n"
+        "print(json.dumps(res))\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", audit_src],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+    )
+    if r.returncode != 0 or not r.stdout.strip():
+        raise RuntimeError(
+            "precision audit subprocess failed "
+            f"(rc={r.returncode}): " + r.stderr.strip()[-400:]
+        )
+    audits = json.loads(r.stdout.strip().splitlines()[-1])
+    ratios = []
+    audits_clean = True
+    for label, row in audits.items():
+        out[f"precision_{label}_payload_bytes_f32"] = row["f32"]
+        out[f"precision_{label}_payload_bytes_bf16"] = row["bf16"]
+        audits_clean = audits_clean and bool(row["clean"])
+        ratios.append(row["f32"] / max(row["bf16"], 1))
+    out["precision_audits_clean"] = audits_clean
+    out["precision_payload_cut_x"] = round(min(ratios), 2)
+    out["precision_int8_table_bytes_cut_x"] = 4.0
+    return out
+
+
 def bench_auto(args, probe=None):
     """Learned-portfolio auto-selection (ISSUE 10): train the cost
     model on a seeded sweep of TRAINING families, then score a
@@ -3651,7 +3782,8 @@ def main():
                  "pfleet", "churn",
                  "auto", "twin", "elastic", "elastic-inner", "search",
                  "search-inner", "structured", "structured-inner",
-                 "memo", "r06", "r07", "r08", "r09", "r10"],
+                 "memo", "precision",
+                 "r06", "r07", "r08", "r09", "r10", "r11"],
         default="all",
     )
     # watchdog covers the FULL run: the wholesweep DPOP kernel compile
@@ -3662,6 +3794,50 @@ def main():
     args = ap.parse_args()
     if args.cycles is None:
         args.cycles = 50 if args.stretch else 2000
+
+    if args.only == "r11":
+        # consolidated r11 record (ISSUE 19 satellite): the r10 legs
+        # plus the mixed-precision leg, EACH in a fresh subprocess
+        # (same isolation rationale as r06 below)
+        legs = ("serve", "churn", "dpop-sharded", "auto", "fleet",
+                "pfleet", "twin", "elastic", "search", "structured",
+                "memo", "precision")
+        fwd = []
+        skip_next = False
+        for a in sys.argv[1:]:
+            if skip_next:
+                skip_next = False
+                continue
+            if a in ("--only", "--snapshot"):
+                skip_next = True
+                continue
+            if a.startswith(("--only=", "--snapshot=")):
+                continue
+            fwd.append(a)
+        extra = {}
+        for leg in legs:
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--only", leg] + fwd
+            try:
+                r = subprocess.run(
+                    cmd, capture_output=True, text=True, timeout=3000,
+                )
+                parsed = json.loads(
+                    r.stdout.strip().splitlines()[-1]
+                )
+                extra.update(parsed.get("extra", {}))
+            except Exception as e:
+                extra[f"{leg}_error"] = repr(e)[:500]
+        out = {
+            "metric": "r11_consolidated",
+            "value": extra.get("precision_payload_cut_x", 0.0),
+            "unit": "x (f32 / bf16 max collective payload bytes)",
+            "vs_baseline": 0.0,
+            "extra": extra,
+        }
+        _maybe_snapshot(args, out)
+        print(json.dumps(out), flush=True)
+        return
 
     if args.only == "r10":
         # consolidated r10 record (ISSUE 18 satellite): the r09 legs
@@ -4144,6 +4320,15 @@ def main():
         except Exception as e:
             extra["memo_error"] = repr(e)
 
+    if args.only in ("all", "precision"):
+        # mixed-precision tiers (ISSUE 19): per-tier throughput/cost,
+        # the declared bf16 statistical gate and the jaxpr-walked
+        # collective payload-byte cut (BENCHREF.md "Mixed precision")
+        try:
+            extra.update(bench_precision(args, probe=probe))
+        except Exception as e:
+            extra["precision_error"] = repr(e)
+
     if args.only in ("all", "twin"):
         # city-scale digital twin (ISSUE 12): the combined sustained
         # scenario (traffic tiers + churn + chaos + --auto) scored by
@@ -4310,7 +4495,7 @@ def main():
     if args.only in ("dpop", "local", "convergence", "convergence2",
                      "scalefree", "mixed", "sharded", "dpop-sharded",
                      "probe", "batch", "harness", "serve", "churn",
-                     "auto", "twin", "memo") \
+                     "auto", "twin", "memo", "precision") \
             and not value:
         # single-part run: promote the part's headline measurement (not
         # config constants like stretch_vars) to the primary slot
@@ -4323,6 +4508,8 @@ def main():
             headline = ("twin_gold_attainment_ladder_on",) + headline
         if args.only == "memo":
             headline = ("memo_variant_speedup",) + headline
+        if args.only == "precision":
+            headline = ("precision_payload_cut_x",) + headline
         k = next(
             (k for k in extra if any(h in k for h in headline)),
             next((k for k in extra if not k.endswith("_error")), None),

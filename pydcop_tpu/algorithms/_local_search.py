@@ -33,6 +33,20 @@ from pydcop_tpu.ops.segments import masked_argmin, segment_max, segment_min
 #: reference uses as serializable infinity (maxsum.py:96, dba.py:265)
 HARD_THRESHOLD = 10000.0
 
+#: exactness tier map (ISSUE 19, ops/precision.py EXACTNESS): storage
+#: tiers of the local-search family.  The weighted-breakout variants
+#: (dba/gdba) exclude int8 — their cycle multiplies the STORED tables
+#: by per-factor weights, which is meaningless on quantization codes;
+#: bf16 tables weight fine (the product promotes to f32).
+PRECISION_TIERS = {
+    "f32": "exact",
+    "bf16": "statistical",
+    "int8": "quantized",
+}
+
+#: algorithms whose weighting rules out the int8 code storage
+_WEIGHTED_ALGOS = ("dba", "gdba")
+
 
 def random_valid_values(
     tensors: ConstraintGraphTensors, key: jax.Array
@@ -213,6 +227,21 @@ class LocalSearchSolver(SynchronousTensorSolver):
     def __init__(self, dcop, tensors: ConstraintGraphTensors, algo_def:
                  AlgorithmDef, seed: int = 0, use_packed=None):
         super().__init__(dcop, tensors, algo_def, seed)
+        from pydcop_tpu.ops.precision import apply_precision, require_tier
+
+        algo = getattr(algo_def, "algo", None) or "local_search"
+        supported = dict(PRECISION_TIERS)
+        if algo in _WEIGHTED_ALGOS:
+            supported.pop("int8")
+        self.precision = require_tier(
+            algo, self.params.get("precision"), supported,
+            "run precision=f32 (exact) or bf16 (statistical)",
+        )
+        if self.precision != "f32":
+            # re-stage the bucket tables at the cheap tier; the packed
+            # pallas engines pin f32, so they are skipped below
+            self.tensors = apply_precision(self.tensors, self.precision)
+            use_packed = False
         # one value message to each neighbor per cycle (reference parity:
         # mgm/dsa broadcast their value each cycle)
         self.msgs_per_cycle = int(tensors.neighbor_src.shape[0])
@@ -225,7 +254,7 @@ class LocalSearchSolver(SynchronousTensorSolver):
         if use_packed:
             from pydcop_tpu.ops.pallas_maxsum import try_pack_for_pallas
 
-            self.packed = try_pack_for_pallas(tensors)
+            self.packed = try_pack_for_pallas(self.tensors)
 
     @property
     def packed_ls(self):

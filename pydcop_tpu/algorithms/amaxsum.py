@@ -34,6 +34,7 @@ algo_params = [
     AlgoParameterDef("stability", "float", None, 0.1),
     AlgoParameterDef("noise", "float", None, 0.01),
     AlgoParameterDef("activation", "float", None, DEFAULT_ACTIVATION),
+    AlgoParameterDef("precision", "str", ["f32", "bf16", "int8"], "f32"),
 ]
 
 

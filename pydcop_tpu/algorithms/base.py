@@ -353,6 +353,10 @@ class SynchronousTensorSolver:
         self.params = algo_def.params
         self.seed = seed
         self.infinity = DEFAULT_INFINITY
+        #: storage tier (ops/precision.py); subclasses that support the
+        #: knob resolve it from params and re-stage their tensors —
+        #: everything else stays at the exact f32 tier
+        self.precision = "f32"
         self._compiled_chunks = LruCache()
         self._masked_trace_counts: Dict[Any, int] = {}
         self._vals_cache = None
@@ -394,9 +398,18 @@ class SynchronousTensorSolver:
         collectives, no host callbacks, the f32 tier, and constants
         bounded by the baked tensor footprint — cold solvers close
         over their tables by design; warm solvers override this with
-        an operand-sized discount (algorithms/warm.py)."""
+        an operand-sized discount (algorithms/warm.py).  The bf16/int8
+        storage tiers widen the dtype set with bfloat16 (messages /
+        table storage) — the f32 budget keeps EXCLUDING it, so a
+        silent downcast on the exact tier still fails the audit."""
+        dtypes = (
+            HARNESS_DTYPES
+            if self.precision == "f32"
+            else HARNESS_DTYPES | {"bfloat16"}
+        )
         return harness_budget(
-            tensor_const_bytes(self.tensors) + CONST_SLACK_BYTES
+            tensor_const_bytes(self.tensors) + CONST_SLACK_BYTES,
+            dtypes=dtypes,
         )
 
     # -- convergence --------------------------------------------------------
@@ -831,6 +844,7 @@ class SynchronousTensorSolver:
             history=history if collect_cycles else None,
             harness=counters.as_dict(),
             config=resolved_config(
-                self.algo_def.algo, "harness", chunk=chunk
+                self.algo_def.algo, "harness", chunk=chunk,
+                precision=self.precision,
             ),
         )

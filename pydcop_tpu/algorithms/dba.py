@@ -40,6 +40,7 @@ algo_params = [
     AlgoParameterDef("infinity", "int", None, 10000),
     AlgoParameterDef("max_distance", "int", None, 50),
     AlgoParameterDef("stop_cycle", "int", None, 0),
+    AlgoParameterDef("precision", "str", ["f32", "bf16", "int8"], "f32"),
 ]
 
 

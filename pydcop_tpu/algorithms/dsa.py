@@ -33,6 +33,7 @@ algo_params = [
     AlgoParameterDef("probability", "float", None, 0.7),
     AlgoParameterDef("variant", "str", ["A", "B", "C"], "B"),
     AlgoParameterDef("stop_cycle", "int", None, 0),
+    AlgoParameterDef("precision", "str", ["f32", "bf16", "int8"], "f32"),
 ]
 
 

@@ -47,6 +47,7 @@ algo_params = [
     AlgoParameterDef("violation", "str", ["NZ", "NM", "MX"], "NZ"),
     AlgoParameterDef("increase_mode", "str", ["E", "R", "C", "T"], "E"),
     AlgoParameterDef("stop_cycle", "int", None, 0),
+    AlgoParameterDef("precision", "str", ["f32", "bf16", "int8"], "f32"),
 ]
 
 

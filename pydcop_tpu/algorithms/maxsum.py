@@ -40,7 +40,18 @@ algo_params = [
     AlgoParameterDef("damping", "float", None, 0.5),
     AlgoParameterDef("stability", "float", None, 0.1),
     AlgoParameterDef("noise", "float", None, 0.01),
+    AlgoParameterDef("precision", "str", ["f32", "bf16", "int8"], "f32"),
 ]
+
+#: exactness tier map (ISSUE 19, ops/precision.py EXACTNESS): the
+#: storage tiers the generic bucket engine supports.  The lane-packed
+#: pallas and edge-slab megascale engines pin f32 — a cheaper tier
+#: falls back to the generic engine automatically.
+PRECISION_TIERS = {
+    "f32": "exact",
+    "bf16": "statistical",
+    "int8": "quantized",
+}
 
 
 def messages_stable(r_prev: jnp.ndarray, r_cur: jnp.ndarray,
@@ -70,6 +81,16 @@ class MaxSumSolver(SynchronousTensorSolver):
 
     def __init__(self, dcop, tensors, algo_def, seed=0, use_packed=None):
         super().__init__(dcop, tensors, algo_def, seed)
+        from pydcop_tpu.ops.precision import (
+            message_dtype,
+            require_tier,
+        )
+
+        self.precision = require_tier(
+            "maxsum", self.params.get("precision"), PRECISION_TIERS,
+            "run precision=f32 (exact) or bf16 (statistical)",
+        )
+        self._msg_dtype = message_dtype(self.precision)
         self.damping = float(self.params.get("damping", 0.5))
         # message-stability convergence coefficient (the reference's
         # approx_match STABILITY_COEFF, maxsum.py:98): messages within
@@ -99,6 +120,14 @@ class MaxSumSolver(SynchronousTensorSolver):
         self.msgs_per_cycle = 2 * tensors.n_edges
         self.msg_size_per_msg = float(tensors.max_domain_size)
 
+        # low-precision storage tiers: re-stage the bucket tables (bf16
+        # cast / per-factor int8 quantization); f32 returns the SAME
+        # tensors object, so the default path's jaxpr is untouched
+        if self.precision != "f32":
+            from pydcop_tpu.ops.precision import apply_precision
+
+            self.tensors = apply_precision(self.tensors, self.precision)
+
         # engine selection: lane-packed pallas on TPU for binary graphs
         self.packed = None
         if use_packed is None:
@@ -106,6 +135,9 @@ class MaxSumSolver(SynchronousTensorSolver):
         # table-free (structured) buckets run through the generic bucket
         # loop only: the packed/edge-slab engines assume all-binary tables
         if getattr(self.tensors, "sbuckets", None):
+            use_packed = False
+        # the packed/edge-slab engines pin the exact f32 tier
+        if self.precision != "f32":
             use_packed = False
         if use_packed:
             from pydcop_tpu.ops.pallas_maxsum import try_pack_for_pallas
@@ -117,6 +149,7 @@ class MaxSumSolver(SynchronousTensorSolver):
         # form is bit-identical and compiles in seconds at any size
         self.eslabs = None
         if (self.packed is None
+                and self.precision == "f32"
                 and not getattr(self.tensors, "sbuckets", None)
                 and self.tensors.n_edges >= 1_000_000
                 and len(self.tensors.buckets) == 1
@@ -131,7 +164,7 @@ class MaxSumSolver(SynchronousTensorSolver):
 
             q, r = packed_init_state(self.packed)
         else:
-            q, r = init_messages(self.tensors)
+            q, r = init_messages(self.tensors, dtype=self._msg_dtype)
         values = masked_argmin(self.tensors.unary_costs,
                                self.tensors.domain_mask)
         return q, r, values
@@ -154,7 +187,8 @@ class MaxSumSolver(SynchronousTensorSolver):
             )
         else:
             q2, r2, beliefs, values = maxsum_cycle(
-                self.tensors, q, r, damping=self.damping
+                self.tensors, q, r, damping=self.damping,
+                msg_dtype=self._msg_dtype,
             )
         return q2, r2, values
 

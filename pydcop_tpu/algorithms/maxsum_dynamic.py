@@ -31,6 +31,7 @@ algo_params = [
     AlgoParameterDef("stop_cycle", "int", None, 0),
     AlgoParameterDef("damping", "float", None, 0.5),
     AlgoParameterDef("noise", "float", None, 0.01),
+    AlgoParameterDef("precision", "str", ["f32", "bf16", "int8"], "f32"),
 ]
 
 

@@ -28,6 +28,7 @@ GRAPH_TYPE = "constraints_hypergraph"
 algo_params = [
     AlgoParameterDef("break_mode", "str", ["lexic", "random"], "lexic"),
     AlgoParameterDef("stop_cycle", "int", None, 0),
+    AlgoParameterDef("precision", "str", ["f32", "bf16", "int8"], "f32"),
 ]
 
 

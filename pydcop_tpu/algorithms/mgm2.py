@@ -52,6 +52,7 @@ algo_params = [
         "favor", "str", ["unilateral", "no", "coordinated"], "unilateral"
     ),
     AlgoParameterDef("stop_cycle", "int", None, 0),
+    AlgoParameterDef("precision", "str", ["f32", "bf16", "int8"], "f32"),
 ]
 
 

@@ -27,6 +27,7 @@ algo_params = [
     AlgoParameterDef("proba_soft", "float", None, 0.5),
     AlgoParameterDef("variant", "str", ["A", "B", "C"], "B"),
     AlgoParameterDef("stop_cycle", "int", None, 0),
+    AlgoParameterDef("precision", "str", ["f32", "bf16", "int8"], "f32"),
 ]
 
 

@@ -103,8 +103,15 @@ class _WarmMixin:
         t.domain_mask = ops["mask"]
         t.unary_costs = ops["unary"]
         t.edge_var = ops["edge_var"]
-        for b, tt in zip(t.buckets, ops["tensors"]):
+        nb = len(t.buckets)
+        for b, tt, qs, qo in zip(
+            t.buckets, ops["tensors"],
+            ops.get("qscale") or (None,) * nb,
+            ops.get("qoffset") or (None,) * nb,
+        ):
             b.tensors = tt
+            if qs is not None:
+                b.qscale, b.qoffset = qs, qo
         for sb, leaves in zip(getattr(t, "sbuckets", None) or [],
                               ops.get("s_costs", ())):
             if sb.kind == "linear":
@@ -217,7 +224,7 @@ class WarmMaxSumSolver(_WarmMixin, MaxSumSolver):
         self._init_warm(layout)
 
     def initial_state(self):
-        q, r = init_messages(self.tensors)
+        q, r = init_messages(self.tensors, dtype=self._msg_dtype)
         values = masked_argmin(self.operands["unary"],
                                self.operands["mask"])
         return q, r, values, self.operands
@@ -225,7 +232,8 @@ class WarmMaxSumSolver(_WarmMixin, MaxSumSolver):
     def cycle(self, state, key):
         q, r, _, ops = state
         q2, r2, _beliefs, values = maxsum_cycle(
-            self._view(ops), q, r, damping=self.damping
+            self._view(ops), q, r, damping=self.damping,
+            msg_dtype=self._msg_dtype,
         )
         return q2, r2, values, ops
 

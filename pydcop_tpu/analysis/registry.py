@@ -287,13 +287,16 @@ for _algo in ("mgm", "maxsum"):
 
 def _sharded_maxsum_cell(overlap: str, use_packed: bool,
                          exchange: bool = False,
-                         sentinel: bool = False) -> AuditedProgram:
+                         sentinel: bool = False,
+                         precision: Optional[str] = None
+                         ) -> AuditedProgram:
     from pydcop_tpu.parallel.mesh import ShardedMaxSum
 
     t = _ring_factor_tensors()
     comp = ShardedMaxSum(
         t, _mesh(), damping=0.5, use_packed=use_packed,
         overlap=overlap, exchange=exchange, sentinel=sentinel,
+        precision=precision,
     )
     comp._build()
     keys = _one_cycle_keys(1)
@@ -307,6 +310,8 @@ def _sharded_maxsum_cell(overlap: str, use_packed: bool,
     mode = "exchange" if exchange else overlap
     if sentinel:
         mode = "sentinel" if mode == "off" else f"sentinel-{mode}"
+    if precision and precision != "f32":
+        mode = f"{mode}-{precision}"
     return AuditedProgram(
         name=f"sharded/maxsum/{kind}/{mode}",
         fn=comp._run_n,
@@ -340,6 +345,19 @@ for _ov, _pk in (("off", False), ("exact", False), ("off", True)):
                           True)
     )
 
+# mixed-precision wire cells (ISSUE 19): the SAME cycle programs with
+# the boundary slab / psum payload cast to bfloat16 in transit and
+# accumulated back in f32 — the per-tier budgets (payload_itemsize=2
+# in the comm plan) make the jaxpr walk PROVE the collective-byte cut
+# instead of estimating it (tests/unit/test_precision.py compares
+# these cells' walked payloads against their f32 twins)
+for _ov, _pk in (("exact", False), ("exact", True), ("off", False)):
+    _kind = "packed" if _pk else "generic"
+    register_cell(f"sharded/maxsum/{_kind}/{_ov}-bf16")(
+        functools.partial(_sharded_maxsum_cell, _ov, _pk, False,
+                          False, "bf16")
+    )
+
 
 # ---------------------------------------------------------------------------
 # sharded local-search cells (PR 2/5 contracts)
@@ -347,7 +365,9 @@ for _ov, _pk in (("off", False), ("exact", False), ("off", True)):
 
 def _sharded_ls_cell(rule: str, overlap: str,
                      use_packed: bool,
-                     sentinel: bool = False) -> AuditedProgram:
+                     sentinel: bool = False,
+                     precision: Optional[str] = None
+                     ) -> AuditedProgram:
     import jax.numpy as jnp
 
     from pydcop_tpu.parallel.mesh import ShardedLocalSearch
@@ -358,7 +378,7 @@ def _sharded_ls_cell(rule: str, overlap: str,
     s = ShardedLocalSearch(
         _ring_constraint_tensors(), _mesh(), rule=rule,
         algo_params=params, use_packed=use_packed, overlap=overlap,
-        sentinel=sentinel,
+        sentinel=sentinel, precision=precision,
     )
     s._build()
     keys = _one_cycle_keys(1)
@@ -376,6 +396,8 @@ def _sharded_ls_cell(rule: str, overlap: str,
         s._bucket_args) + tuple(s._extra_args)
     kind = "packed" if use_packed else "generic"
     mode = "sentinel" if sentinel else overlap
+    if precision and precision != "f32":
+        mode = f"{mode}-{precision}"
     return AuditedProgram(
         name=f"sharded/{rule}/{kind}/{mode}",
         fn=s._run_n,
@@ -398,6 +420,20 @@ for _rule, _ov in (("mgm", "off"), ("mgm", "exact"), ("dsa", "off")):
 register_cell("sharded/mgm/generic/sentinel")(
     functools.partial(_sharded_ls_cell, "mgm", "off", False, True)
 )
+# mixed-precision wire cells (ISSUE 19): table-slab collectives carry
+# bfloat16; the float-encoded tie-break index payload stays f32 (wire
+# cast would corrupt indices above 256 — see mesh._combine_arb), so
+# the arbitration extras keep their 4-byte rows in the declared budget
+for _rule, _ov, _pk in (
+    ("mgm", "exact", True),
+    ("mgm", "exact", False),
+    ("dsa", "off", True),
+):
+    _kind = "packed" if _pk else "generic"
+    register_cell(f"sharded/{_rule}/{_kind}/{_ov}-bf16")(
+        functools.partial(_sharded_ls_cell, _rule, _ov, _pk, False,
+                          "bf16")
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -91,11 +91,18 @@ def bucket_signature(target: InstanceDims, batch_size: int) -> Tuple:
             target.F, target.M, batch_size)
 
 
+class StructuredBatchingUnsupported(NotImplementedError):
+    """Typed refusal: structured (table-free) buckets reached the lane
+    stacker, which cannot pad them (ISSUE 19 satellite).  Subclasses
+    NotImplementedError so pre-existing handlers keep working; the
+    message text is pinned by tests — it names the fallback path."""
+
+
 def dims_of(tensors, graph_type: str) -> InstanceDims:
     """Shape signature of a compiled tensor graph
     (ops.compile.GraphTensorsBase subclass)."""
     if getattr(tensors, "sbuckets", None):
-        raise NotImplementedError(
+        raise StructuredBatchingUnsupported(
             "batched lanes do not yet pad table-free (structured) buckets; "
             "solve structured instances on a dedicated lane"
         )

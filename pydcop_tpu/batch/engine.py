@@ -138,8 +138,19 @@ def pad_instance(tensors, target: InstanceDims) -> Dict[str, np.ndarray]:
     for i, (a, fp) in enumerate(zip(target.arities, target.F)):
         b = tensors.buckets[i]
         F = b.n_factors
-        t = np.full((fp,) + (Dp,) * a, PAD_COST, np.float32)
-        t[(slice(0, F),) + (slice(0, D),) * a] = np.asarray(b.tensors)
+        t_src = np.asarray(b.tensors)
+        if t_src.dtype == np.int8:
+            from pydcop_tpu.ops.precision import PrecisionError
+
+            raise PrecisionError(
+                "batched lanes do not stack int8 quantized tables; run "
+                "the single-device engine for precision=int8 or use "
+                "precision=bf16 for batched lanes"
+            )
+        # bf16-staged instances keep their storage tier through the
+        # lane stack (ISSUE 19) — PAD_COST is exactly representable
+        t = np.full((fp,) + (Dp,) * a, PAD_COST, t_src.dtype)
+        t[(slice(0, F),) + (slice(0, D),) * a] = t_src
         # padded factors: zero costs routed at the dummy var — zero
         # messages / zero table rows, landing on the dummy only
         t[F:] = 0.0
@@ -522,7 +533,8 @@ class _MaxSumAdapter(_AdapterBase):
         # messages start at zero, so padding them is trivial — but edge
         # offsets shift when factor counts pad, so build fresh zeros at
         # the padded layout rather than padding the true arrays
-        zq = np.zeros((Ep, target.D), np.float32)
+        zq = np.zeros((Ep, target.D),
+                      np.dtype(spec.solver._msg_dtype))
         return (
             zq,
             zq.copy(),
@@ -531,14 +543,23 @@ class _MaxSumAdapter(_AdapterBase):
 
     def make_cycle(self, params):
         from pydcop_tpu.ops.maxsum_kernels import maxsum_cycle
+        from pydcop_tpu.ops.precision import (
+            message_dtype,
+            resolve_precision,
+        )
 
         damping = params.get("damping")
         damping = 0.5 if damping is None else float(damping)
+        # params are uniform across a bucket (grouping key), so one
+        # message dtype serves every lane; f32 emits the pre-PR jaxpr
+        msg_dtype = message_dtype(
+            resolve_precision(params.get("precision"))
+        )
 
         def cycle(t, arr, st, xs):
             q, r, _ = st
             q2, r2, _beliefs, values = maxsum_cycle(
-                t, q, r, damping=damping
+                t, q, r, damping=damping, msg_dtype=msg_dtype
             )
             return (q2, r2, values)
 

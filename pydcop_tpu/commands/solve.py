@@ -101,6 +101,15 @@ def set_parser(subparsers):
                         default=0.5,
                         help="auto-policy cut-fraction threshold above "
                         "which the dense psum is kept (default 0.5)")
+    # mixed-precision storage/wire tiers (docs/performance.rst,
+    # "Mixed precision tiers") — shorthand for -p precision:<tier>
+    parser.add_argument("--precision",
+                        choices=["f32", "bf16", "int8"], default=None,
+                        help="tensor storage/wire tier: f32 = exact "
+                        "(bit-identical, default), bf16 = bfloat16 "
+                        "tables + messages with f32 accumulation "
+                        "(statistical), int8 = affine-quantized cost "
+                        "tables (quantized; iterative engines only)")
     # sharded exact inference (docs/performance.rst "Sharded exact
     # inference") — DPOP only; shorthand for the matching -p algo params
     parser.add_argument("--dpop-budget-mb", type=float, default=None,
@@ -267,6 +276,8 @@ def run_cmd(args):
         output_metrics({"status": "ERROR", "error": str(e)}, args.output)
         return 1
     algo_params = parse_algo_params(args.algo_params)
+    if args.precision is not None:
+        algo_params.setdefault("precision", args.precision)
     if args.anytime_exact:
         # flag shorthands for the frontier engine params (the engine
         # itself is a first-class -p engine:frontier on syncbb/ncbb

@@ -48,6 +48,16 @@ from pydcop_tpu.ops.structured_kernels import (
 # device we can afford a much larger sentinel).
 PAD_COST = 1e30
 
+# int8 table storage format (precision="int8", ops/precision.py): codes in
+# [QUANT_MIN, QUANT_MAX] are affine (code * qscale + qoffset, per factor);
+# QUANT_SATURATION is reserved for entries >= QUANT_THRESHOLD — the hard-
+# violation / PAD tier — and dequantizes back to PAD_COST, so infeasibility
+# survives quantization whatever the finite entries' dynamic range.
+QUANT_SATURATION = 127
+QUANT_MIN = -127
+QUANT_MAX = 126
+QUANT_THRESHOLD = 1e4
+
 
 @dataclass
 class FactorBucket:
@@ -58,6 +68,10 @@ class FactorBucket:
     var_idx: np.ndarray  # [F, arity] int32 — variable index per position
     factor_ids: np.ndarray  # [F] global factor index
     edge_offset: int  # start of this bucket's edges in global edge arrays
+    # int8 storage tier only (ops/precision.py): per-factor affine
+    # dequantization parameters.  None whenever tensors are float.
+    qscale: Optional[jnp.ndarray] = None  # [F] float32
+    qoffset: Optional[jnp.ndarray] = None  # [F] float32
 
     @property
     def n_factors(self) -> int:
@@ -369,12 +383,46 @@ def compile_binary_from_arrays(
 # ---------------------------------------------------------------------------
 
 
+def _dequant(codes: jnp.ndarray, scale, offset) -> jnp.ndarray:
+    """Dequantize gathered int8 codes (scale/offset pre-broadcast to the
+    codes' shape).  Saturated codes pin back to PAD_COST so hard/PAD
+    entries stay un-selectable whatever the finite dynamic range."""
+    return jnp.where(
+        codes == QUANT_SATURATION,
+        jnp.float32(PAD_COST),
+        codes.astype(jnp.float32) * scale + offset,
+    )
+
+
+def gathered_f32(rows: jnp.ndarray, bucket: FactorBucket,
+                 expand: int = 0) -> jnp.ndarray:
+    """Gathered table entries in f32 compute form, whatever the storage
+    tier: f32 passthrough (bit-identical jaxpr), bf16 upcast, int8
+    dequant-on-gather.  ``rows`` has a leading [F] factor axis; ``expand``
+    trailing broadcast axes align the per-factor scale/offset."""
+    if rows.dtype == jnp.int8:
+        shape = (bucket.qscale.shape[0],) + (1,) * expand
+        return _dequant(
+            rows, bucket.qscale.reshape(shape), bucket.qoffset.reshape(shape)
+        )
+    if rows.dtype != jnp.float32:
+        return rows.astype(jnp.float32)
+    return rows
+
+
+def bucket_table_f32(bucket: FactorBucket) -> jnp.ndarray:
+    """The bucket's full cost table in f32 compute form (see
+    :func:`gathered_f32`) — for kernels that reduce over every entry."""
+    return gathered_f32(bucket.tensors, bucket, expand=bucket.arity)
+
+
 def bucket_factor_values(bucket: FactorBucket, x: jnp.ndarray) -> jnp.ndarray:
     """Cost of each factor in the bucket under assignment x ([V] value
     indices) → [F]."""
     vals = x[bucket.var_idx]  # [F, a]
     idx = tuple(vals[:, p] for p in range(bucket.arity))
-    return bucket.tensors[(jnp.arange(bucket.n_factors),) + idx]
+    out = bucket.tensors[(jnp.arange(bucket.n_factors),) + idx]
+    return gathered_f32(out, bucket)
 
 
 def total_cost(tensors: GraphTensorsBase, x: jnp.ndarray) -> jnp.ndarray:
@@ -446,6 +494,7 @@ def local_cost_tables(
                 for q in range(a)
             )
             rows = T[(fidx,) + idx]  # [F, D]
+            rows = gathered_f32(rows, b, expand=1)
             if w is not None:
                 rows = rows * w
             out = out + segment_sum(rows, b.var_idx[:, p], V)

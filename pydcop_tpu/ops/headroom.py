@@ -411,6 +411,16 @@ def make_operands(cap) -> Dict:
         "var_idx": tuple(
             jnp.asarray(b.var_idx, dtype=jnp.int32) for b in cap.buckets
         ),
+        # int8-staged buckets (ISSUE 19): the per-factor scale/offset
+        # pairs ride the operand pytree too, so a quantized warm edit is
+        # still a fixed-shape in-place write (None leaves — empty
+        # subtrees — for f32/bf16 buckets)
+        "qscale": tuple(
+            getattr(b, "qscale", None) for b in cap.buckets
+        ),
+        "qoffset": tuple(
+            getattr(b, "qoffset", None) for b in cap.buckets
+        ),
         "edge_var": jnp.asarray(cap.edge_var, dtype=jnp.int32),
         # structured (table-free) parameters: a few O(k·D) scalar arrays
         # per bucket instead of a D^arity slab — the warm-mutation path
@@ -429,9 +439,15 @@ def operand_view(cap, ops: Dict):
     operand leaves — every existing kernel (maxsum_cycle,
     local_cost_tables, total_cost, the move rules) runs on it
     unchanged."""
+    nb = len(cap.buckets)
     buckets = [
-        dataclasses.replace(b, tensors=t, var_idx=vi)
-        for b, t, vi in zip(cap.buckets, ops["tensors"], ops["var_idx"])
+        dataclasses.replace(b, tensors=t, var_idx=vi, qscale=qs,
+                            qoffset=qo)
+        for b, t, vi, qs, qo in zip(
+            cap.buckets, ops["tensors"], ops["var_idx"],
+            ops.get("qscale") or (None,) * nb,
+            ops.get("qoffset") or (None,) * nb,
+        )
     ]
     kw = dict(
         domain_mask=ops["mask"],
@@ -569,6 +585,35 @@ def _aligned_table(cap, constraint: Constraint, slot_names: List[str],
     return padded
 
 
+def _store_table_row(ops: Dict, b: int, k: int,
+                     table: np.ndarray) -> None:
+    """Write one factor's f32 table into slot ``(b, k)`` at the
+    bucket's STORAGE TIER (ISSUE 19): f32 writes through, bf16 takes
+    the hard-threshold-preserving cast, int8 re-quantizes the row and
+    updates its scale/offset operands — all fixed-shape ``.at[].set``
+    writes, so warm mutations stay retrace-free at every tier."""
+    tl = list(ops["tensors"])
+    dt = tl[b].dtype
+    if dt == jnp.int8:
+        from pydcop_tpu.ops.precision import quantize_row
+
+        codes, scale, offset = quantize_row(table)
+        tl[b] = tl[b].at[k].set(jnp.asarray(codes))
+        qs, qo = list(ops["qscale"]), list(ops["qoffset"])
+        qs[b] = qs[b].at[k].set(jnp.float32(scale))
+        qo[b] = qo[b].at[k].set(jnp.float32(offset))
+        ops["qscale"], ops["qoffset"] = tuple(qs), tuple(qo)
+    elif dt == jnp.bfloat16:
+        from pydcop_tpu.ops.precision import cast_bf16_preserving_hard
+
+        tl[b] = tl[b].at[k].set(
+            jnp.asarray(cast_bf16_preserving_hard(table))
+        )
+    else:
+        tl[b] = tl[b].at[k].set(jnp.asarray(table))
+    ops["tensors"] = tuple(tl)
+
+
 def apply_mutation(cap, layout: HeadroomLayout, ops: Dict, mut) -> Tuple[
         Dict, Dirty]:
     """Apply one mutation as fixed-shape buffer writes.
@@ -611,9 +656,7 @@ def apply_mutation(cap, layout: HeadroomLayout, ops: Dict, mut) -> Tuple[
             )
         table = _aligned_table(cap, c, slot_names, cap.sign)
         ops = dict(ops)
-        tl = list(ops["tensors"])
-        tl[b] = tl[b].at[k].set(jnp.asarray(table))
-        ops["tensors"] = tuple(tl)
+        _store_table_row(ops, b, k, table)
         return ops, _factor_dirty(cap, layout, b, k, bko.var_idx[k])
 
     if isinstance(mut, AddFactor):
@@ -632,13 +675,13 @@ def apply_mutation(cap, layout: HeadroomLayout, ops: Dict, mut) -> Tuple[
         bko = cap.buckets[b]
         vi_row = np.asarray(slots, dtype=np.int32)
         ops = dict(ops)
-        tl, vl = list(ops["tensors"]), list(ops["var_idx"])
-        tl[b] = tl[b].at[k].set(jnp.asarray(table))
+        _store_table_row(ops, b, k, table)
+        vl = list(ops["var_idx"])
         vl[b] = vl[b].at[k].set(jnp.asarray(vi_row))
         eo = bko.edge_offset + k * bko.arity
         ops["edge_var"] = ops["edge_var"].at[
             eo:eo + bko.arity].set(jnp.asarray(vi_row))
-        ops["tensors"], ops["var_idx"] = tuple(tl), tuple(vl)
+        ops["var_idx"] = tuple(vl)
         # host mirror: the slot's scope (assignment extraction, edits)
         bko.var_idx[k] = vi_row
         cap.factor_names[int(bko.factor_ids[k])] = c.name
@@ -653,13 +696,13 @@ def apply_mutation(cap, layout: HeadroomLayout, ops: Dict, mut) -> Tuple[
         D = cap.max_domain_size
         park = np.full(a, layout.parking, dtype=np.int32)
         ops = dict(ops)
-        tl, vl = list(ops["tensors"]), list(ops["var_idx"])
-        tl[b] = tl[b].at[k].set(jnp.zeros((D,) * a, dtype=jnp.float32))
+        _store_table_row(ops, b, k, np.zeros((D,) * a, np.float32))
+        vl = list(ops["var_idx"])
         vl[b] = vl[b].at[k].set(jnp.asarray(park))
         eo = bko.edge_offset + k * a
         ops["edge_var"] = ops["edge_var"].at[eo:eo + a].set(
             jnp.asarray(park))
-        ops["tensors"], ops["var_idx"] = tuple(tl), tuple(vl)
+        ops["var_idx"] = tuple(vl)
         bko.var_idx[k] = park
         cap.factor_names[int(bko.factor_ids[k])] = f"__slot_{a}_{k:04d}"
         dirty = _factor_dirty(cap, layout, b, k, old_row)

@@ -24,7 +24,11 @@ from typing import List, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from pydcop_tpu.ops.compile import FactorBucket, FactorGraphTensors
+from pydcop_tpu.ops.compile import (
+    FactorBucket,
+    FactorGraphTensors,
+    bucket_table_f32,
+)
 from pydcop_tpu.ops.segments import masked_argmin, masked_mean, segment_sum
 from pydcop_tpu.ops.structured_kernels import structured_factor_messages
 
@@ -48,9 +52,12 @@ def factor_to_var_messages(
     variables of (cost + sum of their incoming messages).
     """
     a = bucket.arity
+    if q_bucket.dtype != jnp.float32:
+        q_bucket = q_bucket.astype(jnp.float32)  # accumulate in f32
+    table = bucket_table_f32(bucket)  # f32 passthrough / bf16 up / int8 deq
     outs = []
     for p in range(a):
-        s = bucket.tensors
+        s = table
         for q in range(a):
             if q != p:
                 s = s + _broadcast_to_axis(q_bucket[:, q, :], q, a)
@@ -81,6 +88,8 @@ def all_factor_messages(
         q_bucket = q_flat[sb.edge_offset : sb.edge_offset + F * a].reshape(
             F, a, -1
         )
+        if q_bucket.dtype != jnp.float32:
+            q_bucket = q_bucket.astype(jnp.float32)
         dmask = tensors.domain_mask[sb.var_idx]  # [F, a, D]
         parts.append(
             structured_factor_messages(sb, q_bucket, dmask).reshape(F * a, -1)
@@ -102,6 +111,8 @@ def var_beliefs_and_messages(
     edge-slab big-graph path re-orders edges for gather locality).
     """
     V = tensors.n_vars
+    if r_flat.dtype != jnp.float32:
+        r_flat = r_flat.astype(jnp.float32)  # f32 segment accumulation
     beliefs = tensors.unary_costs + segment_sum(
         r_flat, tensors.edge_var, V, indices_are_sorted=edges_sorted)
     vmask = tensors.domain_mask[tensors.edge_var]  # [E, D]
@@ -121,27 +132,39 @@ def maxsum_cycle(
     q_flat: jnp.ndarray,
     r_flat: jnp.ndarray,
     damping: float = 0.0,
+    msg_dtype=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One synchronous MaxSum cycle.
 
     Returns (q', r', beliefs, values).  Equivalent to every factor and
     variable computation firing once (the reference's
     SynchronousComputationMixin round, computations.py:633).
+    ``msg_dtype`` is the message STORAGE dtype (bf16 tier); the cycle
+    math — table reductions, damping blend, belief segment sums — is
+    always f32, with casts only at the storage boundary, so the f32
+    default emits an unchanged jaxpr.
     """
     vmask = tensors.domain_mask[tensors.edge_var]
     r_new = all_factor_messages(tensors, q_flat) * vmask
     if damping:
-        r_new = damping * r_flat + (1.0 - damping) * r_new
+        r_prev = r_flat if r_flat.dtype == jnp.float32 \
+            else r_flat.astype(jnp.float32)
+        r_new = damping * r_prev + (1.0 - damping) * r_new
     beliefs, q_new = var_beliefs_and_messages(tensors, r_new)
     values = select_values(tensors, beliefs)
+    if msg_dtype is not None and q_new.dtype != msg_dtype:
+        q_new = q_new.astype(msg_dtype)
+        r_new = r_new.astype(msg_dtype)
     return q_new, r_new, beliefs, values
 
 
-def init_messages(tensors: FactorGraphTensors) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def init_messages(tensors: FactorGraphTensors, dtype=jnp.float32
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Zero-initialized message arrays (the reference starts by sending
-    empty/zero costs, maxsum.py on_start)."""
+    empty/zero costs, maxsum.py on_start).  ``dtype`` is the message
+    storage tier (ops/precision.py message_dtype)."""
     E, D = tensors.n_edges, tensors.max_domain_size
-    z = jnp.zeros((E, D), dtype=jnp.float32)
+    z = jnp.zeros((E, D), dtype=dtype)
     return z, z
 
 

@@ -1,0 +1,256 @@
+"""Mixed-precision storage tiers for the tensor engines (ISSUE 19).
+
+Every engine compiles and computes in float32 by default.  This module
+adds two cheaper STORAGE tiers with f32 accumulation at every combine
+point (the PGMax memory discipline, arXiv:2202.04110), selected by a
+``precision`` knob threaded through the solvers, the sharded mesh, the
+batch engine, checkpoints and the CLI:
+
+* ``"f32"`` — the default.  :func:`apply_precision` returns the SAME
+  tensors object and every kernel's cast guard is a python no-op, so
+  the emitted jaxpr — and therefore the numerics — are bit-identical
+  to a build without this module (pinned by tests).
+* ``"bf16"`` — cost tables, maxsum messages/beliefs and the sharded
+  boundary slabs are STORED in bfloat16; every reduction (min over
+  table axes, segment sums, damping blends, psum'd partial beliefs)
+  upcasts to f32 first.  bfloat16 shares float32's exponent range, so
+  PAD_COST (1e30) survives the cast; entries at the hard-violation
+  threshold are rounded UP onto the bf16 grid so ``>= QUANT_THRESHOLD``
+  feasibility checks never lose a violation to round-to-nearest.  On
+  the sharded engines the ppermute/psum payload is the bf16 slab —
+  half the bytes per element, enforced by the audit registry's
+  per-tier budgets (jaxpr-walked, not estimated).
+* ``"int8"`` — cost tables are affine-quantized PER FACTOR: codes in
+  ``[QUANT_MIN, QUANT_MAX]`` with an f32 scale/offset pair riding
+  alongside the slab (``FactorBucket.qscale/qoffset``), dequantized on
+  gather.  Entries at or above ``QUANT_THRESHOLD`` (hard violations,
+  PAD) are pinned to the reserved ``QUANT_SATURATION`` code and
+  dequantize back to PAD_COST — infeasibility survives quantization
+  whatever the finite entries' dynamic range.  Round-trip error of
+  finite entries is <= qscale/2 (property-tested).  Messages still use
+  the bf16 tier (quantizing accumulating state would compound error).
+
+The exactness contract per tier is :data:`EXACTNESS` — the same
+three-level discipline as PR 5's overlap modes: engines declare which
+tiers they support in a ``PRECISION_TIERS`` map next to their cycle
+code, and refuse the rest with a typed :class:`PrecisionError` instead
+of silently computing something else.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from pydcop_tpu.ops.compile import (
+    PAD_COST,
+    QUANT_MAX,
+    QUANT_MIN,
+    QUANT_SATURATION,
+    QUANT_THRESHOLD,
+)
+
+#: the supported storage tiers, cheapest last
+PRECISIONS = ("f32", "bf16", "int8")
+
+#: exactness contract of each tier (mirrors PR 5's overlap-mode map):
+#: ``exact`` — bit-identical to the pre-knob engines; ``statistical`` —
+#: converges to final costs within the declared gate but individual
+#: message trajectories differ; ``quantized`` — costs are exact only up
+#: to the per-factor quantization step (argmin-preserving on integer
+#: tables whose range fits the code space).
+EXACTNESS = {"f32": "exact", "bf16": "statistical", "int8": "quantized"}
+
+#: the declared statistical gate of the bf16 tier: a bf16 run's final
+#: cost must land within RTOL of the f32 run's final cost (ATOL floors
+#: the comparison for near-zero optima).  The equivalence tests and
+#: the bench's precision leg both check THIS pair — one declared gate,
+#: not per-caller tolerances.
+BF16_COST_RTOL = 0.05
+BF16_COST_ATOL = 1.0
+
+
+class PrecisionError(ValueError):
+    """Unknown precision tier, or a tier an engine/path does not
+    support.  The message always names the supported fallback."""
+
+
+def resolve_precision(precision) -> str:
+    """Normalize/validate a precision knob value (None → ``"f32"``)."""
+    if precision in (None, ""):
+        return "f32"
+    p = str(precision).lower()
+    if p not in PRECISIONS:
+        raise PrecisionError(
+            f"unknown precision {precision!r}: expected one of "
+            f"{'/'.join(PRECISIONS)}"
+        )
+    return p
+
+
+def message_dtype(precision: str):
+    """Storage dtype of maxsum messages / boundary slabs at a tier.
+    int8 keeps bf16 messages: quantizing accumulating state would
+    compound error cycle over cycle."""
+    return jnp.bfloat16 if precision in ("bf16", "int8") else jnp.float32
+
+
+def payload_itemsize(precision: str) -> int:
+    """Bytes per element of the cross-device collective payload."""
+    return 2 if precision in ("bf16", "int8") else 4
+
+
+def precision_of(tensors) -> str:
+    """The storage tier a compiled graph is staged at (bucket dtype)."""
+    for b in tensors.buckets:
+        if b.tensors.dtype == jnp.int8:
+            return "int8"
+        if b.tensors.dtype == jnp.bfloat16:
+            return "bf16"
+    return "f32"
+
+
+# ---------------------------------------------------------------------------
+# bf16: guarded cast
+# ---------------------------------------------------------------------------
+
+
+def cast_bf16_preserving_hard(t: np.ndarray) -> np.ndarray:
+    """f32 → bf16 cast that never rounds an entry DOWN across the
+    hard-violation threshold.
+
+    round-to-nearest can map 10000.0 to 9984.0 (bf16 has 8 mantissa
+    bits), which would make a violated hard constraint pass a
+    ``>= QUANT_THRESHOLD`` feasibility check.  Entries that cross are
+    bumped one bf16 ulp up instead.
+    """
+    import ml_dtypes
+
+    t = np.asarray(t, dtype=np.float32)
+    bt = t.astype(ml_dtypes.bfloat16)
+    low = (t >= QUANT_THRESHOLD) & (bt.astype(np.float32) < QUANT_THRESHOLD)
+    if low.any():
+        bits = bt.view(np.uint16)
+        bits = np.where(low, bits + np.uint16(1), bits)
+        bt = bits.view(ml_dtypes.bfloat16)
+    return bt
+
+
+# ---------------------------------------------------------------------------
+# int8: per-factor affine quantization
+# ---------------------------------------------------------------------------
+
+
+def quantize_table(table: np.ndarray) -> Tuple[np.ndarray, np.ndarray,
+                                               np.ndarray]:
+    """Affine-quantize a stacked [F, D, ..., D] f32 cost table per factor.
+
+    Returns ``(codes int8, scale f32 [F], offset f32 [F])`` with
+    ``entry ~= code * scale + offset`` for finite entries (error
+    <= scale/2) and every entry >= QUANT_THRESHOLD pinned to the
+    QUANT_SATURATION code (dequantizes to PAD_COST).
+    """
+    t = np.asarray(table, dtype=np.float32)
+    F = t.shape[0]
+    flat = t.reshape(F, -1)
+    finite = flat < QUANT_THRESHOLD
+    any_finite = finite.any(axis=1)
+    lo = np.where(any_finite,
+                  np.where(finite, flat, np.inf).min(axis=1), 0.0)
+    hi = np.where(any_finite,
+                  np.where(finite, flat, -np.inf).max(axis=1), 0.0)
+    scale = (hi - lo) / float(QUANT_MAX - QUANT_MIN)
+    scale = np.where(scale <= 0.0, 1.0, scale).astype(np.float32)
+    offset = (lo - QUANT_MIN * scale).astype(np.float32)
+    codes = np.clip(
+        np.rint((flat - offset[:, None]) / scale[:, None]),
+        QUANT_MIN, QUANT_MAX,
+    ).astype(np.int8)
+    codes = np.where(finite, codes, np.int8(QUANT_SATURATION))
+    return codes.reshape(t.shape), scale, offset
+
+
+def quantize_row(row: np.ndarray) -> Tuple[np.ndarray, np.ndarray,
+                                           np.ndarray]:
+    """Quantize one factor's [D, ..., D] table (warm in-place edits):
+    returns (codes, scale scalar-array, offset scalar-array)."""
+    codes, scale, offset = quantize_table(np.asarray(row)[None])
+    return codes[0], scale[0], offset[0]
+
+
+def dequantize_table(codes, scale, offset) -> jnp.ndarray:
+    """Full-table dequantization (twin of the gather-side
+    ops.compile._dequant): [F, D..D] codes + per-factor scale/offset
+    → f32 table with saturated codes pinned to PAD_COST."""
+    codes = jnp.asarray(codes)
+    shape = (codes.shape[0],) + (1,) * (codes.ndim - 1)
+    return jnp.where(
+        codes == QUANT_SATURATION,
+        jnp.float32(PAD_COST),
+        codes.astype(jnp.float32) * jnp.reshape(scale, shape)
+        + jnp.reshape(offset, shape),
+    )
+
+
+# ---------------------------------------------------------------------------
+# staging: re-store a compiled graph at a tier
+# ---------------------------------------------------------------------------
+
+
+def apply_precision(tensors, precision):
+    """Return ``tensors`` staged at ``precision``.
+
+    ``"f32"`` returns the SAME object (the bit-identity pin: no copy,
+    no cast, no new jaxpr).  ``"bf16"`` re-stores every dense bucket
+    table in bfloat16 (guarded cast, see
+    :func:`cast_bf16_preserving_hard`).  ``"int8"`` quantizes every
+    dense bucket per factor and rides qscale/qoffset on the bucket.
+    Structured (table-free) parameter buckets stay f32 at every tier —
+    they are already O(k·D) bytes, far below any table.
+    """
+    p = resolve_precision(precision)
+    if p == "f32":
+        return tensors
+    staged = precision_of(tensors)
+    if staged != "f32":
+        if staged == p:
+            return tensors
+        raise PrecisionError(
+            f"tensors already staged at {staged!r}; recompile at f32 "
+            f"before re-staging to {p!r}"
+        )
+    buckets = []
+    for b in tensors.buckets:
+        if b.n_factors == 0:
+            buckets.append(b)
+        elif p == "bf16":
+            buckets.append(dataclasses.replace(
+                b,
+                tensors=jnp.asarray(
+                    cast_bf16_preserving_hard(np.asarray(b.tensors))
+                ),
+            ))
+        else:
+            codes, scale, offset = quantize_table(np.asarray(b.tensors))
+            buckets.append(dataclasses.replace(
+                b,
+                tensors=jnp.asarray(codes),
+                qscale=jnp.asarray(scale),
+                qoffset=jnp.asarray(offset),
+            ))
+    return dataclasses.replace(tensors, buckets=buckets)
+
+
+def require_tier(engine: str, precision: str, supported, fallback: str):
+    """Typed refusal helper: engines call this against their declared
+    ``PRECISION_TIERS`` map so an unsupported tier fails loudly with
+    the supported fallback named."""
+    p = resolve_precision(precision)
+    if p not in supported:
+        raise PrecisionError(
+            f"{engine} does not support precision={p!r} (supported: "
+            f"{'/'.join(sorted(supported))}); {fallback}"
+        )
+    return p

@@ -75,6 +75,13 @@ class CommPlan:
     #: packed and compact engines exchange 1-row partials
     extra_dense: int = 0
     extra_compact: int = 0
+    #: wire dtype of float collective payloads (ISSUE 19): None keeps
+    #: the native float32 (no casts emitted — the f32 tier stays
+    #: bit-identical); jnp.bfloat16 halves every boundary slab /
+    #: ppermute round on the wire, with the combine back into the f32
+    #: partial.  Integer payloads (routing, assignments) never cast.
+    payload_dtype: Optional[object] = None
+    payload_itemsize: int = 4
 
     @property
     def compact(self) -> bool:
@@ -103,12 +110,18 @@ class CommPlan:
             boundary_fraction=(
                 info.boundary_fraction if info else 0.0
             ),
-            bytes_per_cycle_dense=4 * self.width_dense * (
-                self.rows + self.extra_dense
+            # the main slab travels at the wire itemsize; the 1-row
+            # arbitration extras keep f32 (one of MGM's pair carries
+            # float-encoded indices, which bf16 would corrupt) — for
+            # f32 plans both terms collapse to the historical
+            # 4 * width * (rows + extra)
+            bytes_per_cycle_dense=self.width_dense * (
+                self.payload_itemsize * self.rows + 4 * self.extra_dense
             ),
-            bytes_per_cycle_compact=4 * width_c * (
-                self.rows + (self.extra_dense if self.mode == "dense"
-                             else self.extra_compact)
+            bytes_per_cycle_compact=width_c * (
+                self.payload_itemsize * self.rows
+                + 4 * (self.extra_dense if self.mode == "dense"
+                       else self.extra_compact)
             ),
             exchange_rounds=(
                 len(self.rounds)
@@ -191,8 +204,29 @@ def _announce_comm(plan: CommPlan, n_shards: int, engine: str,
     send_shard("comm.selected", payload)
 
 
+def _to_wire(x, plan: CommPlan):
+    """Cast a float32 collective payload to the plan's wire dtype
+    (ISSUE 19).  Python-level no-op when the plan carries native f32 —
+    the f32 tier emits the exact pre-PR jaxpr."""
+    if plan.payload_dtype is None or x.dtype != jnp.float32:
+        return x
+    return x.astype(plan.payload_dtype)
+
+
+def _psum_wire(x, plan: CommPlan):
+    """psum with the payload on the wire dtype; the total is widened
+    back to float32 BEFORE it joins any accumulation (combine points
+    stay f32)."""
+    if plan.payload_dtype is None or x.dtype != jnp.float32:
+        return jax.lax.psum(x, AXIS)
+    return jax.lax.psum(
+        x.astype(plan.payload_dtype), AXIS
+    ).astype(jnp.float32)
+
+
 def _combine_boundary(part, plan: CommPlan, bnd, axis: int,
-                      op: str = "sum", exch_blocks=None):
+                      op: str = "sum", exch_blocks=None,
+                      wire: bool = True):
     """Inside ``shard_map``: combine per-shard partials across the mesh
     at the BOUNDARY indices only, leaving interior entries as the local
     partial (which IS the global total for an interior column — its
@@ -216,7 +250,11 @@ def _combine_boundary(part, plan: CommPlan, bnd, axis: int,
             if not perm:
                 continue
             seg = jnp.take(part, send[r], axis=axis)
+            if wire:
+                seg = _to_wire(seg, plan)
             got = jax.lax.ppermute(seg, AXIS, perm)
+            if got.dtype != part.dtype:
+                got = got.astype(part.dtype)
             v = valid[r]
             if part.ndim == 2:
                 v = v[None, :] if axis == 1 else v[:, None]
@@ -226,8 +264,12 @@ def _combine_boundary(part, plan: CommPlan, bnd, axis: int,
                                  "min": "min"}[op])(upd)
         return part
     slab = jnp.take(part, bnd, axis=axis)
+    if wire:
+        slab = _to_wire(slab, plan)
     tot = {"sum": jax.lax.psum, "max": jax.lax.pmax,
            "min": jax.lax.pmin}[op](slab, AXIS)
+    if tot.dtype != part.dtype:
+        tot = tot.astype(part.dtype)
     ref = part.at[:, bnd] if axis == 1 else part.at[bnd]
     return ref.set(tot)
 
@@ -361,6 +403,13 @@ class ShardedFactorGraph:
         return self.base.max_domain_size
 
 
+class StructuredShardingUnsupported(NotImplementedError):
+    """Typed refusal: structured (table-free) buckets reached a sharded
+    engine that cannot partition them (ISSUE 19 satellite).  Subclasses
+    NotImplementedError so pre-existing handlers keep working; the
+    message text is pinned by tests — it names the fallback paths."""
+
+
 def shard_factor_graph(
     tensors: FactorGraphTensors, n_shards: int,
     assigns: Optional[List[np.ndarray]] = None,
@@ -373,7 +422,7 @@ def shard_factor_graph(
     distribution YAML, reference pydcop/commands/solve.py:483-507) drives
     device sharding."""
     if getattr(tensors, "sbuckets", None):
-        raise NotImplementedError(
+        raise StructuredShardingUnsupported(
             "sharded maxsum does not yet shard table-free (structured) "
             "buckets; run the single-device engine or densify small "
             "structured constraints first"
@@ -466,6 +515,22 @@ def shard_factor_graph(
 class _CommPlanMixin:
     """Shared comm-plan plumbing for the sharded engines (ISSUE 5)."""
 
+    #: storage/wire tiers of the sharded engines (ISSUE 19 exactness
+    #: map): tables stay f32 on every shard; bf16 rides the WIRE only
+    #: (boundary slabs / ppermute rounds / dense belief psums), with
+    #: all accumulation back at f32.  int8 is refused — quantized
+    #: tables are a single-device storage tier, and a quantized wire
+    #: would compound per-cycle
+    PRECISION_TIERS = {"f32": "exact", "bf16": "statistical"}
+
+    def _resolve_precision(self, precision, engine: str) -> str:
+        from pydcop_tpu.ops.precision import require_tier
+
+        return require_tier(
+            engine, precision, self.PRECISION_TIERS,
+            "run the single-device engine for int8 storage",
+        )
+
     def _make_comm_plan(self, overlap, threshold, exchange,
                         extra_dense: int = 0,
                         extra_compact: int = 0) -> CommPlan:
@@ -481,11 +546,15 @@ class _CommPlanMixin:
             else (src.exch_send, src.exch_recv, src.exch_valid)
         )
         own = src.own_rows
-        return _plan_comm(
+        plan = _plan_comm(
             overlap, threshold, exchange, src.boundary, bnd, own,
             exch, src.exch_rounds, width_dense=width, rows=rows,
             extra_dense=extra_dense, extra_compact=extra_compact,
         )
+        if getattr(self, "precision", "f32") == "bf16":
+            plan.payload_dtype = jnp.bfloat16
+            plan.payload_itemsize = 2
+        return plan
 
     def comm_stats(self) -> dict:
         """The chosen collective path + partition quality as a plain
@@ -519,14 +588,27 @@ class _CommPlanMixin:
             plan.width_dense if plan.mode == "dense"
             else plan.width_compact
         )
-        payload = 4 * max(1, width) * max(1, plan.rows)
+        extra = (plan.extra_dense if plan.mode == "dense"
+                 else plan.extra_compact)
+        # largest single collective: the slab at the wire itemsize, or
+        # (when the slab is bf16 and single-row) an f32 arbitration row
+        payload = max(1, width) * max(
+            plan.payload_itemsize * max(1, plan.rows),
+            4 if extra else 0,
+        )
+        dtypes = self.SHARDED_DTYPES
+        if plan.payload_dtype is not None:
+            # low-precision wire: the cycle program legitimately holds
+            # bf16 avals; the f32 tier keeps EXCLUDING bfloat16 so a
+            # silently downcast payload fails its audit
+            dtypes = dtypes | {"bfloat16"}
         full = {k: 0 for k in COLLECTIVE_KINDS}
         full.update(counts)
         return ProgramBudget(
             collectives=full,
             max_collective_bytes=payload,
             max_host_callbacks=0,
-            dtypes=self.SHARDED_DTYPES,
+            dtypes=dtypes,
             max_const_bytes=self.SHARDED_CONST_SLACK + extra_const,
             donate=True,
         )
@@ -561,10 +643,14 @@ class ShardedMaxSum(_CommPlanMixin):
         boundary_threshold: float = 0.5,
         exchange: Optional[bool] = None,
         sentinel: bool = False,
+        precision: Optional[str] = None,
     ):
         self.mesh = mesh or build_mesh()
         self.n_shards = self.mesh.devices.size
         self.base = tensors
+        self.precision = self._resolve_precision(
+            precision, "sharded maxsum"
+        )
         self.packs = None
         #: in-jit integrity sentinels (ISSUE 14): the chunk runner
         #: additionally computes nonfinite/checksum/residual
@@ -738,7 +824,7 @@ class ShardedMaxSum(_CommPlanMixin):
         r_new, vmask = self._r_new_block(q_blk, r_blk, bucket_blocks)
         # partial belief sums; the one collective of the cycle
         partial = segment_sum(r_new, self._edge_var_blk, V + 1)
-        total = jax.lax.psum(partial, AXIS)
+        total = _psum_wire(partial, self.comm)
         beliefs = st.unary + total[:V]
         values = masked_argmin(beliefs, st.base.domain_mask)
         q_new, r_new = self._var_side(
@@ -765,7 +851,7 @@ class ShardedMaxSum(_CommPlanMixin):
         pend2 = None
         if comm.mode == "stale":
             bnd = tail[0]
-            tot = jax.lax.psum(pend, AXIS)
+            tot = _psum_wire(pend, comm)
             pend2 = jnp.take(partial, bnd, axis=0)
             total = partial.at[bnd].set(tot)
         elif comm.collective == "ppermute":
@@ -815,7 +901,7 @@ class ShardedMaxSum(_CommPlanMixin):
             partial = partial + g[:, k]
         pend2 = None
         if comm.mode == "stale":
-            tot = jax.lax.psum(pend, AXIS)
+            tot = _psum_wire(pend, comm)
             pend2 = partial[slab_loc]
             partial = partial.at[slab_loc].set(tot)
         elif comm.collective == "ppermute":
@@ -824,7 +910,7 @@ class ShardedMaxSum(_CommPlanMixin):
                 exch_blocks=tuple(t[0] for t in tail),
             )
         elif comm.collective == "psum":
-            tot = jax.lax.psum(partial[slab_loc], AXIS)
+            tot = _psum_wire(partial[slab_loc], comm)
             partial = partial.at[slab_loc].set(tot)
         beliefs = unary_loc + partial
         # var side on local rows (beliefs gather via edge_loc)
@@ -1093,12 +1179,12 @@ class ShardedMaxSum(_CommPlanMixin):
             """(beliefs partial with cross-shard totals merged at the
             boundary columns, next pending slab)."""
             if not compact:
-                return jax.lax.psum(bel, AXIS), None
+                return _psum_wire(bel, comm), None
             if comm.collective == "none":
                 return bel, None
             if stale:
                 bnd = tail[0]
-                tot = jax.lax.psum(pend, AXIS)
+                tot = _psum_wire(pend, comm)
                 return bel.at[:, bnd].set(tot), jnp.take(bel, bnd, axis=1)
             if comm.collective == "ppermute":
                 blocks = tuple(t[0] for t in tail)
@@ -1860,7 +1946,8 @@ class ShardedLocalSearch(_CommPlanMixin):
                  overlap: Optional[str] = None,
                  boundary_threshold: float = 0.5,
                  exchange: Optional[bool] = None,
-                 sentinel: bool = False):
+                 sentinel: bool = False,
+                 precision: Optional[str] = None):
         from pydcop_tpu.ops.compile import ConstraintGraphTensors
 
         assert isinstance(tensors, ConstraintGraphTensors), (
@@ -1879,6 +1966,11 @@ class ShardedLocalSearch(_CommPlanMixin):
         self.rule = rule
         self.probability = probability
         self.params = dict(algo_params or {})
+        self.precision = self._resolve_precision(
+            precision if precision is not None
+            else self.params.pop("precision", None),
+            f"sharded {rule}",
+        )
         # unweighted rules run the lane-packed tables kernel per shard;
         # the breakout rules (dba/gdba) carry per-factor weight state the
         # packed layout doesn't hold, so they keep the generic blocks
@@ -2205,12 +2297,12 @@ class ShardedLocalSearch(_CommPlanMixin):
             next pending slab) — the ONE collective of a compact cycle
             (dense keeps the full psum)."""
             if not compact:
-                return jax.lax.psum(bel, AXIS), None
+                return _psum_wire(bel, comm), None
             if comm.collective == "none":
                 return bel, None
             if stale:
                 bnd = tail[0]
-                tot = jax.lax.psum(pend, AXIS)
+                tot = _psum_wire(pend, comm)
                 if axis == 1:
                     return (bel.at[:, bnd].set(tot),
                             jnp.take(bel, bnd, axis=1))
@@ -2222,22 +2314,28 @@ class ShardedLocalSearch(_CommPlanMixin):
                 ), None
             return _combine_boundary(bel, comm, tail[0], axis=axis), None
 
-        def _combine_arb(part, tail, op, axis):
+        def _combine_arb(part, tail, op, axis, wire=True):
             """MGM-family arbitration combine: dense pmax/pmin over the
             whole row vs boundary-compacted (always synchronous — gains
-            are this cycle's even in stale mode)."""
+            are this cycle's even in stale mode).  ``wire=False`` pins
+            the payload to its native dtype — the tie-break row carries
+            FLOAT-ENCODED variable indices, which a bf16 wire cast
+            would round to the wrong variable (ISSUE 19)."""
             if not compact:
-                return (jax.lax.pmax if op == "max"
-                        else jax.lax.pmin)(part, AXIS)
+                wired = _to_wire(part, comm) if wire else part
+                tot = (jax.lax.pmax if op == "max"
+                       else jax.lax.pmin)(wired, AXIS)
+                return (tot.astype(part.dtype)
+                        if tot.dtype != part.dtype else tot)
             if comm.collective == "none":
                 return part
             if comm.collective == "ppermute":
                 return _combine_boundary(
                     part, comm, None, axis=axis, op=op,
-                    exch_blocks=_exch_blocks(tail),
+                    exch_blocks=_exch_blocks(tail), wire=wire,
                 )
             return _combine_boundary(part, comm, tail[0], axis=axis,
-                                     op=op)
+                                     op=op, wire=wire)
 
         def packed_cycle_fn(x, key, aux, pend, *rest):
             """One lane-packed sharded cycle: ``x`` is the [1, Vp]
@@ -2354,7 +2452,8 @@ class ShardedLocalSearch(_CommPlanMixin):
                 idx_part = _tiebreak_idx_partial(
                     pg, nm_exp, gn, mate, gn2, mate2, gn3, mate3
                 )
-                idx_at_max = _combine_arb(idx_part, tail, "min", axis=1)
+                idx_at_max = _combine_arb(idx_part, tail, "min", axis=1,
+                                          wire=False)
                 move = _mgm_decision(gain, idx_row, neigh_max,
                                      idx_at_max)
             x2 = jnp.where(move & (colmask > 0), best_idx, x)
@@ -2466,7 +2565,7 @@ class ShardedLocalSearch(_CommPlanMixin):
                     jnp.where(at_max, src_blk, V), dst_blk, V + 1
                 )
                 idx_at_max = _combine_arb(
-                    idx_part, tail, "min", axis=0
+                    idx_part, tail, "min", axis=0, wire=False
                 )[:V]
                 me = jnp.arange(V)
                 move = (gain > 0) & (

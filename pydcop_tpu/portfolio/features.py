@@ -80,16 +80,22 @@ ENGINE_CHOICES: Tuple[str, ...] = (
     "harness", "auto", "minibucket", "sharded", "frontier",
 )
 OVERLAP_CHOICES: Tuple[str, ...] = ("default", "off", "exact", "stale")
+#: mixed-precision tiers (ISSUE 19) — the tier changes both the cost
+#: profile (collective bytes, table footprint) and the result quality
+#: class, so the model sees it as its own one-hot block
+PRECISION_CHOICES: Tuple[str, ...] = ("f32", "bf16", "int8")
 
 #: length of the config-encoding vector
 CONFIG_ENC_LEN = (
-    len(ALGO_CHOICES) + len(ENGINE_CHOICES) + len(OVERLAP_CHOICES) + 4
+    len(ALGO_CHOICES) + len(ENGINE_CHOICES) + len(OVERLAP_CHOICES)
+    + len(PRECISION_CHOICES) + 4
 )
 
 CONFIG_ENC_NAMES: Tuple[str, ...] = tuple(
     [f"algo={a}" for a in ALGO_CHOICES]
     + [f"engine={e}" for e in ENGINE_CHOICES]
     + [f"overlap={o}" for o in OVERLAP_CHOICES]
+    + [f"precision={p}" for p in PRECISION_CHOICES]
     + ["log1p_chunk", "boundary_threshold", "i_bound", "log1p_budget_mb"]
 )
 
@@ -142,6 +148,18 @@ def featurize_detail(dcop, n_shards: int = REFERENCE_SHARDS):
     dense_table_bytes = 0.0
     structured_dense_bytes = 0.0
     structured_over_cap = False
+    # int8 routing signal (ISSUE 19): per-factor quantization is
+    # LOSSLESS exactly when every table is integer-valued with its
+    # value range inside the 253 usable code levels (scale <= 1 →
+    # round-trip error < 0.5 → argmins preserved) and free of
+    # hard/BIG entries, which would pin to the saturation code.
+    # Anything unknown — a structured constraint, a relation type
+    # that exposes no materialized matrix — keeps the signal False:
+    # the `solve --auto` mask is conservative by construction.
+    # Scans only matrices the relations ALREADY hold; builds nothing.
+    from pydcop_tpu.ops.compile import QUANT_THRESHOLD
+
+    int8_safe = True
     for c in dcop.constraints.values():
         if isinstance(c, StructuredConstraint):
             n_structured += 1
@@ -153,11 +171,25 @@ def featurize_detail(dcop, n_shards: int = REFERENCE_SHARDS):
             dense_table_bytes += b
             if c.dense_entries() > MAX_DENSIFY_ENTRIES:
                 structured_over_cap = True
+            int8_safe = False
         else:
             b = 4.0
             for v in c.dimensions:
                 b *= len(v.domain)
             dense_table_bytes += b
+            if int8_safe:
+                m = getattr(c, "matrix", None)
+                if m is None:
+                    int8_safe = False
+                else:
+                    m = np.asarray(m, dtype=np.float64)
+                    if (m.size == 0
+                            or not np.all(np.isfinite(m))
+                            or float(m.max()) >= QUANT_THRESHOLD
+                            or not np.allclose(
+                                m, np.round(m), atol=1e-6)
+                            or float(m.max() - m.min()) > 253.0):
+                        int8_safe = False
 
     dom_sizes = np.asarray(
         [len(v.domain) for v in dcop.variables.values()] or [1],
@@ -237,6 +269,7 @@ def featurize_detail(dcop, n_shards: int = REFERENCE_SHARDS):
         "dense_table_bytes": float(dense_table_bytes),
         "structured_dense_bytes": float(structured_dense_bytes),
         "structured_over_table_cap": structured_over_cap,
+        "int8_safe": bool(int8_safe and n_factors > 0),
     }
     return vec, info
 
@@ -265,6 +298,7 @@ def encode_config(cfg: Any) -> np.ndarray:
         _one_hot(ALGO_CHOICES, cfg.algo)
         + _one_hot(ENGINE_CHOICES, cfg.engine)
         + _one_hot(OVERLAP_CHOICES, cfg.overlap)
+        + _one_hot(PRECISION_CHOICES, getattr(cfg, "precision", "f32"))
         + [
             float(np.log1p(max(0, int(cfg.chunk)))),
             float(cfg.boundary_threshold),

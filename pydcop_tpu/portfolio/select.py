@@ -80,13 +80,19 @@ class PortfolioConfig:
     boundary_threshold: float = 0.5
     budget_mb: float = 0.0     # 0 = engine caps (dpop only)
     i_bound: int = 0           # 0 = off (dpop only)
+    precision: str = "f32"     # f32 | bf16 | int8 (ISSUE 19 tiers)
 
     def key(self) -> str:
-        return (
+        # the f32 default keeps the pre-tier key format so the label
+        # space of existing datasets/benchmarks stays joinable
+        base = (
             f"{self.algo}|{self.engine}|c{self.chunk}|{self.overlap}"
             f"|t{self.boundary_threshold:g}|b{self.budget_mb:g}"
             f"|i{self.i_bound}"
         )
+        if self.precision != "f32":
+            base += f"|p{self.precision}"
+        return base
 
     def as_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -112,6 +118,11 @@ class PortfolioConfig:
                 params["budget_mb"] = float(self.budget_mb)
             return params
         if self.algo != "dpop":
+            # the iterative engines take the tier as an algo param;
+            # f32 stays parameterless so pre-tier resolved configs
+            # (and their cache keys) are byte-identical
+            if self.precision != "f32":
+                return {"precision": self.precision}
             return {}
         params: Dict[str, Any] = {"engine": self.engine}
         if self.budget_mb > 0:
@@ -155,6 +166,15 @@ DEFAULT_GRID: Tuple[PortfolioConfig, ...] = (
     # exact search")
     PortfolioConfig("syncbb", engine="frontier",
                     budget_mb=AUTO_DPOP_BUDGET_MB),
+    # mixed-precision tiers (ISSUE 19): the cheap tiers ride the grid
+    # behind hard feasibility masks — int8 only where the featurizer
+    # proved it lossless (integer-valued small-range soft tables, no
+    # hard/BIG entries), bf16 under the statistical-equivalence gate
+    PortfolioConfig("maxsum", precision="bf16"),
+    PortfolioConfig("mgm", precision="bf16"),
+    PortfolioConfig("dsa", precision="bf16"),
+    PortfolioConfig("maxsum", precision="int8"),
+    PortfolioConfig("mgm", precision="int8"),
 )
 
 #: 3-cell grid for smokes/tests: one BP engine, one local-search
@@ -204,6 +224,39 @@ def feasible_grid(
         info.get("structured_over_table_cap", False)
     )
     for cfg in grid:
+        prec = getattr(cfg, "precision", "f32")
+        if prec != "f32":
+            # mixed-precision masks (ISSUE 19): the cheap tiers are
+            # only ROUTED where the engines declared them safe — a
+            # forced pick still gets the engines' typed PrecisionError
+            if cfg.algo in ("dpop", "syncbb", "ncbb"):
+                masked.append((cfg, (
+                    "the exact engines compute util tables in f32 only"
+                )))
+                continue
+            if n_structured > 0:
+                masked.append((cfg, (
+                    "precision tiers re-encode cost tables; structured "
+                    "(table-free) constraints keep their closed-form "
+                    "f32 kernels"
+                )))
+                continue
+        if prec == "int8":
+            if cfg.algo in ("dba", "gdba"):
+                masked.append((cfg, (
+                    "per-factor weighting rescales cost tables every "
+                    "cycle; frozen int8 codes cannot follow"
+                )))
+                continue
+            if not bool(info.get("int8_safe", False)):
+                # conservative by construction: unknown table contents
+                # (or any hard/BIG entry, non-integer values, range
+                # past the 253 code levels) keep int8 OFF the menu
+                masked.append((cfg, (
+                    "int8 is only safe on integer-valued cost tables "
+                    "with range <= 253 and no hard/BIG entries"
+                )))
+                continue
         if cfg.algo in ("gdba", "dba") and n_structured > 0:
             # the weighted local-search family substitutes per-factor
             # cost tensors — structured factors have none and the

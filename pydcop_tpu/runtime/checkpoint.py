@@ -141,6 +141,11 @@ def save_checkpoint(path: str, solver, extra: Optional[Dict] = None,
         "algo": solver.algo_def.algo,
         "params": solver.algo_def.params,
         "seed": solver.seed,
+        # precision tier the state leaves were produced under: int8 leaves
+        # carry quantized tables, bf16 leaves carry bfloat16 messages — a
+        # restore into a solver staged at another tier would silently mix
+        # representations, so load_checkpoint refuses on mismatch
+        "precision": getattr(solver, "precision", "f32"),
         "n_leaves": len(leaves),
         "extra": extra or {},
     }
@@ -196,6 +201,17 @@ def load_checkpoint(path: str, solver) -> Dict[str, Any]:
             f"or foreign file"
         ) from e
     key = arrays.get("__prng_key__")
+    ckpt_tier = meta.get("precision", "f32")
+    solver_tier = getattr(solver, "precision", "f32")
+    if ckpt_tier != solver_tier:
+        from ..ops.precision import PrecisionError
+
+        raise PrecisionError(
+            f"checkpoint {path!r} was saved at precision={ckpt_tier!r} but "
+            f"the restoring solver is staged at precision={solver_tier!r}; "
+            f"rebuild the solver with precision={ckpt_tier!r} to resume "
+            f"this checkpoint (state leaves are tier-specific)"
+        )
     ref_state = solver.initial_state()
     ref_leaves, treedef = jax.tree.flatten(ref_state)
     if len(ref_leaves) != len(leaves):

@@ -431,10 +431,12 @@ def _solve_under_placement(
         activation = float(
             algo_def.params.get("activation", DEFAULT_ACTIVATION)
         )
+    precision = algo_def.params.get("precision")
     sharded = ShardedMaxSum(tensors, mesh, damping=damping,
                             assigns=assigns, activation=activation,
                             overlap=shard_overlap,
-                            boundary_threshold=shard_boundary_threshold)
+                            boundary_threshold=shard_boundary_threshold,
+                            precision=precision)
     n_cycles = cycles or 30
     status = "FINISHED"
     history = []
@@ -478,6 +480,7 @@ def _solve_under_placement(
         algo_def.algo, "sharded_mesh",
         overlap=shard_overlap or "default",
         boundary_threshold=shard_boundary_threshold,
+        precision=sharded.precision,
     )
     return SolveResult(
         status=status,

@@ -587,6 +587,7 @@ CONFIG_FIELDS = (
     "boundary_threshold",  # PR 5 auto-policy threshold in force
     "dpop_budget_mb",      # per-device util-table budget (0 = caps)
     "i_bound",             # mini-bucket width bound (0 = off)
+    "precision",           # storage tier: f32 | bf16 | int8 (ISSUE 19)
 )
 
 
@@ -598,6 +599,7 @@ def resolved_config(
     boundary_threshold: float = 0.5,
     dpop_budget_mb: float = 0.0,
     i_bound: int = 0,
+    precision: str = "f32",
 ) -> dict:
     """Build the canonical config dict (all CONFIG_FIELDS, typed)."""
     return {
@@ -608,6 +610,7 @@ def resolved_config(
         "boundary_threshold": float(boundary_threshold),
         "dpop_budget_mb": float(dpop_budget_mb),
         "i_bound": int(i_bound),
+        "precision": str(precision),
     }
 
 

@@ -300,18 +300,35 @@ class MemoCache:
             (probe.tenant, probe.algo, probe.pkey, probe.shape_sig))
         if not bucket:
             return
-        ranked = []
-        for k in bucket:
-            e = self._entries.get(k)
-            if e is None:
-                continue
-            if probe.features is not None and e.features is not None:
-                d = float(np.linalg.norm(
-                    probe.features - e.features.astype(np.float32)))
-            else:
-                d = float("inf")
-            ranked.append((d, e))
-        ranked.sort(key=lambda t: t[0])
+        entries = [
+            e for e in (self._entries.get(k) for k in bucket)
+            if e is not None
+        ]
+        if not entries:
+            return
+        # ONE [B, F] distance computation replaces the per-entry norm
+        # loop.  Entries lacking features rank last at +inf, and the
+        # STABLE argsort keeps bucket insertion order among equal
+        # distances — the same tie-break the stable per-entry sort
+        # produced, so the matched entry is identical to the scan
+        # this replaces (pinned by test)
+        dists = np.full(len(entries), np.inf, dtype=np.float64)
+        if probe.features is not None:
+            with_f = [
+                i for i, e in enumerate(entries)
+                if e.features is not None
+            ]
+            if with_f:
+                mat = np.stack([
+                    entries[i].features.astype(np.float32)
+                    for i in with_f
+                ])
+                delta = mat - probe.features[None, :]
+                dists[with_f] = np.sqrt(
+                    np.sum(np.square(delta, dtype=np.float64), axis=1)
+                )
+        order = np.argsort(dists, kind="stable")
+        ranked = [(float(dists[i]), entries[i]) for i in order]
         for d, e in ranked:
             diff = factor_diff(e.digests, None, probe.digests)
             if diff.edits <= self.config.max_edits:

@@ -1,0 +1,685 @@
+"""Mixed-precision tiers (ISSUE 19).
+
+The acceptance contract under test:
+
+* ``precision="f32"`` is BIT-IDENTICAL to a build without the knob:
+  :func:`ops.precision.apply_precision` returns the SAME tensors
+  object and solver results match the default path exactly;
+* int8 quantization is property-bounded — round-trip error of finite
+  entries <= scale/2, every hard/BIG entry pinned to the saturation
+  code (dequantizes to PAD_COST), and argmins preserved on
+  integer-valued tables whose range fits the code space;
+* bf16 final costs sit inside the DECLARED statistical gate
+  (``ops.precision.BF16_COST_RTOL``/``ATOL``) for maxsum/mgm/dsa
+  across seeds — one declared gate, not per-test tolerances;
+* the audit registry PROVES the collective-byte cut: the bf16 cells'
+  jaxpr-walked payloads are >= 2x smaller than their f32 twins';
+* unsupported tiers refuse with typed errors and pinned messages
+  (engine tier maps, sharded int8, batched int8, weighted-rule int8,
+  structured sharding/batching);
+* checkpoints record the tier and refuse a mismatched restore;
+* ``solve --auto`` never routes int8 where the featurizer could not
+  prove it lossless (conservative mask, pinned);
+* warm quantized in-place edits keep the zero-retrace contract;
+* the vectorized memo embedding scan matches the per-entry loop it
+  replaced, including the stable insertion-order tie-break.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms import AlgorithmDef, load_algorithm_module
+from pydcop_tpu.generators import generate_graph_coloring
+from pydcop_tpu.ops.compile import (
+    PAD_COST,
+    QUANT_SATURATION,
+    QUANT_THRESHOLD,
+)
+from pydcop_tpu.ops.precision import (
+    BF16_COST_ATOL,
+    BF16_COST_RTOL,
+    EXACTNESS,
+    PRECISIONS,
+    PrecisionError,
+    apply_precision,
+    cast_bf16_preserving_hard,
+    dequantize_table,
+    message_dtype,
+    payload_itemsize,
+    precision_of,
+    quantize_table,
+    resolve_precision,
+)
+
+
+def _dcop(seed=1, V=16, E=24):
+    return generate_graph_coloring(
+        n_variables=V, n_colors=3, n_edges=E, soft=True, n_agents=1,
+        seed=seed,
+    )
+
+
+def _solver(algo, dcop, precision=None, seed=0):
+    params = {} if precision is None else {"precision": precision}
+    adef = AlgorithmDef.build_with_default_params(algo, params)
+    return load_algorithm_module(algo).build_solver(
+        dcop, algo_def=adef, seed=seed
+    )
+
+
+# ---------------------------------------------------------------------------
+# tier map + knob plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestTierMap:
+    def test_exactness_map_covers_every_tier(self):
+        assert set(EXACTNESS) == set(PRECISIONS)
+        assert EXACTNESS["f32"] == "exact"
+        assert EXACTNESS["bf16"] == "statistical"
+        assert EXACTNESS["int8"] == "quantized"
+
+    def test_resolve_defaults_and_rejects(self):
+        assert resolve_precision(None) == "f32"
+        assert resolve_precision("") == "f32"
+        assert resolve_precision("BF16") == "bf16"
+        with pytest.raises(PrecisionError, match="f32/bf16/int8"):
+            resolve_precision("fp8")
+
+    def test_payload_and_message_dtypes(self):
+        import jax.numpy as jnp
+
+        assert payload_itemsize("f32") == 4
+        assert payload_itemsize("bf16") == 2
+        # int8 keeps bf16 messages: quantizing accumulating state
+        # would compound error cycle over cycle
+        assert message_dtype("int8") == jnp.bfloat16
+        assert message_dtype("f32") == jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# int8 quantization properties
+# ---------------------------------------------------------------------------
+
+
+class TestQuantization:
+    def test_round_trip_error_bounded_by_half_scale(self):
+        rng = np.random.default_rng(0)
+        t = rng.uniform(-30.0, 170.0, (6, 3, 3)).astype(np.float32)
+        codes, scale, offset = quantize_table(t)
+        deq = np.asarray(dequantize_table(codes, scale, offset))
+        err = np.abs(deq - t).reshape(6, -1).max(axis=1)
+        assert np.all(err <= scale / 2 + 1e-5), (err, scale)
+
+    def test_big_entries_saturate_and_dequantize_to_pad(self):
+        t = np.array(
+            [[[0.0, 3.0], [QUANT_THRESHOLD, PAD_COST]]], np.float32
+        )
+        codes, scale, offset = quantize_table(t)
+        assert codes[0, 1, 0] == QUANT_SATURATION
+        assert codes[0, 1, 1] == QUANT_SATURATION
+        deq = np.asarray(dequantize_table(codes, scale, offset))
+        assert deq[0, 1, 0] == np.float32(PAD_COST)
+        assert deq[0, 1, 1] == np.float32(PAD_COST)
+        # finite entries unharmed by the saturated neighbors
+        assert abs(deq[0, 0, 1] - 3.0) <= scale[0] / 2 + 1e-6
+
+    def test_argmin_preserved_on_integer_tables(self):
+        rng = np.random.default_rng(7)
+        t = rng.integers(0, 254, (8, 4, 4)).astype(np.float32)
+        codes, scale, offset = quantize_table(t)
+        assert np.all(scale <= 1.0 + 1e-6)
+        deq = np.asarray(dequantize_table(codes, scale, offset))
+        # error < 0.5 on an integer grid -> every argmin survives
+        flat_t = t.reshape(8, -1)
+        flat_d = deq.reshape(8, -1)
+        assert np.array_equal(
+            np.argmin(flat_t, axis=1), np.argmin(flat_d, axis=1)
+        )
+
+    def test_constant_table_quantizes_without_dividing_by_zero(self):
+        t = np.full((2, 3, 3), 5.0, np.float32)
+        codes, scale, offset = quantize_table(t)
+        deq = np.asarray(dequantize_table(codes, scale, offset))
+        np.testing.assert_allclose(deq, t, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# bf16 guarded cast
+# ---------------------------------------------------------------------------
+
+
+class TestBf16Cast:
+    def test_hard_threshold_never_rounds_below(self):
+        t = np.array(
+            [QUANT_THRESHOLD, 10001.0, 12345.0, PAD_COST], np.float32
+        )
+        bt = cast_bf16_preserving_hard(t).astype(np.float32)
+        assert np.all(bt >= QUANT_THRESHOLD), bt
+
+    def test_soft_entries_round_to_nearest(self):
+        t = np.array([0.5, 9999.0, 1.0 / 3.0], np.float32)
+        bt = cast_bf16_preserving_hard(t).astype(np.float32)
+        assert bt[0] == 0.5
+        assert bt[1] < QUANT_THRESHOLD  # stays a soft cost
+        assert abs(bt[2] - 1.0 / 3.0) < 2e-3
+
+
+# ---------------------------------------------------------------------------
+# f32 bit-identity + staging
+# ---------------------------------------------------------------------------
+
+
+class TestF32BitIdentity:
+    def test_apply_precision_f32_is_the_same_object(self):
+        s = _solver("maxsum", _dcop())
+        assert apply_precision(s.tensors, "f32") is s.tensors
+        assert apply_precision(s.tensors, None) is s.tensors
+
+    @pytest.mark.parametrize("algo", ["maxsum", "mgm", "dsa"])
+    def test_explicit_f32_matches_default_run(self, algo):
+        d = _dcop(seed=2)
+        ref = _solver(algo, d, precision=None, seed=1).run(
+            cycles=40, chunk=20
+        )
+        got = _solver(algo, d, precision="f32", seed=1).run(
+            cycles=40, chunk=20
+        )
+        assert got.assignment == ref.assignment
+        assert got.cost == ref.cost
+        assert got.cycle == ref.cycle
+
+    def test_double_staging_is_idempotent_and_cross_tier_refuses(self):
+        s = _solver("maxsum", _dcop())
+        b = apply_precision(s.tensors, "bf16")
+        assert precision_of(b) == "bf16"
+        assert apply_precision(b, "bf16") is b
+        with pytest.raises(PrecisionError, match="already staged"):
+            apply_precision(b, "int8")
+
+
+# ---------------------------------------------------------------------------
+# bf16 statistical equivalence (the declared gate)
+# ---------------------------------------------------------------------------
+
+
+class TestStatisticalEquivalence:
+    @pytest.mark.parametrize("algo", ["maxsum", "mgm", "dsa"])
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_bf16_final_cost_within_declared_gate(self, algo, seed):
+        d = _dcop(seed=seed)
+        ref = _solver(algo, d, precision=None, seed=seed).run(
+            cycles=40, chunk=20
+        )
+        got = _solver(algo, d, precision="bf16", seed=seed).run(
+            cycles=40, chunk=20
+        )
+        gate = max(BF16_COST_ATOL, BF16_COST_RTOL * abs(ref.cost))
+        assert abs(got.cost - ref.cost) <= gate, (
+            algo, seed, ref.cost, got.cost,
+        )
+
+    def test_int8_keeps_hard_instances_feasible(self):
+        # 0/BIG tables: the saturation pin must keep every violation
+        # visible, so the quantized run still reaches violation 0 on
+        # a colorable instance
+        d = generate_graph_coloring(
+            n_variables=12, n_colors=3, n_edges=16, soft=False,
+            n_agents=1, seed=4,
+        )
+        res = _solver("mgm", d, precision="int8", seed=0).run(
+            cycles=60, chunk=20
+        )
+        ref = _solver("mgm", d, precision=None, seed=0).run(
+            cycles=60, chunk=20
+        )
+        assert res.violation == ref.violation
+
+
+# ---------------------------------------------------------------------------
+# typed refusals (messages pinned)
+# ---------------------------------------------------------------------------
+
+
+class TestTierRefusals:
+    def test_weighted_rule_refuses_int8(self):
+        with pytest.raises(PrecisionError) as e:
+            _solver("dba", _dcop(), precision="int8")
+        assert str(e.value) == (
+            "dba does not support precision='int8' (supported: "
+            "bf16/f32); run precision=f32 (exact) or bf16 (statistical)"
+        )
+
+    def test_sharded_engines_refuse_int8(self):
+        from pydcop_tpu.analysis.registry import (
+            _mesh,
+            _ring_factor_tensors,
+        )
+        from pydcop_tpu.parallel.mesh import ShardedMaxSum
+
+        with pytest.raises(PrecisionError,
+                           match="single-device engine for int8"):
+            ShardedMaxSum(
+                _ring_factor_tensors(), _mesh(), precision="int8"
+            )
+
+    def test_batched_lanes_refuse_int8(self):
+        from pydcop_tpu.batch.cache import CompileCache
+        from pydcop_tpu.batch.engine import BatchEngine, BatchItem
+
+        items = [
+            BatchItem(_dcop(seed=s), "maxsum",
+                      algo_params={"precision": "int8"}, seed=s)
+            for s in (1, 2)
+        ]
+        engine = BatchEngine(cache=CompileCache(),
+                             max_padding_waste=0.9)
+        with pytest.raises(PrecisionError,
+                           match="do not stack int8"):
+            engine.solve(items, cycles=5)
+
+    def test_structured_sharding_refusal_typed_and_pinned(self):
+        from pydcop_tpu.analysis.registry import _structured_dcop
+        from pydcop_tpu.ops.compile import compile_factor_graph
+        from pydcop_tpu.parallel.mesh import (
+            StructuredShardingUnsupported,
+            shard_factor_graph,
+        )
+
+        assert issubclass(
+            StructuredShardingUnsupported, NotImplementedError
+        )
+        t = compile_factor_graph(_structured_dcop())
+        with pytest.raises(StructuredShardingUnsupported) as e:
+            shard_factor_graph(t, 2)
+        assert str(e.value) == (
+            "sharded maxsum does not yet shard table-free (structured) "
+            "buckets; run the single-device engine or densify small "
+            "structured constraints first"
+        )
+
+    def test_structured_batching_refusal_typed_and_pinned(self):
+        from types import SimpleNamespace
+
+        from pydcop_tpu.batch.bucketing import (
+            StructuredBatchingUnsupported,
+            dims_of,
+        )
+
+        assert issubclass(
+            StructuredBatchingUnsupported, NotImplementedError
+        )
+        fake = SimpleNamespace(sbuckets=[object()])
+        with pytest.raises(StructuredBatchingUnsupported) as e:
+            dims_of(fake, "factor_graph")
+        assert str(e.value) == (
+            "batched lanes do not yet pad table-free (structured) "
+            "buckets; solve structured instances on a dedicated lane"
+        )
+
+
+# ---------------------------------------------------------------------------
+# checkpoints record the tier; restore refuses a mismatch
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointTier:
+    def test_tier_recorded_and_mismatch_refused(self, tmp_path):
+        from pydcop_tpu.runtime.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        d = _dcop(seed=3)
+        s = _solver("mgm", d, precision="bf16", seed=0)
+        s.run(cycles=10, chunk=5)
+        path = str(tmp_path / "ck.npz")
+        save_checkpoint(path, s, cycle=10)
+
+        other = _solver("mgm", d, precision=None, seed=0)
+        with pytest.raises(PrecisionError) as e:
+            load_checkpoint(path, other)
+        msg = str(e.value)
+        assert "precision='bf16'" in msg and "precision='f32'" in msg
+
+        # matching tier restores fine and reports the recorded tier
+        twin = _solver("mgm", d, precision="bf16", seed=0)
+        meta = load_checkpoint(path, twin)
+        assert meta["precision"] == "bf16"
+
+    def test_pre_tier_checkpoints_default_to_f32(self, tmp_path):
+        # a meta without the key (older writer) restores into an f32
+        # solver — the default tier is the only one old files can hold
+        from pydcop_tpu.runtime.checkpoint import (
+            load_checkpoint,
+            read_state_npz,
+            save_checkpoint,
+            write_state_npz,
+        )
+
+        d = _dcop(seed=3)
+        s = _solver("mgm", d, seed=0)
+        s.run(cycles=5, chunk=5)
+        path = str(tmp_path / "old.npz")
+        save_checkpoint(path, s, cycle=5)
+        meta, arrays = read_state_npz(path)
+        assert meta.pop("precision") == "f32"
+        write_state_npz(path, arrays, meta)
+        fresh = _solver("mgm", d, seed=0)
+        meta2 = load_checkpoint(path, fresh)
+        assert meta2.get("precision", "f32") == "f32"
+
+
+# ---------------------------------------------------------------------------
+# the audited collective-byte cut (jaxpr-walked, not estimated)
+# ---------------------------------------------------------------------------
+
+
+class TestAuditedByteCut:
+    PAIRS = [
+        # the compact sharded maxsum cells and the packed local-search
+        # cells the acceptance names, plus the dense psum twin
+        ("sharded/maxsum/generic/exact",
+         "sharded/maxsum/generic/exact-bf16"),
+        ("sharded/maxsum/packed/exact",
+         "sharded/maxsum/packed/exact-bf16"),
+        ("sharded/mgm/packed/exact", "sharded/mgm/packed/exact-bf16"),
+        ("sharded/dsa/packed/off", "sharded/dsa/packed/off-bf16"),
+    ]
+
+    @pytest.mark.parametrize("f32_cell,bf16_cell", PAIRS)
+    def test_bf16_halves_the_walked_payload(self, f32_cell, bf16_cell):
+        from pydcop_tpu.analysis import registry
+
+        ra = registry.audit_cell(f32_cell)
+        rb = registry.audit_cell(bf16_cell)
+        assert not ra.findings, ra.findings
+        assert not rb.findings, rb.findings
+        a = ra.scorecard["max_collective_payload_bytes"]
+        b = rb.scorecard["max_collective_payload_bytes"]
+        assert a > 0 and b > 0
+        assert a >= 2 * b, (f32_cell, a, b)
+
+    def test_maxsum_total_cycle_payload_at_least_halves(self):
+        # maxsum has no f32 arbitration extras, so the SUM of every
+        # collective payload in one cycle must cut >= 2x too
+        import jax
+
+        from pydcop_tpu.analysis import registry
+        from pydcop_tpu.analysis.auditor import collect_collectives
+
+        def total(cell):
+            prog = registry.build_cell(cell)
+            closed = jax.make_jaxpr(prog.fn)(*prog.args)
+            return sum(n for _k, _s, n in collect_collectives(closed))
+
+        a = total("sharded/maxsum/generic/exact")
+        b = total("sharded/maxsum/generic/exact-bf16")
+        assert a > 0 and a >= 2 * b, (a, b)
+
+    def test_bf16_cells_declare_the_statistical_tier(self):
+        from pydcop_tpu.analysis import registry
+        from pydcop_tpu.parallel.mesh import _CommPlanMixin
+
+        assert _CommPlanMixin.PRECISION_TIERS == {
+            "f32": "exact", "bf16": "statistical",
+        }
+        names = registry.cell_names()
+        assert "sharded/maxsum/generic/exact-bf16" in names
+        assert "sharded/mgm/packed/exact-bf16" in names
+
+
+# ---------------------------------------------------------------------------
+# solve --auto: the cheap tiers only where safe
+# ---------------------------------------------------------------------------
+
+
+class TestPortfolioPrecision:
+    def _integer_dcop(self):
+        from pydcop_tpu.dcop.dcop import DCOP
+        from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+        from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+        rng = np.random.default_rng(0)
+        d = DCOP("ints", "min")
+        dom = Domain("c", "c", [0, 1, 2])
+        vs = [Variable(f"v{i}", dom) for i in range(8)]
+        for v in vs:
+            d.add_variable(v)
+        for i in range(8):
+            m = rng.integers(0, 10, (3, 3)).astype(float)
+            d.add_constraint(NAryMatrixRelation(
+                [vs[i], vs[(i + 1) % 8]], m, name=f"c{i}"))
+        d.add_agents([AgentDef("a0")])
+        return d
+
+    def test_int8_masked_on_float_and_hard_tables(self):
+        from pydcop_tpu.portfolio.features import featurize_detail
+        from pydcop_tpu.portfolio.select import (
+            DEFAULT_GRID,
+            feasible_grid,
+        )
+
+        for soft in (True, False):
+            d = generate_graph_coloring(
+                n_variables=10, n_colors=3, n_edges=14, soft=soft,
+                n_agents=1, seed=1,
+            )
+            _vec, info = featurize_detail(d)
+            assert info["int8_safe"] is False
+            feasible, masked = feasible_grid(
+                DEFAULT_GRID, info, n_devices=1
+            )
+            assert not any(
+                getattr(c, "precision", "f32") == "int8"
+                for c in feasible
+            )
+            reasons = [
+                r for c, r in masked
+                if getattr(c, "precision", "f32") == "int8"
+            ]
+            assert reasons and all(
+                r == ("int8 is only safe on integer-valued cost "
+                      "tables with range <= 253 and no hard/BIG "
+                      "entries")
+                for r in reasons
+            )
+
+    def test_int8_feasible_on_integer_small_range_tables(self):
+        from pydcop_tpu.portfolio.features import featurize_detail
+        from pydcop_tpu.portfolio.select import (
+            DEFAULT_GRID,
+            feasible_grid,
+        )
+
+        _vec, info = featurize_detail(self._integer_dcop())
+        assert info["int8_safe"] is True
+        feasible, _ = feasible_grid(DEFAULT_GRID, info, n_devices=1)
+        assert any(
+            getattr(c, "precision", "f32") == "int8" for c in feasible
+        )
+
+    def test_exact_engines_stay_f32_only(self):
+        from pydcop_tpu.portfolio.select import (
+            PortfolioConfig,
+            feasible_grid,
+        )
+
+        grid = (PortfolioConfig("dpop", engine="auto",
+                                precision="bf16"),)
+        info = {"sweep_bytes": 1024, "max_node_entries": 729}
+        feasible, masked = feasible_grid(grid, info, n_devices=1)
+        assert feasible == []
+        assert masked[0][1] == (
+            "the exact engines compute util tables in f32 only"
+        )
+
+    def test_precision_rides_the_config_key_and_params(self):
+        from pydcop_tpu.portfolio.select import PortfolioConfig
+
+        f32 = PortfolioConfig("mgm")
+        assert f32.key() == "mgm|harness|c0|default|t0.5|b0|i0"
+        assert f32.algo_params() == {}
+        b = PortfolioConfig("mgm", precision="bf16")
+        assert b.key().endswith("|pbf16")
+        assert b.algo_params() == {"precision": "bf16"}
+
+    def test_encoder_one_hots_the_tier(self):
+        from pydcop_tpu.portfolio.features import (
+            CONFIG_ENC_LEN,
+            CONFIG_ENC_NAMES,
+            encode_config,
+        )
+        from pydcop_tpu.portfolio.select import PortfolioConfig
+
+        i = CONFIG_ENC_NAMES.index("precision=int8")
+        enc = encode_config(PortfolioConfig("mgm", precision="int8"))
+        assert enc.shape == (CONFIG_ENC_LEN,)
+        assert enc[i] == 1.0
+        assert enc[CONFIG_ENC_NAMES.index("precision=f32")] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# warm engines: quantized in-place edits keep zero-retrace
+# ---------------------------------------------------------------------------
+
+
+class TestWarmQuantizedEdits:
+    @pytest.mark.parametrize("precision", ["bf16", "int8"])
+    def test_edit_factor_zero_retrace_at_cheap_tiers(self, precision):
+        from pydcop_tpu.algorithms.warm import build_warm_solver
+        from pydcop_tpu.dcop.relations import constraint_from_str
+        from pydcop_tpu.ops.headroom import EditFactor
+
+        d = _dcop(seed=5, V=10, E=14)
+        adef = AlgorithmDef.build_with_default_params(
+            "mgm", {"precision": precision}
+        )
+        s = build_warm_solver(
+            d, algo="mgm", algo_def=adef, seed=3, headroom=0.4
+        )
+        s.run(cycles=20, chunk=10)
+        t0 = s.trace_count()
+        name, old = next(iter(d.constraints.items()))
+        edited = constraint_from_str(
+            name, "1 if {} == {} else 4".format(
+                *[v.name for v in old.dimensions]
+            ),
+            list(old.dimensions),
+        )
+        s.apply_mutations([EditFactor(edited)])
+        d.constraints[name] = edited
+        res = s.run(cycles=20, chunk=10, resume=True)
+        assert s.trace_count() == t0, (
+            "a warm quantized mutation must not retrace"
+        )
+        assert res.status == "FINISHED"
+
+
+# ---------------------------------------------------------------------------
+# memo: the vectorized embedding scan (ISSUE 19 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestMemoVectorizedScan:
+    def _memo_with_bucket(self, feats_list):
+        import time
+
+        from pydcop_tpu.serve.memo import MemoCache, MemoEntry
+
+        memo = MemoCache()
+        bucket_key = ("t", "maxsum", "pk", "sig")
+        now = time.time()
+        for i, f in enumerate(feats_list):
+            key = f"k{i}"
+            e = MemoEntry(
+                key=key, tenant="t", algo="maxsum", pkey="pk",
+                seed=0, chash=f"h{i}", shape_sig="sig",
+                digests={}, assignment={}, status="FINISHED",
+                cost=0.0, violation=0, cycle=1, msg_count=0,
+                msg_size=0.0, yaml="", features=f, created_at=now,
+                last_used=now,
+            )
+            memo._entries[key] = e
+            memo._buckets.setdefault(bucket_key, []).append(key)
+        return memo
+
+    def _probe(self, feats):
+        from pydcop_tpu.serve.memo import MemoProbe
+
+        return MemoProbe(
+            "miss", "t", "maxsum", "pk", 0, "hX", "kX",
+            shape_sig="sig", digests={}, features=feats,
+        )
+
+    def test_nearest_entry_wins_and_distance_is_euclidean(self):
+        import time
+
+        f = np.zeros(4, np.float32)
+        memo = self._memo_with_bucket([
+            np.full(4, 3.0, np.float32),
+            np.full(4, 1.0, np.float32),
+            np.full(4, 2.0, np.float32),
+        ])
+        probe = self._probe(f)
+        with memo._lock:
+            memo._match_variant_locked(probe, time.time())
+        assert probe.kind == "variant"
+        assert probe.entry.key == "k1"
+        assert probe.distance == pytest.approx(2.0)
+
+    def test_tie_break_keeps_bucket_insertion_order(self):
+        import time
+
+        # k0 and k2 are equidistant; the stable argsort must pick the
+        # FIRST inserted — the exact tie-break of the per-entry sort
+        # the matrix scan replaced
+        memo = self._memo_with_bucket([
+            np.array([1.0, 0, 0, 0], np.float32),
+            np.array([5.0, 0, 0, 0], np.float32),
+            np.array([-1.0, 0, 0, 0], np.float32),
+        ])
+        probe = self._probe(np.zeros(4, np.float32))
+        with memo._lock:
+            memo._match_variant_locked(probe, time.time())
+        assert probe.entry.key == "k0"
+
+    def test_featureless_entries_rank_last_not_crash(self):
+        import time
+
+        memo = self._memo_with_bucket([
+            None,
+            np.full(4, 9.0, np.float32),
+        ])
+        probe = self._probe(np.zeros(4, np.float32))
+        with memo._lock:
+            memo._match_variant_locked(probe, time.time())
+        assert probe.entry.key == "k1"
+
+    def test_matches_the_reference_loop_bit_for_bit(self):
+        import time
+
+        rng = np.random.default_rng(11)
+        feats = [
+            rng.standard_normal(8).astype(np.float32)
+            for _ in range(17)
+        ] + [None]
+        memo = self._memo_with_bucket(feats)
+        probe_f = rng.standard_normal(8).astype(np.float32)
+
+        # the scan this replaced, verbatim
+        ranked = []
+        for i, f in enumerate(feats):
+            d = (
+                float(np.linalg.norm(probe_f - f.astype(np.float32)))
+                if f is not None else float("inf")
+            )
+            ranked.append((d, f"k{i}"))
+        ranked.sort(key=lambda t: t[0])
+
+        probe = self._probe(probe_f)
+        with memo._lock:
+            memo._match_variant_locked(probe, time.time())
+        assert probe.entry.key == ranked[0][1]
+        assert probe.distance == pytest.approx(ranked[0][0], rel=1e-6)
